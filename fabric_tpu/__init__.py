@@ -1,0 +1,8 @@
+"""fabric-tpu: a TPU-native permissioned-blockchain framework.
+
+Clean-room rebuild of the capability surface of Hyperledger Fabric
+(reference layer map: SURVEY.md §1) with batched TPU signature
+verification as the core compute path (see ARCHITECTURE.md).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,171 @@
+"""Leveled, per-logger-configurable logging.
+
+Equivalent of the reference's ``common/flogging`` (zap-based; see reference
+``common/flogging/{global,loggerlevels}.go``): named loggers, a runtime
+re-parseable *logging spec* of the form ``default-level:logger=level:...``
+(e.g. ``info:gossip=debug:ledger.statedb=error``), env var override
+``FABRIC_LOGGING_SPEC``, and an ActivateSpec admin hook (the reference exposes
+this over HTTP at /logspec — ours is wired in fabric_tpu/operations).
+
+Logger names are dot-separated; a spec entry applies to the named logger and
+all its children, longest prefix wins (matches the reference's
+``loggerlevels.go`` behavior).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "panic": logging.CRITICAL,
+    "fatal": logging.CRITICAL,
+}
+_LEVEL_NAMES = {
+    logging.DEBUG: "DEBU",
+    logging.INFO: "INFO",
+    logging.WARNING: "WARN",
+    logging.ERROR: "ERRO",
+    logging.CRITICAL: "FATA",
+}
+# Canonical spellings for spec() output — every value must parse back
+# through _LEVELS so activate_spec(spec()) round-trips.
+_CANONICAL = {
+    logging.DEBUG: "debug",
+    logging.INFO: "info",
+    logging.WARNING: "warn",
+    logging.ERROR: "error",
+    logging.CRITICAL: "fatal",
+}
+
+
+class _Formatter(logging.Formatter):
+    """Compact fabric-style line format: time [logger] LEVL message."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        t = time.strftime("%H:%M:%S", time.localtime(record.created))
+        lvl = _LEVEL_NAMES.get(record.levelno, "INFO")
+        msg = record.getMessage()
+        if record.exc_info:
+            msg += "\n" + self.formatException(record.exc_info)
+        return f"{t}.{int(record.msecs):03d} [{record.name}] {lvl} {msg}"
+
+
+class LoggerLevels:
+    """Per-logger level table with longest-prefix matching."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._default = logging.INFO
+        self._specs: dict[str, int] = {}
+
+    def activate_spec(self, spec: str) -> None:
+        """Parse and apply a logging spec. Invalid entries raise ValueError."""
+        default = logging.INFO
+        table: dict[str, int] = {}
+        for field in (spec or "").split(":"):
+            if not field:
+                continue
+            if "=" in field:
+                names, _, lvl = field.rpartition("=")
+                level = _parse_level(lvl)
+                for name in names.split(","):
+                    if not name:
+                        raise ValueError(f"invalid logging spec field: {field!r}")
+                    table[name] = level
+            else:
+                default = _parse_level(field)
+        with self._lock:
+            self._default = default
+            self._specs = table
+        _reapply_all()
+
+    def spec(self) -> str:
+        with self._lock:
+            parts = [
+                f"{name}={_CANONICAL[lvl]}"
+                for name, lvl in sorted(self._specs.items())
+            ]
+            parts.append(_CANONICAL[self._default])
+        return ":".join(parts)
+
+    def level_for(self, name: str) -> int:
+        with self._lock:
+            best, best_len = self._default, -1
+            for prefix, lvl in self._specs.items():
+                if name == prefix or name.startswith(prefix + "."):
+                    if len(prefix) > best_len:
+                        best, best_len = lvl, len(prefix)
+            return best
+
+
+def _parse_level(s: str) -> int:
+    try:
+        return _LEVELS[s.strip().lower()]
+    except KeyError:
+        raise ValueError(f"invalid logging level: {s!r}") from None
+
+
+_levels = LoggerLevels()
+_registry: dict[str, logging.Logger] = {}
+_registry_lock = threading.Lock()
+_handler: logging.Handler | None = None
+
+
+def _ensure_handler() -> logging.Handler:
+    global _handler
+    if _handler is None:
+        _handler = logging.StreamHandler(sys.stderr)
+        _handler.setFormatter(_Formatter())
+    return _handler
+
+
+def must_get_logger(name: str) -> logging.Logger:
+    """Return the named logger, registered for spec-driven level control.
+
+    Mirror of the reference's ``flogging.MustGetLogger``.
+    """
+    with _registry_lock:
+        logger = _registry.get(name)
+        if logger is None:
+            logger = logging.getLogger("fabric." + name)
+            logger.propagate = False
+            h = _ensure_handler()
+            if h not in logger.handlers:
+                logger.addHandler(h)
+            logger.setLevel(_levels.level_for(name))
+            _registry[name] = logger
+    return logger
+
+
+def _reapply_all() -> None:
+    with _registry_lock:
+        for name, logger in _registry.items():
+            logger.setLevel(_levels.level_for(name))
+
+
+def activate_spec(spec: str) -> None:
+    """Apply a logging spec globally (the /logspec admin operation)."""
+    _levels.activate_spec(spec)
+
+
+def spec() -> str:
+    return _levels.spec()
+
+
+# Initialize from the environment, like the reference's flogging init
+# (FABRIC_LOGGING_SPEC — reference common/flogging/global.go).
+_env_spec = os.environ.get("FABRIC_LOGGING_SPEC", "")
+if _env_spec:
+    try:
+        _levels.activate_spec(_env_spec)
+    except ValueError:
+        pass

@@ -1,0 +1,271 @@
+"""Metrics provider API: Counter / Gauge / Histogram with label currying.
+
+Equivalent of the reference's ``common/metrics`` (go-kit style; see reference
+``common/metrics/provider.go``): components receive a ``Provider`` and create
+instruments from ``*Opts``; ``with_labels(...)`` returns a curried instrument.
+Backends: ``PrometheusProvider`` (in-process registry rendered as Prometheus
+text exposition on the operations endpoint, like the reference's
+``/metrics``), ``StatsdProvider`` is TODO, and ``DisabledProvider`` (no-ops,
+reference ``common/metrics/disabled``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class CounterOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class GaugeOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class HistogramOpts:
+    namespace: str = ""
+    subsystem: str = ""
+    name: str = ""
+    help: str = ""
+    label_names: tuple[str, ...] = ()
+    buckets: tuple[float, ...] = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10,
+    )
+
+
+def _fqname(opts) -> str:
+    return "_".join(p for p in (opts.namespace, opts.subsystem, opts.name) if p)
+
+
+def _label_key(
+    names: tuple[str, ...], label_values: tuple[str, ...]
+) -> tuple[tuple[str, str], ...]:
+    if len(label_values) % 2 != 0:
+        raise ValueError("odd number of label values")
+    given = dict(zip(label_values[::2], label_values[1::2]))
+    return tuple((n, given.get(n, "")) for n in names)
+
+
+class Counter:
+    def __init__(self, opts: CounterOpts):
+        self.opts = opts
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+        self._labels: tuple[str, ...] = ()
+
+    def with_labels(self, *label_values: str) -> "Counter":
+        child = Counter.__new__(Counter)
+        child.opts = self.opts
+        child._lock = self._lock
+        child._values = self._values
+        child._labels = self._labels + label_values
+        return child
+
+    def add(self, delta: float = 1.0) -> None:
+        key = _label_key(self.opts.label_names, self._labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+
+class Gauge:
+    def __init__(self, opts: GaugeOpts):
+        self.opts = opts
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+        self._labels: tuple[str, ...] = ()
+
+    def with_labels(self, *label_values: str) -> "Gauge":
+        child = Gauge.__new__(Gauge)
+        child.opts = self.opts
+        child._lock = self._lock
+        child._values = self._values
+        child._labels = self._labels + label_values
+        return child
+
+    def set(self, value: float) -> None:
+        key = _label_key(self.opts.label_names, self._labels)
+        with self._lock:
+            self._values[key] = value
+
+    def add(self, delta: float) -> None:
+        key = _label_key(self.opts.label_names, self._labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+
+@dataclass
+class _HistState:
+    counts: list[int]
+    total: int = 0
+    sum: float = 0.0
+
+
+class Histogram:
+    def __init__(self, opts: HistogramOpts):
+        self.opts = opts
+        self._lock = threading.Lock()
+        self._states: dict[tuple, _HistState] = {}
+        self._labels: tuple[str, ...] = ()
+
+    def with_labels(self, *label_values: str) -> "Histogram":
+        child = Histogram.__new__(Histogram)
+        child.opts = self.opts
+        child._lock = self._lock
+        child._states = self._states
+        child._labels = self._labels + label_values
+        return child
+
+    def observe(self, value: float) -> None:
+        key = _label_key(self.opts.label_names, self._labels)
+        with self._lock:
+            st = self._states.get(key)
+            if st is None:
+                st = _HistState(counts=[0] * len(self.opts.buckets))
+                self._states[key] = st
+            for i, ub in enumerate(self.opts.buckets):
+                if value <= ub:
+                    st.counts[i] += 1
+            st.total += 1
+            st.sum += value
+
+
+class Provider:
+    """Abstract provider; see PrometheusProvider / DisabledProvider."""
+
+    def new_counter(self, opts: CounterOpts) -> Counter:
+        raise NotImplementedError
+
+    def new_gauge(self, opts: GaugeOpts) -> Gauge:
+        raise NotImplementedError
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        raise NotImplementedError
+
+
+class PrometheusProvider(Provider):
+    """Registry-backed provider rendering Prometheus text exposition."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: dict[str, object] = {}
+
+    def _register(self, name: str, inst):
+        with self._lock:
+            existing = self._instruments.get(name)
+            if existing is not None:
+                if type(existing) is not type(inst) or existing.opts != inst.opts:
+                    raise ValueError(
+                        f"metric {name} re-registered with different type or opts"
+                    )
+                return existing
+            self._instruments[name] = inst
+            return inst
+
+    def new_counter(self, opts: CounterOpts) -> Counter:
+        return self._register(_fqname(opts), Counter(opts))
+
+    def new_gauge(self, opts: GaugeOpts) -> Gauge:
+        return self._register(_fqname(opts), Gauge(opts))
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        return self._register(_fqname(opts), Histogram(opts))
+
+    def render(self) -> str:
+        """Prometheus text exposition format (for the /metrics endpoint)."""
+        out: list[str] = []
+        with self._lock:
+            instruments = dict(self._instruments)
+        for name, inst in sorted(instruments.items()):
+            if isinstance(inst, Counter):
+                out.append(f"# HELP {name} {inst.opts.help}")
+                out.append(f"# TYPE {name} counter")
+                with inst._lock:
+                    values = dict(inst._values)
+                for key, v in sorted(values.items()):
+                    out.append(f"{name}{_render_labels(key)} {_fmt(v)}")
+            elif isinstance(inst, Gauge):
+                out.append(f"# HELP {name} {inst.opts.help}")
+                out.append(f"# TYPE {name} gauge")
+                with inst._lock:
+                    values = dict(inst._values)
+                for key, v in sorted(values.items()):
+                    out.append(f"{name}{_render_labels(key)} {_fmt(v)}")
+            elif isinstance(inst, Histogram):
+                out.append(f"# HELP {name} {inst.opts.help}")
+                out.append(f"# TYPE {name} histogram")
+                with inst._lock:
+                    states = {
+                        k: _HistState(list(s.counts), s.total, s.sum)
+                        for k, s in inst._states.items()
+                    }
+                for key, st in sorted(states.items()):
+                    for ub, c in zip(inst.opts.buckets, st.counts):
+                        lk = key + (("le", _fmt(ub)),)
+                        out.append(f"{name}_bucket{_render_labels(lk)} {c}")
+                    lk = key + (("le", "+Inf"),)
+                    out.append(f"{name}_bucket{_render_labels(lk)} {st.total}")
+                    out.append(f"{name}_sum{_render_labels(key)} {_fmt(st.sum)}")
+                    out.append(f"{name}_count{_render_labels(key)} {st.total}")
+        return "\n".join(out) + "\n"
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _render_labels(key: tuple[tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+class _NoopInstrument:
+    """True no-op: no locks, no state (reference common/metrics/disabled)."""
+
+    def with_labels(self, *label_values: str) -> "_NoopInstrument":
+        return self
+
+    def add(self, delta: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+
+class DisabledProvider(Provider):
+    def __init__(self) -> None:
+        self._noop = _NoopInstrument()
+
+    def new_counter(self, opts: CounterOpts) -> Counter:
+        return self._noop  # type: ignore[return-value]
+
+    def new_gauge(self, opts: GaugeOpts) -> Gauge:
+        return self._noop  # type: ignore[return-value]
+
+    def new_histogram(self, opts: HistogramOpts) -> Histogram:
+        return self._noop  # type: ignore[return-value]

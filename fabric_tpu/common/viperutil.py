@@ -1,0 +1,128 @@
+"""Viper-style config loading: YAML file + env-var overrides.
+
+Equivalent of the reference's viper usage plus ``common/viperutil``
+(enhanced unmarshal): nested YAML trees addressed by dotted, case-insensitive
+paths; environment overrides of the form ``<PREFIX>_SECTION_SUBKEY=value``
+(reference: ``CORE_*`` for the peer — ``cmd/peer/main.go:33-36`` — and
+``ORDERER_*`` for the orderer); duration strings ("5s", "250ms"); byte-size
+ints; and relative-path resolution against the config file's directory.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import yaml
+
+_DURATION_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0,
+}
+
+
+def parse_duration(value: Any) -> float:
+    """Parse a Go-style duration string (possibly composite, '1m30s') to seconds."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip()
+    total, pos = 0.0, 0
+    for m in re.finditer(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)", s):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration: {value!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s) or pos == 0:
+        raise ValueError(f"invalid duration: {value!r}")
+    return total
+
+
+class Config:
+    """A loaded config tree with env overrides, addressed by dotted path."""
+
+    def __init__(self, tree: dict | None = None, env_prefix: str = "",
+                 config_dir: str = ""):
+        self._tree = tree or {}
+        self._env_prefix = env_prefix
+        self.config_dir = config_dir
+
+    @classmethod
+    def load(cls, path: str, env_prefix: str = "") -> "Config":
+        with open(path) as f:
+            tree = yaml.safe_load(f) or {}
+        return cls(tree, env_prefix, os.path.dirname(os.path.abspath(path)))
+
+    def _env_lookup(self, dotted: str) -> str | None:
+        if not self._env_prefix:
+            return None
+        key = self._env_prefix + "_" + dotted.upper().replace(".", "_")
+        return os.environ.get(key)
+
+    def get(self, dotted: str, default: Any = None) -> Any:
+        env = self._env_lookup(dotted)
+        if env is not None:
+            return _coerce(env)
+        node: Any = self._tree
+        for part in dotted.split("."):
+            if not isinstance(node, dict):
+                return default
+            found = None
+            for k in node:
+                if str(k).lower() == part.lower():
+                    found = node[k]
+                    break
+            else:
+                return default
+            node = found
+        return node if node is not None else default
+
+    def get_bool(self, dotted: str, default: bool = False) -> bool:
+        v = self.get(dotted, default)
+        if isinstance(v, str):
+            return v.strip().lower() in ("1", "true", "yes", "on")
+        return bool(v)
+
+    def get_int(self, dotted: str, default: int = 0) -> int:
+        v = self.get(dotted, default)
+        return int(v)
+
+    def get_duration(self, dotted: str, default: float = 0.0) -> float:
+        v = self.get(dotted, None)
+        if v is None:
+            return default
+        return parse_duration(v)
+
+    def get_path(self, dotted: str, default: str = "") -> str:
+        """Resolve a possibly-relative path against the config file's dir
+        (reference viperutil path translation)."""
+        v = self.get(dotted, default)
+        if not v:
+            return default
+        v = str(v)
+        if os.path.isabs(v):
+            return v
+        return os.path.join(self.config_dir, v)
+
+    def sub(self, dotted: str) -> "Config":
+        node = self.get(dotted, {})
+        prefix = (
+            self._env_prefix + "_" + dotted.upper().replace(".", "_")
+            if self._env_prefix else ""
+        )
+        sub = Config(node if isinstance(node, dict) else {}, prefix, self.config_dir)
+        return sub
+
+
+def _coerce(s: str) -> Any:
+    low = s.strip().lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s

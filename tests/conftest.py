@@ -1,0 +1,18 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh before JAX imports.
+
+Multi-chip sharding paths (fabric_tpu/parallel) are exercised on a virtual
+8-device CPU backend so the suite runs anywhere; real-TPU benchmarking lives
+in bench.py, which does NOT import this.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

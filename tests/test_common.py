@@ -1,0 +1,124 @@
+"""Tests for fabric_tpu.common: flogging, metrics, viperutil."""
+
+import logging
+import os
+
+import pytest
+
+from fabric_tpu.common import flogging, metrics, viperutil
+
+
+class TestFlogging:
+    def test_get_logger_and_default_level(self):
+        lg = flogging.must_get_logger("unittest.sub")
+        assert lg.level == logging.INFO
+
+    def test_activate_spec_prefix_matching(self):
+        a = flogging.must_get_logger("specmod")
+        b = flogging.must_get_logger("specmod.child")
+        c = flogging.must_get_logger("other")
+        flogging.activate_spec("warn:specmod=debug")
+        try:
+            assert a.level == logging.DEBUG
+            assert b.level == logging.DEBUG  # child inherits by prefix
+            assert c.level == logging.WARNING  # default applies
+        finally:
+            flogging.activate_spec("info")
+
+    def test_longest_prefix_wins(self):
+        a = flogging.must_get_logger("pfx.x")
+        b = flogging.must_get_logger("pfx.x.y")
+        flogging.activate_spec("info:pfx=error:pfx.x.y=debug")
+        try:
+            assert a.level == logging.ERROR
+            assert b.level == logging.DEBUG
+        finally:
+            flogging.activate_spec("info")
+
+    def test_invalid_spec_raises(self):
+        with pytest.raises(ValueError):
+            flogging.activate_spec("bogus-level")
+
+    def test_spec_roundtrip(self):
+        flogging.activate_spec("info:aaa=debug")
+        try:
+            assert "aaa=debug" in flogging.spec()
+        finally:
+            flogging.activate_spec("info")
+
+
+class TestMetrics:
+    def test_counter_with_labels(self):
+        p = metrics.PrometheusProvider()
+        c = p.new_counter(metrics.CounterOpts(
+            namespace="ledger", name="tx_count", label_names=("channel", "status")))
+        c.with_labels("channel", "ch1", "status", "valid").add(3)
+        c.with_labels("channel", "ch1", "status", "invalid").add()
+        text = p.render()
+        assert 'ledger_tx_count{channel="ch1",status="valid"} 3' in text
+        assert 'ledger_tx_count{channel="ch1",status="invalid"} 1' in text
+
+    def test_histogram_buckets(self):
+        p = metrics.PrometheusProvider()
+        h = p.new_histogram(metrics.HistogramOpts(
+            name="commit_time", buckets=(0.1, 1.0)))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        text = p.render()
+        assert 'commit_time_bucket{le="0.1"} 1' in text
+        assert 'commit_time_bucket{le="1"} 2' in text
+        assert 'commit_time_bucket{le="+Inf"} 3' in text
+        assert "commit_time_count 3" in text
+
+    def test_reregistration_returns_same_instrument(self):
+        p = metrics.PrometheusProvider()
+        a = p.new_gauge(metrics.GaugeOpts(name="g"))
+        b = p.new_gauge(metrics.GaugeOpts(name="g"))
+        assert a is b
+
+    def test_disabled_provider_noops(self):
+        p = metrics.DisabledProvider()
+        c = p.new_counter(metrics.CounterOpts(name="x"))
+        c.add(5)  # must not raise
+
+
+class TestViperutil:
+    def test_yaml_load_and_dotted_get(self, tmp_path):
+        cfg_file = tmp_path / "core.yaml"
+        cfg_file.write_text(
+            "peer:\n  id: peer0\n  gossip:\n    bootstrap: 127.0.0.1:7051\n"
+            "  validatorPoolSize: 4\n")
+        cfg = viperutil.Config.load(str(cfg_file), env_prefix="CORE")
+        assert cfg.get("peer.id") == "peer0"
+        assert cfg.get("PEER.Gossip.Bootstrap") == "127.0.0.1:7051"
+        assert cfg.get_int("peer.validatorPoolSize") == 4
+        assert cfg.get("peer.missing", "dflt") == "dflt"
+
+    def test_env_override(self, tmp_path, monkeypatch):
+        cfg_file = tmp_path / "core.yaml"
+        cfg_file.write_text("peer:\n  id: peer0\n")
+        monkeypatch.setenv("CORE_PEER_ID", "peer9")
+        cfg = viperutil.Config.load(str(cfg_file), env_prefix="CORE")
+        assert cfg.get("peer.id") == "peer9"
+
+    def test_durations(self):
+        assert viperutil.parse_duration("5s") == 5.0
+        assert viperutil.parse_duration("250ms") == 0.25
+        assert viperutil.parse_duration("1m30s") == 90.0
+        with pytest.raises(ValueError):
+            viperutil.parse_duration("xyz")
+
+    def test_path_resolution(self, tmp_path):
+        cfg_file = tmp_path / "core.yaml"
+        cfg_file.write_text("msp: msp/dir\nabs: /tmp/x\n")
+        cfg = viperutil.Config.load(str(cfg_file))
+        assert cfg.get_path("msp") == str(tmp_path / "msp" / "dir")
+        assert cfg.get_path("abs") == "/tmp/x"
+
+    def test_sub_config(self, tmp_path):
+        cfg_file = tmp_path / "core.yaml"
+        cfg_file.write_text("bccsp:\n  default: SW\n  sw:\n    hash: SHA2\n")
+        cfg = viperutil.Config.load(str(cfg_file))
+        sub = cfg.sub("bccsp")
+        assert sub.get("default") == "SW"
+        assert sub.get("sw.hash") == "SHA2"
