@@ -8,7 +8,10 @@ in bench.py, which does NOT import this.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment preconfigures a TPU platform
+# (e.g. JAX_PLATFORMS=axon tunneling to a remote chip): unit tests must be
+# hermetic and fast; eager per-op dispatch over a tunnel is neither.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,167 @@
+"""Differential tests: fabric_tpu.ops.limb vs Python bigint arithmetic."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fabric_tpu.ops import limb
+
+P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+rng = random.Random(1234)
+
+
+def rand_below(m, k=32):
+    vals = [rng.randrange(m) for _ in range(k - 4)]
+    # adversarial corners
+    vals += [0, 1, m - 1, (1 << 256) % m]
+    return vals
+
+
+@pytest.fixture(scope="module", params=[P256_P, P256_N], ids=["p", "n"])
+def mod(request):
+    return limb.Mod(request.param)
+
+
+class TestConverters:
+    def test_roundtrip(self):
+        for x in [0, 1, P256_P - 1, (1 << 256) - 1, 12345678901234567890]:
+            assert limb.limbs_to_int(limb.int_to_limbs(x)) == x
+
+    def test_too_big_raises(self):
+        with pytest.raises(ValueError):
+            limb.int_to_limbs(1 << 260)
+
+    def test_batch(self):
+        xs = [3, 5, 7]
+        arr = limb.ints_to_limbs(xs)
+        assert arr.shape == (3, limb.L)
+        assert [limb.limbs_to_int(a) for a in arr] == xs
+
+
+class TestCarry:
+    def test_carry3_preserves_value_and_bounds(self):
+        # worst-case realizable columns: product of two maximal
+        # semi-reduced values (< 2^256 + 2^243), product < 2^513 < 2^520
+        vmax = (1 << 256) + (1 << 243) - 1
+        a = jnp.asarray(limb.ints_to_limbs([vmax] * 4))
+        cols = limb.mul_columns(a, a)
+        # overflow wraps negative in int32, so prove exactness against the
+        # true bigint product rather than checking magnitudes
+        assert (np.asarray(cols) >= 0).all()
+        assert limb.limbs_to_int(np.asarray(cols[0], np.int64)) == vmax * vmax
+        out = np.asarray(limb.carry3(cols))
+        assert (out >= 0).all() and (out <= 1 << limb.W).all()
+        assert limb.limbs_to_int(out[0]) == vmax * vmax
+
+    def test_full_carry_strict(self):
+        # redundant limbs (some at 2^13) whose value still fits 20 limbs
+        x = limb.int_to_limbs((1 << 256) + (1 << 243) - 1)[None, :].copy()
+        x[0, :5] = 8192
+        assert limb.limbs_to_int(x[0]) < 1 << (limb.W * limb.L)
+        out = np.asarray(limb.full_carry(jnp.asarray(x)))
+        assert (out <= limb.MASK).all() and (out >= 0).all()
+        assert limb.limbs_to_int(out[0]) == limb.limbs_to_int(x[0])
+
+
+class TestModOps:
+    def _canon_int(self, mod, arr):
+        return limb.limbs_to_int(np.asarray(mod.canonical(arr)))
+
+    def test_mulmod(self, mod):
+        avs = rand_below(mod.m)
+        bvs = rand_below(mod.m)
+        a = jnp.asarray(limb.ints_to_limbs(avs))
+        b = jnp.asarray(limb.ints_to_limbs(bvs))
+        out = mod.mulmod(a, b)
+        for i, (x, y) in enumerate(zip(avs, bvs)):
+            assert self._canon_int(mod, out[i]) == (x * y) % mod.m
+
+    def test_addmod_submod(self, mod):
+        avs = rand_below(mod.m)
+        bvs = rand_below(mod.m)
+        a = jnp.asarray(limb.ints_to_limbs(avs))
+        b = jnp.asarray(limb.ints_to_limbs(bvs))
+        add = mod.addmod(a, b)
+        sub = mod.submod(a, b)
+        for i, (x, y) in enumerate(zip(avs, bvs)):
+            assert self._canon_int(mod, add[i]) == (x + y) % mod.m
+            assert self._canon_int(mod, sub[i]) == (x - y) % mod.m
+
+    def test_long_redundant_chains(self, mod):
+        """Chain ops on semi-reduced intermediates; compare at the end."""
+        m = mod.m
+        xs = rand_below(m, 8)
+        ys = rand_below(m, 8)
+        zs = rand_below(m, 8)
+        x = jnp.asarray(limb.ints_to_limbs(xs))
+        y = jnp.asarray(limb.ints_to_limbs(ys))
+        z = jnp.asarray(limb.ints_to_limbs(zs))
+        # ((x*y + z - x)^2 * y + (z - y)) repeated twice through redundant form
+        acc = mod.mulmod(x, y)
+        acc = mod.addmod(acc, z)
+        acc = mod.submod(acc, x)
+        acc = mod.mulmod(acc, acc)
+        acc = mod.mulmod(acc, y)
+        acc = mod.addmod(acc, mod.submod(z, y))
+        acc = mod.submod(mod.mulmod(acc, acc), acc)
+        for i in range(len(xs)):
+            ref = (xs[i] * ys[i] + zs[i] - xs[i]) % m
+            ref = (ref * ref) % m
+            ref = (ref * ys[i]) % m
+            ref = (ref + zs[i] - ys[i]) % m
+            ref = (ref * ref - ref) % m
+            assert self._canon_int(mod, acc[i]) == ref
+
+    def test_sub_stays_nonnegative(self, mod):
+        """submod of 0 - (m-1): all intermediate limbs must be >= 0."""
+        a = jnp.asarray(limb.ints_to_limbs([0, 1]))
+        b = jnp.asarray(limb.ints_to_limbs([mod.m - 1, mod.m - 1]))
+        out = mod.submod(a, b)
+        assert (np.asarray(out) >= 0).all()
+        assert self._canon_int(mod, out[0]) == 1
+        assert self._canon_int(mod, out[1]) == 2
+
+    def test_eq(self, mod):
+        m = mod.m
+        a = jnp.asarray(limb.ints_to_limbs([5, 7]))
+        b = jnp.asarray(limb.ints_to_limbs([3, 7]))
+        two = jnp.asarray(limb.ints_to_limbs([2, 2]))
+        # 5 == 3 + 2; 7 != 7 + 2
+        lhs = mod.addmod(b, two)
+        got = np.asarray(mod.eq(a, lhs))
+        assert got[0] and not got[1]
+
+    def test_canonical_of_semireduced_max(self, mod):
+        """Semi-reduced values just below 2^256 + 2^243 canonicalize right."""
+        for v in [mod.m, mod.m + 1, (1 << 256) - 1, (1 << 256) + (1 << 243) - 1]:
+            arr = np.zeros((1, limb.L), dtype=np.int64)
+            t = v
+            for i in range(limb.L):
+                arr[0, i] = t & limb.MASK
+                t >>= limb.W
+            assert t == 0
+            out = self._canon_int(mod, jnp.asarray(arr[0], dtype=jnp.int32))
+            assert out == v % mod.m
+
+
+class TestWordRepack:
+    def test_digest_words_to_limbs(self):
+        digests = [bytes(range(32)), b"\xff" * 32, b"\x00" * 31 + b"\x01"]
+        words = np.zeros((len(digests), 8), dtype=np.uint32)
+        for bi, d in enumerate(digests):
+            for w in range(8):
+                words[bi, w] = int.from_bytes(d[4 * w : 4 * w + 4], "big")
+        out = np.asarray(limb.words_be_to_limbs(jnp.asarray(words)))
+        for bi, d in enumerate(digests):
+            assert limb.limbs_to_int(out[bi]) == int.from_bytes(d, "big")
+
+
+class TestModInit:
+    def test_rejects_small_modulus(self):
+        with pytest.raises(ValueError):
+            limb.Mod(1 << 200)
