@@ -1,0 +1,162 @@
+"""P-256 verification core tests.
+
+Ground truth comes from two independent oracles: the `cryptography`
+package (OpenSSL) for scalar-mul/sign/verify, and a textbook affine
+implementation for edge cases the library won't produce.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from fabric_tpu.ops import limb, p256, sha256
+
+rng = random.Random(99)
+
+
+def openssl_point(k: int):
+    """k*G via OpenSSL — independent oracle."""
+    priv = ec.derive_private_key(k, ec.SECP256R1())
+    nums = priv.public_key().public_numbers()
+    return (nums.x, nums.y)
+
+
+def rand_proj(pt, z=None):
+    """Rescale an affine int point to random-Z projective coordinates."""
+    x, y = pt
+    z = z or rng.randrange(1, p256.P)
+    return (x * z % p256.P, y * z % p256.P, z)
+
+
+class TestIntReference:
+    def test_matches_openssl_scalar_mul(self):
+        for _ in range(4):
+            k = rng.randrange(1, p256.N)
+            got = p256.to_affine_int(p256.scalar_mul_int(k, (p256.GX, p256.GY, 1)))
+            assert got == openssl_point(k)
+
+    def test_complete_edge_cases(self):
+        G = (p256.GX, p256.GY, 1)
+        inf = (0, 1, 0)
+        # P + inf = P
+        assert p256.to_affine_int(p256.cadd_int(G, inf)) == (p256.GX, p256.GY)
+        # inf + inf = inf
+        assert p256.to_affine_int(p256.cadd_int(inf, inf)) is None
+        # P + (-P) = inf
+        negG = (p256.GX, p256.P - p256.GY, 1)
+        assert p256.to_affine_int(p256.cadd_int(G, negG)) is None
+        # doubling through the same formula: G + G == 2G
+        two_g = p256.to_affine_int(p256.cadd_int(G, G))
+        assert two_g == openssl_point(2)
+
+    def test_order_times_g_is_infinity(self):
+        assert p256.to_affine_int(p256.scalar_mul_int(p256.N, (p256.GX, p256.GY, 1))) is None
+
+
+class TestLimbCadd:
+    def test_matches_int_reference(self):
+        pts = []
+        for _ in range(4):
+            k1, k2 = rng.randrange(1, p256.N), rng.randrange(1, p256.N)
+            p1 = rand_proj(openssl_point(k1))
+            p2 = rand_proj(openssl_point(k2))
+            pts.append((p1, p2))
+        # include doubling and inf cases in the same batch
+        G = (p256.GX, p256.GY, 1)
+        pts.append((rand_proj(openssl_point(5)), rand_proj(openssl_point(5))))
+        pts.append(((0, 1, 0), G))
+
+        def stack(coord_idx, side):
+            return jnp.asarray(
+                limb.ints_to_limbs([pair[side][coord_idx] for pair in pts])
+            )
+
+        p1 = tuple(stack(c, 0) for c in range(3))
+        p2 = tuple(stack(c, 1) for c in range(3))
+        X, Y, Z = jax.jit(p256.cadd)(p1, p2)
+        for i, (a, b) in enumerate(pts):
+            want = p256.cadd_int(a, b)
+            got = tuple(
+                limb.limbs_to_int(np.asarray(p256.FP.canonical(v[i])))
+                for v in (X, Y, Z)
+            )
+            assert p256.to_affine_int(got) == p256.to_affine_int(want), f"pair {i}"
+
+
+class TestDoubleScalarMul:
+    def test_matches_int_reference(self):
+        B = 4
+        u1s = [rng.randrange(0, p256.N) for _ in range(B)]
+        u2s = [rng.randrange(1, p256.N) for _ in range(B)]
+        qs = [openssl_point(rng.randrange(1, p256.N)) for _ in range(B)]
+        u1 = jnp.asarray(limb.ints_to_limbs(u1s))
+        u2 = jnp.asarray(limb.ints_to_limbs(u2s))
+        qx = jnp.asarray(limb.ints_to_limbs([q[0] for q in qs]))
+        qy = jnp.asarray(limb.ints_to_limbs([q[1] for q in qs]))
+        X, Y, Z = jax.jit(p256.double_scalar_mul)(u1, u2, qx, qy)
+        for i in range(B):
+            want = p256.cadd_int(
+                p256.scalar_mul_int(u1s[i], (p256.GX, p256.GY, 1)),
+                p256.scalar_mul_int(u2s[i], (qs[i][0], qs[i][1], 1)),
+            )
+            got = tuple(
+                limb.limbs_to_int(np.asarray(p256.FP.canonical(v[i])))
+                for v in (X, Y, Z)
+            )
+            assert p256.to_affine_int(got) == p256.to_affine_int(want), f"lane {i}"
+
+
+class TestVerifyCore:
+    def _run(self, msgs, keys, sigs, tamper=None):
+        """Build kernel inputs from (msg, key, (r, s)) triples."""
+        B = len(msgs)
+        digests = [hashlib.sha256(m).digest() for m in msgs]
+        words = np.zeros((B, 8), dtype=np.uint32)
+        for i, d in enumerate(digests):
+            words[i] = np.frombuffer(d, dtype=">u4")
+        qx = limb.ints_to_limbs([k[0] for k in keys])
+        qy = limb.ints_to_limbs([k[1] for k in keys])
+        rs = [s[0] for s in sigs]
+        ws = [pow(s[1], -1, p256.N) for s in sigs]
+        rpn = [r + p256.N if r + p256.N < p256.P else r for r in rs]
+        out = jax.jit(p256.verify_core)(
+            jnp.asarray(words),
+            jnp.asarray(qx),
+            jnp.asarray(qy),
+            jnp.asarray(limb.ints_to_limbs(rs)),
+            jnp.asarray(limb.ints_to_limbs(rpn)),
+            jnp.asarray(limb.ints_to_limbs(ws)),
+            jnp.ones((B,), dtype=bool),
+        )
+        return np.asarray(out)
+
+    def test_valid_and_tampered_signatures(self):
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+        )
+
+        B = 6
+        msgs, keys, sigs = [], [], []
+        for i in range(B):
+            priv = ec.generate_private_key(ec.SECP256R1())
+            msg = f"fabric tx payload {i}".encode() * (i + 1)
+            der = priv.sign(msg, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
+            nums = priv.public_key().public_numbers()
+            msgs.append(msg)
+            keys.append((nums.x, nums.y))
+            sigs.append((r, s))
+        # lanes 0..2 valid; tamper lane 3 msg, lane 4 sig, lane 5 wrong key
+        msgs[3] = msgs[3] + b"!"
+        sigs[4] = (sigs[4][0], (sigs[4][1] * 7) % p256.N or 1)
+        keys[5] = openssl_point(424242)
+        got = self._run(msgs, keys, sigs)
+        assert got.tolist() == [True, True, True, False, False, False]

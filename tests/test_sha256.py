@@ -1,0 +1,51 @@
+"""Differential tests: fabric_tpu.ops.sha256 vs hashlib."""
+
+import hashlib
+import random
+
+import numpy as np
+
+from fabric_tpu.ops import sha256
+
+
+def _ref(msg: bytes) -> np.ndarray:
+    d = hashlib.sha256(msg).digest()
+    return np.frombuffer(d, dtype=">u4").astype(np.uint32)
+
+
+class TestSha256:
+    def test_known_vectors(self):
+        msgs = [b"", b"abc", b"a" * 55, b"a" * 56, b"a" * 64, b"a" * 119]
+        got = sha256.sha256_host(msgs)
+        for i, m in enumerate(msgs):
+            assert (got[i] == _ref(m)).all(), f"mismatch for {m!r}"
+
+    def test_random_lengths_mixed_bucket(self):
+        rng = random.Random(7)
+        msgs = [
+            bytes(rng.randrange(256) for _ in range(rng.randrange(0, 300)))
+            for _ in range(32)
+        ]
+        got = sha256.sha256_host(msgs)
+        for i, m in enumerate(msgs):
+            assert (got[i] == _ref(m)).all()
+
+    def test_block_boundaries(self):
+        # padding boundary cases: 55/56 force 1 vs 2 blocks, 119/120 2 vs 3
+        msgs = [b"x" * k for k in (0, 1, 54, 55, 56, 63, 64, 118, 119, 120)]
+        got = sha256.sha256_host(msgs)
+        for i, m in enumerate(msgs):
+            assert (got[i] == _ref(m)).all()
+
+    def test_max_message_len(self):
+        assert sha256.max_message_len(1) == 55
+        assert sha256.max_message_len(2) == 119
+        m = b"z" * sha256.max_message_len(3)
+        got = sha256.sha256_host([m], nb=3)
+        assert (got[0] == _ref(m)).all()
+
+    def test_too_long_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            sha256.pack_messages([b"x" * 200], nb=2)
