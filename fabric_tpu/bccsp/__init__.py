@@ -1,0 +1,26 @@
+"""BCCSP — the blockchain crypto service provider seam.
+
+Rebuild of the reference's `bccsp/` tree (`bccsp/bccsp.go:15-134`): a
+pluggable provider interface with `sw` (CPU, the oracle) and `tpu`
+(batched JAX) implementations behind a config-driven factory
+(`bccsp/factory/factory.go:42`). The one deliberate contract change is
+batch-first verification: `BCCSP.verify_batch([...VerifyItem]) -> bools`,
+which the block-validation path uses to verify a whole block's signatures
+as one fixed-shape TPU program.
+"""
+
+from fabric_tpu.bccsp.bccsp import (  # noqa: F401
+    BCCSP,
+    Key,
+    VerifyItem,
+    AES256KeyGenOpts,
+    ECDSAKeyGenOpts,
+    ECDSAPrivateKeyImportOpts,
+    ECDSAPublicKeyImportOpts,
+    X509PublicKeyImportOpts,
+    SHA256Opts,
+    SHA384Opts,
+    SHA3_256Opts,
+    SHA3_384Opts,
+)
+from fabric_tpu.bccsp.factory import get_default, init_factories  # noqa: F401

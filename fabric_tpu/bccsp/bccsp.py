@@ -1,0 +1,133 @@
+"""BCCSP interfaces and option types.
+
+Mirrors the reference contract (`bccsp/bccsp.go:15-134`; opts in
+`bccsp/opts.go`, `bccsp/ecdsaopts.go`, `bccsp/hashopts.go`) with one
+extension: `verify_batch`, the batch-first path the reference lacks
+(its per-call `Verify(k, sig, digest)` is the CPU bottleneck this
+framework exists to remove).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+class Key(abc.ABC):
+    """A cryptographic key handle (reference: `bccsp/bccsp.go:15-45`)."""
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes:
+        """Serialized form, if allowed (public keys: DER SPKI)."""
+
+    @abc.abstractmethod
+    def ski(self) -> bytes:
+        """Subject Key Identifier — SHA-256 of the uncompressed point for
+        ECDSA keys (reference: `bccsp/sw/ecdsakey.go`)."""
+
+    @abc.abstractmethod
+    def symmetric(self) -> bool: ...
+
+    @abc.abstractmethod
+    def private(self) -> bool: ...
+
+    def public_key(self) -> "Key":
+        """Corresponding public part of an asymmetric key pair."""
+        raise TypeError("not an asymmetric key")
+
+
+@dataclass(frozen=True)
+class VerifyItem:
+    """One signature verification request for the batch path.
+
+    Exactly one of `message` / `digest` is set: `message` routes hashing
+    to the provider (the TPU provider hashes on-device), `digest` is a
+    precomputed SHA-256 digest (reference semantics:
+    `bccsp.Verify(k, signature, digest)`).
+    """
+
+    key: Key
+    signature: bytes
+    message: Optional[bytes] = None
+    digest: Optional[bytes] = None
+
+
+# --- option types (constructor-arg carriers, like the reference's Opts) ---
+
+@dataclass(frozen=True)
+class ECDSAKeyGenOpts:
+    ephemeral: bool = False
+    curve: str = "P-256"
+
+
+@dataclass(frozen=True)
+class AES256KeyGenOpts:
+    ephemeral: bool = False
+
+
+@dataclass(frozen=True)
+class ECDSAPrivateKeyImportOpts:
+    ephemeral: bool = False
+
+
+@dataclass(frozen=True)
+class ECDSAPublicKeyImportOpts:
+    ephemeral: bool = False
+
+
+@dataclass(frozen=True)
+class X509PublicKeyImportOpts:
+    ephemeral: bool = False
+
+
+class SHA256Opts:
+    algorithm = "SHA256"
+
+
+class SHA384Opts:
+    algorithm = "SHA384"
+
+
+class SHA3_256Opts:
+    algorithm = "SHA3_256"
+
+
+class SHA3_384Opts:
+    algorithm = "SHA3_384"
+
+
+class BCCSP(abc.ABC):
+    """The provider contract (reference: `bccsp/bccsp.go:90-134`)."""
+
+    @abc.abstractmethod
+    def key_gen(self, opts) -> Key: ...
+
+    @abc.abstractmethod
+    def key_import(self, raw, opts) -> Key: ...
+
+    @abc.abstractmethod
+    def get_key(self, ski: bytes) -> Key: ...
+
+    @abc.abstractmethod
+    def hash(self, msg: bytes, opts=None) -> bytes: ...
+
+    @abc.abstractmethod
+    def sign(self, key: Key, digest: bytes, opts=None) -> bytes: ...
+
+    @abc.abstractmethod
+    def verify(self, key: Key, signature: bytes, digest: bytes,
+               opts=None) -> bool: ...
+
+    @abc.abstractmethod
+    def verify_batch(self, items: Sequence[VerifyItem]) -> list[bool]:
+        """Verify many independent signatures; element i is the
+        accept/reject for items[i]. Must be bit-identical to calling
+        `verify` per item (with provider-side hashing for `message`
+        items)."""
+
+    @abc.abstractmethod
+    def encrypt(self, key: Key, plaintext: bytes, opts=None) -> bytes: ...
+
+    @abc.abstractmethod
+    def decrypt(self, key: Key, ciphertext: bytes, opts=None) -> bytes: ...

@@ -1,0 +1,106 @@
+"""BCCSP factory: config-driven provider selection + process singleton.
+
+Rebuild of `bccsp/factory/` (`factory.go:17-55`, `nopkcs11.go:20-34`,
+`swfactory.go:38`): `FactoryOpts{default: "SW"|"TPU", ...}` chooses the
+provider; `get_default()` is the handle injected throughout the node
+(reference injection sites: `cmd/peer/main.go:46`,
+`internal/peer/node/start.go:289`). `BCCSP.Default: TPU` in core.yaml is
+the only user-visible switch — no other layer imports the tpu module.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from fabric_tpu.bccsp.bccsp import BCCSP
+
+_lock = threading.Lock()
+_default: Optional[BCCSP] = None
+
+
+@dataclass
+class SwOpts:
+    hash_family: str = "SHA2"
+    security: int = 256
+    keystore_path: Optional[str] = None
+
+
+@dataclass
+class TpuOpts:
+    min_batch: int = 16
+    max_blocks: int = 64
+    n_devices: Optional[int] = None   # None = single-device (no mesh)
+
+
+@dataclass
+class FactoryOpts:
+    default: str = "SW"
+    sw: SwOpts = field(default_factory=SwOpts)
+    tpu: TpuOpts = field(default_factory=TpuOpts)
+
+    @classmethod
+    def from_config(cls, cfg: dict) -> "FactoryOpts":
+        """Build from a core.yaml-style `BCCSP:` mapping (reference:
+        `sampleconfig/core.yaml:319-343` plus the new `TPU:` sibling)."""
+        cfg = cfg or {}
+        sw_cfg = cfg.get("SW") or {}
+        tpu_cfg = cfg.get("TPU") or {}
+        fks = sw_cfg.get("FileKeyStore") or {}
+        return cls(
+            default=(cfg.get("Default") or "SW").upper(),
+            sw=SwOpts(
+                hash_family=sw_cfg.get("Hash", "SHA2"),
+                security=int(sw_cfg.get("Security", 256)),
+                keystore_path=fks.get("KeyStore") or None,
+            ),
+            tpu=TpuOpts(
+                min_batch=int(tpu_cfg.get("MinBatch", 16)),
+                max_blocks=int(tpu_cfg.get("MaxBlocks", 64)),
+                n_devices=tpu_cfg.get("Devices"),
+            ),
+        )
+
+
+def new_bccsp(opts: FactoryOpts) -> BCCSP:
+    from fabric_tpu.bccsp.keystore import FileKeyStore
+
+    ks = FileKeyStore(opts.sw.keystore_path) if opts.sw.keystore_path else None
+    if opts.default == "SW":
+        from fabric_tpu.bccsp.sw import SWProvider
+        return SWProvider(ks)
+    if opts.default == "TPU":
+        from fabric_tpu.bccsp.tpu import TPUProvider
+        mesh = None
+        if opts.tpu.n_devices:
+            from fabric_tpu.parallel import batch_mesh
+            mesh = batch_mesh(opts.tpu.n_devices)
+        return TPUProvider(ks, min_batch=opts.tpu.min_batch,
+                           max_blocks=opts.tpu.max_blocks, mesh=mesh)
+    raise ValueError(f"unknown BCCSP default {opts.default!r}")
+
+
+def init_factories(opts: Optional[FactoryOpts] = None) -> BCCSP:
+    """Initialize the process-wide default provider (idempotent, like
+    `bccsp/factory/nopkcs11.go:29` InitFactories' sync.Once)."""
+    global _default
+    with _lock:
+        if _default is None:
+            _default = new_bccsp(opts or FactoryOpts())
+        return _default
+
+
+def get_default() -> BCCSP:
+    """The singleton handle (reference: `factory.go:42` GetDefault, which
+    lazily falls back to SW with a warning)."""
+    global _default
+    if _default is None:
+        return init_factories()
+    return _default
+
+
+def _reset_for_tests() -> None:
+    global _default
+    with _lock:
+        _default = None

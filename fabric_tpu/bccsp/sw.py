@@ -1,0 +1,249 @@
+"""Software BCCSP provider — the CPU oracle.
+
+Rebuild of `bccsp/sw/` (`impl.go`, `ecdsa.go`, `aes.go`, `hash.go`):
+ECDSA-P256 sign/verify via OpenSSL (`cryptography`), SHA-2/SHA-3 hashing,
+AES-256-CBC-PKCS7. Where the reference dispatches on reflect.Type maps
+(`bccsp/sw/impl.go:34-45`), Python single-dispatches on key/opts classes.
+
+Verification semantics (`bccsp/sw/ecdsa.go:41-57`, order preserved):
+DER unmarshal (shared strict parser) → low-S policy → curve verify.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional, Sequence
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+from cryptography import x509
+from cryptography.exceptions import InvalidSignature
+
+from fabric_tpu.bccsp import bccsp as api
+from fabric_tpu.bccsp import utils
+
+
+def _point_ski(pub: ec.EllipticCurvePublicKey) -> bytes:
+    """SKI = SHA-256 over the uncompressed point (reference:
+    `bccsp/sw/ecdsakey.go` SKI())."""
+    raw = pub.public_bytes(
+        serialization.Encoding.X962,
+        serialization.PublicFormat.UncompressedPoint,
+    )
+    return hashlib.sha256(raw).digest()
+
+
+class ECDSAPublicKey(api.Key):
+    def __init__(self, pub: ec.EllipticCurvePublicKey):
+        self._pub = pub
+        nums = pub.public_numbers()
+        self.x, self.y = nums.x, nums.y
+
+    def bytes(self) -> bytes:
+        return self._pub.public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+
+    def ski(self) -> bytes:
+        return _point_ski(self._pub)
+
+    def symmetric(self) -> bool:
+        return False
+
+    def private(self) -> bool:
+        return False
+
+    def public_key(self) -> "ECDSAPublicKey":
+        return self
+
+    @property
+    def raw(self) -> ec.EllipticCurvePublicKey:
+        return self._pub
+
+
+class ECDSAPrivateKey(api.Key):
+    def __init__(self, priv: ec.EllipticCurvePrivateKey):
+        self._priv = priv
+
+    def bytes(self) -> bytes:
+        raise TypeError("private key export not allowed")
+
+    def ski(self) -> bytes:
+        return _point_ski(self._priv.public_key())
+
+    def symmetric(self) -> bool:
+        return False
+
+    def private(self) -> bool:
+        return True
+
+    def public_key(self) -> ECDSAPublicKey:
+        return ECDSAPublicKey(self._priv.public_key())
+
+    @property
+    def raw(self) -> ec.EllipticCurvePrivateKey:
+        return self._priv
+
+
+class AESKey(api.Key):
+    def __init__(self, raw: bytes):
+        self._raw = raw
+
+    def bytes(self) -> bytes:
+        raise TypeError("symmetric key export not allowed")
+
+    def ski(self) -> bytes:
+        return hashlib.sha256(self._raw).digest()
+
+    def symmetric(self) -> bool:
+        return True
+
+    def private(self) -> bool:
+        return True
+
+    @property
+    def raw(self) -> bytes:
+        return self._raw
+
+
+_HASHERS = {
+    "SHA256": hashlib.sha256,
+    "SHA384": hashlib.sha384,
+    "SHA3_256": hashlib.sha3_256,
+    "SHA3_384": hashlib.sha3_384,
+}
+
+
+def check_signature(key, signature: bytes) -> Optional[tuple[int, int]]:
+    """Shared pre-validation: strict DER + positivity + low-S.
+
+    Returns (r, s) if the signature passes the format gates, else None.
+    Both providers call this, so their accept/reject sets can only differ
+    in the curve equation itself (which differential tests then pin).
+    """
+    try:
+        r, s = utils.unmarshal_signature(signature)
+    except utils.SignatureFormatError:
+        return None
+    if not utils.is_low_s(s):
+        return None
+    return (r, s)
+
+
+class SWProvider(api.BCCSP):
+    """CPU provider (reference: `bccsp/sw/new.go` NewDefaultSecurityLevel)."""
+
+    def __init__(self, keystore=None):
+        self._ks = keystore
+
+    # -- keys --
+
+    def key_gen(self, opts) -> api.Key:
+        if isinstance(opts, api.ECDSAKeyGenOpts):
+            key = ECDSAPrivateKey(ec.generate_private_key(ec.SECP256R1()))
+        elif isinstance(opts, api.AES256KeyGenOpts):
+            key = AESKey(os.urandom(32))
+        else:
+            raise TypeError(f"unsupported KeyGenOpts {opts!r}")
+        if self._ks is not None and not opts.ephemeral:
+            self._ks.store_key(key)
+        return key
+
+    def key_import(self, raw, opts) -> api.Key:
+        if isinstance(opts, api.X509PublicKeyImportOpts):
+            cert = raw if isinstance(raw, x509.Certificate) \
+                else x509.load_der_x509_certificate(raw)
+            pub = cert.public_key()
+            if not isinstance(pub, ec.EllipticCurvePublicKey):
+                raise TypeError("certificate does not carry an EC key")
+            return ECDSAPublicKey(pub)
+        if isinstance(opts, api.ECDSAPublicKeyImportOpts):
+            if isinstance(raw, ec.EllipticCurvePublicKey):
+                return ECDSAPublicKey(raw)
+            return ECDSAPublicKey(serialization.load_der_public_key(raw))
+        if isinstance(opts, api.ECDSAPrivateKeyImportOpts):
+            if isinstance(raw, ec.EllipticCurvePrivateKey):
+                return ECDSAPrivateKey(raw)
+            key = serialization.load_der_private_key(raw, password=None)
+            return ECDSAPrivateKey(key)
+        raise TypeError(f"unsupported KeyImportOpts {opts!r}")
+
+    def get_key(self, ski: bytes) -> api.Key:
+        if self._ks is None:
+            raise KeyError("no keystore configured")
+        return self._ks.get_key(ski)
+
+    # -- hashing --
+
+    def hash(self, msg: bytes, opts=None) -> bytes:
+        alg = getattr(opts, "algorithm", "SHA256") if opts else "SHA256"
+        return _HASHERS[alg](msg).digest()
+
+    # -- sign/verify --
+
+    def sign(self, key: api.Key, digest: bytes, opts=None) -> bytes:
+        """Low-S DER signature over a precomputed digest (reference:
+        `bccsp/sw/ecdsa.go:27-39` signECDSA → ToLowS → marshal)."""
+        if not isinstance(key, ECDSAPrivateKey):
+            raise TypeError("sign requires an ECDSA private key")
+        der = key.raw.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+        r, s = decode_dss_signature(der)
+        return utils.marshal_signature(r, utils.to_low_s(s))
+
+    def verify(self, key: api.Key, signature: bytes, digest: bytes,
+               opts=None) -> bool:
+        pub = key.public_key()
+        if not isinstance(pub, ECDSAPublicKey):
+            raise TypeError("verify requires an ECDSA key")
+        rs = check_signature(pub, signature)
+        if rs is None:
+            return False
+        try:
+            pub.raw.verify(
+                encode_dss_signature(*rs),
+                digest,
+                ec.ECDSA(Prehashed(hashes.SHA256())),
+            )
+            return True
+        except InvalidSignature:
+            return False
+
+    def verify_batch(self, items: Sequence[api.VerifyItem]) -> list[bool]:
+        out = []
+        for it in items:
+            digest = it.digest if it.digest is not None \
+                else self.hash(it.message)
+            out.append(self.verify(it.key, it.signature, digest))
+        return out
+
+    # -- AES-CBC-PKCS7 (reference: `bccsp/sw/aes.go`) --
+
+    def encrypt(self, key: api.Key, plaintext: bytes, opts=None) -> bytes:
+        if not isinstance(key, AESKey):
+            raise TypeError("encrypt requires an AES key")
+        iv = os.urandom(16)
+        pad = 16 - len(plaintext) % 16
+        padded = plaintext + bytes([pad]) * pad
+        enc = Cipher(algorithms.AES(key.raw), modes.CBC(iv)).encryptor()
+        return iv + enc.update(padded) + enc.finalize()
+
+    def decrypt(self, key: api.Key, ciphertext: bytes, opts=None) -> bytes:
+        if not isinstance(key, AESKey):
+            raise TypeError("decrypt requires an AES key")
+        if len(ciphertext) < 32 or len(ciphertext) % 16:
+            raise ValueError("invalid ciphertext length")
+        iv, body = ciphertext[:16], ciphertext[16:]
+        dec = Cipher(algorithms.AES(key.raw), modes.CBC(iv)).decryptor()
+        padded = dec.update(body) + dec.finalize()
+        pad = padded[-1]
+        if pad < 1 or pad > 16 or padded[-pad:] != bytes([pad]) * pad:
+            raise ValueError("invalid padding")
+        return padded[:-pad]

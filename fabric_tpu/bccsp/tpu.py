@@ -1,0 +1,209 @@
+"""TPU BCCSP provider — batched verification on an accelerator mesh.
+
+The rebuild's north star (BASELINE.json): where the reference's fastest
+option is one `crypto/ecdsa.Verify` per goroutine
+(`bccsp/sw/ecdsa.go:41-57` under the txvalidator pool), this provider
+collects a whole block's signatures and runs ONE fixed-shape XLA program
+(SHA-256 + P-256 double-scalar-mul) over the padded batch, sharded over
+the batch axis of a device mesh.
+
+Structure mirrors the `pkcs11` provider's containment
+(`bccsp/pkcs11/pkcs11.go`): everything except `verify_batch` delegates to
+an embedded `sw` provider; no layer above the factory knows TPUs exist.
+
+Semantics: host-side pre-validation (strict DER, positivity, low-S) is
+the SAME code path the sw provider uses (`sw.check_signature`), so the
+two providers' accept/reject sets are structurally identical; the device
+kernel then decides the curve equation exactly (integer limb arithmetic,
+no floating point). Small batches and device failures fall back to sw —
+a 3-signature block must not pay kernel-dispatch latency, and a sidecar
+outage must degrade, not halt (SURVEY §7 step 3).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fabric_tpu.bccsp import bccsp as api
+from fabric_tpu.bccsp import sw as swmod
+from fabric_tpu.bccsp import utils
+
+logger = logging.getLogger("bccsp.tpu")
+
+P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = utils.P256_N
+
+
+class TPUProvider(api.BCCSP):
+    def __init__(self, keystore=None, min_batch: int = 16,
+                 max_blocks: int = 64, mesh=None):
+        self._sw = swmod.SWProvider(keystore)
+        self._min_batch = min_batch
+        self._max_blocks = max_blocks
+        self._mesh = mesh
+        self._fn = None          # lazily-built jitted pipeline
+
+    # -- everything non-batch delegates (pkcs11-style containment) --
+
+    def key_gen(self, opts):
+        return self._sw.key_gen(opts)
+
+    def key_import(self, raw, opts):
+        return self._sw.key_import(raw, opts)
+
+    def get_key(self, ski):
+        return self._sw.get_key(ski)
+
+    def hash(self, msg, opts=None):
+        return self._sw.hash(msg, opts)
+
+    def sign(self, key, digest, opts=None):
+        # Signing stays on CPU by design: secret keys + RNG never leave
+        # the host (SURVEY §7 hard-parts list).
+        return self._sw.sign(key, digest, opts)
+
+    def verify(self, key, signature, digest, opts=None):
+        return self._sw.verify(key, signature, digest, opts)
+
+    def encrypt(self, key, plaintext, opts=None):
+        return self._sw.encrypt(key, plaintext, opts)
+
+    def decrypt(self, key, ciphertext, opts=None):
+        return self._sw.decrypt(key, ciphertext, opts)
+
+    # -- the batch path --
+
+    def verify_batch(self, items: Sequence[api.VerifyItem]) -> list[bool]:
+        if len(items) < self._min_batch:
+            return self._sw.verify_batch(items)
+        try:
+            return self._verify_batch_device(items)
+        except Exception:
+            logger.exception(
+                "TPU batch verify failed; falling back to sw for %d items",
+                len(items))
+            return self._sw.verify_batch(items)
+
+    def _verify_batch_device(self, items) -> list[bool]:
+        import jax.numpy as jnp
+
+        from fabric_tpu.ops import limb, sha256
+
+        n = len(items)
+        bucket = self._bucket(n)
+
+        premask = np.zeros(bucket, dtype=bool)
+        r_b = np.zeros((bucket, 32), dtype=np.uint8)
+        rpn_b = np.zeros((bucket, 32), dtype=np.uint8)
+        w_b = np.zeros((bucket, 32), dtype=np.uint8)
+        qx_b = np.zeros((bucket, 32), dtype=np.uint8)
+        qy_b = np.zeros((bucket, 32), dtype=np.uint8)
+        msgs: list[bytes] = []
+        digests = np.zeros((bucket, 8), dtype=np.uint32)
+        has_digest = np.zeros(bucket, dtype=bool)
+
+        max_len = 0
+        for i, it in enumerate(items):
+            pub = it.key.public_key()
+            if not isinstance(pub, swmod.ECDSAPublicKey):
+                msgs.append(b"")
+                continue            # premask stays False -> reject
+            rs = swmod.check_signature(pub, it.signature)
+            if rs is None:
+                msgs.append(b"")
+                continue
+            r, s = rs
+            if r >= N or s >= N:
+                # crypto/ecdsa.Verify rejects out-of-range scalars before
+                # any curve math; mirror that on the host.
+                msgs.append(b"")
+                continue
+            premask[i] = True
+            rpn = r + N if r + N < P256_P else r
+            w = pow(s, -1, N)
+            r_b[i] = np.frombuffer(r.to_bytes(32, "big"), np.uint8)
+            rpn_b[i] = np.frombuffer(rpn.to_bytes(32, "big"), np.uint8)
+            w_b[i] = np.frombuffer(w.to_bytes(32, "big"), np.uint8)
+            qx_b[i] = np.frombuffer(pub.x.to_bytes(32, "big"), np.uint8)
+            qy_b[i] = np.frombuffer(pub.y.to_bytes(32, "big"), np.uint8)
+            if it.digest is not None:
+                digests[i] = np.frombuffer(it.digest, dtype=">u4")
+                has_digest[i] = True
+                msgs.append(b"")
+            else:
+                msgs.append(it.message)
+                max_len = max(max_len, len(it.message))
+
+        msgs += [b""] * (bucket - n)
+        nb = self._nb_bucket(max_len)
+        if nb is None:
+            # a message too large for the block budget: hash host-side
+            for i, m in enumerate(msgs[:n]):
+                if premask[i] and not has_digest[i]:
+                    digests[i] = np.frombuffer(
+                        self._sw.hash(m), dtype=">u4")
+                    has_digest[i] = True
+            nb = 1
+        blocks, nblocks = sha256.pack_messages(msgs, nb)
+        # digest-carrying lanes skip on-device hashing: zero their block
+        # count and inject the digest after the hash stage via select
+        nblocks = np.where(has_digest, 0, nblocks).astype(np.int32)
+
+        args = (
+            jnp.asarray(blocks),
+            jnp.asarray(nblocks),
+            jnp.asarray(limb.be_bytes_to_limbs(qx_b)),
+            jnp.asarray(limb.be_bytes_to_limbs(qy_b)),
+            jnp.asarray(limb.be_bytes_to_limbs(r_b)),
+            jnp.asarray(limb.be_bytes_to_limbs(rpn_b)),
+            jnp.asarray(limb.be_bytes_to_limbs(w_b)),
+            jnp.asarray(premask),
+            jnp.asarray(digests),
+            jnp.asarray(has_digest),
+        )
+        out = np.asarray(self._pipeline()(*args))
+        return out[:n].tolist()
+
+    def _pipeline(self):
+        if self._fn is None:
+            import jax
+
+            from fabric_tpu.ops import p256, sha256
+
+            def fused(blocks, nblocks, qx, qy, r, rpn, w, premask,
+                      digests, has_digest):
+                import jax.numpy as jnp
+                hashed = sha256.sha256_blocks(blocks, nblocks)
+                words = jnp.where(has_digest[:, None], digests, hashed)
+                return p256.verify_core(words, qx, qy, r, rpn, w, premask)
+
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                s = NamedSharding(self._mesh, P("batch"))
+                self._fn = jax.jit(fused, in_shardings=(s,) * 10,
+                                   out_shardings=s)
+            else:
+                self._fn = jax.jit(fused)
+        return self._fn
+
+    def _bucket(self, n: int) -> int:
+        b = self._min_batch
+        while b < n:
+            b *= 2
+        if self._mesh is not None:
+            m = self._mesh.size
+            b = ((b + m - 1) // m) * m
+        return b
+
+    def _nb_bucket(self, max_len: int) -> Optional[int]:
+        """Power-of-two SHA block count covering max_len, else None."""
+        from fabric_tpu.ops import sha256
+        nb = 1
+        while sha256.max_message_len(nb) < max_len:
+            nb *= 2
+            if nb > self._max_blocks:
+                return None
+        return nb

@@ -1,0 +1,117 @@
+"""ECDSA signature encoding + low-S policy.
+
+Rebuild of `bccsp/utils/ecdsa.go`: DER SEQUENCE{r, s} marshal/unmarshal
+with Go `encoding/asn1` strictness (minimal integer encoding, minimal
+length form, trailing bytes after the top-level element tolerated —
+`asn1.Unmarshal` returns them as `rest`, which the reference ignores),
+and the low-S acceptance policy (`IsLowS`/`ToLowS`,
+`bccsp/utils/ecdsa.go:82-108`).
+
+One shared parser backs BOTH the sw and tpu providers, so accept/reject
+parity between them is structural, not incidental.
+"""
+
+from __future__ import annotations
+
+# NIST P-256 group order and half-order (reference precomputes these per
+# curve — `bccsp/utils/ecdsa.go:26-31`)
+P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+P256_HALF_N = P256_N >> 1
+
+
+class SignatureFormatError(ValueError):
+    """Malformed DER — maps to the reference's unmarshal error."""
+
+
+def _parse_len(raw: bytes, off: int) -> tuple[int, int]:
+    """DER definite length at raw[off:] -> (length, next_off)."""
+    if off >= len(raw):
+        raise SignatureFormatError("truncated length")
+    b = raw[off]
+    if b < 0x80:
+        return b, off + 1
+    nbytes = b & 0x7F
+    if nbytes == 0 or nbytes > 4:
+        raise SignatureFormatError("indefinite or oversized length")
+    if off + 1 + nbytes > len(raw):
+        raise SignatureFormatError("truncated length")
+    val = int.from_bytes(raw[off + 1 : off + 1 + nbytes], "big")
+    if raw[off + 1] == 0:
+        raise SignatureFormatError("superfluous leading zeros in length")
+    if val < 0x80:
+        raise SignatureFormatError("length in non-minimal form")
+    return val, off + 1 + nbytes
+
+
+def _parse_int(raw: bytes, off: int) -> tuple[int, int]:
+    """DER INTEGER at raw[off:] -> (value, next_off); minimal encoding
+    enforced, negative values returned negative (rejected by callers'
+    range check, as in the reference)."""
+    if off >= len(raw) or raw[off] != 0x02:
+        raise SignatureFormatError("expected INTEGER tag")
+    length, off = _parse_len(raw, off + 1)
+    if length == 0:
+        raise SignatureFormatError("empty integer")
+    if off + length > len(raw):
+        raise SignatureFormatError("truncated integer")
+    content = raw[off : off + length]
+    if length > 1:
+        if content[0] == 0x00 and content[1] < 0x80:
+            raise SignatureFormatError("integer not minimally encoded")
+        if content[0] == 0xFF and content[1] >= 0x80:
+            raise SignatureFormatError("integer not minimally encoded")
+    return int.from_bytes(content, "big", signed=True), off + length
+
+
+def unmarshal_signature(raw: bytes) -> tuple[int, int]:
+    """DER -> (r, s); raises SignatureFormatError on malformed input or
+    non-positive r/s (reference: `UnmarshalECDSASignature`,
+    `bccsp/utils/ecdsa.go:41-67`)."""
+    if not raw or raw[0] != 0x30:
+        raise SignatureFormatError("expected SEQUENCE tag")
+    seq_len, off = _parse_len(raw, 1)
+    if off + seq_len > len(raw):
+        raise SignatureFormatError("truncated sequence")
+    end = off + seq_len
+    r, off = _parse_int(raw, off)
+    s, off = _parse_int(raw, off)
+    if off != end:
+        raise SignatureFormatError("trailing data inside sequence")
+    # bytes after `end` are tolerated (Go asn1.Unmarshal `rest` semantics)
+    if r <= 0:
+        raise SignatureFormatError("R must be larger than zero")
+    if s <= 0:
+        raise SignatureFormatError("S must be larger than zero")
+    return r, s
+
+
+def _encode_int(v: int) -> bytes:
+    nbytes = max(1, (v.bit_length() + 7) // 8)
+    content = v.to_bytes(nbytes, "big")
+    if content[0] >= 0x80:
+        content = b"\x00" + content
+    return b"\x02" + _encode_len(len(content)) + content
+
+
+def _encode_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    content = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(content)]) + content
+
+
+def marshal_signature(r: int, s: int) -> bytes:
+    """(r, s) -> DER (reference: `MarshalECDSASignature`)."""
+    body = _encode_int(r) + _encode_int(s)
+    return b"\x30" + _encode_len(len(body)) + body
+
+
+def is_low_s(s: int, n: int = P256_N) -> bool:
+    """Low-S acceptance policy (`bccsp/utils/ecdsa.go:82-90`)."""
+    return s <= (n >> 1)
+
+
+def to_low_s(s: int, n: int = P256_N) -> int:
+    """Normalize s into the low half of the signature space
+    (`bccsp/utils/ecdsa.go:92-108`)."""
+    return s if is_low_s(s, n) else n - s

@@ -66,6 +66,22 @@ def ints_to_limbs(xs, n: int = L) -> np.ndarray:
     return np.stack([int_to_limbs(x, n) for x in xs])
 
 
+def be_bytes_to_limbs(raw: np.ndarray) -> np.ndarray:
+    """(B, 32) uint8 big-endian 256-bit values -> (B, L) canonical limbs.
+
+    Fully vectorized (no per-element Python) — this is the host-side
+    packing path for whole-block signature batches, where a Python loop
+    over 30k values x 20 limbs would dominate the pipeline.
+    """
+    raw = np.ascontiguousarray(raw, dtype=np.uint8)
+    B = raw.shape[0]
+    # bit k of the value at bits[:, k] (value little-endian bit order)
+    bits = np.unpackbits(raw[:, ::-1], axis=1, bitorder="little")
+    bits = np.pad(bits, ((0, 0), (0, L * W - 256)))
+    weights = (1 << np.arange(W, dtype=np.int32))
+    return (bits.reshape(B, L, W) * weights).sum(axis=2, dtype=np.int32)
+
+
 # ---------------------------------------------------------------------------
 # Carry propagation
 # ---------------------------------------------------------------------------

@@ -28,8 +28,6 @@ rejects them — so accept/reject here is bit-identical to the `sw` oracle.
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax.numpy as jnp
 from jax import lax
 
@@ -215,6 +213,7 @@ def verify_core(digest_words, qx, qy, r, rpn, w, premask):
     X, Y, Z = double_scalar_mul(u1, u2, qx, qy)
     z_canon = FP.canonical(Z)
     nonzero = jnp.any(z_canon != 0, axis=-1)
-    ok1 = FP.eq(X, FP.mulmod(r, Z))
-    ok2 = FP.eq(X, FP.mulmod(rpn, Z))
+    x_canon = FP.canonical(X)
+    ok1 = jnp.all(x_canon == FP.canonical(FP.mulmod(r, Z)), axis=-1)
+    ok2 = jnp.all(x_canon == FP.canonical(FP.mulmod(rpn, Z)), axis=-1)
     return premask & nonzero & (ok1 | ok2)

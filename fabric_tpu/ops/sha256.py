@@ -128,7 +128,7 @@ def pack_messages(msgs: list[bytes], nb: int) -> tuple[np.ndarray, np.ndarray]:
         k = len(padded) // 64
         counts[i] = k
         words = np.frombuffer(padded, dtype=">u4").astype(np.uint32)
-        out[i, : k * 16 // 16, :] = words.reshape(k, 16)
+        out[i, :k, :] = words.reshape(k, 16)
     return out, counts
 
 

@@ -1,0 +1,5 @@
+from fabric_tpu.parallel.mesh import (  # noqa: F401
+    batch_mesh,
+    shard_batch,
+    sharded_verify_fn,
+)

@@ -1,0 +1,55 @@
+"""Device-mesh sharding for the batched verify pipeline.
+
+The reference scales validation with a goroutine pool bounded by
+`validatorPoolSize` (`core/peer/peer.go:501`, default NumCPU); the TPU
+rebuild scales by sharding the signature-batch axis of one XLA program over
+a `jax.sharding.Mesh`. Verification is embarrassingly batch-parallel —
+XLA's SPMD partitioner splits every op along the batch dim and the only
+collective is the implicit all-gather of the (B,) result bits back to the
+host. Multi-host sidecars would extend the same mesh over DCN; nothing in
+the kernel changes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fabric_tpu.ops import verify as verify_ops
+
+BATCH_AXIS = "batch"
+
+
+def batch_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the first `n_devices` local devices (default: all)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (BATCH_AXIS,))
+
+
+def _shardings(mesh: Mesh):
+    """Input shardings for verify_pipeline's 8 args (all batch-leading)."""
+    s = NamedSharding(mesh, P(BATCH_AXIS))
+    return (s,) * 8
+
+
+def shard_batch(mesh: Mesh, *host_arrays):
+    """Place batch-leading host arrays onto the mesh, split on dim 0.
+
+    Batch size must be a multiple of the mesh size — callers pad to a
+    fixed bucket first (fabric_tpu/bccsp handles bucketing).
+    """
+    s = NamedSharding(mesh, P(BATCH_AXIS))
+    return tuple(jax.device_put(a, s) for a in host_arrays)
+
+
+def sharded_verify_fn(mesh: Mesh):
+    """jit-compiled verify_pipeline with batch-dim sharding over `mesh`."""
+    return jax.jit(
+        verify_ops.verify_pipeline,
+        in_shardings=_shardings(mesh),
+        out_shardings=NamedSharding(mesh, P(BATCH_AXIS)),
+    )

@@ -1,0 +1,203 @@
+"""BCCSP provider tests.
+
+The centerpiece is the differential gate (SURVEY §7 step 3): the tpu
+provider must produce bit-identical accept/reject to the sw oracle over an
+adversarial corpus (bad DER, high-S, out-of-range scalars, tampered
+digests, wrong keys) — the reference's semantics at `bccsp/sw/ecdsa.go:41-57`.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+)
+
+from fabric_tpu.bccsp import (
+    AES256KeyGenOpts,
+    ECDSAKeyGenOpts,
+    VerifyItem,
+    X509PublicKeyImportOpts,
+)
+from fabric_tpu.bccsp import factory, utils
+from fabric_tpu.bccsp.keystore import FileKeyStore
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.bccsp.tpu import TPUProvider
+
+
+class TestDERUtils:
+    def test_roundtrip(self):
+        for r, s in [(1, 1), (utils.P256_N - 1, utils.P256_HALF_N),
+                     (0x80, 0x7F), (1 << 255, 1 << 200)]:
+            der = utils.marshal_signature(r, s)
+            assert utils.unmarshal_signature(der) == (r, s)
+
+    def test_trailing_bytes_after_sequence_tolerated(self):
+        # Go asn1.Unmarshal returns trailing data as `rest`; the
+        # reference ignores it — parity requires acceptance.
+        der = utils.marshal_signature(5, 7) + b"garbage"
+        assert utils.unmarshal_signature(der) == (5, 7)
+
+    @pytest.mark.parametrize("mutate", [
+        lambda d: d[:-1],                      # truncated
+        lambda d: b"\x31" + d[1:],             # wrong outer tag
+        lambda d: d[:2] + b"\x03" + d[3:],     # wrong inner tag
+        lambda d: d[:4] + b"\x00" + d[4:-1],   # non-minimal integer pad
+        lambda d: b"",                         # empty
+    ])
+    def test_malformed_rejected(self, mutate):
+        der = utils.marshal_signature(0x1234, 0x90FF)
+        with pytest.raises(utils.SignatureFormatError):
+            utils.unmarshal_signature(mutate(der))
+
+    def test_nonpositive_rejected(self):
+        # hand-encode r = 0 and a negative s
+        zero_r = bytes.fromhex("30080202000002020001")
+        with pytest.raises(utils.SignatureFormatError):
+            utils.unmarshal_signature(zero_r)
+        neg_s = bytes.fromhex("3006020101020181")   # s = -127
+        with pytest.raises(utils.SignatureFormatError):
+            utils.unmarshal_signature(neg_s)
+
+    def test_low_s(self):
+        assert utils.is_low_s(utils.P256_HALF_N)
+        assert not utils.is_low_s(utils.P256_HALF_N + 1)
+        assert utils.to_low_s(utils.P256_N - 5) == 5
+
+
+class TestSWProvider:
+    def test_sign_verify_roundtrip(self):
+        csp = SWProvider()
+        key = csp.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+        digest = csp.hash(b"the tx payload")
+        sig = csp.sign(key, digest)
+        # produced signatures are always low-S (reference signECDSA)
+        _, s = utils.unmarshal_signature(sig)
+        assert utils.is_low_s(s)
+        assert csp.verify(key.public_key(), sig, digest)
+        assert not csp.verify(key.public_key(), sig, csp.hash(b"other"))
+
+    def test_keystore_roundtrip(self, tmp_path):
+        ks = FileKeyStore(str(tmp_path))
+        csp = SWProvider(ks)
+        key = csp.key_gen(ECDSAKeyGenOpts())
+        got = csp.get_key(key.ski())
+        assert got.ski() == key.ski()
+        assert got.private()
+
+    def test_aes_roundtrip(self):
+        csp = SWProvider()
+        key = csp.key_gen(AES256KeyGenOpts(ephemeral=True))
+        pt = b"private collection payload" * 3
+        ct = csp.encrypt(key, pt)
+        assert csp.decrypt(key, ct) == pt
+        assert ct[16:] != pt
+
+    def test_x509_import(self):
+        from fabric_tpu.bccsp.bccsp import ECDSAPrivateKeyImportOpts
+        from tests.certgen import make_self_signed
+        cert, priv = make_self_signed("org1-admin")
+        csp = SWProvider()
+        pub = csp.key_import(cert, X509PublicKeyImportOpts())
+        digest = csp.hash(b"msg")
+        sig = csp.sign(csp.key_import(priv, ECDSAPrivateKeyImportOpts()),
+                       digest)
+        assert csp.verify(pub, sig, digest)
+
+
+class TestFactory:
+    def test_config_parse(self):
+        opts = factory.FactoryOpts.from_config({
+            "Default": "TPU",
+            "SW": {"Hash": "SHA2", "Security": 256,
+                   "FileKeyStore": {"KeyStore": "/tmp/ks"}},
+            "TPU": {"MinBatch": 8, "MaxBlocks": 32},
+        })
+        assert opts.default == "TPU"
+        assert opts.sw.keystore_path == "/tmp/ks"
+        assert opts.tpu.min_batch == 8
+
+    def test_singleton(self):
+        factory._reset_for_tests()
+        a = factory.get_default()
+        b = factory.get_default()
+        assert a is b
+        factory._reset_for_tests()
+
+
+def _corpus():
+    """(description, VerifyItem) pairs with a mix of valid/invalid."""
+    sw = SWProvider()
+    items = []
+    keys = [sw.key_gen(ECDSAKeyGenOpts(ephemeral=True)) for _ in range(3)]
+
+    def sign(key, msg):
+        return sw.sign(key, hashlib.sha256(msg).digest())
+
+    for i in range(4):
+        k = keys[i % 3]
+        m = f"valid payload {i}".encode() * (i + 1)
+        items.append((True, VerifyItem(
+            key=k.public_key(), signature=sign(k, m), message=m)))
+    # digest mode
+    m = b"digest-mode payload"
+    items.append((True, VerifyItem(
+        key=keys[0].public_key(), signature=sign(keys[0], m),
+        digest=hashlib.sha256(m).digest())))
+    # tampered message
+    m = b"tampered"
+    items.append((False, VerifyItem(
+        key=keys[0].public_key(), signature=sign(keys[0], m),
+        message=m + b"!")))
+    # wrong key
+    items.append((False, VerifyItem(
+        key=keys[1].public_key(), signature=sign(keys[0], m), message=m)))
+    # high-S: rewrite a valid signature into its high-S twin
+    der = sign(keys[2], m)
+    r, s = utils.unmarshal_signature(der)
+    items.append((False, VerifyItem(
+        key=keys[2].public_key(),
+        signature=utils.marshal_signature(r, utils.P256_N - s), message=m)))
+    # malformed DER
+    items.append((False, VerifyItem(
+        key=keys[0].public_key(), signature=der[:-2], message=m)))
+    # trailing garbage after a valid signature -> still accepted
+    items.append((True, VerifyItem(
+        key=keys[2].public_key(), signature=der + b"\x00\x01", message=m)))
+    # r >= n (encode r = n, s valid range)
+    items.append((False, VerifyItem(
+        key=keys[0].public_key(),
+        signature=utils.marshal_signature(utils.P256_N, 5), message=m)))
+    # long message (multi-block SHA path)
+    big = os.urandom(500)
+    items.append((True, VerifyItem(
+        key=keys[1].public_key(), signature=sign(keys[1], big),
+        message=big)))
+    # empty message
+    items.append((True, VerifyItem(
+        key=keys[1].public_key(), signature=sign(keys[1], b""),
+        message=b"")))
+    return items
+
+
+class TestDifferential:
+    def test_tpu_matches_sw_bit_identical(self):
+        expected_and_items = _corpus()
+        items = [it for _, it in expected_and_items]
+        expected = [e for e, _ in expected_and_items]
+        sw = SWProvider()
+        tpu = TPUProvider(min_batch=4)
+        got_sw = sw.verify_batch(items)
+        got_tpu = tpu.verify_batch(items)
+        assert got_sw == expected
+        assert got_tpu == got_sw
+
+    def test_small_batch_uses_sw_fallback(self):
+        tpu = TPUProvider(min_batch=1000)
+        items = [it for _, it in _corpus()[:3]]
+        assert tpu.verify_batch(items) == [True, True, True]

@@ -9,14 +9,13 @@ import hashlib
 import random
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp
 
 from cryptography.hazmat.primitives.asymmetric import ec
 
-from fabric_tpu.ops import limb, p256, sha256
+from fabric_tpu.ops import limb, p256
 
 rng = random.Random(99)
 
