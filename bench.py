@@ -26,7 +26,7 @@ import numpy as np
 BLOCK_TXS = int(os.environ.get("BENCH_TXS", "10240"))
 SIGS_PER_TX = 3
 MSG_LEN = 256          # typical proposal-response payload scale
-NB = (MSG_LEN + 9 + 63) // 64 + 1
+NB = (MSG_LEN + 9 + 63) // 64   # ceil((len + padding) / block) — no slack
 CPU_SAMPLE = 300
 TPU_ITERS = 5
 

@@ -58,7 +58,8 @@ class FactoryOpts:
             tpu=TpuOpts(
                 min_batch=int(tpu_cfg.get("MinBatch", 16)),
                 max_blocks=int(tpu_cfg.get("MaxBlocks", 64)),
-                n_devices=tpu_cfg.get("Devices"),
+                n_devices=(int(tpu_cfg["Devices"])
+                           if tpu_cfg.get("Devices") is not None else None),
             ),
         )
 

@@ -140,12 +140,15 @@ class TPUProvider(api.BCCSP):
         msgs += [b""] * (bucket - n)
         nb = self._nb_bucket(max_len)
         if nb is None:
-            # a message too large for the block budget: hash host-side
+            # a message too large for the block budget: hash host-side and
+            # turn every message lane into a digest lane so the nb=1 pack
+            # below only ever sees empty messages
             for i, m in enumerate(msgs[:n]):
                 if premask[i] and not has_digest[i]:
                     digests[i] = np.frombuffer(
                         self._sw.hash(m), dtype=">u4")
                     has_digest[i] = True
+                msgs[i] = b""
             nb = 1
         blocks, nblocks = sha256.pack_messages(msgs, nb)
         # digest-carrying lanes skip on-device hashing: zero their block

@@ -1,0 +1,22 @@
+"""Generated wire-format modules (layer 0 — SURVEY.md §1).
+
+Sources are the sibling .proto files; regenerate with
+`python tools/gen_protos.py` after editing them.
+"""
+
+from fabric_tpu.protos import chaincode_shim_pb2 as ccshim
+from fabric_tpu.protos import common_pb2 as common
+from fabric_tpu.protos import configtx_pb2 as configtx
+from fabric_tpu.protos import gateway_pb2 as gateway
+from fabric_tpu.protos import gossip_pb2 as gossip
+from fabric_tpu.protos import msp_pb2 as msp
+from fabric_tpu.protos import orderer_pb2 as orderer
+from fabric_tpu.protos import policies_pb2 as policies
+from fabric_tpu.protos import proposal_pb2 as proposal
+from fabric_tpu.protos import rwset_pb2 as rwset
+from fabric_tpu.protos import transaction_pb2 as transaction
+
+__all__ = [
+    "ccshim", "common", "configtx", "gateway", "gossip", "msp",
+    "orderer", "policies", "proposal", "rwset", "transaction",
+]

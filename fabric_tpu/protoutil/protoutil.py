@@ -1,0 +1,201 @@
+"""Block/envelope assembly and hashing helpers.
+
+Rebuild of the reference's `protoutil/` package (`blockutils.go`,
+`commonutils.go`, `signeddata.go` — SURVEY.md §2.12): the glue every
+layer uses to build, hash, and pick apart wire messages. Hash
+definitions are this framework's own (the wire format is new), but the
+*roles* mirror the reference: `block_data_hash` chains block contents,
+`block_header_hash` chains blocks, `compute_tx_id` makes tx ids unique
+per (nonce, creator).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+from fabric_tpu.protos import common
+
+
+def marshal(msg) -> bytes:
+    """Deterministic protobuf serialization — anything that gets hashed
+    or signed goes through here so byte images are reproducible."""
+    return msg.SerializeToString(deterministic=True)
+
+
+def random_nonce(n: int = 24) -> bytes:
+    """Reference: `protoutil/commonutils.go` CreateNonce (24 bytes)."""
+    return os.urandom(n)
+
+
+def compute_tx_id(nonce: bytes, creator: bytes) -> str:
+    """TxID = hex(sha256(nonce || creator)) — reference:
+    `protoutil/txutils.go` ComputeTxID."""
+    return hashlib.sha256(nonce + creator).hexdigest()
+
+
+def block_data_hash(data: common.BlockData) -> bytes:
+    """SHA-256 over the concatenated envelope bytes — reference:
+    `protoutil/blockutils.go` ComputeBlockDataHash. Verified on every
+    received block (`internal/peer/gossip/mcs.go:155`)."""
+    h = hashlib.sha256()
+    for d in data.data:
+        h.update(d)
+    return h.digest()
+
+
+def block_header_bytes(header: common.BlockHeader) -> bytes:
+    """Deterministic image of a header for hashing/signing. The
+    reference uses ASN.1 DER (`protoutil/blockutils.go
+    BlockHeaderBytes`); we use a fixed-width encoding with the same
+    injectivity property."""
+    return (
+        header.number.to_bytes(8, "big")
+        + len(header.previous_hash).to_bytes(4, "big")
+        + header.previous_hash
+        + len(header.data_hash).to_bytes(4, "big")
+        + header.data_hash
+    )
+
+
+def block_header_hash(header: common.BlockHeader) -> bytes:
+    return hashlib.sha256(block_header_bytes(header)).digest()
+
+
+def new_block(seq: int, previous_hash: bytes) -> common.Block:
+    block = common.Block()
+    block.header.number = seq
+    block.header.previous_hash = previous_hash
+    # one metadata slot per BlockMetadataIndex value
+    for _ in range(5):
+        block.metadata.metadata.append(b"")
+    return block
+
+
+def create_signature_header(creator: bytes,
+                            nonce: Optional[bytes] = None
+                            ) -> common.SignatureHeader:
+    sh = common.SignatureHeader()
+    sh.creator = creator
+    sh.nonce = nonce if nonce is not None else random_nonce()
+    return sh
+
+
+def make_channel_header(header_type: int, channel_id: str, tx_id: str = "",
+                        epoch: int = 0, extension: bytes = b"",
+                        version: int = 0) -> common.ChannelHeader:
+    ch = common.ChannelHeader()
+    ch.type = header_type
+    ch.version = version
+    ch.timestamp = time.time_ns()
+    ch.channel_id = channel_id
+    ch.tx_id = tx_id
+    ch.epoch = epoch
+    ch.extension = extension
+    return ch
+
+
+def make_payload(channel_header: common.ChannelHeader,
+                 signature_header: common.SignatureHeader,
+                 data: bytes) -> common.Payload:
+    payload = common.Payload()
+    payload.header.channel_header = marshal(channel_header)
+    payload.header.signature_header = marshal(signature_header)
+    payload.data = data
+    return payload
+
+
+def sign_or_panic(signer, payload: common.Payload) -> common.Envelope:
+    """Wrap a payload in a signed envelope. `signer` is anything with
+    `sign(bytes) -> bytes` and `serialize() -> bytes` (msp
+    SigningIdentity or a test signer)."""
+    env = common.Envelope()
+    env.payload = marshal(payload)
+    env.signature = signer.sign(env.payload)
+    return env
+
+
+# ---- unpacking ----
+
+def unmarshal_envelope(raw: bytes) -> common.Envelope:
+    env = common.Envelope()
+    env.ParseFromString(raw)
+    return env
+
+
+def unmarshal_block(raw: bytes) -> common.Block:
+    block = common.Block()
+    block.ParseFromString(raw)
+    return block
+
+
+def extract_envelope(block: common.Block, index: int) -> common.Envelope:
+    """Reference: `protoutil/blockutils.go` ExtractEnvelope."""
+    if index >= len(block.data.data):
+        raise IndexError(f"envelope index {index} out of bounds "
+                         f"({len(block.data.data)} entries)")
+    return unmarshal_envelope(block.data.data[index])
+
+
+def get_payload(env: common.Envelope) -> common.Payload:
+    payload = common.Payload()
+    payload.ParseFromString(env.payload)
+    return payload
+
+
+def get_channel_header(payload: common.Payload) -> common.ChannelHeader:
+    ch = common.ChannelHeader()
+    ch.ParseFromString(payload.header.channel_header)
+    return ch
+
+
+def get_signature_header(raw: bytes) -> common.SignatureHeader:
+    sh = common.SignatureHeader()
+    sh.ParseFromString(raw)
+    return sh
+
+
+# ---- signed-data extraction (reference: protoutil/signeddata.go) ----
+
+@dataclass(frozen=True)
+class SignedData:
+    """One (message, identity, signature) triple for policy evaluation.
+    Reference: `protoutil/signeddata.go` SignedData — the unit the
+    policy engine (and the batched TPU verify) consumes."""
+
+    data: bytes       # what was signed
+    identity: bytes   # serialized identity of the signer
+    signature: bytes
+
+
+def envelope_as_signed_data(env: common.Envelope) -> list[SignedData]:
+    """Reference: `protoutil/signeddata.go` EnvelopeAsSignedData —
+    the envelope signature covers the raw payload bytes."""
+    payload = get_payload(env)
+    sh = get_signature_header(payload.header.signature_header)
+    return [SignedData(data=env.payload, identity=sh.creator,
+                       signature=env.signature)]
+
+
+def block_signature_set(block: common.Block) -> list[SignedData]:
+    """SignedData for each block-metadata signature — what block
+    verification feeds the BlockValidation policy (reference:
+    `protoutil/signeddata.go` BlockSignatureVerifier /
+    `internal/peer/gossip/mcs.go:174-191`). Each signature covers
+    (metadata.value || signature_header || header bytes)."""
+    md = common.Metadata()
+    md.ParseFromString(
+        block.metadata.metadata[common.BlockMetadataIndex.SIGNATURES])
+    out = []
+    hdr = block_header_bytes(block.header)
+    for sig in md.signatures:
+        sh = get_signature_header(sig.signature_header)
+        out.append(SignedData(
+            data=md.value + sig.signature_header + hdr,
+            identity=sh.creator,
+            signature=sig.signature,
+        ))
+    return out

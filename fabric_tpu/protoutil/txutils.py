@@ -1,0 +1,157 @@
+"""Proposal / transaction assembly.
+
+Rebuild of the reference's `protoutil/{proputils,txutils}.go`: build a
+SignedProposal from an invocation spec, a ProposalResponse from a
+simulation result, and the final ENDORSER_TRANSACTION envelope from a
+proposal + endorsements (`protoutil/txutils.go` CreateSignedTx — the
+inverse of what the txvalidator unpacks, SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Sequence
+
+from fabric_tpu.protos import common, proposal as pb, transaction as txpb
+from fabric_tpu.protoutil import protoutil as pu
+
+
+def create_proposal(channel_id: str, cc_name: str, args: Sequence[bytes],
+                    creator: bytes, transient_map=None,
+                    is_init: bool = False):
+    """Build (Proposal, tx_id). Reference:
+    `protoutil/proputils.go` CreateChaincodeProposal."""
+    nonce = pu.random_nonce()
+    tx_id = pu.compute_tx_id(nonce, creator)
+
+    spec = pb.ChaincodeInvocationSpec()
+    spec.chaincode_spec.type = pb.ChaincodeSpec.PYTHON
+    spec.chaincode_spec.chaincode_id.name = cc_name
+    spec.chaincode_spec.input.args.extend(args)
+    spec.chaincode_spec.input.is_init = is_init
+
+    ext = pb.ChaincodeHeaderExtension()
+    ext.chaincode_id.name = cc_name
+
+    ch = pu.make_channel_header(
+        common.HeaderType.ENDORSER_TRANSACTION, channel_id, tx_id=tx_id,
+        extension=pu.marshal(ext))
+    sh = pu.create_signature_header(creator, nonce)
+
+    ccpp = pb.ChaincodeProposalPayload()
+    ccpp.input = pu.marshal(spec)
+    if transient_map:
+        for k, v in transient_map.items():
+            ccpp.transient_map[k] = v
+
+    prop = pb.Proposal()
+    hdr = common.Header()
+    hdr.channel_header = pu.marshal(ch)
+    hdr.signature_header = pu.marshal(sh)
+    prop.header = pu.marshal(hdr)
+    prop.payload = pu.marshal(ccpp)
+    return prop, tx_id
+
+
+def sign_proposal(prop: pb.Proposal, signer) -> pb.SignedProposal:
+    sp = pb.SignedProposal()
+    sp.proposal_bytes = pu.marshal(prop)
+    sp.signature = signer.sign(sp.proposal_bytes)
+    return sp
+
+
+def proposal_hash(proposal_bytes: bytes) -> bytes:
+    """The image endorsements bind to (reference:
+    `protoutil/proputils.go` GetProposalHash2)."""
+    return hashlib.sha256(proposal_bytes).digest()
+
+
+def create_proposal_response(proposal_bytes: bytes, results: bytes,
+                             events: bytes, response: pb.Response,
+                             chaincode_id: pb.ChaincodeID,
+                             signer) -> pb.ProposalResponse:
+    """Simulate→endorse: sign (payload || endorser identity). Reference:
+    `protoutil/proputils.go` CreateProposalResponse +
+    `core/handlers/endorsement/builtin/default_endorsement.go:35-53`."""
+    action = pb.ChaincodeAction()
+    action.results = results
+    action.events = events
+    action.response.CopyFrom(response)
+    action.chaincode_id.CopyFrom(chaincode_id)
+
+    prp = pb.ProposalResponsePayload()
+    prp.proposal_hash = proposal_hash(proposal_bytes)
+    prp.extension = pu.marshal(action)
+    prp_bytes = pu.marshal(prp)
+
+    resp = pb.ProposalResponse()
+    resp.version = 1
+    resp.timestamp = time.time_ns()
+    resp.response.CopyFrom(response)
+    resp.payload = prp_bytes
+    resp.endorsement.endorser = signer.serialize()
+    resp.endorsement.signature = signer.sign(prp_bytes +
+                                             resp.endorsement.endorser)
+    return resp
+
+
+def create_signed_tx(prop: pb.Proposal,
+                     responses: Sequence[pb.ProposalResponse],
+                     signer) -> common.Envelope:
+    """Assemble the final transaction envelope from a proposal and its
+    endorsements. Reference: `protoutil/txutils.go` CreateSignedTx —
+    all responses must carry identical payloads."""
+    if not responses:
+        raise ValueError("at least one proposal response is required")
+    payloads = {r.payload for r in responses}
+    if len(payloads) != 1:
+        raise ValueError("proposal responses do not match")
+    first = responses[0]
+    if first.response.status < 200 or first.response.status >= 400:
+        raise ValueError(f"proposal response was not successful: "
+                         f"{first.response.status}")
+
+    hdr = common.Header()
+    hdr.ParseFromString(prop.header)
+
+    # strip transient data from the committed payload (reference:
+    # txutils.go — GetBytesChaincodeProposalPayload w/o transient field)
+    ccpp = pb.ChaincodeProposalPayload()
+    ccpp.ParseFromString(prop.payload)
+    ccpp.ClearField("transient_map")
+
+    cap = txpb.ChaincodeActionPayload()
+    cap.chaincode_proposal_payload = pu.marshal(ccpp)
+    cap.action.proposal_response_payload = first.payload
+    for r in responses:
+        cap.action.endorsements.add().CopyFrom(r.endorsement)
+
+    ta = txpb.TransactionAction()
+    ta.header = hdr.signature_header
+    ta.payload = pu.marshal(cap)
+
+    tx = txpb.Transaction()
+    tx.actions.add().CopyFrom(ta)
+
+    payload = common.Payload()
+    payload.header.CopyFrom(hdr)
+    payload.data = pu.marshal(tx)
+    return pu.sign_or_panic(signer, payload)
+
+
+def get_action_from_envelope(env_bytes: bytes) -> pb.ChaincodeAction:
+    """Dig the ChaincodeAction out of a tx envelope (reference:
+    `protoutil/txutils.go` GetActionFromEnvelope)."""
+    env = pu.unmarshal_envelope(env_bytes)
+    payload = pu.get_payload(env)
+    tx = txpb.Transaction()
+    tx.ParseFromString(payload.data)
+    if not tx.actions:
+        raise ValueError("transaction has no actions")
+    cap = txpb.ChaincodeActionPayload()
+    cap.ParseFromString(tx.actions[0].payload)
+    prp = pb.ProposalResponsePayload()
+    prp.ParseFromString(cap.action.proposal_response_payload)
+    action = pb.ChaincodeAction()
+    action.ParseFromString(prp.extension)
+    return action

@@ -18,4 +18,11 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The env var alone is NOT enough: a sitecustomize-registered TPU plugin
+# (axon) overrides JAX_PLATFORMS at interpreter start. jax.config wins
+# over both as long as it runs before backend initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -201,3 +201,41 @@ class TestDifferential:
         tpu = TPUProvider(min_batch=1000)
         items = [it for _, it in _corpus()[:3]]
         assert tpu.verify_batch(items) == [True, True, True]
+
+    def test_device_path_actually_runs(self):
+        """The differential test is meaningless if the broad exception
+        fallback silently routed everything to sw — pin the device path."""
+        expected_and_items = _corpus()
+        items = [it for _, it in expected_and_items]
+        tpu = TPUProvider(min_batch=4)
+
+        def boom(_items):
+            raise AssertionError("sw fallback ran; device path failed")
+        tpu._sw.verify_batch = boom
+        assert tpu.verify_batch(items) == [e for e, _ in expected_and_items]
+
+    def test_oversize_message_hashes_host_side_on_device_path(self):
+        """A message beyond the SHA block budget (nb bucket = None) must
+        be hashed host-side and the batch still verified on-device."""
+        sw = SWProvider()
+        keys = [sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+                for _ in range(2)]
+        huge = os.urandom(5000)   # > max_message_len(max_blocks=64) = 4087
+        items = []
+        expected = []
+        for i in range(6):
+            k = keys[i % 2]
+            m = huge if i == 0 else f"small {i}".encode()
+            sig = sw.sign(k, hashlib.sha256(m).digest())
+            ok = i != 3
+            if not ok:
+                m = m + b"!"   # tamper one lane
+            items.append(VerifyItem(key=k.public_key(), signature=sig,
+                                    message=m))
+            expected.append(ok)
+        tpu = TPUProvider(min_batch=4)
+
+        def boom(_items):
+            raise AssertionError("sw fallback ran; device path failed")
+        tpu._sw.verify_batch = boom
+        assert tpu.verify_batch(items) == expected

@@ -1,0 +1,159 @@
+"""Wire-format + protoutil tests (layer 0).
+
+Mirrors the reference's protoutil tests (`protoutil/*_test.go`):
+roundtrips, hash chaining, signed-data extraction, tx assembly."""
+
+import hashlib
+
+import pytest
+
+from fabric_tpu.protos import common, proposal as pb, transaction as txpb
+from fabric_tpu import protoutil as pu
+
+
+class FakeSigner:
+    """Deterministic test signer: 'signature' = sha256(identity || msg)."""
+
+    def __init__(self, identity=b"org1-admin"):
+        self._id = identity
+
+    def serialize(self):
+        return self._id
+
+    def sign(self, msg):
+        return hashlib.sha256(self._id + msg).digest()
+
+
+def test_envelope_roundtrip():
+    ch = pu.make_channel_header(common.HeaderType.MESSAGE, "mychannel")
+    sh = pu.create_signature_header(b"creator")
+    payload = pu.make_payload(ch, sh, b"hello")
+    env = pu.sign_or_panic(FakeSigner(), payload)
+
+    env2 = pu.unmarshal_envelope(env.SerializeToString())
+    p2 = pu.get_payload(env2)
+    assert pu.get_channel_header(p2).channel_id == "mychannel"
+    assert p2.data == b"hello"
+
+
+def test_compute_tx_id_unique_per_nonce():
+    a = pu.compute_tx_id(b"n1", b"creator")
+    b = pu.compute_tx_id(b"n2", b"creator")
+    assert a != b
+    assert a == hashlib.sha256(b"n1creator").hexdigest()
+
+
+def test_block_hash_chain():
+    b0 = pu.new_block(0, b"")
+    b0.data.data.append(b"genesis-tx")
+    b0.header.data_hash = pu.block_data_hash(b0.data)
+
+    b1 = pu.new_block(1, pu.block_header_hash(b0.header))
+    assert b1.header.previous_hash == pu.block_header_hash(b0.header)
+    # header hash is sensitive to every field
+    mutated = common.BlockHeader()
+    mutated.CopyFrom(b0.header)
+    mutated.number = 7
+    assert pu.block_header_hash(mutated) != pu.block_header_hash(b0.header)
+
+
+def test_block_data_hash_is_concat_sha256():
+    bd = common.BlockData()
+    bd.data.append(b"aa")
+    bd.data.append(b"bb")
+    assert pu.block_data_hash(bd) == hashlib.sha256(b"aabb").digest()
+
+
+def test_new_block_has_all_metadata_slots():
+    b = pu.new_block(3, b"prev")
+    assert len(b.metadata.metadata) == 5
+
+
+def test_envelope_as_signed_data():
+    ch = pu.make_channel_header(common.HeaderType.MESSAGE, "ch")
+    sh = pu.create_signature_header(b"creator-bytes")
+    env = pu.sign_or_panic(FakeSigner(b"creator-bytes"),
+                           pu.make_payload(ch, sh, b"data"))
+    sds = pu.envelope_as_signed_data(env)
+    assert len(sds) == 1
+    assert sds[0].identity == b"creator-bytes"
+    assert sds[0].data == env.payload
+    assert sds[0].signature == env.signature
+
+
+def test_block_signature_set():
+    block = pu.new_block(5, b"prev")
+    md = common.Metadata()
+    md.value = b"md-value"
+    sig = md.signatures.add()
+    sh = pu.create_signature_header(b"orderer-id")
+    sig.signature_header = pu.marshal(sh)
+    sig.signature = b"sig-bytes"
+    block.metadata.metadata[common.BlockMetadataIndex.SIGNATURES] = \
+        pu.marshal(md)
+
+    sds = pu.block_signature_set(block)
+    assert len(sds) == 1
+    assert sds[0].identity == b"orderer-id"
+    assert sds[0].data == (md.value + sig.signature_header +
+                           pu.block_header_bytes(block.header))
+
+
+def test_proposal_and_signed_tx_assembly():
+    signer = FakeSigner(b"endorser-1")
+    prop, tx_id = pu.create_proposal("ch1", "mycc", [b"invoke", b"a", b"b"],
+                                     creator=b"client-1")
+    assert len(tx_id) == 64
+
+    resp = pb.Response(status=200, message="OK", payload=b"result")
+    ccid = pb.ChaincodeID(name="mycc", version="1.0")
+    prop_bytes = pu.marshal(prop)
+    presp = pu.create_proposal_response(prop_bytes, b"rwset-bytes",
+                                        b"", resp, ccid, signer)
+    assert presp.endorsement.endorser == b"endorser-1"
+
+    env = pu.create_signed_tx(prop, [presp], FakeSigner(b"client-1"))
+    action = pu.get_action_from_envelope(env.SerializeToString())
+    assert action.results == b"rwset-bytes"
+    assert action.response.status == 200
+
+    # mismatched responses must be rejected
+    presp2 = pu.create_proposal_response(prop_bytes, b"DIFFERENT", b"",
+                                         resp, ccid, signer)
+    with pytest.raises(ValueError, match="do not match"):
+        pu.create_signed_tx(prop, [presp, presp2], FakeSigner(b"client-1"))
+
+
+def test_signed_tx_strips_transient_map():
+    prop, _ = pu.create_proposal("ch1", "mycc", [b"put"], creator=b"c",
+                                 transient_map={"secret": b"s3cret"})
+    resp = pb.Response(status=200)
+    presp = pu.create_proposal_response(pu.marshal(prop), b"rw", b"", resp,
+                                        pb.ChaincodeID(name="mycc"),
+                                        FakeSigner())
+    env = pu.create_signed_tx(prop, [presp], FakeSigner(b"c"))
+
+    payload = pu.get_payload(env)
+    tx = txpb.Transaction()
+    tx.ParseFromString(payload.data)
+    cap = txpb.ChaincodeActionPayload()
+    cap.ParseFromString(tx.actions[0].payload)
+    ccpp = pb.ChaincodeProposalPayload()
+    ccpp.ParseFromString(cap.chaincode_proposal_payload)
+    assert not ccpp.transient_map
+
+
+def test_rejected_proposal_cannot_become_tx():
+    prop, _ = pu.create_proposal("ch1", "mycc", [b"x"], creator=b"c")
+    resp = pb.Response(status=500, message="simulation failed")
+    presp = pu.create_proposal_response(pu.marshal(prop), b"", b"", resp,
+                                        pb.ChaincodeID(name="mycc"),
+                                        FakeSigner())
+    with pytest.raises(ValueError, match="not successful"):
+        pu.create_signed_tx(prop, [presp], FakeSigner(b"c"))
+
+
+def test_extract_envelope_bounds():
+    b = pu.new_block(0, b"")
+    with pytest.raises(IndexError):
+        pu.extract_envelope(b, 0)

@@ -1,0 +1,48 @@
+#!/usr/bin/env python3
+"""Regenerate fabric_tpu/protos/*_pb2.py from the .proto sources.
+
+Generated files are checked in (the test/runtime path never shells out
+to protoc); rerun this after editing any .proto. Service stubs are NOT
+generated (no grpc protoc plugin in this image) — services are defined
+over grpc's generic API in fabric_tpu/comm/rpc.py instead.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+PROTO_DIR = pathlib.Path(__file__).resolve().parent.parent / "fabric_tpu" / "protos"
+
+
+def main() -> int:
+    protos = sorted(PROTO_DIR.glob("*.proto"))
+    if not protos:
+        print("no .proto files found", file=sys.stderr)
+        return 1
+    cmd = [
+        "protoc",
+        f"--proto_path={PROTO_DIR}",
+        f"--python_out={PROTO_DIR}",
+        *[str(p) for p in protos],
+    ]
+    subprocess.run(cmd, check=True)
+    # protoc emits flat sibling imports (`import x_pb2`); rewrite them to
+    # package-relative so the modules work inside fabric_tpu.protos.
+    import re
+
+    for gen in PROTO_DIR.glob("*_pb2.py"):
+        text = gen.read_text()
+        fixed = re.sub(
+            r"^import (\w+_pb2) as",
+            r"from fabric_tpu.protos import \1 as",
+            text,
+            flags=re.M,
+        )
+        if fixed != text:
+            gen.write_text(fixed)
+    print(f"generated {len(protos)} modules in {PROTO_DIR}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
