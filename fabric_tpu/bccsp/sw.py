@@ -143,8 +143,17 @@ class SWProvider(api.BCCSP):
 
     def __init__(self, keystore=None):
         self._ks = keystore
+        # in-memory record of non-ephemeral keys so get_key(ski) works
+        # without a file keystore (reference: dummy in-mem keystore,
+        # bccsp/sw/dummyks.go)
+        self._mem: dict[bytes, api.Key] = {}
 
     # -- keys --
+
+    def _retain(self, key: api.Key) -> None:
+        self._mem[key.ski()] = key
+        if self._ks is not None:
+            self._ks.store_key(key)
 
     def key_gen(self, opts) -> api.Key:
         if isinstance(opts, api.ECDSAKeyGenOpts):
@@ -153,8 +162,8 @@ class SWProvider(api.BCCSP):
             key = AESKey(os.urandom(32))
         else:
             raise TypeError(f"unsupported KeyGenOpts {opts!r}")
-        if self._ks is not None and not opts.ephemeral:
-            self._ks.store_key(key)
+        if not opts.ephemeral:
+            self._retain(key)
         return key
 
     def key_import(self, raw, opts) -> api.Key:
@@ -164,22 +173,36 @@ class SWProvider(api.BCCSP):
             pub = cert.public_key()
             if not isinstance(pub, ec.EllipticCurvePublicKey):
                 raise TypeError("certificate does not carry an EC key")
-            return ECDSAPublicKey(pub)
-        if isinstance(opts, api.ECDSAPublicKeyImportOpts):
+            key: api.Key = ECDSAPublicKey(pub)
+        elif isinstance(opts, api.ECDSAPublicKeyImportOpts):
             if isinstance(raw, ec.EllipticCurvePublicKey):
-                return ECDSAPublicKey(raw)
-            return ECDSAPublicKey(serialization.load_der_public_key(raw))
-        if isinstance(opts, api.ECDSAPrivateKeyImportOpts):
+                key = ECDSAPublicKey(raw)
+            else:
+                key = ECDSAPublicKey(serialization.load_der_public_key(raw))
+        elif isinstance(opts, api.ECDSAPrivateKeyImportOpts):
             if isinstance(raw, ec.EllipticCurvePrivateKey):
-                return ECDSAPrivateKey(raw)
-            key = serialization.load_der_private_key(raw, password=None)
-            return ECDSAPrivateKey(key)
-        raise TypeError(f"unsupported KeyImportOpts {opts!r}")
+                key = ECDSAPrivateKey(raw)
+            else:
+                key = ECDSAPrivateKey(
+                    serialization.load_der_private_key(raw, password=None))
+        else:
+            raise TypeError(f"unsupported KeyImportOpts {opts!r}")
+        # non-ephemeral imports persist, so get_key(ski) resolves later
+        # (reference: bccsp/sw/keyimport.go + impl.go KeyImport → StoreKey)
+        if not getattr(opts, "ephemeral", True):
+            self._retain(key)
+        return key
 
     def get_key(self, ski: bytes) -> api.Key:
-        if self._ks is None:
-            raise KeyError("no keystore configured")
-        return self._ks.get_key(ski)
+        if self._ks is not None:
+            try:
+                return self._ks.get_key(ski)
+            except KeyError:
+                pass
+        key = self._mem.get(ski)
+        if key is None:
+            raise KeyError(f"key {ski.hex()} not found")
+        return key
 
     # -- hashing --
 

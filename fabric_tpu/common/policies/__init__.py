@@ -1,0 +1,15 @@
+from fabric_tpu.common.policies.policy import (
+    Manager,
+    Policy,
+    PolicyError,
+    signature_set_to_valid_identities,
+)
+from fabric_tpu.common.policies.cauthdsl import SignaturePolicy
+from fabric_tpu.common.policies.implicitmeta import ImplicitMetaPolicy
+from fabric_tpu.common.policies.policydsl import from_string
+
+__all__ = [
+    "Manager", "Policy", "PolicyError",
+    "signature_set_to_valid_identities", "SignaturePolicy",
+    "ImplicitMetaPolicy", "from_string",
+]

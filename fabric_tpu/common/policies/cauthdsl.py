@@ -1,0 +1,103 @@
+"""Signature-policy compiler and evaluator.
+
+Rebuild of `common/cauthdsl/{cauthdsl.go,policy.go}`: compile a
+SignaturePolicyEnvelope (NOutOf/SignedBy tree over MSPPrincipals) into
+a closure over a list of identities, and wrap it as a `policies.Policy`
+that first turns a signature set into valid identities — via the
+batched verifier — then runs pure principal matching (no crypto in the
+tree walk, exactly like the reference's compiled evaluators).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Sequence
+
+from fabric_tpu.protos import policies as polpb
+from fabric_tpu.common.policies import policy as papi
+
+logger = logging.getLogger("cauthdsl")
+
+
+def compile_rule(rule: polpb.SignaturePolicy,
+                 principals: Sequence[polpb.MSPPrincipal]
+                 ) -> Callable[[Sequence, list[bool]], bool]:
+    """Reference: `common/cauthdsl/cauthdsl.go:24-92` compile — returns
+    evaluator(identities, used) -> bool. `used` prevents one identity
+    from satisfying two SignedBy leaves (same semantics as the
+    reference's `used` vector)."""
+    which = rule.WhichOneof("type")
+    if which == "signed_by":
+        idx = rule.signed_by
+        if idx < 0 or idx >= len(principals):
+            raise ValueError(f"signed_by index {idx} out of range")
+        principal = principals[idx]
+
+        def eval_signed_by(identities, used):
+            for i, ident in enumerate(identities):
+                if used[i]:
+                    continue
+                try:
+                    ident.satisfies_principal(principal)
+                except Exception:
+                    continue
+                used[i] = True
+                return True
+            return False
+        return eval_signed_by
+
+    if which == "n_out_of":
+        n = rule.n_out_of.n
+        children = [compile_rule(r, principals)
+                    for r in rule.n_out_of.rules]
+        if n < 0 or n > len(children):
+            raise ValueError(f"asked for {n} of {len(children)} sub-rules")
+
+        def eval_n_out_of(identities, used):
+            # like the reference, children snapshot `used` so a failed
+            # child doesn't consume identities
+            satisfied = 0
+            for child in children:
+                snapshot = list(used)
+                if child(identities, used):
+                    satisfied += 1
+                else:
+                    used[:] = snapshot
+                if satisfied >= n:
+                    return True
+            return satisfied >= n
+        return eval_n_out_of
+
+    raise ValueError(f"unknown signature policy node {which!r}")
+
+
+class SignaturePolicy(papi.Policy):
+    """An evaluatable signature policy (reference:
+    `common/cauthdsl/policy.go:86-108`)."""
+
+    def __init__(self, envelope: polpb.SignaturePolicyEnvelope,
+                 deserializer, csp):
+        if envelope.version != 0:
+            raise ValueError(
+                f"unsupported policy version {envelope.version}")
+        self._envelope = envelope
+        self._eval = compile_rule(envelope.rule, list(envelope.identities))
+        self._deserializer = deserializer
+        self._csp = csp
+
+    @classmethod
+    def from_bytes(cls, raw: bytes, deserializer, csp) -> "SignaturePolicy":
+        env = polpb.SignaturePolicyEnvelope()
+        env.ParseFromString(raw)
+        return cls(env, deserializer, csp)
+
+    def evaluate_signed_data(self, signed_data) -> None:
+        identities = papi.signature_set_to_valid_identities(
+            signed_data, self._deserializer, self._csp)
+        self.evaluate_identities(identities)
+
+    def evaluate_identities(self, identities) -> None:
+        used = [False] * len(identities)
+        if not self._eval(identities, used):
+            raise papi.PolicyError(
+                "signature set did not satisfy policy")

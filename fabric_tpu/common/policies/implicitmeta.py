@@ -1,0 +1,89 @@
+"""ImplicitMeta policies: ANY/ALL/MAJORITY over named sub-policies.
+
+Rebuild of `common/policies/implicitmeta.go:69,107`: the policy holds a
+sub-policy NAME; at evaluation it fetches that policy from each child
+manager and requires the threshold number of children to pass. Used for
+the standard channel policies (Readers/Writers/Admins at every level).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Sequence
+
+from fabric_tpu.protos import policies as polpb
+from fabric_tpu.common.policies import policy as papi
+
+logger = logging.getLogger("policies.implicitmeta")
+
+
+class ImplicitMetaPolicy(papi.Policy):
+    def __init__(self, meta: polpb.ImplicitMetaPolicy,
+                 sub_policies: Sequence[papi.Policy],
+                 converter=None):
+        """`converter` = (identity_deserializer, csp); when given,
+        signature sets are turned into valid identities once, with one
+        batched verify, before fan-out to the children."""
+        self._sub_policy_name = meta.sub_policy
+        self._subs = list(sub_policies)
+        self._converter = converter
+        n = len(self._subs)
+        if meta.rule == polpb.ImplicitMetaPolicy.ANY:
+            # threshold stays 1 even with zero children: an ANY over
+            # nothing must fail closed (reference implicitmeta.go:69)
+            self._threshold = 1
+        elif meta.rule == polpb.ImplicitMetaPolicy.ALL:
+            self._threshold = n
+        elif meta.rule == polpb.ImplicitMetaPolicy.MAJORITY:
+            self._threshold = n // 2 + 1
+        else:
+            raise ValueError(f"unknown implicit-meta rule {meta.rule}")
+
+    @classmethod
+    def from_managers(cls, meta: polpb.ImplicitMetaPolicy,
+                      managers: Sequence[papi.Manager],
+                      converter=None) -> "ImplicitMetaPolicy":
+        """Collect `meta.sub_policy` from each org manager that defines
+        it (reference: NewPolicy gathers from all child managers)."""
+        subs = []
+        for m in managers:
+            try:
+                subs.append(m.get_policy(meta.sub_policy))
+            except papi.PolicyError:
+                logger.debug("manager %s lacks sub-policy %s",
+                             m.name, meta.sub_policy)
+        return cls(meta, subs, converter=converter)
+
+    def _evaluate(self, fn_name: str, arg) -> None:
+        satisfied = 0
+        errors = []
+        for sub in self._subs:
+            try:
+                getattr(sub, fn_name)(arg)
+                satisfied += 1
+            except papi.PolicyError as e:
+                errors.append(str(e))
+            if satisfied >= self._threshold:
+                return
+        if satisfied >= self._threshold:
+            # e.g. ALL over zero children passes vacuously (reference
+            # implicitmeta.go returns nil when remaining == 0)
+            return
+        raise papi.PolicyError(
+            f"implicit-meta {self._sub_policy_name!r}: {satisfied} of "
+            f"{len(self._subs)} sub-policies satisfied, "
+            f"needed {self._threshold}: {errors[:3]}")
+
+    def evaluate_signed_data(self, signed_data) -> None:
+        if self._converter is not None:
+            # convert the signature set to valid identities ONCE — one
+            # batched verify dispatch — instead of once per child
+            deserializer, csp = self._converter
+            identities = papi.signature_set_to_valid_identities(
+                signed_data, deserializer, csp)
+            self._evaluate("evaluate_identities", identities)
+        else:
+            self._evaluate("evaluate_signed_data", signed_data)
+
+    def evaluate_identities(self, identities) -> None:
+        self._evaluate("evaluate_identities", identities)

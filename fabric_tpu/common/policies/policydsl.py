@@ -1,0 +1,178 @@
+"""Text policy DSL → SignaturePolicyEnvelope.
+
+Rebuild of `common/policydsl/policyparser.go` (`FromString:247`): the
+operator grammar `AND(...)`, `OR(...)`, `OutOf(n, ...)` over quoted
+principal strings `'MSP.ROLE'` (ROLE ∈ member|admin|client|peer|
+orderer). AND = n-of-n, OR = 1-of-n. Parsed with a small recursive
+parser instead of the reference's govaluate trick.
+"""
+
+from __future__ import annotations
+
+import re
+
+from fabric_tpu.protos import policies as polpb
+
+_ROLES = {
+    "member": polpb.MSPRole.MEMBER,
+    "admin": polpb.MSPRole.ADMIN,
+    "client": polpb.MSPRole.CLIENT,
+    "peer": polpb.MSPRole.PEER,
+    "orderer": polpb.MSPRole.ORDERER,
+}
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+        (?P<op>AND|OR|OutOf|outof|and|or)\s*\( |
+        (?P<close>\)) |
+        (?P<comma>,) |
+        '(?P<principal>[^']*)' |
+        "(?P<principal2>[^"]*)" |
+        (?P<int>\d+)
+    )""", re.X)
+
+
+class PolicyParseError(ValueError):
+    pass
+
+
+def _tokenize(s: str):
+    pos = 0
+    while pos < len(s):
+        m = _TOKEN.match(s, pos)
+        if m is None:
+            if s[pos:].strip() == "":
+                return
+            raise PolicyParseError(f"unexpected input at {s[pos:]!r}")
+        pos = m.end()
+        if m.group("op"):
+            yield ("open", m.group("op").lower())
+        elif m.group("close"):
+            yield ("close", None)
+        elif m.group("comma"):
+            yield ("comma", None)
+        elif m.group("int"):
+            yield ("int", int(m.group("int")))
+        else:
+            p = m.group("principal")
+            if p is None:
+                p = m.group("principal2")
+            yield ("principal", p)
+    return
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self._toks = list(tokens)
+        self._i = 0
+        self.principals: list[tuple[str, int]] = []
+
+    def _peek(self):
+        return self._toks[self._i] if self._i < len(self._toks) else None
+
+    def _next(self):
+        tok = self._peek()
+        if tok is None:
+            raise PolicyParseError("unexpected end of policy")
+        self._i += 1
+        return tok
+
+    def parse(self) -> polpb.SignaturePolicy:
+        node = self._expr()
+        if self._peek() is not None:
+            raise PolicyParseError(f"trailing tokens after policy: "
+                                   f"{self._toks[self._i:]}")
+        return node
+
+    def _expr(self) -> polpb.SignaturePolicy:
+        kind, val = self._next()
+        if kind == "principal":
+            return self._leaf(val)
+        if kind != "open":
+            raise PolicyParseError(f"expected operator or principal, "
+                                   f"got {kind}")
+        args: list = []
+        n_required = None
+        if val == "outof":
+            k, n_required = self._next()
+            if k != "int":
+                raise PolicyParseError("OutOf requires a leading count")
+            self._expect_comma_or_close()
+        while True:
+            tok = self._peek()
+            if tok is None:
+                raise PolicyParseError("unclosed operator")
+            if tok[0] == "close":
+                self._next()
+                break
+            args.append(self._expr())
+            self._expect_comma_or_close(consume_close=True)
+            if self._closed:
+                break
+        if not args:
+            raise PolicyParseError("operator with no arguments")
+        node = polpb.SignaturePolicy()
+        if val == "and":
+            node.n_out_of.n = len(args)
+        elif val == "or":
+            node.n_out_of.n = 1
+        else:
+            if n_required is None or n_required > len(args):
+                raise PolicyParseError(
+                    f"OutOf({n_required}) with only {len(args)} args")
+            node.n_out_of.n = n_required
+        for a in args:
+            node.n_out_of.rules.add().CopyFrom(a)
+        return node
+
+    _closed = False
+
+    def _expect_comma_or_close(self, consume_close: bool = False):
+        self._closed = False
+        tok = self._peek()
+        if tok is None:
+            raise PolicyParseError("unexpected end of policy")
+        if tok[0] == "comma":
+            self._next()
+        elif tok[0] == "close" and consume_close:
+            self._next()
+            self._closed = True
+        elif tok[0] == "close":
+            pass
+        else:
+            raise PolicyParseError(f"expected ',' or ')', got {tok}")
+
+    def _leaf(self, principal: str) -> polpb.SignaturePolicy:
+        # greedy (.+) so MSP IDs may contain dots: 'org.example.com.member'
+        # splits at the LAST dot (reference policyparser.go behaviour)
+        m = re.fullmatch(r"(.+)\.(\w+)", principal)
+        if m is None:
+            raise PolicyParseError(
+                f"principal {principal!r} is not MSP.ROLE")
+        mspid, role_s = m.group(1), m.group(2).lower()
+        if role_s not in _ROLES:
+            raise PolicyParseError(f"unknown role {role_s!r}")
+        key = (mspid, _ROLES[role_s])
+        try:
+            idx = self.principals.index(key)
+        except ValueError:
+            idx = len(self.principals)
+            self.principals.append(key)
+        node = polpb.SignaturePolicy()
+        node.signed_by = idx
+        return node
+
+
+def from_string(policy: str) -> polpb.SignaturePolicyEnvelope:
+    """Reference: `common/policydsl/policyparser.go:247` FromString."""
+    parser = _Parser(_tokenize(policy))
+    rule = parser.parse()
+    env = polpb.SignaturePolicyEnvelope()
+    env.version = 0
+    env.rule.CopyFrom(rule)
+    for mspid, role in parser.principals:
+        p = env.identities.add()
+        p.classification = polpb.MSPPrincipal.ROLE
+        p.principal = polpb.MSPRole(
+            msp_identifier=mspid, role=role).SerializeToString()
+    return env

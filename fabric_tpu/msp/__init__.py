@@ -1,0 +1,20 @@
+from fabric_tpu.msp.msp import (
+    Identity,
+    IdentityDeserializer,
+    MSP,
+    MSPManager,
+    MSPRole,
+    SigningIdentity,
+)
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.msp.mgr import CachedMSP, Manager
+from fabric_tpu.msp.configbuilder import (
+    build_msp_config,
+    msp_config_from_dir,
+)
+
+__all__ = [
+    "Identity", "IdentityDeserializer", "MSP", "MSPManager", "MSPRole",
+    "SigningIdentity", "X509MSP", "CachedMSP", "Manager",
+    "build_msp_config", "msp_config_from_dir",
+]

@@ -1,0 +1,127 @@
+"""Build MSPConfig protos from the on-disk MSP directory layout.
+
+Rebuild of `msp/configbuilder.go`: the same directory convention the
+reference's cryptogen emits —
+
+    <msp-dir>/
+      cacerts/*.pem            root CAs (required)
+      intermediatecerts/*.pem  intermediate CAs
+      admincerts/*.pem         explicit admin certs
+      signcerts/*.pem          this node's certificate
+      keystore/*_sk            this node's private key (PEM PKCS#8)
+      crls/*.pem               revocation lists
+      tlscacerts/*.pem         TLS root CAs
+      config.yaml              NodeOUs declaration
+
+`msp_config_from_dir` also imports the keystore key into the BCCSP
+keystore so `signing_identity.private_signer` (an SKI) resolves.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import yaml
+
+from fabric_tpu.protos import msp as msppb
+
+
+def _read_pems(d: str) -> list[bytes]:
+    if not os.path.isdir(d):
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        with open(os.path.join(d, name), "rb") as f:
+            out.append(f.read())
+    return out
+
+
+def _node_ous_from_config(msp_dir: str) -> Optional[msppb.NodeOUs]:
+    path = os.path.join(msp_dir, "config.yaml")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    nodeous = (cfg or {}).get("NodeOUs")
+    if not nodeous or not nodeous.get("Enable"):
+        return None
+    out = msppb.NodeOUs(enable=True)
+    for yaml_key, field in (
+        ("ClientOUIdentifier", out.client_ou_identifier),
+        ("PeerOUIdentifier", out.peer_ou_identifier),
+        ("AdminOUIdentifier", out.admin_ou_identifier),
+        ("OrdererOUIdentifier", out.orderer_ou_identifier),
+    ):
+        spec = nodeous.get(yaml_key) or {}
+        field.organizational_unit_identifier = \
+            spec.get("OrganizationalUnitIdentifier", "")
+        cert_rel = spec.get("Certificate")
+        if cert_rel:
+            with open(os.path.join(msp_dir, cert_rel), "rb") as f:
+                field.certificate = f.read()
+    return out
+
+
+def build_msp_config(name: str, root_certs: list[bytes],
+                     intermediate_certs: list[bytes] = (),
+                     admins: list[bytes] = (),
+                     revocation_list: list[bytes] = (),
+                     tls_root_certs: list[bytes] = (),
+                     node_ous: Optional[msppb.NodeOUs] = None,
+                     signing_cert: Optional[bytes] = None,
+                     signing_key_ski: Optional[bytes] = None
+                     ) -> msppb.MSPConfig:
+    """Assemble an X.509 MSPConfig proto from in-memory material."""
+    conf = msppb.X509MSPConfig()
+    conf.name = name
+    conf.root_certs.extend(root_certs)
+    conf.intermediate_certs.extend(intermediate_certs)
+    conf.admins.extend(admins)
+    conf.revocation_list.extend(revocation_list)
+    conf.tls_root_certs.extend(tls_root_certs)
+    if node_ous is not None:
+        conf.fabric_node_ous.CopyFrom(node_ous)
+    if signing_cert is not None:
+        conf.signing_identity.public_signer = signing_cert
+        if signing_key_ski is not None:
+            conf.signing_identity.private_signer = \
+                signing_key_ski.hex().encode()
+    wrapper = msppb.MSPConfig(type=0)
+    wrapper.config = conf.SerializeToString(deterministic=True)
+    return wrapper
+
+
+def msp_config_from_dir(msp_dir: str, name: str,
+                        csp=None) -> msppb.MSPConfig:
+    """Read the directory layout; if `csp` is given and a keystore/ key
+    exists, import it so the signing identity is usable."""
+    roots = _read_pems(os.path.join(msp_dir, "cacerts"))
+    if not roots:
+        raise ValueError(f"{msp_dir}/cacerts is empty — not an MSP dir")
+    signing_cert = None
+    signing_ski = None
+    signcerts = _read_pems(os.path.join(msp_dir, "signcerts"))
+    if signcerts and csp is not None:
+        from cryptography.hazmat.primitives.serialization import (
+            load_pem_private_key,
+        )
+        from fabric_tpu.bccsp.bccsp import ECDSAPrivateKeyImportOpts
+        keys = _read_pems(os.path.join(msp_dir, "keystore"))
+        if keys:
+            priv = csp.key_import(load_pem_private_key(keys[0], None),
+                                  ECDSAPrivateKeyImportOpts())
+            signing_cert = signcerts[0]
+            signing_ski = priv.ski()
+    return build_msp_config(
+        name=name,
+        root_certs=roots,
+        intermediate_certs=_read_pems(
+            os.path.join(msp_dir, "intermediatecerts")),
+        admins=_read_pems(os.path.join(msp_dir, "admincerts")),
+        revocation_list=_read_pems(os.path.join(msp_dir, "crls")),
+        tls_root_certs=_read_pems(os.path.join(msp_dir, "tlscacerts")),
+        node_ous=_node_ous_from_config(msp_dir),
+        signing_cert=signing_cert,
+        signing_key_ski=signing_ski,
+    )

@@ -1,0 +1,132 @@
+"""MSP manager (per-channel multiplexer) and memoizing cache.
+
+Rebuild of `msp/mspmgrimpl.go` and `msp/cache/cache.go`: the manager
+routes deserialization to the owning MSP by the embedded mspid; the
+cache wraps an MSP and memoizes the three hot, pure-given-config
+operations (deserialize, validate, satisfies-principal) keyed on
+identity bytes — the reference sizes these LRUs at
+`msp/cache/cache.go` (deserialize/validate/satisfiesPrincipal caches).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Sequence
+
+from fabric_tpu.protos import msp as msppb
+from fabric_tpu.msp import msp as api
+from fabric_tpu.msp.mspimpl import MSPError
+
+
+class Manager(api.MSPManager):
+    def __init__(self):
+        self._msps: dict[str, api.MSP] = {}
+
+    def setup(self, msps: Sequence[api.MSP]) -> None:
+        self._msps = {m.identifier(): m for m in msps}
+
+    def get_msps(self) -> dict[str, api.MSP]:
+        return dict(self._msps)
+
+    def deserialize_identity(self, serialized: bytes) -> api.Identity:
+        sid = msppb.SerializedIdentity()
+        sid.ParseFromString(serialized)
+        msp = self._msps.get(sid.mspid)
+        if msp is None:
+            raise MSPError(f"MSP {sid.mspid!r} is unknown on this channel")
+        return msp.deserialize_identity(serialized)
+
+    def is_well_formed(self, serialized: bytes) -> None:
+        sid = msppb.SerializedIdentity()
+        try:
+            sid.ParseFromString(serialized)
+        except Exception as e:
+            raise MSPError(f"not a SerializedIdentity: {e}") from e
+        for msp in self._msps.values():
+            try:
+                msp.is_well_formed(serialized)
+                return
+            except MSPError:
+                continue
+        raise MSPError("no MSP recognizes this identity")
+
+
+class _LRU:
+    def __init__(self, cap: int):
+        self._cap = cap
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, k):
+        with self._lock:
+            if k in self._d:
+                self._d.move_to_end(k)
+                return self._d[k]
+            return None
+
+    def put(self, k, v):
+        with self._lock:
+            self._d[k] = v
+            self._d.move_to_end(k)
+            if len(self._d) > self._cap:
+                self._d.popitem(last=False)
+
+
+class CachedMSP(api.MSP):
+    """Decorator MSP memoizing the hot calls (reference:
+    `msp/cache/cache.go`, default cache sizes 100/100/100)."""
+
+    def __init__(self, inner: api.MSP, size: int = 100):
+        self._inner = inner
+        self._deser = _LRU(size)
+        self._valid = _LRU(size)
+        self._sat = _LRU(size)
+
+    def identifier(self) -> str:
+        return self._inner.identifier()
+
+    def setup(self, config) -> None:
+        self._inner.setup(config)
+
+    def deserialize_identity(self, serialized: bytes) -> api.Identity:
+        hit = self._deser.get(serialized)
+        if hit is not None:
+            return hit
+        ident = self._inner.deserialize_identity(serialized)
+        self._deser.put(serialized, ident)
+        return ident
+
+    def is_well_formed(self, serialized: bytes) -> None:
+        self._inner.is_well_formed(serialized)
+
+    def validate(self, identity: api.Identity) -> None:
+        key = identity.serialize()
+        hit = self._valid.get(key)
+        if hit is True:
+            return
+        if isinstance(hit, Exception):
+            raise hit
+        try:
+            self._inner.validate(identity)
+        except Exception as e:
+            self._valid.put(key, e)
+            raise
+        self._valid.put(key, True)
+
+    def satisfies_principal(self, identity: api.Identity, principal) -> None:
+        key = (identity.serialize(), principal.SerializeToString())
+        hit = self._sat.get(key)
+        if hit is True:
+            return
+        if isinstance(hit, Exception):
+            raise hit
+        try:
+            self._inner.satisfies_principal(identity, principal)
+        except Exception as e:
+            self._sat.put(key, e)
+            raise
+        self._sat.put(key, True)
+
+    def get_default_signing_identity(self):
+        return self._inner.get_default_signing_identity()

@@ -1,0 +1,120 @@
+"""MSP interfaces — identity layer contracts.
+
+Rebuild of the reference's `msp/msp.go` (Identity at :115, MSP,
+MSPManager, IdentityDeserializer). Identities verify signatures through
+BCCSP, so the TPU batch path serves every consumer above (policies,
+gossip, block verification) without any of them knowing.
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+from typing import Optional, Sequence
+
+from fabric_tpu.bccsp.bccsp import VerifyItem
+
+
+class MSPRole(enum.IntEnum):
+    """Mirrors ftpu.policies.MSPRole.RoleType (and the reference's
+    msp_principal.proto)."""
+    MEMBER = 0
+    ADMIN = 1
+    CLIENT = 2
+    PEER = 3
+    ORDERER = 4
+
+
+class Identity(abc.ABC):
+    """A validated(able) member of some MSP (reference: `msp/msp.go:115`)."""
+
+    @abc.abstractmethod
+    def id_bytes(self) -> bytes:
+        """The raw serialized form (PEM cert)."""
+
+    @abc.abstractmethod
+    def mspid(self) -> str: ...
+
+    @abc.abstractmethod
+    def serialize(self) -> bytes:
+        """Marshaled ftpu.msp.SerializedIdentity."""
+
+    @abc.abstractmethod
+    def validate(self) -> None:
+        """Raise if this identity is not (or no longer) valid under its
+        MSP: untrusted chain, expired, revoked."""
+
+    @abc.abstractmethod
+    def verify(self, msg: bytes, sig: bytes) -> bool:
+        """hash(msg) then BCCSP verify — reference
+        `msp/identities.go:170-199`."""
+
+    @abc.abstractmethod
+    def verify_item(self, msg: bytes, sig: bytes) -> VerifyItem:
+        """The batch-path equivalent of `verify`: an item to feed
+        `bccsp.verify_batch`. New in this framework — lets the policy
+        engine collect a whole signature set and verify it in one
+        device dispatch."""
+
+    @abc.abstractmethod
+    def satisfies_principal(self, principal) -> None:
+        """Raise if this identity does not match the given
+        ftpu.policies.MSPPrincipal."""
+
+    @abc.abstractmethod
+    def organizational_units(self) -> Sequence[str]: ...
+
+    def expires_at(self) -> Optional[float]:
+        """Unix seconds of cert expiry, None if unknowable."""
+        return None
+
+
+class SigningIdentity(Identity):
+    """An identity we hold the private key for (reference:
+    `msp/msp.go` SigningIdentity)."""
+
+    @abc.abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+
+class IdentityDeserializer(abc.ABC):
+    """Reference: `msp/msp.go` IdentityDeserializer — implemented by both
+    MSP (one org) and MSPManager (a channel's orgs)."""
+
+    @abc.abstractmethod
+    def deserialize_identity(self, serialized: bytes) -> Identity: ...
+
+    @abc.abstractmethod
+    def is_well_formed(self, serialized: bytes) -> None:
+        """Raise if the bytes cannot possibly be one of our identities
+        (cheap syntactic check before any crypto)."""
+
+
+class MSP(IdentityDeserializer):
+    """One organization's membership rules (reference: `msp/msp.go` MSP)."""
+
+    @abc.abstractmethod
+    def identifier(self) -> str: ...
+
+    @abc.abstractmethod
+    def setup(self, config) -> None:
+        """Configure from a ftpu.msp.MSPConfig."""
+
+    @abc.abstractmethod
+    def validate(self, identity: Identity) -> None: ...
+
+    @abc.abstractmethod
+    def satisfies_principal(self, identity: Identity, principal) -> None: ...
+
+    def get_default_signing_identity(self) -> SigningIdentity:
+        raise NotImplementedError("MSP holds no signing identity")
+
+
+class MSPManager(IdentityDeserializer):
+    """Multiplexes MSPs by identifier (reference: `msp/mspmgrimpl.go`)."""
+
+    @abc.abstractmethod
+    def setup(self, msps: Sequence[MSP]) -> None: ...
+
+    @abc.abstractmethod
+    def get_msps(self) -> dict[str, MSP]: ...

@@ -134,7 +134,7 @@ def unmarshal_block(raw: bytes) -> common.Block:
 
 def extract_envelope(block: common.Block, index: int) -> common.Envelope:
     """Reference: `protoutil/blockutils.go` ExtractEnvelope."""
-    if index >= len(block.data.data):
+    if index < 0 or index >= len(block.data.data):
         raise IndexError(f"envelope index {index} out of bounds "
                          f"({len(block.data.data)} entries)")
     return unmarshal_envelope(block.data.data[index])

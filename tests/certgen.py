@@ -51,7 +51,7 @@ def make_self_signed(cn: str):
 
 
 def make_leaf(cn: str, ca_cert, ca_priv, org: str | None = None,
-              ou: str | None = None):
+              ou: str | None = None, not_after=None):
     """Leaf cert signed by the given CA (CA:FALSE), optional OU."""
     priv = ec.generate_private_key(ec.SECP256R1())
     attrs = [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
@@ -66,9 +66,51 @@ def make_leaf(cn: str, ca_cert, ca_priv, org: str | None = None,
         .public_key(priv.public_key())
         .serial_number(x509.random_serial_number())
         .not_valid_before(_NOT_BEFORE)
-        .not_valid_after(_NOT_AFTER)
+        .not_valid_after(not_after or _NOT_AFTER)
         .add_extension(x509.BasicConstraints(ca=False, path_length=None),
                        critical=True)
         .sign(ca_priv, hashes.SHA256())
     )
     return cert, priv
+
+
+def make_intermediate(cn: str, ca_cert, ca_priv):
+    """Intermediate CA signed by a root (CA:TRUE)."""
+    priv = ec.generate_private_key(ec.SECP256R1())
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(cn))
+        .issuer_name(ca_cert.subject)
+        .public_key(priv.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_NOT_BEFORE)
+        .not_valid_after(_NOT_AFTER)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0),
+                       critical=True)
+        .sign(ca_priv, hashes.SHA256())
+    )
+    return cert, priv
+
+
+def make_crl(ca_cert, ca_priv, revoked_serials):
+    """CRL issued by the given CA revoking the given serial numbers."""
+    builder = (
+        x509.CertificateRevocationListBuilder()
+        .issuer_name(ca_cert.subject)
+        .last_update(_NOT_BEFORE)
+        .next_update(_NOT_AFTER)
+    )
+    for serial in revoked_serials:
+        builder = builder.add_revoked_certificate(
+            x509.RevokedCertificateBuilder()
+            .serial_number(serial)
+            .revocation_date(_NOT_BEFORE)
+            .build()
+        )
+    return builder.sign(ca_priv, hashes.SHA256())
+
+
+def pem(obj) -> bytes:
+    """PEM-encode a cert or CRL."""
+    from cryptography.hazmat.primitives.serialization import Encoding
+    return obj.public_bytes(Encoding.PEM)

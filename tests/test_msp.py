@@ -1,0 +1,312 @@
+"""MSP tests — mirrors the reference's msp package tests
+(`msp/msp_test.go`, `msp/cache/cache_test.go` shape): setup, chain
+validation, revocation, principal matching, manager routing, cache."""
+
+import datetime
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.msp import CachedMSP, Manager, X509MSP, build_msp_config
+from fabric_tpu.msp.mspimpl import MSPError, PrincipalNotSatisfied
+from fabric_tpu.protos import msp as msppb, policies as polpb
+from tests import certgen
+
+
+@pytest.fixture(scope="module")
+def org1():
+    """Org1: root CA, intermediate CA, member leaf, admin leaf,
+    OU-classified peer leaf, revoked leaf."""
+    root, root_key = certgen.make_self_signed("org1-root-ca")
+    inter, inter_key = certgen.make_intermediate("org1-inter-ca",
+                                                 root, root_key)
+    member, member_key = certgen.make_leaf("user1", inter, inter_key)
+    admin, admin_key = certgen.make_leaf("admin1", inter, inter_key)
+    peer, peer_key = certgen.make_leaf("peer0", inter, inter_key, ou="peer")
+    client, client_key = certgen.make_leaf("client3", inter, inter_key,
+                                           ou="client")
+    revoked, revoked_key = certgen.make_leaf("bad-user", inter, inter_key)
+    crl = certgen.make_crl(inter, inter_key, [revoked.serial_number])
+    return {
+        "root": (root, root_key), "inter": (inter, inter_key),
+        "member": (member, member_key), "admin": (admin, admin_key),
+        "peer": (peer, peer_key), "client": (client, client_key),
+        "revoked": (revoked, revoked_key), "crl": crl,
+    }
+
+
+def _msp_for(org1, node_ous=False, with_crl=True) -> X509MSP:
+    csp = SWProvider()
+    nodeous = None
+    if node_ous:
+        nodeous = msppb.NodeOUs(enable=True)
+        nodeous.peer_ou_identifier.organizational_unit_identifier = "peer"
+        nodeous.client_ou_identifier.organizational_unit_identifier = "client"
+        nodeous.admin_ou_identifier.organizational_unit_identifier = "admin"
+    config = build_msp_config(
+        name="Org1MSP",
+        root_certs=[certgen.pem(org1["root"][0])],
+        intermediate_certs=[certgen.pem(org1["inter"][0])],
+        admins=[certgen.pem(org1["admin"][0])],
+        revocation_list=[certgen.pem(org1["crl"])] if with_crl else [],
+        node_ous=nodeous,
+    )
+    msp = X509MSP(csp)
+    msp.setup(config)
+    return msp
+
+
+def _sid(cert) -> bytes:
+    sid = msppb.SerializedIdentity()
+    sid.mspid = "Org1MSP"
+    sid.id_bytes = certgen.pem(cert)
+    return sid.SerializeToString(deterministic=True)
+
+
+def _role_principal(mspid, role) -> polpb.MSPPrincipal:
+    p = polpb.MSPPrincipal()
+    p.classification = polpb.MSPPrincipal.ROLE
+    p.principal = polpb.MSPRole(
+        msp_identifier=mspid, role=role).SerializeToString()
+    return p
+
+
+class TestValidation:
+    def test_member_chain_validates(self, org1):
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(org1["member"][0]))
+        ident.validate()   # no raise
+
+    def test_unknown_ca_rejected(self, org1):
+        msp = _msp_for(org1)
+        other_root, other_key = certgen.make_self_signed("evil-ca")
+        stranger, _ = certgen.make_leaf("mallory", other_root, other_key)
+        ident = msp.deserialize_identity(_sid(stranger))
+        with pytest.raises(MSPError, match="no trusted issuer"):
+            ident.validate()
+
+    def test_revoked_rejected(self, org1):
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(org1["revoked"][0]))
+        with pytest.raises(MSPError, match="revoked"):
+            ident.validate()
+        # without the CRL the same cert is fine
+        ident2 = _msp_for(org1, with_crl=False).deserialize_identity(
+            _sid(org1["revoked"][0]))
+        ident2.validate()
+
+    def test_expired_rejected(self, org1):
+        inter, inter_key = org1["inter"]
+        old, _ = certgen.make_leaf(
+            "old-user", inter, inter_key,
+            not_after=datetime.datetime(2021, 1, 1))
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(old))
+        with pytest.raises(MSPError, match="validity period"):
+            ident.validate()
+
+    def test_wrong_mspid_rejected(self, org1):
+        msp = _msp_for(org1)
+        sid = msppb.SerializedIdentity()
+        sid.mspid = "OtherMSP"
+        sid.id_bytes = certgen.pem(org1["member"][0])
+        with pytest.raises(MSPError, match="expected MSP ID"):
+            msp.deserialize_identity(sid.SerializeToString())
+
+    def test_resetup_drops_stale_crl(self, org1):
+        """Channel reconfig removing a CRL must un-revoke (setup resets
+        revocation state, it doesn't accumulate)."""
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(org1["revoked"][0]))
+        with pytest.raises(MSPError, match="revoked"):
+            ident.validate()
+        config_no_crl = build_msp_config(
+            name="Org1MSP",
+            root_certs=[certgen.pem(org1["root"][0])],
+            intermediate_certs=[certgen.pem(org1["inter"][0])],
+        )
+        msp.setup(config_no_crl)
+        msp.deserialize_identity(_sid(org1["revoked"][0])).validate()
+
+    def test_deserialize_does_not_touch_keystore(self, org1, tmp_path):
+        """Identity deserialization is the hot path: it must not write
+        key files (imports are ephemeral)."""
+        from fabric_tpu.bccsp.keystore import FileKeyStore
+        from fabric_tpu.bccsp.sw import SWProvider as SW
+        csp = SW(FileKeyStore(str(tmp_path)))
+        msp = X509MSP(csp)
+        msp.setup(build_msp_config(
+            name="Org1MSP",
+            root_certs=[certgen.pem(org1["root"][0])],
+            intermediate_certs=[certgen.pem(org1["inter"][0])]))
+        msp.deserialize_identity(_sid(org1["member"][0]))
+        assert list(tmp_path.iterdir()) == []
+
+    def test_is_well_formed(self, org1):
+        msp = _msp_for(org1)
+        msp.is_well_formed(_sid(org1["member"][0]))
+        with pytest.raises(MSPError):
+            msp.is_well_formed(b"\x00garbage")
+
+
+class TestSignVerify:
+    def test_identity_verify_roundtrip(self, org1):
+        """identity.verify = hash + bccsp verify
+        (reference msp/identities.go:170-199)."""
+        from fabric_tpu.bccsp.bccsp import ECDSAPrivateKeyImportOpts
+        msp = _msp_for(org1)
+        cert, priv = org1["member"]
+        csp = msp.csp
+        priv_key = csp.key_import(priv, ECDSAPrivateKeyImportOpts())
+        ident = msp.deserialize_identity(_sid(cert))
+        msg = b"endorsement payload"
+        sig = csp.sign(priv_key, csp.hash(msg))
+        assert ident.verify(msg, sig)
+        assert not ident.verify(msg + b"!", sig)
+        # the batch item carries the same key + message
+        item = ident.verify_item(msg, sig)
+        assert item.key is ident.key and item.message == msg
+
+    def test_verify_items_batch_with_provider(self, org1):
+        """Whole-set verification through verify_batch — the path the
+        policy engine uses (batched TPU dispatch upstream)."""
+        from fabric_tpu.bccsp.bccsp import ECDSAPrivateKeyImportOpts
+        msp = _msp_for(org1)
+        csp = msp.csp
+        items, expect = [], []
+        for who in ("member", "admin", "peer"):
+            cert, priv = org1[who]
+            pk = csp.key_import(priv, ECDSAPrivateKeyImportOpts())
+            ident = msp.deserialize_identity(_sid(cert))
+            msg = f"payload from {who}".encode()
+            sig = csp.sign(pk, csp.hash(msg))
+            items.append(ident.verify_item(msg, sig))
+            expect.append(True)
+            items.append(ident.verify_item(msg + b"x", sig))
+            expect.append(False)
+        assert csp.verify_batch(items) == expect
+
+
+class TestPrincipals:
+    def test_member_role(self, org1):
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(org1["member"][0]))
+        ident.satisfies_principal(
+            _role_principal("Org1MSP", polpb.MSPRole.MEMBER))
+        with pytest.raises(PrincipalNotSatisfied, match="for MSP"):
+            ident.satisfies_principal(
+                _role_principal("Org2MSP", polpb.MSPRole.MEMBER))
+
+    def test_admin_by_list(self, org1):
+        msp = _msp_for(org1)
+        admin = msp.deserialize_identity(_sid(org1["admin"][0]))
+        admin.satisfies_principal(
+            _role_principal("Org1MSP", polpb.MSPRole.ADMIN))
+        member = msp.deserialize_identity(_sid(org1["member"][0]))
+        with pytest.raises(PrincipalNotSatisfied, match="not an admin"):
+            member.satisfies_principal(
+                _role_principal("Org1MSP", polpb.MSPRole.ADMIN))
+
+    def test_node_ou_roles(self, org1):
+        msp = _msp_for(org1, node_ous=True)
+        peer = msp.deserialize_identity(_sid(org1["peer"][0]))
+        peer.satisfies_principal(
+            _role_principal("Org1MSP", polpb.MSPRole.PEER))
+        with pytest.raises(PrincipalNotSatisfied):
+            peer.satisfies_principal(
+                _role_principal("Org1MSP", polpb.MSPRole.CLIENT))
+        client = msp.deserialize_identity(_sid(org1["client"][0]))
+        client.satisfies_principal(
+            _role_principal("Org1MSP", polpb.MSPRole.CLIENT))
+        # NodeOUs disabled -> peer/client roles unclassifiable
+        msp2 = _msp_for(org1, node_ous=False)
+        peer2 = msp2.deserialize_identity(_sid(org1["peer"][0]))
+        with pytest.raises(PrincipalNotSatisfied, match="NodeOUs disabled"):
+            peer2.satisfies_principal(
+                _role_principal("Org1MSP", polpb.MSPRole.PEER))
+
+    def test_identity_principal(self, org1):
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(org1["member"][0]))
+        p = polpb.MSPPrincipal()
+        p.classification = polpb.MSPPrincipal.IDENTITY
+        p.principal = ident.serialize()
+        ident.satisfies_principal(p)
+        p.principal = b"someone else"
+        with pytest.raises(PrincipalNotSatisfied):
+            ident.satisfies_principal(p)
+
+    def test_ou_principal(self, org1):
+        msp = _msp_for(org1)
+        peer = msp.deserialize_identity(_sid(org1["peer"][0]))
+        p = polpb.MSPPrincipal()
+        p.classification = polpb.MSPPrincipal.ORGANIZATION_UNIT
+        p.principal = polpb.OrganizationUnit(
+            msp_identifier="Org1MSP",
+            organizational_unit_identifier="peer").SerializeToString()
+        peer.satisfies_principal(p)
+        member = msp.deserialize_identity(_sid(org1["member"][0]))
+        with pytest.raises(PrincipalNotSatisfied):
+            member.satisfies_principal(p)
+
+    def test_combined_principal(self, org1):
+        msp = _msp_for(org1, node_ous=True)
+        peer = msp.deserialize_identity(_sid(org1["peer"][0]))
+        combined = polpb.CombinedPrincipal()
+        combined.principals.add().CopyFrom(
+            _role_principal("Org1MSP", polpb.MSPRole.MEMBER))
+        combined.principals.add().CopyFrom(
+            _role_principal("Org1MSP", polpb.MSPRole.PEER))
+        p = polpb.MSPPrincipal()
+        p.classification = polpb.MSPPrincipal.COMBINED
+        p.principal = combined.SerializeToString()
+        peer.satisfies_principal(p)
+
+    def test_anonymity_principal(self, org1):
+        msp = _msp_for(org1)
+        ident = msp.deserialize_identity(_sid(org1["member"][0]))
+        p = polpb.MSPPrincipal()
+        p.classification = polpb.MSPPrincipal.ANONYMITY
+        p.principal = polpb.MSPIdentityAnonymity(
+            anonymity_type=polpb.MSPIdentityAnonymity.NOMINAL
+        ).SerializeToString()
+        ident.satisfies_principal(p)
+        p.principal = polpb.MSPIdentityAnonymity(
+            anonymity_type=polpb.MSPIdentityAnonymity.ANONYMOUS
+        ).SerializeToString()
+        with pytest.raises(PrincipalNotSatisfied, match="anonymous"):
+            ident.satisfies_principal(p)
+
+
+class TestManagerAndCache:
+    def test_manager_routes_by_mspid(self, org1):
+        msp = _msp_for(org1)
+        mgr = Manager()
+        mgr.setup([msp])
+        ident = mgr.deserialize_identity(_sid(org1["member"][0]))
+        assert ident.mspid() == "Org1MSP"
+        sid = msppb.SerializedIdentity(mspid="NopeMSP", id_bytes=b"x")
+        with pytest.raises(MSPError, match="unknown"):
+            mgr.deserialize_identity(sid.SerializeToString())
+
+    def test_cache_memoizes_deserialize(self, org1):
+        inner = _msp_for(org1)
+        calls = {"n": 0}
+        orig = inner.deserialize_identity
+
+        def counting(serialized):
+            calls["n"] += 1
+            return orig(serialized)
+        inner.deserialize_identity = counting
+        cached = CachedMSP(inner)
+        a = cached.deserialize_identity(_sid(org1["member"][0]))
+        b = cached.deserialize_identity(_sid(org1["member"][0]))
+        assert a is b
+        assert calls["n"] == 1
+
+    def test_cache_memoizes_failures(self, org1):
+        cached = CachedMSP(_msp_for(org1))
+        ident = cached.deserialize_identity(_sid(org1["revoked"][0]))
+        for _ in range(2):
+            with pytest.raises(MSPError, match="revoked"):
+                cached.validate(ident)

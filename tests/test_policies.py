@@ -1,0 +1,265 @@
+"""Policy engine tests — mirrors the reference's
+`common/cauthdsl/cauthdsl_test.go`, `policydsl_test.go`,
+`implicitmeta_test.go` shapes, plus the batched signature-set path."""
+
+import pytest
+
+from fabric_tpu.bccsp.bccsp import ECDSAPrivateKeyImportOpts
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.policies import (
+    ImplicitMetaPolicy,
+    Manager,
+    PolicyError,
+    SignaturePolicy,
+    from_string,
+    signature_set_to_valid_identities,
+)
+from fabric_tpu.common.policies.policydsl import PolicyParseError
+from fabric_tpu.msp import Manager as MSPManager, X509MSP, build_msp_config
+from fabric_tpu.protos import msp as msppb, policies as polpb
+from fabric_tpu.protoutil import SignedData
+from tests import certgen
+
+
+@pytest.fixture(scope="module")
+def orgs():
+    """Three orgs, one signer each, one shared MSP manager + csp."""
+    csp = SWProvider()
+    mgr = MSPManager()
+    msps = []
+    world = {}
+    for org in ("Org1", "Org2", "Org3"):
+        root, root_key = certgen.make_self_signed(f"{org.lower()}-ca")
+        leaf, leaf_key = certgen.make_leaf("signer", root, root_key)
+        admin, admin_key = certgen.make_leaf("admin", root, root_key)
+        msp = X509MSP(csp)
+        msp.setup(build_msp_config(
+            name=f"{org}MSP",
+            root_certs=[certgen.pem(root)],
+            admins=[certgen.pem(admin)],
+        ))
+        msps.append(msp)
+        priv = csp.key_import(leaf_key, ECDSAPrivateKeyImportOpts())
+        apriv = csp.key_import(admin_key, ECDSAPrivateKeyImportOpts())
+        sid = msppb.SerializedIdentity(
+            mspid=f"{org}MSP", id_bytes=certgen.pem(leaf))
+        asid = msppb.SerializedIdentity(
+            mspid=f"{org}MSP", id_bytes=certgen.pem(admin))
+        world[org] = {
+            "sid": sid.SerializeToString(deterministic=True),
+            "asid": asid.SerializeToString(deterministic=True),
+            "priv": priv, "apriv": apriv,
+        }
+    mgr.setup(msps)
+    world["mgr"] = mgr
+    world["csp"] = csp
+    return world
+
+
+def _signed(orgs, org, msg, admin=False, garbage=False):
+    csp = orgs["csp"]
+    o = orgs[org]
+    key = o["apriv"] if admin else o["priv"]
+    sig = b"\x01bad" if garbage else csp.sign(key, csp.hash(msg))
+    return SignedData(data=msg, identity=o["asid"] if admin else o["sid"],
+                      signature=sig)
+
+
+class TestPolicyDSL:
+    def test_and_or_outof(self):
+        env = from_string("AND('Org1.member', OR('Org2.member', "
+                          "'Org3.admin'))")
+        assert env.rule.n_out_of.n == 2
+        assert len(env.rule.n_out_of.rules) == 2
+        assert env.rule.n_out_of.rules[1].n_out_of.n == 1
+        assert len(env.identities) == 3
+        role = polpb.MSPRole()
+        role.ParseFromString(env.identities[2].principal)
+        assert role.msp_identifier == "Org3MSP" or \
+            role.msp_identifier == "Org3"
+
+    def test_outof(self):
+        env = from_string("OutOf(2, 'Org1.member', 'Org2.member', "
+                          "'Org3.member')")
+        assert env.rule.n_out_of.n == 2
+        assert len(env.rule.n_out_of.rules) == 3
+
+    def test_duplicate_principals_are_shared(self):
+        env = from_string("OR('Org1.member', 'Org1.member')")
+        assert len(env.identities) == 1
+
+    def test_dotted_mspid(self):
+        env = from_string("OR('org.example.com.member')")
+        role = polpb.MSPRole()
+        role.ParseFromString(env.identities[0].principal)
+        assert role.msp_identifier == "org.example.com"
+        assert role.role == polpb.MSPRole.MEMBER
+
+    @pytest.mark.parametrize("bad", [
+        "", "AND()", "AND('Org1.member'", "XOR('A.member','B.member')",
+        "'Org1.wizard'", "'no-dot'", "OutOf('Org1.member')",
+        "OutOf(3, 'Org1.member')", "AND('A.member') garbage",
+    ])
+    def test_malformed_rejected(self, bad):
+        with pytest.raises(PolicyParseError):
+            from_string(bad)
+
+
+class TestSignaturePolicy:
+    def _policy(self, orgs, spec):
+        env = from_string(spec)
+        # the DSL writes bare org names; our fixture MSP ids end in MSP
+        for p in env.identities:
+            role = polpb.MSPRole()
+            role.ParseFromString(p.principal)
+            if not role.msp_identifier.endswith("MSP"):
+                role.msp_identifier += "MSP"
+                p.principal = role.SerializeToString()
+        return SignaturePolicy(env, orgs["mgr"], orgs["csp"])
+
+    def test_two_of_three(self, orgs):
+        pol = self._policy(
+            orgs, "OutOf(2, 'Org1.member', 'Org2.member', 'Org3.member')")
+        msg = b"the block payload"
+        pol.evaluate_signed_data([
+            _signed(orgs, "Org1", msg), _signed(orgs, "Org2", msg)])
+        with pytest.raises(PolicyError):
+            pol.evaluate_signed_data([_signed(orgs, "Org1", msg)])
+
+    def test_bad_signature_drops_identity(self, orgs):
+        pol = self._policy(orgs, "AND('Org1.member', 'Org2.member')")
+        msg = b"payload"
+        with pytest.raises(PolicyError):
+            pol.evaluate_signed_data([
+                _signed(orgs, "Org1", msg),
+                _signed(orgs, "Org2", msg, garbage=True)])
+
+    def test_one_identity_cannot_satisfy_two_leaves(self, orgs):
+        """The `used` semantics: AND('Org1.member','Org1.member') needs
+        two DISTINCT Org1 signers (reference cauthdsl used-vector)."""
+        pol = self._policy(orgs, "AND('Org1.member', 'Org1.member')")
+        msg = b"payload"
+        with pytest.raises(PolicyError):
+            pol.evaluate_signed_data([_signed(orgs, "Org1", msg)])
+        # member + admin of Org1 are two distinct identities
+        pol.evaluate_signed_data([
+            _signed(orgs, "Org1", msg),
+            _signed(orgs, "Org1", msg, admin=True)])
+
+    def test_admin_role(self, orgs):
+        pol = self._policy(orgs, "AND('Org1.admin')")
+        msg = b"cfg update"
+        pol.evaluate_signed_data([_signed(orgs, "Org1", msg, admin=True)])
+        with pytest.raises(PolicyError):
+            pol.evaluate_signed_data([_signed(orgs, "Org1", msg)])
+
+    def test_duplicate_signed_data_deduped(self, orgs):
+        sd = _signed(orgs, "Org1", b"m")
+        idents = signature_set_to_valid_identities(
+            [sd, sd, sd], orgs["mgr"], orgs["csp"])
+        assert len(idents) == 1
+
+    def test_unknown_identity_skipped(self, orgs):
+        sd = SignedData(data=b"m", identity=b"not-an-identity",
+                        signature=b"x")
+        assert signature_set_to_valid_identities(
+            [sd], orgs["mgr"], orgs["csp"]) == []
+
+
+class TestImplicitMeta:
+    def _org_manager(self, orgs, org):
+        env = from_string(f"OR('{org}.member')")
+        role = polpb.MSPRole()
+        role.ParseFromString(env.identities[0].principal)
+        role.msp_identifier += "MSP"
+        env.identities[0].principal = role.SerializeToString()
+        pol = SignaturePolicy(env, orgs["mgr"], orgs["csp"])
+        return Manager(name=org, policies={"Writers": pol})
+
+    def test_majority(self, orgs):
+        managers = [self._org_manager(orgs, o)
+                    for o in ("Org1", "Org2", "Org3")]
+        meta = polpb.ImplicitMetaPolicy(
+            sub_policy="Writers", rule=polpb.ImplicitMetaPolicy.MAJORITY)
+        pol = ImplicitMetaPolicy.from_managers(meta, managers)
+        msg = b"tx"
+        pol.evaluate_signed_data([
+            _signed(orgs, "Org1", msg), _signed(orgs, "Org2", msg)])
+        with pytest.raises(PolicyError, match="needed 2"):
+            pol.evaluate_signed_data([_signed(orgs, "Org1", msg)])
+
+    def test_all_and_any(self, orgs):
+        managers = [self._org_manager(orgs, o) for o in ("Org1", "Org2")]
+        msg = b"tx"
+        any_pol = ImplicitMetaPolicy.from_managers(
+            polpb.ImplicitMetaPolicy(
+                sub_policy="Writers", rule=polpb.ImplicitMetaPolicy.ANY),
+            managers)
+        any_pol.evaluate_signed_data([_signed(orgs, "Org2", msg)])
+        all_pol = ImplicitMetaPolicy.from_managers(
+            polpb.ImplicitMetaPolicy(
+                sub_policy="Writers", rule=polpb.ImplicitMetaPolicy.ALL),
+            managers)
+        with pytest.raises(PolicyError):
+            all_pol.evaluate_signed_data([_signed(orgs, "Org2", msg)])
+
+    def test_any_over_nothing_fails_closed(self):
+        meta = polpb.ImplicitMetaPolicy(
+            sub_policy="Writers", rule=polpb.ImplicitMetaPolicy.ANY)
+        pol = ImplicitMetaPolicy(meta, [])
+        with pytest.raises(PolicyError):
+            pol.evaluate_signed_data([])
+
+    def test_all_over_nothing_passes_vacuously(self):
+        # reference implicitmeta.go: remaining == 0 -> nil
+        meta = polpb.ImplicitMetaPolicy(
+            sub_policy="Writers", rule=polpb.ImplicitMetaPolicy.ALL)
+        ImplicitMetaPolicy(meta, []).evaluate_signed_data([])
+
+    def test_converter_batches_once(self, orgs):
+        """With a converter, K sub-policies trigger exactly ONE
+        verify_batch dispatch over the signature set."""
+        managers = [self._org_manager(orgs, o)
+                    for o in ("Org1", "Org2", "Org3")]
+        meta = polpb.ImplicitMetaPolicy(
+            sub_policy="Writers", rule=polpb.ImplicitMetaPolicy.MAJORITY)
+        calls = {"n": 0}
+        csp = orgs["csp"]
+        orig = csp.verify_batch
+
+        def counting(items):
+            calls["n"] += 1
+            return orig(items)
+        csp.verify_batch = counting
+        try:
+            pol = ImplicitMetaPolicy.from_managers(
+                meta, managers, converter=(orgs["mgr"], csp))
+            msg = b"tx"
+            pol.evaluate_signed_data([
+                _signed(orgs, "Org1", msg), _signed(orgs, "Org2", msg)])
+        finally:
+            csp.verify_batch = orig
+        assert calls["n"] == 1
+
+
+class TestManager:
+    def test_path_routing(self, orgs):
+        writers = self._dummy_policy()
+        app = Manager(name="Application", policies={"Writers": writers})
+        channel = Manager(name="Channel", sub_managers={"Application": app})
+        assert channel.get_policy("/Channel/Application/Writers") is writers
+        assert channel.get_policy("Application/Writers") is writers
+        assert app.get_policy("Writers") is writers
+        assert not channel.has_policy("/Channel/Application/Nope")
+        with pytest.raises(PolicyError, match="does not start"):
+            channel.get_policy("/Other/Application/Writers")
+
+    @staticmethod
+    def _dummy_policy():
+        class Always:
+            def evaluate_signed_data(self, sd):
+                pass
+
+            def evaluate_identities(self, ids):
+                pass
+        return Always()
