@@ -151,7 +151,12 @@ class SWProvider(api.BCCSP):
     # -- keys --
 
     def _retain(self, key: api.Key) -> None:
-        self._mem[key.ski()] = key
+        # a public key and its private twin share an SKI (both hash the
+        # public point); never let the public half displace the private
+        # (FileKeyStore gets this for free via _sk/_pk suffixes)
+        existing = self._mem.get(key.ski())
+        if existing is None or not existing.private() or key.private():
+            self._mem[key.ski()] = key
         if self._ks is not None:
             self._ks.store_key(key)
 

@@ -1,0 +1,64 @@
+"""Capability gates — feature flags agreed in channel config.
+
+Rebuild of `common/capabilities/` (`application.go:28-57`,
+`channel.go`, `orderer.go`): each level (channel/application/orderer)
+declares named capabilities in its config; nodes refuse to process a
+channel whose required capabilities they don't implement.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos import configtx as ctxpb
+
+# capabilities this implementation understands
+CHANNEL_V2_0 = "V2_0"
+APPLICATION_V2_0 = "V2_0"
+ORDERER_V2_0 = "V2_0"
+
+_SUPPORTED_CHANNEL = {CHANNEL_V2_0}
+_SUPPORTED_APPLICATION = {APPLICATION_V2_0}
+_SUPPORTED_ORDERER = {ORDERER_V2_0}
+
+
+class CapabilityError(Exception):
+    pass
+
+
+class _Capabilities:
+    def __init__(self, cap_value: ctxpb.Capabilities | None,
+                 supported: set[str], level: str):
+        self._caps = set(cap_value.capabilities.keys()) if cap_value else set()
+        self._supported = supported
+        self._level = level
+
+    def declared(self) -> set[str]:
+        return set(self._caps)
+
+    def supported(self) -> None:
+        """Raise unless every declared capability is implemented
+        (reference: `common/capabilities/registry.go` Supported)."""
+        missing = self._caps - self._supported
+        if missing:
+            raise CapabilityError(
+                f"{self._level} capabilities {sorted(missing)} are "
+                f"required but not supported by this node")
+
+
+class ChannelCapabilities(_Capabilities):
+    def __init__(self, cap_value=None):
+        super().__init__(cap_value, _SUPPORTED_CHANNEL, "channel")
+
+
+class ApplicationCapabilities(_Capabilities):
+    def __init__(self, cap_value=None):
+        super().__init__(cap_value, _SUPPORTED_APPLICATION, "application")
+
+    def v20_validation(self) -> bool:
+        """Gate for the v2 tx-validation/lifecycle path (reference:
+        `common/capabilities/application.go:28-57` V2_0Validation)."""
+        return APPLICATION_V2_0 in self._caps
+
+
+class OrdererCapabilities(_Capabilities):
+    def __init__(self, cap_value=None):
+        super().__init__(cap_value, _SUPPORTED_ORDERER, "orderer")

@@ -1,0 +1,14 @@
+from fabric_tpu.common.channelconfig.bundle import (
+    ApplicationConfig,
+    ApplicationOrg,
+    Bundle,
+    ChannelConfig,
+    ConfigError,
+    OrdererConfig,
+    OrdererOrg,
+)
+
+__all__ = [
+    "ApplicationConfig", "ApplicationOrg", "Bundle", "ChannelConfig",
+    "ConfigError", "OrdererConfig", "OrdererOrg",
+]

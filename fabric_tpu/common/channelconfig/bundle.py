@@ -1,0 +1,276 @@
+"""Channel config Bundle: one immutable parse of a channel's Config.
+
+Rebuild of `common/channelconfig/` (`bundle.go:182` NewBundle,
+`channel.go`, `application.go`, `orderer.go`): given the channel's
+`Config` tree, build — once — the MSP manager for all orgs, the policy
+manager tree (signature + implicit-meta policies at every level), and
+typed views over the standard config values. Everything downstream
+(endorser, validator, orderer, gossip) reads THIS object; a config
+block replaces the bundle wholesale (no mutation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from fabric_tpu.common import capabilities as caps
+from fabric_tpu.common.policies import (
+    ImplicitMetaPolicy,
+    Manager,
+    SignaturePolicy,
+)
+from fabric_tpu.msp import CachedMSP, Manager as MSPManager, X509MSP
+from fabric_tpu.protos import configtx as ctxpb, msp as msppb
+from fabric_tpu.protos import policies as polpb
+
+# canonical group names (reference: channelconfig consts)
+APPLICATION = "Application"
+ORDERER = "Orderer"
+CONSORTIUMS = "Consortiums"
+
+MSP_KEY = "MSP"
+CAPABILITIES_KEY = "Capabilities"
+HASHING_ALGORITHM_KEY = "HashingAlgorithm"
+BLOCK_HASHING_KEY = "BlockDataHashingStructure"
+ORDERER_ADDRESSES_KEY = "OrdererAddresses"
+CONSORTIUM_KEY = "Consortium"
+BATCH_SIZE_KEY = "BatchSize"
+BATCH_TIMEOUT_KEY = "BatchTimeout"
+CONSENSUS_TYPE_KEY = "ConsensusType"
+CHANNEL_RESTRICTIONS_KEY = "ChannelRestrictions"
+ANCHOR_PEERS_KEY = "AnchorPeers"
+ACLS_KEY = "ACLs"
+ENDPOINTS_KEY = "Endpoints"
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _value(group: ctxpb.ConfigGroup, key: str, msg_type):
+    cv = group.values.get(key)
+    if cv is None:
+        return None
+    out = msg_type()
+    out.ParseFromString(cv.value)
+    return out
+
+
+@dataclass
+class ApplicationOrg:
+    name: str
+    mspid: str
+    anchor_peers: list = field(default_factory=list)
+
+
+@dataclass
+class OrdererOrg:
+    name: str
+    mspid: str
+    endpoints: list = field(default_factory=list)
+
+
+@dataclass
+class ApplicationConfig:
+    orgs: dict[str, ApplicationOrg]
+    capabilities: caps.ApplicationCapabilities
+    acls: dict[str, str]
+
+
+@dataclass
+class OrdererConfig:
+    orgs: dict[str, OrdererOrg]
+    consensus_type: str
+    consensus_metadata: bytes
+    consensus_state: int
+    batch_size: ctxpb.BatchSize
+    batch_timeout_s: float
+    max_channels: int
+    capabilities: caps.OrdererCapabilities
+
+
+@dataclass
+class ChannelConfig:
+    hashing_algorithm: str
+    orderer_addresses: list[str]
+    capabilities: caps.ChannelCapabilities
+    consortium: str
+
+
+class Bundle:
+    """Reference: `common/channelconfig/bundle.go:182` NewBundle(channel
+    id, config, bccsp) — takes the crypto provider explicitly, like the
+    reference, so MSPs verify through the batched path."""
+
+    def __init__(self, channel_id: str, config: ctxpb.Config, csp):
+        self.channel_id = channel_id
+        self.config = config
+        self.csp = csp
+        root = config.channel_group
+        self._msps: list = []
+
+        self.channel = self._parse_channel(root)
+        self.application: Optional[ApplicationConfig] = None
+        self.orderer: Optional[OrdererConfig] = None
+        app_group = root.groups.get(APPLICATION)
+        ord_group = root.groups.get(ORDERER)
+
+        # MSPs first: policies reference principals by mspid
+        for section in (app_group, ord_group):
+            if section is None:
+                continue
+            for org_name, org_group in section.groups.items():
+                self._load_msp(org_group, org_name)
+        self.msp_manager = MSPManager()
+        self.msp_manager.setup(self._msps)
+
+        # policy managers bottom-up (orgs -> section -> channel)
+        subs: dict[str, Manager] = {}
+        for section_name, section in ((APPLICATION, app_group),
+                                      (ORDERER, ord_group)):
+            if section is None:
+                continue
+            org_mgrs = {}
+            for org_name, org_group in section.groups.items():
+                org_mgrs[org_name] = Manager(
+                    name=org_name,
+                    policies=self._compile_policies(org_group, []))
+            section_policies = self._compile_policies(
+                section, list(org_mgrs.values()))
+            subs[section_name] = Manager(name=section_name,
+                                         policies=section_policies,
+                                         sub_managers=org_mgrs)
+        channel_policies = self._compile_policies(
+            root, list(subs.values()))
+        self.policy_manager = Manager(name="Channel",
+                                      policies=channel_policies,
+                                      sub_managers=subs)
+
+        if app_group is not None:
+            self.application = self._parse_application(app_group)
+        if ord_group is not None:
+            self.orderer = self._parse_orderer(ord_group)
+
+        # refuse to run with capabilities we don't implement
+        self.channel.capabilities.supported()
+        if self.application:
+            self.application.capabilities.supported()
+        if self.orderer:
+            self.orderer.capabilities.supported()
+
+    # -- sections --
+
+    def _parse_channel(self, root: ctxpb.ConfigGroup) -> ChannelConfig:
+        ha = _value(root, HASHING_ALGORITHM_KEY, ctxpb.HashingAlgorithm)
+        if ha is not None and ha.name not in ("", "SHA256"):
+            raise ConfigError(f"unsupported hashing algorithm {ha.name!r}")
+        addrs = _value(root, ORDERER_ADDRESSES_KEY, ctxpb.OrdererAddresses)
+        cap = _value(root, CAPABILITIES_KEY, ctxpb.Capabilities)
+        consortium = _value(root, CONSORTIUM_KEY, ctxpb.Consortium)
+        return ChannelConfig(
+            hashing_algorithm=(ha.name if ha and ha.name else "SHA256"),
+            orderer_addresses=list(addrs.addresses) if addrs else [],
+            capabilities=caps.ChannelCapabilities(cap),
+            consortium=consortium.name if consortium else "",
+        )
+
+    def _parse_application(self, group) -> ApplicationConfig:
+        orgs = {}
+        for name, og in group.groups.items():
+            msp_value = _value(og, MSP_KEY, ctxpb.MSPValue)
+            mspid = self._mspid_of(msp_value)
+            anchors = _value(og, ANCHOR_PEERS_KEY, ctxpb.AnchorPeers)
+            orgs[name] = ApplicationOrg(
+                name=name, mspid=mspid,
+                anchor_peers=[(a.host, a.port) for a in
+                              anchors.anchor_peers] if anchors else [])
+        acls = _value(group, ACLS_KEY, ctxpb.ACLs)
+        cap = _value(group, CAPABILITIES_KEY, ctxpb.Capabilities)
+        return ApplicationConfig(
+            orgs=orgs,
+            capabilities=caps.ApplicationCapabilities(cap),
+            acls=dict(acls.acls) if acls else {},
+        )
+
+    def _parse_orderer(self, group) -> OrdererConfig:
+        orgs = {}
+        for name, og in group.groups.items():
+            msp_value = _value(og, MSP_KEY, ctxpb.MSPValue)
+            endpoints = _value(og, ENDPOINTS_KEY, ctxpb.OrdererAddresses)
+            orgs[name] = OrdererOrg(
+                name=name, mspid=self._mspid_of(msp_value),
+                endpoints=list(endpoints.addresses) if endpoints else [])
+        ct = _value(group, CONSENSUS_TYPE_KEY, ctxpb.ConsensusType)
+        if ct is None:
+            raise ConfigError("Orderer group lacks ConsensusType")
+        bs = _value(group, BATCH_SIZE_KEY, ctxpb.BatchSize)
+        bt = _value(group, BATCH_TIMEOUT_KEY, ctxpb.BatchTimeout)
+        cr = _value(group, CHANNEL_RESTRICTIONS_KEY,
+                    ctxpb.ChannelRestrictions)
+        cap = _value(group, CAPABILITIES_KEY, ctxpb.Capabilities)
+        from fabric_tpu.common.viperutil import parse_duration
+        return OrdererConfig(
+            orgs=orgs,
+            consensus_type=ct.type,
+            consensus_metadata=bytes(ct.metadata),
+            consensus_state=ct.state,
+            batch_size=bs or ctxpb.BatchSize(
+                max_message_count=500,
+                absolute_max_bytes=10 * 1024 * 1024,
+                preferred_max_bytes=2 * 1024 * 1024),
+            batch_timeout_s=parse_duration(bt.timeout) if bt and bt.timeout
+            else 2.0,
+            max_channels=cr.max_count if cr else 0,
+            capabilities=caps.OrdererCapabilities(cap),
+        )
+
+    # -- msp / policy plumbing --
+
+    def _mspid_of(self, msp_value: Optional[ctxpb.MSPValue]) -> str:
+        if msp_value is None:
+            raise ConfigError("org group lacks MSP value")
+        mc = msppb.MSPConfig()
+        mc.ParseFromString(msp_value.config)
+        xc = msppb.X509MSPConfig()
+        xc.ParseFromString(mc.config)
+        return xc.name
+
+    def _load_msp(self, org_group, org_name: str) -> None:
+        msp_value = _value(org_group, MSP_KEY, ctxpb.MSPValue)
+        if msp_value is None:
+            raise ConfigError(f"org {org_name!r} lacks MSP value")
+        mc = msppb.MSPConfig()
+        mc.ParseFromString(msp_value.config)
+        msp = X509MSP(self.csp)
+        msp.setup(mc)
+        self._msps.append(CachedMSP(msp))
+
+    def _compile_policies(self, group: ctxpb.ConfigGroup,
+                          child_managers: list[Manager]) -> dict:
+        out = {}
+        for name, cp in group.policies.items():
+            pol = cp.policy
+            if pol.type == polpb.Policy.SIGNATURE:
+                out[name] = SignaturePolicy.from_bytes(
+                    pol.value, self._deserializer_proxy(), self.csp)
+            elif pol.type == polpb.Policy.IMPLICIT_META:
+                meta = polpb.ImplicitMetaPolicy()
+                meta.ParseFromString(pol.value)
+                out[name] = ImplicitMetaPolicy.from_managers(
+                    meta, child_managers,
+                    converter=(self._deserializer_proxy(), self.csp))
+            else:
+                raise ConfigError(
+                    f"policy {name!r} has unknown type {pol.type}")
+        return out
+
+    def _deserializer_proxy(self):
+        """Policies are compiled before the MSP manager is final; the
+        proxy defers the lookup to evaluation time."""
+        bundle = self
+
+        class _Proxy:
+            def deserialize_identity(self, serialized):
+                return bundle.msp_manager.deserialize_identity(serialized)
+        return _Proxy()

@@ -1,0 +1,7 @@
+from fabric_tpu.common.configtx.validator import (
+    ConfigTxError,
+    Validator,
+    compute_update,
+)
+
+__all__ = ["ConfigTxError", "Validator", "compute_update"]

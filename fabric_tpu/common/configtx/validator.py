@@ -1,0 +1,295 @@
+"""Config-transaction validation and delta computation.
+
+Rebuild of `common/configtx/{validator,update,compare}.go`: a channel
+reconfig is a ConfigUpdate (read_set: version assertions; write_set:
+the new content) signed by enough principals to satisfy the mod_policy
+of everything it touches.
+
+Semantics:
+- read_set versions must match the current config exactly;
+- a write_set element with the current version is context (merged
+  member-wise for groups);
+- an element with version+1 is a modification → its CURRENT
+  mod_policy must be satisfied by the update's signatures, and for
+  groups the new membership is exactly the write_set's members;
+- a new element must carry version 0 and satisfies the policy check
+  via its parent group's mod_policy (reference: validator.go
+  policyForItem walks up for new items).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fabric_tpu.protos import common, configtx as ctxpb
+from fabric_tpu import protoutil as pu
+from fabric_tpu.common.policies import PolicyError
+
+class ConfigTxError(Exception):
+    pass
+
+
+def _members(group: ctxpb.ConfigGroup):
+    """(kind, name, element) triples for all members of a group."""
+    for name, g in group.groups.items():
+        yield "groups", name, g
+    for name, v in group.values.items():
+        yield "values", name, v
+    for name, p in group.policies.items():
+        yield "policies", name, p
+
+
+class Validator:
+    """Per-channel config state machine (reference:
+    `common/configtx/validator.go` ValidatorImpl)."""
+
+    def __init__(self, channel_id: str, config: ctxpb.Config,
+                 policy_manager):
+        self.channel_id = channel_id
+        self.config = config
+        self._pm = policy_manager
+
+    def sequence(self) -> int:
+        return self.config.sequence
+
+    # -- entry point --
+
+    def propose_config_update(self, update_env: ctxpb.ConfigUpdateEnvelope
+                              ) -> ctxpb.Config:
+        """Validate + apply; returns the NEW Config (sequence+1).
+        Reference: `validator.go` ProposeConfigUpdate."""
+        update = ctxpb.ConfigUpdate()
+        update.ParseFromString(update_env.config_update)
+        if update.channel_id != self.channel_id:
+            raise ConfigTxError(
+                f"update for channel {update.channel_id!r}, "
+                f"validator is {self.channel_id!r}")
+
+        signed_data = [
+            pu.SignedData(
+                data=bytes(sig.signature_header) +
+                bytes(update_env.config_update),
+                identity=pu.get_signature_header(
+                    sig.signature_header).creator,
+                signature=bytes(sig.signature),
+            )
+            for sig in update_env.signatures
+        ]
+
+        current = self.config.channel_group
+        self._verify_read_set(current, update.read_set)
+        new_group = self._apply_group(
+            current, update.write_set, path=["Channel"],
+            signed_data=signed_data,
+            parent_mod_policy=current.mod_policy or "Admins")
+
+        new_config = ctxpb.Config(sequence=self.config.sequence + 1)
+        new_config.channel_group.CopyFrom(new_group)
+        return new_config
+
+    # -- read set --
+
+    def _verify_read_set(self, current: Optional[ctxpb.ConfigGroup],
+                         read: ctxpb.ConfigGroup, path: str = "Channel"
+                         ) -> None:
+        if current is None:
+            raise ConfigTxError(f"read_set references missing group {path}")
+        if read.version != current.version:
+            raise ConfigTxError(
+                f"read_set version mismatch at {path}: "
+                f"asserted {read.version}, current {current.version}")
+        for kind, name, elem in _members(read):
+            cur = getattr(current, kind).get(name)
+            if kind == "groups":
+                self._verify_read_set(cur, elem, f"{path}/{name}")
+            else:
+                if cur is None:
+                    raise ConfigTxError(
+                        f"read_set references missing {kind[:-1]} "
+                        f"{path}/{name}")
+                if elem.version != cur.version:
+                    raise ConfigTxError(
+                        f"read_set version mismatch at {path}/{name}")
+
+    # -- write set --
+
+    def _check_policy(self, mod_policy: str, path: list[str],
+                      signed_data) -> None:
+        if not mod_policy:
+            raise ConfigTxError(
+                f"element at {'/'.join(path)} has empty mod_policy — "
+                f"unmodifiable")
+        if mod_policy.startswith("/"):
+            policy_path = mod_policy
+        else:
+            policy_path = "/" + "/".join(path + [mod_policy])
+        try:
+            pol = self._pm.get_policy(policy_path)
+        except PolicyError as e:
+            raise ConfigTxError(
+                f"mod_policy {policy_path!r} cannot be resolved: {e}"
+            ) from e
+        try:
+            pol.evaluate_signed_data(signed_data)
+        except PolicyError as e:
+            raise ConfigTxError(
+                f"signature set does not satisfy mod_policy "
+                f"{policy_path!r}: {e}") from e
+
+    def _apply_group(self, current: ctxpb.ConfigGroup,
+                     write: ctxpb.ConfigGroup, path: list[str],
+                     signed_data, parent_mod_policy: str
+                     ) -> ctxpb.ConfigGroup:
+        modified = write.version == current.version + 1
+        if not modified and write.version != current.version:
+            raise ConfigTxError(
+                f"group {'/'.join(path)} version {write.version} is "
+                f"neither current ({current.version}) nor current+1")
+        if modified:
+            self._check_policy(current.mod_policy or parent_mod_policy,
+                               path, signed_data)
+
+        out = ctxpb.ConfigGroup()
+        out.version = write.version
+        out.mod_policy = write.mod_policy or current.mod_policy
+
+        if modified:
+            # membership is exactly the write set's members
+            keep = {(k, n) for k, n, _ in _members(write)}
+        else:
+            keep = None   # merge: unmentioned members are retained
+
+        # start from current members that survive
+        for kind, name, elem in _members(current):
+            if keep is not None and (kind, name) not in keep:
+                continue
+            getattr(out, kind)[name].CopyFrom(elem)
+
+        # apply write members
+        for kind, name, elem in _members(write):
+            cur = getattr(current, kind).get(name)
+            sub_path = path + [name]
+            if kind == "groups":
+                if cur is None:
+                    self._check_new_group(elem, sub_path, signed_data,
+                                          out.mod_policy)
+                    out.groups[name].CopyFrom(elem)
+                else:
+                    out.groups[name].CopyFrom(self._apply_group(
+                        cur, elem, sub_path, signed_data,
+                        out.mod_policy))
+            else:
+                if cur is None:
+                    if elem.version != 0:
+                        raise ConfigTxError(
+                            f"new {kind[:-1]} {'/'.join(sub_path)} must "
+                            f"have version 0, has {elem.version}")
+                    self._check_policy(out.mod_policy, path, signed_data)
+                    getattr(out, kind)[name].CopyFrom(elem)
+                elif elem.version == cur.version:
+                    if pu.marshal(elem) != pu.marshal(cur):
+                        raise ConfigTxError(
+                            f"{kind[:-1]} {'/'.join(sub_path)} changed "
+                            f"without version bump")
+                elif elem.version == cur.version + 1:
+                    self._check_policy(cur.mod_policy or out.mod_policy,
+                                       path, signed_data)
+                    getattr(out, kind)[name].CopyFrom(elem)
+                else:
+                    raise ConfigTxError(
+                        f"{kind[:-1]} {'/'.join(sub_path)} version "
+                        f"{elem.version} invalid (current {cur.version})")
+        return out
+
+    def _check_new_group(self, group: ctxpb.ConfigGroup, path: list[str],
+                         signed_data, parent_mod_policy: str) -> None:
+        if group.version != 0:
+            raise ConfigTxError(
+                f"new group {'/'.join(path)} must have version 0")
+        self._check_policy(parent_mod_policy, path[:-1], signed_data)
+
+
+# ---- client-side delta computation (reference: update.go) ----
+
+def compute_update(channel_id: str, original: ctxpb.Config,
+                   updated: ctxpb.Config) -> ctxpb.ConfigUpdate:
+    """Compute the ConfigUpdate transforming `original` into `updated`
+    (reference: `common/configtx/update.go` Compute). Unchanged members
+    of modified groups are carried in the write_set at their current
+    version so membership stays exact."""
+    read = ctxpb.ConfigGroup()
+    write = ctxpb.ConfigGroup()
+    changed = _compute_group(original.channel_group,
+                             updated.channel_group, read, write)
+    if not changed:
+        raise ConfigTxError("no differences between configs")
+    update = ctxpb.ConfigUpdate(channel_id=channel_id)
+    update.read_set.CopyFrom(read)
+    update.write_set.CopyFrom(write)
+    return update
+
+
+def _compute_group(orig: ctxpb.ConfigGroup, new: ctxpb.ConfigGroup,
+                   read: ctxpb.ConfigGroup,
+                   write: ctxpb.ConfigGroup) -> bool:
+    """Returns True iff this subtree differs. The group's own version
+    bumps only for DIRECT changes (membership, values, policies at this
+    level) — a change buried in a subgroup leaves this group at its
+    current version as pure context (matching the validator's merge
+    rule for unbumped groups)."""
+    membership_changed = (
+        set(orig.groups) != set(new.groups)
+        or set(orig.values) != set(new.values)
+        or set(orig.policies) != set(new.policies)
+    )
+    direct_changed = membership_changed
+    nested_changed = False
+
+    for kind in ("values", "policies"):
+        for name, elem in getattr(new, kind).items():
+            cur = getattr(orig, kind).get(name)
+            if cur is None:
+                target = getattr(write, kind)[name]
+                target.CopyFrom(elem)
+                target.version = 0
+                direct_changed = True
+            elif pu.marshal(_strip_version(elem)) != \
+                    pu.marshal(_strip_version(cur)):
+                target = getattr(write, kind)[name]
+                target.CopyFrom(elem)
+                target.version = cur.version + 1
+                direct_changed = True
+
+    for name, elem in new.groups.items():
+        cur = orig.groups.get(name)
+        if cur is None:
+            write.groups[name].CopyFrom(elem)
+            direct_changed = True
+            continue
+        sub_read = ctxpb.ConfigGroup()
+        sub_write = ctxpb.ConfigGroup()
+        if _compute_group(cur, elem, sub_read, sub_write):
+            nested_changed = True
+            read.groups[name].CopyFrom(sub_read)
+            write.groups[name].CopyFrom(sub_write)
+
+    read.version = orig.version
+    if direct_changed:
+        write.version = orig.version + 1
+        write.mod_policy = new.mod_policy
+        # a bumped group's membership is exact: carry unchanged members
+        for kind in ("groups", "values", "policies"):
+            for name in getattr(new, kind):
+                if name not in getattr(write, kind):
+                    getattr(write, kind)[name].CopyFrom(
+                        getattr(orig, kind)[name])
+    else:
+        write.version = orig.version
+    return direct_changed or nested_changed
+
+
+def _strip_version(elem):
+    clone = type(elem)()
+    clone.CopyFrom(elem)
+    clone.version = 0
+    return clone

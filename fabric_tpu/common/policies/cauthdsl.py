@@ -50,7 +50,9 @@ def compile_rule(rule: polpb.SignaturePolicy,
         n = rule.n_out_of.n
         children = [compile_rule(r, principals)
                     for r in rule.n_out_of.rules]
-        if n < 0 or n > len(children):
+        if n < 1 or n > len(children):
+            # n == 0 would be always-satisfied (fail-open); reject it at
+            # compile time even though the reference compiles it silently
             raise ValueError(f"asked for {n} of {len(children)} sub-rules")
 
         def eval_n_out_of(identities, used):

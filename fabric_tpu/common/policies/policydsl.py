@@ -117,9 +117,11 @@ class _Parser:
         elif val == "or":
             node.n_out_of.n = 1
         else:
-            if n_required is None or n_required > len(args):
+            if n_required is None or n_required < 1 or \
+                    n_required > len(args):
                 raise PolicyParseError(
-                    f"OutOf({n_required}) with only {len(args)} args")
+                    f"OutOf({n_required}) of {len(args)} args is not "
+                    f"in [1, {len(args)}]")
             node.n_out_of.n = n_required
         for a in args:
             node.n_out_of.rules.add().CopyFrom(a)

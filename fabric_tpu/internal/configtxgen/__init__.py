@@ -1,0 +1,19 @@
+from fabric_tpu.internal.configtxgen.encoder import (
+    default_org_policies,
+    new_application_group,
+    new_channel_group,
+    new_orderer_group,
+    new_org_group,
+)
+from fabric_tpu.internal.configtxgen.genesis import (
+    config_block_for_channel,
+    config_envelope,
+    config_from_block,
+    genesis_block,
+)
+
+__all__ = [
+    "default_org_policies", "new_application_group", "new_channel_group",
+    "new_orderer_group", "new_org_group", "config_block_for_channel",
+    "config_envelope", "config_from_block", "genesis_block",
+]

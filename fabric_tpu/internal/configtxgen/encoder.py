@@ -1,0 +1,229 @@
+"""Channel-config tree encoder: profile → ConfigGroup tree.
+
+Rebuild of `internal/configtxgen/encoder/encoder.go`: turns a
+configtx.yaml-style profile (here: a plain dict, loadable from YAML)
+into the `Config.channel_group` tree the Bundle parses. Org policies
+default to the standard member/admin signature policies when the
+profile omits them (the reference requires them spelled out; defaulting
+keeps test profiles short).
+
+Profile shape (all sections optional except one of Application/Orderer):
+
+    {
+      "Consortium": "SampleConsortium",
+      "Capabilities": {"V2_0": True},            # channel level
+      "Application": {
+          "Organizations": [org, ...],
+          "Capabilities": {"V2_0": True},
+          "ACLs": {"event/Block": "/Channel/Application/Readers"},
+          "Policies": {name: policy-spec, ...},
+      },
+      "Orderer": {
+          "OrdererType": "solo" | "raft",
+          "Addresses": ["host:port", ...],
+          "BatchTimeout": "2s",
+          "BatchSize": {"MaxMessageCount": 500, ...},
+          "Organizations": [org, ...],
+          "Raft": {"Consenters": [{"Host","Port","ClientTLSCert",
+                    "ServerTLSCert"}, ...], "Options": {...}},
+      },
+    }
+
+org shape: {"Name", "ID" (mspid), "MSPConfig" (ftpu.msp.MSPConfig) or
+"MSPDir", "AnchorPeers": [("host", port)], "OrdererEndpoints": [...],
+"Policies": {...}}.
+
+policy-spec: either a policydsl string (signature policy) or
+{"Type": "ImplicitMeta", "Rule": "MAJORITY Admins"}.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fabric_tpu.common.channelconfig import bundle as bkeys
+from fabric_tpu.common.policies import from_string
+from fabric_tpu.protos import configtx as ctxpb, policies as polpb
+
+ADMINS_POLICY_KEY = "Admins"
+READERS_POLICY_KEY = "Readers"
+WRITERS_POLICY_KEY = "Writers"
+
+
+def _set_value(group: ctxpb.ConfigGroup, key: str, msg,
+               mod_policy: str = ADMINS_POLICY_KEY) -> None:
+    cv = group.values[key]
+    cv.value = msg.SerializeToString(deterministic=True)
+    cv.mod_policy = mod_policy
+
+
+def _set_policy(group: ctxpb.ConfigGroup, name: str, spec,
+                mod_policy: str = ADMINS_POLICY_KEY) -> None:
+    cp = group.policies[name]
+    cp.mod_policy = mod_policy
+    if isinstance(spec, str):
+        env = from_string(spec)
+        cp.policy.type = polpb.Policy.SIGNATURE
+        cp.policy.value = env.SerializeToString(deterministic=True)
+    elif isinstance(spec, dict) and spec.get("Type") == "ImplicitMeta":
+        rule_s, sub = spec["Rule"].split(None, 1)
+        meta = polpb.ImplicitMetaPolicy(
+            sub_policy=sub,
+            rule=polpb.ImplicitMetaPolicy.Rule.Value(rule_s.upper()))
+        cp.policy.type = polpb.Policy.IMPLICIT_META
+        cp.policy.value = meta.SerializeToString(deterministic=True)
+    else:
+        raise ValueError(f"bad policy spec for {name!r}: {spec!r}")
+
+
+def default_org_policies(mspid: str) -> dict:
+    return {
+        READERS_POLICY_KEY: f"OR('{mspid}.member')",
+        WRITERS_POLICY_KEY: f"OR('{mspid}.member')",
+        ADMINS_POLICY_KEY: f"OR('{mspid}.admin')",
+        "Endorsement": f"OR('{mspid}.member')",
+    }
+
+
+def _implicit(rule: str, sub: str) -> dict:
+    return {"Type": "ImplicitMeta", "Rule": f"{rule} {sub}"}
+
+
+def new_org_group(org: dict, orderer_org: bool = False) -> ctxpb.ConfigGroup:
+    g = ctxpb.ConfigGroup()
+    g.mod_policy = ADMINS_POLICY_KEY
+    mspid = org["ID"]
+    msp_config = org.get("MSPConfig")
+    if msp_config is None:
+        from fabric_tpu.msp import msp_config_from_dir
+        msp_config = msp_config_from_dir(org["MSPDir"], mspid)
+    _set_value(g, bkeys.MSP_KEY, ctxpb.MSPValue(
+        config=msp_config.SerializeToString(deterministic=True)))
+    policies = dict(default_org_policies(mspid))
+    policies.update(org.get("Policies") or {})
+    for name, spec in policies.items():
+        _set_policy(g, name, spec)
+    if not orderer_org and org.get("AnchorPeers"):
+        anchors = ctxpb.AnchorPeers()
+        for host, port in org["AnchorPeers"]:
+            anchors.anchor_peers.add(host=host, port=port)
+        _set_value(g, bkeys.ANCHOR_PEERS_KEY, anchors)
+    if orderer_org and org.get("OrdererEndpoints"):
+        _set_value(g, bkeys.ENDPOINTS_KEY, ctxpb.OrdererAddresses(
+            addresses=org["OrdererEndpoints"]))
+    return g
+
+
+def _capabilities_value(group, spec: Optional[dict]) -> None:
+    if not spec:
+        return
+    cap = ctxpb.Capabilities()
+    for name, on in spec.items():
+        if on:
+            cap.capabilities[name] = True
+    _set_value(group, bkeys.CAPABILITIES_KEY, cap)
+
+
+def new_application_group(app: dict) -> ctxpb.ConfigGroup:
+    g = ctxpb.ConfigGroup()
+    g.mod_policy = ADMINS_POLICY_KEY
+    for org in app.get("Organizations", []):
+        g.groups[org["Name"]].CopyFrom(new_org_group(org))
+    policies = {
+        READERS_POLICY_KEY: _implicit("ANY", "Readers"),
+        WRITERS_POLICY_KEY: _implicit("ANY", "Writers"),
+        ADMINS_POLICY_KEY: _implicit("MAJORITY", "Admins"),
+        "Endorsement": _implicit("MAJORITY", "Endorsement"),
+        "LifecycleEndorsement": _implicit("MAJORITY", "Endorsement"),
+    }
+    policies.update(app.get("Policies") or {})
+    for name, spec in policies.items():
+        _set_policy(g, name, spec)
+    _capabilities_value(g, app.get("Capabilities"))
+    if app.get("ACLs"):
+        acls = ctxpb.ACLs()
+        for k, v in app["ACLs"].items():
+            acls.acls[k] = v
+        _set_value(g, bkeys.ACLS_KEY, acls)
+    return g
+
+
+def new_orderer_group(ord_cfg: dict) -> ctxpb.ConfigGroup:
+    g = ctxpb.ConfigGroup()
+    g.mod_policy = ADMINS_POLICY_KEY
+    for org in ord_cfg.get("Organizations", []):
+        g.groups[org["Name"]].CopyFrom(new_org_group(org, orderer_org=True))
+    policies = {
+        READERS_POLICY_KEY: _implicit("ANY", "Readers"),
+        WRITERS_POLICY_KEY: _implicit("ANY", "Writers"),
+        ADMINS_POLICY_KEY: _implicit("MAJORITY", "Admins"),
+        "BlockValidation": _implicit("ANY", "Writers"),
+    }
+    policies.update(ord_cfg.get("Policies") or {})
+    for name, spec in policies.items():
+        _set_policy(g, name, spec)
+
+    ctype = ord_cfg.get("OrdererType", "solo")
+    consensus = ctxpb.ConsensusType(type=ctype)
+    if ctype == "raft":
+        raft = ord_cfg.get("Raft") or {}
+        meta = ctxpb.ConsensusMetadata()
+        for c in raft.get("Consenters", []):
+            meta.consenters.add(
+                host=c["Host"], port=c["Port"],
+                client_tls_cert=c.get("ClientTLSCert", b""),
+                server_tls_cert=c.get("ServerTLSCert", b""))
+        opts = raft.get("Options") or {}
+        meta.options.tick_interval_ms = opts.get("TickIntervalMs", 500)
+        meta.options.election_tick = opts.get("ElectionTick", 10)
+        meta.options.heartbeat_tick = opts.get("HeartbeatTick", 1)
+        meta.options.max_inflight_blocks = opts.get("MaxInflightBlocks", 5)
+        meta.options.snapshot_interval_size = opts.get(
+            "SnapshotIntervalSize", 16 * 1024 * 1024)
+        consensus.metadata = meta.SerializeToString(deterministic=True)
+    _set_value(g, bkeys.CONSENSUS_TYPE_KEY, consensus)
+
+    bs = ord_cfg.get("BatchSize") or {}
+    _set_value(g, bkeys.BATCH_SIZE_KEY, ctxpb.BatchSize(
+        max_message_count=bs.get("MaxMessageCount", 500),
+        absolute_max_bytes=bs.get("AbsoluteMaxBytes", 10 * 1024 * 1024),
+        preferred_max_bytes=bs.get("PreferredMaxBytes", 2 * 1024 * 1024)))
+    _set_value(g, bkeys.BATCH_TIMEOUT_KEY, ctxpb.BatchTimeout(
+        timeout=ord_cfg.get("BatchTimeout", "2s")))
+    _capabilities_value(g, ord_cfg.get("Capabilities"))
+    return g
+
+
+def new_channel_group(profile: dict) -> ctxpb.ConfigGroup:
+    """Reference: `encoder.go` NewChannelGroup."""
+    root = ctxpb.ConfigGroup()
+    root.mod_policy = ADMINS_POLICY_KEY
+    for name, spec in {
+        READERS_POLICY_KEY: _implicit("ANY", "Readers"),
+        WRITERS_POLICY_KEY: _implicit("ANY", "Writers"),
+        ADMINS_POLICY_KEY: _implicit("MAJORITY", "Admins"),
+        **(profile.get("Policies") or {}),
+    }.items():
+        _set_policy(root, name, spec)
+
+    _set_value(root, bkeys.HASHING_ALGORITHM_KEY,
+               ctxpb.HashingAlgorithm(name="SHA256"))
+    _set_value(root, bkeys.BLOCK_HASHING_KEY,
+               ctxpb.BlockDataHashingStructure(width=0xFFFFFFFF))
+    if profile.get("Orderer", {}).get("Addresses"):
+        _set_value(root, bkeys.ORDERER_ADDRESSES_KEY,
+                   ctxpb.OrdererAddresses(
+                       addresses=profile["Orderer"]["Addresses"]),
+                   mod_policy="/Channel/Orderer/Admins")
+    if profile.get("Consortium"):
+        _set_value(root, bkeys.CONSORTIUM_KEY,
+                   ctxpb.Consortium(name=profile["Consortium"]))
+    _capabilities_value(root, profile.get("Capabilities"))
+
+    if "Orderer" in profile:
+        root.groups[bkeys.ORDERER].CopyFrom(
+            new_orderer_group(profile["Orderer"]))
+    if "Application" in profile:
+        root.groups[bkeys.APPLICATION].CopyFrom(
+            new_application_group(profile["Application"]))
+    return root

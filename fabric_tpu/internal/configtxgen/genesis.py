@@ -1,0 +1,63 @@
+"""Genesis-block construction.
+
+Rebuild of `common/genesis/genesis.go` + the configtxgen outputBlock
+path: wrap a channel's Config in a CONFIG envelope inside block 0.
+Orderers bootstrap channels from this block; peers join with it.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.protos import common, configtx as ctxpb
+
+
+def config_envelope(channel_id: str, config: ctxpb.Config,
+                    last_update: bytes = b"") -> common.Envelope:
+    """An (unsigned) CONFIG envelope carrying the given config."""
+    cenv = ctxpb.ConfigEnvelope()
+    cenv.config.CopyFrom(config)
+    cenv.last_update = last_update
+    ch = pu.make_channel_header(common.HeaderType.CONFIG, channel_id)
+    sh = pu.create_signature_header(b"")   # genesis has no creator
+    payload = pu.make_payload(ch, sh, pu.marshal(cenv))
+    env = common.Envelope()
+    env.payload = pu.marshal(payload)
+    return env
+
+
+def genesis_block(channel_id: str,
+                  channel_group: ctxpb.ConfigGroup) -> common.Block:
+    """Block 0 for a new channel (reference: `common/genesis/genesis.go`
+    Block)."""
+    config = ctxpb.Config(sequence=0)
+    config.channel_group.CopyFrom(channel_group)
+    return config_block_for_channel(channel_id, config, seq=0,
+                                    previous_hash=b"")
+
+
+def config_block_for_channel(channel_id: str, config: ctxpb.Config,
+                             seq: int,
+                             previous_hash: bytes) -> common.Block:
+    env = config_envelope(channel_id, config)
+    block = pu.new_block(seq, previous_hash)
+    block.data.data.append(pu.marshal(env))
+    block.header.data_hash = pu.block_data_hash(block.data)
+    md = common.Metadata()
+    md.value = common.OrdererBlockMetadata(
+        last_config_index=seq).SerializeToString(deterministic=True)
+    block.metadata.metadata[common.BlockMetadataIndex.SIGNATURES] = \
+        pu.marshal(md)
+    return block
+
+
+def config_from_block(block: common.Block) -> ctxpb.Config:
+    """Extract the Config from a config block (reference:
+    `protoutil/blockutils.go` GetConfigFromBlock)."""
+    env = pu.extract_envelope(block, 0)
+    payload = pu.get_payload(env)
+    ch = pu.get_channel_header(payload)
+    if ch.type != common.HeaderType.CONFIG:
+        raise ValueError(f"block envelope is not CONFIG (type {ch.type})")
+    cenv = ctxpb.ConfigEnvelope()
+    cenv.ParseFromString(payload.data)
+    return cenv.config

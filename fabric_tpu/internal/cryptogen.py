@@ -1,0 +1,165 @@
+"""Test/dev crypto material generator.
+
+Rebuild of `internal/cryptogen/` (`ca/`, `csp/`, `msp/` generators +
+the cobra CLI): emits the canonical MSP directory layout for orgs,
+their nodes, and users —
+
+    <out>/peerOrganizations/<domain>/
+        ca/ca.<domain>-cert.pem, ca-key.pem
+        msp/cacerts/…                    (org-level verification MSP)
+        peers/<peer>.<domain>/msp/{cacerts,signcerts,keystore}
+        users/{Admin,User1…}@<domain>/msp/…
+
+NodeOU classification is on by default: node certs carry OU=peer /
+OU=orderer, user certs OU=client / OU=admin, and each MSP dir gets a
+config.yaml enabling NodeOUs — mirroring cryptogen's output.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+_NOT_BEFORE = datetime.datetime(2020, 1, 1)
+_NOT_AFTER = datetime.datetime(2099, 1, 1)
+
+_NODE_OU_CONFIG = """NodeOUs:
+  Enable: true
+  ClientOUIdentifier:
+    OrganizationalUnitIdentifier: client
+  PeerOUIdentifier:
+    OrganizationalUnitIdentifier: peer
+  AdminOUIdentifier:
+    OrganizationalUnitIdentifier: admin
+  OrdererOUIdentifier:
+    OrganizationalUnitIdentifier: orderer
+"""
+
+
+def _pem_cert(cert) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def _pem_key(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+def _write(path: str, data: bytes) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _make_ca(cn: str, org: str):
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([
+        x509.NameAttribute(NameOID.COMMON_NAME, cn),
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+    ])
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name).issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_NOT_BEFORE).not_valid_after(_NOT_AFTER)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .add_extension(
+            x509.KeyUsage(digital_signature=True, content_commitment=False,
+                          key_encipherment=False, data_encipherment=False,
+                          key_agreement=False, key_cert_sign=True,
+                          crl_sign=True, encipher_only=False,
+                          decipher_only=False),
+            critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    return cert, key
+
+
+def _issue(cn: str, org: str, ou: str, ca_cert, ca_key):
+    key = ec.generate_private_key(ec.SECP256R1())
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([
+            x509.NameAttribute(NameOID.COMMON_NAME, cn),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+            x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, ou),
+        ]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_NOT_BEFORE).not_valid_after(_NOT_AFTER)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    return cert, key
+
+
+def _write_local_msp(msp_dir: str, ca_cert, cert, key) -> None:
+    """A node/user MSP dir: its own cert + key + the org's CA."""
+    _write(os.path.join(msp_dir, "cacerts", "ca-cert.pem"),
+           _pem_cert(ca_cert))
+    _write(os.path.join(msp_dir, "signcerts", "cert.pem"), _pem_cert(cert))
+    _write(os.path.join(msp_dir, "keystore", "key_sk"), _pem_key(key))
+    _write(os.path.join(msp_dir, "config.yaml"),
+           _NODE_OU_CONFIG.encode())
+
+
+def generate_org(out_dir: str, domain: str, n_peers: int = 1,
+                 n_users: int = 1, orderer_org: bool = False,
+                 n_orderers: int = 1) -> str:
+    """Generate one organization; returns its directory. Reference:
+    cryptogen `generate` with one OrgSpec."""
+    kind = "ordererOrganizations" if orderer_org else "peerOrganizations"
+    org_dir = os.path.join(out_dir, kind, domain)
+    ca_cert, ca_key = _make_ca(f"ca.{domain}", domain)
+
+    _write(os.path.join(org_dir, "ca", f"ca.{domain}-cert.pem"),
+           _pem_cert(ca_cert))
+    _write(os.path.join(org_dir, "ca", "ca-key.pem"), _pem_key(ca_key))
+
+    # org-level (channel) MSP: verification material only
+    _write(os.path.join(org_dir, "msp", "cacerts", "ca-cert.pem"),
+           _pem_cert(ca_cert))
+    _write(os.path.join(org_dir, "msp", "config.yaml"),
+           _NODE_OU_CONFIG.encode())
+
+    if orderer_org:
+        for i in range(n_orderers):
+            cn = f"orderer{i}.{domain}"
+            cert, key = _issue(cn, domain, "orderer", ca_cert, ca_key)
+            _write_local_msp(os.path.join(org_dir, "orderers", cn, "msp"),
+                             ca_cert, cert, key)
+    else:
+        for i in range(n_peers):
+            cn = f"peer{i}.{domain}"
+            cert, key = _issue(cn, domain, "peer", ca_cert, ca_key)
+            _write_local_msp(os.path.join(org_dir, "peers", cn, "msp"),
+                             ca_cert, cert, key)
+
+    admin_cn = f"Admin@{domain}"
+    cert, key = _issue(admin_cn, domain, "admin", ca_cert, ca_key)
+    _write_local_msp(os.path.join(org_dir, "users", admin_cn, "msp"),
+                     ca_cert, cert, key)
+    # admins also listed explicitly for MSPs with NodeOUs off
+    _write(os.path.join(org_dir, "msp", "admincerts", "admin-cert.pem"),
+           _pem_cert(cert))
+    _write(os.path.join(org_dir, "users", admin_cn, "msp",
+                        "admincerts", "admin-cert.pem"), _pem_cert(cert))
+
+    for i in range(1, n_users + 1):
+        user_cn = f"User{i}@{domain}"
+        cert, key = _issue(user_cn, domain, "client", ca_cert, ca_key)
+        _write_local_msp(os.path.join(org_dir, "users", user_cn, "msp"),
+                         ca_cert, cert, key)
+    return org_dir

@@ -88,6 +88,12 @@ class CachedMSP(api.MSP):
 
     def setup(self, config) -> None:
         self._inner.setup(config)
+        # a reconfig changes the accept set (roots, CRLs, OUs): every
+        # memoized result is stale
+        size = self._deser._cap
+        self._deser = _LRU(size)
+        self._valid = _LRU(size)
+        self._sat = _LRU(size)
 
     def deserialize_identity(self, serialized: bytes) -> api.Identity:
         hit = self._deser.get(serialized)

@@ -215,8 +215,20 @@ class X509MSP(api.MSP):
     def validate(self, identity: api.Identity) -> None:
         if not isinstance(identity, X509Identity):
             raise MSPError("not an X.509 identity")
-        chain = self._validation_chain(identity.cert)
-        self._check_revocation(identity.cert, chain)
+        # memoized per identity object: policy evaluation calls validate
+        # once per SignedBy leaf, and chain crypto is the expensive part
+        cached = identity.__dict__.get("_validation_result")
+        if cached is True:
+            return
+        if isinstance(cached, MSPError):
+            raise cached
+        try:
+            chain = self._validation_chain(identity.cert)
+            self._check_revocation(chain)
+        except MSPError as e:
+            identity.__dict__["_validation_result"] = e
+            raise
+        identity.__dict__["_validation_result"] = True
 
     def _validation_chain(self, cert: x509.Certificate
                           ) -> list[x509.Certificate]:
@@ -256,10 +268,14 @@ class X509MSP(api.MSP):
             current = issuer
         raise MSPError("validation chain too long")
 
-    def _check_revocation(self, cert, chain) -> None:
-        issuer_der = cert.issuer.public_bytes()
-        if (issuer_der, cert.serial_number) in self._revoked:
-            raise MSPError("certificate is revoked")
+    def _check_revocation(self, chain) -> None:
+        """Every cert in the chain is checked, so a revoked intermediate
+        poisons everything below it (reference:
+        `msp/mspimplvalidate.go` validateCertAgainstChain per link)."""
+        for cert in chain:
+            issuer_der = cert.issuer.public_bytes()
+            if (issuer_der, cert.serial_number) in self._revoked:
+                raise MSPError("certificate is revoked")
 
     # -- principal matching (reference: mspimpl.go:424,606) --
 

@@ -1,0 +1,291 @@
+"""channelconfig + configtx + configtxgen + cryptogen tests.
+
+Mirrors the reference's `common/channelconfig/bundle_test.go`,
+`common/configtx/validator_test.go` shapes: profile → genesis →
+bundle; config updates validated against mod policies.
+"""
+
+import pytest
+
+from fabric_tpu.bccsp.bccsp import ECDSAPrivateKeyImportOpts
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.channelconfig import Bundle, ConfigError
+from fabric_tpu.common.configtx import (
+    ConfigTxError,
+    Validator,
+    compute_update,
+)
+from fabric_tpu.common.policies import PolicyError
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import (
+    config_from_block,
+    genesis_block,
+    new_channel_group,
+)
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.protos import common, configtx as ctxpb
+from fabric_tpu import protoutil as pu
+
+
+@pytest.fixture(scope="module")
+def crypto(tmp_path_factory):
+    out = str(tmp_path_factory.mktemp("crypto"))
+    org1 = cryptogen.generate_org(out, "org1.example.com", n_peers=2,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(out, "org2.example.com", n_peers=1)
+    ordo = cryptogen.generate_org(out, "example.com", orderer_org=True)
+    return {"root": out, "org1": org1, "org2": org2, "orderer": ordo}
+
+
+@pytest.fixture(scope="module")
+def profile(crypto):
+    import os
+    return {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(crypto["org1"], "msp"),
+                 "AnchorPeers": [("peer0.org1.example.com", 7051)]},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(crypto["org2"], "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+            "ACLs": {"event/Block": "/Channel/Application/Readers"},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "1s",
+            "BatchSize": {"MaxMessageCount": 100},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(crypto["orderer"], "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+
+
+@pytest.fixture(scope="module")
+def bundle(profile):
+    group = new_channel_group(profile)
+    block = genesis_block("testchannel", group)
+    config = config_from_block(block)
+    return Bundle("testchannel", config, SWProvider())
+
+
+class TestGenesisAndBundle:
+    def test_genesis_block_shape(self, profile):
+        block = genesis_block("testchannel", new_channel_group(profile))
+        assert block.header.number == 0
+        assert block.header.data_hash == pu.block_data_hash(block.data)
+        env = pu.extract_envelope(block, 0)
+        ch = pu.get_channel_header(pu.get_payload(env))
+        assert ch.type == common.HeaderType.CONFIG
+        assert ch.channel_id == "testchannel"
+
+    def test_bundle_sections(self, bundle):
+        assert set(bundle.application.orgs) == {"Org1", "Org2"}
+        assert bundle.application.orgs["Org1"].mspid == "Org1MSP"
+        assert bundle.application.orgs["Org1"].anchor_peers == \
+            [("peer0.org1.example.com", 7051)]
+        assert bundle.orderer.consensus_type == "solo"
+        assert bundle.orderer.batch_size.max_message_count == 100
+        assert bundle.orderer.batch_timeout_s == 1.0
+        assert bundle.orderer.orgs["OrdererOrg"].endpoints == \
+            ["orderer0.example.com:7050"]
+        assert bundle.channel.orderer_addresses == \
+            ["orderer0.example.com:7050"]
+        assert bundle.application.acls["event/Block"] == \
+            "/Channel/Application/Readers"
+        assert bundle.application.capabilities.v20_validation()
+
+    def test_bundle_msps(self, bundle):
+        assert set(bundle.msp_manager.get_msps()) == \
+            {"Org1MSP", "Org2MSP", "OrdererMSP"}
+
+    def test_bundle_policy_tree(self, bundle):
+        for path in ("/Channel/Readers", "/Channel/Writers",
+                     "/Channel/Admins",
+                     "/Channel/Application/Writers",
+                     "/Channel/Application/Endorsement",
+                     "/Channel/Application/LifecycleEndorsement",
+                     "/Channel/Application/Org1/Readers",
+                     "/Channel/Orderer/BlockValidation"):
+            assert bundle.policy_manager.has_policy(path), path
+
+    def test_unsupported_capability_rejected(self, profile):
+        import copy
+        p2 = copy.deepcopy(profile)
+        p2["Capabilities"] = {"V99_9": True}
+        config = config_from_block(
+            genesis_block("c", new_channel_group(p2)))
+        from fabric_tpu.common.capabilities import CapabilityError
+        with pytest.raises(CapabilityError):
+            Bundle("c", config, SWProvider())
+
+
+class _DirSigner:
+    """SigningIdentity-alike backed by a cryptogen user MSP dir."""
+
+    def __init__(self, msp_dir, mspid):
+        import os
+        from cryptography.hazmat.primitives.serialization import (
+            load_pem_private_key,
+        )
+        self.csp = SWProvider()
+        with open(os.path.join(msp_dir, "signcerts", "cert.pem"),
+                  "rb") as f:
+            self._cert_pem = f.read()
+        with open(os.path.join(msp_dir, "keystore", "key_sk"), "rb") as f:
+            self._key = self.csp.key_import(
+                load_pem_private_key(f.read(), None),
+                ECDSAPrivateKeyImportOpts(ephemeral=True))
+        self._mspid = mspid
+
+    def serialize(self):
+        from fabric_tpu.protos import msp as msppb
+        return msppb.SerializedIdentity(
+            mspid=self._mspid,
+            id_bytes=self._cert_pem).SerializeToString(deterministic=True)
+
+    def sign(self, msg):
+        return self.csp.sign(self._key, self.csp.hash(msg))
+
+
+def _signed_update(update: ctxpb.ConfigUpdate, signers):
+    env = ctxpb.ConfigUpdateEnvelope()
+    env.config_update = pu.marshal(update)
+    for s in signers:
+        cs = env.signatures.add()
+        sh = pu.create_signature_header(s.serialize())
+        cs.signature_header = pu.marshal(sh)
+        cs.signature = s.sign(bytes(cs.signature_header) +
+                              bytes(env.config_update))
+    return env
+
+
+class TestConfigUpdate:
+    @pytest.fixture()
+    def state(self, profile, crypto, bundle):
+        import copy
+        import os
+        config = config_from_block(
+            genesis_block("testchannel", new_channel_group(profile)))
+        validator = Validator("testchannel", config,
+                              bundle.policy_manager)
+        admin1 = _DirSigner(
+            os.path.join(crypto["org1"], "users",
+                         "Admin@org1.example.com", "msp"), "Org1MSP")
+        admin2 = _DirSigner(
+            os.path.join(crypto["org2"], "users",
+                         "Admin@org2.example.com", "msp"), "Org2MSP")
+        return {"config": config, "validator": validator,
+                "admin1": admin1, "admin2": admin2,
+                "profile": copy.deepcopy(profile)}
+
+    def _updated_profile_config(self, state, mutate):
+        import copy
+        p = copy.deepcopy(state["profile"])
+        mutate(p)
+        new_config = ctxpb.Config(sequence=state["config"].sequence)
+        new_config.channel_group.CopyFrom(new_channel_group(p))
+        return new_config
+
+    def test_batchsize_update_majority_admins(self, state):
+        """Changing Orderer BatchSize under MAJORITY Admins of the
+        orderer org — signed by app admins only — must fail; anchor-peer
+        change under Org1 Admins signed by admin1 passes."""
+        def mutate(p):
+            p["Application"]["Organizations"][0]["AnchorPeers"] = \
+                [("peer1.org1.example.com", 7051)]
+        new_config = self._updated_profile_config(state, mutate)
+        update = compute_update("testchannel", state["config"], new_config)
+        env = _signed_update(update, [state["admin1"]])
+        out = state["validator"].propose_config_update(env)
+        assert out.sequence == 1
+        # the new config carries the changed anchor peers
+        b2 = Bundle("testchannel", out, SWProvider())
+        assert b2.application.orgs["Org1"].anchor_peers == \
+            [("peer1.org1.example.com", 7051)]
+
+    def test_update_without_signatures_rejected(self, state):
+        def mutate(p):
+            p["Application"]["Organizations"][0]["AnchorPeers"] = \
+                [("peer1.org1.example.com", 8888)]
+        new_config = self._updated_profile_config(state, mutate)
+        update = compute_update("testchannel", state["config"], new_config)
+        env = _signed_update(update, [])
+        with pytest.raises(ConfigTxError, match="mod_policy"):
+            state["validator"].propose_config_update(env)
+
+    def test_wrong_org_admin_rejected(self, state):
+        def mutate(p):
+            p["Application"]["Organizations"][0]["AnchorPeers"] = \
+                [("peer1.org1.example.com", 9999)]
+        new_config = self._updated_profile_config(state, mutate)
+        update = compute_update("testchannel", state["config"], new_config)
+        env = _signed_update(update, [state["admin2"]])   # org2 admin
+        with pytest.raises(ConfigTxError, match="mod_policy"):
+            state["validator"].propose_config_update(env)
+
+    def test_wrong_channel_rejected(self, state):
+        update = ctxpb.ConfigUpdate(channel_id="otherchannel")
+        env = _signed_update(update, [state["admin1"]])
+        with pytest.raises(ConfigTxError, match="channel"):
+            state["validator"].propose_config_update(env)
+
+    def test_stale_read_set_rejected(self, state):
+        def mutate(p):
+            p["Application"]["Organizations"][0]["AnchorPeers"] = \
+                [("x", 1)]
+        new_config = self._updated_profile_config(state, mutate)
+        update = compute_update("testchannel", state["config"], new_config)
+        # tamper: claim the org group is at version 5
+        update.read_set.groups["Application"].groups["Org1"].version = 5
+        env = _signed_update(update, [state["admin1"]])
+        with pytest.raises(ConfigTxError, match="read_set"):
+            state["validator"].propose_config_update(env)
+
+    def test_no_change_rejected(self, state):
+        with pytest.raises(ConfigTxError, match="no differences"):
+            compute_update("testchannel", state["config"],
+                           state["config"])
+
+
+class TestCryptogen:
+    def test_layout(self, crypto):
+        import os
+        org1 = crypto["org1"]
+        for sub in ("ca", "msp/cacerts", "peers/peer0.org1.example.com/msp",
+                    "peers/peer1.org1.example.com/msp",
+                    "users/Admin@org1.example.com/msp",
+                    "users/User1@org1.example.com/msp"):
+            assert os.path.isdir(os.path.join(org1, sub)), sub
+
+    def test_msp_dir_loads_and_validates(self, crypto):
+        import os
+        from fabric_tpu.msp import X509MSP
+        from fabric_tpu.protos import msp as msppb
+        csp = SWProvider()
+        msp = X509MSP(csp)
+        msp.setup(msp_config_from_dir(
+            os.path.join(crypto["org1"], "msp"), "Org1MSP"))
+        with open(os.path.join(crypto["org1"],
+                               "peers/peer0.org1.example.com/msp",
+                               "signcerts/cert.pem"), "rb") as f:
+            peer_pem = f.read()
+        sid = msppb.SerializedIdentity(mspid="Org1MSP", id_bytes=peer_pem)
+        ident = msp.deserialize_identity(
+            sid.SerializeToString(deterministic=True))
+        ident.validate()
+        from fabric_tpu.protos import policies as polpb
+        role = polpb.MSPPrincipal(
+            classification=polpb.MSPPrincipal.ROLE,
+            principal=polpb.MSPRole(
+                msp_identifier="Org1MSP",
+                role=polpb.MSPRole.PEER).SerializeToString())
+        ident.satisfies_principal(role)

@@ -310,3 +310,39 @@ class TestManagerAndCache:
         for _ in range(2):
             with pytest.raises(MSPError, match="revoked"):
                 cached.validate(ident)
+
+    def test_cache_purged_on_resetup(self, org1):
+        """Reconfig through the cached wrapper must drop memoized
+        validation results (a new CRL revokes a previously-valid
+        cert)."""
+        inner = _msp_for(org1, with_crl=False)
+        cached = CachedMSP(inner)
+        ident = cached.deserialize_identity(_sid(org1["revoked"][0]))
+        cached.validate(ident)   # valid pre-reconfig
+        cached.setup(build_msp_config(
+            name="Org1MSP",
+            root_certs=[certgen.pem(org1["root"][0])],
+            intermediate_certs=[certgen.pem(org1["inter"][0])],
+            revocation_list=[certgen.pem(org1["crl"])],
+        ))
+        ident2 = cached.deserialize_identity(_sid(org1["revoked"][0]))
+        with pytest.raises(MSPError, match="revoked"):
+            cached.validate(ident2)
+
+    def test_revoked_intermediate_poisons_leaves(self, org1):
+        """A CRL revoking the intermediate CA rejects every identity
+        chained through it."""
+        root, root_key = org1["root"]
+        inter = org1["inter"][0]
+        crl = certgen.make_crl(root, root_key, [inter.serial_number])
+        csp = SWProvider()
+        msp = X509MSP(csp)
+        msp.setup(build_msp_config(
+            name="Org1MSP",
+            root_certs=[certgen.pem(root)],
+            intermediate_certs=[certgen.pem(inter)],
+            revocation_list=[certgen.pem(crl)],
+        ))
+        ident = msp.deserialize_identity(_sid(org1["member"][0]))
+        with pytest.raises(MSPError, match="revoked"):
+            ident.validate()

@@ -99,6 +99,7 @@ class TestPolicyDSL:
         "", "AND()", "AND('Org1.member'", "XOR('A.member','B.member')",
         "'Org1.wizard'", "'no-dot'", "OutOf('Org1.member')",
         "OutOf(3, 'Org1.member')", "AND('A.member') garbage",
+        "OutOf(0, 'Org1.member')",   # n=0 would be fail-open
     ])
     def test_malformed_rejected(self, bad):
         with pytest.raises(PolicyParseError):
