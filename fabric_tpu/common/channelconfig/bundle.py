@@ -109,6 +109,7 @@ class Bundle:
         self.csp = csp
         root = config.channel_group
         self._msps: list = []
+        self._mspid_by_org: dict[str, str] = {}
 
         self.channel = self._parse_channel(root)
         self.application: Optional[ApplicationConfig] = None
@@ -178,11 +179,9 @@ class Bundle:
     def _parse_application(self, group) -> ApplicationConfig:
         orgs = {}
         for name, og in group.groups.items():
-            msp_value = _value(og, MSP_KEY, ctxpb.MSPValue)
-            mspid = self._mspid_of(msp_value)
             anchors = _value(og, ANCHOR_PEERS_KEY, ctxpb.AnchorPeers)
             orgs[name] = ApplicationOrg(
-                name=name, mspid=mspid,
+                name=name, mspid=self._mspid_by_org[name],
                 anchor_peers=[(a.host, a.port) for a in
                               anchors.anchor_peers] if anchors else [])
         acls = _value(group, ACLS_KEY, ctxpb.ACLs)
@@ -196,10 +195,9 @@ class Bundle:
     def _parse_orderer(self, group) -> OrdererConfig:
         orgs = {}
         for name, og in group.groups.items():
-            msp_value = _value(og, MSP_KEY, ctxpb.MSPValue)
             endpoints = _value(og, ENDPOINTS_KEY, ctxpb.OrdererAddresses)
             orgs[name] = OrdererOrg(
-                name=name, mspid=self._mspid_of(msp_value),
+                name=name, mspid=self._mspid_by_org[name],
                 endpoints=list(endpoints.addresses) if endpoints else [])
         ct = _value(group, CONSENSUS_TYPE_KEY, ctxpb.ConsensusType)
         if ct is None:
@@ -227,15 +225,6 @@ class Bundle:
 
     # -- msp / policy plumbing --
 
-    def _mspid_of(self, msp_value: Optional[ctxpb.MSPValue]) -> str:
-        if msp_value is None:
-            raise ConfigError("org group lacks MSP value")
-        mc = msppb.MSPConfig()
-        mc.ParseFromString(msp_value.config)
-        xc = msppb.X509MSPConfig()
-        xc.ParseFromString(mc.config)
-        return xc.name
-
     def _load_msp(self, org_group, org_name: str) -> None:
         msp_value = _value(org_group, MSP_KEY, ctxpb.MSPValue)
         if msp_value is None:
@@ -245,6 +234,7 @@ class Bundle:
         msp = X509MSP(self.csp)
         msp.setup(mc)
         self._msps.append(CachedMSP(msp))
+        self._mspid_by_org[org_name] = msp.identifier()
 
     def _compile_policies(self, group: ctxpb.ConfigGroup,
                           child_managers: list[Manager]) -> dict:

@@ -148,10 +148,17 @@ class Validator:
         if modified:
             self._check_policy(current.mod_policy or parent_mod_policy,
                                path, signed_data)
+        elif write.mod_policy and write.mod_policy != current.mod_policy:
+            # swapping the gate without bumping (and so without passing
+            # the CURRENT policy) would be a silent privilege downgrade
+            raise ConfigTxError(
+                f"group {'/'.join(path)} changes mod_policy without a "
+                f"version bump")
 
         out = ctxpb.ConfigGroup()
         out.version = write.version
-        out.mod_policy = write.mod_policy or current.mod_policy
+        out.mod_policy = (write.mod_policy or current.mod_policy) \
+            if modified else current.mod_policy
 
         if modified:
             # membership is exactly the write set's members
@@ -203,10 +210,25 @@ class Validator:
 
     def _check_new_group(self, group: ctxpb.ConfigGroup, path: list[str],
                          signed_data, parent_mod_policy: str) -> None:
+        self._require_all_version_zero(group, path)
+        self._check_policy(parent_mod_policy, path[:-1], signed_data)
+
+    @staticmethod
+    def _require_all_version_zero(group: ctxpb.ConfigGroup,
+                                  path: list[str]) -> None:
+        """Every element of a brand-new subtree starts at version 0
+        (reference: validator.go verifyDeltaSet)."""
         if group.version != 0:
             raise ConfigTxError(
                 f"new group {'/'.join(path)} must have version 0")
-        self._check_policy(parent_mod_policy, path[:-1], signed_data)
+        for kind, name, elem in _members(group):
+            sub = path + [name]
+            if kind == "groups":
+                Validator._require_all_version_zero(elem, sub)
+            elif elem.version != 0:
+                raise ConfigTxError(
+                    f"new {kind[:-1]} {'/'.join(sub)} must have "
+                    f"version 0, has {elem.version}")
 
 
 # ---- client-side delta computation (reference: update.go) ----
@@ -242,7 +264,8 @@ def _compute_group(orig: ctxpb.ConfigGroup, new: ctxpb.ConfigGroup,
         or set(orig.values) != set(new.values)
         or set(orig.policies) != set(new.policies)
     )
-    direct_changed = membership_changed
+    direct_changed = membership_changed or \
+        new.mod_policy != orig.mod_policy
     nested_changed = False
 
     for kind in ("values", "policies"):

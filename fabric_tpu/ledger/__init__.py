@@ -1,0 +1,4 @@
+from fabric_tpu.ledger.kvledger import KVLedger, LedgerError
+from fabric_tpu.ledger.ledgermgmt import LedgerManager
+
+__all__ = ["KVLedger", "LedgerError", "LedgerManager"]

@@ -1,0 +1,185 @@
+"""Append-only block store with indexes.
+
+Rebuild of `common/ledger/blkstorage/` (`blockfile_mgr.go`,
+`blockindex.go`, `blockfile_helper.go`): blocks are length-prefixed
+records in numbered append-only files; a KV index maps block number /
+block hash / txid to locations. Crash recovery truncates a torn tail
+record and rebuilds the checkpoint from the last good block.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, Optional
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ledger.kvdb import DBHandle
+from fabric_tpu.protos import common, transaction as txpb
+
+_MAX_FILE = 64 * 1024 * 1024   # rotate block files at 64 MiB
+_LEN = struct.Struct(">I")
+
+
+class BlockStoreError(Exception):
+    pass
+
+
+def _file_name(suffix: int) -> str:
+    return f"blockfile_{suffix:06d}"
+
+
+class BlockStore:
+    """One channel's chain of blocks (reference: blockfileMgr)."""
+
+    def __init__(self, ledger_dir: str, index: DBHandle):
+        self._dir = os.path.join(ledger_dir, "chains")
+        os.makedirs(self._dir, exist_ok=True)
+        self._index = index
+        self._height = 0
+        self._last_hash = b""
+        self._cur_suffix = 0
+        self._recover()
+        self._f = open(self._cur_path(), "ab")
+
+    # -- recovery / checkpoint --
+
+    def _cur_path(self) -> str:
+        return os.path.join(self._dir, _file_name(self._cur_suffix))
+
+    def _recover(self) -> None:
+        """Scan existing files, truncate a torn tail, rebuild height
+        (reference: blockfile_helper.go constructCheckpointInfoFromBlockFiles)."""
+        suffixes = sorted(
+            int(n.split("_")[1]) for n in os.listdir(self._dir)
+            if n.startswith("blockfile_"))
+        if not suffixes:
+            return
+        self._cur_suffix = suffixes[-1]
+        for suffix in suffixes:
+            path = os.path.join(self._dir, _file_name(suffix))
+            good = 0
+            with open(path, "rb") as f:
+                while True:
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        break
+                    (ln,) = _LEN.unpack(hdr)
+                    raw = f.read(ln)
+                    if len(raw) < ln:
+                        break
+                    block = pu.unmarshal_block(raw)
+                    self._height = block.header.number + 1
+                    self._last_hash = pu.block_header_hash(block.header)
+                    good = f.tell()
+            size = os.path.getsize(path)
+            if size > good:
+                with open(path, "ab") as f:
+                    f.truncate(good)
+
+    # -- writes --
+
+    def add_block(self, block: common.Block) -> None:
+        if block.header.number != self._height:
+            raise BlockStoreError(
+                f"expected block {self._height}, got {block.header.number}")
+        if self._height > 0 and \
+                block.header.previous_hash != self._last_hash:
+            raise BlockStoreError(
+                f"block {block.header.number} previous_hash mismatch")
+        raw = pu.marshal(block)
+        if self._f.tell() + 4 + len(raw) > _MAX_FILE and self._f.tell() > 0:
+            self._f.close()
+            self._cur_suffix += 1
+            self._f = open(self._cur_path(), "ab")
+        offset = self._f.tell()
+        self._f.write(_LEN.pack(len(raw)))
+        self._f.write(raw)
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._index_block(block, self._cur_suffix, offset)
+        self._height = block.header.number + 1
+        self._last_hash = pu.block_header_hash(block.header)
+
+    def _index_block(self, block: common.Block, suffix: int,
+                     offset: int) -> None:
+        batch = self._index.new_batch()
+        loc = struct.pack(">IQ", suffix, offset)
+        batch.put(b"n" + struct.pack(">Q", block.header.number), loc)
+        batch.put(b"h" + pu.block_header_hash(block.header),
+                  struct.pack(">Q", block.header.number))
+        filt = block.metadata.metadata[
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER]
+        for i, env_bytes in enumerate(block.data.data):
+            try:
+                env = pu.unmarshal_envelope(env_bytes)
+                ch = pu.get_channel_header(pu.get_payload(env))
+            except Exception:
+                continue
+            if not ch.tx_id:
+                continue
+            code = filt[i] if i < len(filt) else \
+                txpb.TxValidationCode.NOT_VALIDATED
+            batch.put(b"t" + ch.tx_id.encode(),
+                      struct.pack(">QIB", block.header.number, i, code))
+        self._index.write_batch(batch)
+
+    # -- reads --
+
+    @property
+    def height(self) -> int:
+        return self._height
+
+    @property
+    def last_block_hash(self) -> bytes:
+        return self._last_hash
+
+    def _read_at(self, suffix: int, offset: int) -> common.Block:
+        with open(os.path.join(self._dir, _file_name(suffix)), "rb") as f:
+            f.seek(offset)
+            (ln,) = _LEN.unpack(f.read(4))
+            return pu.unmarshal_block(f.read(ln))
+
+    def get_block_by_number(self, num: int) -> Optional[common.Block]:
+        loc = self._index.get(b"n" + struct.pack(">Q", num))
+        if loc is None:
+            return None
+        suffix, offset = struct.unpack(">IQ", loc)
+        return self._read_at(suffix, offset)
+
+    def get_block_by_hash(self, block_hash: bytes
+                          ) -> Optional[common.Block]:
+        num = self._index.get(b"h" + block_hash)
+        if num is None:
+            return None
+        return self.get_block_by_number(struct.unpack(">Q", num)[0])
+
+    def get_tx_loc(self, tx_id: str) -> Optional[tuple[int, int, int]]:
+        """(block_num, tx_index, validation_code) for a txid."""
+        loc = self._index.get(b"t" + tx_id.encode())
+        if loc is None:
+            return None
+        return struct.unpack(">QIB", loc)
+
+    def get_tx_by_id(self, tx_id: str) -> Optional[txpb.ProcessedTransaction]:
+        loc = self.get_tx_loc(tx_id)
+        if loc is None:
+            return None
+        num, idx, code = loc
+        block = self.get_block_by_number(num)
+        return txpb.ProcessedTransaction(
+            transaction_envelope=block.data.data[idx],
+            validation_code=code)
+
+    def iter_blocks(self, start: int = 0,
+                    end: Optional[int] = None) -> Iterator[common.Block]:
+        n = start
+        while end is None or n < end:
+            block = self.get_block_by_number(n)
+            if block is None:
+                return
+            yield block
+            n += 1
+
+    def close(self) -> None:
+        self._f.close()

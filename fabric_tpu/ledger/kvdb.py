@@ -1,0 +1,139 @@
+"""Embedded ordered KV store.
+
+Role of goleveldb in the reference (`common/ledger/util/leveldbhelper`,
+used by the block index, statedb, history db, pvtdata store,
+bookkeeping). The interface is leveldb-shaped — get/put/delete,
+write-batch, ordered range iteration, named sub-DBs via key prefixes —
+backed here by SQLite (stdlib, crash-safe WAL); the interface leaves
+room for a C++ LSM engine drop-in if profiling demands it.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Iterator, Optional
+
+
+class WriteBatch:
+    def __init__(self):
+        self.ops: list[tuple[bytes, Optional[bytes]]] = []
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self.ops.append((key, value))
+
+    def delete(self, key: bytes) -> None:
+        self.ops.append((key, None))
+
+
+class KVStore:
+    """One ordered keyspace on disk (":memory:" for tests)."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        cur = self._conn.cursor()
+        cur.execute("PRAGMA journal_mode=WAL")
+        cur.execute("PRAGMA synchronous=NORMAL")
+        cur.execute("CREATE TABLE IF NOT EXISTS kv "
+                    "(k BLOB PRIMARY KEY, v BLOB NOT NULL) WITHOUT ROWID")
+        self._conn.commit()
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv(k, v) VALUES(?, ?) "
+                "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (key, value))
+            self._conn.commit()
+
+    def delete(self, key: bytes) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM kv WHERE k = ?", (key,))
+            self._conn.commit()
+
+    def write_batch(self, batch: WriteBatch, sync: bool = True) -> None:
+        """Atomic multi-op commit (leveldb WriteBatch semantics)."""
+        with self._lock:
+            cur = self._conn.cursor()
+            for key, value in batch.ops:
+                if value is None:
+                    cur.execute("DELETE FROM kv WHERE k = ?", (key,))
+                else:
+                    cur.execute(
+                        "INSERT INTO kv(k, v) VALUES(?, ?) "
+                        "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                        (key, value))
+            self._conn.commit()
+
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None
+                ) -> Iterator[tuple[bytes, bytes]]:
+        """Ordered [start, end) scan; end=None = to the end of keyspace."""
+        with self._lock:
+            if end is None:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? ORDER BY k",
+                    (start,)).fetchall()
+            else:
+                rows = self._conn.execute(
+                    "SELECT k, v FROM kv WHERE k >= ? AND k < ? "
+                    "ORDER BY k", (start, end)).fetchall()
+        yield from ((bytes(k), bytes(v)) for k, v in rows)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.commit()
+            self._conn.close()
+
+
+class DBHandle:
+    """A named sub-keyspace of a KVStore (reference:
+    leveldbhelper.Provider GetDBHandle — one physical DB, per-ledger
+    prefixes)."""
+
+    def __init__(self, store: KVStore, name: str):
+        self._store = store
+        self._prefix = name.encode() + b"\x00"
+
+    def _k(self, key: bytes) -> bytes:
+        return self._prefix + key
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._store.get(self._k(key))
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._store.put(self._k(key), value)
+
+    def delete(self, key: bytes) -> None:
+        self._store.delete(self._k(key))
+
+    def new_batch(self) -> "PrefixedBatch":
+        return PrefixedBatch(self._prefix)
+
+    def write_batch(self, batch: "PrefixedBatch") -> None:
+        self._store.write_batch(batch)
+
+    def iterate(self, start: bytes = b"", end: Optional[bytes] = None):
+        lo = self._k(start)
+        hi = self._k(end) if end is not None else \
+            self._prefix[:-1] + b"\x01"   # one past the prefix byte
+        for k, v in self._store.iterate(lo, hi):
+            yield k[len(self._prefix):], v
+
+
+class PrefixedBatch(WriteBatch):
+    def __init__(self, prefix: bytes):
+        super().__init__()
+        self._prefix = prefix
+
+    def put(self, key: bytes, value: bytes) -> None:
+        super().put(self._prefix + key, value)
+
+    def delete(self, key: bytes) -> None:
+        super().delete(self._prefix + key)

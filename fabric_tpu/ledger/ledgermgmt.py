@@ -1,0 +1,63 @@
+"""Multi-channel ledger lifecycle.
+
+Rebuild of `core/ledger/ledgermgmt/ledger_mgmt.go` (NewLedgerMgr, wired
+at `internal/peer/node/start.go:429-442`): create-from-genesis, open
+existing, enumerate, close-all. One directory per ledger under the
+root.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.ledger.kvledger import KVLedger, LedgerError
+from fabric_tpu.protos import common
+
+logger = must_get_logger("ledgermgmt")
+
+
+class LedgerManager:
+    def __init__(self, root_dir: str, metrics_provider=None):
+        self._root = root_dir
+        self._metrics = metrics_provider
+        self._ledgers: dict[str, KVLedger] = {}
+        os.makedirs(root_dir, exist_ok=True)
+
+    def create(self, genesis_block: common.Block,
+               ledger_id: str) -> KVLedger:
+        """Reference: CreateLedger — genesis block required."""
+        if ledger_id in self._ledgers or \
+                os.path.isdir(os.path.join(self._root, ledger_id)):
+            raise LedgerError(f"ledger {ledger_id!r} already exists")
+        ledger = KVLedger(ledger_id,
+                          os.path.join(self._root, ledger_id),
+                          self._metrics)
+        ledger.initialize_from_genesis(genesis_block)
+        self._ledgers[ledger_id] = ledger
+        logger.info("created ledger %s", ledger_id)
+        return ledger
+
+    def open(self, ledger_id: str) -> KVLedger:
+        if ledger_id in self._ledgers:
+            return self._ledgers[ledger_id]
+        path = os.path.join(self._root, ledger_id)
+        if not os.path.isdir(path):
+            raise LedgerError(f"ledger {ledger_id!r} does not exist")
+        ledger = KVLedger(ledger_id, path, self._metrics)
+        self._ledgers[ledger_id] = ledger
+        return ledger
+
+    def get(self, ledger_id: str) -> Optional[KVLedger]:
+        return self._ledgers.get(ledger_id)
+
+    def ledger_ids(self) -> list[str]:
+        on_disk = [d for d in sorted(os.listdir(self._root))
+                   if os.path.isdir(os.path.join(self._root, d))]
+        return on_disk
+
+    def close(self) -> None:
+        for ledger in self._ledgers.values():
+            ledger.close()
+        self._ledgers.clear()

@@ -1,0 +1,107 @@
+"""Versioned state database.
+
+Rebuild of `core/ledger/kvledger/txmgmt/statedb/` (statedb.go interface
++ stateleveldb impl): world state as (namespace, key) → (version,
+value); version = (block, tx) height of the writing transaction — the
+MVCC clock. A savepoint records the last committed height for
+crash recovery (reference: bookkeeping + statedb savepoint key).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from fabric_tpu.ledger.kvdb import DBHandle
+
+_SAVEPOINT = b"\x00savepoint"
+_SEP = b"\x00"
+
+
+@dataclass(frozen=True, order=True)
+class Height:
+    block: int
+    tx: int
+
+    def pack(self) -> bytes:
+        return struct.pack(">QQ", self.block, self.tx)
+
+    @classmethod
+    def unpack(cls, raw: bytes) -> "Height":
+        b, t = struct.unpack(">QQ", raw)
+        return cls(b, t)
+
+
+@dataclass
+class VersionedValue:
+    value: bytes
+    version: Height
+
+
+class UpdateBatch:
+    """Accumulates the writes of one block's valid txs (reference:
+    statedb.UpdateBatch)."""
+
+    def __init__(self):
+        self.updates: dict[tuple[str, str], Optional[VersionedValue]] = {}
+
+    def put(self, ns: str, key: str, value: bytes, version: Height) -> None:
+        self.updates[(ns, key)] = VersionedValue(value, version)
+
+    def delete(self, ns: str, key: str, version: Height) -> None:
+        self.updates[(ns, key)] = None
+
+    def get(self, ns: str, key: str):
+        """(present, versioned_value_or_None)."""
+        if (ns, key) in self.updates:
+            return True, self.updates[(ns, key)]
+        return False, None
+
+
+class StateDB:
+    def __init__(self, db: DBHandle):
+        self._db = db
+
+    @staticmethod
+    def _k(ns: str, key: str) -> bytes:
+        return ns.encode() + _SEP + key.encode()
+
+    def get_state(self, ns: str, key: str) -> Optional[VersionedValue]:
+        raw = self._db.get(self._k(ns, key))
+        if raw is None:
+            return None
+        version = Height.unpack(raw[:16])
+        return VersionedValue(raw[16:], version)
+
+    def get_version(self, ns: str, key: str) -> Optional[Height]:
+        vv = self.get_state(ns, key)
+        return vv.version if vv else None
+
+    def get_state_range(self, ns: str, start_key: str, end_key: str
+                        ) -> Iterator[tuple[str, VersionedValue]]:
+        """[start, end) ordered scan within a namespace; empty end_key
+        scans to the namespace end (reference: GetStateRangeScanIterator)."""
+        lo = self._k(ns, start_key)
+        # next-prefix bound: every key of `ns` starts with ns+\x00, so
+        # ns+\x01 is one past the whole namespace
+        hi = self._k(ns, end_key) if end_key else ns.encode() + b"\x01"
+        for k, raw in self._db.iterate(lo, hi):
+            key = k.split(_SEP, 1)[1].decode()
+            yield key, VersionedValue(raw[16:], Height.unpack(raw[:16]))
+
+    def apply_updates(self, batch: UpdateBatch, height: Height) -> None:
+        """Atomically apply a block's updates + the savepoint
+        (reference: stateleveldb ApplyUpdates)."""
+        wb = self._db.new_batch()
+        for (ns, key), vv in batch.updates.items():
+            if vv is None:
+                wb.delete(self._k(ns, key))
+            else:
+                wb.put(self._k(ns, key), vv.version.pack() + vv.value)
+        wb.put(_SAVEPOINT, height.pack())
+        self._db.write_batch(wb)
+
+    def savepoint(self) -> Optional[Height]:
+        raw = self._db.get(_SAVEPOINT)
+        return Height.unpack(raw) if raw else None

@@ -1,0 +1,227 @@
+"""MVCC transaction manager: simulation and block validation.
+
+Rebuild of `core/ledger/kvledger/txmgmt/` — the simulator
+(`txmgr/lockbased_tx_simulator.go`) records reads with committed
+versions and buffered writes; the block validator
+(`validation/validator.go:81-260`) replays each tx's read set against
+the state DB plus the updates of earlier valid txs in the same block
+(validateKVRead:174, validateRangeQuery:213 phantom detection), marking
+MVCC conflicts; surviving writes land in one UpdateBatch stamped with
+(block, tx) heights (batch_preparer.go:72).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from fabric_tpu.ledger.statedb import (
+    Height,
+    StateDB,
+    UpdateBatch,
+    VersionedValue,
+)
+from fabric_tpu.protos import rwset as rwpb, transaction as txpb
+
+logger = logging.getLogger("ledger.txmgr")
+
+
+def _pb_version(v: Optional[Height]) -> Optional[rwpb.Version]:
+    if v is None:
+        return None
+    return rwpb.Version(block_num=v.block, tx_num=v.tx)
+
+
+def _height_of(v: rwpb.Version) -> Optional[Height]:
+    # proto3 can't distinguish "unset" from (0,0) on a submessage field
+    # unless we check presence at the KVRead level
+    return Height(v.block_num, v.tx_num)
+
+
+class TxSimulator:
+    """Collects a read-write set over the committed state (reference:
+    lockbased_tx_simulator.go)."""
+
+    def __init__(self, statedb: StateDB, tx_id: str = ""):
+        self._db = statedb
+        self.tx_id = tx_id
+        self._reads: dict[tuple[str, str], Optional[Height]] = {}
+        self._writes: dict[tuple[str, str], Optional[bytes]] = {}
+        self._range_queries: list[rwpb.RangeQueryInfo] = []
+        self._done = False
+
+    # -- chaincode-facing ops --
+
+    def get_state(self, ns: str, key: str) -> Optional[bytes]:
+        # read-your-writes within the simulation
+        if (ns, key) in self._writes:
+            return self._writes[(ns, key)]
+        vv = self._db.get_state(ns, key)
+        if (ns, key) not in self._reads:
+            self._reads[(ns, key)] = vv.version if vv else None
+        return vv.value if vv else None
+
+    def put_state(self, ns: str, key: str, value: bytes) -> None:
+        if not key:
+            raise ValueError("empty key")
+        self._writes[(ns, key)] = value
+
+    def del_state(self, ns: str, key: str) -> None:
+        self._writes[(ns, key)] = None
+
+    def get_state_range(self, ns: str, start: str, end: str,
+                        limit: int = 0) -> list[tuple[str, bytes]]:
+        """Range read with phantom protection: the returned keys (and
+        their versions) are recorded as a RangeQueryInfo."""
+        rqi = rwpb.RangeQueryInfo(start_key=start, end_key=end)
+        out = []
+        raw_reads = rqi.raw_reads
+        exhausted = True
+        for key, vv in self._db.get_state_range(ns, start, end):
+            kr = raw_reads.kv_reads.add(key=key)
+            kr.version.CopyFrom(_pb_version(vv.version))
+            out.append((key, vv.value))
+            if limit and len(out) >= limit:
+                exhausted = False
+                break
+        rqi.itr_exhausted = exhausted
+        self._range_queries.append((ns, rqi))
+        return out
+
+    # -- result --
+
+    def get_tx_simulation_results(self) -> rwpb.TxReadWriteSet:
+        self._done = True
+        by_ns: dict[str, rwpb.KVRWSet] = {}
+
+        def ns_set(ns: str) -> rwpb.KVRWSet:
+            if ns not in by_ns:
+                by_ns[ns] = rwpb.KVRWSet()
+            return by_ns[ns]
+
+        for (ns, key), ver in sorted(self._reads.items()):
+            kr = ns_set(ns).reads.add(key=key)
+            if ver is not None:
+                kr.version.CopyFrom(_pb_version(ver))
+        for ns, rqi in self._range_queries:
+            ns_set(ns).range_queries_info.add().CopyFrom(rqi)
+        for (ns, key), value in sorted(self._writes.items()):
+            kw = ns_set(ns).writes.add(key=key)
+            if value is None:
+                kw.is_delete = True
+            else:
+                kw.value = value
+
+        txrw = rwpb.TxReadWriteSet(data_model=rwpb.TxReadWriteSet.KV)
+        for ns in sorted(by_ns):
+            nsrw = txrw.ns_rwset.add(namespace=ns)
+            nsrw.rwset = by_ns[ns].SerializeToString(deterministic=True)
+        return txrw
+
+
+class TxMgr:
+    """Block-level validate-and-prepare (reference:
+    `validation/validator.go` validateAndPrepareBatch)."""
+
+    def __init__(self, statedb: StateDB):
+        self.statedb = statedb
+
+    def validate_and_prepare(
+        self, block_num: int,
+        tx_rwsets: Sequence[Optional[rwpb.TxReadWriteSet]],
+        flags: Optional[list[int]] = None,
+    ) -> tuple[list[int], UpdateBatch]:
+        """For each tx (None = already invalid upstream): MVCC-check its
+        reads against committed state + earlier in-block updates; valid
+        txs contribute writes. Returns (validation codes, batch)."""
+        n = len(tx_rwsets)
+        codes = list(flags) if flags else \
+            [txpb.TxValidationCode.VALID] * n
+        batch = UpdateBatch()
+
+        for tx_num, txrw in enumerate(tx_rwsets):
+            if codes[tx_num] != txpb.TxValidationCode.VALID:
+                continue
+            if txrw is None:
+                codes[tx_num] = txpb.TxValidationCode.BAD_RWSET
+                continue
+            code = self._validate_tx(txrw, batch)
+            codes[tx_num] = code
+            if code == txpb.TxValidationCode.VALID:
+                self._apply_writes(txrw, batch,
+                                   Height(block_num, tx_num))
+        return codes, batch
+
+    # -- per-tx checks --
+
+    def _validate_tx(self, txrw: rwpb.TxReadWriteSet,
+                     batch: UpdateBatch) -> int:
+        for nsrw in txrw.ns_rwset:
+            kv = rwpb.KVRWSet()
+            kv.ParseFromString(nsrw.rwset)
+            for read in kv.reads:
+                if not self._validate_read(nsrw.namespace, read, batch):
+                    return txpb.TxValidationCode.MVCC_READ_CONFLICT
+            for rqi in kv.range_queries_info:
+                if not self._validate_range_query(nsrw.namespace, rqi,
+                                                  batch):
+                    return txpb.TxValidationCode.PHANTOM_READ_CONFLICT
+        return txpb.TxValidationCode.VALID
+
+    def _validate_read(self, ns: str, read: rwpb.KVRead,
+                       batch: UpdateBatch) -> bool:
+        """Reference: validator.go:174 validateKVRead — a read conflicts
+        if the key was updated in this block by an earlier valid tx, or
+        its committed version differs from the read version."""
+        in_batch, _ = batch.get(ns, read.key)
+        if in_batch:
+            return False
+        committed = self.statedb.get_version(ns, read.key)
+        read_ver = _height_of(read.version) if read.HasField("version") \
+            else None
+        return committed == read_ver
+
+    def _validate_range_query(self, ns: str, rqi: rwpb.RangeQueryInfo,
+                              batch: UpdateBatch) -> bool:
+        """Reference: validator.go:213 validateRangeQuery — re-execute
+        the range over (committed state + batch) and require the same
+        keys/versions the simulator saw."""
+        current: list[tuple[str, Optional[Height]]] = []
+        seen = set()
+        for key, vv in self.statedb.get_state_range(
+                ns, rqi.start_key, rqi.end_key):
+            in_batch, bv = batch.get(ns, key)
+            if in_batch:
+                seen.add(key)
+                if bv is not None:
+                    current.append((key, bv.version))
+                continue
+            current.append((key, vv.version))
+        for (bns, key), bv in batch.updates.items():
+            if bns != ns or key in seen or bv is None:
+                continue
+            if rqi.start_key <= key and (not rqi.end_key or
+                                         key < rqi.end_key):
+                current.append((key, bv.version))
+        current.sort()
+
+        expected = [
+            (kr.key,
+             _height_of(kr.version) if kr.HasField("version") else None)
+            for kr in rqi.raw_reads.kv_reads
+        ]
+        if not rqi.itr_exhausted:
+            # simulator stopped early: only the observed prefix must match
+            current = current[:len(expected)]
+        return current == expected
+
+    def _apply_writes(self, txrw, batch: UpdateBatch,
+                      height: Height) -> None:
+        for nsrw in txrw.ns_rwset:
+            kv = rwpb.KVRWSet()
+            kv.ParseFromString(nsrw.rwset)
+            for w in kv.writes:
+                if w.is_delete:
+                    batch.delete(nsrw.namespace, w.key, height)
+                else:
+                    batch.put(nsrw.namespace, w.key, w.value, height)

@@ -128,6 +128,7 @@ class X509MSP(api.MSP):
         self._node_ous: Optional[msppb.NodeOUs] = None
         self._ou_ids: list[msppb.OUIdentifier] = []
         self._signer: Optional[X509SigningIdentity] = None
+        self._epoch = 0   # bumped by setup(); invalidates identity memos
 
     # -- setup (reference: mspimpl.go:250 Setup + mspimplsetup.go) --
 
@@ -144,6 +145,7 @@ class X509MSP(api.MSP):
         if not conf.root_certs:
             raise MSPError("at least one root CA is required")
         self._id = conf.name
+        self._epoch += 1        # stale identity memos die here
         self._revoked = set()   # re-setup must drop stale CRLs
         self._roots = [x509.load_pem_x509_certificate(p)
                        for p in conf.root_certs]
@@ -217,18 +219,20 @@ class X509MSP(api.MSP):
             raise MSPError("not an X.509 identity")
         # memoized per identity object: policy evaluation calls validate
         # once per SignedBy leaf, and chain crypto is the expensive part
-        cached = identity.__dict__.get("_validation_result")
-        if cached is True:
-            return
-        if isinstance(cached, MSPError):
-            raise cached
+        # memo is epoch-stamped: setup() bumps the epoch, so identities
+        # retained across a reconfig re-validate against the new config
+        memo = identity.__dict__.get("_validation_result")
+        if memo is not None and memo[0] == self._epoch:
+            if memo[1] is True:
+                return
+            raise memo[1]
         try:
             chain = self._validation_chain(identity.cert)
             self._check_revocation(chain)
         except MSPError as e:
-            identity.__dict__["_validation_result"] = e
+            identity.__dict__["_validation_result"] = (self._epoch, e)
             raise
-        identity.__dict__["_validation_result"] = True
+        identity.__dict__["_validation_result"] = (self._epoch, True)
 
     def _validation_chain(self, cert: x509.Certificate
                           ) -> list[x509.Certificate]:

@@ -156,6 +156,13 @@ class _DirSigner:
         return self.csp.sign(self._key, self.csp.hash(msg))
 
 
+def _shallow_read(group: ctxpb.ConfigGroup) -> ctxpb.ConfigGroup:
+    """Version-only read-set entry for a group."""
+    out = ctxpb.ConfigGroup()
+    out.version = group.version
+    return out
+
+
 def _signed_update(update: ctxpb.ConfigUpdate, signers):
     env = ctxpb.ConfigUpdateEnvelope()
     env.config_update = pu.marshal(update)
@@ -254,6 +261,52 @@ class TestConfigUpdate:
         with pytest.raises(ConfigTxError, match="no differences"):
             compute_update("testchannel", state["config"],
                            state["config"])
+
+    def test_mod_policy_downgrade_without_bump_rejected(self, state):
+        """A context (unbumped) group cannot swap its mod_policy — that
+        would downgrade the gate without ever passing it."""
+        update = ctxpb.ConfigUpdate(channel_id="testchannel")
+        update.read_set.CopyFrom(
+            _shallow_read(state["config"].channel_group))
+        ws = update.write_set
+        ws.version = state["config"].channel_group.version
+        ws.mod_policy = "Readers"   # downgrade attempt
+        env = _signed_update(update, [state["admin1"]])
+        with pytest.raises(ConfigTxError, match="mod_policy"):
+            state["validator"].propose_config_update(env)
+
+    def test_new_group_with_nonzero_nested_version_rejected(self, state):
+        update = ctxpb.ConfigUpdate(channel_id="testchannel")
+        update.read_set.CopyFrom(
+            _shallow_read(state["config"].channel_group))
+        ws = update.write_set
+        cur = state["config"].channel_group
+        ws.version = cur.version + 1
+        ws.mod_policy = cur.mod_policy
+        # keep existing membership...
+        for kind in ("groups", "values", "policies"):
+            for name, elem in getattr(cur, kind).items():
+                getattr(ws, kind)[name].CopyFrom(elem)
+        # ...and add a new group whose nested value claims version 7
+        evil = ws.groups["Evil"]
+        evil.version = 0
+        evil.mod_policy = "Admins"
+        evil.values["X"].version = 7
+        evil.values["X"].mod_policy = "Admins"
+        env = _signed_update(update, [state["admin1"], state["admin2"]])
+        with pytest.raises(ConfigTxError, match="version 0"):
+            state["validator"].propose_config_update(env)
+
+    def test_mod_policy_only_change_is_an_update(self, state):
+        import copy
+        new_config = ctxpb.Config()
+        new_config.CopyFrom(state["config"])
+        new_config.channel_group.groups["Application"].mod_policy = \
+            "Writers"
+        update = compute_update("testchannel", state["config"],
+                                new_config)
+        assert update.write_set.groups["Application"].version == \
+            state["config"].channel_group.groups["Application"].version + 1
 
 
 class TestCryptogen:
