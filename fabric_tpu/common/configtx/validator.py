@@ -29,6 +29,14 @@ class ConfigTxError(Exception):
     pass
 
 
+_SINGULAR = {"groups": "group", "values": "value",
+             "policies": "policy"}
+
+
+def _singular(kind: str) -> str:
+    return _SINGULAR[kind]
+
+
 def _members(group: ctxpb.ConfigGroup):
     """(kind, name, element) triples for all members of a group."""
     for name, g in group.groups.items():
@@ -78,6 +86,8 @@ class Validator:
 
         current = self.config.channel_group
         self._verify_read_set(current, update.read_set)
+        self._verify_write_structure(current, update.write_set,
+                                     ["Channel"])
         new_group = self._apply_group(
             current, update.write_set, path=["Channel"],
             signed_data=signed_data,
@@ -105,13 +115,77 @@ class Validator:
             else:
                 if cur is None:
                     raise ConfigTxError(
-                        f"read_set references missing {kind[:-1]} "
+                        f"read_set references missing {_singular(kind)} "
                         f"{path}/{name}")
                 if elem.version != cur.version:
                     raise ConfigTxError(
                         f"read_set version mismatch at {path}/{name}")
 
     # -- write set --
+
+    def _verify_write_structure(self, current: ctxpb.ConfigGroup,
+                                write: ctxpb.ConfigGroup,
+                                path: list[str]) -> None:
+        """Structural pre-pass over the whole write_set, run BEFORE any
+        signature-policy evaluation, covering every signature-independent
+        rule: version windows, brand-new subtrees at version 0,
+        same-version elements being byte-identical, and mod_policy swaps
+        without a version bump. Violations are therefore reported
+        deterministically regardless of which mod_policies the update's
+        signatures happen to satisfy (reference: the version checks of
+        `common/configtx/update.go` verifyDeltaSet). `_apply_group`
+        trusts this pass — the version rules live only here."""
+        if write.version not in (current.version, current.version + 1):
+            raise ConfigTxError(
+                f"group {'/'.join(path)} version {write.version} is "
+                f"neither current ({current.version}) nor current+1")
+        if write.version == current.version:
+            if (write.mod_policy
+                    and write.mod_policy != current.mod_policy):
+                # swapping the gate without bumping (and so without
+                # passing the CURRENT policy) would be a silent
+                # privilege downgrade
+                raise ConfigTxError(
+                    f"group {'/'.join(path)} changes mod_policy "
+                    f"without a version bump")
+        elif not write.mod_policy:
+            # every modified item must carry a usable mod_policy
+            # (reference: update.go validateModPolicy rejects empty);
+            # silently retaining the old one would make a requested
+            # clear a non-converging no-op
+            raise ConfigTxError(
+                f"group {'/'.join(path)} is modified but has an empty "
+                f"mod_policy")
+        for kind, name, elem in _members(write):
+            cur = getattr(current, kind).get(name)
+            sub = path + [name]
+            if kind == "groups":
+                if cur is None:
+                    self._require_all_version_zero(elem, sub)
+                else:
+                    self._verify_write_structure(cur, elem, sub)
+            elif cur is None:
+                if elem.version != 0:
+                    raise ConfigTxError(
+                        f"new {_singular(kind)} {'/'.join(sub)} must have "
+                        f"version 0, has {elem.version}")
+                if not elem.mod_policy:
+                    raise ConfigTxError(
+                        f"new {_singular(kind)} {'/'.join(sub)} has an "
+                        f"empty mod_policy")
+            elif elem.version == cur.version:
+                if pu.marshal(elem) != pu.marshal(cur):
+                    raise ConfigTxError(
+                        f"{_singular(kind)} {'/'.join(sub)} changed "
+                        f"without version bump")
+            elif elem.version != cur.version + 1:
+                raise ConfigTxError(
+                    f"{_singular(kind)} {'/'.join(sub)} version "
+                    f"{elem.version} invalid (current {cur.version})")
+            elif not elem.mod_policy:
+                raise ConfigTxError(
+                    f"{_singular(kind)} {'/'.join(sub)} is modified but "
+                    f"has an empty mod_policy")
 
     def _check_policy(self, mod_policy: str, path: list[str],
                       signed_data) -> None:
@@ -140,20 +214,14 @@ class Validator:
                      write: ctxpb.ConfigGroup, path: list[str],
                      signed_data, parent_mod_policy: str
                      ) -> ctxpb.ConfigGroup:
+        # structure (version windows, mod_policy swaps, new-subtree
+        # zeros, same-version immutability) is pre-verified by
+        # _verify_write_structure; this pass only evaluates policies
+        # and builds the merged group
         modified = write.version == current.version + 1
-        if not modified and write.version != current.version:
-            raise ConfigTxError(
-                f"group {'/'.join(path)} version {write.version} is "
-                f"neither current ({current.version}) nor current+1")
         if modified:
             self._check_policy(current.mod_policy or parent_mod_policy,
                                path, signed_data)
-        elif write.mod_policy and write.mod_policy != current.mod_policy:
-            # swapping the gate without bumping (and so without passing
-            # the CURRENT policy) would be a silent privilege downgrade
-            raise ConfigTxError(
-                f"group {'/'.join(path)} changes mod_policy without a "
-                f"version bump")
 
         out = ctxpb.ConfigGroup()
         out.version = write.version
@@ -187,30 +255,17 @@ class Validator:
                         out.mod_policy))
             else:
                 if cur is None:
-                    if elem.version != 0:
-                        raise ConfigTxError(
-                            f"new {kind[:-1]} {'/'.join(sub_path)} must "
-                            f"have version 0, has {elem.version}")
                     self._check_policy(out.mod_policy, path, signed_data)
                     getattr(out, kind)[name].CopyFrom(elem)
-                elif elem.version == cur.version:
-                    if pu.marshal(elem) != pu.marshal(cur):
-                        raise ConfigTxError(
-                            f"{kind[:-1]} {'/'.join(sub_path)} changed "
-                            f"without version bump")
                 elif elem.version == cur.version + 1:
                     self._check_policy(cur.mod_policy or out.mod_policy,
                                        path, signed_data)
                     getattr(out, kind)[name].CopyFrom(elem)
-                else:
-                    raise ConfigTxError(
-                        f"{kind[:-1]} {'/'.join(sub_path)} version "
-                        f"{elem.version} invalid (current {cur.version})")
+                # same version: pre-verified byte-identical — context only
         return out
 
     def _check_new_group(self, group: ctxpb.ConfigGroup, path: list[str],
                          signed_data, parent_mod_policy: str) -> None:
-        self._require_all_version_zero(group, path)
         self._check_policy(parent_mod_policy, path[:-1], signed_data)
 
     @staticmethod
@@ -227,7 +282,7 @@ class Validator:
                 Validator._require_all_version_zero(elem, sub)
             elif elem.version != 0:
                 raise ConfigTxError(
-                    f"new {kind[:-1]} {'/'.join(sub)} must have "
+                    f"new {_singular(kind)} {'/'.join(sub)} must have "
                     f"version 0, has {elem.version}")
 
 

@@ -297,6 +297,47 @@ class TestConfigUpdate:
         with pytest.raises(ConfigTxError, match="version 0"):
             state["validator"].propose_config_update(env)
 
+    def test_structural_errors_win_over_policy_errors(self, state):
+        """The version-0 violation must be reported even with NO
+        signatures at all (structural pre-pass runs before any policy
+        evaluation)."""
+        update = ctxpb.ConfigUpdate(channel_id="testchannel")
+        update.read_set.CopyFrom(
+            _shallow_read(state["config"].channel_group))
+        ws = update.write_set
+        cur = state["config"].channel_group
+        ws.version = cur.version + 1
+        ws.mod_policy = cur.mod_policy
+        evil = ws.groups["Evil"]
+        evil.mod_policy = "Admins"
+        evil.values["X"].version = 7
+        evil.values["X"].mod_policy = "Admins"
+        env = _signed_update(update, [])
+        with pytest.raises(ConfigTxError, match="version 0"):
+            state["validator"].propose_config_update(env)
+
+    def test_modified_item_with_empty_mod_policy_rejected(self, state):
+        """Clearing mod_policy must be an explicit rejection, not a
+        silently-retained no-op (reference: update.go
+        validateModPolicy)."""
+        update = ctxpb.ConfigUpdate(channel_id="testchannel")
+        update.read_set.CopyFrom(
+            _shallow_read(state["config"].channel_group))
+        ws = update.write_set
+        cur = state["config"].channel_group
+        ws.version = cur.version
+        ws.mod_policy = cur.mod_policy
+        app = ws.groups["Application"]
+        cur_app = cur.groups["Application"]
+        app.version = cur_app.version + 1
+        app.mod_policy = ""   # attempt to clear
+        for kind in ("groups", "values", "policies"):
+            for name, elem in getattr(cur_app, kind).items():
+                getattr(app, kind)[name].CopyFrom(elem)
+        env = _signed_update(update, [state["admin1"], state["admin2"]])
+        with pytest.raises(ConfigTxError, match="empty mod_policy"):
+            state["validator"].propose_config_update(env)
+
     def test_mod_policy_only_change_is_an_update(self, state):
         import copy
         new_config = ctxpb.Config()
