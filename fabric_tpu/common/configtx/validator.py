@@ -271,19 +271,28 @@ class Validator:
     @staticmethod
     def _require_all_version_zero(group: ctxpb.ConfigGroup,
                                   path: list[str]) -> None:
-        """Every element of a brand-new subtree starts at version 0
-        (reference: validator.go verifyDeltaSet)."""
+        """Every element of a brand-new subtree starts at version 0 and
+        carries a non-empty mod_policy (reference: validator.go
+        verifyDeltaSet + update.go validateModPolicy)."""
         if group.version != 0:
             raise ConfigTxError(
                 f"new group {'/'.join(path)} must have version 0")
+        if not group.mod_policy:
+            raise ConfigTxError(
+                f"new group {'/'.join(path)} has an empty mod_policy")
         for kind, name, elem in _members(group):
             sub = path + [name]
             if kind == "groups":
                 Validator._require_all_version_zero(elem, sub)
-            elif elem.version != 0:
-                raise ConfigTxError(
-                    f"new {_singular(kind)} {'/'.join(sub)} must have "
-                    f"version 0, has {elem.version}")
+            else:
+                if elem.version != 0:
+                    raise ConfigTxError(
+                        f"new {_singular(kind)} {'/'.join(sub)} must "
+                        f"have version 0, has {elem.version}")
+                if not elem.mod_policy:
+                    raise ConfigTxError(
+                        f"new {_singular(kind)} {'/'.join(sub)} has an "
+                        f"empty mod_policy")
 
 
 # ---- client-side delta computation (reference: update.go) ----

@@ -19,6 +19,9 @@ from fabric_tpu.protos import common, transaction as txpb
 
 _MAX_FILE = 64 * 1024 * 1024   # rotate block files at 64 MiB
 _LEN = struct.Struct(">I")
+# index key: (suffix, end-offset, height) + last block-header hash,
+# written atomically with every block's index batch
+_CHECKPOINT = b"cp"
 
 
 class BlockStoreError(Exception):
@@ -48,19 +51,39 @@ class BlockStore:
         return os.path.join(self._dir, _file_name(self._cur_suffix))
 
     def _recover(self) -> None:
-        """Scan existing files, truncate a torn tail, rebuild height
-        (reference: blockfile_helper.go constructCheckpointInfoFromBlockFiles)."""
+        """Resume from the persisted checkpoint: scan only the files at
+        or after it, truncate a torn tail, and RE-INDEX any block that
+        was fsynced to its file but whose index batch was lost (the
+        add_block ordering durably writes the file first) — otherwise
+        height would exceed the index and reads of the tail block would
+        fail forever (reference: blockfile_helper.go
+        constructCheckpointInfoFromBlockFiles + blockindex.go syncIndex).
+        Startup cost is O(blocks since last clean checkpoint), not
+        O(chain)."""
+        cp = self._index.get(_CHECKPOINT)
+        scan_suffix = scan_offset = 0
+        if cp is not None:
+            suffix, offset, height = struct.unpack(">IQQ", cp[:20])
+            self._cur_suffix, self._height = suffix, height
+            self._last_hash = cp[20:]
+            scan_suffix, scan_offset = suffix, offset
         suffixes = sorted(
             int(n.split("_")[1]) for n in os.listdir(self._dir)
             if n.startswith("blockfile_"))
         if not suffixes:
+            if cp is not None:
+                raise BlockStoreError(
+                    "index checkpoint present but block files missing")
             return
-        self._cur_suffix = suffixes[-1]
-        for suffix in suffixes:
+        self._cur_suffix = max(suffixes[-1], self._cur_suffix)
+        tail = (scan_suffix, scan_offset)
+        for suffix in (s for s in suffixes if s >= scan_suffix):
             path = os.path.join(self._dir, _file_name(suffix))
-            good = 0
+            good = scan_offset if suffix == scan_suffix else 0
             with open(path, "rb") as f:
+                f.seek(good)
                 while True:
+                    offset = f.tell()
                     hdr = f.read(4)
                     if len(hdr) < 4:
                         break
@@ -69,13 +92,29 @@ class BlockStore:
                     if len(raw) < ln:
                         break
                     block = pu.unmarshal_block(raw)
+                    good = f.tell()
                     self._height = block.header.number + 1
                     self._last_hash = pu.block_header_hash(block.header)
-                    good = f.tell()
+                    tail = (suffix, good)
+                    # only write index entries the crash actually lost —
+                    # a checkpoint-less store (first open of an old
+                    # layout) is already indexed, so a full rewrite
+                    # would make startup an O(chain) SQLite churn
+                    if self._index.get(
+                            b"n" + struct.pack(
+                                ">Q", block.header.number)) is None:
+                        self._index_block(block, suffix, offset, good)
             size = os.path.getsize(path)
             if size > good:
                 with open(path, "ab") as f:
                     f.truncate(good)
+        if self._height > 0 and tail != (scan_suffix, scan_offset):
+            # scan advanced past the stored checkpoint: persist the new
+            # one even if every scanned block was already indexed
+            self._index.put(
+                _CHECKPOINT,
+                struct.pack(">IQQ", tail[0], tail[1], self._height) +
+                self._last_hash)
 
     # -- writes --
 
@@ -97,12 +136,13 @@ class BlockStore:
         self._f.write(raw)
         self._f.flush()
         os.fsync(self._f.fileno())
-        self._index_block(block, self._cur_suffix, offset)
         self._height = block.header.number + 1
         self._last_hash = pu.block_header_hash(block.header)
+        self._index_block(block, self._cur_suffix, offset,
+                          self._f.tell())
 
     def _index_block(self, block: common.Block, suffix: int,
-                     offset: int) -> None:
+                     offset: int, end_offset: int) -> None:
         batch = self._index.new_batch()
         loc = struct.pack(">IQ", suffix, offset)
         batch.put(b"n" + struct.pack(">Q", block.header.number), loc)
@@ -122,6 +162,10 @@ class BlockStore:
                 txpb.TxValidationCode.NOT_VALIDATED
             batch.put(b"t" + ch.tx_id.encode(),
                       struct.pack(">QIB", block.header.number, i, code))
+        batch.put(_CHECKPOINT,
+                  struct.pack(">IQQ", suffix, end_offset,
+                              block.header.number + 1) +
+                  pu.block_header_hash(block.header))
         self._index.write_batch(batch)
 
     # -- reads --

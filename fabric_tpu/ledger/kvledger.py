@@ -21,7 +21,7 @@ from fabric_tpu.common.flogging import must_get_logger
 from fabric_tpu.ledger.blkstorage import BlockStore
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.kvdb import DBHandle, KVStore
-from fabric_tpu.ledger.statedb import Height, StateDB
+from fabric_tpu.ledger.statedb import Height, StateDB, UpdateBatch
 from fabric_tpu.ledger.txmgr import TxMgr, TxSimulator
 from fabric_tpu.protos import common, rwset as rwpb, transaction as txpb
 
@@ -58,7 +58,6 @@ class KVLedger:
         self.state_db = StateDB(DBHandle(self._kv, "statedb"))
         self.history_db = HistoryDB(DBHandle(self._kv, "historydb"))
         self.txmgr = TxMgr(self.state_db)
-        self._commit_hash = self._load_commit_hash()
 
         provider = metrics_provider or metrics_mod.DisabledProvider()
         hopts = lambda name: metrics_mod.HistogramOpts(  # noqa: E731
@@ -75,6 +74,7 @@ class KVLedger:
             label_names=("channel",))).with_labels(ledger_id)
 
         self._recover_dbs()
+        self._commit_hash = self._load_commit_hash()
 
     # -- lifecycle --
 
@@ -84,8 +84,20 @@ class KVLedger:
         self.commit_block(genesis)
 
     def _load_commit_hash(self) -> bytes:
-        h = DBHandle(self._kv, "meta").get(b"commit_hash")
-        return h or b""
+        """The commit-hash chain head is recovered from the LAST stored
+        block's COMMIT_HASH metadata — the block append is the
+        durability point of the hash, so this cannot race a separately
+        persisted copy (a meta key written after the state commit could
+        be stale after a crash, silently forking this peer's chain from
+        peers that did not crash)."""
+        height = self.block_store.height
+        if height == 0:
+            return b""
+        last = self.block_store.get_block_by_number(height - 1)
+        md = last.metadata.metadata
+        if len(md) > common.BlockMetadataIndex.COMMIT_HASH:
+            return bytes(md[common.BlockMetadataIndex.COMMIT_HASH])
+        return b""
 
     def _recover_dbs(self) -> None:
         """Replay blocks the state DB missed (crash between block append
@@ -143,27 +155,32 @@ class KVLedger:
         # TRANSACTIONS_FILTER: one code byte per tx
         block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(codes)
-        # commit-hash chain (reference kv_ledger.go commitHash)
-        self._commit_hash = hashlib.sha256(
+        # commit-hash chain (reference kv_ledger.go commitHash); only
+        # adopted in-memory once add_block accepts the block, so a
+        # rejected block (wrong number / previous_hash) cannot poison
+        # the chain
+        new_commit_hash = hashlib.sha256(
             self._commit_hash + bytes(codes) +
             block.header.data_hash).digest()
         block.metadata.metadata[common.BlockMetadataIndex.COMMIT_HASH] = \
-            self._commit_hash
+            new_commit_hash
 
         t1 = time.perf_counter()
         self.block_store.add_block(block)
+        self._commit_hash = new_commit_hash
         t2 = time.perf_counter()
 
+        # history BEFORE the statedb savepoint: its puts are idempotent
+        # empty entries, so a crash in between is healed by replay —
+        # the reverse order would permanently lose block N's history
         if batch is not None:
+            self.history_db.commit_block(block, codes)
             self.state_db.apply_updates(batch,
                                         Height(block_num, max(n - 1, 0)))
-            self.history_db.commit_block(block, codes)
         else:
             # config/genesis blocks still advance the savepoint
-            from fabric_tpu.ledger.statedb import UpdateBatch
             self.state_db.apply_updates(UpdateBatch(),
                                         Height(block_num, 0))
-        DBHandle(self._kv, "meta").put(b"commit_hash", self._commit_hash)
         t3 = time.perf_counter()
 
         self._m_block_time.observe(t3 - t0)
@@ -182,7 +199,6 @@ class KVLedger:
         """Recovery path: re-run MVCC for an already-stored block using
         its recorded TRANSACTIONS_FILTER as upstream flags."""
         if self._is_config_block(block) or block.header.number == 0:
-            from fabric_tpu.ledger.statedb import UpdateBatch
             self.state_db.apply_updates(
                 UpdateBatch(), Height(block.header.number, 0))
             return
@@ -195,10 +211,11 @@ class KVLedger:
         ]
         codes, batch = self.txmgr.validate_and_prepare(
             block.header.number, rwsets, flags)
+        # same history-before-savepoint ordering as commit_block
+        self.history_db.commit_block(block, codes)
         self.state_db.apply_updates(
             batch, Height(block.header.number,
                           max(len(rwsets) - 1, 0)))
-        self.history_db.commit_block(block, codes)
 
     @staticmethod
     def _is_config_block(block: common.Block) -> bool:

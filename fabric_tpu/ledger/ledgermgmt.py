@@ -9,6 +9,7 @@ root.
 from __future__ import annotations
 
 import os
+import shutil
 from typing import Optional
 
 from fabric_tpu.common.flogging import must_get_logger
@@ -16,6 +17,11 @@ from fabric_tpu.ledger.kvledger import KVLedger, LedgerError
 from fabric_tpu.protos import common
 
 logger = must_get_logger("ledgermgmt")
+
+# marker file present inside a ledger dir from create() start until the
+# genesis block is durably committed (reference: the msgs.Status
+# UNDER_CONSTRUCTION bookkeeping in kv_ledger_provider.go)
+_UNDER_CONSTRUCTION = "_under_construction"
 
 
 class LedgerManager:
@@ -25,16 +31,48 @@ class LedgerManager:
         self._ledgers: dict[str, KVLedger] = {}
         os.makedirs(root_dir, exist_ok=True)
 
+    def _path(self, ledger_id: str) -> str:
+        return os.path.join(self._root, ledger_id)
+
+    def _is_under_construction(self, ledger_id: str) -> bool:
+        return os.path.exists(
+            os.path.join(self._path(ledger_id), _UNDER_CONSTRUCTION))
+
     def create(self, genesis_block: common.Block,
                ledger_id: str) -> KVLedger:
-        """Reference: CreateLedger — genesis block required."""
-        if ledger_id in self._ledgers or \
-                os.path.isdir(os.path.join(self._root, ledger_id)):
+        """Reference: CreateLedger — genesis block required. A ledger
+        dir left by a create() that died before the genesis commit is
+        wiped and rebuilt, so failed creates are retryable instead of
+        permanently blocking the id."""
+        path = self._path(ledger_id)
+        if ledger_id in self._ledgers:
             raise LedgerError(f"ledger {ledger_id!r} already exists")
-        ledger = KVLedger(ledger_id,
-                          os.path.join(self._root, ledger_id),
-                          self._metrics)
-        ledger.initialize_from_genesis(genesis_block)
+        if os.path.isdir(path):
+            if not self._is_under_construction(ledger_id):
+                raise LedgerError(f"ledger {ledger_id!r} already exists")
+            logger.warning(
+                "removing half-built ledger %s from a failed create",
+                ledger_id)
+            shutil.rmtree(path)
+        # stage dir + marker in a temp name, then atomically rename: the
+        # ledger dir can never exist without its marker, so a crash at
+        # any point here leaves either nothing (stale .uc-tmp, wiped on
+        # retry) or a marked dir (wiped on retry)
+        tmp = path + ".uc-tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, _UNDER_CONSTRUCTION), "w"):
+            pass
+        os.replace(tmp, path)
+        marker = os.path.join(path, _UNDER_CONSTRUCTION)
+        ledger = KVLedger(ledger_id, path, self._metrics)
+        try:
+            ledger.initialize_from_genesis(genesis_block)
+        except Exception:
+            ledger.close()
+            raise
+        os.remove(marker)
         self._ledgers[ledger_id] = ledger
         logger.info("created ledger %s", ledger_id)
         return ledger
@@ -42,9 +80,13 @@ class LedgerManager:
     def open(self, ledger_id: str) -> KVLedger:
         if ledger_id in self._ledgers:
             return self._ledgers[ledger_id]
-        path = os.path.join(self._root, ledger_id)
+        path = self._path(ledger_id)
         if not os.path.isdir(path):
             raise LedgerError(f"ledger {ledger_id!r} does not exist")
+        if self._is_under_construction(ledger_id):
+            raise LedgerError(
+                f"ledger {ledger_id!r} is incomplete (create() did not "
+                f"finish); re-create it from its genesis block")
         ledger = KVLedger(ledger_id, path, self._metrics)
         self._ledgers[ledger_id] = ledger
         return ledger
@@ -53,9 +95,10 @@ class LedgerManager:
         return self._ledgers.get(ledger_id)
 
     def ledger_ids(self) -> list[str]:
-        on_disk = [d for d in sorted(os.listdir(self._root))
-                   if os.path.isdir(os.path.join(self._root, d))]
-        return on_disk
+        return [d for d in sorted(os.listdir(self._root))
+                if os.path.isdir(os.path.join(self._root, d))
+                and not d.endswith(".uc-tmp")
+                and not self._is_under_construction(d)]
 
     def close(self) -> None:
         for ledger in self._ledgers.values():

@@ -316,6 +316,21 @@ class TestConfigUpdate:
         with pytest.raises(ConfigTxError, match="version 0"):
             state["validator"].propose_config_update(env)
 
+    def test_new_subtree_with_empty_mod_policy_rejected(self, state):
+        update = ctxpb.ConfigUpdate(channel_id="testchannel")
+        update.read_set.CopyFrom(
+            _shallow_read(state["config"].channel_group))
+        ws = update.write_set
+        cur = state["config"].channel_group
+        ws.version = cur.version + 1
+        ws.mod_policy = cur.mod_policy
+        evil = ws.groups["Evil"]
+        evil.mod_policy = "Admins"
+        evil.values["X"].version = 0   # version fine, mod_policy empty
+        env = _signed_update(update, [])
+        with pytest.raises(ConfigTxError, match="empty mod_policy"):
+            state["validator"].propose_config_update(env)
+
     def test_modified_item_with_empty_mod_policy_rejected(self, state):
         """Clearing mod_policy must be an explicit rejection, not a
         silently-retained no-op (reference: update.go

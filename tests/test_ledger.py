@@ -321,3 +321,189 @@ class TestKVLedger:
         led2 = mgr2.open("mychannel")
         assert led2.height == 1
         mgr2.close()
+
+
+class TestCrashRecovery:
+    """Crash-window regressions: every durability ordering in the
+    commit pipeline (file → index → history → state savepoint) must be
+    healed by reopening the ledger."""
+
+    @staticmethod
+    def _mk_block(n, prev, payload):
+        b = pu.new_block(n, prev)
+        b.data.data.append(payload)
+        b.header.data_hash = pu.block_data_hash(b.data)
+        return b
+
+    def test_index_rebuilt_after_lost_index_batch(self, tmp_path):
+        """add_block fsyncs the block file before the index batch; a
+        crash in between must not leave the store with height > index
+        (the tail block unreadable forever)."""
+        import struct as _struct
+        kv = KVStore(str(tmp_path / "idx.db"))
+        store = BlockStore(str(tmp_path), DBHandle(kv, "i"))
+        b0 = self._mk_block(0, b"", b"tx-0")
+        store.add_block(b0)
+        b1 = self._mk_block(1, pu.block_header_hash(b0.header), b"tx-1")
+        raw = pu.marshal(b1)
+        store.close()
+        # simulate: record durably in the file, index batch lost
+        path = os.path.join(str(tmp_path), "chains", "blockfile_000000")
+        with open(path, "ab") as f:
+            f.write(_struct.pack(">I", len(raw)))
+            f.write(raw)
+        kv2 = KVStore(str(tmp_path / "idx.db"))
+        store2 = BlockStore(str(tmp_path), DBHandle(kv2, "i"))
+        assert store2.height == 2
+        got = store2.get_block_by_number(1)
+        assert got is not None and got.data.data[0] == b"tx-1"
+        # and the chain continues cleanly
+        b2 = self._mk_block(2, store2.last_block_hash, b"tx-2")
+        store2.add_block(b2)
+        assert store2.get_block_by_number(2) is not None
+        store2.close()
+
+    def test_checkpointed_recovery_does_not_scan_old_files(
+            self, tmp_path, monkeypatch):
+        """Startup scans only from the persisted checkpoint — proven by
+        deleting the rotated-away first file: reopen must still work."""
+        from fabric_tpu.ledger import blkstorage as bs
+        monkeypatch.setattr(bs, "_MAX_FILE", 256)   # force rotation
+        kv = KVStore(str(tmp_path / "idx.db"))
+        store = BlockStore(str(tmp_path), DBHandle(kv, "i"))
+        prev = b""
+        for n in range(6):
+            b = self._mk_block(n, prev, b"x" * 100)
+            store.add_block(b)
+            prev = pu.block_header_hash(b.header)
+        assert store._cur_suffix > 0
+        height, last = store.height, store.last_block_hash
+        store.close()
+        os.remove(os.path.join(str(tmp_path), "chains",
+                               "blockfile_000000"))
+        kv2 = KVStore(str(tmp_path / "idx.db"))
+        store2 = BlockStore(str(tmp_path), DBHandle(kv2, "i"))
+        assert store2.height == height
+        assert store2.last_block_hash == last
+        b = self._mk_block(height, last, b"more")
+        store2.add_block(b)
+        store2.close()
+
+    def test_history_recovered_with_state_on_replay(self, tmp_path):
+        """Crash between block append and the state/history commit:
+        replay must restore BOTH; and re-replay (savepoint rolled back)
+        must not duplicate history entries."""
+        from fabric_tpu.ledger.statedb import _SAVEPOINT
+        led = KVLedger("ch1", str(tmp_path / "ch1"))
+        genesis = pu.new_block(0, b"")
+        genesis.header.data_hash = pu.block_data_hash(genesis.data)
+        led.initialize_from_genesis(genesis)
+        sim = led.new_tx_simulator()
+        sim.put_state("cc", "k", b"v1")
+        env, _ = make_tx_envelope("ch1", sim)
+        block = append_block(led, [env])
+        block.metadata.metadata[
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(
+            [txpb.TxValidationCode.VALID])
+        led.block_store.add_block(block)      # crash before state commit
+        led.close()
+        led2 = KVLedger("ch1", str(tmp_path / "ch1"))
+        assert led2.get_state("cc", "k") == b"v1"
+        hist = list(led2.get_history_for_key("cc", "k"))
+        assert len(hist) == 1 and hist[0]["value"] == b"v1"
+        # roll the savepoint back and reopen: replay must be idempotent
+        led2.state_db._db.put(_SAVEPOINT, Height(0, 0).pack())
+        led2.close()
+        led3 = KVLedger("ch1", str(tmp_path / "ch1"))
+        assert led3.get_state("cc", "k") == b"v1"
+        assert len(list(led3.get_history_for_key("cc", "k"))) == 1
+        led3.close()
+
+    def test_commit_hash_chain_survives_crash(self, tmp_path):
+        """A crashed-and-recovered peer must produce the same
+        COMMIT_HASH chain as a peer that never crashed."""
+        def fresh(name):
+            led = KVLedger("ch1", str(tmp_path / name))
+            genesis = pu.new_block(0, b"")
+            genesis.header.data_hash = pu.block_data_hash(genesis.data)
+            led.initialize_from_genesis(genesis)
+            return led
+
+        led_a, led_b = fresh("a"), fresh("b")
+        sim = led_a.new_tx_simulator()
+        sim.put_state("cc", "k", b"v1")
+        env1, _ = make_tx_envelope("ch1", sim)
+        sim2 = led_a.new_tx_simulator()
+        sim2.put_state("cc", "k", b"v2")
+        env2, _ = make_tx_envelope("ch1", sim2)
+
+        b1a = append_block(led_a, [env1])
+        led_a.commit_block(b1a)
+        # peer B: same block, but crash between append and state commit
+        b1b = append_block(led_b, [env1])
+        b1b.metadata.metadata[
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(
+            [txpb.TxValidationCode.VALID])
+        b1b.metadata.metadata[common.BlockMetadataIndex.COMMIT_HASH] = \
+            b1a.metadata.metadata[common.BlockMetadataIndex.COMMIT_HASH]
+        led_b.block_store.add_block(b1b)
+        led_b.close()
+        led_b2 = KVLedger("ch1", str(tmp_path / "b"))
+
+        b2a = append_block(led_a, [env2])
+        codes_a = led_a.commit_block(b2a)
+        b2b = append_block(led_b2, [env2])
+        codes_b = led_b2.commit_block(b2b)
+        assert codes_a == codes_b
+        assert b2a.metadata.metadata[
+            common.BlockMetadataIndex.COMMIT_HASH] == \
+            b2b.metadata.metadata[common.BlockMetadataIndex.COMMIT_HASH]
+        led_a.close()
+        led_b2.close()
+
+    def test_rejected_block_does_not_poison_commit_hash(self, tmp_path):
+        def fresh(name):
+            led = KVLedger("ch1", str(tmp_path / name))
+            genesis = pu.new_block(0, b"")
+            genesis.header.data_hash = pu.block_data_hash(genesis.data)
+            led.initialize_from_genesis(genesis)
+            return led
+
+        led_a, led_b = fresh("a"), fresh("b")
+        sim = led_a.new_tx_simulator()
+        sim.put_state("cc", "k", b"v1")
+        env, _ = make_tx_envelope("ch1", sim)
+
+        bad = pu.new_block(7, b"nope")          # wrong number
+        bad.data.data.append(env)
+        bad.header.data_hash = pu.block_data_hash(bad.data)
+        with pytest.raises(BlockStoreError):
+            led_a.commit_block(bad)
+
+        b1a = append_block(led_a, [env])
+        led_a.commit_block(b1a)
+        b1b = append_block(led_b, [env])
+        led_b.commit_block(b1b)
+        assert b1a.metadata.metadata[
+            common.BlockMetadataIndex.COMMIT_HASH] == \
+            b1b.metadata.metadata[common.BlockMetadataIndex.COMMIT_HASH]
+        led_a.close()
+        led_b.close()
+
+    def test_failed_create_is_retryable(self, tmp_path):
+        mgr = LedgerManager(str(tmp_path))
+        bad_genesis = pu.new_block(3, b"")       # wrong number
+        bad_genesis.header.data_hash = pu.block_data_hash(
+            bad_genesis.data)
+        with pytest.raises(BlockStoreError):
+            mgr.create(bad_genesis, "ch1")
+        # half-built dir: not listed, not openable
+        assert mgr.ledger_ids() == []
+        with pytest.raises(LedgerError, match="incomplete"):
+            mgr.open("ch1")
+        good = pu.new_block(0, b"")
+        good.header.data_hash = pu.block_data_hash(good.data)
+        led = mgr.create(good, "ch1")            # retry succeeds
+        assert led.height == 1
+        assert mgr.ledger_ids() == ["ch1"]
+        mgr.close()
