@@ -1,0 +1,133 @@
+"""Shared block-delivery engine (orderer Deliver + peer deliver events).
+
+Rebuild of `common/deliver/deliver.go:173,198` (Handle/deliverBlocks):
+parse the signed SeekInfo envelope, gate on the channel's Readers
+policy, then stream blocks [start, stop], blocking for not-yet-cut
+blocks under BLOCK_UNTIL_READY.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator, Optional
+
+from fabric_tpu.protos import common, orderer as ordpb
+from fabric_tpu.protoutil import protoutil as pu
+from fabric_tpu.common.policies import policy as papi
+
+logger = logging.getLogger("deliver")
+
+MAX_INT64 = (1 << 63) - 1
+
+
+def _status(code) -> ordpb.DeliverResponse:
+    return ordpb.DeliverResponse(status=code)
+
+
+class DeliverHandler:
+    """`chain_getter(channel_id)` must return an object with `.ledger`
+    (height / get_block / wait_for_block) and `.bundle()` — the
+    orderer's ChainSupport or the peer's Channel both satisfy it."""
+
+    def __init__(self, chain_getter, policy_name: str = "/Channel/Readers",
+                 timeout_s: Optional[float] = None):
+        self._chain_getter = chain_getter
+        self._policy_name = policy_name
+        self._timeout_s = timeout_s
+
+    def handle(self, env: common.Envelope
+               ) -> Iterator[ordpb.DeliverResponse]:
+        """One SeekInfo envelope → a stream of blocks then a status
+        (reference deliver.go:198 deliverBlocks)."""
+        try:
+            payload = pu.get_payload(env)
+            ch = pu.get_channel_header(payload)
+        except Exception:
+            yield _status(common.Status.BAD_REQUEST)
+            return
+        chain = self._chain_getter(ch.channel_id)
+        if chain is None:
+            yield _status(common.Status.NOT_FOUND)
+            return
+        # the orderer's ChainSupport carries a dedicated ledger object;
+        # the peer's Channel plays both roles itself (it exposes
+        # height/get_block/wait_for_block directly)
+        ledger = getattr(chain, "ledger", chain)
+        if not hasattr(ledger, "get_block"):
+            ledger = chain
+        seek = ordpb.SeekInfo()
+        try:
+            seek.ParseFromString(payload.data)
+        except Exception:
+            yield _status(common.Status.BAD_REQUEST)
+            return
+
+        # access control: signed SeekInfo vs Readers policy; like the
+        # reference's SessionAC, re-evaluated whenever the channel
+        # config changes during a long-lived stream (see loop below)
+        signed_data = pu.envelope_as_signed_data(env)
+        current_bundle = None
+
+        def authorized() -> bool:
+            nonlocal current_bundle
+            bundle = chain.bundle()
+            if bundle is current_bundle:
+                return True
+            try:
+                policy = bundle.policy_manager.get_policy(
+                    self._policy_name)
+                policy.evaluate_signed_data(signed_data)
+            except papi.PolicyError:
+                return False
+            current_bundle = bundle
+            return True
+
+        if not authorized():
+            yield _status(common.Status.FORBIDDEN)
+            return
+
+        height = ledger.height
+
+        def resolve(pos: ordpb.SeekPosition, default: int) -> int:
+            which = pos.WhichOneof("type")
+            if which == "oldest":
+                return 0
+            if which == "newest":
+                return max(height - 1, 0)
+            if which == "specified":
+                return pos.specified.number
+            if which == "next_commit":
+                return height
+            return default
+
+        start = resolve(seek.start, 0)
+        stop = resolve(seek.stop, MAX_INT64)
+        if stop < start:
+            yield _status(common.Status.BAD_REQUEST)
+            return
+
+        number = start
+        while number <= stop:
+            if not authorized():
+                yield _status(common.Status.FORBIDDEN)
+                return
+            if number >= ledger.height:
+                if seek.behavior == ordpb.SeekInfo.FAIL_IF_NOT_READY:
+                    yield _status(common.Status.NOT_FOUND)
+                    return
+                if not ledger.wait_for_block(number, self._timeout_s):
+                    yield _status(common.Status.SERVICE_UNAVAILABLE)
+                    return
+            block = ledger.get_block(number)
+            if block is None:
+                yield _status(common.Status.INTERNAL_SERVER_ERROR)
+                return
+            if seek.content_type == ordpb.SeekInfo.HEADER_WITH_SIG:
+                pruned = common.Block()
+                pruned.header.CopyFrom(block.header)
+                pruned.metadata.CopyFrom(block.metadata)
+                yield ordpb.DeliverResponse(block=pruned)
+            else:
+                yield ordpb.DeliverResponse(block=block)
+            number += 1
+        yield _status(common.Status.SUCCESS)

@@ -98,8 +98,35 @@ class SignaturePolicy(papi.Policy):
             signed_data, self._deserializer, self._csp)
         self.evaluate_identities(identities)
 
+    def prepare(self, signed_data) -> "PreparedPolicyEval":
+        """Two-phase evaluation for block-scope batching: returns the
+        pending VerifyItems; the caller batches them (typically together
+        with every other signature set in the block), then calls
+        `.finish(ok_flags)` which raises PolicyError exactly as
+        `evaluate_signed_data` would."""
+        prepared = papi.prepare_signature_set(
+            signed_data, self._deserializer)
+        return PreparedPolicyEval(self, prepared)
+
     def evaluate_identities(self, identities) -> None:
         used = [False] * len(identities)
         if not self._eval(identities, used):
             raise papi.PolicyError(
                 "signature set did not satisfy policy")
+
+
+class PreparedPolicyEval:
+    """Deferred `SignaturePolicy.evaluate_signed_data`: identities are
+    deserialized, signatures not yet verified."""
+
+    def __init__(self, policy: SignaturePolicy,
+                 prepared: papi.PreparedSignatureSet):
+        self._policy = policy
+        self._prepared = prepared
+
+    @property
+    def items(self):
+        return self._prepared.items
+
+    def finish(self, ok) -> None:
+        self._policy.evaluate_identities(self._prepared.finish(ok))

@@ -87,3 +87,25 @@ class ImplicitMetaPolicy(papi.Policy):
 
     def evaluate_identities(self, identities) -> None:
         self._evaluate("evaluate_identities", identities)
+
+    def prepare(self, signed_data):
+        """Two-phase evaluation (see `SignaturePolicy.prepare`): the
+        signature set is converted to identities once; `finish(ok)`
+        fans the surviving identities out to the children. Requires the
+        converter (bundle-compiled policies always have one)."""
+        if self._converter is None:
+            raise papi.PolicyError(
+                "implicit-meta policy lacks identity converter; "
+                "two-phase evaluation unavailable")
+        deserializer, _csp = self._converter
+        prepared = papi.prepare_signature_set(signed_data, deserializer)
+        policy = self
+
+        class _Prepared:
+            items = prepared.items
+
+            @staticmethod
+            def finish(ok) -> None:
+                policy.evaluate_identities(prepared.finish(ok))
+
+        return _Prepared()

@@ -50,17 +50,38 @@ class Policy(abc.ABC):
         satisfy the policy."""
 
 
-def signature_set_to_valid_identities(signed_data: Sequence[SignedData],
-                                      deserializer,
-                                      csp) -> list:
-    """Dedup by identity, verify all signatures in ONE batch, return the
-    identities whose signatures verified.
+class PreparedSignatureSet:
+    """A signature set after dedup + identity deserialization, before
+    crypto. `items` are the pending `VerifyItem`s; `finish(ok)` applies
+    the batch-verify results and returns the valid identities.
 
-    Reference: `common/policies/policy.go:363-393`
-    SignatureSetToValidIdentities — semantics preserved (dedup on
-    identity bytes, bad identities skipped with a log line, bad
-    signatures dropped), execution batched (the ★ site of SURVEY §3.4).
+    This split lets a block-scope caller (the txvalidator) concatenate
+    the items of EVERY signature set in a block into one
+    `csp.verify_batch` dispatch — the whole point of the rebuild — while
+    single-set callers use `signature_set_to_valid_identities` below.
     """
+
+    def __init__(self, identities: list, items: list):
+        self.identities = identities
+        self.items = items
+
+    def finish(self, ok: Sequence[bool]) -> list:
+        valid = []
+        for ident, good in zip(self.identities, ok):
+            if good:
+                valid.append(ident)
+            else:
+                logger.debug("signature for identity %s did not verify",
+                             ident.mspid())
+        return valid
+
+
+def prepare_signature_set(signed_data: Sequence[SignedData],
+                          deserializer) -> PreparedSignatureSet:
+    """CPU half of SignatureSetToValidIdentities (reference:
+    `common/policies/policy.go:363-393`): dedup on identity bytes, skip
+    undeserializable identities with a log line, build one VerifyItem
+    per remaining signature. No crypto happens here."""
     used = set()
     idents = []
     items = []
@@ -75,17 +96,24 @@ def signature_set_to_valid_identities(signed_data: Sequence[SignedData],
             continue
         idents.append(ident)
         items.append(ident.verify_item(sd.data, sd.signature))
-    if not items:
+    return PreparedSignatureSet(idents, items)
+
+
+def signature_set_to_valid_identities(signed_data: Sequence[SignedData],
+                                      deserializer,
+                                      csp) -> list:
+    """Dedup by identity, verify all signatures in ONE batch, return the
+    identities whose signatures verified.
+
+    Reference: `common/policies/policy.go:363-393`
+    SignatureSetToValidIdentities — semantics preserved (dedup on
+    identity bytes, bad identities skipped with a log line, bad
+    signatures dropped), execution batched (the ★ site of SURVEY §3.4).
+    """
+    prepared = prepare_signature_set(signed_data, deserializer)
+    if not prepared.items:
         return []
-    ok = csp.verify_batch(items)
-    valid = []
-    for ident, good in zip(idents, ok):
-        if good:
-            valid.append(ident)
-        else:
-            logger.debug("signature for identity %s did not verify",
-                         ident.mspid())
-    return valid
+    return prepared.finish(csp.verify_batch(prepared.items))
 
 
 class Manager:

@@ -1,0 +1,2 @@
+"""Peer transaction pipeline: endorser, chaincode runtime, validator,
+committer (reference: `core/` — SURVEY.md §2.7)."""

@@ -1,0 +1,80 @@
+"""Resource→policy ACL mapping for peer APIs.
+
+Rebuild of `core/aclmgmt/` (`NewACLProvider`, resource names in
+`core/aclmgmt/resources/resources.go`): each named peer resource maps
+to a channel policy path; `check_acl` evaluates the caller's signed
+data against it. Channel config may override per-resource policies via
+the ACLs config value (not yet wired; defaults below mirror the
+reference's `defaultACLProvider`).
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.common.policies import policy as papi
+
+# resource names (reference: core/aclmgmt/resources/resources.go)
+PROPOSE = "peer/Propose"
+CHAINCODE_TO_CHAINCODE = "peer/ChaincodeToChaincode"
+BLOCK_EVENT = "event/Block"
+FILTERED_BLOCK_EVENT = "event/FilteredBlock"
+QSCC_GET_CHAIN_INFO = "qscc/GetChainInfo"
+QSCC_GET_BLOCK_BY_NUMBER = "qscc/GetBlockByNumber"
+QSCC_GET_BLOCK_BY_HASH = "qscc/GetBlockByHash"
+QSCC_GET_TX_BY_ID = "qscc/GetTransactionByID"
+CSCC_GET_CONFIG_BLOCK = "cscc/GetConfigBlock"
+CSCC_GET_CHANNEL_CONFIG = "cscc/GetChannelConfig"
+GATEWAY_EVALUATE = "gateway/Evaluate"
+GATEWAY_ENDORSE = "gateway/Endorse"
+GATEWAY_SUBMIT = "gateway/Submit"
+GATEWAY_COMMIT_STATUS = "gateway/CommitStatus"
+
+_CHANNEL_READERS = "/Channel/Application/Readers"
+_CHANNEL_WRITERS = "/Channel/Application/Writers"
+
+_DEFAULTS = {
+    PROPOSE: _CHANNEL_WRITERS,
+    CHAINCODE_TO_CHAINCODE: _CHANNEL_WRITERS,
+    BLOCK_EVENT: _CHANNEL_READERS,
+    FILTERED_BLOCK_EVENT: _CHANNEL_READERS,
+    QSCC_GET_CHAIN_INFO: _CHANNEL_READERS,
+    QSCC_GET_BLOCK_BY_NUMBER: _CHANNEL_READERS,
+    QSCC_GET_BLOCK_BY_HASH: _CHANNEL_READERS,
+    QSCC_GET_TX_BY_ID: _CHANNEL_READERS,
+    CSCC_GET_CONFIG_BLOCK: _CHANNEL_READERS,
+    CSCC_GET_CHANNEL_CONFIG: _CHANNEL_READERS,
+    GATEWAY_EVALUATE: _CHANNEL_READERS,
+    GATEWAY_ENDORSE: _CHANNEL_WRITERS,
+    GATEWAY_SUBMIT: _CHANNEL_WRITERS,
+    GATEWAY_COMMIT_STATUS: _CHANNEL_READERS,
+}
+
+
+class ACLError(Exception):
+    pass
+
+
+class ACLProvider:
+    def __init__(self, overrides: dict[str, str] | None = None):
+        self._map = dict(_DEFAULTS)
+        if overrides:
+            self._map.update(overrides)
+
+    def policy_for(self, resource: str) -> str:
+        path = self._map.get(resource)
+        if path is None:
+            raise ACLError(f"unknown resource {resource!r}")
+        return path
+
+    def check_acl(self, resource: str, policy_manager,
+                  signed_data) -> None:
+        """Raise ACLError unless `signed_data` satisfies the policy
+        mapped to `resource` (reference: aclmgmt CheckACL)."""
+        path = self.policy_for(resource)
+        try:
+            policy = policy_manager.get_policy(path)
+        except papi.PolicyError as e:
+            raise ACLError(f"no policy {path} for {resource}: {e}")
+        try:
+            policy.evaluate_signed_data(signed_data)
+        except papi.PolicyError as e:
+            raise ACLError(f"access denied for {resource}: {e}")

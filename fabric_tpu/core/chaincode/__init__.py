@@ -1,0 +1,6 @@
+from fabric_tpu.core.chaincode.shim import (  # noqa: F401
+    Chaincode, ChaincodeStub, Response, success, error,
+)
+from fabric_tpu.core.chaincode.support import (  # noqa: F401
+    ChaincodeSupport, ChaincodeDefinition, ExecuteError,
+)

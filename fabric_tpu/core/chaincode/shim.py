@@ -1,0 +1,155 @@
+"""Chaincode programming model: the shim the contract code sees.
+
+Rebuild of the reference's chaincode shim contract (vendored
+`fabric-chaincode-go` interfaces, spoken to over the
+`ChaincodeSupport.Register` gRPC stream — `core/chaincode/handler.go`).
+In-process Python chaincode is this framework's native mode (the
+reference's docker/external-builder launch is the heavyweight analog;
+the CCaaS-style external gRPC process mode reuses this same stub
+surface). Every state access routes through the transaction simulator,
+so the rwset capture semantics match the reference's
+`HandleGetState/HandlePutState` (`core/chaincode/handler.go:601,990`).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional, Sequence
+
+from fabric_tpu.protos import proposal as pb
+
+# response status codes (reference: shim package consts)
+OK = 200
+ERRORTHRESHOLD = 400
+ERROR = 500
+
+Response = pb.Response
+
+
+def success(payload: bytes = b"") -> pb.Response:
+    return pb.Response(status=OK, payload=payload)
+
+
+def error(message: str) -> pb.Response:
+    return pb.Response(status=ERROR, message=message)
+
+
+class Chaincode(abc.ABC):
+    """What a contract implements (reference: shim.Chaincode)."""
+
+    @abc.abstractmethod
+    def init(self, stub: "ChaincodeStub") -> pb.Response: ...
+
+    @abc.abstractmethod
+    def invoke(self, stub: "ChaincodeStub") -> pb.Response: ...
+
+
+class ChaincodeStub:
+    """Per-invocation API handed to the contract (reference:
+    shim.ChaincodeStub; state ops mirror `core/chaincode/handler.go`
+    GET_STATE/PUT_STATE/DEL_STATE/GET_STATE_BY_RANGE dialog, but as
+    direct simulator calls — no gRPC round trip per state access).
+    """
+
+    def __init__(self, channel_id: str, tx_id: str, namespace: str,
+                 simulator, args: Sequence[bytes],
+                 creator: bytes = b"",
+                 transient: Optional[dict] = None,
+                 support=None,
+                 timestamp: int = 0):
+        self._channel_id = channel_id
+        self._tx_id = tx_id
+        self._ns = namespace
+        self._sim = simulator
+        self._args = list(args)
+        self._creator = creator
+        self._transient = dict(transient or {})
+        self._support = support
+        self._timestamp = timestamp
+        self._event: Optional[pb.ChaincodeEvent] = None
+
+    # -- invocation context --
+
+    def get_channel_id(self) -> str:
+        return self._channel_id
+
+    def get_tx_id(self) -> str:
+        return self._tx_id
+
+    def get_args(self) -> list[bytes]:
+        return list(self._args)
+
+    def get_function_and_parameters(self) -> tuple[str, list[str]]:
+        if not self._args:
+            return "", []
+        return (self._args[0].decode("utf-8", "replace"),
+                [a.decode("utf-8", "replace") for a in self._args[1:]])
+
+    def get_creator(self) -> bytes:
+        """Serialized identity of the proposal submitter."""
+        return self._creator
+
+    def get_transient(self) -> dict:
+        """Endorsement-time-only inputs; never written to the ledger."""
+        return dict(self._transient)
+
+    def get_tx_timestamp(self) -> int:
+        """Unix nanos from the channel header (deterministic across
+        endorsers, unlike wall clock)."""
+        return self._timestamp
+
+    # -- state --
+
+    def get_state(self, key: str) -> Optional[bytes]:
+        return self._sim.get_state(self._ns, key)
+
+    def put_state(self, key: str, value: bytes) -> None:
+        self._sim.put_state(self._ns, key, value)
+
+    def del_state(self, key: str) -> None:
+        self._sim.del_state(self._ns, key)
+
+    def get_state_by_range(self, start: str, end: str):
+        """Iterate (key, value) in [start, end); '' means unbounded,
+        matching the reference's GetStateByRange semantics."""
+        return self._sim.get_state_range(self._ns, start, end)
+
+    # -- private data --
+
+    def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
+        return self._sim.get_private_data(self._ns, collection, key)
+
+    def put_private_data(self, collection: str, key: str,
+                         value: bytes) -> None:
+        self._sim.put_private_data(self._ns, collection, key, value)
+
+    def del_private_data(self, collection: str, key: str) -> None:
+        self._sim.del_private_data(self._ns, collection, key)
+
+    # -- events --
+
+    def set_event(self, name: str, payload: bytes) -> None:
+        if not name:
+            raise ValueError("event name must not be empty")
+        self._event = pb.ChaincodeEvent(
+            chaincode_id=self._ns, tx_id=self._tx_id,
+            event_name=name, payload=payload)
+
+    @property
+    def chaincode_event(self) -> Optional[pb.ChaincodeEvent]:
+        return self._event
+
+    # -- chaincode-to-chaincode --
+
+    def invoke_chaincode(self, name: str, args: Sequence[bytes],
+                         channel: str = "") -> pb.Response:
+        """Call another chaincode in the same transaction (reference:
+        `core/chaincode/handler.go:1081` HandleInvokeChaincode).
+        Same-channel calls share this tx's simulator, so their writes
+        land in this tx's rwset; cross-channel calls are read-only
+        (reference semantics).
+        """
+        if self._support is None:
+            return error("chaincode-to-chaincode unavailable")
+        return self._support.invoke_chaincode(
+            self, name, list(args), channel or self._channel_id)

@@ -1,0 +1,128 @@
+"""Chaincode execution support: registry + launch + invoke.
+
+Rebuild of `core/chaincode/chaincode_support.go` (`Execute:160`,
+`Invoke:197`): the endorser hands a chaincode invocation spec and a tx
+simulator to `execute`; the runtime resolves the chaincode, runs it,
+and returns the response + events for the ProposalResponsePayload.
+
+The reference launches chaincode as external processes (docker /
+external builder / CCaaS) and talks gRPC
+(`core/chaincode/handler.go:362` ProcessStream). Here the native mode
+is in-process Python (registered `Chaincode` objects — the analog of
+the reference's built-in system chaincodes, `core/scc/scc.go`
+in-proc stream); an external CCaaS-style gRPC mode plugs in through the
+same `Runtime` seam.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from fabric_tpu.protos import proposal as pb
+from fabric_tpu.core.chaincode import shim
+
+logger = logging.getLogger("chaincode")
+
+
+class ExecuteError(Exception):
+    pass
+
+
+@dataclass
+class ChaincodeDefinition:
+    """What `_lifecycle` tracks per committed chaincode (reference:
+    `core/chaincode/lifecycle/lifecycle.go` ChaincodeDefinition):
+    name, sequence, version, endorsement-policy bytes."""
+    name: str
+    version: str = "1.0"
+    sequence: int = 1
+    endorsement_policy: bytes = b""   # marshaled ApplicationPolicy; empty = channel default
+    init_required: bool = False
+
+
+class ChaincodeSupport:
+    """Registry + executor for one peer (all channels).
+
+    The registry maps name → `Chaincode` instance (in-process) — the
+    launch step of the reference (`Launch`, docker build etc.) has no
+    TPU-side analog worth reproducing for in-process code; external
+    processes register themselves at connect time (CCaaS).
+    """
+
+    def __init__(self, execute_timeout_s: float = 30.0):
+        self._chaincodes: dict[str, shim.Chaincode] = {}
+        self._timeout = execute_timeout_s
+
+    def register(self, name: str, chaincode: shim.Chaincode) -> None:
+        if not isinstance(chaincode, shim.Chaincode):
+            raise TypeError("chaincode must implement Chaincode")
+        self._chaincodes[name] = chaincode
+        logger.info("chaincode %s registered (in-process)", name)
+
+    def is_registered(self, name: str) -> bool:
+        return name in self._chaincodes
+
+    def registered(self) -> list[str]:
+        return sorted(self._chaincodes)
+
+    def execute(self, channel_id: str, tx_id: str,
+                spec: pb.ChaincodeInvocationSpec, simulator,
+                creator: bytes = b"",
+                transient: Optional[dict] = None,
+                timestamp: int = 0) -> tuple[pb.Response,
+                                             Optional[pb.ChaincodeEvent],
+                                             pb.ChaincodeID]:
+        """Reference: `ChaincodeSupport.Execute` → `Invoke` → handler
+        round-trips; returns (response, event, resolved chaincode id).
+        Raises ExecuteError only for infrastructure faults; contract
+        errors come back as Response.status >= 400 like the reference
+        (endorser propagates them, `core/endorser/endorser.go:178`).
+        """
+        cc_id = spec.chaincode_spec.chaincode_id
+        cc = self._chaincodes.get(cc_id.name)
+        if cc is None:
+            raise ExecuteError(f"chaincode {cc_id.name} not found")
+        stub = shim.ChaincodeStub(
+            channel_id=channel_id, tx_id=tx_id, namespace=cc_id.name,
+            simulator=simulator,
+            args=list(spec.chaincode_spec.input.args),
+            creator=creator, transient=transient, support=self,
+            timestamp=timestamp)
+        try:
+            if spec.chaincode_spec.input.is_init:
+                resp = cc.init(stub)
+            else:
+                resp = cc.invoke(stub)
+        except Exception as e:
+            logger.exception("chaincode %s panicked", cc_id.name)
+            # reference: a chaincode panic fails the proposal, not the peer
+            resp = shim.error(f"chaincode {cc_id.name} crashed: {e}")
+        if not isinstance(resp, pb.Response):
+            resp = shim.error(
+                f"chaincode {cc_id.name} returned invalid response type")
+        return resp, stub.chaincode_event, cc_id
+
+    def invoke_chaincode(self, caller_stub: shim.ChaincodeStub,
+                         name: str, args: list, channel: str) -> pb.Response:
+        """cc2cc: same-channel shares the caller's simulator (writes
+        merge into one rwset, reference `handler.go:1081`)."""
+        cc = self._chaincodes.get(name)
+        if cc is None:
+            return shim.error(f"chaincode {name} not found")
+        if channel != caller_stub.get_channel_id():
+            return shim.error(
+                "cross-channel chaincode invocation is read-only and "
+                "not yet supported")
+        stub = shim.ChaincodeStub(
+            channel_id=channel, tx_id=caller_stub.get_tx_id(),
+            namespace=name, simulator=caller_stub._sim,
+            args=args, creator=caller_stub.get_creator(),
+            transient=caller_stub.get_transient(), support=self,
+            timestamp=caller_stub.get_tx_timestamp())
+        try:
+            return cc.invoke(stub)
+        except Exception as e:
+            logger.exception("chaincode %s panicked in cc2cc", name)
+            return shim.error(f"chaincode {name} crashed: {e}")

@@ -1,0 +1,43 @@
+"""Committer: validated block → ledger, config-block hook.
+
+Rebuild of `core/committer/committer_impl.go:55-70` LedgerCommitter —
+a thin wrapper over the ledger commit that first gives the channel a
+chance to process config blocks (bundle update), mirroring the
+reference's `preCommit` eventer.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Sequence
+
+from fabric_tpu.protos import common
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("committer")
+
+
+class LedgerCommitter:
+    def __init__(self, ledger,
+                 on_config_block: Optional[Callable] = None):
+        self._ledger = ledger
+        self._on_config_block = on_config_block
+
+    def commit(self, block: common.Block,
+               flags: Optional[Sequence[int]] = None) -> list[int]:
+        if self._on_config_block is not None and \
+                pu.is_config_block(block):
+            # adopt the config only if the validator accepted it
+            # (an INVALID_CONFIG_TRANSACTION block still commits to the
+            # chain — with its invalid marker — but changes nothing)
+            from fabric_tpu.protos import transaction as txpb
+            if not flags or flags[0] == txpb.TxValidationCode.VALID:
+                self._on_config_block(block)
+            else:
+                logger.warning("config block [%d] rejected by "
+                               "validation (code %s); not adopting",
+                               block.header.number, flags[0])
+        return self._ledger.commit_block(block, flags)
+
+    def height(self) -> int:
+        return self._ledger.height

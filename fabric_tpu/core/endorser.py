@@ -1,0 +1,114 @@
+"""The endorser: simulate a proposal, sign the result.
+
+Rebuild of `core/endorser/endorser.go` ProcessProposal (:304) /
+preProcess (:255) / simulateProposal (:178), with the default
+endorsement plugin inlined
+(`core/handlers/endorsement/builtin/default_endorsement.go:35-53` —
+sign prpBytes‖identity with the peer's signing identity).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fabric_tpu.protos import proposal as pb
+from fabric_tpu.protoutil import protoutil as pu, txutils
+from fabric_tpu.core import aclmgmt
+from fabric_tpu.core.chaincode import ChaincodeSupport, shim
+from fabric_tpu.core.msgvalidation import (
+    ProposalValidationError, UnpackedProposal,
+)
+
+logger = logging.getLogger("endorser")
+
+
+@dataclass
+class ChannelSupport:
+    """What the endorser needs from one channel (reference:
+    `core/endorser/support.go` Support, narrowed)."""
+    ledger: object          # KVLedger: new_tx_simulator, get_transaction_by_id
+    policy_manager: object  # policies.Manager
+    deserializer: object    # msp manager for the channel
+
+
+def _error_response(status: int, message: str) -> pb.ProposalResponse:
+    resp = pb.ProposalResponse(version=1)
+    resp.response.status = status
+    resp.response.message = message
+    return resp
+
+
+class Endorser:
+    def __init__(self, signer,
+                 cc_support: ChaincodeSupport,
+                 channel_support: Callable[[str], Optional[ChannelSupport]],
+                 acl_provider: Optional[aclmgmt.ACLProvider] = None,
+                 metrics=None):
+        self._signer = signer
+        self._cc = cc_support
+        self._channel = channel_support
+        self._acl = acl_provider or aclmgmt.ACLProvider()
+
+    def process_proposal(self, sp: pb.SignedProposal) -> pb.ProposalResponse:
+        """gRPC-facing entry (reference: endorser.go:304). All failures
+        come back as a ProposalResponse with status>=500, mirroring the
+        reference's error envelope behavior."""
+        try:
+            up = UnpackedProposal.unpack(sp)
+        except ProposalValidationError as e:
+            return _error_response(500, str(e))
+
+        support = self._channel(up.channel_id)
+        if support is None:
+            return _error_response(
+                500, f"access denied: channel [{up.channel_id}] not found")
+
+        # -- preProcess: creator sig, ACL, duplicate txid --
+        try:
+            up.validate(support.deserializer)
+        except ProposalValidationError as e:
+            return _error_response(
+                500, f"error validating proposal: {e}")
+
+        sd = [pu.SignedData(data=sp.proposal_bytes,
+                            identity=up.signature_header.creator,
+                            signature=sp.signature)]
+        try:
+            self._acl.check_acl(aclmgmt.PROPOSE,
+                                support.policy_manager, sd)
+        except aclmgmt.ACLError as e:
+            return _error_response(500, str(e))
+
+        if support.ledger.get_transaction_by_id(up.tx_id) is not None:
+            return _error_response(
+                500, f"duplicate transaction found [{up.tx_id}]")
+
+        # -- simulate --
+        sim = support.ledger.new_tx_simulator(up.tx_id)
+        try:
+            resp, event, cc_id = self._cc.execute(
+                up.channel_id, up.tx_id, up.input, sim,
+                creator=up.signature_header.creator,
+                transient=up.transient,
+                timestamp=up.channel_header.timestamp)
+        except Exception as e:
+            logger.warning("chaincode execution failed for [%s]: %s",
+                           up.tx_id, e)
+            return _error_response(500, f"chaincode execute failed: {e}")
+
+        if resp.status >= shim.ERRORTHRESHOLD:
+            # contract refused: propagate without endorsement
+            # (reference endorser.go:343-349)
+            out = pb.ProposalResponse(version=1)
+            out.response.CopyFrom(resp)
+            return out
+
+        results = pu.marshal(sim.get_tx_simulation_results())
+        events = pu.marshal(event) if event is not None else b""
+
+        # -- endorse (default plugin, inlined) --
+        return txutils.create_proposal_response(
+            sp.proposal_bytes, results, events, resp, cc_id,
+            self._signer)

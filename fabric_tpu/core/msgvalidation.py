@@ -1,0 +1,167 @@
+"""Proposal/transaction message validation.
+
+Rebuild of `core/endorser/msgvalidation.go` (UnpackProposal/Validate)
+and `core/common/validation/msgvalidation.go` (ValidateTransaction —
+the committed-tx structural checks the txvalidator runs per tx).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from fabric_tpu.protos import common, proposal as pb, transaction as txpb
+from fabric_tpu.protoutil import protoutil as pu
+
+
+class ProposalValidationError(Exception):
+    pass
+
+
+@dataclass
+class UnpackedProposal:
+    """Reference: `core/endorser/msgvalidation.go` UnpackedProposal."""
+    signed_proposal: pb.SignedProposal
+    proposal: pb.Proposal
+    header: common.Header
+    channel_header: common.ChannelHeader
+    signature_header: common.SignatureHeader
+    chaincode_name: str
+    input: pb.ChaincodeInvocationSpec
+    transient: dict
+
+    @property
+    def channel_id(self) -> str:
+        return self.channel_header.channel_id
+
+    @property
+    def tx_id(self) -> str:
+        return self.channel_header.tx_id
+
+    @classmethod
+    def unpack(cls, sp: pb.SignedProposal) -> "UnpackedProposal":
+        try:
+            prop = pb.Proposal()
+            prop.ParseFromString(sp.proposal_bytes)
+            hdr = common.Header()
+            hdr.ParseFromString(prop.header)
+            ch = common.ChannelHeader()
+            ch.ParseFromString(hdr.channel_header)
+            sh = common.SignatureHeader()
+            sh.ParseFromString(hdr.signature_header)
+        except Exception as e:
+            raise ProposalValidationError(f"malformed proposal: {e}")
+        if ch.type != common.HeaderType.ENDORSER_TRANSACTION:
+            raise ProposalValidationError(
+                f"invalid header type {ch.type} for proposal")
+        ext = pb.ChaincodeHeaderExtension()
+        try:
+            ext.ParseFromString(ch.extension)
+        except Exception as e:
+            raise ProposalValidationError(f"bad header extension: {e}")
+        if not ext.chaincode_id.name:
+            raise ProposalValidationError("chaincode name is empty")
+        ccpp = pb.ChaincodeProposalPayload()
+        spec = pb.ChaincodeInvocationSpec()
+        try:
+            ccpp.ParseFromString(prop.payload)
+            spec.ParseFromString(ccpp.input)
+        except Exception as e:
+            raise ProposalValidationError(f"bad proposal payload: {e}")
+        return cls(signed_proposal=sp, proposal=prop, header=hdr,
+                   channel_header=ch, signature_header=sh,
+                   chaincode_name=ext.chaincode_id.name, input=spec,
+                   transient=dict(ccpp.transient_map))
+
+    def validate(self, deserializer):
+        """Creator-signature + identity checks (reference:
+        `msgvalidation.go:123` Validate → `msp/identities.go:170`).
+        Returns the verified creator identity."""
+        sh = self.signature_header
+        if not sh.creator:
+            raise ProposalValidationError("creator is empty")
+        if not sh.nonce:
+            raise ProposalValidationError("nonce is empty")
+        expected = pu.compute_tx_id(sh.nonce, sh.creator)
+        if self.tx_id != expected:
+            raise ProposalValidationError(
+                f"tx id {self.tx_id} does not match computed id")
+        try:
+            ident = deserializer.deserialize_identity(sh.creator)
+        except Exception as e:
+            raise ProposalValidationError(
+                f"creator identity could not be deserialized: {e}")
+        try:
+            ident.validate()
+        except Exception as e:
+            raise ProposalValidationError(f"creator is not valid: {e}")
+        if not ident.verify(self.signed_proposal.proposal_bytes,
+                            self.signed_proposal.signature):
+            raise ProposalValidationError(
+                "creator signature does not verify")
+        return ident
+
+
+@dataclass
+class CheckedTransaction:
+    """Structural unpack of a committed ENDORSER_TRANSACTION envelope —
+    everything the VSCC needs, plus the creator's SignedData (verified
+    later, in the block-wide batch)."""
+    payload: common.Payload
+    channel_header: common.ChannelHeader
+    signature_header: common.SignatureHeader
+    creator_signed_data: pu.SignedData
+    transaction: Optional[txpb.Transaction] = None
+    config_envelope: Optional[bytes] = None
+
+
+def check_envelope(env: common.Envelope,
+                   channel_id: str) -> tuple[int, Optional[CheckedTransaction]]:
+    """Per-tx structural validation — everything from
+    `core/common/validation/msgvalidation.go:248` ValidateTransaction
+    EXCEPT the creator signature check, which is deferred to the
+    block-wide batch (`CheckedTransaction.creator_signed_data`).
+    Returns (TxValidationCode, checked-or-None)."""
+    TVC = txpb.TxValidationCode
+    if not env.payload:
+        return TVC.NIL_ENVELOPE, None
+    try:
+        payload = pu.get_payload(env)
+    except Exception:
+        return TVC.BAD_PAYLOAD, None
+    try:
+        ch = pu.get_channel_header(payload)
+    except Exception:
+        return TVC.BAD_COMMON_HEADER, None
+    try:
+        sh = common.SignatureHeader()
+        sh.ParseFromString(payload.header.signature_header)
+    except Exception:
+        return TVC.BAD_COMMON_HEADER, None
+    if ch.channel_id != channel_id:
+        return TVC.BAD_CHANNEL_HEADER, None
+    if not sh.creator or not sh.nonce:
+        return TVC.BAD_COMMON_HEADER, None
+
+    creator_sd = pu.SignedData(data=env.payload, identity=sh.creator,
+                               signature=env.signature)
+    checked = CheckedTransaction(payload=payload, channel_header=ch,
+                                 signature_header=sh,
+                                 creator_signed_data=creator_sd)
+
+    if ch.type == common.HeaderType.ENDORSER_TRANSACTION:
+        if ch.tx_id != pu.compute_tx_id(sh.nonce, sh.creator):
+            return TVC.BAD_PROPOSAL_TXID, None
+        tx = txpb.Transaction()
+        try:
+            tx.ParseFromString(payload.data)
+        except Exception:
+            return TVC.INVALID_ENDORSER_TRANSACTION, None
+        if not tx.actions:
+            return TVC.NIL_TXACTION, None
+        checked.transaction = tx
+        return TVC.NOT_VALIDATED, checked
+    if ch.type == common.HeaderType.CONFIG:
+        checked.config_envelope = payload.data
+        return TVC.NOT_VALIDATED, checked
+    return TVC.UNSUPPORTED_TX_PAYLOAD, None

@@ -1,0 +1,64 @@
+"""Application-policy evaluator (VSCC-facing).
+
+Rebuild of `core/policy/application.go:70-160`: an ApplicationPolicy is
+either an inline SignaturePolicyEnvelope or a by-name reference into the
+channel policy manager. Resolution returns a policies.Policy supporting
+both one-shot and two-phase (`prepare`) evaluation.
+"""
+
+from __future__ import annotations
+
+from fabric_tpu.protos import policies as polpb
+from fabric_tpu.common.policies import cauthdsl
+from fabric_tpu.common.policies import policy as papi
+
+
+class OneShotPrepared:
+    """Adapter giving any Policy the two-phase shape: contributes no
+    items to the block batch and evaluates eagerly at finish()."""
+
+    items: list = []
+
+    def __init__(self, policy, signed_data):
+        self._policy = policy
+        self._sd = signed_data
+
+    def finish(self, ok) -> None:
+        self._policy.evaluate_signed_data(self._sd)
+
+
+def prepare_policy(policy, signed_data):
+    """policy.prepare(sd) when supported, one-shot fallback otherwise."""
+    prep = getattr(policy, "prepare", None)
+    if prep is not None:
+        try:
+            return prep(signed_data)
+        except papi.PolicyError:
+            pass
+    return OneShotPrepared(policy, signed_data)
+
+
+class ApplicationPolicyEvaluator:
+    """Reference: `core/policy/application.go` — Evaluate(policyBytes,
+    signedData); here split into resolve + evaluate so the txvalidator
+    can batch."""
+
+    def __init__(self, policy_manager, deserializer, csp):
+        self._mgr = policy_manager
+        self._deserializer = deserializer
+        self._csp = csp
+
+    def resolve(self, policy_bytes: bytes):
+        """ApplicationPolicy bytes → Policy. Raises on malformed or
+        unresolvable policies (the VSCC maps that to
+        INVALID_CHAINCODE/ENDORSEMENT_POLICY_FAILURE)."""
+        app = polpb.ApplicationPolicy()
+        app.ParseFromString(policy_bytes)
+        which = app.WhichOneof("type")
+        if which == "signature_policy":
+            return cauthdsl.SignaturePolicy(
+                app.signature_policy, self._deserializer, self._csp)
+        if which == "channel_config_policy_reference":
+            return self._mgr.get_policy(
+                app.channel_config_policy_reference)
+        raise ValueError("empty application policy")

@@ -219,14 +219,7 @@ class KVLedger:
 
     @staticmethod
     def _is_config_block(block: common.Block) -> bool:
-        if not block.data.data:
-            return False
-        try:
-            env = pu.extract_envelope(block, 0)
-            ch = pu.get_channel_header(pu.get_payload(env))
-            return ch.type == common.HeaderType.CONFIG
-        except Exception:
-            return False
+        return pu.is_config_block(block)
 
     def close(self) -> None:
         self.block_store.close()

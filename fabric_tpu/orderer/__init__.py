@@ -1,0 +1,1 @@
+"""Ordering service (reference: `orderer/` — SURVEY.md §2.8)."""

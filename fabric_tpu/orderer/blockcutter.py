@@ -1,0 +1,76 @@
+"""Block cutter: batch envelopes by count / bytes / timeout.
+
+Rebuild of `orderer/common/blockcutter/blockcutter.go:69` (Ordered):
+returns zero, one, or two batches per message plus a "pending" flag the
+chain uses to arm its batch timer. Timeout itself lives in the
+consenter (solo/raft), exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from fabric_tpu.protos import common
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("blockcutter")
+
+
+@dataclass
+class BatchConfig:
+    """Orderer.BatchSize from channel config (reference:
+    configtx.yaml Orderer.BatchSize)."""
+    max_message_count: int = 500
+    absolute_max_bytes: int = 10 * 1024 * 1024
+    preferred_max_bytes: int = 2 * 1024 * 1024
+
+
+class Receiver:
+    def __init__(self, config_source):
+        """`config_source()` returns the current BatchConfig — config
+        can change between blocks (reference fetches
+        sharedConfigFetcher.OrdererConfig() per call)."""
+        self._config_source = config_source
+        self._pending: list[common.Envelope] = []
+        self._pending_bytes = 0
+
+    def ordered(self, env: common.Envelope
+                ) -> tuple[list[list[common.Envelope]], bool]:
+        """Reference `Ordered`: returns (batches, pending). An oversize
+        message is cut into its own batch; a message that would
+        overflow preferred_max_bytes first flushes the pending batch."""
+        cfg = self._config_source()
+        msg_bytes = len(pu.marshal(env))
+        batches: list[list[common.Envelope]] = []
+
+        if msg_bytes > cfg.preferred_max_bytes:
+            logger.debug("message (%dB) larger than preferred (%dB): "
+                         "isolating", msg_bytes, cfg.preferred_max_bytes)
+            if self._pending:
+                batches.append(self._cut())
+            batches.append([env])
+            return batches, False
+
+        if self._pending_bytes + msg_bytes > cfg.preferred_max_bytes:
+            batches.append(self._cut())
+
+        self._pending.append(env)
+        self._pending_bytes += msg_bytes
+        if len(self._pending) >= cfg.max_message_count:
+            batches.append(self._cut())
+        return batches, bool(self._pending)
+
+    def cut(self) -> list[common.Envelope]:
+        """Flush pending (timer fired or config message arrived)."""
+        return self._cut() if self._pending else []
+
+    def _cut(self) -> list[common.Envelope]:
+        batch = self._pending
+        self._pending = []
+        self._pending_bytes = 0
+        return batch
+
+    @property
+    def pending_count(self) -> int:
+        return len(self._pending)

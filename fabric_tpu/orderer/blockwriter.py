@@ -1,0 +1,93 @@
+"""Block assembly + signing on the ordering side.
+
+Rebuild of `orderer/common/multichannel/blockwriter.go`:
+`CreateNextBlock:67` (hash-chain a batch of envelopes into a block) and
+`WriteBlock:168` → `commitBlock:197` → `addBlockSignature:208` (the
+orderer signs (metadata.value ‖ sig_header ‖ block_header_bytes) and
+stores the signature in the SIGNATURES metadata slot — exactly what the
+peer's `VerifyBlock` / `block_signature_set` checks).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from fabric_tpu.protos import common
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("orderer.blockwriter")
+
+
+class BlockWriter:
+    def __init__(self, block_store, signer, last_block=None):
+        """`block_store` is an append-only store exposing
+        `add_block(block)` + `get_block_by_number`; `signer` the
+        orderer's signing identity."""
+        self._store = block_store
+        self._signer = signer
+        self._last = last_block
+        self._lock = threading.Lock()
+
+    @property
+    def last_block(self):
+        return self._last
+
+    def create_next_block(self, envelopes) -> common.Block:
+        """Reference: `CreateNextBlock:67`."""
+        with self._lock:
+            if self._last is None:
+                prev_hash = b""
+                number = 0
+            else:
+                prev_hash = pu.block_header_hash(self._last.header)
+                number = self._last.header.number + 1
+        block = pu.new_block(number, prev_hash)
+        for env in envelopes:
+            block.data.data.append(pu.marshal(env))
+        block.header.data_hash = pu.block_data_hash(block.data)
+        return block
+
+    def write_block(self, block: common.Block,
+                    consenter_metadata: bytes = b"",
+                    last_config_number: int = 0) -> None:
+        """Reference: `WriteBlock:168` + `commitBlock:197`. Signs, then
+        appends to the block store; `self._last` only advances on
+        success so a store failure cannot fork the hash chain.
+        `last_config_number` rides in Metadata.value (the reference's
+        OrdererBlockMetadata.LastConfig) so restarts and onboarding can
+        find the governing config block without a scan."""
+        with self._lock:
+            if self._last is not None and \
+                    block.header.number != self._last.header.number + 1:
+                raise ValueError(
+                    f"writing block {block.header.number} out of order "
+                    f"(last {self._last.header.number})")
+            self._add_metadata(block, consenter_metadata,
+                               last_config_number)
+            self._store.add_block(block)
+            self._last = block
+
+    def _add_metadata(self, block: common.Block,
+                      consenter_metadata: bytes,
+                      last_config_number: int) -> None:
+        """Reference: `addBlockSignature:208` — the signed payload is
+        (metadata.value ‖ signature_header ‖ block_header_bytes)."""
+        sig_header = pu.create_signature_header(
+            self._signer.serialize(), pu.random_nonce())
+        md = common.Metadata()
+        md.value = pu.encode_last_config(last_config_number)
+        ms = md.signatures.add()
+        ms.signature_header = pu.marshal(sig_header)
+        signed_bytes = (md.value + ms.signature_header +
+                        pu.block_header_bytes(block.header))
+        ms.signature = self._signer.sign(signed_bytes)
+        block.metadata.metadata[
+            common.BlockMetadataIndex.SIGNATURES] = pu.marshal(md)
+        block.metadata.metadata[
+            common.BlockMetadataIndex.ORDERER] = consenter_metadata
+        # the slot must exist even on the ordering side (reference
+        # writes an all-zero filter; peers overwrite at validation)
+        n = len(block.data.data)
+        block.metadata.metadata[
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(n)

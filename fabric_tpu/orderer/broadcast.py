@@ -1,0 +1,71 @@
+"""Broadcast ingest: classify → process → order.
+
+Rebuild of `orderer/common/broadcast/broadcast.go:66,135`
+(Handle/ProcessMessage): each envelope is classified, run through the
+channel's msgprocessor (filters + config processing), then handed to
+the consenter chain via Order/Configure.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fabric_tpu.protos import common, orderer as ordpb
+from fabric_tpu.protoutil import protoutil as pu
+from fabric_tpu.orderer import msgprocessor
+
+logger = logging.getLogger("orderer.broadcast")
+
+
+class BroadcastHandler:
+    def __init__(self, registrar):
+        self._registrar = registrar
+
+    def process_message(self, env: common.Envelope
+                        ) -> ordpb.BroadcastResponse:
+        """One envelope in, one status out (the gRPC stream layer maps
+        this 1:1 — reference broadcast.go Handle loop)."""
+        try:
+            ch = pu.get_channel_header(pu.get_payload(env))
+        except Exception as e:
+            return ordpb.BroadcastResponse(
+                status=common.Status.BAD_REQUEST,
+                info=f"malformed envelope: {e}")
+        if not ch.channel_id:
+            return ordpb.BroadcastResponse(
+                status=common.Status.BAD_REQUEST,
+                info="empty channel id")
+        support = self._registrar.get_chain(ch.channel_id)
+        if support is None:
+            return ordpb.BroadcastResponse(
+                status=common.Status.NOT_FOUND,
+                info=f"channel {ch.channel_id} not found")
+        if support.chain.errored():
+            return ordpb.BroadcastResponse(
+                status=common.Status.SERVICE_UNAVAILABLE,
+                info="consenter is in an errored state")
+
+        kind = msgprocessor.classify(ch)
+        try:
+            if kind == msgprocessor.NORMAL:
+                seq = support.processor.process_normal_msg(env)
+                support.chain.order(env, seq)
+            else:
+                if kind == msgprocessor.CONFIG_UPDATE:
+                    wrapped, seq = \
+                        support.processor.process_config_update_msg(env)
+                else:
+                    wrapped, seq = \
+                        support.processor.process_config_msg(env)
+                support.chain.configure(wrapped, seq)
+        except msgprocessor.PermissionDenied as e:
+            return ordpb.BroadcastResponse(
+                status=common.Status.FORBIDDEN, info=str(e))
+        except msgprocessor.MsgProcessorError as e:
+            return ordpb.BroadcastResponse(
+                status=common.Status.BAD_REQUEST, info=str(e))
+        except Exception as e:
+            logger.exception("[%s] broadcast failure", ch.channel_id)
+            return ordpb.BroadcastResponse(
+                status=common.Status.INTERNAL_SERVER_ERROR, info=str(e))
+        return ordpb.BroadcastResponse(status=common.Status.SUCCESS)

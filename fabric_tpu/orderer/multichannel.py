@@ -1,0 +1,283 @@
+"""Multichannel registrar: one ordering chain per channel.
+
+Rebuild of `orderer/common/multichannel/registrar.go:97` — channel
+registry, channel creation from a join-block (channel-participation
+style, no system channel: the reference's 2.x direction), per-channel
+`ChainSupport` binding together config bundle, configtx validator,
+msgprocessor, blockcutter, blockwriter and the consenter chain.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.protos import common, configtx as ctxpb
+from fabric_tpu.protoutil import protoutil as pu
+from fabric_tpu.common.channelconfig import Bundle
+from fabric_tpu.common.configtx import Validator as ConfigTxValidator
+from fabric_tpu.internal.configtxgen import genesis as genesis_mod
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.orderer import blockcutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.orderer.msgprocessor import StandardChannel
+
+logger = logging.getLogger("orderer.multichannel")
+
+
+class OrdererLedger:
+    """The ordering side keeps only the block chain (no state DB) —
+    reference: orderer uses blkstorage directly
+    (`orderer/common/server/main.go` createLedgerFactory). A condition
+    variable lets Deliver block until the next block arrives."""
+
+    def __init__(self, ledger_dir: str):
+        os.makedirs(ledger_dir, exist_ok=True)
+        self._kv = KVStore(os.path.join(ledger_dir, "index.db"))
+        self.block_store = BlockStore(ledger_dir,
+                                      DBHandle(self._kv, "blkindex"))
+        self._cond = threading.Condition()
+
+    @property
+    def height(self) -> int:
+        return self.block_store.height
+
+    def add_block(self, block: common.Block) -> None:
+        self.block_store.add_block(block)
+        with self._cond:
+            self._cond.notify_all()
+
+    def get_block(self, number: int) -> Optional[common.Block]:
+        return self.block_store.get_block_by_number(number)
+
+    def wait_for_block(self, number: int,
+                       timeout: Optional[float] = None) -> bool:
+        """Block until height > number (i.e. block `number` exists)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self.block_store.height > number, timeout)
+
+    def close(self) -> None:
+        self.block_store.close()
+        self._kv.close()
+
+
+
+
+class ChainSupport:
+    """Everything one channel's chain needs (reference:
+    `multichannel/chainsupport.go`). The msgprocessor's `support`
+    duck-type (bundle()/configtx_validator()/signer) is satisfied
+    here."""
+
+    def __init__(self, channel_id: str, ledger: OrdererLedger,
+                 signer, csp, consenter_factory):
+        self.channel_id = channel_id
+        self.ledger = ledger
+        self.signer = signer
+        self._csp = csp
+        self._lock = threading.Lock()
+        self._bundle: Optional[Bundle] = None
+        self._validator: Optional[ConfigTxValidator] = None
+
+        height = ledger.height
+        if height == 0:
+            raise ValueError("chain support requires a bootstrapped "
+                             "ledger (join-block first)")
+        last = ledger.get_block(height - 1)
+        cfg_block = last if pu.is_config_block(last) else \
+            ledger.get_block(pu.get_last_config_index(last))
+        self._apply_config_block(cfg_block)
+        self._last_config_number = cfg_block.header.number
+
+        self.cutter = blockcutter.Receiver(self._batch_config)
+        self.writer = BlockWriter(ledger, signer, last_block=last)
+        self.processor = StandardChannel(channel_id, self)
+        self.chain = consenter_factory(self)
+        logger.info("[%s] chain support up at height %d "
+                    "(consensus=%s)", channel_id, height,
+                    self.bundle().orderer.consensus_type)
+
+    # -- config plumbing --
+
+    def _apply_config_block(self, block: common.Block) -> None:
+        env = pu.extract_envelope(block, 0)
+        payload = pu.get_payload(env)
+        ch = pu.get_channel_header(payload)
+        if ch.type != common.HeaderType.CONFIG:
+            raise ValueError(f"block {block.header.number} is not a "
+                             "config block")
+        if ch.channel_id != self.channel_id:
+            raise ValueError("config block is for channel "
+                             f"{ch.channel_id!r}")
+        cfg_env = ctxpb.ConfigEnvelope()
+        cfg_env.ParseFromString(payload.data)
+        bundle = Bundle(self.channel_id, cfg_env.config, self._csp)
+        if bundle.orderer is None:
+            raise ValueError("config lacks an Orderer section")
+        with self._lock:
+            self._bundle = bundle
+            self._validator = ConfigTxValidator(
+                self.channel_id, cfg_env.config,
+                bundle.policy_manager)
+        logger.info("[%s] config now at sequence %d",
+                    self.channel_id, self._validator.sequence())
+
+    def bundle(self) -> Bundle:
+        with self._lock:
+            return self._bundle
+
+    def configtx_validator(self) -> ConfigTxValidator:
+        with self._lock:
+            return self._validator
+
+    def sequence(self) -> int:
+        return self.configtx_validator().sequence()
+
+    def _batch_config(self) -> blockcutter.BatchConfig:
+        bs = self.bundle().orderer.batch_size
+        return blockcutter.BatchConfig(
+            max_message_count=bs.max_message_count,
+            absolute_max_bytes=bs.absolute_max_bytes,
+            preferred_max_bytes=bs.preferred_max_bytes)
+
+    @property
+    def batch_timeout_s(self) -> float:
+        return self.bundle().orderer.batch_timeout_s
+
+    # -- what consenter chains call to emit blocks --
+
+    def create_next_block(self, envelopes) -> common.Block:
+        return self.writer.create_next_block(envelopes)
+
+    def write_block(self, block: common.Block,
+                    consenter_metadata: bytes = b"") -> None:
+        self.writer.write_block(
+            block, consenter_metadata,
+            last_config_number=self._last_config_number)
+
+    def write_config_block(self, block: common.Block,
+                           consenter_metadata: bytes = b"") -> None:
+        """A committed config block reconfigures the chain before the
+        next message is processed (reference:
+        `chainsupport.go` WriteConfigBlock)."""
+        self.writer.write_block(
+            block, consenter_metadata,
+            last_config_number=block.header.number)
+        self._last_config_number = block.header.number
+        self._apply_config_block(block)
+
+    def halt(self) -> None:
+        self.chain.halt()
+
+
+class Registrar:
+    """Channel registry (reference: `registrar.go:97` NewRegistrar +
+    Initialize). Channels come into being via `join` (channel
+    participation, `orderer/common/channelparticipation`) and are
+    restored from disk on restart."""
+
+    def __init__(self, root_dir: str, signer, csp,
+                 consenters: dict[str, Callable]):
+        self._root = root_dir
+        self._signer = signer
+        self._csp = csp
+        self._consenters = dict(consenters)
+        self._chains: dict[str, ChainSupport] = {}
+        self._lock = threading.Lock()
+        os.makedirs(root_dir, exist_ok=True)
+        for channel_id in sorted(os.listdir(root_dir)):
+            if os.path.isdir(os.path.join(root_dir, channel_id)):
+                try:
+                    self._restore(channel_id)
+                except Exception:
+                    logger.exception("failed to restore channel %s",
+                                     channel_id)
+
+    def _consenter_factory(self):
+        def factory(support: ChainSupport):
+            ctype = support.bundle().orderer.consensus_type
+            maker = self._consenters.get(ctype)
+            if maker is None:
+                raise ValueError(f"no consenter for type {ctype!r}")
+            return maker(support)
+        return factory
+
+    def _restore(self, channel_id: str) -> None:
+        ledger = OrdererLedger(os.path.join(self._root, channel_id))
+        if ledger.height == 0:
+            ledger.close()
+            return
+        try:
+            support = ChainSupport(channel_id, ledger, self._signer,
+                                   self._csp,
+                                   self._consenter_factory())
+        except Exception:
+            ledger.close()
+            raise
+        self._chains[channel_id] = support
+        support.chain.start()
+
+    def join(self, join_block: common.Block) -> ChainSupport:
+        """Channel participation join (reference:
+        `registrar.go` JoinChannel / `channelparticipation`): bootstrap
+        the channel's ledger from a genesis (join) block."""
+        env = pu.extract_envelope(join_block, 0)
+        ch = pu.get_channel_header(pu.get_payload(env))
+        channel_id = ch.channel_id
+        with self._lock:
+            if channel_id in self._chains:
+                raise ValueError(f"channel {channel_id} already exists")
+            if join_block.header.number != 0:
+                raise ValueError("join from non-genesis block not yet "
+                                 "supported (onboarding/follower mode)")
+            # validate the join block BEFORE anything touches disk:
+            # a rejected join must leave no trace so it can be retried
+            # (same contract as ledgermgmt.create's marker protocol)
+            bundle = Bundle(channel_id,
+                            genesis_mod.config_from_block(join_block),
+                            self._csp)
+            if bundle.orderer is None:
+                raise ValueError("join block config lacks an Orderer "
+                                 "section")
+            channel_dir = os.path.join(self._root, channel_id)
+            ledger = OrdererLedger(channel_dir)
+            try:
+                if ledger.height == 0:
+                    ledger.add_block(join_block)
+                support = ChainSupport(channel_id, ledger, self._signer,
+                                       self._csp,
+                                       self._consenter_factory())
+            except Exception:
+                ledger.close()
+                shutil.rmtree(channel_dir, ignore_errors=True)
+                raise
+            self._chains[channel_id] = support
+        support.chain.start()
+        return support
+
+    def remove(self, channel_id: str) -> None:
+        with self._lock:
+            support = self._chains.pop(channel_id, None)
+        if support is not None:
+            support.halt()
+            support.ledger.close()
+
+    def get_chain(self, channel_id: str) -> Optional[ChainSupport]:
+        with self._lock:
+            return self._chains.get(channel_id)
+
+    def channel_list(self) -> list[str]:
+        with self._lock:
+            return sorted(self._chains)
+
+    def halt(self) -> None:
+        with self._lock:
+            chains = list(self._chains.values())
+        for c in chains:
+            c.halt()
+            c.ledger.close()

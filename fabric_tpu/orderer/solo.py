@@ -1,0 +1,139 @@
+"""Solo consenter: single-node ordering for dev/test.
+
+Rebuild of `orderer/consensus/solo/consensus.go` — one goroutine
+(thread) drains a message queue through the blockcutter, arming the
+batch timer while messages are pending; config messages flush pending
+and get their own block. Production deployments use raft
+(`fabric_tpu/orderer/raft`), exactly as in the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from fabric_tpu.protos import common
+from fabric_tpu.orderer.msgprocessor import (
+    CONFIG, CONFIG_UPDATE, MsgProcessorError, classify,
+)
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("orderer.solo")
+
+
+@dataclass
+class _Msg:
+    env: common.Envelope
+    config_seq: int
+    is_config: bool
+
+
+class SoloChain:
+    """consensus.Chain (reference: `orderer/consensus/consensus.go`)."""
+
+    def __init__(self, support):
+        self._support = support
+        self._queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._halted = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- Chain interface --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"solo-{self._support.channel_id}",
+            daemon=True)
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        self._queue.put(None)  # wake the loop
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def order(self, env: common.Envelope, config_seq: int) -> None:
+        """Normal message (reference solo `Order`)."""
+        self._enqueue(_Msg(env, config_seq, is_config=False))
+
+    def configure(self, env: common.Envelope, config_seq: int) -> None:
+        """Config message — already wrapped by the msgprocessor."""
+        self._enqueue(_Msg(env, config_seq, is_config=True))
+
+    def _enqueue(self, msg: _Msg) -> None:
+        if self._halted.is_set():
+            raise MsgProcessorError("chain is halted")
+        self._queue.put(msg)
+
+    def errored(self) -> bool:
+        return self._halted.is_set()
+
+    # -- the loop (reference solo main for/select) --
+
+    def _run(self) -> None:
+        support = self._support
+        timer_deadline: Optional[float] = None
+        while not self._halted.is_set():
+            timeout = None
+            if timer_deadline is not None:
+                timeout = max(0.0, timer_deadline - time.monotonic())
+            try:
+                msg = self._queue.get(timeout=timeout)
+            except queue.Empty:
+                # batch timer fired
+                timer_deadline = None
+                batch = support.cutter.cut()
+                if batch:
+                    block = support.create_next_block(batch)
+                    support.write_block(block)
+                continue
+            if msg is None:
+                break
+            try:
+                if msg.is_config:
+                    timer_deadline = self._process_config(
+                        msg, timer_deadline)
+                else:
+                    timer_deadline = self._process_normal(
+                        msg, timer_deadline)
+            except MsgProcessorError as e:
+                logger.warning("[%s] message dropped during ordering: "
+                               "%s", support.channel_id, e)
+            except Exception:
+                logger.exception("[%s] consenter error",
+                                 support.channel_id)
+
+    def _process_normal(self, msg: _Msg, timer_deadline):
+        support = self._support
+        if msg.config_seq < support.sequence():
+            # config changed since broadcast validated it: revalidate
+            support.processor.process_normal_msg(msg.env)
+        batches, pending = support.cutter.ordered(msg.env)
+        for batch in batches:
+            block = support.create_next_block(batch)
+            support.write_block(block)
+        if not pending:
+            return None
+        if timer_deadline is None:
+            return time.monotonic() + support.batch_timeout_s
+        return timer_deadline
+
+    def _process_config(self, msg: _Msg, timer_deadline):
+        support = self._support
+        env = msg.env
+        if msg.config_seq < support.sequence():
+            env, _seq = support.processor.process_config_msg(env)
+        batch = support.cutter.cut()
+        if batch:
+            support.write_block(support.create_next_block(batch))
+        block = support.create_next_block([env])
+        support.write_config_block(block)
+        return None
+
+
+def consenter(support) -> SoloChain:
+    """Factory for the registrar's consenter map."""
+    return SoloChain(support)

@@ -1,0 +1,3 @@
+"""Peer node assembly (reference: `core/peer` + `internal/peer/node`)."""
+
+from fabric_tpu.peer.peer import Peer, Channel  # noqa: F401

@@ -1,0 +1,107 @@
+"""Deliver client: pull blocks from the ordering service into a channel.
+
+Rebuild of `core/deliverservice/deliveryclient.go` +
+`internal/pkg/peer/blocksprovider/blocksprovider.go:113` DeliverBlocks:
+request a stream from the peer's next block height, verify every block
+(`BlockVerifier.VerifyBlock`, :229), hand it to the channel's
+validate→commit pipeline; reconnect with backoff on stream failure.
+
+The transport is pluggable: `orderer_source()` yields "deliver
+endpoints" — in-process `DeliverHandler`s for single-process networks
+and tests, gRPC stubs in multi-process deployments (same failover
+logic either way, mirroring `internal/pkg/peer/orderers`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from fabric_tpu.protos import common, orderer as ordpb
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("peer.deliverclient")
+
+
+def seek_envelope(channel_id: str, start: int, signer) -> common.Envelope:
+    """Signed SeekInfo from `start` to MAX (reference:
+    blocksprovider.go:286)."""
+    seek = ordpb.SeekInfo()
+    seek.start.specified.number = start
+    seek.stop.specified.number = (1 << 63) - 1
+    seek.behavior = ordpb.SeekInfo.BLOCK_UNTIL_READY
+    ch = pu.make_channel_header(common.HeaderType.DELIVER_SEEK_INFO,
+                                channel_id)
+    sh = pu.create_signature_header(signer.serialize(),
+                                    pu.random_nonce())
+    payload = pu.make_payload(ch, sh, pu.marshal(seek))
+    return pu.sign_or_panic(signer, payload)
+
+
+class Deliverer:
+    """One channel's block puller (reference: blocksprovider
+    Deliverer)."""
+
+    def __init__(self, channel, signer, orderer_source: Callable,
+                 mcs, retry_base_s: float = 0.1,
+                 retry_max_s: float = 10.0):
+        """`orderer_source()` → an object whose `handle(env)` yields
+        DeliverResponse (in-process DeliverHandler or a gRPC
+        adapter)."""
+        self._channel = channel
+        self._signer = signer
+        self._orderer_source = orderer_source
+        self._mcs = mcs
+        self._retry_base_s = retry_base_s
+        self._retry_max_s = retry_max_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"deliver-{self._channel.channel_id}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        failures = 0
+        while not self._stop.is_set():
+            try:
+                endpoint = self._orderer_source()
+                if endpoint is None:
+                    raise ConnectionError("no orderer endpoint")
+                self._pull(endpoint)
+                failures = 0
+            except Exception as e:
+                failures += 1
+                delay = min(self._retry_base_s * (2 ** failures),
+                            self._retry_max_s)
+                logger.warning(
+                    "[%s] deliver stream failed (%s); retry in %.1fs",
+                    self._channel.channel_id, e, delay)
+                self._stop.wait(delay)
+
+    def _pull(self, endpoint) -> None:
+        channel = self._channel
+        start = channel.ledger.height
+        env = seek_envelope(channel.channel_id, start, self._signer)
+        for resp in endpoint.handle(env):
+            if self._stop.is_set():
+                return
+            which = resp.WhichOneof("type")
+            if which == "status":
+                raise ConnectionError(
+                    f"deliver ended with status {resp.status}")
+            block = resp.block
+            # verify BEFORE touching the pipeline
+            # (blocksprovider.go:229)
+            self._mcs.verify_block(channel.channel_id,
+                                   channel.ledger.height, block)
+            channel.process_block(block)

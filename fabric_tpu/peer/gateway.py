@@ -1,0 +1,122 @@
+"""Gateway: the server-side client SDK.
+
+Rebuild of `internal/pkg/gateway/api.go`: `Evaluate:38` (one peer,
+no ordering), `Endorse:127` (collect endorsements satisfying the
+policy), `Submit:402` (broadcast to an orderer), `CommitStatus:472`
+(wait for finality). In-process peers/orderers plug in directly; gRPC
+remotes adapt to the same duck-types.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from fabric_tpu.protos import common, proposal as pb
+from fabric_tpu.protoutil import protoutil as pu, txutils
+
+logger = logging.getLogger("gateway")
+
+
+class GatewayError(Exception):
+    pass
+
+
+@dataclass
+class SubmitResult:
+    tx_id: str
+    status: int
+
+
+class Gateway:
+    def __init__(self, peer, broadcast, signer):
+        """`peer`: the local Peer (endorser + channels); `broadcast`:
+        BroadcastHandler (or gRPC adapter) to the ordering service;
+        `signer`: the gateway's client signing identity."""
+        self._peer = peer
+        self._broadcast = broadcast
+        self._signer = signer
+
+    # -- Evaluate (api.go:38): simulate on one peer, return result --
+
+    def evaluate(self, channel_id: str, cc_name: str,
+                 args: Sequence[bytes],
+                 transient: Optional[dict] = None) -> pb.Response:
+        prop, _tx_id = txutils.create_proposal(
+            channel_id, cc_name, list(args),
+            self._signer.serialize(), transient_map=transient)
+        sp = txutils.sign_proposal(prop, self._signer)
+        resp = self._peer.endorser.process_proposal(sp)
+        return resp.response
+
+    # -- Endorse (api.go:127): collect endorsements --
+
+    def endorse(self, channel_id: str, cc_name: str,
+                args: Sequence[bytes],
+                endorsing_peers: Optional[Sequence] = None,
+                transient: Optional[dict] = None,
+                is_init: bool = False
+                ) -> tuple[common.Envelope, str]:
+        """Returns (signed tx envelope, tx_id). `endorsing_peers`
+        defaults to just the local peer; the discovery-driven layout
+        planner replaces this as discovery lands."""
+        peers = list(endorsing_peers or [self._peer])
+        prop, tx_id = txutils.create_proposal(
+            channel_id, cc_name, list(args),
+            self._signer.serialize(), transient_map=transient,
+            is_init=is_init)
+        sp = txutils.sign_proposal(prop, self._signer)
+        responses = []
+        for peer in peers:
+            resp = peer.endorser.process_proposal(sp)
+            if resp.response.status >= 400:
+                raise GatewayError(
+                    f"endorsement refused by peer: "
+                    f"{resp.response.status} {resp.response.message}")
+            responses.append(resp)
+        env = txutils.create_signed_tx(prop, responses, self._signer)
+        return env, tx_id
+
+    # -- Submit (api.go:402) --
+
+    def submit(self, env: common.Envelope) -> None:
+        resp = self._broadcast.process_message(env)
+        if resp.status != common.Status.SUCCESS:
+            raise GatewayError(
+                f"broadcast failed: {resp.status} {resp.info}")
+
+    # -- CommitStatus (api.go:472) --
+
+    def commit_status(self, channel_id: str, tx_id: str,
+                      timeout_s: float = 10.0) -> int:
+        """Wait until the tx lands in a committed block on the local
+        peer; returns its TxValidationCode."""
+        channel = self._peer.channel(channel_id)
+        if channel is None:
+            raise GatewayError(f"unknown channel {channel_id}")
+        import time
+        deadline = time.monotonic() + timeout_s
+        while True:
+            code = channel.tx_validation_code(tx_id)
+            if code is not None:
+                return code
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise GatewayError(
+                    f"timed out waiting for commit of {tx_id}")
+            channel.wait_for_height(channel.ledger.height + 1,
+                                    min(remaining, 0.5))
+
+    # -- convenience: the full endorse→submit→wait round trip --
+
+    def submit_transaction(self, channel_id: str, cc_name: str,
+                           args: Sequence[bytes],
+                           endorsing_peers: Optional[Sequence] = None,
+                           transient: Optional[dict] = None,
+                           timeout_s: float = 10.0) -> SubmitResult:
+        env, tx_id = self.endorse(channel_id, cc_name, args,
+                                  endorsing_peers, transient)
+        self.submit(env)
+        code = self.commit_status(channel_id, tx_id, timeout_s)
+        return SubmitResult(tx_id=tx_id, status=code)

@@ -1,0 +1,248 @@
+"""The peer singleton and its per-channel resources.
+
+Rebuild of `core/peer/peer.go` (per-channel bundle of ledger, policy
+manager, MSP manager, tx validator — :335-344) and the channel wiring
+part of `internal/peer/node/start.go:189-911`. A `Peer` owns the
+ledger manager, the chaincode runtime, the endorser, and N `Channel`s;
+each `Channel` owns the batched TxValidator + committer and updates its
+config bundle when config blocks commit.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.protos import common, configtx as ctxpb, transaction as txpb
+from fabric_tpu.protoutil import protoutil as pu
+from fabric_tpu.common.channelconfig import Bundle
+from fabric_tpu.common.configtx import Validator as ConfigTxValidator
+from fabric_tpu.internal.configtxgen import genesis as genesis_mod
+from fabric_tpu.core import endorser as endorser_mod
+from fabric_tpu.core.chaincode import ChaincodeDefinition, ChaincodeSupport
+from fabric_tpu.core.committer import LedgerCommitter
+from fabric_tpu.core.txvalidator import TxValidator
+from fabric_tpu.ledger.ledgermgmt import LedgerManager
+from fabric_tpu.peer.mcs import MSPMessageCryptoService
+
+logger = logging.getLogger("peer")
+
+
+class Channel:
+    """Per-channel resources (reference: `core/peer/peer.go` Channel)."""
+
+    def __init__(self, peer: "Peer", channel_id: str, ledger):
+        self.channel_id = channel_id
+        self.ledger = ledger
+        self._peer = peer
+        self._lock = threading.Lock()
+        self._bundle: Optional[Bundle] = None
+        self._definitions: dict[str, ChaincodeDefinition] = {}
+        self._commit_listeners: list[Callable] = []
+        self._commit_cond = threading.Condition()
+
+        cfg_block = self._find_last_config_block()
+        self._apply_config(cfg_block)
+
+        self.validator = TxValidator(
+            channel_id, ledger, self.bundle, peer.csp,
+            self.chaincode_definition,
+            configtx_validator_source=self.configtx_validator)
+        self.committer = LedgerCommitter(
+            ledger, on_config_block=self._on_config_block)
+
+    # -- config --
+
+    def _find_last_config_block(self) -> common.Block:
+        """O(1) via the LAST_CONFIG pointer the orderer stamps into
+        every block's SIGNATURES metadata (protoutil
+        get_last_config_index); linear scan only as a salvage path for
+        chains written before the pointer existed."""
+        height = self.ledger.height
+        tip = self.ledger.block_store.get_block_by_number(height - 1)
+        if tip is not None:
+            if pu.is_config_block(tip):
+                return tip
+            try:
+                cfg = self.ledger.block_store.get_block_by_number(
+                    pu.get_last_config_index(tip))
+                if cfg is not None and pu.is_config_block(cfg):
+                    return cfg
+            except Exception:
+                logger.warning("[%s] last-config pointer unreadable; "
+                               "falling back to scan", self.channel_id)
+        for num in range(height - 1, -1, -1):
+            block = self.ledger.block_store.get_block_by_number(num)
+            if block is not None and pu.is_config_block(block):
+                return block
+        raise ValueError(f"no config block found on {self.channel_id}")
+
+    def _apply_config(self, block: common.Block) -> None:
+        env = pu.extract_envelope(block, 0)
+        payload = pu.get_payload(env)
+        cfg_env = ctxpb.ConfigEnvelope()
+        cfg_env.ParseFromString(payload.data)
+        bundle = Bundle(self.channel_id, cfg_env.config, self._peer.csp)
+        with self._lock:
+            self._bundle = bundle
+            self._configtx_validator = ConfigTxValidator(
+                self.channel_id, cfg_env.config, bundle.policy_manager)
+        logger.info("[%s] channel config applied from block %d",
+                    self.channel_id, block.header.number)
+
+    def _on_config_block(self, block: common.Block) -> None:
+        try:
+            self._apply_config(block)
+        except Exception:
+            logger.exception("[%s] failed to apply config block %d",
+                             self.channel_id, block.header.number)
+            raise
+
+    def bundle(self) -> Bundle:
+        with self._lock:
+            return self._bundle
+
+    def configtx_validator(self) -> ConfigTxValidator:
+        with self._lock:
+            return self._configtx_validator
+
+    # -- chaincode definitions (lifecycle-lite; the state-backed
+    #    _lifecycle SCC replaces this as the source of truth later) --
+
+    def define_chaincode(self, definition: ChaincodeDefinition) -> None:
+        with self._lock:
+            self._definitions[definition.name] = definition
+
+    def chaincode_definition(self, name: str
+                             ) -> Optional[ChaincodeDefinition]:
+        with self._lock:
+            return self._definitions.get(name)
+
+    # -- block intake (what the deliver client calls) --
+
+    def process_block(self, block: common.Block) -> list[int]:
+        """validate (batched) → commit; returns final tx codes.
+        Reference: gossip/state deliverPayloads →
+        coordinator.StoreBlock (SURVEY §3.4)."""
+        flags = self.validator.validate(block)
+        codes = self.committer.commit(block, flags)
+        self._notify_commit(block, codes)
+        return codes
+
+    # -- commit notification (gateway CommitStatus; reference:
+    #    internal/pkg/gateway/commit) --
+
+    def _notify_commit(self, block: common.Block,
+                       codes: list[int]) -> None:
+        events = []
+        for i, env_bytes in enumerate(block.data.data):
+            try:
+                env = pu.unmarshal_envelope(env_bytes)
+                ch = pu.get_channel_header(pu.get_payload(env))
+                if ch.tx_id:
+                    events.append((ch.tx_id, codes[i]))
+            except Exception:
+                continue
+        with self._commit_cond:
+            self._last_committed = block.header.number
+            self._commit_cond.notify_all()
+        for cb in list(self._commit_listeners):
+            try:
+                cb(self.channel_id, block, dict(events))
+            except Exception:
+                logger.exception("commit listener failed")
+
+    def add_commit_listener(self, cb: Callable) -> None:
+        self._commit_listeners.append(cb)
+
+    def wait_for_height(self, height: int,
+                        timeout: Optional[float] = None) -> bool:
+        with self._commit_cond:
+            return self._commit_cond.wait_for(
+                lambda: self.ledger.height >= height, timeout)
+
+    def tx_validation_code(self, tx_id: str) -> Optional[int]:
+        ptx = self.ledger.get_transaction_by_id(tx_id)
+        if ptx is None:
+            return None
+        return ptx.validation_code
+
+    # -- duck-type for the shared DeliverHandler (peer-side deliver
+    #    events service) --
+
+    @property
+    def height(self) -> int:
+        return self.ledger.height
+
+    def get_block(self, number: int):
+        return self.ledger.block_store.get_block_by_number(number)
+
+    def wait_for_block(self, number: int,
+                       timeout: Optional[float] = None) -> bool:
+        return self.wait_for_height(number + 1, timeout)
+
+
+class Peer:
+    """Reference: `core/peer/peer.go` Peer + the wiring in
+    `internal/peer/node/start.go` serve()."""
+
+    def __init__(self, ledger_root: str, local_msp, csp,
+                 metrics_provider=None):
+        self.csp = csp
+        self.local_msp = local_msp
+        self.signer = local_msp.get_default_signing_identity()
+        self.ledger_mgr = LedgerManager(ledger_root,
+                                        metrics_provider=metrics_provider)
+        self.chaincode_support = ChaincodeSupport()
+        self.channels: dict[str, Channel] = {}
+        self._lock = threading.Lock()
+        self.mcs = MSPMessageCryptoService(
+            lambda cid: (self.channels[cid].bundle()
+                         if cid in self.channels else None),
+            local_deserializer=local_msp)
+        self.endorser = endorser_mod.Endorser(
+            self.signer, self.chaincode_support, self._channel_support)
+        # reopen any previously joined channels (start.go:770
+        # peerInstance.Initialize)
+        for channel_id in self.ledger_mgr.ledger_ids():
+            ledger = self.ledger_mgr.open(channel_id)
+            self._register_channel(channel_id, ledger)
+
+    def _register_channel(self, channel_id: str, ledger) -> Channel:
+        channel = Channel(self, channel_id, ledger)
+        with self._lock:
+            self.channels[channel_id] = channel
+        return channel
+
+    def _channel_support(self, channel_id: str
+                         ) -> Optional[endorser_mod.ChannelSupport]:
+        channel = self.channels.get(channel_id)
+        if channel is None:
+            return None
+        bundle = channel.bundle()
+        return endorser_mod.ChannelSupport(
+            ledger=channel.ledger,
+            policy_manager=bundle.policy_manager,
+            deserializer=bundle.msp_manager)
+
+    # -- channel lifecycle (reference: cscc JoinChain →
+    #    peer.CreateChannel, core/peer/channel.go) --
+
+    def join_channel(self, genesis_block: common.Block) -> Channel:
+        cfg = genesis_mod.config_from_block(genesis_block)
+        env = pu.extract_envelope(genesis_block, 0)
+        ch = pu.get_channel_header(pu.get_payload(env))
+        channel_id = ch.channel_id
+        if channel_id in self.channels:
+            raise ValueError(f"already joined {channel_id}")
+        # sanity: the config must parse into a bundle before we commit
+        Bundle(channel_id, cfg, self.csp)
+        ledger = self.ledger_mgr.create(genesis_block, channel_id)
+        return self._register_channel(channel_id, ledger)
+
+    def channel(self, channel_id: str) -> Optional[Channel]:
+        return self.channels.get(channel_id)
+
+    def close(self) -> None:
+        self.ledger_mgr.close()

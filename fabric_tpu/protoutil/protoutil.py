@@ -158,6 +158,42 @@ def get_signature_header(raw: bytes) -> common.SignatureHeader:
     return sh
 
 
+def is_config_block(block: common.Block) -> bool:
+    """True iff the block's first envelope is a CONFIG transaction
+    (reference: `protoutil/blockutils.go` IsConfigBlock). The single
+    shared predicate — committer, ledger, peer and orderer all route
+    here."""
+    if not block.data.data:
+        return False
+    try:
+        env = extract_envelope(block, 0)
+        ch = get_channel_header(get_payload(env))
+        return ch.type == common.HeaderType.CONFIG
+    except Exception:
+        return False
+
+
+def encode_last_config(last_config_index: int) -> bytes:
+    """Metadata.value payload of the SIGNATURES slot: a serialized
+    OrdererBlockMetadata pointing at the governing config block
+    (reference: `protoutil/blockutils.go` — LastConfig folded into the
+    SIGNATURES metadata in Fabric 2.x)."""
+    return common.OrdererBlockMetadata(
+        last_config_index=last_config_index
+    ).SerializeToString(deterministic=True)
+
+
+def get_last_config_index(block: common.Block) -> int:
+    """Read the last-config pointer back out of a committed block.
+    Raises on blocks without the pointer (pre-genesis artifacts)."""
+    md = common.Metadata()
+    md.ParseFromString(
+        block.metadata.metadata[common.BlockMetadataIndex.SIGNATURES])
+    obm = common.OrdererBlockMetadata()
+    obm.ParseFromString(md.value)
+    return obm.last_config_index
+
+
 # ---- signed-data extraction (reference: protoutil/signeddata.go) ----
 
 @dataclass(frozen=True)

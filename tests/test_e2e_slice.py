@@ -1,0 +1,259 @@
+"""End-to-end slice: endorse → order (solo) → deliver → batched
+validate → commit.
+
+The rebuild's first benchmarkable milestone (SURVEY.md §7 step 8):
+a 2-org network, one solo orderer, two in-process peers, an in-process
+KV chaincode, a gateway client. Exercises the entire north-star path
+of SURVEY §3.4 — including ONE batched `verify_batch` per block in the
+txvalidator — and the failure modes (bad endorsement, tampered block,
+duplicate txid).
+
+Reference analog: `integration/e2e/e2e_test.go` under the nwo harness
+(in-process here; the multi-process nwo equivalent comes with the gRPC
+comm layer).
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition
+from fabric_tpu.core.chaincode import shim
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer
+from fabric_tpu.peer.gateway import Gateway, GatewayError
+from fabric_tpu.protos import transaction as txpb
+
+CHANNEL = "testchannel"
+
+
+class KVChaincode(Chaincode):
+    """The e2e asset-transfer-basic analog."""
+
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            stub.set_event("put", params[0].encode())
+            return shim.success()
+        if fn == "get":
+            val = stub.get_state(params[0])
+            if val is None:
+                return shim.error(f"key {params[0]} not found")
+            return shim.success(val)
+        if fn == "transfer":
+            src, dst, amt = params[0], params[1], int(params[2])
+            a = int(stub.get_state(src) or b"0")
+            b = int(stub.get_state(dst) or b"0")
+            if a < amt:
+                return shim.error("insufficient funds")
+            stub.put_state(src, str(a - amt).encode())
+            stub.put_state(dst, str(b + amt).encode())
+            return shim.success()
+        return shim.error(f"unknown function {fn}")
+
+
+@pytest.fixture(scope="module")
+def network(tmp_path_factory):
+    root = tmp_path_factory.mktemp("net")
+    cdir = str(root / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "250ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+    csp = SWProvider()
+
+    def local_msp(msp_dir, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(msp_dir, mspid, csp=csp))
+        return m
+
+    # ---- ordering service ----
+    orderer_msp = local_msp(
+        os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
+        "OrdererMSP")
+    registrar = Registrar(str(root / "orderer"),
+                          orderer_msp.get_default_signing_identity(),
+                          csp, {"solo": solo.consenter})
+    registrar.join(genesis)
+    broadcast = BroadcastHandler(registrar)
+    deliver = DeliverHandler(registrar.get_chain)
+
+    # ---- peers ----
+    peers = {}
+    deliverers = []
+    for org_name, org_dir, mspid in (("org1", org1, "Org1MSP"),
+                                     ("org2", org2, "Org2MSP")):
+        msp = local_msp(
+            os.path.join(org_dir, "peers",
+                         f"peer0.{org_name}.example.com", "msp"),
+            mspid)
+        peer = Peer(str(root / f"peer_{org_name}"), msp, csp)
+        channel = peer.join_channel(genesis)
+        peer.chaincode_support.register("basic", KVChaincode())
+        channel.define_chaincode(ChaincodeDefinition(name="basic"))
+        d = Deliverer(channel, peer.signer, lambda: deliver, peer.mcs)
+        d.start()
+        peers[org_name] = peer
+        deliverers.append(d)
+
+    # ---- gateway client (Org1 user) ----
+    user_msp = local_msp(
+        os.path.join(org1, "users", "User1@org1.example.com", "msp"),
+        "Org1MSP")
+    gateway = Gateway(peers["org1"],
+                      broadcast,
+                      user_msp.get_default_signing_identity())
+
+    yield {
+        "peers": peers, "gateway": gateway, "registrar": registrar,
+        "deliver": deliver, "csp": csp, "genesis": genesis,
+    }
+
+    for d in deliverers:
+        d.stop()
+    registrar.halt()
+    for p in peers.values():
+        p.close()
+
+
+def _both_peers(net):
+    return [net["peers"]["org1"], net["peers"]["org2"]]
+
+
+class TestEndToEnd:
+    def test_submit_and_commit(self, network):
+        gw = network["gateway"]
+        res = gw.submit_transaction(
+            CHANNEL, "basic", [b"put", b"alice", b"100"],
+            endorsing_peers=_both_peers(network))
+        assert res.status == txpb.TxValidationCode.VALID
+
+        # committed state is visible on BOTH peers (org2 got the block
+        # via deliver → batched validate → commit)
+        for peer in _both_peers(network):
+            ch = peer.channel(CHANNEL)
+            assert ch.ledger.get_state("basic", "alice") == b"100"
+
+    def test_evaluate_reads_committed_state(self, network):
+        gw = network["gateway"]
+        gw.submit_transaction(CHANNEL, "basic",
+                              [b"put", b"bob", b"42"],
+                              endorsing_peers=_both_peers(network))
+        resp = gw.evaluate(CHANNEL, "basic", [b"get", b"bob"])
+        assert resp.status == 200
+        assert resp.payload == b"42"
+
+    def test_transfer_chain(self, network):
+        gw = network["gateway"]
+        gw.submit_transaction(CHANNEL, "basic",
+                              [b"put", b"carol", b"50"],
+                              endorsing_peers=_both_peers(network))
+        res = gw.submit_transaction(
+            CHANNEL, "basic", [b"transfer", b"alice", b"carol", b"30"],
+            endorsing_peers=_both_peers(network))
+        assert res.status == txpb.TxValidationCode.VALID
+        ch = network["peers"]["org2"].channel(CHANNEL)
+        assert ch.ledger.get_state("basic", "alice") == b"70"
+        assert ch.ledger.get_state("basic", "carol") == b"80"
+
+    def test_single_org_endorsement_fails_majority_policy(self, network):
+        """2-of-2 MAJORITY endorsement: one org's endorsement must be
+        rejected at validation (ENDORSEMENT_POLICY_FAILURE), not at
+        endorsement time — exactly the reference's VSCC behavior."""
+        gw = network["gateway"]
+        env, tx_id = gw.endorse(
+            CHANNEL, "basic", [b"put", b"mallory", b"1"],
+            endorsing_peers=[network["peers"]["org1"]])
+        gw.submit(env)
+        code = gw.commit_status(CHANNEL, tx_id, timeout_s=10)
+        assert code == txpb.TxValidationCode.ENDORSEMENT_POLICY_FAILURE
+        ch = network["peers"]["org1"].channel(CHANNEL)
+        assert ch.ledger.get_state("basic", "mallory") is None
+
+    def test_chaincode_error_refuses_endorsement(self, network):
+        gw = network["gateway"]
+        with pytest.raises(GatewayError, match="endorsement refused"):
+            gw.endorse(CHANNEL, "basic",
+                       [b"transfer", b"nobody", b"alice", b"999"],
+                       endorsing_peers=_both_peers(network))
+
+    def test_mvcc_conflict_between_racing_txs(self, network):
+        """Two txs reading the same key in one block: the second gets
+        MVCC_READ_CONFLICT (reference txmgmt/validation semantics)."""
+        gw = network["gateway"]
+        gw.submit_transaction(CHANNEL, "basic",
+                              [b"put", b"race", b"1"],
+                              endorsing_peers=_both_peers(network))
+        env1, tx1 = gw.endorse(CHANNEL, "basic",
+                               [b"transfer", b"race", b"alice", b"1"],
+                               endorsing_peers=_both_peers(network))
+        env2, tx2 = gw.endorse(CHANNEL, "basic",
+                               [b"transfer", b"race", b"bob", b"1"],
+                               endorsing_peers=_both_peers(network))
+        gw.submit(env1)
+        gw.submit(env2)
+        c1 = gw.commit_status(CHANNEL, tx1, timeout_s=10)
+        c2 = gw.commit_status(CHANNEL, tx2, timeout_s=10)
+        assert sorted([c1, c2]) == sorted(
+            [txpb.TxValidationCode.VALID,
+             txpb.TxValidationCode.MVCC_READ_CONFLICT])
+
+    def test_deliver_rejects_unauthorized_seeker(self, network, tmp_path):
+        """An identity from outside the channel's MSPs must get
+        FORBIDDEN from the deliver service (Readers policy)."""
+        from fabric_tpu.peer.deliverclient import seek_envelope
+        outsider_dir = cryptogen.generate_org(
+            str(tmp_path), "evil.example.com", n_peers=1)
+        csp = network["csp"]
+        msp = X509MSP(csp)
+        msp.setup(msp_config_from_dir(
+            os.path.join(outsider_dir, "peers",
+                         "peer0.evil.example.com", "msp"),
+            "EvilMSP", csp=csp))
+        env = seek_envelope(CHANNEL, 0,
+                            msp.get_default_signing_identity())
+        responses = list(network["deliver"].handle(env))
+        assert len(responses) == 1
+        from fabric_tpu.protos import common
+        assert responses[0].status == common.Status.FORBIDDEN
