@@ -161,6 +161,17 @@ def _both_peers(net):
     return [net["peers"]["org1"], net["peers"]["org2"]]
 
 
+def _sync(net, timeout_s=10.0):
+    """Wait until every peer's channel has caught up to the tallest
+    ledger (commit_status only proves finality on the gateway's local
+    peer; other peers commit via their own deliverers)."""
+    chans = [p.channel(CHANNEL) for p in _both_peers(net)]
+    target = max(ch.ledger.height for ch in chans)
+    for ch in chans:
+        assert ch.wait_for_height(target, timeout_s), (
+            f"peer stuck at height {ch.ledger.height} < {target}")
+
+
 class TestEndToEnd:
     def test_submit_and_commit(self, network):
         gw = network["gateway"]
@@ -171,6 +182,7 @@ class TestEndToEnd:
 
         # committed state is visible on BOTH peers (org2 got the block
         # via deliver → batched validate → commit)
+        _sync(network)
         for peer in _both_peers(network):
             ch = peer.channel(CHANNEL)
             assert ch.ledger.get_state("basic", "alice") == b"100"
@@ -193,6 +205,7 @@ class TestEndToEnd:
             CHANNEL, "basic", [b"transfer", b"alice", b"carol", b"30"],
             endorsing_peers=_both_peers(network))
         assert res.status == txpb.TxValidationCode.VALID
+        _sync(network)
         ch = network["peers"]["org2"].channel(CHANNEL)
         assert ch.ledger.get_state("basic", "alice") == b"70"
         assert ch.ledger.get_state("basic", "carol") == b"80"
