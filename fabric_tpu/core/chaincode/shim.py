@@ -116,15 +116,22 @@ class ChaincodeStub:
 
     # -- private data --
 
+    def _pvt_sim(self):
+        if not hasattr(self._sim, "get_private_data"):
+            raise NotImplementedError(
+                "private data collections require a pvtdata-enabled "
+                "simulator (TxSimulator without pvtdata support)")
+        return self._sim
+
     def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
-        return self._sim.get_private_data(self._ns, collection, key)
+        return self._pvt_sim().get_private_data(self._ns, collection, key)
 
     def put_private_data(self, collection: str, key: str,
                          value: bytes) -> None:
-        self._sim.put_private_data(self._ns, collection, key, value)
+        self._pvt_sim().put_private_data(self._ns, collection, key, value)
 
     def del_private_data(self, collection: str, key: str) -> None:
-        self._sim.del_private_data(self._ns, collection, key)
+        self._pvt_sim().del_private_data(self._ns, collection, key)
 
     # -- events --
 

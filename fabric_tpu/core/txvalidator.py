@@ -131,7 +131,15 @@ class TxValidator:
         validator = self._configtx_validator_source()
         if cfg_env.config.sequence == validator.sequence():
             # re-delivery of the current config (e.g. catch-up replay)
-            return TVC.VALID
+            # — only if it IS the current config, byte for byte; an
+            # equal-sequence config with different contents is exactly
+            # the rogue-orderer push this replay defends against
+            if pu.marshal(cfg_env.config) == pu.marshal(validator.config):
+                return TVC.VALID
+            logger.warning("tx[%d] config tx repeats sequence %d with "
+                           "different contents", index,
+                           validator.sequence())
+            return TVC.INVALID_CONFIG_TRANSACTION
         if not cfg_env.last_update:
             logger.warning("tx[%d] config tx lacks its originating "
                            "update", index)
@@ -264,6 +272,13 @@ class TxValidator:
                 continue
             codes[c.index] = TVC.VALID
 
+        # init-extend metadata first (reference protoutil.CopyBlockMetadata
+        # semantics): a block from a rogue orderer may arrive with no
+        # metadata slots at all, and that must invalidate txs, not crash
+        # the deliverer
+        while len(block.metadata.metadata) <= \
+                common.BlockMetadataIndex.TRANSACTIONS_FILTER:
+            block.metadata.metadata.append(b"")
         block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(codes)
         logger.info("[%s] validated block [%d] in %.0fms (%d txs, "

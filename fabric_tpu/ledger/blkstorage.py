@@ -150,6 +150,7 @@ class BlockStore:
                   struct.pack(">Q", block.header.number))
         filt = block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER]
+        seen_txids: set[bytes] = set()
         for i, env_bytes in enumerate(block.data.data):
             try:
                 env = pu.unmarshal_envelope(env_bytes)
@@ -160,7 +161,14 @@ class BlockStore:
                 continue
             code = filt[i] if i < len(filt) else \
                 txpb.TxValidationCode.NOT_VALIDATED
-            batch.put(b"t" + ch.tx_id.encode(),
+            # first occurrence wins (reference blkstorage keeps the
+            # original tx's entry; a later DUPLICATE_TXID replay must
+            # not clobber the VALID tx's recorded validation code)
+            tkey = b"t" + ch.tx_id.encode()
+            if tkey in seen_txids or self._index.get(tkey) is not None:
+                continue
+            seen_txids.add(tkey)
+            batch.put(tkey,
                       struct.pack(">QIB", block.header.number, i, code))
         batch.put(_CHECKPOINT,
                   struct.pack(">IQQ", suffix, end_offset,

@@ -245,6 +245,10 @@ class Registrar:
                 raise ValueError("join block config lacks an Orderer "
                                  "section")
             channel_dir = os.path.join(self._root, channel_id)
+            # only a join that CREATES the ledger may clean it up on
+            # failure; a pre-existing dir (e.g. startup _restore failed
+            # and the operator retries) holds a chain we must not wipe
+            created = not os.path.isdir(channel_dir)
             ledger = OrdererLedger(channel_dir)
             try:
                 if ledger.height == 0:
@@ -254,7 +258,8 @@ class Registrar:
                                        self._consenter_factory())
             except Exception:
                 ledger.close()
-                shutil.rmtree(channel_dir, ignore_errors=True)
+                if created:
+                    shutil.rmtree(channel_dir, ignore_errors=True)
                 raise
             self._chains[channel_id] = support
         support.chain.start()
