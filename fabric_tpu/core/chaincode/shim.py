@@ -126,6 +126,12 @@ class ChaincodeStub:
     def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
         return self._pvt_sim().get_private_data(self._ns, collection, key)
 
+    def get_private_data_hash(self, collection: str, key: str
+                              ) -> Optional[bytes]:
+        """Readable by non-members (reference GetPrivateDataHash)."""
+        return self._pvt_sim().get_private_data_hash(
+            self._ns, collection, key)
+
     def put_private_data(self, collection: str, key: str,
                          value: bytes) -> None:
         self._pvt_sim().put_private_data(self._ns, collection, key, value)

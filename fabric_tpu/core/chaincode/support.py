@@ -34,12 +34,20 @@ class ExecuteError(Exception):
 class ChaincodeDefinition:
     """What `_lifecycle` tracks per committed chaincode (reference:
     `core/chaincode/lifecycle/lifecycle.go` ChaincodeDefinition):
-    name, sequence, version, endorsement-policy bytes."""
+    name, sequence, version, endorsement-policy bytes, private-data
+    collection configs."""
     name: str
     version: str = "1.0"
     sequence: int = 1
     endorsement_policy: bytes = b""   # marshaled ApplicationPolicy; empty = channel default
     init_required: bool = False
+    collections: tuple = ()           # CollectionConfig, ordered
+
+    def collection(self, name: str):
+        for c in self.collections:
+            if c.name == name:
+                return c
+        return None
 
 
 class ChaincodeSupport:

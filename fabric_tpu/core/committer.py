@@ -24,7 +24,8 @@ class LedgerCommitter:
         self._on_config_block = on_config_block
 
     def commit(self, block: common.Block,
-               flags: Optional[Sequence[int]] = None) -> list[int]:
+               flags: Optional[Sequence[int]] = None,
+               pvt_data: Optional[dict] = None) -> list[int]:
         if self._on_config_block is not None and \
                 pu.is_config_block(block):
             # adopt the config only if the validator accepted it
@@ -37,7 +38,8 @@ class LedgerCommitter:
                 logger.warning("config block [%d] rejected by "
                                "validation (code %s); not adopting",
                                block.header.number, flags[0])
-        return self._ledger.commit_block(block, flags)
+        return self._ledger.commit_block(block, flags,
+                                         pvt_data=pvt_data)
 
     def height(self) -> int:
         return self._ledger.height

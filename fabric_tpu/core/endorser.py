@@ -31,6 +31,7 @@ class ChannelSupport:
     ledger: object          # KVLedger: new_tx_simulator, get_transaction_by_id
     policy_manager: object  # policies.Manager
     deserializer: object    # msp manager for the channel
+    transient_store: object = None  # TransientStore (pvt distribution)
 
 
 def _error_response(status: int, message: str) -> pb.ProposalResponse:
@@ -107,6 +108,19 @@ class Endorser:
 
         results = pu.marshal(sim.get_tx_simulation_results())
         events = pu.marshal(event) if event is not None else b""
+
+        # private writes: the cleartext NEVER enters the proposal
+        # response — it is parked in the transient store (and, with
+        # gossip, pushed to authorized peers) until commit
+        # (reference endorser.go:234 DistributePrivateData)
+        pvt_results = sim.get_private_simulation_results()
+        if pvt_results is not None:
+            if support.transient_store is None:
+                return _error_response(
+                    500, "private data written but this peer has no "
+                         "transient store")
+            support.transient_store.persist(
+                up.tx_id, support.ledger.height, pvt_results)
 
         # -- endorse (default plugin, inlined) --
         return txutils.create_proposal_response(

@@ -1,0 +1,88 @@
+"""Transient store: endorsement-time private data, purged by height.
+
+Rebuild of `core/transientstore/store.go`: when a peer endorses a tx
+with private writes, the cleartext TxPvtReadWriteSet is parked here
+(keyed by tx id + the block height at endorsement time) until the tx
+commits — at which point the committer reads it back — or until it goes
+stale and is purged by height. Backed by the same embedded ordered KV
+store as the ledger (the reference uses a dedicated leveldb).
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.protos import rwset as rwpb
+
+_BY_TXID = b"t"    # t + txid + 0x00 + pack(height) -> TxPvtReadWriteSet
+_BY_HEIGHT = b"h"  # h + pack(height) + txid -> b""
+
+
+class TransientStore:
+    def __init__(self, path: str):
+        self._kv = KVStore(path)
+        self._db = DBHandle(self._kv, "transient")
+        self._lock = threading.Lock()
+
+    def persist(self, tx_id: str, endorsement_height: int,
+                pvt: rwpb.TxPvtReadWriteSet) -> None:
+        """Reference: Store.Persist — idempotent per (txid, height)."""
+        hb = struct.pack(">Q", endorsement_height)
+        batch = self._db.new_batch()
+        batch.put(_BY_TXID + tx_id.encode() + b"\x00" + hb,
+                  pvt.SerializeToString(deterministic=True))
+        batch.put(_BY_HEIGHT + hb + tx_id.encode(), b"")
+        self._db.write_batch(batch)
+
+    def get(self, tx_id: str) -> Optional[rwpb.TxPvtReadWriteSet]:
+        """Latest entry for the tx id (reference returns an iterator of
+        all endorsements; one entry per height suffices here — peers
+        re-endorse at a later height under a fresh key)."""
+        prefix = _BY_TXID + tx_id.encode() + b"\x00"
+        latest = None
+        for _k, v in self._db.iterate(start=prefix,
+                                      end=prefix + b"\xff"):
+            latest = v
+        if latest is None:
+            return None
+        pvt = rwpb.TxPvtReadWriteSet()
+        pvt.ParseFromString(latest)
+        return pvt
+
+    def purge_by_txids(self, tx_ids: list[str]) -> None:
+        """Reference: PurgeByTxids — called after the txs commit."""
+        batch = self._db.new_batch()
+        for tx_id in tx_ids:
+            prefix = _BY_TXID + tx_id.encode() + b"\x00"
+            for k, _v in self._db.iterate(start=prefix,
+                                          end=prefix + b"\xff"):
+                batch.delete(k)
+                hb = k[len(prefix):]
+                batch.delete(_BY_HEIGHT + hb + tx_id.encode())
+        if batch.ops:
+            self._db.write_batch(batch)
+
+    def purge_below_height(self, height: int) -> None:
+        """Reference: PurgeBelowHeight — drop entries endorsed before
+        `height` (their txs either committed long ago or never will)."""
+        end = _BY_HEIGHT + struct.pack(">Q", height)
+        batch = self._db.new_batch()
+        for k, _v in self._db.iterate(start=_BY_HEIGHT, end=end):
+            hb = k[1:9]
+            tx_id = k[9:]
+            batch.delete(k)
+            batch.delete(_BY_TXID + tx_id + b"\x00" + hb)
+        if batch.ops:
+            self._db.write_batch(batch)
+
+    def min_height(self) -> Optional[int]:
+        for k, _v in self._db.iterate(start=_BY_HEIGHT,
+                                      end=_BY_HEIGHT + b"\xff"):
+            return struct.unpack(">Q", k[1:9])[0]
+        return None
+
+    def close(self) -> None:
+        self._kv.close()

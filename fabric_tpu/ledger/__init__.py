@@ -2,3 +2,7 @@ from fabric_tpu.ledger.kvledger import KVLedger, LedgerError
 from fabric_tpu.ledger.ledgermgmt import LedgerManager
 
 __all__ = ["KVLedger", "LedgerError", "LedgerManager"]
+
+from fabric_tpu.ledger.pvtdata import CollectionConfig  # noqa: F401,E402
+
+__all__.append("CollectionConfig")

@@ -13,11 +13,12 @@ from __future__ import annotations
 import hashlib
 import os
 import time
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 from fabric_tpu import protoutil as pu
 from fabric_tpu.common import metrics as metrics_mod
 from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.ledger import pvtdata as pvt
 from fabric_tpu.ledger.blkstorage import BlockStore
 from fabric_tpu.ledger.history import HistoryDB
 from fabric_tpu.ledger.kvdb import DBHandle, KVStore
@@ -58,6 +59,13 @@ class KVLedger:
         self.state_db = StateDB(DBHandle(self._kv, "statedb"))
         self.history_db = HistoryDB(DBHandle(self._kv, "historydb"))
         self.txmgr = TxMgr(self.state_db)
+        self.pvt_store = pvt.PvtDataStore(DBHandle(self._kv, "pvtstore"))
+        # (ns, coll) -> CollectionConfig | None; wired by the channel
+        # from its chaincode definitions (the reference resolves this
+        # through confighistory at commit time)
+        self._collection_info: Callable[[str, str],
+                                        Optional[pvt.CollectionConfig]] \
+            = lambda ns, coll: None
 
         provider = metrics_provider or metrics_mod.DisabledProvider()
         hopts = lambda name: metrics_mod.HistogramOpts(  # noqa: E731
@@ -126,6 +134,27 @@ class KVLedger:
     def get_transaction_by_id(self, tx_id: str):
         return self.block_store.get_tx_by_id(tx_id)
 
+    def set_collection_info_source(self, fn) -> None:
+        self._collection_info = fn
+
+    def get_private_data(self, ns: str, coll: str, key: str
+                         ) -> Optional[bytes]:
+        vv = self.state_db.get_state(pvt.pvt_ns(ns, coll), key)
+        return vv.value if vv else None
+
+    def get_private_data_hash(self, ns: str, coll: str, key: str
+                              ) -> Optional[bytes]:
+        vv = self.state_db.get_state(
+            pvt.hash_ns(ns, coll),
+            pvt.hashed_key_str(pvt.key_hash(key)))
+        return vv.value if vv else None
+
+    def get_pvt_data_by_num(self, block_num: int, tx_num: int):
+        return self.pvt_store.get_pvt_data(block_num, tx_num)
+
+    def missing_pvt_data(self, max_entries: int = 0):
+        return self.pvt_store.get_missing(max_entries)
+
     def get_history_for_key(self, ns: str, key: str):
         return self.history_db.get_history_for_key(
             self.block_store, ns, key)
@@ -133,10 +162,13 @@ class KVLedger:
     # -- commit --
 
     def commit_block(self, block: common.Block,
-                     flags: Optional[Sequence[int]] = None) -> list[int]:
+                     flags: Optional[Sequence[int]] = None,
+                     pvt_data: Optional[dict] = None) -> list[int]:
         """The commit pipeline. `flags` carries upstream validation
         results (sig/policy failures from the txvalidator); MVCC runs
-        here. Returns final per-tx validation codes."""
+        here. `pvt_data` maps tx_num → TxPvtReadWriteSet (cleartext the
+        peer holds — from its transient store or gossip pull). Returns
+        final per-tx validation codes."""
         t0 = time.perf_counter()
         n = len(block.data.data)
         block_num = block.header.number
@@ -151,6 +183,8 @@ class KVLedger:
             codes, batch = self.txmgr.validate_and_prepare(
                 block_num, rwsets,
                 list(flags) if flags else None)
+            self._commit_pvt_data(block_num, rwsets, codes,
+                                  pvt_data or {}, batch)
 
         # TRANSACTIONS_FILTER: one code byte per tx
         block.metadata.metadata[
@@ -177,6 +211,10 @@ class KVLedger:
             self.history_db.commit_block(block, codes)
             self.state_db.apply_updates(batch,
                                         Height(block_num, max(n - 1, 0)))
+            # bookkeeping for purged entries is dropped only AFTER the
+            # state deletes are durable: a crash in between re-purges
+            # (idempotent) on the next commit instead of leaking keys
+            self._drop_expired_bookkeeping(block_num)
         else:
             # config/genesis blocks still advance the savepoint
             self.state_db.apply_updates(UpdateBatch(),
@@ -197,11 +235,14 @@ class KVLedger:
 
     def _apply_block_to_state(self, block: common.Block) -> None:
         """Recovery path: re-run MVCC for an already-stored block using
-        its recorded TRANSACTIONS_FILTER as upstream flags."""
+        its recorded TRANSACTIONS_FILTER as upstream flags. Private
+        cleartext is replayed from the pvt store (written before the
+        state apply, so it survives the crash being recovered from)."""
         if self._is_config_block(block) or block.header.number == 0:
             self.state_db.apply_updates(
                 UpdateBatch(), Height(block.header.number, 0))
             return
+        block_num = block.header.number
         filt = block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER]
         rwsets = [extract_tx_rwset(e) for e in block.data.data]
@@ -210,12 +251,147 @@ class KVLedger:
             for i in range(len(rwsets))
         ]
         codes, batch = self.txmgr.validate_and_prepare(
-            block.header.number, rwsets, flags)
+            block_num, rwsets, flags)
+        pvt_data = {}
+        for tx_num in range(len(rwsets)):
+            stored = self.pvt_store.get_pvt_data(block_num, tx_num)
+            if stored is not None:
+                pvt_data[tx_num] = stored
+        self._commit_pvt_data(block_num, rwsets, codes, pvt_data, batch)
         # same history-before-savepoint ordering as commit_block
         self.history_db.commit_block(block, codes)
         self.state_db.apply_updates(
-            batch, Height(block.header.number,
-                          max(len(rwsets) - 1, 0)))
+            batch, Height(block_num, max(len(rwsets) - 1, 0)))
+        self._drop_expired_bookkeeping(block_num)
+
+    # -- private data commit (reference: commitToPvtAndBlockStore +
+    #    pvtdatastorage Commit + expiry keeper) --
+
+    def _commit_pvt_data(self, block_num: int, rwsets, codes: list[int],
+                         pvt_data: dict, batch: UpdateBatch) -> None:
+        """Verify supplied cleartext against the on-chain hashes, apply
+        it to the private namespaces, persist it to the pvt store,
+        record missing collections + BTL expiry, and fold purges of
+        already-expired keys into `batch`."""
+        store_batch = self.pvt_store._db.new_batch()
+        accepted: dict[int, rwpb.TxPvtReadWriteSet] = {}
+        missing: list[pvt.MissingPvtData] = []
+        expiry: dict[int, list] = {}   # expiry_block -> entries
+
+        for tx_num, txrw in enumerate(rwsets):
+            if txrw is None or \
+                    codes[tx_num] != txpb.TxValidationCode.VALID:
+                continue
+            supplied = self._index_supplied_pvt(pvt_data.get(tx_num))
+            kept = rwpb.TxPvtReadWriteSet(
+                data_model=rwpb.TxReadWriteSet.KV)
+            for nsrw in txrw.ns_rwset:
+                ns_kept = None
+                for chrw in nsrw.collection_hashed_rwset:
+                    hset = rwpb.HashedRWSet()
+                    hset.ParseFromString(chrw.rwset)
+                    if not hset.hashed_writes:
+                        continue   # read-only: no cleartext to commit
+                    coll = chrw.collection_name
+                    raw = supplied.get((nsrw.namespace, coll))
+                    if raw is None or pvt.pvt_rwset_hash(raw) != \
+                            chrw.pvt_rwset_hash:
+                        if raw is not None:
+                            logger.warning(
+                                "[%s] pvt data for tx %d [%s/%s] does "
+                                "not match its on-chain hash; treating "
+                                "as missing", self.ledger_id, tx_num,
+                                nsrw.namespace, coll)
+                        missing.append(pvt.MissingPvtData(
+                            block_num, tx_num, nsrw.namespace, coll))
+                        self._record_expiry_hashes(
+                            expiry, block_num, nsrw.namespace, coll,
+                            hset)
+                        continue
+                    self._apply_pvt_writes(
+                        batch, expiry, block_num,
+                        Height(block_num, tx_num),
+                        nsrw.namespace, coll, raw, hset)
+                    if ns_kept is None:
+                        ns_kept = kept.ns_pvt_rwset.add(
+                            namespace=nsrw.namespace)
+                    ns_kept.collection_pvt_rwset.add(
+                        collection_name=coll, rwset=raw)
+            if kept.ns_pvt_rwset:
+                accepted[tx_num] = kept
+
+        self.pvt_store.prepare_batch(store_batch, block_num, accepted,
+                                     missing)
+        for exp_block in sorted(expiry):
+            self.pvt_store.record_expiry(store_batch, exp_block,
+                                         block_num, expiry[exp_block])
+        if store_batch.ops:
+            self.pvt_store._db.write_batch(store_batch)
+
+        # fold purges of entries that expire AT this block into the
+        # state batch (reference: PurgeExpiredData during commit)
+        for _raw_key, entries in self.pvt_store.expired_entries(
+                block_num):
+            h = Height(block_num, 0)
+            for ns, coll, key, kh in entries:
+                batch.delete(pvt.hash_ns(ns, coll),
+                             pvt.hashed_key_str(kh), h)
+                if key:
+                    batch.delete(pvt.pvt_ns(ns, coll), key, h)
+
+    @staticmethod
+    def _index_supplied_pvt(txpvt) -> dict:
+        out = {}
+        if txpvt is None:
+            return out
+        for nspvt in txpvt.ns_pvt_rwset:
+            for cpvt in nspvt.collection_pvt_rwset:
+                out[(nspvt.namespace, cpvt.collection_name)] = cpvt.rwset
+        return out
+
+    def _btl(self, ns: str, coll: str) -> int:
+        cfg = self._collection_info(ns, coll)
+        return cfg.block_to_live if cfg else 0
+
+    def _record_expiry_hashes(self, expiry: dict, block_num: int,
+                              ns: str, coll: str, hset) -> None:
+        """Missing-cleartext case: the hashes still expire on schedule."""
+        btl = self._btl(ns, coll)
+        if not btl:
+            return
+        entries = expiry.setdefault(block_num + btl + 1, [])
+        for hw in hset.hashed_writes:
+            entries.append((ns, coll, "", hw.key_hash))
+
+    def _apply_pvt_writes(self, batch: UpdateBatch, expiry: dict,
+                          block_num: int, height: Height, ns: str,
+                          coll: str, raw: bytes, hset) -> None:
+        kv = rwpb.KVRWSet()
+        kv.ParseFromString(raw)
+        pns = pvt.pvt_ns(ns, coll)
+        btl = self._btl(ns, coll)
+        entries = expiry.setdefault(block_num + btl + 1, []) if btl \
+            else None
+        hashes = {pvt.key_hash(w.key): w for w in kv.writes}
+        for w in kv.writes:
+            if w.is_delete:
+                batch.delete(pns, w.key, height)
+            else:
+                batch.put(pns, w.key, w.value, height)
+        if entries is not None:
+            for hw in hset.hashed_writes:
+                w = hashes.get(hw.key_hash)
+                entries.append((ns, coll, w.key if w else "",
+                                hw.key_hash))
+
+    def _drop_expired_bookkeeping(self, block_num: int) -> None:
+        expired = self.pvt_store.expired_entries(block_num)
+        if not expired:
+            return
+        store_batch = self.pvt_store._db.new_batch()
+        for raw_key, _entries in expired:
+            self.pvt_store.drop_expiry_key(store_batch, raw_key)
+        self.pvt_store._db.write_batch(store_batch)
 
     @staticmethod
     def _is_config_block(block: common.Block) -> bool:

@@ -1,0 +1,197 @@
+"""Private data collections: config, hashing, committed pvtdata store.
+
+Rebuild of the reference's private-data ledger machinery
+(SURVEY.md §2.5): collection configs (`core/common/privdata`),
+the "DB-of-DBs" namespace scheme of
+`core/ledger/kvledger/txmgmt/privacyenabledstate/` (public, private
+`ns$$p<coll>`, hashed `ns$$h<coll>` sections of one versioned state DB)
+and the committed private-data store with BTL expiry + missing-data
+bookkeeping (`core/ledger/pvtdatastorage/*.go`).
+
+Semantics preserved from the reference:
+- only SHA-256 hashes of private keys/values go on-chain (in the public
+  rwset's `collection_hashed_rwset`); cleartext lives off-chain in the
+  private section and in the pvtdata store;
+- MVCC runs over the HASHED reads (deterministic on every peer, with or
+  without the cleartext);
+- a valid tx whose cleartext is missing still commits its hashed writes;
+  the gap is recorded for reconciliation;
+- `block_to_live` (BTL) purges cleartext AND hashes `btl` blocks after
+  the write (`pvtdatastorage/expiry_keeper.go`); 0 = never.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from fabric_tpu.ledger.kvdb import DBHandle
+from fabric_tpu.protos import rwset as rwpb
+
+
+@dataclass
+class CollectionConfig:
+    """Reference: `StaticCollectionConfig` proto
+    (`core/common/privdata/collection.go`)."""
+    name: str
+    member_orgs: tuple[str, ...] = ()     # MSP IDs allowed the cleartext
+    required_peer_count: int = 0
+    maximum_peer_count: int = 1
+    block_to_live: int = 0                # 0 = never expire
+    member_only_read: bool = True
+    member_only_write: bool = True
+
+
+# -- namespace scheme (privacyenabledstate/common_storage_db.go) --
+
+def pvt_ns(ns: str, coll: str) -> str:
+    return f"{ns}$$p${coll}"
+
+
+def hash_ns(ns: str, coll: str) -> str:
+    return f"{ns}$$h${coll}"
+
+
+def key_hash(key: str) -> bytes:
+    return hashlib.sha256(key.encode()).digest()
+
+
+def value_hash(value: bytes) -> bytes:
+    return hashlib.sha256(value).digest()
+
+
+def hashed_key_str(kh: bytes) -> str:
+    """Hashed-namespace keys are hex strings (the state DB keyspace is
+    str; the reference stores raw hash bytes in leveldb)."""
+    return kh.hex()
+
+
+def pvt_rwset_hash(coll_rwset_bytes: bytes) -> bytes:
+    """Hash binding the cleartext collection rwset to the on-chain
+    hashed rwset (reference: rwsetutil CollPvtRwSet hash)."""
+    return hashlib.sha256(coll_rwset_bytes).digest()
+
+
+def collections_of(txrw: rwpb.TxReadWriteSet) -> list[tuple[str, str]]:
+    """(namespace, collection) pairs a public rwset commits hashes for."""
+    out = []
+    for nsrw in txrw.ns_rwset:
+        for chrw in nsrw.collection_hashed_rwset:
+            out.append((nsrw.namespace, chrw.collection_name))
+    return out
+
+
+# -- committed private-data store --
+
+_EXPIRY = b"e"      # e + pack(expiry_block, seq) -> expiry entry
+_DATA = b"d"        # d + pack(block, tx) -> TxPvtReadWriteSet bytes
+_MISSING = b"m"     # m + pack(block, tx) + ns + 0x00 + coll -> b""
+
+
+def _bt(block: int, tx: int) -> bytes:
+    return struct.pack(">QI", block, tx)
+
+
+@dataclass
+class MissingPvtData:
+    block_num: int
+    tx_num: int
+    namespace: str
+    collection: str
+
+
+class PvtDataStore:
+    """Committed cleartext per (block, tx) + expiry + missing-data
+    bookkeeping (reference: `core/ledger/pvtdatastorage/store.go`)."""
+
+    def __init__(self, db: DBHandle):
+        self._db = db
+
+    # -- commit-time writes (called inside the ledger commit) --
+
+    def prepare_batch(self, batch, block_num: int,
+                      pvt_data: dict[int, rwpb.TxPvtReadWriteSet],
+                      missing: Iterable[MissingPvtData] = ()) -> None:
+        for tx_num, txpvt in sorted(pvt_data.items()):
+            batch.put(_DATA + _bt(block_num, tx_num),
+                      txpvt.SerializeToString(deterministic=True))
+        for m in missing:
+            batch.put(_MISSING + _bt(m.block_num, m.tx_num) +
+                      m.namespace.encode() + b"\x00" +
+                      m.collection.encode(), b"")
+
+    def record_expiry(self, batch, expiry_block: int, block_num: int,
+                      entries: list[tuple[str, str, str, bytes]]) -> None:
+        """entries: (ns, coll, pvt_key_or_empty, key_hash). Written under
+        the expiry block so commit of that block purges them."""
+        payload = b"".join(
+            struct.pack(">H", len(ns)) + ns.encode() +
+            struct.pack(">H", len(coll)) + coll.encode() +
+            struct.pack(">H", len(key)) + key.encode() +
+            struct.pack(">H", len(kh)) + kh
+            for ns, coll, key, kh in entries
+        )
+        # deterministic key: recovery replay of block_num rewrites the
+        # same entry instead of duplicating it
+        batch.put(_EXPIRY + struct.pack(">QQ", expiry_block, block_num),
+                  payload)
+
+    # -- expiry scan (commit of block N purges entries with
+    #    expiry_block <= N) --
+
+    def expired_entries(self, upto_block: int
+                        ) -> list[tuple[bytes, list[tuple[str, str, str,
+                                                          bytes]]]]:
+        out = []
+        end = _EXPIRY + struct.pack(">QQ", upto_block + 1, 0)
+        for k, v in self._db.iterate(start=_EXPIRY, end=end):
+            entries = []
+            off = 0
+            while off < len(v):
+                parts = []
+                for _ in range(4):
+                    (ln,) = struct.unpack_from(">H", v, off)
+                    off += 2
+                    parts.append(v[off:off + ln])
+                    off += ln
+                entries.append((parts[0].decode(), parts[1].decode(),
+                                parts[2].decode(), parts[3]))
+            out.append((k, entries))
+        return out
+
+    def drop_expiry_key(self, batch, raw_key: bytes) -> None:
+        batch.delete(raw_key)
+
+    # -- reads --
+
+    def get_pvt_data(self, block_num: int, tx_num: int
+                     ) -> Optional[rwpb.TxPvtReadWriteSet]:
+        raw = self._db.get(_DATA + _bt(block_num, tx_num))
+        if raw is None:
+            return None
+        txpvt = rwpb.TxPvtReadWriteSet()
+        txpvt.ParseFromString(raw)
+        return txpvt
+
+    def get_missing(self, max_blocks: int = 0) -> list[MissingPvtData]:
+        out = []
+        for k, _ in self._db.iterate(start=_MISSING,
+                                     end=_MISSING + b"\xff"):
+            block, tx = struct.unpack_from(">QI", k, 1)
+            rest = k[1 + 12:]
+            ns, coll = rest.split(b"\x00", 1)
+            out.append(MissingPvtData(block, tx, ns.decode(),
+                                      coll.decode()))
+            if max_blocks and len(out) >= max_blocks:
+                break
+        return out
+
+    def resolve_missing(self, batch, m: MissingPvtData) -> None:
+        batch.delete(_MISSING + _bt(m.block_num, m.tx_num) +
+                     m.namespace.encode() + b"\x00" +
+                     m.collection.encode())
+
+    def drop_pvt_data(self, batch, block_num: int, tx_num: int) -> None:
+        batch.delete(_DATA + _bt(block_num, tx_num))

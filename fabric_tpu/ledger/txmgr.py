@@ -15,6 +15,7 @@ from __future__ import annotations
 import logging
 from typing import Optional, Sequence
 
+from fabric_tpu.ledger import pvtdata as pvt
 from fabric_tpu.ledger.statedb import (
     Height,
     StateDB,
@@ -24,6 +25,11 @@ from fabric_tpu.ledger.statedb import (
 from fabric_tpu.protos import rwset as rwpb, transaction as txpb
 
 logger = logging.getLogger("ledger.txmgr")
+
+
+class PvtDataNotAvailable(Exception):
+    """The key exists on-chain (hash present) but this peer holds no
+    cleartext — the chaincode call must fail, not silently read None."""
 
 
 def _pb_version(v: Optional[Height]) -> Optional[rwpb.Version]:
@@ -48,6 +54,13 @@ class TxSimulator:
         self._reads: dict[tuple[str, str], Optional[Height]] = {}
         self._writes: dict[tuple[str, str], Optional[bytes]] = {}
         self._range_queries: list[rwpb.RangeQueryInfo] = []
+        # private collections: hashed reads go on-chain for MVCC;
+        # cleartext writes stay off-chain (reference:
+        # lockbased_tx_simulator.go + rwsetutil pvt builders)
+        self._pvt_reads: dict[tuple[str, str, str],
+                              Optional[Height]] = {}
+        self._pvt_writes: dict[tuple[str, str, str],
+                               Optional[bytes]] = {}
         self._done = False
 
     # -- chaincode-facing ops --
@@ -88,6 +101,45 @@ class TxSimulator:
         self._range_queries.append((ns, rqi))
         return out
 
+    # -- private data (reference: handler HandleGetState/PutState private
+    #    variants → simulator GetPrivateData/SetPrivateData) --
+
+    def get_private_data(self, ns: str, coll: str, key: str
+                         ) -> Optional[bytes]:
+        if (ns, coll, key) in self._pvt_writes:
+            return self._pvt_writes[(ns, coll, key)]
+        # MVCC read recorded against the HASHED version (identical on
+        # every peer whether or not it holds the cleartext)
+        hver = self._db.get_version(
+            pvt.hash_ns(ns, coll),
+            pvt.hashed_key_str(pvt.key_hash(key)))
+        if (ns, coll, key) not in self._pvt_reads:
+            self._pvt_reads[(ns, coll, key)] = hver
+        vv = self._db.get_state(pvt.pvt_ns(ns, coll), key)
+        if vv is None and hver is not None:
+            raise PvtDataNotAvailable(
+                f"private data for [{ns}/{coll}/{key}] exists on-chain "
+                f"but this peer does not hold the cleartext")
+        return vv.value if vv else None
+
+    def get_private_data_hash(self, ns: str, coll: str, key: str
+                              ) -> Optional[bytes]:
+        """Readable by non-members too (reference GetPrivateDataHash —
+        no read recorded on the cleartext, only the hash lookup)."""
+        vv = self._db.get_state(
+            pvt.hash_ns(ns, coll),
+            pvt.hashed_key_str(pvt.key_hash(key)))
+        return vv.value if vv else None
+
+    def put_private_data(self, ns: str, coll: str, key: str,
+                         value: bytes) -> None:
+        if not key:
+            raise ValueError("empty key")
+        self._pvt_writes[(ns, coll, key)] = value
+
+    def del_private_data(self, ns: str, coll: str, key: str) -> None:
+        self._pvt_writes[(ns, coll, key)] = None
+
     # -- result --
 
     def get_tx_simulation_results(self) -> rwpb.TxReadWriteSet:
@@ -112,11 +164,73 @@ class TxSimulator:
             else:
                 kw.value = value
 
+        # hashed collection rwsets ride in the PUBLIC results — that is
+        # what goes on-chain and what MVCC replays on every peer
+        hashed_by_nc: dict[tuple[str, str], rwpb.HashedRWSet] = {}
+        for (ns, coll, key), ver in sorted(self._pvt_reads.items()):
+            h = hashed_by_nc.setdefault((ns, coll), rwpb.HashedRWSet())
+            hr = h.hashed_reads.add(key_hash=pvt.key_hash(key))
+            if ver is not None:
+                hr.version.CopyFrom(_pb_version(ver))
+        for (ns, coll, key), value in sorted(self._pvt_writes.items()):
+            h = hashed_by_nc.setdefault((ns, coll), rwpb.HashedRWSet())
+            hw = h.hashed_writes.add(key_hash=pvt.key_hash(key))
+            if value is None:
+                hw.is_delete = True
+            else:
+                hw.value_hash = pvt.value_hash(value)
+
+        pvt_colls = self._pvt_collection_rwsets()
         txrw = rwpb.TxReadWriteSet(data_model=rwpb.TxReadWriteSet.KV)
-        for ns in sorted(by_ns):
+        all_ns = sorted(set(by_ns) | {ns for ns, _ in hashed_by_nc})
+        for ns in all_ns:
             nsrw = txrw.ns_rwset.add(namespace=ns)
-            nsrw.rwset = by_ns[ns].SerializeToString(deterministic=True)
+            nsrw.rwset = by_ns.get(ns, rwpb.KVRWSet()).SerializeToString(
+                deterministic=True)
+            for (hns, coll) in sorted(hashed_by_nc):
+                if hns != ns:
+                    continue
+                chrw = nsrw.collection_hashed_rwset.add(
+                    collection_name=coll)
+                chrw.rwset = hashed_by_nc[(hns, coll)].SerializeToString(
+                    deterministic=True)
+                cleartext = pvt_colls.get((ns, coll))
+                if cleartext is not None:
+                    chrw.pvt_rwset_hash = pvt.pvt_rwset_hash(cleartext)
         return txrw
+
+    def _pvt_collection_rwsets(self) -> dict[tuple[str, str], bytes]:
+        """Marshaled cleartext KVRWSet per (ns, coll) — only collections
+        with writes (reads need no cleartext distribution)."""
+        by_nc: dict[tuple[str, str], rwpb.KVRWSet] = {}
+        for (ns, coll, key), value in sorted(self._pvt_writes.items()):
+            kv = by_nc.setdefault((ns, coll), rwpb.KVRWSet())
+            kw = kv.writes.add(key=key)
+            if value is None:
+                kw.is_delete = True
+            else:
+                kw.value = value
+        return {nc: kv.SerializeToString(deterministic=True)
+                for nc, kv in by_nc.items()}
+
+    def get_private_simulation_results(
+            self) -> Optional[rwpb.TxPvtReadWriteSet]:
+        """The cleartext side (endorser → transient store / gossip
+        distribution). None when the tx touched no private writes."""
+        colls = self._pvt_collection_rwsets()
+        if not colls:
+            return None
+        txpvt = rwpb.TxPvtReadWriteSet(
+            data_model=rwpb.TxReadWriteSet.KV)
+        by_ns: dict[str, list[tuple[str, bytes]]] = {}
+        for (ns, coll), raw in sorted(colls.items()):
+            by_ns.setdefault(ns, []).append((coll, raw))
+        for ns in sorted(by_ns):
+            nspvt = txpvt.ns_pvt_rwset.add(namespace=ns)
+            for coll, raw in by_ns[ns]:
+                nspvt.collection_pvt_rwset.add(collection_name=coll,
+                                               rwset=raw)
+        return txpvt
 
 
 class TxMgr:
@@ -166,6 +280,19 @@ class TxMgr:
                 if not self._validate_range_query(nsrw.namespace, rqi,
                                                   batch):
                     return txpb.TxValidationCode.PHANTOM_READ_CONFLICT
+            # hashed collection reads: same MVCC rule over the hashed
+            # namespace (deterministic on every peer)
+            for chrw in nsrw.collection_hashed_rwset:
+                hset = rwpb.HashedRWSet()
+                hset.ParseFromString(chrw.rwset)
+                hns = pvt.hash_ns(nsrw.namespace, chrw.collection_name)
+                for hread in hset.hashed_reads:
+                    read = rwpb.KVRead(
+                        key=pvt.hashed_key_str(hread.key_hash))
+                    if hread.HasField("version"):
+                        read.version.CopyFrom(hread.version)
+                    if not self._validate_read(hns, read, batch):
+                        return txpb.TxValidationCode.MVCC_READ_CONFLICT
         return txpb.TxValidationCode.VALID
 
     def _validate_read(self, ns: str, read: rwpb.KVRead,
@@ -225,3 +352,13 @@ class TxMgr:
                     batch.delete(nsrw.namespace, w.key, height)
                 else:
                     batch.put(nsrw.namespace, w.key, w.value, height)
+            for chrw in nsrw.collection_hashed_rwset:
+                hset = rwpb.HashedRWSet()
+                hset.ParseFromString(chrw.rwset)
+                hns = pvt.hash_ns(nsrw.namespace, chrw.collection_name)
+                for hw in hset.hashed_writes:
+                    hkey = pvt.hashed_key_str(hw.key_hash)
+                    if hw.is_delete:
+                        batch.delete(hns, hkey, height)
+                    else:
+                        batch.put(hns, hkey, hw.value_hash, height)

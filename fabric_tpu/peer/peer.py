@@ -11,6 +11,7 @@ config bundle when config blocks commit.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Callable, Optional
 
@@ -22,6 +23,7 @@ from fabric_tpu.internal.configtxgen import genesis as genesis_mod
 from fabric_tpu.core import endorser as endorser_mod
 from fabric_tpu.core.chaincode import ChaincodeDefinition, ChaincodeSupport
 from fabric_tpu.core.committer import LedgerCommitter
+from fabric_tpu.core.transientstore import TransientStore
 from fabric_tpu.core.txvalidator import TxValidator
 from fabric_tpu.ledger.ledgermgmt import LedgerManager
 from fabric_tpu.peer.mcs import MSPMessageCryptoService
@@ -44,6 +46,9 @@ class Channel:
 
         cfg_block = self._find_last_config_block()
         self._apply_config(cfg_block)
+        # the ledger resolves collection configs (BTL etc.) through the
+        # channel's chaincode definitions
+        ledger.set_collection_info_source(self._collection_info)
 
         self.validator = TxValidator(
             channel_id, ledger, self.bundle, peer.csp,
@@ -119,16 +124,52 @@ class Channel:
         with self._lock:
             return self._definitions.get(name)
 
+    def _collection_info(self, ns: str, coll: str):
+        definition = self.chaincode_definition(ns)
+        return definition.collection(coll) if definition else None
+
     # -- block intake (what the deliver client calls) --
 
     def process_block(self, block: common.Block) -> list[int]:
-        """validate (batched) → commit; returns final tx codes.
-        Reference: gossip/state deliverPayloads →
-        coordinator.StoreBlock (SURVEY §3.4)."""
+        """validate (batched) → gather private data → commit; returns
+        final tx codes. Reference: gossip/state deliverPayloads →
+        coordinator.StoreBlock (`gossip/privdata/coordinator.go:152`,
+        SURVEY §3.4)."""
         flags = self.validator.validate(block)
-        codes = self.committer.commit(block, flags)
+        pvt_data, committed_txids = self._gather_pvt_data(block, flags)
+        codes = self.committer.commit(block, flags, pvt_data=pvt_data)
+        if committed_txids:
+            self._peer.transient_store.purge_by_txids(committed_txids)
         self._notify_commit(block, codes)
         return codes
+
+    def _gather_pvt_data(self, block: common.Block, flags: list[int]
+                         ) -> tuple[dict, list[str]]:
+        """Transient-store lookup per valid tx that advertises hashed
+        collection writes (the gossip pull for still-missing data is
+        the reconciler's job)."""
+        from fabric_tpu.ledger.kvledger import extract_tx_rwset
+        pvt_data: dict[int, object] = {}
+        txids: list[str] = []
+        store = self._peer.transient_store
+        for i, env_bytes in enumerate(block.data.data):
+            if flags[i] != txpb.TxValidationCode.VALID:
+                continue
+            txrw = extract_tx_rwset(env_bytes)
+            if txrw is None or not any(
+                    nsrw.collection_hashed_rwset
+                    for nsrw in txrw.ns_rwset):
+                continue
+            try:
+                env = pu.unmarshal_envelope(env_bytes)
+                ch = pu.get_channel_header(pu.get_payload(env))
+            except Exception:
+                continue
+            txids.append(ch.tx_id)
+            stored = store.get(ch.tx_id)
+            if stored is not None:
+                pvt_data[i] = stored
+        return pvt_data, txids
 
     # -- commit notification (gateway CommitStatus; reference:
     #    internal/pkg/gateway/commit) --
@@ -194,6 +235,8 @@ class Peer:
         self.signer = local_msp.get_default_signing_identity()
         self.ledger_mgr = LedgerManager(ledger_root,
                                         metrics_provider=metrics_provider)
+        self.transient_store = TransientStore(
+            os.path.join(ledger_root, "transient.db"))
         self.chaincode_support = ChaincodeSupport()
         self.channels: dict[str, Channel] = {}
         self._lock = threading.Lock()
@@ -224,7 +267,8 @@ class Peer:
         return endorser_mod.ChannelSupport(
             ledger=channel.ledger,
             policy_manager=bundle.policy_manager,
-            deserializer=bundle.msp_manager)
+            deserializer=bundle.msp_manager,
+            transient_store=self.transient_store)
 
     # -- channel lifecycle (reference: cscc JoinChain →
     #    peer.CreateChannel, core/peer/channel.go) --
@@ -245,4 +289,5 @@ class Peer:
         return self.channels.get(channel_id)
 
     def close(self) -> None:
+        self.transient_store.close()
         self.ledger_mgr.close()

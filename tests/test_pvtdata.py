@@ -1,0 +1,357 @@
+"""Private data collections: hashing, MVCC, distribution, BTL expiry.
+
+Mirrors the reference's pvtdata semantics (SURVEY §2.5/§2.6,
+`integration/pvtdata`): cleartext never on-chain; hashed reads/writes
+drive MVCC identically on every peer; non-endorsing peers commit hashes
+and record the missing cleartext; BTL purges cleartext AND hashes.
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.common.policies.policydsl import from_string
+from fabric_tpu.core.chaincode import (
+    Chaincode, ChaincodeDefinition, shim,
+)
+from fabric_tpu.core.transientstore import TransientStore
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.ledger import CollectionConfig
+from fabric_tpu.ledger.pvtdata import hash_ns, key_hash, pvt_ns, value_hash
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer
+from fabric_tpu.peer.gateway import Gateway
+from fabric_tpu.protos import policies as polpb, rwset as rwpb
+from fabric_tpu.protos import transaction as txpb
+
+CHANNEL = "pvtchannel"
+
+
+class MarbleCC(Chaincode):
+    """The pvtdata marbles analog: public name, private price."""
+
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            # transient map carries the secret (reference pattern:
+            # pvt payload rides in transient, never in args).
+            # read-before-write so MVCC guards concurrent updates
+            stub.get_private_data("prices", params[0])
+            price = stub.get_transient()["price"]
+            stub.put_state(params[0], b"marble")
+            stub.put_private_data("prices", params[0], price)
+            return shim.success()
+        if fn == "getprice":
+            val = stub.get_private_data("prices", params[0])
+            if val is None:
+                return shim.error("no price")
+            return shim.success(val)
+        if fn == "gethash":
+            h = stub.get_private_data_hash("prices", params[0])
+            return shim.success(h or b"")
+        if fn == "delprice":
+            stub.del_private_data("prices", params[0])
+            return shim.success()
+        return shim.error("unknown")
+
+
+def _or_policy(*orgs) -> bytes:
+    spec = "OR(" + ", ".join(f"'{o}.member'" for o in orgs) + ")"
+    return polpb.ApplicationPolicy(
+        signature_policy=from_string(spec)).SerializeToString()
+
+
+@pytest.fixture(scope="module")
+def network(tmp_path_factory):
+    root = tmp_path_factory.mktemp("pvtnet")
+    cdir = str(root / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "150ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+    csp = SWProvider()
+
+    def local_msp(msp_dir, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(msp_dir, mspid, csp=csp))
+        return m
+
+    orderer_msp = local_msp(
+        os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
+        "OrdererMSP")
+    registrar = Registrar(str(root / "orderer"),
+                          orderer_msp.get_default_signing_identity(),
+                          csp, {"solo": solo.consenter})
+    registrar.join(genesis)
+    broadcast = BroadcastHandler(registrar)
+    deliver = DeliverHandler(registrar.get_chain)
+
+    definition = ChaincodeDefinition(
+        name="marbles",
+        # OR policy: one org's endorsement suffices — lets us create
+        # blocks where org2 never saw the cleartext
+        endorsement_policy=_or_policy("Org1MSP", "Org2MSP"),
+        collections=(
+            CollectionConfig(name="prices",
+                             member_orgs=("Org1MSP", "Org2MSP"),
+                             block_to_live=0),
+            CollectionConfig(name="ephemeral",
+                             member_orgs=("Org1MSP",),
+                             block_to_live=1),
+        ))
+
+    peers, deliverers = {}, []
+    for org_name, org_dir, mspid in (("org1", org1, "Org1MSP"),
+                                     ("org2", org2, "Org2MSP")):
+        msp = local_msp(
+            os.path.join(org_dir, "peers",
+                         f"peer0.{org_name}.example.com", "msp"),
+            mspid)
+        peer = Peer(str(root / f"peer_{org_name}"), msp, csp)
+        channel = peer.join_channel(genesis)
+        peer.chaincode_support.register("marbles", MarbleCC())
+        channel.define_chaincode(definition)
+        d = Deliverer(channel, peer.signer, lambda: deliver, peer.mcs)
+        d.start()
+        peers[org_name] = peer
+        deliverers.append(d)
+
+    user_msp = local_msp(
+        os.path.join(org1, "users", "User1@org1.example.com", "msp"),
+        "Org1MSP")
+    gateway = Gateway(peers["org1"], broadcast,
+                      user_msp.get_default_signing_identity())
+    yield {"peers": peers, "gateway": gateway, "csp": csp}
+    for d in deliverers:
+        d.stop()
+    registrar.halt()
+    for p in peers.values():
+        p.close()
+
+
+def _sync(net, timeout_s=10.0):
+    chans = [net["peers"][o].channel(CHANNEL) for o in ("org1", "org2")]
+    target = max(ch.ledger.height for ch in chans)
+    for ch in chans:
+        assert ch.wait_for_height(target, timeout_s)
+
+
+class TestPrivateData:
+    def test_cleartext_on_endorser_hash_on_chain(self, network):
+        gw = network["gateway"]
+        res = gw.submit_transaction(
+            CHANNEL, "marbles", [b"put", b"m1"],
+            transient={"price": b"99"},
+            endorsing_peers=[network["peers"]["org1"]])
+        assert res.status == txpb.TxValidationCode.VALID
+        _sync(network)
+
+        led1 = network["peers"]["org1"].channel(CHANNEL).ledger
+        led2 = network["peers"]["org2"].channel(CHANNEL).ledger
+        # org1 endorsed → has cleartext
+        assert led1.get_private_data("marbles", "prices", "m1") == b"99"
+        # both peers hold the HASH (public, deterministic)
+        for led in (led1, led2):
+            assert led.get_private_data_hash(
+                "marbles", "prices", "m1") == value_hash(b"99")
+        # org2 never saw the cleartext → missing entry recorded
+        assert led2.get_private_data("marbles", "prices", "m1") is None
+        missing = led2.missing_pvt_data()
+        assert any(m.namespace == "marbles" and
+                   m.collection == "prices" for m in missing)
+        # and org1 has no missing entries for this collection
+        assert not any(m.collection == "prices"
+                       for m in led1.missing_pvt_data())
+
+    def test_cleartext_never_in_block(self, network):
+        """The secret must not appear anywhere in the committed block
+        bytes — the core privacy property."""
+        gw = network["gateway"]
+        secret = b"supersecret-7741"
+        gw.submit_transaction(
+            CHANNEL, "marbles", [b"put", b"m2"],
+            transient={"price": secret},
+            endorsing_peers=[network["peers"]["org1"]])
+        _sync(network)
+        ch = network["peers"]["org1"].channel(CHANNEL)
+        for num in range(ch.ledger.height):
+            blk = ch.get_block(num)
+            assert secret not in blk.SerializeToString()
+
+    def test_evaluate_reads_private_state(self, network):
+        gw = network["gateway"]
+        gw.submit_transaction(
+            CHANNEL, "marbles", [b"put", b"m3"],
+            transient={"price": b"55"},
+            endorsing_peers=[network["peers"]["org1"]])
+        _sync(network)
+        resp = gw.evaluate(CHANNEL, "marbles", [b"getprice", b"m3"])
+        assert resp.status == 200 and resp.payload == b"55"
+        resp = gw.evaluate(CHANNEL, "marbles", [b"gethash", b"m3"])
+        assert resp.payload == value_hash(b"55")
+
+    def test_pvt_mvcc_conflict(self, network):
+        """Two txs in one block reading the same private key: hashed
+        reads collide → second gets MVCC_READ_CONFLICT, on BOTH peers
+        (org2 validates purely from hashes)."""
+        gw = network["gateway"]
+        gw.submit_transaction(
+            CHANNEL, "marbles", [b"put", b"race"],
+            transient={"price": b"1"},
+            endorsing_peers=[network["peers"]["org1"]])
+        env1, tx1 = gw.endorse(
+            CHANNEL, "marbles", [b"put", b"race"],
+            transient={"price": b"2"},
+            endorsing_peers=[network["peers"]["org1"]])
+        env2, tx2 = gw.endorse(
+            CHANNEL, "marbles", [b"put", b"race"],
+            transient={"price": b"3"},
+            endorsing_peers=[network["peers"]["org1"]])
+        gw.submit(env1)
+        gw.submit(env2)
+        c1 = gw.commit_status(CHANNEL, tx1, timeout_s=10)
+        c2 = gw.commit_status(CHANNEL, tx2, timeout_s=10)
+        assert sorted([c1, c2]) == sorted(
+            [txpb.TxValidationCode.VALID,
+             txpb.TxValidationCode.MVCC_READ_CONFLICT])
+        _sync(network)
+        # org2, validating from hashes alone, reached the same verdict
+        ch2 = network["peers"]["org2"].channel(CHANNEL)
+        assert ch2.tx_validation_code(tx1) == c1
+        assert ch2.tx_validation_code(tx2) == c2
+
+    def test_btl_expiry_purges_cleartext_and_hash(self, network):
+        """block_to_live=1: data written at block N is purged at commit
+        of block N+2."""
+        gw = network["gateway"]
+        org1 = network["peers"]["org1"]
+
+        class EphemeralCC(MarbleCC):
+            def invoke(self, stub):
+                fn, params = stub.get_function_and_parameters()
+                if fn == "eput":
+                    stub.put_private_data(
+                        "ephemeral", params[0],
+                        stub.get_transient()["v"])
+                    return shim.success()
+                return super().invoke(stub)
+
+        for p in network["peers"].values():
+            p.chaincode_support.register("marbles", EphemeralCC())
+
+        gw.submit_transaction(CHANNEL, "marbles", [b"eput", b"tmp"],
+                              transient={"v": b"gone-soon"},
+                              endorsing_peers=[org1])
+        _sync(network)
+        led = org1.channel(CHANNEL).ledger
+        assert led.get_private_data("marbles", "ephemeral",
+                                    "tmp") == b"gone-soon"
+        kh = key_hash("tmp")
+        assert led.state_db.get_state(
+            hash_ns("marbles", "ephemeral"), kh.hex()) is not None
+
+        # two more blocks → purge fires (expiry = write_block + 1 + 1)
+        for i in range(2):
+            gw.submit_transaction(
+                CHANNEL, "marbles", [b"put", f"fill{i}".encode()],
+                transient={"price": b"0"},
+                endorsing_peers=[org1])
+        _sync(network)
+        assert led.get_private_data("marbles", "ephemeral",
+                                    "tmp") is None
+        assert led.state_db.get_state(
+            hash_ns("marbles", "ephemeral"), kh.hex()) is None
+        # non-expiring collection data survives
+        assert led.get_private_data("marbles", "prices",
+                                    "m1") == b"99"
+
+    def test_delete_private_data(self, network):
+        gw = network["gateway"]
+        org1 = network["peers"]["org1"]
+        gw.submit_transaction(CHANNEL, "marbles", [b"put", b"delme"],
+                              transient={"price": b"11"},
+                              endorsing_peers=[org1])
+        gw.submit_transaction(CHANNEL, "marbles",
+                              [b"delprice", b"delme"],
+                              endorsing_peers=[org1])
+        _sync(network)
+        led = org1.channel(CHANNEL).ledger
+        assert led.get_private_data("marbles", "prices",
+                                    "delme") is None
+        assert led.get_private_data_hash("marbles", "prices",
+                                         "delme") is None
+
+
+class TestTransientStore:
+    def _pvt(self, ns="ns", coll="c", key="k", val=b"v"):
+        tx = rwpb.TxPvtReadWriteSet(data_model=rwpb.TxReadWriteSet.KV)
+        kv = rwpb.KVRWSet()
+        kv.writes.add(key=key, value=val)
+        tx.ns_pvt_rwset.add(namespace=ns).collection_pvt_rwset.add(
+            collection_name=coll,
+            rwset=kv.SerializeToString(deterministic=True))
+        return tx
+
+    def test_persist_get_purge(self, tmp_path):
+        ts = TransientStore(str(tmp_path / "t.db"))
+        ts.persist("tx1", 5, self._pvt(val=b"a"))
+        ts.persist("tx2", 7, self._pvt(val=b"b"))
+        assert ts.get("tx1") is not None
+        assert ts.get("nope") is None
+        ts.purge_by_txids(["tx1"])
+        assert ts.get("tx1") is None
+        assert ts.get("tx2") is not None
+        assert ts.min_height() == 7
+        ts.purge_below_height(8)
+        assert ts.get("tx2") is None
+        assert ts.min_height() is None
+        ts.close()
+
+    def test_latest_endorsement_wins(self, tmp_path):
+        ts = TransientStore(str(tmp_path / "t.db"))
+        ts.persist("tx", 3, self._pvt(val=b"old"))
+        ts.persist("tx", 9, self._pvt(val=b"new"))
+        got = ts.get("tx")
+        kv = rwpb.KVRWSet()
+        kv.ParseFromString(
+            got.ns_pvt_rwset[0].collection_pvt_rwset[0].rwset)
+        assert kv.writes[0].value == b"new"
+        ts.close()
