@@ -32,6 +32,7 @@ class ChannelSupport:
     policy_manager: object  # policies.Manager
     deserializer: object    # msp manager for the channel
     transient_store: object = None  # TransientStore (pvt distribution)
+    pvt_distributor: object = None  # gossip push to collection members
 
 
 def _error_response(status: int, message: str) -> pb.ProposalResponse:
@@ -121,6 +122,14 @@ class Endorser:
                          "transient store")
             support.transient_store.persist(
                 up.tx_id, support.ledger.height, pvt_results)
+            if support.pvt_distributor is not None:
+                try:
+                    support.pvt_distributor(up.tx_id,
+                                            support.ledger.height,
+                                            pvt_results)
+                except Exception:
+                    logger.exception("private data distribution failed "
+                                     "for [%s]", up.tx_id)
 
         # -- endorse (default plugin, inlined) --
         return txutils.create_proposal_response(

@@ -1,0 +1,5 @@
+from fabric_tpu.gossip.node import GossipNode  # noqa: F401
+from fabric_tpu.gossip.transport import (  # noqa: F401
+    LocalNetwork, Transport,
+)
+from fabric_tpu.gossip.service import GossipService  # noqa: F401

@@ -1,0 +1,174 @@
+"""Leader election per channel.
+
+Rebuild of `gossip/election/{election,adapter}.go` (460 ln): exactly one
+peer per org should run the deliver client against the ordering
+service. Peers gossip leadership PROPOSALS; after a collection window,
+the smallest PKI-ID among proposers declares itself leader and keeps
+broadcasting DECLARATIONS; followers relinquish. A leader that falls
+silent past the alive threshold triggers re-election; a declaration
+from a smaller PKI-ID pre-empts a sitting leader (the reference's
+`leadershipMsg` handling).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from fabric_tpu.gossip import message as gmsg
+from fabric_tpu.protos import gossip as gpb
+
+logger = logging.getLogger("gossip.election")
+
+
+class LeaderElectionService:
+    def __init__(self, node, channel_id: str,
+                 on_gain: Callable[[], None],
+                 on_lose: Callable[[], None],
+                 propose_interval_s: float = 0.3,
+                 leader_alive_s: float = 1.5):
+        self._node = node
+        self._channel = node.join_channel(channel_id)
+        self._channel.on_leadership = self._handle
+        self.channel_id = channel_id
+        self._on_gain = on_gain
+        self._on_lose = on_lose
+        self._interval = propose_interval_s
+        self._leader_alive = leader_alive_s
+
+        self._lock = threading.Lock()
+        self.is_leader = False
+        self._leader_pki: Optional[bytes] = None
+        self._leader_seen = 0.0
+        self._proposals: dict[bytes, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="gossip-election",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+        self._relinquish()
+
+    @property
+    def leader(self) -> Optional[bytes]:
+        with self._lock:
+            return self._leader_pki
+
+    # -- protocol --
+
+    def _send(self, is_declaration: bool) -> None:
+        msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_AND_ORG)
+        self._channel._tag_channel(msg)
+        msg.leadership_msg.pki_id = self._node.pki_id
+        msg.leadership_msg.is_declaration = is_declaration
+        msg.leadership_msg.timestamp.inc_num = self._node.incarnation
+        msg.leadership_msg.timestamp.seq_num = self._node.next_seq()
+        self._node.gossip_channel(
+            self._channel, gmsg.sign_message(msg, self._node.signer))
+
+    def _handle(self, sender: str, msg: gpb.GossipMessage,
+                smsg: gpb.SignedGossipMessage) -> None:
+        lm = msg.leadership_msg
+        pki = bytes(lm.pki_id)
+        if pki == self._node.pki_id:
+            return
+        info = self._node.discovery.lookup(pki)
+        if info is not None and info.identity:
+            if not self._node.mcs.verify_by_channel(
+                    self.channel_id, info.identity, smsg.signature,
+                    smsg.payload) and not self._node.mcs.verify(
+                        info.identity, smsg.signature, smsg.payload):
+                logger.warning("leadership msg from %s failed "
+                               "verification", sender)
+                return
+        now = time.monotonic()
+        yield_leadership = False
+        with self._lock:
+            if lm.is_declaration:
+                if self._leader_pki is None or pki <= self._leader_pki \
+                        or now - self._leader_seen > self._leader_alive:
+                    self._leader_pki = pki
+                    self._leader_seen = now
+                if self.is_leader and pki < self._node.pki_id:
+                    yield_leadership = True
+            else:
+                self._proposals[pki] = now
+        if yield_leadership:
+            logger.info("[%s] yielding leadership to %s",
+                        self.channel_id, pki.hex()[:8])
+            self._relinquish()
+
+    def _loop(self) -> None:
+        # stagger the first proposal so peers see each other's
+        # proposals before anyone declares
+        self._send(is_declaration=False)
+        while not self._stop.wait(self._interval):
+            try:
+                self._round()
+            except Exception:
+                logger.exception("election round failed")
+
+    def _round(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            leader_fresh = (self._leader_pki is not None and
+                            now - self._leader_seen <=
+                            self._leader_alive)
+            if leader_fresh and not self.is_leader:
+                return  # someone else leads and is alive
+            # drop stale proposals
+            self._proposals = {
+                p: t for p, t in self._proposals.items()
+                if now - t <= self._leader_alive}
+            contenders = set(self._proposals)
+            contenders.add(self._node.pki_id)
+            i_win = min(contenders) == self._node.pki_id
+        if self.is_leader:
+            if i_win:
+                self._send(is_declaration=True)
+                with self._lock:
+                    self._leader_pki = self._node.pki_id
+                    self._leader_seen = now
+            else:
+                self._relinquish()
+            return
+        if i_win:
+            self._claim()
+        else:
+            self._send(is_declaration=False)
+
+    def _claim(self) -> None:
+        with self._lock:
+            if self.is_leader:
+                return
+            self.is_leader = True
+            self._leader_pki = self._node.pki_id
+            self._leader_seen = time.monotonic()
+        logger.info("[%s] %s became leader", self.channel_id,
+                    self._node.endpoint)
+        self._send(is_declaration=True)
+        try:
+            self._on_gain()
+        except Exception:
+            logger.exception("on_gain callback failed")
+
+    def _relinquish(self) -> None:
+        with self._lock:
+            if not self.is_leader:
+                return
+            self.is_leader = False
+        logger.info("[%s] %s relinquished leadership", self.channel_id,
+                    self._node.endpoint)
+        try:
+            self._on_lose()
+        except Exception:
+            logger.exception("on_lose callback failed")

@@ -1,0 +1,43 @@
+"""Gossip message signing/verification envelopes.
+
+Rebuild of `gossip/protoext/` (signing.go, validation.go): a
+`SignedGossipMessage` wraps a marshaled `GossipMessage` plus a
+signature by the sender's identity. PKI-ID = SHA-256 of the serialized
+identity (reference `gossip/common` + mcs.GetPKIidOfCert).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from fabric_tpu.protos import gossip as gpb
+
+
+def pki_id_of(identity_bytes: bytes) -> bytes:
+    return hashlib.sha256(identity_bytes).digest()
+
+
+def sign_message(msg: gpb.GossipMessage, signer) -> gpb.SignedGossipMessage:
+    payload = msg.SerializeToString(deterministic=True)
+    return gpb.SignedGossipMessage(payload=payload,
+                                   signature=signer.sign(payload))
+
+
+def unsigned(msg: gpb.GossipMessage) -> gpb.SignedGossipMessage:
+    """Messages whose authenticity rides on content (e.g. blocks carry
+    orderer signatures; pull digests are advisory) travel unsigned,
+    like the reference's NoopSign."""
+    return gpb.SignedGossipMessage(
+        payload=msg.SerializeToString(deterministic=True))
+
+
+def parse(smsg: gpb.SignedGossipMessage) -> gpb.GossipMessage:
+    msg = gpb.GossipMessage()
+    msg.ParseFromString(smsg.payload)
+    return msg
+
+
+def channel_mac(pki_id: bytes, channel_id: str) -> str:
+    """Reference `gossip/util` GenerateMAC — hides channel names from
+    peers outside the channel."""
+    return hashlib.sha256(pki_id + channel_id.encode()).hexdigest()

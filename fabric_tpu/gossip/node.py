@@ -1,0 +1,342 @@
+"""Gossip core: message routing, channel state, push dissemination.
+
+Rebuild of `gossip/gossip/gossip_impl.go` (Node: `handleMessage:331`,
+`gossipBatch:444`) + `gossip/gossip/channel/channel.go` (per-channel
+state-info, membership filtering by channel MAC) + the identity mapper
+(`gossip/identity/identity.go`). Push is batched: outgoing messages
+queue and flush every emit interval to a fanout of channel members
+(the reference's batching emitter). Block payloads travel unsigned —
+they self-certify via orderer signatures, checked by the state layer
+before commit; alive/state-info messages are signed and verified
+through the MCS → batched BCCSP seam.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from fabric_tpu.gossip import message as gmsg
+from fabric_tpu.gossip.discovery import Discovery, DiscoveryConfig
+from fabric_tpu.gossip.pull import PullMediator
+from fabric_tpu.gossip.transport import Transport
+from fabric_tpu.protos import gossip as gpb
+
+logger = logging.getLogger("gossip.node")
+
+
+class ChannelGossip:
+    """Per-channel view: which alive peers are in the channel (learned
+    from StateInfo), their ledger heights, and the recent-block cache
+    backing the pull engine."""
+
+    def __init__(self, node: "GossipNode", channel_id: str,
+                 block_cache_size: int = 16):
+        self.channel_id = channel_id
+        self._node = node
+        self._mac_cache: dict[bytes, str] = {}
+        self._lock = threading.RLock()
+        # pki_id -> (Properties, PeerTime)
+        self._state_info: dict[bytes, tuple[gpb.Properties,
+                                            tuple[int, int]]] = {}
+        self._blocks: dict[int, gpb.SignedGossipMessage] = {}
+        self._cache_size = block_cache_size
+        self.on_block: Optional[Callable[[str, int, bytes], None]] = None
+        self.on_leadership: Optional[Callable] = None
+        self.on_pvt_request: Optional[Callable] = None
+        self.on_pvt_response: Optional[Callable] = None
+        self.on_pvt_push: Optional[Callable] = None
+        self.on_state_request: Optional[Callable] = None
+        self.on_state_response: Optional[Callable] = None
+        self.pull = PullMediator(
+            gpb.PullRequest.BLOCK_MSG,
+            digests=self._block_digests,
+            fetch=self._fetch_block,
+            store=lambda _d, item: self._store_pulled(item),
+            send=lambda ep, msg: node.send_endpoint(
+                ep, gmsg.unsigned(self._tag_channel(msg))))
+
+    # -- channel MAC --
+
+    def _mac_of(self, pki: bytes) -> str:
+        mac = self._mac_cache.get(pki)
+        if mac is None:
+            mac = gmsg.channel_mac(pki, self.channel_id)
+            self._mac_cache[pki] = mac
+        return mac
+
+    def _tag_channel(self, msg: gpb.GossipMessage) -> gpb.GossipMessage:
+        msg.channel = self.channel_id.encode()
+        return msg
+
+    # -- state info --
+
+    def publish_state_info(self, height: int,
+                           chaincodes: list[str] = ()) -> None:
+        msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
+        self._tag_channel(msg)
+        msg.state_info.pki_id = self._node.pki_id
+        msg.state_info.channel_mac = self._mac_of(self._node.pki_id)
+        msg.state_info.timestamp.inc_num = self._node.incarnation
+        msg.state_info.timestamp.seq_num = self._node.next_seq()
+        msg.state_info.properties.ledger_height = height
+        for name in chaincodes:
+            msg.state_info.properties.chaincodes.add(name=name)
+        self._node.gossip_channel(self, gmsg.sign_message(
+            msg, self._node.signer))
+
+    def handle_state_info(self, msg: gpb.GossipMessage,
+                          smsg: gpb.SignedGossipMessage) -> None:
+        si = msg.state_info
+        pki = bytes(si.pki_id)
+        if si.channel_mac != self._mac_of(pki):
+            return
+        info = self._node.discovery.lookup(pki)
+        identity = info.identity if info else b""
+        if identity and not self._node.mcs.verify_by_channel(
+                self.channel_id, identity, smsg.signature,
+                smsg.payload):
+            logger.warning("[%s] state-info from %s failed verification",
+                           self.channel_id, pki.hex()[:8])
+            return
+        ts = (si.timestamp.inc_num, si.timestamp.seq_num)
+        with self._lock:
+            cur = self._state_info.get(pki)
+            if cur is not None and ts <= cur[1]:
+                return
+            props = gpb.Properties()
+            props.CopyFrom(si.properties)
+            self._state_info[pki] = (props, ts)
+
+    # -- membership views --
+
+    def members(self) -> list:
+        """Alive peers known to be in this channel."""
+        with self._lock:
+            in_channel = set(self._state_info)
+        return [m for m in self._node.discovery.alive_members()
+                if bytes(m.member.pki_id) in in_channel]
+
+    def heights(self) -> dict[bytes, int]:
+        with self._lock:
+            return {pki: props.ledger_height
+                    for pki, (props, _ts) in self._state_info.items()}
+
+    # -- block cache (pull engine backing) --
+
+    def cache_block(self, seq: int,
+                    smsg: gpb.SignedGossipMessage) -> None:
+        with self._lock:
+            self._blocks[seq] = smsg
+            while len(self._blocks) > self._cache_size:
+                del self._blocks[min(self._blocks)]
+
+    def _block_digests(self) -> list[bytes]:
+        with self._lock:
+            return [str(s).encode() for s in sorted(self._blocks)]
+
+    def _fetch_block(self, digest: bytes
+                     ) -> Optional[gpb.SignedGossipMessage]:
+        with self._lock:
+            return self._blocks.get(int(digest))
+
+    def _store_pulled(self, item: gpb.SignedGossipMessage) -> None:
+        try:
+            inner = gmsg.parse(item)
+        except Exception:
+            return
+        if inner.WhichOneof("content") == "data_msg":
+            self._node._handle_data("", self, inner, item)
+
+    def pull_round(self) -> None:
+        eps = [m.member.endpoint for m in self.members()]
+        if eps:
+            self.pull.initiate(eps[:self._node.cfg.fanout])
+
+
+class GossipNode:
+    """Reference: gossip/gossip/gossip_impl.go Node."""
+
+    def __init__(self, endpoint: str, identity_bytes: bytes, signer,
+                 transport: Transport, mcs,
+                 config: Optional[DiscoveryConfig] = None,
+                 org_id: str = ""):
+        self.endpoint = endpoint
+        self.identity = identity_bytes
+        self.pki_id = gmsg.pki_id_of(identity_bytes)
+        self.signer = signer
+        self.mcs = mcs
+        self.org_id = org_id
+        self.cfg = config or DiscoveryConfig()
+        self.incarnation = int(time.time() * 1000)
+        self._seq_lock = threading.Lock()
+        self._seq = 0
+
+        self._transport = transport
+        transport.set_handler(self._on_message)
+
+        member = gpb.Member(endpoint=endpoint, pki_id=self.pki_id,
+                            identity=identity_bytes)
+        self.discovery = Discovery(
+            member, identity_bytes, signer,
+            send=self._send_raw,
+            verify_alive=self._verify_alive,
+            config=self.cfg,
+            on_membership_change=self._membership_changed)
+        self._channels: dict[str, ChannelGossip] = {}
+        self._lock = threading.Lock()
+        self._on_membership_change: list[Callable] = []
+        self._stop = threading.Event()
+        self._pull_thread: Optional[threading.Thread] = None
+
+    def next_seq(self) -> int:
+        with self._seq_lock:
+            self._seq += 1
+            return self._seq
+
+    # -- lifecycle --
+
+    def start(self, bootstrap: list[str] = ()) -> None:
+        self.discovery.start(bootstrap)
+        self._pull_thread = threading.Thread(
+            target=self._pull_loop, name="gossip-pull", daemon=True)
+        self._pull_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.discovery.stop()
+        if self._pull_thread:
+            self._pull_thread.join(timeout=2)
+        self._transport.close()
+
+    def _pull_loop(self) -> None:
+        while not self._stop.wait(self.cfg.alive_interval_s * 2):
+            with self._lock:
+                channels = list(self._channels.values())
+            for ch in channels:
+                try:
+                    ch.pull_round()
+                except Exception:
+                    logger.exception("pull round failed")
+
+    # -- channels --
+
+    def join_channel(self, channel_id: str) -> ChannelGossip:
+        with self._lock:
+            if channel_id not in self._channels:
+                self._channels[channel_id] = ChannelGossip(
+                    self, channel_id)
+            return self._channels[channel_id]
+
+    def channel(self, channel_id: str) -> Optional[ChannelGossip]:
+        with self._lock:
+            return self._channels.get(channel_id)
+
+    # -- sending --
+
+    def _send_raw(self, endpoint: str,
+                  smsg: gpb.SignedGossipMessage) -> None:
+        self._transport.send(endpoint, smsg)
+
+    def send_endpoint(self, endpoint: str,
+                      smsg: gpb.SignedGossipMessage) -> None:
+        self._transport.send(endpoint, smsg)
+
+    def gossip_channel(self, ch: ChannelGossip,
+                       smsg: gpb.SignedGossipMessage,
+                       exclude: set = frozenset()) -> None:
+        """Push to a fanout of the channel's members; falls back to all
+        alive peers while state-info hasn't propagated yet (channel
+        membership is itself learned by gossip)."""
+        members = ch.members() or self.discovery.alive_members()
+        sent = 0
+        for m in members:
+            if m.member.endpoint in exclude:
+                continue
+            self._send_raw(m.member.endpoint, smsg)
+            sent += 1
+            if sent >= self.cfg.fanout:
+                break
+
+    def gossip_block(self, channel_id: str, seq: int,
+                     block_bytes: bytes) -> None:
+        ch = self.join_channel(channel_id)
+        msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_AND_ORG)
+        ch._tag_channel(msg)
+        msg.data_msg.seq_num = seq
+        msg.data_msg.block = block_bytes
+        smsg = gmsg.unsigned(msg)
+        ch.cache_block(seq, smsg)
+        self.gossip_channel(ch, smsg)
+
+    # -- receiving --
+
+    def _on_message(self, sender: str,
+                    smsg: gpb.SignedGossipMessage) -> None:
+        try:
+            msg = gmsg.parse(smsg)
+        except Exception:
+            logger.warning("undecodable gossip message from %s", sender)
+            return
+        if self.discovery.handle_message(sender, msg, smsg):
+            return
+        channel_id = msg.channel.decode(errors="replace")
+        ch = self.channel(channel_id)
+        if ch is None:
+            return  # not our channel
+        which = msg.WhichOneof("content")
+        if which == "state_info":
+            ch.handle_state_info(msg, smsg)
+        elif which == "data_msg":
+            self._handle_data(sender, ch, msg, smsg)
+        elif which in ("hello", "data_dig", "data_req", "data_update"):
+            ch.pull.handle(sender, msg)
+        elif which == "leadership_msg" and ch.on_leadership:
+            ch.on_leadership(sender, msg, smsg)
+        elif which == "state_request" and ch.on_state_request:
+            ch.on_state_request(sender, msg)
+        elif which == "state_response" and ch.on_state_response:
+            ch.on_state_response(sender, msg)
+        elif which == "private_data" and ch.on_pvt_push:
+            ch.on_pvt_push(sender, msg)
+        elif which == "private_req" and ch.on_pvt_request:
+            ch.on_pvt_request(sender, msg)
+        elif which == "private_res" and ch.on_pvt_response:
+            ch.on_pvt_response(sender, msg)
+
+    def _handle_data(self, sender: str, ch: ChannelGossip,
+                     msg: gpb.GossipMessage,
+                     smsg: gpb.SignedGossipMessage) -> None:
+        seq = msg.data_msg.seq_num
+        with ch._lock:
+            fresh = seq not in ch._blocks
+        if fresh:
+            ch.cache_block(seq, smsg)
+            # forward (push epidemic) before local processing
+            self.gossip_channel(ch, smsg, exclude={sender})
+        if ch.on_block is not None:
+            ch.on_block(sender, seq, bytes(msg.data_msg.block))
+
+    def _verify_alive(self, identity: bytes, signature: bytes,
+                      payload: bytes) -> bool:
+        # membership spans channels: verify against ANY channel MSPs or
+        # the local MSP (reference mcs.Verify → all channel MSPs)
+        if self.mcs.verify(identity, signature, payload):
+            return True
+        with self._lock:
+            channels = list(self._channels)
+        return any(self.mcs.verify_by_channel(cid, identity, signature,
+                                              payload)
+                   for cid in channels)
+
+    def _membership_changed(self) -> None:
+        for cb in list(self._on_membership_change):
+            try:
+                cb()
+            except Exception:
+                logger.exception("membership callback failed")
+
+    def on_membership_change(self, cb: Callable) -> None:
+        self._on_membership_change.append(cb)

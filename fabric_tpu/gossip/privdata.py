@@ -1,0 +1,213 @@
+"""Private-data gossip: push at endorsement, pull at commit, reconcile.
+
+Rebuild of `gossip/privdata/` (SURVEY §2.6): the *distributor*
+(`distributor.go`) pushes endorsement-time cleartext to peers whose org
+is in the collection policy; the *fetcher* (`pull.go`) requests missing
+cleartext from authorized peers at commit time; the *reconciler*
+(`reconcile.go`) periodically back-fills gaps recorded by the ledger.
+Responders enforce the collection ACL: cleartext is served only to
+members (the reference's `ccArtifactsRetriever` eligibility check).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.gossip import message as gmsg
+from fabric_tpu.protos import gossip as gpb, rwset as rwpb
+
+logger = logging.getLogger("gossip.privdata")
+
+
+class PrivDataProvider:
+    """Per-channel private-data gossip glue."""
+
+    def __init__(self, node, channel_id: str, peer_channel, peer,
+                 org_of_identity: Callable[[bytes], Optional[str]],
+                 reconcile_interval_s: float = 1.0):
+        self._node = node
+        self._gchannel = node.join_channel(channel_id)
+        self.channel_id = channel_id
+        self._peer_channel = peer_channel
+        self._peer = peer
+        self._org_of = org_of_identity
+        self._interval = reconcile_interval_s
+        self._gchannel.on_pvt_push = self._on_push
+        self._gchannel.on_pvt_request = self._on_request
+        self._gchannel.on_pvt_response = self._on_response
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._reconcile_loop,
+                                        name="gossip-pvt-reconciler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- collection helpers --
+
+    def _collection_config(self, ns: str, coll: str):
+        definition = self._peer_channel.chaincode_definition(ns)
+        return definition.collection(coll) if definition else None
+
+    def _member_endpoints(self, ns: str, coll: str) -> list[str]:
+        cfg = self._collection_config(ns, coll)
+        if cfg is None:
+            return []
+        out = []
+        for m in self._gchannel.members():
+            org = self._org_of(m.identity) if m.identity else None
+            if org and org in cfg.member_orgs:
+                out.append(m.member.endpoint)
+        return out
+
+    def _i_am_member(self, ns: str, coll: str) -> bool:
+        cfg = self._collection_config(ns, coll)
+        return cfg is not None and \
+            self._node.org_id in cfg.member_orgs
+
+    # -- distribution (endorsement-time push,
+    #    reference distributor.go DistributePrivateData) --
+
+    def distribute(self, tx_id: str, height: int,
+                   pvt_results: rwpb.TxPvtReadWriteSet) -> None:
+        for nspvt in pvt_results.ns_pvt_rwset:
+            for cpvt in nspvt.collection_pvt_rwset:
+                endpoints = self._member_endpoints(
+                    nspvt.namespace, cpvt.collection_name)
+                if not endpoints:
+                    continue
+                msg = gpb.GossipMessage(
+                    tag=gpb.GossipMessage.CHAN_AND_ORG)
+                self._gchannel._tag_channel(msg)
+                msg.private_data.channel = self.channel_id
+                msg.private_data.namespace = nspvt.namespace
+                msg.private_data.collection_name = cpvt.collection_name
+                msg.private_data.tx_id = tx_id
+                msg.private_data.private_rwset = cpvt.rwset
+                msg.private_data.private_sim_height = height
+                smsg = gmsg.sign_message(msg, self._node.signer)
+                for ep in endpoints:
+                    self._node.send_endpoint(ep, smsg)
+
+    def _on_push(self, sender: str, msg: gpb.GossipMessage) -> None:
+        pd = msg.private_data
+        if not self._i_am_member(pd.namespace, pd.collection_name):
+            return  # not authorized to hold this cleartext
+        single = rwpb.TxPvtReadWriteSet(
+            data_model=rwpb.TxReadWriteSet.KV)
+        single.ns_pvt_rwset.add(
+            namespace=pd.namespace).collection_pvt_rwset.add(
+            collection_name=pd.collection_name,
+            rwset=bytes(pd.private_rwset))
+        existing = self._peer.transient_store.get(pd.tx_id)
+        if existing is not None:
+            _merge_pvt(existing, single)
+            single = existing
+        self._peer.transient_store.persist(
+            pd.tx_id, pd.private_sim_height, single)
+
+    # -- pull (missing at commit / reconciliation,
+    #    reference pull.go fetchPrivateData) --
+
+    def _reconcile_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.reconcile_once()
+            except Exception:
+                logger.exception("pvt reconciliation failed")
+
+    def reconcile_once(self) -> int:
+        """Request every missing (block, tx, ns, coll) this peer is a
+        member of from authorized peers; returns #requests sent."""
+        ledger = self._peer_channel.ledger
+        missing = ledger.missing_pvt_data(max_entries=64)
+        sent = 0
+        for m in missing:
+            if not self._i_am_member(m.namespace, m.collection):
+                continue
+            endpoints = self._member_endpoints(m.namespace,
+                                               m.collection)
+            if not endpoints:
+                continue
+            msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
+            self._gchannel._tag_channel(msg)
+            d = msg.private_req.digests.add()
+            d.namespace = m.namespace
+            d.collection = m.collection
+            d.block_seq = m.block_num
+            d.seq_in_block = m.tx_num
+            smsg = gmsg.sign_message(msg, self._node.signer)
+            self._node.send_endpoint(endpoints[sent % len(endpoints)],
+                                     smsg)
+            sent += 1
+        return sent
+
+    def _on_request(self, sender: str, msg: gpb.GossipMessage) -> None:
+        # ACL: the requester's org must be a collection member
+        requester = None
+        for m in self._node.discovery.alive_members():
+            if m.member.endpoint == sender:
+                requester = m
+                break
+        req_org = self._org_of(requester.identity) \
+            if requester is not None and requester.identity else None
+        out = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
+        self._gchannel._tag_channel(out)
+        ledger = self._peer_channel.ledger
+        for d in msg.private_req.digests:
+            cfg = self._collection_config(d.namespace, d.collection)
+            if cfg is None or req_org not in cfg.member_orgs:
+                continue
+            stored = ledger.get_pvt_data_by_num(d.block_seq,
+                                                d.seq_in_block)
+            if stored is None:
+                continue
+            for nspvt in stored.ns_pvt_rwset:
+                if nspvt.namespace != d.namespace:
+                    continue
+                for cpvt in nspvt.collection_pvt_rwset:
+                    if cpvt.collection_name != d.collection:
+                        continue
+                    el = out.private_res.elements.add()
+                    el.digest.CopyFrom(d)
+                    el.payload.append(cpvt.rwset)
+        if out.private_res.elements:
+            self._node.send_endpoint(sender, gmsg.unsigned(out))
+
+    def _on_response(self, sender: str, msg: gpb.GossipMessage) -> None:
+        ledger = self._peer_channel.ledger
+        for el in msg.private_res.elements:
+            for payload in el.payload:
+                ok = ledger.commit_pvt_data_of_old_blocks(
+                    el.digest.block_seq, el.digest.seq_in_block,
+                    el.digest.namespace, el.digest.collection,
+                    bytes(payload))
+                if ok:
+                    logger.info("[%s] reconciled pvt data for block %d "
+                                "tx %d [%s/%s]", self.channel_id,
+                                el.digest.block_seq,
+                                el.digest.seq_in_block,
+                                el.digest.namespace,
+                                el.digest.collection)
+
+
+def _merge_pvt(base: rwpb.TxPvtReadWriteSet,
+               add: rwpb.TxPvtReadWriteSet) -> None:
+    for nspvt in add.ns_pvt_rwset:
+        target = next((n for n in base.ns_pvt_rwset
+                       if n.namespace == nspvt.namespace), None)
+        if target is None:
+            base.ns_pvt_rwset.add().CopyFrom(nspvt)
+            continue
+        for cpvt in nspvt.collection_pvt_rwset:
+            if not any(c.collection_name == cpvt.collection_name
+                       for c in target.collection_pvt_rwset):
+                target.collection_pvt_rwset.add().CopyFrom(cpvt)

@@ -1,0 +1,104 @@
+"""Digest-based pull engine (anti-entropy redundancy channel).
+
+Rebuild of `gossip/gossip/pull/pullstore.go` + `gossip/gossip/algo/`
+(PullEngine): initiator sends Hello(nonce) → responder answers with its
+item digests → initiator requests the digests it lacks → responder
+ships the items. Used for block dissemination redundancy (the primary
+path is push; the state module's range transfer handles large gaps).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from fabric_tpu.protos import gossip as gpb
+
+logger = logging.getLogger("gossip.pull")
+
+
+class PullMediator:
+    """One pull protocol instance (per channel, per msg type)."""
+
+    def __init__(self, msg_type: int,
+                 digests: Callable[[], list[bytes]],
+                 fetch: Callable[[bytes],
+                                 Optional[gpb.SignedGossipMessage]],
+                 store: Callable[[bytes, gpb.SignedGossipMessage], None],
+                 send: Callable[[str, gpb.GossipMessage], None],
+                 interval_s: float = 0.5):
+        self._type = msg_type
+        self._digests = digests
+        self._fetch = fetch
+        self._store = store
+        self._send = send
+        self._interval = interval_s
+        self._nonce_lock = threading.Lock()
+        self._nonce = int(time.monotonic() * 1e6) & 0xFFFFFFFF
+        self._pending: dict[int, str] = {}   # nonce -> endpoint
+
+    def _next_nonce(self) -> int:
+        with self._nonce_lock:
+            self._nonce = (self._nonce + 1) & 0x7FFFFFFFFFFFFFFF
+            return self._nonce
+
+    # -- initiator side --
+
+    def initiate(self, endpoints: list[str]) -> None:
+        for ep in endpoints:
+            nonce = self._next_nonce()
+            with self._nonce_lock:
+                self._pending[nonce] = ep
+            msg = gpb.GossipMessage(nonce=nonce,
+                                    tag=gpb.GossipMessage.CHAN_ONLY)
+            msg.hello.msg_type = self._type
+            msg.hello.nonce = nonce
+            self._send(ep, msg)
+
+    def handle(self, sender: str, msg: gpb.GossipMessage) -> bool:
+        which = msg.WhichOneof("content")
+        if which == "hello" and msg.hello.msg_type == self._type:
+            out = gpb.GossipMessage(nonce=msg.hello.nonce,
+                                    tag=gpb.GossipMessage.CHAN_ONLY)
+            out.data_dig.msg_type = self._type
+            out.data_dig.nonce = msg.hello.nonce
+            out.data_dig.digests.extend(self._digests())
+            self._send(sender, out)
+            return True
+        if which == "data_dig" and msg.data_dig.msg_type == self._type:
+            with self._nonce_lock:
+                expected = self._pending.pop(msg.data_dig.nonce, None)
+            if expected is None:
+                return True
+            have = set(self._digests())
+            want = [d for d in msg.data_dig.digests
+                    if bytes(d) not in have]
+            if not want:
+                return True
+            out = gpb.GossipMessage(nonce=msg.data_dig.nonce,
+                                    tag=gpb.GossipMessage.CHAN_ONLY)
+            out.data_req.msg_type = self._type
+            out.data_req.nonce = msg.data_dig.nonce
+            out.data_req.digests.extend(want)
+            self._send(sender, out)
+            return True
+        if which == "data_req" and msg.data_req.msg_type == self._type:
+            out = gpb.GossipMessage(nonce=msg.data_req.nonce,
+                                    tag=gpb.GossipMessage.CHAN_ONLY)
+            out.data_update.msg_type = self._type
+            out.data_update.nonce = msg.data_req.nonce
+            for d in msg.data_req.digests:
+                item = self._fetch(bytes(d))
+                if item is not None:
+                    out.data_update.data.append(item)
+            if out.data_update.data:
+                self._send(sender, out)
+            return True
+        if which == "data_update" and \
+                msg.data_update.msg_type == self._type:
+            for item in msg.data_update.data:
+                self._store(b"", item)
+            return True
+        return False

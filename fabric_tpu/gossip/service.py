@@ -1,0 +1,143 @@
+"""Gossip service: per-channel assembly of discovery, election, state
+transfer and private-data gossip.
+
+Rebuild of `gossip/service/gossip_service.go` (538 ln, wired at
+`internal/peer/node/start.go:451-466,1187`): one GossipNode per peer;
+per joined channel — leader election decides which org peer runs the
+deliver client against the ordering service; the elected leader feeds
+fetched blocks into the state provider (which gossips them to the
+org's other peers and commits in order); everyone reconciles private
+data.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fabric_tpu.gossip.discovery import DiscoveryConfig
+from fabric_tpu.gossip.election import LeaderElectionService
+from fabric_tpu.gossip.node import GossipNode
+from fabric_tpu.gossip.privdata import PrivDataProvider
+from fabric_tpu.gossip.state import GossipStateProvider
+from fabric_tpu.gossip.transport import Transport
+
+logger = logging.getLogger("gossip.service")
+
+
+class _LeaderChannelAdapter:
+    """What the leader's Deliverer sees: blocks it fetches go through
+    the gossip state pipeline (buffer → verify → commit → push to
+    peers) instead of straight to commit."""
+
+    def __init__(self, peer_channel, state_provider):
+        self._peer_channel = peer_channel
+        self._state = state_provider
+
+    @property
+    def channel_id(self):
+        return self._peer_channel.channel_id
+
+    @property
+    def ledger(self):
+        return self._peer_channel.ledger
+
+    def process_block(self, block):
+        self._state.add_local_block(block)
+        # wait for the ordered commit so the deliverer's seek position
+        # (ledger.height) advances before the next iteration
+        self._peer_channel.wait_for_height(block.header.number + 1,
+                                           timeout=30)
+
+
+@dataclass
+class ChannelGossipResources:
+    election: LeaderElectionService
+    state: GossipStateProvider
+    privdata: PrivDataProvider
+    deliverer: object = None
+
+
+class GossipService:
+    def __init__(self, peer, transport: Transport, mcs,
+                 org_id: str,
+                 config: Optional[DiscoveryConfig] = None):
+        identity = peer.signer.serialize()
+        self.node = GossipNode(transport.endpoint, identity,
+                               peer.signer, transport, mcs,
+                               config=config, org_id=org_id)
+        self._peer = peer
+        self._mcs = mcs
+        self._org_id = org_id
+        self._channels: dict[str, ChannelGossipResources] = {}
+
+    def start(self, bootstrap: list[str] = ()) -> None:
+        self.node.start(bootstrap)
+
+    def stop(self) -> None:
+        for res in self._channels.values():
+            if res.deliverer is not None:
+                res.deliverer.stop()
+            res.election.stop()
+            res.state.stop()
+            res.privdata.stop()
+        self.node.stop()
+
+    def _org_of_identity(self, identity_bytes: bytes) -> Optional[str]:
+        """Resolve a peer identity to its MSP ID via any channel's MSP
+        manager (reference: SecurityAdvisor.OrgByPeerIdentity)."""
+        for channel_id in list(self._channels):
+            bundle = self._peer.channel(channel_id).bundle()
+            try:
+                ident = bundle.msp_manager.deserialize_identity(
+                    identity_bytes)
+                return ident.mspid()
+            except Exception:
+                continue
+        return None
+
+    def initialize_channel(self, peer_channel,
+                           deliverer_factory: Callable,
+                           ) -> ChannelGossipResources:
+        """`deliverer_factory(channel_like)` → a Deliverer-like object
+        with start()/stop(); started only while this peer leads."""
+        channel_id = peer_channel.channel_id
+        state = GossipStateProvider(self.node, channel_id, peer_channel,
+                                    self._mcs)
+        privdata = PrivDataProvider(self.node, channel_id, peer_channel,
+                                    self._peer, self._org_of_identity)
+        res = ChannelGossipResources(election=None, state=state,
+                                     privdata=privdata)
+
+        def on_gain():
+            if res.deliverer is None:
+                adapter = _LeaderChannelAdapter(peer_channel, state)
+                res.deliverer = deliverer_factory(adapter)
+                res.deliverer.start()
+                logger.info("[%s] %s leads: deliver client started",
+                            channel_id, self.node.endpoint)
+
+        def on_lose():
+            d, res.deliverer = res.deliverer, None
+            if d is not None:
+                d.stop()
+                logger.info("[%s] %s no longer leads: deliver client "
+                            "stopped", channel_id, self.node.endpoint)
+
+        res.election = LeaderElectionService(
+            self.node, channel_id, on_gain, on_lose,
+            propose_interval_s=self.node.cfg.alive_interval_s,
+            leader_alive_s=self.node.cfg.alive_expiration_s)
+        state.start()
+        privdata.start()
+        res.election.start()
+        self._channels[channel_id] = res
+        return res
+
+    def distribute_private_data(self, channel_id: str, tx_id: str,
+                                height: int, pvt_results) -> None:
+        """Endorsement-time hook (reference endorser.go:234)."""
+        res = self._channels.get(channel_id)
+        if res is not None:
+            res.privdata.distribute(tx_id, height, pvt_results)

@@ -1,0 +1,212 @@
+"""Gossip state transfer: ordered block delivery + anti-entropy.
+
+Rebuild of `gossip/state/state.go` (815 ln): blocks arrive out of order
+from push/pull gossip; a payload buffer holds them until the next
+in-sequence block is available (`payloads_buffer.go`), each block is
+verified (MCS VerifyBlock — batched orderer-signature check) exactly
+once before commit, and an anti-entropy loop compares the local height
+against channel peers' advertised heights (state-info) and requests
+missing ranges (`handleStateRequest:418`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from fabric_tpu.gossip import message as gmsg
+from fabric_tpu.protos import common, gossip as gpb
+
+logger = logging.getLogger("gossip.state")
+
+MAX_RANGE = 10  # blocks per state request (reference defAntiEntropyBatchSize)
+
+
+class PayloadBuffer:
+    """Min-buffer keyed by seq; pops only the exact next height
+    (reference: payloads_buffer.go PayloadsBuffer)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._payloads: dict[int, bytes] = {}
+        self.ready = threading.Event()
+        self._next = 0
+
+    def set_next(self, seq: int) -> None:
+        with self._lock:
+            self._next = seq
+            for old in [s for s in self._payloads if s < seq]:
+                del self._payloads[old]
+            if self._next in self._payloads:
+                self.ready.set()
+
+    def push(self, seq: int, block_bytes: bytes) -> None:
+        with self._lock:
+            if seq < self._next or seq in self._payloads:
+                return
+            self._payloads[seq] = block_bytes
+            if seq == self._next:
+                self.ready.set()
+
+    def pop(self) -> Optional[tuple[int, bytes]]:
+        with self._lock:
+            data = self._payloads.pop(self._next, None)
+            if data is None:
+                self.ready.clear()
+                return None
+            seq = self._next
+            self._next += 1
+            if self._next not in self._payloads:
+                self.ready.clear()
+            return seq, data
+
+    @property
+    def next_seq(self) -> int:
+        with self._lock:
+            return self._next
+
+
+class GossipStateProvider:
+    """Glues a ChannelGossip to a peer channel (ledger)."""
+
+    def __init__(self, node, channel_id: str, peer_channel, mcs,
+                 anti_entropy_interval_s: float = 0.5):
+        """`peer_channel` duck-type: .ledger.height, .get_block(num),
+        .process_block(block) — fabric_tpu.peer.Channel satisfies it."""
+        self._node = node
+        self._gchannel = node.join_channel(channel_id)
+        self.channel_id = channel_id
+        self._peer = peer_channel
+        self._mcs = mcs
+        self._interval = anti_entropy_interval_s
+        self.buffer = PayloadBuffer()
+        self.buffer.set_next(peer_channel.ledger.height)
+
+        self._gchannel.on_block = self._on_block
+        self._gchannel.on_state_request = self._on_state_request
+        self._gchannel.on_state_response = self._on_state_response
+
+        self._stop = threading.Event()
+        self._commit_thread: Optional[threading.Thread] = None
+        self._ae_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._commit_thread = threading.Thread(
+            target=self._commit_loop, name="gossip-state-commit",
+            daemon=True)
+        self._commit_thread.start()
+        self._ae_thread = threading.Thread(
+            target=self._anti_entropy_loop, name="gossip-anti-entropy",
+            daemon=True)
+        self._ae_thread.start()
+        self._publish_height()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.buffer.ready.set()  # wake the commit loop
+        for t in (self._commit_thread, self._ae_thread):
+            if t:
+                t.join(timeout=2)
+
+    # -- ingest --
+
+    def _on_block(self, sender: str, seq: int,
+                  block_bytes: bytes) -> None:
+        self.buffer.push(seq, block_bytes)
+
+    def add_local_block(self, block: common.Block,
+                        gossip_out: bool = True) -> None:
+        """Leader path: a block fetched from the orderer enters the
+        same pipeline AND is pushed to the channel."""
+        raw = block.SerializeToString()
+        self.buffer.push(block.header.number, raw)
+        if gossip_out:
+            self._node.gossip_block(self.channel_id,
+                                    block.header.number, raw)
+
+    # -- ordered verify → commit --
+
+    def _commit_loop(self) -> None:
+        while not self._stop.is_set():
+            if not self.buffer.ready.wait(timeout=0.2):
+                continue
+            if self._stop.is_set():
+                return
+            item = self.buffer.pop()
+            if item is None:
+                continue
+            seq, raw = item
+            try:
+                block = common.Block()
+                block.ParseFromString(raw)
+                self._mcs.verify_block(self.channel_id, seq, block)
+            except Exception as e:
+                logger.warning("[%s] gossiped block [%d] rejected: %s",
+                               self.channel_id, seq, e)
+                self.buffer.set_next(seq)  # retry from another peer
+                continue
+            try:
+                self._peer.process_block(block)
+            except Exception:
+                logger.exception("[%s] commit of block [%d] failed",
+                                 self.channel_id, seq)
+                self.buffer.set_next(seq)
+                continue
+            self._publish_height()
+
+    def _publish_height(self) -> None:
+        try:
+            self._gchannel.publish_state_info(self._peer.ledger.height)
+        except Exception:
+            logger.exception("state-info publish failed")
+
+    # -- anti-entropy (reference state.go:494 antiEntropy) --
+
+    def _anti_entropy_loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._publish_height()
+                self._request_missing()
+            except Exception:
+                logger.exception("anti-entropy failed")
+
+    def _request_missing(self) -> None:
+        my_height = self._peer.ledger.height
+        heights = self._gchannel.heights()
+        best = max(heights.values(), default=0)
+        if best <= my_height:
+            return
+        target_pki = next(p for p, h in heights.items() if h == best)
+        info = self._node.discovery.lookup(target_pki)
+        if info is None:
+            return
+        msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
+        self._gchannel._tag_channel(msg)
+        msg.state_request.start_seq_num = my_height
+        msg.state_request.end_seq_num = min(best - 1,
+                                            my_height + MAX_RANGE - 1)
+        self._node.send_endpoint(info.member.endpoint,
+                                 gmsg.unsigned(msg))
+
+    def _on_state_request(self, sender: str,
+                          msg: gpb.GossipMessage) -> None:
+        start = msg.state_request.start_seq_num
+        end = min(msg.state_request.end_seq_num,
+                  start + MAX_RANGE - 1,
+                  self._peer.ledger.height - 1)
+        out = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
+        self._gchannel._tag_channel(out)
+        for seq in range(start, end + 1):
+            block = self._peer.get_block(seq)
+            if block is None:
+                break
+            out.state_response.payloads.add(
+                seq_num=seq, block=block.SerializeToString())
+        if out.state_response.payloads:
+            self._node.send_endpoint(sender, gmsg.unsigned(out))
+
+    def _on_state_response(self, sender: str,
+                           msg: gpb.GossipMessage) -> None:
+        for payload in msg.state_response.payloads:
+            self.buffer.push(payload.seq_num, bytes(payload.block))

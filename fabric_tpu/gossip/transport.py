@@ -1,0 +1,154 @@
+"""Gossip transport: pluggable message fabric.
+
+Rebuild of `gossip/comm/comm_impl.go` behind an interface: the
+reference speaks gRPC `GossipStream` bidi streams with a signed
+connection handshake; here the contract is narrowed to what the gossip
+core needs — send-to-endpoint and an incoming-message callback — so an
+in-process fabric (this file, the unit-test and single-process
+topology) and the gRPC fabric (`fabric_tpu/comm/gossip_grpc.py`) are
+interchangeable.
+
+Delivery is asynchronous through a per-node inbox thread (mirroring the
+reference's per-connection goroutines): a handler may send more
+messages without deadlocking, and a slow peer cannot stall the sender
+(bounded inbox, drop-oldest — gossip is loss-tolerant by design).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.protos import gossip as gpb
+
+logger = logging.getLogger("gossip.comm")
+
+Handler = Callable[[str, gpb.SignedGossipMessage], None]
+
+
+class Transport:
+    """The seam. Implementations: LocalTransport (in-proc),
+    GRPCTransport (fabric_tpu/comm)."""
+
+    endpoint: str
+
+    def send(self, endpoint: str, msg: gpb.SignedGossipMessage) -> None:
+        raise NotImplementedError
+
+    def set_handler(self, handler: Handler) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    def __init__(self, network: "LocalNetwork", endpoint: str,
+                 inbox_size: int = 1024):
+        self.endpoint = endpoint
+        self._net = network
+        self._handler: Optional[Handler] = None
+        self._inbox: queue.Queue = queue.Queue(maxsize=inbox_size)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._drain, name=f"gossip-inbox-{endpoint}",
+            daemon=True)
+        self._thread.start()
+
+    def send(self, endpoint: str, msg: gpb.SignedGossipMessage) -> None:
+        self._net.deliver(self.endpoint, endpoint, msg)
+
+    def set_handler(self, handler: Handler) -> None:
+        self._handler = handler
+
+    # -- called by the network --
+
+    def enqueue(self, sender: str, msg: gpb.SignedGossipMessage) -> None:
+        try:
+            self._inbox.put_nowait((sender, msg))
+        except queue.Full:
+            # drop-oldest: stale gossip is worthless, fresh is not
+            try:
+                self._inbox.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                self._inbox.put_nowait((sender, msg))
+            except queue.Full:
+                pass
+
+    def _drain(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sender, msg = self._inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            handler = self._handler
+            if handler is None:
+                continue
+            try:
+                handler(sender, msg)
+            except Exception:
+                logger.exception("[%s] gossip handler failed",
+                                 self.endpoint)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._net.unregister(self.endpoint)
+        self._thread.join(timeout=2)
+
+
+class LocalNetwork:
+    """In-process message fabric with fault injection for tests
+    (reference analog: gossip tests spin N in-proc instances on
+    localhost ports — `gossip/gossip/gossip_test.go`)."""
+
+    def __init__(self):
+        self._nodes: dict[str, LocalTransport] = {}
+        self._lock = threading.Lock()
+        self._partitions: set[frozenset] = set()
+        self.drop_fraction = 0.0
+        self._drop_seq = 0
+
+    def register(self, endpoint: str) -> LocalTransport:
+        t = LocalTransport(self, endpoint)
+        with self._lock:
+            self._nodes[endpoint] = t
+        return t
+
+    def unregister(self, endpoint: str) -> None:
+        with self._lock:
+            self._nodes.pop(endpoint, None)
+
+    # -- fault injection --
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str = None, b: str = None) -> None:
+        with self._lock:
+            if a is None:
+                self._partitions.clear()
+            else:
+                self._partitions.discard(frozenset((a, b)))
+
+    def deliver(self, sender: str, target: str,
+                msg: gpb.SignedGossipMessage) -> None:
+        with self._lock:
+            node = self._nodes.get(target)
+            cut = frozenset((sender, target)) in self._partitions
+        if node is None or cut:
+            return
+        if self.drop_fraction:
+            # deterministic drop pattern (no RNG: reproducible tests)
+            self._drop_seq += 1
+            if (self._drop_seq % 100) < self.drop_fraction * 100:
+                return
+        node.enqueue(sender, msg)
+
+    def endpoints(self) -> list[str]:
+        with self._lock:
+            return sorted(self._nodes)

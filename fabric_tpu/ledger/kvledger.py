@@ -384,6 +384,70 @@ class KVLedger:
                 entries.append((ns, coll, w.key if w else "",
                                 hw.key_hash))
 
+    def commit_pvt_data_of_old_blocks(
+            self, block_num: int, tx_num: int, ns: str, coll: str,
+            coll_rwset_bytes: bytes) -> bool:
+        """Reconciliation path (reference:
+        `CommitPvtDataOfOldBlocks`, gossip/privdata/reconcile.go):
+        cleartext for an already-committed block arrives late. It is
+        accepted only if (a) it hashes to the block's recorded
+        pvt_rwset_hash and (b) per key, the hashed state's current
+        version still points at (block_num, tx_num) — otherwise a later
+        tx superseded the key and the stale cleartext must not be
+        applied to current state (it is still stored for serving
+        historical pvt queries)."""
+        block = self.block_store.get_block_by_number(block_num)
+        if block is None or tx_num >= len(block.data.data):
+            return False
+        txrw = extract_tx_rwset(block.data.data[tx_num])
+        if txrw is None:
+            return False
+        chrw = next(
+            (c for nsrw in txrw.ns_rwset if nsrw.namespace == ns
+             for c in nsrw.collection_hashed_rwset
+             if c.collection_name == coll), None)
+        if chrw is None or \
+                pvt.pvt_rwset_hash(coll_rwset_bytes) != \
+                chrw.pvt_rwset_hash:
+            return False
+
+        kv = rwpb.KVRWSet()
+        kv.ParseFromString(coll_rwset_bytes)
+        height = Height(block_num, tx_num)
+        batch = UpdateBatch()
+        pns = pvt.pvt_ns(ns, coll)
+        hns = pvt.hash_ns(ns, coll)
+        for w in kv.writes:
+            hkey = pvt.hashed_key_str(pvt.key_hash(w.key))
+            if self.state_db.get_version(hns, hkey) != height:
+                continue  # superseded (or expired) since
+            if w.is_delete:
+                batch.delete(pns, w.key, height)
+            else:
+                batch.put(pns, w.key, w.value, height)
+        if batch.updates:
+            self.state_db.apply_writes_only(batch)
+
+        # persist + clear the missing marker
+        store_batch = self.pvt_store._db.new_batch()
+        existing = self.pvt_store.get_pvt_data(block_num, tx_num) or \
+            rwpb.TxPvtReadWriteSet(data_model=rwpb.TxReadWriteSet.KV)
+        nspvt = next((n for n in existing.ns_pvt_rwset
+                      if n.namespace == ns), None)
+        if nspvt is None:
+            nspvt = existing.ns_pvt_rwset.add(namespace=ns)
+        if not any(c.collection_name == coll
+                   for c in nspvt.collection_pvt_rwset):
+            nspvt.collection_pvt_rwset.add(collection_name=coll,
+                                           rwset=coll_rwset_bytes)
+        self.pvt_store.prepare_batch(store_batch, block_num,
+                                     {tx_num: existing})
+        self.pvt_store.resolve_missing(
+            store_batch, pvt.MissingPvtData(block_num, tx_num, ns,
+                                            coll))
+        self.pvt_store._db.write_batch(store_batch)
+        return True
+
     def _drop_expired_bookkeeping(self, block_num: int) -> None:
         expired = self.pvt_store.expired_entries(block_num)
         if not expired:

@@ -102,6 +102,18 @@ class StateDB:
         wb.put(_SAVEPOINT, height.pack())
         self._db.write_batch(wb)
 
+    def apply_writes_only(self, batch: UpdateBatch) -> None:
+        """Apply updates WITHOUT advancing the savepoint — the
+        reconciliation path back-fills old-block private data and must
+        not disturb crash-recovery bookkeeping."""
+        wb = self._db.new_batch()
+        for (ns, key), vv in batch.updates.items():
+            if vv is None:
+                wb.delete(self._k(ns, key))
+            else:
+                wb.put(self._k(ns, key), vv.version.pack() + vv.value)
+        self._db.write_batch(wb)
+
     def savepoint(self) -> Optional[Height]:
         raw = self._db.get(_SAVEPOINT)
         return Height.unpack(raw) if raw else None

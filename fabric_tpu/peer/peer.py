@@ -244,6 +244,7 @@ class Peer:
             lambda cid: (self.channels[cid].bundle()
                          if cid in self.channels else None),
             local_deserializer=local_msp)
+        self.gossip_service = None   # attached by node assembly
         self.endorser = endorser_mod.Endorser(
             self.signer, self.chaincode_support, self._channel_support)
         # reopen any previously joined channels (start.go:770
@@ -264,11 +265,18 @@ class Peer:
         if channel is None:
             return None
         bundle = channel.bundle()
+        distributor = None
+        if self.gossip_service is not None:
+            gs = self.gossip_service
+            distributor = (lambda tx_id, height, pvt_results:
+                           gs.distribute_private_data(
+                               channel_id, tx_id, height, pvt_results))
         return endorser_mod.ChannelSupport(
             ledger=channel.ledger,
             policy_manager=bundle.policy_manager,
             deserializer=bundle.msp_manager,
-            transient_store=self.transient_store)
+            transient_store=self.transient_store,
+            pvt_distributor=distributor)
 
     # -- channel lifecycle (reference: cscc JoinChain →
     #    peer.CreateChannel, core/peer/channel.go) --
