@@ -165,7 +165,7 @@ def new_orderer_group(ord_cfg: dict) -> ctxpb.ConfigGroup:
 
     ctype = ord_cfg.get("OrdererType", "solo")
     consensus = ctxpb.ConsensusType(type=ctype)
-    if ctype == "raft":
+    if ctype in ("raft", "etcdraft"):
         raft = ord_cfg.get("Raft") or {}
         meta = ctxpb.ConsensusMetadata()
         for c in raft.get("Consenters", []):

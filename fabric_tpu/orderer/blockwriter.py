@@ -33,6 +33,12 @@ class BlockWriter:
     def last_block(self):
         return self._last
 
+    def resync(self, last_block: common.Block) -> None:
+        """Adopt an externally appended block (catch-up/onboarding) as
+        the new chain tip."""
+        with self._lock:
+            self._last = last_block
+
     def create_next_block(self, envelopes) -> common.Block:
         """Reference: `CreateNextBlock:67`."""
         with self._lock:

@@ -1,0 +1,74 @@
+"""Channel participation admin API (system-channel-less operation).
+
+Rebuild of `orderer/common/channelparticipation/` — the operator API
+behind `osnadmin channel {join,list,remove}`: join a channel from a
+config block (genesis, or a later config block → onboarding/follower
+mode), list channels with their consensus relation and height, remove
+a channel. The HTTP surface rides on the operations server
+(fabric_tpu/node); this module is the transport-free core.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fabric_tpu.protos import common, orderer as opb
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("orderer.channelparticipation")
+
+
+class ParticipationError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class ChannelParticipation:
+    def __init__(self, registrar):
+        self._registrar = registrar
+
+    def join(self, config_block_bytes: bytes) -> opb.ChannelInfo:
+        try:
+            block = common.Block()
+            block.ParseFromString(config_block_bytes)
+        except Exception as e:
+            raise ParticipationError(400, f"invalid config block: {e}")
+        if not pu.is_config_block(block):
+            raise ParticipationError(
+                400, "the submitted block is not a config block")
+        try:
+            support = self._registrar.join(block)
+        except ValueError as e:
+            msg = str(e)
+            status = 405 if "already exists" in msg else 400
+            raise ParticipationError(status, msg)
+        return self.info(support.channel_id)
+
+    def list(self) -> opb.ChannelList:
+        out = opb.ChannelList()
+        for name in self._registrar.channel_list():
+            out.channels.append(self.info(name))
+        return out
+
+    def info(self, channel_id: str) -> opb.ChannelInfo:
+        support = self._registrar.get_chain(channel_id)
+        if support is None:
+            raise ParticipationError(
+                404, f"channel {channel_id} does not exist")
+        relation = "consenter"
+        chain = support.chain
+        if type(chain).__name__ == "FollowerChain":
+            relation = "follower"
+        return opb.ChannelInfo(
+            name=channel_id,
+            consensus_relation=relation,
+            status="active" if not chain.errored() else "inactive",
+            height=support.ledger.height)
+
+    def remove(self, channel_id: str) -> None:
+        if self._registrar.get_chain(channel_id) is None:
+            raise ParticipationError(
+                404, f"channel {channel_id} does not exist")
+        self._registrar.remove(channel_id)
+        logger.info("channel %s removed", channel_id)

@@ -1,0 +1,201 @@
+"""Orderer-to-orderer cluster communication seam.
+
+Rebuild of `orderer/common/cluster/{comm.go,service.go,rpc.go}`: the
+Step RPC carries two payload kinds — SubmitRequest (follower forwards a
+tx to the leader) and ConsensusRequest (raft messages) — plus the
+block-pulling used for catch-up/onboarding
+(`orderer/common/cluster/{replication,deliver}.go`, which the reference
+implements over the Deliver API). The interface is transport-agnostic:
+`LocalClusterNetwork` is the in-process fabric; the gRPC fabric
+(fabric_tpu/comm) exposes the same surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.protos import common, orderer as opb
+
+logger = logging.getLogger("orderer.cluster")
+
+
+class ClusterTransport:
+    """What a consenter chain needs from the cluster fabric."""
+
+    endpoint: str
+
+    def send_consensus(self, target: str, channel: str,
+                       payload: bytes) -> None:
+        raise NotImplementedError
+
+    def submit(self, target: str, channel: str,
+               env_bytes: bytes) -> opb.SubmitResponse:
+        raise NotImplementedError
+
+    def pull_blocks(self, target: str, channel: str, start: int,
+                    end: int) -> list[common.Block]:
+        raise NotImplementedError
+
+    def set_handler(self, channel: str, handler) -> None:
+        """handler duck-type: on_consensus(sender, payload_bytes),
+        on_submit(env_bytes) -> SubmitResponse,
+        serve_blocks(start, end) -> list[Block]."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class LocalClusterTransport(ClusterTransport):
+    def __init__(self, network: "LocalClusterNetwork", endpoint: str):
+        self.endpoint = endpoint
+        self._net = network
+        self._handlers: dict[str, object] = {}
+        self._inbox: queue.Queue = queue.Queue(maxsize=4096)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._drain, name=f"cluster-{endpoint}", daemon=True)
+        self._thread.start()
+
+    def set_handler(self, channel: str, handler) -> None:
+        self._handlers[channel] = handler
+
+    def remove_handler(self, channel: str) -> None:
+        self._handlers.pop(channel, None)
+
+    # -- outbound --
+
+    def send_consensus(self, target: str, channel: str,
+                       payload: bytes) -> None:
+        self._net.route_consensus(self.endpoint, target, channel,
+                                  payload)
+
+    def submit(self, target: str, channel: str,
+               env_bytes: bytes) -> opb.SubmitResponse:
+        return self._net.route_submit(self.endpoint, target, channel,
+                                      env_bytes)
+
+    def pull_blocks(self, target: str, channel: str, start: int,
+                    end: int) -> list[common.Block]:
+        return self._net.route_pull(self.endpoint, target, channel,
+                                    start, end)
+
+    # -- inbound (async consensus path only; submit/pull are RPCs) --
+
+    def enqueue_consensus(self, sender: str, channel: str,
+                          payload: bytes) -> None:
+        try:
+            self._inbox.put_nowait((sender, channel, payload))
+        except queue.Full:
+            logger.warning("[%s] cluster inbox full; dropping raft msg",
+                           self.endpoint)
+
+    def _drain(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sender, channel, payload = self._inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            handler = self._handlers.get(channel)
+            if handler is None:
+                continue
+            try:
+                handler.on_consensus(sender, payload)
+            except Exception:
+                logger.exception("[%s] consensus handler failed",
+                                 self.endpoint)
+
+    def handle_submit(self, channel: str,
+                      env_bytes: bytes) -> opb.SubmitResponse:
+        handler = self._handlers.get(channel)
+        if handler is None:
+            return opb.SubmitResponse(
+                channel=channel,
+                status=common.Status.NOT_FOUND,
+                info=f"channel {channel} not served here")
+        return handler.on_submit(env_bytes)
+
+    def handle_pull(self, channel: str, start: int,
+                    end: int) -> list[common.Block]:
+        handler = self._handlers.get(channel)
+        if handler is None:
+            return []
+        return handler.serve_blocks(start, end)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._net.unregister(self.endpoint)
+        self._thread.join(timeout=2)
+
+
+class LocalClusterNetwork:
+    """In-proc cluster fabric with partitions (crash-fault tests)."""
+
+    def __init__(self):
+        self._nodes: dict[str, LocalClusterTransport] = {}
+        self._lock = threading.Lock()
+        self._down: set[str] = set()
+        self._partitions: set[frozenset] = set()
+
+    def register(self, endpoint: str) -> LocalClusterTransport:
+        t = LocalClusterTransport(self, endpoint)
+        with self._lock:
+            self._nodes[endpoint] = t
+            self._down.discard(endpoint)
+        return t
+
+    def unregister(self, endpoint: str) -> None:
+        with self._lock:
+            self._nodes.pop(endpoint, None)
+
+    # fault injection
+    def take_down(self, endpoint: str) -> None:
+        with self._lock:
+            self._down.add(endpoint)
+
+    def bring_up(self, endpoint: str) -> None:
+        with self._lock:
+            self._down.discard(endpoint)
+
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._partitions.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        with self._lock:
+            self._partitions.clear()
+
+    def _reachable(self, sender: str, target: str) -> Optional[
+            LocalClusterTransport]:
+        with self._lock:
+            if sender in self._down or target in self._down:
+                return None
+            if frozenset((sender, target)) in self._partitions:
+                return None
+            return self._nodes.get(target)
+
+    def route_consensus(self, sender: str, target: str, channel: str,
+                        payload: bytes) -> None:
+        node = self._reachable(sender, target)
+        if node is not None:
+            node.enqueue_consensus(sender, channel, payload)
+
+    def route_submit(self, sender: str, target: str, channel: str,
+                     env_bytes: bytes) -> opb.SubmitResponse:
+        node = self._reachable(sender, target)
+        if node is None:
+            return opb.SubmitResponse(
+                channel=channel,
+                status=common.Status.SERVICE_UNAVAILABLE,
+                info=f"{target} unreachable")
+        return node.handle_submit(channel, env_bytes)
+
+    def route_pull(self, sender: str, target: str, channel: str,
+                   start: int, end: int) -> list[common.Block]:
+        node = self._reachable(sender, target)
+        if node is None:
+            return []
+        return node.handle_pull(channel, start, end)

@@ -54,6 +54,10 @@ class OrdererLedger:
     def get_block(self, number: int) -> Optional[common.Block]:
         return self.block_store.get_block_by_number(number)
 
+    def db_handle(self, name: str) -> DBHandle:
+        """A named keyspace in the channel's KV store (raft WAL etc.)."""
+        return DBHandle(self._kv, name)
+
     def wait_for_block(self, number: int,
                        timeout: Optional[float] = None) -> bool:
         """Block until height > number (i.e. block `number` exists)."""
@@ -171,6 +175,28 @@ class ChainSupport:
         self._last_config_number = block.header.number
         self._apply_config_block(block)
 
+    def append_onboarded_block(self, block: common.Block) -> None:
+        """Catch-up path (reference `orderer/common/cluster/util.go:202`
+        VerifyBlocks): a block pulled from another orderer keeps ITS
+        signatures — verify them against this channel's BlockValidation
+        policy, then append verbatim and resync the writer/config."""
+        if block.header.number != self.ledger.height:
+            raise ValueError(
+                f"onboarding block {block.header.number} out of order "
+                f"(height {self.ledger.height})")
+        expected = pu.block_data_hash(block.data)
+        if block.header.data_hash != expected:
+            raise ValueError("onboarding block data hash mismatch")
+        signed = pu.block_signature_set(block)
+        policy = self.bundle().policy_manager.get_policy(
+            "/Channel/Orderer/BlockValidation")
+        policy.evaluate_signed_data(signed)
+        self.ledger.add_block(block)
+        self.writer.resync(block)
+        if pu.is_config_block(block):
+            self._last_config_number = block.header.number
+            self._apply_config_block(block)
+
     def halt(self) -> None:
         self.chain.halt()
 
@@ -266,11 +292,15 @@ class Registrar:
         return support
 
     def remove(self, channel_id: str) -> None:
+        """Channel-participation remove: halt the chain and delete the
+        channel's ledger (reference registrar.RemoveChannel)."""
         with self._lock:
             support = self._chains.pop(channel_id, None)
         if support is not None:
             support.halt()
             support.ledger.close()
+            shutil.rmtree(os.path.join(self._root, channel_id),
+                          ignore_errors=True)
 
     def get_chain(self, channel_id: str) -> Optional[ChainSupport]:
         with self._lock:

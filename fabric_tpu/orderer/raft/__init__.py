@@ -1,0 +1,3 @@
+from fabric_tpu.orderer.raft.chain import RaftChain, consenter  # noqa: F401
+from fabric_tpu.orderer.raft.core import RaftNode, Ready  # noqa: F401
+from fabric_tpu.orderer.raft.storage import RaftStorage  # noqa: F401

@@ -1,0 +1,462 @@
+"""Raft consenter chain: ordering via replicated consensus.
+
+Rebuild of `orderer/consensus/etcdraft/chain.go` (`Order:388`,
+`Submit:529`, `run:599`, `propose:930`, `writeBlock:857`): the elected
+raft leader drains submitted envelopes through the blockcutter, creates
+blocks with a local block creator (decoupled from the block writer —
+in-flight blocks are not yet written), and proposes the serialized
+block as a raft entry; every consenter writes committed entries through
+its own BlockWriter (each orderer signs the blocks it stores). Config
+blocks reconfigure the chain and, when the consenter set changed,
+trigger a raft membership change; a consenter that finds itself removed
+halts (the reference's eviction suspector, `eviction.go`). A follower
+that receives a raft snapshot pulls the missing blocks from a fellow
+consenter and verifies their signatures before appending
+(`blockpuller.go` + `cluster/util.go VerifyBlocks`).
+
+Raft node IDs are the first 8 bytes of SHA-256(endpoint) — stable
+across membership changes without coordination (the reference persists
+an id↔consenter table in the block metadata instead).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import queue
+import threading
+import time
+from typing import Optional
+
+from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+from fabric_tpu.orderer.raft.core import LEADER, RaftNode
+from fabric_tpu.orderer.raft.storage import RaftStorage
+from fabric_tpu.protos import common, orderer as opb
+from fabric_tpu.protos import configtx as ctxpb, raft as rpb
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("orderer.raft.chain")
+
+COMPACT_EVERY = 64   # entries between raft-log compactions
+
+
+def endpoint_id(endpoint: str) -> int:
+    """Stable 63-bit raft node id for a consenter endpoint."""
+    h = hashlib.sha256(endpoint.encode()).digest()
+    return int.from_bytes(h[:8], "big") & 0x7FFFFFFFFFFFFFFF
+
+
+def parse_consenters(metadata: bytes) -> dict[int, str]:
+    meta = ctxpb.ConsensusMetadata()
+    meta.ParseFromString(metadata)
+    out = {}
+    for c in meta.consenters:
+        ep = f"{c.host}:{c.port}"
+        out[endpoint_id(ep)] = ep
+    return out
+
+
+class _BlockCreator:
+    """In-flight block assembly, decoupled from the writer (reference:
+    etcdraft/blockcreator.go)."""
+
+    def __init__(self, number: int, prev_hash: bytes):
+        self.number = number
+        self.prev_hash = prev_hash
+
+    def create(self, envelopes) -> common.Block:
+        block = pu.new_block(self.number, self.prev_hash)
+        for env in envelopes:
+            block.data.data.append(pu.marshal(env))
+        block.header.data_hash = pu.block_data_hash(block.data)
+        self.number += 1
+        self.prev_hash = pu.block_header_hash(block.header)
+        return block
+
+
+class RaftChain:
+    """consensus.Chain over the raft core."""
+
+    def __init__(self, support, transport, tick_interval_s: float = 0.1,
+                 election_tick: int = 10, heartbeat_tick: int = 1):
+        self._support = support
+        self._transport = transport
+        self.endpoint = transport.endpoint
+        self._tick_s = tick_interval_s
+
+        self._consenters = parse_consenters(
+            support.bundle().orderer.consensus_metadata)
+        if not self._consenters:
+            raise ValueError(f"[{support.channel_id}] raft requires a "
+                             "consenter set in the channel config")
+        self.node_id = endpoint_id(self.endpoint)
+        if self.node_id not in self._consenters:
+            raise ValueError(f"{self.endpoint} is not a consenter on "
+                             f"{support.channel_id}")
+
+        storage = RaftStorage(support.ledger.db_handle("raft"))
+        self.node = RaftNode(self.node_id,
+                             list(self._consenters),
+                             storage,
+                             election_tick=election_tick,
+                             heartbeat_tick=heartbeat_tick)
+        self._storage = storage
+        self._events: queue.Queue = queue.Queue(maxsize=4096)
+        self._halted = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._creator: Optional[_BlockCreator] = None
+        self._timer_deadline: Optional[float] = None
+        self._applied_since_compact = 0
+        self._replay_committed()
+        transport.set_handler(support.channel_id, self)
+
+    # -- restart replay: committed-but-unwritten entries --
+
+    def _replay_committed(self) -> None:
+        height = self._support.ledger.height
+        for e in self._storage.entries(self._storage.first_index(),
+                                       self.node.commit_index + 1):
+            if e.type != rpb.Entry.NORMAL or not e.data:
+                continue
+            block = common.Block()
+            try:
+                block.ParseFromString(e.data)
+            except Exception:
+                continue
+            if block.header.number == height:
+                self._write_committed_block(block)
+                height = self._support.ledger.height
+
+    # ------------------------------------------------------------------
+    # Chain interface (what broadcast + registrar call)
+    # ------------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"raft-{self._support.channel_id}-{self.node_id % 997}",
+            daemon=True)
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        try:
+            self._events.put_nowait(None)
+        except queue.Full:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        try:
+            self._transport.remove_handler(self._support.channel_id)
+        except Exception:
+            pass
+
+    def errored(self) -> bool:
+        return self._halted.is_set()
+
+    def order(self, env: common.Envelope, config_seq: int) -> None:
+        self._submit(env, config_seq, is_config=False)
+
+    def configure(self, env: common.Envelope, config_seq: int) -> None:
+        self._submit(env, config_seq, is_config=True)
+
+    def _submit(self, env: common.Envelope, config_seq: int,
+                is_config: bool) -> None:
+        if self._halted.is_set():
+            raise MsgProcessorError("chain is halted")
+        leader = self.node.leader_id
+        if leader == self.node_id:
+            self._events.put(("order", env, config_seq, is_config))
+            return
+        if leader == 0:
+            raise MsgProcessorError(
+                f"[{self._support.channel_id}] no raft leader")
+        target = self._consenters.get(leader)
+        if target is None:
+            raise MsgProcessorError(f"unknown raft leader {leader}")
+        resp = self._transport.submit(target,
+                                      self._support.channel_id,
+                                      pu.marshal(env))
+        if resp.status != common.Status.SUCCESS:
+            raise MsgProcessorError(
+                f"leader {target} rejected submission: {resp.info}")
+
+    # ------------------------------------------------------------------
+    # cluster handler interface (transport calls these)
+    # ------------------------------------------------------------------
+
+    def on_consensus(self, sender: str, payload: bytes) -> None:
+        if self._halted.is_set():
+            return
+        msg = rpb.RaftMessage()
+        try:
+            msg.ParseFromString(payload)
+        except Exception:
+            return
+        try:
+            self._events.put_nowait(("step", msg))
+        except queue.Full:
+            logger.warning("[%s] raft event queue full",
+                           self._support.channel_id)
+
+    def on_submit(self, env_bytes: bytes) -> opb.SubmitResponse:
+        channel = self._support.channel_id
+        if self.node.leader_id != self.node_id:
+            return opb.SubmitResponse(
+                channel=channel, status=common.Status.SERVICE_UNAVAILABLE,
+                info="not the leader")
+        try:
+            env = pu.unmarshal_envelope(env_bytes)
+            # a forwarded message was validated by the origin's
+            # msgprocessor; classify config-ness here
+            payload = pu.get_payload(env)
+            ch = pu.get_channel_header(payload)
+            is_config = ch.type in (common.HeaderType.CONFIG,
+                                    common.HeaderType.ORDERER_TRANSACTION)
+            self._events.put(("order", env, self._support.sequence(),
+                              is_config))
+        except Exception as e:
+            return opb.SubmitResponse(channel=channel,
+                                      status=common.Status.BAD_REQUEST,
+                                      info=str(e))
+        return opb.SubmitResponse(channel=channel,
+                                  status=common.Status.SUCCESS)
+
+    def serve_blocks(self, start: int, end: int) -> list[common.Block]:
+        out = []
+        for num in range(start, min(end, self._support.ledger.height)):
+            b = self._support.ledger.get_block(num)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    # ------------------------------------------------------------------
+    # main loop (reference chain.go run:599)
+    # ------------------------------------------------------------------
+
+    def _run(self) -> None:
+        next_tick = time.monotonic() + self._tick_s
+        while not self._halted.is_set():
+            now = time.monotonic()
+            deadline = next_tick
+            if self._timer_deadline is not None:
+                deadline = min(deadline, self._timer_deadline)
+            try:
+                ev = self._events.get(timeout=max(0.0, deadline - now))
+            except queue.Empty:
+                ev = ()
+            if ev is None:
+                break
+            try:
+                now = time.monotonic()
+                if ev and ev[0] == "step":
+                    self.node.step(ev[1])
+                elif ev and ev[0] == "order":
+                    self._process_order(ev[1], ev[2], ev[3])
+                if now >= next_tick:
+                    self.node.tick()
+                    next_tick = now + self._tick_s
+                if self._timer_deadline is not None and \
+                        now >= self._timer_deadline:
+                    self._timer_deadline = None
+                    self._cut_and_propose(self._support.cutter.cut())
+                self._drain_ready()
+            except Exception:
+                logger.exception("[%s] raft chain loop error",
+                                 self._support.channel_id)
+
+    def _drain_ready(self) -> None:
+        ready = self.node.ready()
+        for msg in ready.messages:
+            target = self._consenters.get(msg.to)
+            if target is not None:
+                self._transport.send_consensus(
+                    target, self._support.channel_id,
+                    msg.SerializeToString())
+        for entry in ready.committed_entries:
+            self._apply(entry)
+        if ready.soft_leader != self.node_id and self._creator:
+            # deposed: in-flight blocks die with the old term
+            self._creator = None
+            self._timer_deadline = None
+
+    # -- leader-side ordering --
+
+    def _process_order(self, env: common.Envelope, config_seq: int,
+                       is_config: bool) -> None:
+        support = self._support
+        if self.node.state != LEADER:
+            # deposed between submit and processing: re-route
+            try:
+                self._submit(env, config_seq, is_config)
+            except MsgProcessorError as e:
+                logger.warning("[%s] dropped message during leader "
+                               "change: %s", support.channel_id, e)
+            return
+        try:
+            if is_config:
+                if config_seq < support.sequence():
+                    env, _ = support.processor.process_config_msg(env)
+                self._cut_and_propose(support.cutter.cut())
+                self._timer_deadline = None
+                self._propose_block([env])
+            else:
+                if config_seq < support.sequence():
+                    support.processor.process_normal_msg(env)
+                batches, pending = support.cutter.ordered(env)
+                for batch in batches:
+                    self._cut_and_propose(batch)
+                if pending:
+                    if self._timer_deadline is None:
+                        self._timer_deadline = (
+                            time.monotonic() + support.batch_timeout_s)
+                else:
+                    self._timer_deadline = None
+        except MsgProcessorError as e:
+            logger.warning("[%s] message dropped during ordering: %s",
+                           support.channel_id, e)
+
+    def _cut_and_propose(self, batch) -> None:
+        if batch:
+            self._propose_block(list(batch))
+
+    def _propose_block(self, envelopes) -> None:
+        if self._creator is None:
+            self._creator = self._creator_from_tail()
+        block = self._creator.create(envelopes)
+        ok = self.node.propose(block.SerializeToString())
+        if not ok:
+            logger.warning("[%s] proposal dropped (not leader)",
+                           self._support.channel_id)
+            self._creator = None
+
+    def _creator_from_tail(self) -> _BlockCreator:
+        """New leader: continue after the last block in the raft log
+        (it will commit under this term), else after the ledger tip."""
+        for e in reversed(self._storage.entries(
+                self._storage.first_index(),
+                self.node.last_index() + 1)):
+            if e.type != rpb.Entry.NORMAL or not e.data:
+                continue
+            try:
+                block = common.Block()
+                block.ParseFromString(e.data)
+            except Exception:
+                continue
+            return _BlockCreator(block.header.number + 1,
+                                 pu.block_header_hash(block.header))
+        tip = self._support.ledger.get_block(
+            self._support.ledger.height - 1)
+        return _BlockCreator(tip.header.number + 1,
+                             pu.block_header_hash(tip.header))
+
+    # -- apply (every consenter) --
+
+    def _apply(self, entry: rpb.Entry) -> None:
+        if entry.type == rpb.Entry.CONF_CHANGE:
+            self._after_conf_change()
+            return
+        if not entry.data:
+            return
+        block = common.Block()
+        try:
+            block.ParseFromString(entry.data)
+        except Exception:
+            logger.warning("[%s] undecodable raft entry %d",
+                           self._support.channel_id, entry.index)
+            return
+        height = self._support.ledger.height
+        if block.header.number < height:
+            return  # duplicate (replay)
+        if block.header.number > height:
+            self._catch_up(height, block.header.number)
+            if self._support.ledger.height != block.header.number:
+                logger.error("[%s] catch-up to %d failed (at %d)",
+                             self._support.channel_id,
+                             block.header.number,
+                             self._support.ledger.height)
+                return
+        self._write_committed_block(block)
+        self._applied_since_compact += 1
+        if self._applied_since_compact >= COMPACT_EVERY:
+            self._applied_since_compact = 0
+            self.node.compact(self.node.applied_index,
+                              self._support.ledger.height)
+
+    def _write_committed_block(self, block: common.Block) -> None:
+        support = self._support
+        if pu.is_config_block(block):
+            support.write_config_block(block)
+            self._reconfigure()
+        else:
+            support.write_block(block)
+
+    def _reconfigure(self) -> None:
+        """A config block committed: adopt the (possibly) new consenter
+        set; the leader drives the raft membership change."""
+        new = parse_consenters(
+            self._support.bundle().orderer.consensus_metadata)
+        if not new or new == self._consenters:
+            return
+        logger.info("[%s] consenter set change: %s -> %s",
+                    self._support.channel_id,
+                    sorted(self._consenters.values()),
+                    sorted(new.values()))
+        self._consenters = new
+        if self.node.state == LEADER:
+            self.node.propose_conf_change(list(new))
+
+    def _after_conf_change(self) -> None:
+        if self.node_id not in self.node.peers:
+            logger.warning("[%s] this consenter was evicted; halting "
+                           "chain (deliver keeps serving)",
+                           self._support.channel_id)
+            threading.Thread(target=self.halt, daemon=True).start()
+
+    # -- snapshot catch-up (reference blockpuller.go) --
+
+    def _catch_up(self, start: int, end: int) -> None:
+        for nid, ep in sorted(self._consenters.items()):
+            if nid == self.node_id:
+                continue
+            try:
+                blocks = self._transport.pull_blocks(
+                    ep, self._support.channel_id, start, end)
+            except Exception as e:
+                logger.warning("[%s] block pull from %s failed: %s",
+                               self._support.channel_id, ep, e)
+                continue
+            for block in blocks:
+                if block.header.number != self._support.ledger.height:
+                    continue
+                try:
+                    self._support.append_onboarded_block(block)
+                except Exception as e:
+                    logger.warning("[%s] pulled block %d rejected: %s",
+                                   self._support.channel_id,
+                                   block.header.number, e)
+                    break
+            if self._support.ledger.height >= end:
+                return
+
+
+def consenter(transport, tick_interval_s: float = 0.1,
+              election_tick: int = 10):
+    """Factory-of-factories for the registrar's consenter map:
+    `{"etcdraft": raft.consenter(transport)}`. An orderer outside the
+    channel's consenter set comes up as a FOLLOWER (onboarding mode)
+    instead — the reference registrar's SwitchFollowerToChain seam."""
+    def factory(support):
+        consenters = parse_consenters(
+            support.bundle().orderer.consensus_metadata)
+        if endpoint_id(transport.endpoint) not in consenters:
+            from fabric_tpu.orderer.raft.follower import FollowerChain
+            logger.info("[%s] %s not in consenter set: starting as "
+                        "follower", support.channel_id,
+                        transport.endpoint)
+            return FollowerChain(support, transport)
+        return RaftChain(support, transport,
+                         tick_interval_s=tick_interval_s,
+                         election_tick=election_tick)
+    return factory

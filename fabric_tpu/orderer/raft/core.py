@@ -1,0 +1,451 @@
+"""Raft consensus state machine — pure, deterministic, IO-free.
+
+The role of etcd/raft in the reference (`orderer/consensus/etcdraft`
+vendors go.etcd.io/etcd/raft): leader election with randomized
+timeouts + pre-vote, log replication with consistency checks and fast
+conflict backtracking, majority commit (current-term rule), and
+configuration changes. Mirrors etcd's architecture: the node is driven
+by `tick()` / `step(msg)` / `propose(data)` and emits side effects only
+through `ready()` — (messages to send, entries to persist, entries to
+apply) — which the chain layer (`chain.py`) executes. Determinism makes
+the protocol unit-testable without threads or clocks
+(`tests/test_raft.py` drives whole clusters synchronously).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from fabric_tpu.protos import raft as rpb
+
+logger = logging.getLogger("orderer.raft")
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+@dataclass
+class Ready:
+    messages: list = field(default_factory=list)       # RaftMessage out
+    entries_to_persist: list = field(default_factory=list)  # new log tail
+    committed_entries: list = field(default_factory=list)   # apply these
+    hard_state_changed: bool = False
+    soft_leader: Optional[int] = None
+
+
+class RaftNode:
+    """One consenter's raft state. `storage` provides the persisted
+    log + hard state (term, voted_for) — see storage.py."""
+
+    def __init__(self, node_id: int, peers: list[int], storage,
+                 election_tick: int = 10, heartbeat_tick: int = 1,
+                 pre_vote: bool = True):
+        self.id = node_id
+        self.peers = sorted(set(peers) | {node_id})
+        self._storage = storage
+        self.election_tick = election_tick
+        self.heartbeat_tick = heartbeat_tick
+        self.pre_vote = pre_vote
+
+        hs = storage.hard_state()
+        self.term: int = hs[0]
+        self.voted_for: int = hs[1]
+        self.commit_index: int = hs[2]
+        self.applied_index: int = self.commit_index
+
+        self.state = FOLLOWER
+        self.leader_id: int = 0
+        self._elapsed = 0
+        # deterministic per-node election jitter (reference uses rand;
+        # node-id spread gives the same liveness without randomness)
+        self._timeout = election_tick + (node_id * 3) % election_tick
+        self._votes: dict[int, bool] = {}
+        self._pre_votes: dict[int, bool] = {}
+
+        # leader volatile state
+        self.next_index: dict[int, int] = {}
+        self.match_index: dict[int, int] = {}
+
+        self._ready = Ready()
+        self._apply_upto(self.commit_index, replay=True)
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def ready(self) -> Ready:
+        """Drain pending side effects (etcd Ready pattern)."""
+        r, self._ready = self._ready, Ready()
+        r.soft_leader = self.leader_id if self.leader_id else None
+        return r
+
+    def tick(self) -> None:
+        self._elapsed += 1
+        if self.state == LEADER:
+            if self._elapsed >= self.heartbeat_tick:
+                self._elapsed = 0
+                self._broadcast_append(heartbeat_only=False)
+        elif self._elapsed >= self._timeout:
+            self._elapsed = 0
+            self._campaign()
+
+    def propose(self, data: bytes,
+                etype: int = rpb.Entry.NORMAL) -> bool:
+        if self.state != LEADER:
+            return False
+        index = self.last_index() + 1
+        entry = rpb.Entry(index=index, term=self.term, type=etype,
+                          data=data)
+        self._storage.append([entry])
+        self._ready.entries_to_persist.append(entry)
+        self.match_index[self.id] = index
+        if len(self.peers) == 1:
+            self._maybe_commit()
+        else:
+            self._broadcast_append()
+        return True
+
+    def propose_conf_change(self, voters: list[int]) -> bool:
+        cs = rpb.ConfState(voters=sorted(voters))
+        return self.propose(cs.SerializeToString(),
+                            etype=rpb.Entry.CONF_CHANGE)
+
+    def step(self, msg: rpb.RaftMessage) -> None:
+        if msg.term > self.term:
+            if msg.type == rpb.RaftMessage.PRE_VOTE_RESP and msg.reject:
+                # a peer at a higher term refused us: adopt the term so
+                # the next campaign can actually win (etcd behavior)
+                self._become_follower(msg.term, 0)
+                return
+            if msg.type not in (rpb.RaftMessage.PRE_VOTE,
+                                rpb.RaftMessage.PRE_VOTE_RESP):
+                leader = msg.from_ if msg.type in (
+                    rpb.RaftMessage.APPEND,
+                    rpb.RaftMessage.HEARTBEAT,
+                    rpb.RaftMessage.SNAPSHOT) else 0
+                self._become_follower(msg.term, leader)
+        elif msg.term < self.term:
+            if msg.type in (rpb.RaftMessage.VOTE,
+                            rpb.RaftMessage.PRE_VOTE):
+                self._send(msg.from_, self._vote_resp(
+                    msg.type, granted=False))
+            return
+
+        t = msg.type
+        if t == rpb.RaftMessage.PRE_VOTE:
+            self._handle_pre_vote(msg)
+        elif t == rpb.RaftMessage.PRE_VOTE_RESP:
+            self._handle_pre_vote_resp(msg)
+        elif t == rpb.RaftMessage.VOTE:
+            self._handle_vote(msg)
+        elif t == rpb.RaftMessage.VOTE_RESP:
+            self._handle_vote_resp(msg)
+        elif t in (rpb.RaftMessage.APPEND, rpb.RaftMessage.HEARTBEAT):
+            self._handle_append(msg)
+        elif t == rpb.RaftMessage.APPEND_RESP:
+            self._handle_append_resp(msg)
+        elif t == rpb.RaftMessage.SNAPSHOT:
+            self._handle_snapshot(msg)
+
+    def advance_applied(self, index: int) -> None:
+        self.applied_index = max(self.applied_index, index)
+
+    # ------------------------------------------------------------------
+    # log helpers
+    # ------------------------------------------------------------------
+
+    def last_index(self) -> int:
+        return self._storage.last_index()
+
+    def last_term(self) -> int:
+        return self._storage.term_of(self.last_index())
+
+    def _log_up_to_date(self, idx: int, term: int) -> bool:
+        lt, li = self.last_term(), self.last_index()
+        return (term, idx) >= (lt, li)
+
+    # ------------------------------------------------------------------
+    # elections
+    # ------------------------------------------------------------------
+
+    def _campaign(self) -> None:
+        if self.id not in self.peers:
+            return  # removed from the cluster
+        if len(self.peers) == 1:
+            self._become_leader(self.term + 1)
+            return
+        if self.pre_vote:
+            self.state = CANDIDATE
+            self._pre_votes = {self.id: True}
+            for p in self._others():
+                m = self._base(p, rpb.RaftMessage.PRE_VOTE)
+                m.term = self.term + 1
+                m.last_log_index = self.last_index()
+                m.last_log_term = self.last_term()
+                self._send(p, m)
+        else:
+            self._start_real_election()
+
+    def _start_real_election(self) -> None:
+        self.state = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self.leader_id = 0
+        self._persist_hard_state()
+        self._votes = {self.id: True}
+        if self._quorum(self._votes):
+            self._become_leader(self.term)
+            return
+        for p in self._others():
+            m = self._base(p, rpb.RaftMessage.VOTE)
+            m.last_log_index = self.last_index()
+            m.last_log_term = self.last_term()
+            self._send(p, m)
+
+    def _handle_pre_vote(self, msg: rpb.RaftMessage) -> None:
+        # grant iff we'd vote in that term: no live leader heard
+        # recently AND candidate log is current
+        granted = (msg.term > self.term and
+                   self._log_up_to_date(msg.last_log_index,
+                                        msg.last_log_term) and
+                   (self.leader_id == 0 or
+                    self._elapsed >= self.election_tick))
+        resp = self._vote_resp(rpb.RaftMessage.PRE_VOTE, granted)
+        resp.term = msg.term
+        self._send(msg.from_, resp)
+
+    def _handle_pre_vote_resp(self, msg: rpb.RaftMessage) -> None:
+        if self.state != CANDIDATE:
+            return
+        self._pre_votes[msg.from_] = not msg.reject
+        if self._quorum({k: v for k, v in self._pre_votes.items()
+                         if v}):
+            self._start_real_election()
+
+    def _handle_vote(self, msg: rpb.RaftMessage) -> None:
+        can_vote = (self.voted_for in (0, msg.from_) and
+                    self.leader_id == 0)
+        granted = can_vote and self._log_up_to_date(
+            msg.last_log_index, msg.last_log_term)
+        if granted:
+            self.voted_for = msg.from_
+            self._elapsed = 0
+            self._persist_hard_state()
+        self._send(msg.from_,
+                   self._vote_resp(rpb.RaftMessage.VOTE, granted))
+
+    def _handle_vote_resp(self, msg: rpb.RaftMessage) -> None:
+        if self.state != CANDIDATE:
+            return
+        if not msg.reject:
+            self._votes[msg.from_] = True
+        if self._quorum(self._votes):
+            self._become_leader(self.term)
+
+    def _vote_resp(self, req_type: int, granted: bool
+                   ) -> rpb.RaftMessage:
+        resp_type = rpb.RaftMessage.VOTE_RESP \
+            if req_type == rpb.RaftMessage.VOTE \
+            else rpb.RaftMessage.PRE_VOTE_RESP
+        m = rpb.RaftMessage(type=resp_type, from_=self.id,
+                            term=self.term)
+        m.reject = not granted
+        return m
+
+    # ------------------------------------------------------------------
+    # role transitions
+    # ------------------------------------------------------------------
+
+    def _become_follower(self, term: int, leader: int) -> None:
+        changed = term != self.term
+        self.state = FOLLOWER
+        self.term = term
+        if changed:
+            self.voted_for = 0
+        self.leader_id = leader
+        self._elapsed = 0
+        if changed:
+            self._persist_hard_state()
+
+    def _become_leader(self, term: int) -> None:
+        self.state = LEADER
+        self.term = term
+        self.leader_id = self.id
+        self._elapsed = 0
+        last = self.last_index()
+        self.next_index = {p: last + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        self.match_index[self.id] = last
+        logger.info("raft node %d became leader at term %d", self.id,
+                    term)
+        self._broadcast_append()
+        if len(self.peers) == 1:
+            self._maybe_commit()
+
+    # ------------------------------------------------------------------
+    # replication
+    # ------------------------------------------------------------------
+
+    def _broadcast_append(self, heartbeat_only: bool = False) -> None:
+        for p in self._others():
+            self._send_append(p)
+
+    def _send_append(self, peer: int) -> None:
+        nxt = self.next_index.get(peer, self.last_index() + 1)
+        first = self._storage.first_index()
+        if nxt < first:
+            # follower is behind our compacted log → snapshot
+            meta = self._storage.snapshot_meta()
+            m = self._base(peer, rpb.RaftMessage.SNAPSHOT)
+            m.snapshot.CopyFrom(meta)
+            self._send(peer, m)
+            return
+        prev = nxt - 1
+        m = self._base(peer, rpb.RaftMessage.APPEND)
+        m.prev_log_index = prev
+        m.prev_log_term = self._storage.term_of(prev)
+        m.commit = self.commit_index
+        for e in self._storage.entries(nxt, nxt + 64):
+            m.entries.add().CopyFrom(e)
+        self._send(peer, m)
+
+    def _handle_append(self, msg: rpb.RaftMessage) -> None:
+        self._elapsed = 0
+        if self.state != FOLLOWER:
+            self._become_follower(msg.term, msg.from_)
+        self.leader_id = msg.from_
+
+        resp = self._base(msg.from_, rpb.RaftMessage.APPEND_RESP)
+        prev = msg.prev_log_index
+        if prev > self.last_index() or \
+                (prev >= self._storage.first_index() - 1 and
+                 self._storage.term_of(prev) != msg.prev_log_term):
+            resp.reject = True
+            resp.reject_hint = min(self.last_index(), prev)
+            self._send(msg.from_, resp)
+            return
+        new_entries = []
+        for e in msg.entries:
+            if e.index <= self.last_index():
+                if self._storage.term_of(e.index) == e.term:
+                    continue  # already have it
+                self._storage.truncate_from(e.index)
+            new_entries.append(e)
+        if new_entries:
+            self._storage.append(new_entries)
+            self._ready.entries_to_persist.extend(new_entries)
+        last_new = msg.prev_log_index + len(msg.entries)
+        if msg.commit > self.commit_index:
+            self._set_commit(min(msg.commit, last_new if msg.entries
+                                 else self.last_index()))
+        resp.last_log_index = self.last_index()
+        self._send(msg.from_, resp)
+
+    def _handle_append_resp(self, msg: rpb.RaftMessage) -> None:
+        if self.state != LEADER:
+            return
+        peer = msg.from_
+        if msg.reject:
+            # fast backtrack to the follower's hint
+            self.next_index[peer] = max(
+                1, min(msg.reject_hint + 1,
+                       self.next_index.get(peer, 1) - 1))
+            self._send_append(peer)
+            return
+        self.match_index[peer] = max(self.match_index.get(peer, 0),
+                                     msg.last_log_index)
+        self.next_index[peer] = self.match_index[peer] + 1
+        self._maybe_commit()
+        if self.next_index[peer] <= self.last_index():
+            self._send_append(peer)
+
+    def _maybe_commit(self) -> None:
+        matches = sorted((self.match_index.get(p, 0)
+                          for p in self.peers), reverse=True)
+        n = matches[len(self.peers) // 2]  # majority floor
+        if n > self.commit_index and \
+                self._storage.term_of(n) == self.term:
+            self._set_commit(n)
+            # propagate the new commit index promptly
+            for p in self._others():
+                self._send_append(p)
+
+    def _set_commit(self, index: int) -> None:
+        if index <= self.commit_index:
+            return
+        self.commit_index = index
+        self._persist_hard_state()
+        self._apply_upto(index)
+
+    def _apply_upto(self, index: int, replay: bool = False) -> None:
+        start = self.applied_index + 1
+        if replay:
+            return  # replay is the chain layer's job at restart
+        for e in self._storage.entries(start, index + 1):
+            self._ready.committed_entries.append(e)
+            self.applied_index = e.index
+            if e.type == rpb.Entry.CONF_CHANGE:
+                self._apply_conf_change(e)
+
+    def _apply_conf_change(self, entry: rpb.Entry) -> None:
+        cs = rpb.ConfState()
+        cs.ParseFromString(entry.data)
+        self.peers = sorted(cs.voters)
+        logger.info("raft node %d: voters now %s", self.id, self.peers)
+        if self.state == LEADER:
+            for p in self.peers:
+                self.next_index.setdefault(p, self.last_index() + 1)
+                self.match_index.setdefault(p, 0)
+
+    # -- snapshots (block-pull catch-up, chain layer completes it) --
+
+    def _handle_snapshot(self, msg: rpb.RaftMessage) -> None:
+        self._elapsed = 0
+        self.leader_id = msg.from_
+        meta = msg.snapshot
+        if meta.last_index <= self.commit_index:
+            return
+        # accept the snapshot position; the chain pulls blocks
+        self._storage.install_snapshot(meta)
+        self.commit_index = meta.last_index
+        self.applied_index = meta.last_index
+        self.peers = sorted(meta.conf.voters) or self.peers
+        self._persist_hard_state()
+        self._ready.committed_entries.append(
+            rpb.Entry(index=meta.last_index, term=meta.last_term,
+                      type=rpb.Entry.NORMAL, data=b""))
+        resp = self._base(msg.from_, rpb.RaftMessage.APPEND_RESP)
+        resp.last_log_index = self.last_index()
+        self._send(msg.from_, resp)
+
+    def compact(self, upto_index: int, block_height: int) -> None:
+        """Truncate the applied prefix (chain calls this periodically —
+        reference: snapshot_interval_size)."""
+        self._storage.compact(min(upto_index, self.applied_index),
+                              block_height,
+                              rpb.ConfState(voters=self.peers))
+
+    # ------------------------------------------------------------------
+    # plumbing
+    # ------------------------------------------------------------------
+
+    def _others(self):
+        return [p for p in self.peers if p != self.id]
+
+    def _quorum(self, votes: dict) -> bool:
+        return len([v for v in votes.values() if v]) > \
+            len(self.peers) // 2
+
+    def _base(self, to: int, mtype: int) -> rpb.RaftMessage:
+        return rpb.RaftMessage(type=mtype, from_=self.id, to=to,
+                               term=self.term)
+
+    def _send(self, to: int, msg: rpb.RaftMessage) -> None:
+        msg.to = to
+        self._ready.messages.append(msg)
+
+    def _persist_hard_state(self) -> None:
+        self._storage.set_hard_state(self.term, self.voted_for,
+                                     self.commit_index)
+        self._ready.hard_state_changed = True

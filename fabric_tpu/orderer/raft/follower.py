@@ -1,0 +1,106 @@
+"""Follower chain: serve a channel without being a consenter.
+
+Rebuild of `orderer/common/follower/follower_chain.go` + the onboarding
+flow (`orderer/common/onboarding/onboarding.go`): an orderer that joins
+a channel whose consenter set does not include it pulls blocks from the
+consenters (verifying signatures — `cluster/util.go VerifyBlocks` via
+ChainSupport.append_onboarded_block), keeps its ledger current for
+Deliver clients, and — when a committed config block adds it to the
+consenter set — halts so the registrar can restart it as a consenter.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+from fabric_tpu.orderer.raft.chain import parse_consenters
+
+logger = logging.getLogger("orderer.follower")
+
+
+class FollowerChain:
+    def __init__(self, support, transport,
+                 poll_interval_s: float = 0.3,
+                 on_became_consenter: Optional[Callable] = None):
+        self._support = support
+        self._transport = transport
+        self._interval = poll_interval_s
+        self._on_promote = on_became_consenter
+        self._halted = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run,
+            name=f"follower-{self._support.channel_id}", daemon=True)
+        self._thread.start()
+
+    def halt(self) -> None:
+        self._halted.set()
+        if self._thread is not None and \
+                self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+
+    def errored(self) -> bool:
+        return self._halted.is_set()
+
+    def order(self, env, config_seq) -> None:
+        raise MsgProcessorError(
+            f"[{self._support.channel_id}] this orderer is a follower; "
+            "submit to a consenter")
+
+    configure = order
+
+    # -- the pull loop --
+
+    def _run(self) -> None:
+        while not self._halted.wait(self._interval):
+            try:
+                self._pull_once()
+                if self._am_consenter():
+                    logger.info("[%s] %s is now in the consenter set; "
+                                "halting follower for promotion",
+                                self._support.channel_id,
+                                self._transport.endpoint)
+                    if self._on_promote is not None:
+                        self._on_promote()
+                    self._halted.set()
+                    return
+            except Exception:
+                logger.exception("[%s] follower pull failed",
+                                 self._support.channel_id)
+
+    def _consenters(self) -> dict[int, str]:
+        return parse_consenters(
+            self._support.bundle().orderer.consensus_metadata)
+
+    def _am_consenter(self) -> bool:
+        return self._transport.endpoint in \
+            self._consenters().values()
+
+    def _pull_once(self) -> None:
+        height = self._support.ledger.height
+        for _nid, ep in sorted(self._consenters().items()):
+            if ep == self._transport.endpoint:
+                continue
+            try:
+                blocks = self._transport.pull_blocks(
+                    ep, self._support.channel_id, height, height + 10)
+            except Exception:
+                continue
+            for block in blocks:
+                if block.header.number != self._support.ledger.height:
+                    continue
+                self._support.append_onboarded_block(block)
+            if self._support.ledger.height > height:
+                return
+
+
+def follower_factory(transport, on_became_consenter=None):
+    def factory(support) -> FollowerChain:
+        return FollowerChain(support, transport,
+                             on_became_consenter=on_became_consenter)
+    return factory

@@ -1,0 +1,144 @@
+"""Raft persistence: hard state, log entries, snapshot metadata.
+
+The role of etcd WAL + snapshot files in the reference
+(`orderer/consensus/etcdraft/storage.go`): everything raft must not
+forget across a crash — (term, voted_for, commit), the entry log, and
+the latest compaction point — lands in the channel's embedded ordered
+KV store (crash-safe WAL-mode SQLite, same engine as the ledger
+indexes) before the state machine acts on it.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from fabric_tpu.ledger.kvdb import DBHandle
+from fabric_tpu.protos import raft as rpb
+
+_HARD = b"h"          # term, voted_for, commit
+_ENTRY = b"e"         # e + pack(index) -> Entry
+_SNAP = b"s"          # SnapshotMeta
+
+
+def _ek(index: int) -> bytes:
+    return _ENTRY + struct.pack(">Q", index)
+
+
+class RaftStorage:
+    def __init__(self, db: DBHandle):
+        self._db = db
+        self._last: Optional[int] = None
+        self._first: Optional[int] = None
+
+    # -- hard state --
+
+    def hard_state(self) -> tuple[int, int, int]:
+        raw = self._db.get(_HARD)
+        if raw is None:
+            return 0, 0, 0
+        return struct.unpack(">QQQ", raw)
+
+    def set_hard_state(self, term: int, voted_for: int,
+                       commit: int) -> None:
+        self._db.put(_HARD, struct.pack(">QQQ", term, voted_for,
+                                        commit))
+
+    # -- log --
+
+    def first_index(self) -> int:
+        """Index of the first entry still in the log (after the
+        snapshot point); snapshot.last_index + 1."""
+        if self._first is None:
+            meta = self.snapshot_meta()
+            self._first = meta.last_index + 1
+        return self._first
+
+    def last_index(self) -> int:
+        if self._last is None:
+            self._last = self.snapshot_meta().last_index
+            for k, _v in self._db.iterate(start=_ENTRY,
+                                          end=_ENTRY + b"\xff"):
+                idx = struct.unpack(">Q", k[1:])[0]
+                if idx > self._last:
+                    self._last = idx
+        return self._last
+
+    def term_of(self, index: int) -> int:
+        if index == 0:
+            return 0
+        meta = self.snapshot_meta()
+        if index == meta.last_index:
+            return meta.last_term
+        raw = self._db.get(_ek(index))
+        if raw is None:
+            return 0
+        e = rpb.Entry()
+        e.ParseFromString(raw)
+        return e.term
+
+    def entries(self, lo: int, hi: int) -> list[rpb.Entry]:
+        """[lo, hi) — silently clipped to what exists."""
+        out = []
+        for _k, v in self._db.iterate(start=_ek(lo), end=_ek(hi)):
+            e = rpb.Entry()
+            e.ParseFromString(v)
+            out.append(e)
+        return out
+
+    def append(self, entries: list[rpb.Entry]) -> None:
+        batch = self._db.new_batch()
+        for e in entries:
+            batch.put(_ek(e.index),
+                      e.SerializeToString(deterministic=True))
+        self._db.write_batch(batch)
+        if entries:
+            self._last = max(self._last or 0, entries[-1].index)
+
+    def truncate_from(self, index: int) -> None:
+        """Drop entries >= index (conflict resolution)."""
+        batch = self._db.new_batch()
+        for k, _v in self._db.iterate(start=_ek(index),
+                                      end=_ENTRY + b"\xff"):
+            batch.delete(k)
+        if batch.ops:
+            self._db.write_batch(batch)
+        self._last = None
+
+    # -- snapshot / compaction --
+
+    def snapshot_meta(self) -> rpb.SnapshotMeta:
+        raw = self._db.get(_SNAP)
+        meta = rpb.SnapshotMeta()
+        if raw is not None:
+            meta.ParseFromString(raw)
+        return meta
+
+    def compact(self, upto_index: int, block_height: int,
+                conf: rpb.ConfState) -> None:
+        """Make `upto_index` the new snapshot point and drop the prefix."""
+        if upto_index < self.first_index():
+            return
+        term = self.term_of(upto_index)
+        meta = rpb.SnapshotMeta(last_index=upto_index, last_term=term,
+                                block_height=block_height)
+        meta.conf.CopyFrom(conf)
+        batch = self._db.new_batch()
+        batch.put(_SNAP, meta.SerializeToString(deterministic=True))
+        for k, _v in self._db.iterate(start=_ENTRY,
+                                      end=_ek(upto_index + 1)):
+            batch.delete(k)
+        self._db.write_batch(batch)
+        self._first = upto_index + 1
+
+    def install_snapshot(self, meta: rpb.SnapshotMeta) -> None:
+        """Follower side: adopt a leader snapshot position wholesale;
+        the entire local log is superseded."""
+        batch = self._db.new_batch()
+        batch.put(_SNAP, meta.SerializeToString(deterministic=True))
+        for k, _v in self._db.iterate(start=_ENTRY,
+                                      end=_ENTRY + b"\xff"):
+            batch.delete(k)
+        self._db.write_batch(batch)
+        self._first = meta.last_index + 1
+        self._last = meta.last_index

@@ -20,3 +20,6 @@ __all__ = [
     "ccshim", "common", "configtx", "gateway", "gossip", "msp",
     "orderer", "policies", "proposal", "rwset", "transaction",
 ]
+from fabric_tpu.protos import raft_pb2 as raft  # noqa: F401,E402
+
+__all__.append("raft")

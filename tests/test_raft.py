@@ -1,0 +1,450 @@
+"""Raft consensus: deterministic core protocol tests + 3-orderer
+crash-fault ordering service tests.
+
+Core tests drive whole clusters synchronously (no threads/clocks) —
+the reference tests the etcdraft chain against fake RPC the same way
+(`orderer/consensus/etcdraft/chain_test.go`); the e2e class mirrors
+`integration/raft/cft_test.go` (kill/restart orderers) in-process.
+"""
+
+import os
+import time
+
+import pytest
+
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.orderer.raft.core import (
+    CANDIDATE, FOLLOWER, LEADER, RaftNode,
+)
+from fabric_tpu.orderer.raft.storage import RaftStorage
+from fabric_tpu.protos import raft as rpb
+
+
+class Cluster:
+    """Synchronous deterministic raft test harness."""
+
+    def __init__(self, n: int, pre_vote: bool = True):
+        self.ids = list(range(1, n + 1))
+        self.nodes: dict[int, RaftNode] = {}
+        self.applied: dict[int, list[bytes]] = {i: [] for i in self.ids}
+        self.down: set[int] = set()
+        self.cut: set[frozenset] = set()
+        for i in self.ids:
+            self._make_node(i)
+
+    def _make_node(self, i: int, storage=None):
+        storage = storage or RaftStorage(
+            DBHandle(KVStore(":memory:"), f"raft{i}"))
+        self.nodes[i] = RaftNode(i, self.ids, storage,
+                                 election_tick=10, heartbeat_tick=2)
+        self._storages = getattr(self, "_storages", {})
+        self._storages[i] = storage
+
+    def restart(self, i: int):
+        """Rebuild the node from its persisted storage (crash sim)."""
+        self._make_node(i, self._storages[i])
+        self.down.discard(i)
+
+    def route(self, rounds: int = 50):
+        """Deliver all pending messages until quiescent."""
+        for _ in range(rounds):
+            moved = False
+            for i, node in self.nodes.items():
+                if i in self.down:
+                    node.ready()  # drain into the void
+                    continue
+                r = node.ready()
+                for e in r.committed_entries:
+                    if e.data and e.type == rpb.Entry.NORMAL:
+                        self.applied[i].append(bytes(e.data))
+                for m in r.messages:
+                    if m.to in self.down or i in self.down:
+                        continue
+                    if frozenset((i, m.to)) in self.cut:
+                        continue
+                    self.nodes[m.to].step(m)
+                    moved = True
+            if not moved:
+                return
+
+    def tick_until_leader(self, max_ticks: int = 200):
+        for _ in range(max_ticks):
+            for i, node in self.nodes.items():
+                if i not in self.down:
+                    node.tick()
+            self.route()
+            leaders = self.leaders()
+            if len(leaders) == 1:
+                # one more settle round so followers learn commit
+                self.route()
+                return leaders[0]
+        raise AssertionError(f"no leader after {max_ticks} ticks: " +
+                             str({i: n.state
+                                  for i, n in self.nodes.items()}))
+
+    def leaders(self):
+        return [i for i, n in self.nodes.items()
+                if n.state == LEADER and i not in self.down]
+
+    def settle(self, ticks: int = 30):
+        for _ in range(ticks):
+            for i, n in self.nodes.items():
+                if i not in self.down:
+                    n.tick()
+            self.route()
+
+
+class TestRaftCore:
+    def test_single_node_self_elects_and_commits(self):
+        c = Cluster(1)
+        leader = c.tick_until_leader()
+        assert leader == 1
+        assert c.nodes[1].propose(b"x")
+        c.route()
+        assert c.applied[1] == [b"x"]
+
+    def test_three_node_election_and_replication(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        assert len(c.leaders()) == 1
+        for i in range(5):
+            assert c.nodes[leader].propose(f"e{i}".encode())
+        c.settle(5)
+        expect = [f"e{i}".encode() for i in range(5)]
+        for i in c.ids:
+            assert c.applied[i] == expect, (i, c.applied[i])
+
+    def test_leader_crash_failover_no_loss(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        c.nodes[leader].propose(b"committed")
+        c.settle(5)
+        c.down.add(leader)
+        new_leader = c.tick_until_leader()
+        assert new_leader != leader
+        c.nodes[new_leader].propose(b"after-failover")
+        c.settle(5)
+        for i in c.ids:
+            if i in c.down:
+                continue
+            assert c.applied[i] == [b"committed", b"after-failover"]
+
+    def test_minority_cannot_commit(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        others = [i for i in c.ids if i != leader]
+        c.down.update(others)  # leader isolated with no quorum
+        c.nodes[leader].propose(b"orphan")
+        c.settle(5)
+        assert c.applied[leader] == []  # never committed
+
+    def test_partitioned_stale_leader_steps_down(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        others = [i for i in c.ids if i != leader]
+        # cut the old leader off, let the rest elect + commit
+        for o in others:
+            c.cut.add(frozenset((leader, o)))
+        new_leader = None
+        for _ in range(300):
+            for i in c.ids:
+                c.nodes[i].tick()
+            c.route()
+            fresh = [i for i in others
+                     if c.nodes[i].state == LEADER]
+            if fresh:
+                new_leader = fresh[0]
+                break
+        assert new_leader is not None
+        c.nodes[new_leader].propose(b"new-era")
+        c.settle(5)
+        # heal: the deposed leader must step down and converge
+        c.cut.clear()
+        c.settle(20)
+        assert c.nodes[leader].state == FOLLOWER
+        assert c.applied[leader] == [b"new-era"]
+        # old leader's uncommitted entries never surfaced anywhere
+        for i in c.ids:
+            assert c.applied[i] == [b"new-era"]
+
+    def test_crash_restart_recovers_from_wal(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        c.nodes[leader].propose(b"persisted")
+        c.settle(5)
+        victim = [i for i in c.ids if i != leader][0]
+        c.down.add(victim)
+        c.nodes[leader].propose(b"while-down")
+        c.settle(5)
+        c.restart(victim)
+        c.settle(20)
+        node = c.nodes[victim]
+        assert node.commit_index >= 2
+        # replays land via committed entries on restart apply path:
+        # storage retained both entries
+        entries = c._storages[victim].entries(1, 100)
+        data = [bytes(e.data) for e in entries if e.data]
+        assert b"persisted" in data and b"while-down" in data
+
+    def test_conf_change_add_and_evict(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        victim = [i for i in c.ids if i != leader][0]
+        keep = sorted(set(c.ids) - {victim})
+        assert c.nodes[leader].propose_conf_change(keep)
+        c.settle(10)
+        assert c.nodes[leader].peers == keep
+        # evicted node cannot win elections against the new quorum
+        assert set(c.nodes[victim].peers) == set(keep) or \
+            victim not in c.nodes[leader].peers
+
+    def test_log_compaction_and_snapshot_catchup(self):
+        c = Cluster(3)
+        leader = c.tick_until_leader()
+        victim = [i for i in c.ids if i != leader][0]
+        c.down.add(victim)
+        for i in range(10):
+            c.nodes[leader].propose(f"b{i}".encode())
+            c.settle(2)
+        # compact the leader's log past the victim's position
+        c.nodes[leader].compact(c.nodes[leader].applied_index,
+                                block_height=10)
+        assert c._storages[leader].first_index() > 1
+        c.down.discard(victim)
+        c.settle(30)
+        # victim accepted the snapshot position and resumed
+        assert c.nodes[victim].commit_index == \
+            c.nodes[leader].commit_index
+        c.nodes[leader].propose(b"fresh")
+        c.settle(5)
+        assert c.applied[victim][-1] == b"fresh"
+
+
+# ---------------------------------------------------------------------------
+# Ordering-service e2e over raft (crash-fault tolerance)
+# ---------------------------------------------------------------------------
+
+from fabric_tpu.bccsp.sw import SWProvider               # noqa: E402
+from fabric_tpu.internal import cryptogen                # noqa: E402
+from fabric_tpu.internal.configtxgen import (            # noqa: E402
+    genesis_block, new_channel_group,
+)
+from fabric_tpu.msp import msp_config_from_dir           # noqa: E402
+from fabric_tpu.msp.mspimpl import X509MSP               # noqa: E402
+from fabric_tpu.orderer import raft as raft_mod          # noqa: E402
+from fabric_tpu.orderer.broadcast import BroadcastHandler  # noqa: E402
+from fabric_tpu.orderer.cluster import LocalClusterNetwork  # noqa: E402
+from fabric_tpu.orderer.multichannel import Registrar    # noqa: E402
+from fabric_tpu.protos import common                     # noqa: E402
+from fabric_tpu.protoutil import protoutil as pu, txutils  # noqa: E402
+
+CHANNEL = "raftchannel"
+ORDERERS = [f"orderer{i}.example.com:7050" for i in range(3)]
+
+
+def _wait(cond, timeout=20.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+class RaftNet:
+    def __init__(self, root: str):
+        self.root = root
+        cdir = os.path.join(root, "crypto")
+        self.org1 = cryptogen.generate_org(cdir, "org1.example.com",
+                                           n_peers=1, n_users=1)
+        self.ordo = cryptogen.generate_org(cdir, "example.com",
+                                           orderer_org=True, n_orderers=3)
+        self.csp = SWProvider()
+        profile = {
+            "Consortium": "SampleConsortium",
+            "Capabilities": {"V2_0": True},
+            "Application": {
+                "Organizations": [
+                    {"Name": "Org1", "ID": "Org1MSP",
+                     "MSPDir": os.path.join(self.org1, "msp")},
+                ],
+                "Capabilities": {"V2_0": True},
+            },
+            "Orderer": {
+                "OrdererType": "etcdraft",
+                "Addresses": ORDERERS,
+                "BatchTimeout": "150ms",
+                "BatchSize": {"MaxMessageCount": 5},
+                "Raft": {"Consenters": [
+                    {"Host": ep.split(":")[0], "Port": 7050}
+                    for ep in ORDERERS
+                ]},
+                "Organizations": [
+                    {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                     "MSPDir": os.path.join(self.ordo, "msp"),
+                     "OrdererEndpoints": ORDERERS},
+                ],
+                "Capabilities": {"V2_0": True},
+            },
+        }
+        self.genesis = genesis_block(CHANNEL,
+                                     new_channel_group(profile))
+        self.net = LocalClusterNetwork()
+        self.registrars: dict[str, Registrar] = {}
+        self.transports = {}
+        self.broadcasts = {}
+        for i, ep in enumerate(ORDERERS):
+            self.start_orderer(i, join=True)
+        user_dir = os.path.join(self.org1, "users",
+                                "User1@org1.example.com", "msp")
+        msp = X509MSP(self.csp)
+        msp.setup(msp_config_from_dir(user_dir, "Org1MSP",
+                                      csp=self.csp))
+        self.user = msp.get_default_signing_identity()
+
+    def _orderer_msp(self, i: int):
+        d = os.path.join(self.ordo, "orderers",
+                         f"orderer{i}.example.com", "msp")
+        m = X509MSP(self.csp)
+        m.setup(msp_config_from_dir(d, "OrdererMSP", csp=self.csp))
+        return m
+
+    def start_orderer(self, i: int, join: bool = False):
+        ep = ORDERERS[i]
+        transport = self.net.register(ep)
+        signer = self._orderer_msp(i).get_default_signing_identity()
+        reg = Registrar(
+            os.path.join(self.root, f"orderer{i}"), signer, self.csp,
+            {"etcdraft": raft_mod.consenter(
+                transport, tick_interval_s=0.03, election_tick=8)})
+        if join:
+            reg.join(self.genesis)
+        self.registrars[ep] = reg
+        self.transports[ep] = transport
+        self.broadcasts[ep] = BroadcastHandler(reg)
+        return reg
+
+    def stop_orderer(self, i: int):
+        ep = ORDERERS[i]
+        self.net.take_down(ep)
+        reg = self.registrars.pop(ep)
+        reg.halt()
+        self.transports.pop(ep).close()
+        self.broadcasts.pop(ep, None)
+
+    def submit(self, ep: str, key: bytes, value: bytes):
+        """A normal message envelope through the broadcast API."""
+        env = self._simple_envelope(key, value)
+        return self.broadcasts[ep].process_message(env)
+
+    def _simple_envelope(self, key: bytes, value: bytes):
+        ch = pu.make_channel_header(
+            common.HeaderType.ENDORSER_TRANSACTION, CHANNEL)
+        sh = pu.create_signature_header(self.user.serialize(),
+                                        pu.random_nonce())
+        payload = pu.make_payload(ch, sh, key + b"=" + value)
+        return pu.sign_or_panic(self.user, payload)
+
+    def heights(self):
+        return {ep: reg.get_chain(CHANNEL).ledger.height
+                for ep, reg in self.registrars.items()}
+
+    def halt(self):
+        for reg in list(self.registrars.values()):
+            reg.halt()
+        for t in list(self.transports.values()):
+            t.close()
+
+
+@pytest.fixture(scope="class")
+def raftnet(tmp_path_factory):
+    net = RaftNet(str(tmp_path_factory.mktemp("raft")))
+    yield net
+    net.halt()
+
+
+class TestRaftOrdering:
+    def _leader_ep(self, net):
+        for ep, reg in net.registrars.items():
+            chain = reg.get_chain(CHANNEL).chain
+            if chain.node.state == LEADER:
+                return ep
+        return None
+
+    def test_election_then_order_through_any_node(self, raftnet):
+        assert _wait(lambda: self._leader_ep(raftnet) is not None), \
+            "no leader elected"
+        # submit through a NON-leader: must forward to the leader
+        leader = self._leader_ep(raftnet)
+        follower = next(ep for ep in raftnet.registrars
+                        if ep != leader)
+        resp = raftnet.submit(follower, b"k1", b"v1")
+        assert resp.status == common.Status.SUCCESS, resp
+        assert _wait(lambda: all(
+            h >= 2 for h in raftnet.heights().values())), \
+            raftnet.heights()
+        # identical blocks everywhere
+        blocks = [reg.get_chain(CHANNEL).ledger.get_block(1)
+                  for reg in raftnet.registrars.values()]
+        hashes = {pu.block_header_hash(b.header) for b in blocks}
+        assert len(hashes) == 1
+
+    def test_leader_crash_reelection_and_continuity(self, raftnet):
+        assert _wait(lambda: self._leader_ep(raftnet) is not None)
+        leader = self._leader_ep(raftnet)
+        idx = ORDERERS.index(leader)
+        base = max(raftnet.heights().values())
+        raftnet.stop_orderer(idx)
+        assert _wait(lambda: self._leader_ep(raftnet) is not None,
+                     timeout=25), "no re-election after leader crash"
+        new_leader = self._leader_ep(raftnet)
+        assert new_leader != leader
+        resp = raftnet.submit(new_leader, b"k2", b"v2")
+        assert resp.status == common.Status.SUCCESS
+        assert _wait(lambda: all(
+            h >= base + 1 for h in raftnet.heights().values())), \
+            raftnet.heights()
+        # restart the crashed orderer: it must catch up from its WAL +
+        # replication
+        raftnet.start_orderer(idx)
+        target = max(raftnet.heights().values())
+        assert _wait(lambda: raftnet.heights()[ORDERERS[idx]] >=
+                     target, timeout=25), raftnet.heights()
+
+    def test_survivors_match_after_rejoin(self, raftnet):
+        hs = raftnet.heights()
+        h = min(hs.values())
+        tips = [pu.block_header_hash(
+            reg.get_chain(CHANNEL).ledger.get_block(h - 1).header)
+            for reg in raftnet.registrars.values()]
+        assert len(set(tips)) == 1
+
+    def test_follower_onboarding_catches_up(self, raftnet, tmp_path):
+        """An orderer OUTSIDE the consenter set joins as a follower and
+        tracks the chain by pulling verified blocks."""
+        from fabric_tpu.orderer.channelparticipation import (
+            ChannelParticipation,
+        )
+        ep = "follower0.example.com:7050"
+        transport = raftnet.net.register(ep)
+        signer = raftnet._orderer_msp(0).get_default_signing_identity()
+        reg = Registrar(
+            str(tmp_path / "follower"), signer, raftnet.csp,
+            {"etcdraft": raft_mod.consenter(transport,
+                                            tick_interval_s=0.03)})
+        cp = ChannelParticipation(reg)
+        try:
+            info = cp.join(raftnet.genesis.SerializeToString())
+            assert info.consensus_relation == "follower"
+            target = max(raftnet.heights().values())
+            assert _wait(lambda: reg.get_chain(CHANNEL).ledger.height
+                         >= target, timeout=20), \
+                reg.get_chain(CHANNEL).ledger.height
+            listed = cp.list()
+            assert [c.name for c in listed.channels] == [CHANNEL]
+            assert listed.channels[0].height >= target
+            cp.remove(CHANNEL)
+            assert cp.list().channels == []
+        finally:
+            reg.halt()
+            transport.close()
