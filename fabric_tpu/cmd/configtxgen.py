@@ -1,0 +1,45 @@
+"""`configtxgen` CLI — genesis block generation from configtx.yaml.
+
+Reference: `internal/configtxgen` (`cmd/configtxgen`):
+  configtxgen -profile TwoOrgsApplicationGenesis -channelID ch \
+      -configPath configtx.yaml -outputBlock genesis.block
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="configtxgen")
+    p.add_argument("-profile", required=True)
+    p.add_argument("-channelID", required=True)
+    p.add_argument("-configPath", required=True,
+                   help="path to configtx.yaml")
+    p.add_argument("-outputBlock", required=True)
+    args = p.parse_args(argv)
+
+    from fabric_tpu.internal.configtxgen import (
+        genesis_block, new_channel_group,
+    )
+    with open(args.configPath) as f:
+        tree = yaml.safe_load(f)
+    profiles = tree.get("Profiles") or {}
+    if args.profile not in profiles:
+        print(f"profile {args.profile!r} not found "
+              f"(have: {sorted(profiles)})", file=sys.stderr)
+        return 1
+    block = genesis_block(args.channelID,
+                          new_channel_group(profiles[args.profile]))
+    with open(args.outputBlock, "wb") as f:
+        f.write(block.SerializeToString())
+    print(f"wrote genesis block for {args.channelID} to "
+          f"{args.outputBlock}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

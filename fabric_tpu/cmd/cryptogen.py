@@ -1,0 +1,55 @@
+"""`cryptogen` CLI — test-crypto hierarchy generation.
+
+Reference: `internal/cryptogen` (`cmd/cryptogen`):
+  cryptogen generate --config crypto-config.yaml --output crypto/
+
+crypto-config.yaml shape (subset of the reference's):
+  OrdererOrgs:
+    - Name: Orderer
+      Domain: example.com
+      Specs: [{Hostname: orderer0}, ...]   # or Template: {Count: N}
+  PeerOrgs:
+    - Name: Org1
+      Domain: org1.example.com
+      Template: {Count: 2}
+      Users: {Count: 1}
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import yaml
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cryptogen")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    gen = sub.add_parser("generate")
+    gen.add_argument("--config", required=True)
+    gen.add_argument("--output", required=True)
+    args = p.parse_args(argv)
+
+    from fabric_tpu.internal import cryptogen as cg
+    with open(args.config) as f:
+        tree = yaml.safe_load(f) or {}
+    for org in tree.get("OrdererOrgs") or []:
+        n = (org.get("Template") or {}).get("Count",
+                                            len(org.get("Specs") or [])
+                                            or 1)
+        cg.generate_org(args.output, org["Domain"], orderer_org=True,
+                        n_orderers=n)
+        print(f"generated orderer org {org['Domain']} ({n} orderers)")
+    for org in tree.get("PeerOrgs") or []:
+        n = (org.get("Template") or {}).get("Count", 1)
+        users = (org.get("Users") or {}).get("Count", 1)
+        cg.generate_org(args.output, org["Domain"], n_peers=n,
+                        n_users=users)
+        print(f"generated peer org {org['Domain']} "
+              f"({n} peers, {users} users)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

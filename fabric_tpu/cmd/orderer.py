@@ -1,0 +1,37 @@
+"""`orderer` CLI (reference: cmd/orderer + orderer/common/server).
+
+  orderer start --config orderer.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="orderer")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    start = sub.add_parser("start")
+    start.add_argument("--config", required=True)
+    args = p.parse_args(argv)
+
+    from fabric_tpu.common.viperutil import Config
+    from fabric_tpu.node.orderer_node import OrdererNode
+    cfg = Config.load(args.config, env_prefix="ORDERER")
+    node = OrdererNode(cfg)
+    node.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

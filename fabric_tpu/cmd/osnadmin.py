@@ -1,0 +1,61 @@
+"""`osnadmin` CLI — orderer channel participation admin.
+
+Reference: `cmd/osnadmin` / `internal/osnadmin`:
+  osnadmin channel join   --orderer-address <admin host:port> \
+      --channelID ch --config-block genesis.block
+  osnadmin channel list   --orderer-address <admin host:port>
+  osnadmin channel remove --orderer-address <admin host:port> \
+      --channelID ch
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import urllib.request
+
+
+def _http(method: str, url: str, body: bytes = b"") -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=body or None, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="osnadmin")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    chan = sub.add_parser("channel").add_subparsers(dest="sub",
+                                                    required=True)
+
+    join = chan.add_parser("join")
+    join.add_argument("--orderer-address", required=True)
+    join.add_argument("--channelID", required=False, default="")
+    join.add_argument("--config-block", required=True)
+
+    lst = chan.add_parser("list")
+    lst.add_argument("--orderer-address", required=True)
+    lst.add_argument("--channelID", default="")
+
+    rm = chan.add_parser("remove")
+    rm.add_argument("--orderer-address", required=True)
+    rm.add_argument("--channelID", required=True)
+
+    args = p.parse_args(argv)
+    base = f"http://{args.orderer_address}/participation/v1/channels"
+    if args.sub == "join":
+        with open(args.config_block, "rb") as f:
+            status, body = _http("POST", base, f.read())
+    elif args.sub == "list":
+        url = base + (f"/{args.channelID}" if args.channelID else "")
+        status, body = _http("GET", url)
+    else:
+        status, body = _http("DELETE", f"{base}/{args.channelID}")
+    print(body.decode() or f"status {status}")
+    return 0 if status < 300 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""`peer` CLI — node start, channel ops, chaincode invoke/query.
+
+Rebuild of `cmd/peer` + `internal/peer/*` (SURVEY §2.7 Peer CLI):
+  peer node start   --config core.yaml
+  peer channel join --ops <host:port> --block genesis.block
+  peer channel list --ops <host:port>
+  peer chaincode invoke --gateway <host:port> -C ch -n cc -a arg...
+  peer chaincode query  --gateway <host:port> -C ch -n cc -a arg...
+Identity for chaincode calls comes from --msp-dir/--msp-id (the
+client signs proposals locally, like the reference CLI's local MSP).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import urllib.request
+
+
+def _load_signer(msp_dir: str, msp_id: str):
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.msp import msp_config_from_dir
+    from fabric_tpu.msp.mspimpl import X509MSP
+    csp = SWProvider()
+    msp = X509MSP(csp)
+    msp.setup(msp_config_from_dir(msp_dir, msp_id, csp=csp))
+    return msp.get_default_signing_identity()
+
+
+def _http(method: str, url: str, body: bytes = b"") -> tuple[int, bytes]:
+    req = urllib.request.Request(url, data=body or None, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def cmd_node_start(args) -> int:
+    from fabric_tpu.common.viperutil import Config
+    from fabric_tpu.node.peer_node import PeerNode
+    cfg = Config.load(args.config, env_prefix="CORE")
+    node = PeerNode(cfg)
+    node.start()
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        node.stop()
+    return 0
+
+
+def cmd_channel_join(args) -> int:
+    with open(args.block, "rb") as f:
+        block = f.read()
+    status, body = _http("POST",
+                         f"http://{args.ops}/admin/channels", block)
+    print(body.decode())
+    return 0 if status in (200, 201) else 1
+
+
+def cmd_channel_list(args) -> int:
+    status, body = _http("GET", f"http://{args.ops}/admin/channels")
+    print(body.decode())
+    return 0 if status == 200 else 1
+
+
+def _gateway_client(args):
+    from fabric_tpu.comm import GatewayClient, channel_to
+    signer = _load_signer(args.msp_dir, args.msp_id)
+    return GatewayClient(channel_to(args.gateway), signer)
+
+
+def cmd_chaincode_invoke(args) -> int:
+    client = _gateway_client(args)
+    transient = json.loads(args.transient) if args.transient else None
+    if transient:
+        transient = {k: v.encode() for k, v in transient.items()}
+    tx_id, code = client.submit_transaction(
+        args.channel, args.name, [a.encode() for a in args.args],
+        transient=transient)
+    from fabric_tpu.protos import transaction as txpb
+    name = txpb.TxValidationCode.Name(code)
+    print(json.dumps({"tx_id": tx_id, "status": name}))
+    return 0 if code == txpb.TxValidationCode.VALID else 1
+
+
+def cmd_chaincode_query(args) -> int:
+    client = _gateway_client(args)
+    resp = client.evaluate(args.channel, args.name,
+                           [a.encode() for a in args.args])
+    if resp.status == 200:
+        sys.stdout.write(resp.payload.decode(errors="replace") + "\n")
+        return 0
+    print(json.dumps({"status": resp.status,
+                      "message": resp.message}), file=sys.stderr)
+    return 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="peer")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    node = sub.add_parser("node").add_subparsers(dest="sub",
+                                                 required=True)
+    start = node.add_parser("start")
+    start.add_argument("--config", required=True)
+    start.set_defaults(fn=cmd_node_start)
+
+    chan = sub.add_parser("channel").add_subparsers(dest="sub",
+                                                    required=True)
+    join = chan.add_parser("join")
+    join.add_argument("--ops", required=True)
+    join.add_argument("--block", required=True)
+    join.set_defaults(fn=cmd_channel_join)
+    lst = chan.add_parser("list")
+    lst.add_argument("--ops", required=True)
+    lst.set_defaults(fn=cmd_channel_list)
+
+    cc = sub.add_parser("chaincode").add_subparsers(dest="sub",
+                                                    required=True)
+    for verb, fn in (("invoke", cmd_chaincode_invoke),
+                     ("query", cmd_chaincode_query)):
+        cp = cc.add_parser(verb)
+        cp.add_argument("--gateway", required=True)
+        cp.add_argument("--msp-dir", required=True)
+        cp.add_argument("--msp-id", required=True)
+        cp.add_argument("-C", "--channel", required=True)
+        cp.add_argument("-n", "--name", required=True)
+        cp.add_argument("-a", "--args", nargs="+", default=[])
+        cp.add_argument("--transient", default="")
+        cp.set_defaults(fn=fn)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+from fabric_tpu.comm.server import GRPCServer, ServerConfig  # noqa: F401
+from fabric_tpu.comm.clients import (  # noqa: F401
+    BroadcastClient, DeliverClient, EndorserClient, GatewayClient,
+    channel_to,
+)

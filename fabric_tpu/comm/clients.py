@@ -1,0 +1,202 @@
+"""gRPC client adapters matching the in-process duck types.
+
+Rebuild of `internal/pkg/comm` client side: each adapter speaks the
+method tables of comm/services.py and presents the same surface the
+in-process objects do, so peers/orderers/CLIs compose identically in
+one process or across the network.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import grpc
+
+from fabric_tpu.comm import services as svc
+from fabric_tpu.protos import common, gateway as gwpb
+from fabric_tpu.protos import orderer as opb, proposal as ppb
+
+logger = logging.getLogger("comm.clients")
+
+_OPTS = [
+    ("grpc.max_send_message_length", 100 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 100 * 1024 * 1024),
+]
+
+
+def channel_to(address: str, tls_root_ca: Optional[bytes] = None,
+               client_cert: Optional[bytes] = None,
+               client_key: Optional[bytes] = None) -> grpc.Channel:
+    if tls_root_ca is None:
+        return grpc.insecure_channel(address, options=_OPTS)
+    creds = grpc.ssl_channel_credentials(
+        root_certificates=tls_root_ca,
+        private_key=client_key, certificate_chain=client_cert)
+    return grpc.secure_channel(address, creds, options=_OPTS)
+
+
+def _uu(channel, service, method, req_cls, resp_cls):
+    return channel.unary_unary(
+        f"/{service}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString)
+
+
+def _us(channel, service, method, req_cls, resp_cls):
+    return channel.unary_stream(
+        f"/{service}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString)
+
+
+class EndorserClient:
+    """Duck-type of `peer.endorser` (process_proposal)."""
+
+    def __init__(self, channel: grpc.Channel, timeout_s: float = 30.0):
+        self._call = _uu(channel, svc.ENDORSER_SERVICE,
+                         "ProcessProposal", ppb.SignedProposal,
+                         ppb.ProposalResponse)
+        self._timeout = timeout_s
+
+    def process_proposal(self, sp: ppb.SignedProposal
+                         ) -> ppb.ProposalResponse:
+        return self._call(sp, timeout=self._timeout)
+
+
+class BroadcastClient:
+    """Duck-type of BroadcastHandler (process_message)."""
+
+    def __init__(self, channel: grpc.Channel, timeout_s: float = 30.0):
+        self._call = _uu(channel, svc.BROADCAST_SERVICE, "Broadcast",
+                         common.Envelope, opb.BroadcastResponse)
+        self._timeout = timeout_s
+
+    def process_message(self, env: common.Envelope
+                        ) -> opb.BroadcastResponse:
+        return self._call(env, timeout=self._timeout)
+
+
+class DeliverClient:
+    """Duck-type of DeliverHandler (handle → iterator) — plugs into
+    peer.deliverclient.Deliverer as its orderer_source."""
+
+    def __init__(self, channel: grpc.Channel):
+        self._call = _us(channel, svc.DELIVER_SERVICE, "Deliver",
+                         common.Envelope, opb.DeliverResponse)
+
+    def handle(self, env: common.Envelope):
+        yield from self._call(env)
+
+
+class GatewayClient:
+    """Client-side SDK over the Gateway service: builds and SIGNS
+    proposals/envelopes locally (the reference's client SDK role)."""
+
+    def __init__(self, channel: grpc.Channel, signer,
+                 timeout_s: float = 30.0):
+        self._signer = signer
+        self._timeout = timeout_s
+        self._evaluate = _uu(channel, svc.GATEWAY_SERVICE, "Evaluate",
+                             gwpb.EvaluateRequest, gwpb.EvaluateResponse)
+        self._endorse = _uu(channel, svc.GATEWAY_SERVICE, "Endorse",
+                            gwpb.EndorseRequest, gwpb.EndorseResponse)
+        self._submit = _uu(channel, svc.GATEWAY_SERVICE, "Submit",
+                           gwpb.SubmitRequest, gwpb.SubmitResponse)
+        self._status = _uu(channel, svc.GATEWAY_SERVICE, "CommitStatus",
+                           gwpb.SignedCommitStatusRequest,
+                           gwpb.CommitStatusResponse)
+
+    def _proposal(self, channel_id: str, cc_name: str,
+                  args: Sequence[bytes], transient=None):
+        from fabric_tpu.protoutil import txutils
+        prop, tx_id = txutils.create_proposal(
+            channel_id, cc_name, list(args),
+            self._signer.serialize(), transient_map=transient)
+        return txutils.sign_proposal(prop, self._signer), tx_id
+
+    def evaluate(self, channel_id: str, cc_name: str,
+                 args: Sequence[bytes], transient=None) -> ppb.Response:
+        sp, tx_id = self._proposal(channel_id, cc_name, args, transient)
+        req = gwpb.EvaluateRequest(transaction_id=tx_id,
+                                   channel_id=channel_id)
+        req.proposed_transaction.CopyFrom(sp)
+        return self._evaluate(req, timeout=self._timeout).result
+
+    def submit_transaction(self, channel_id: str, cc_name: str,
+                           args: Sequence[bytes], transient=None,
+                           endorsing_organizations: Sequence[str] = (),
+                           timeout_s: float = 30.0) -> tuple[str, int]:
+        """endorse → sign → submit → wait for commit; returns
+        (tx_id, validation_code)."""
+        from fabric_tpu.protoutil import protoutil as pu
+        sp, tx_id = self._proposal(channel_id, cc_name, args, transient)
+        req = gwpb.EndorseRequest(transaction_id=tx_id,
+                                  channel_id=channel_id)
+        req.proposed_transaction.CopyFrom(sp)
+        req.endorsing_organizations.extend(endorsing_organizations)
+        prepared = self._endorse(req, timeout=self._timeout) \
+            .prepared_transaction
+        # client-side signature over the prepared payload
+        payload = common.Payload()
+        payload.ParseFromString(prepared.payload)
+        env = pu.sign_or_panic(self._signer, payload)
+        sreq = gwpb.SubmitRequest(transaction_id=tx_id,
+                                  channel_id=channel_id)
+        sreq.prepared_transaction.CopyFrom(env)
+        self._submit(sreq, timeout=self._timeout)
+        inner = gwpb.CommitStatusRequest(
+            transaction_id=tx_id, channel_id=channel_id,
+            identity=self._signer.serialize())
+        creq = gwpb.SignedCommitStatusRequest(
+            request=inner.SerializeToString())
+        code = self._status(creq, timeout=timeout_s).result
+        return tx_id, code
+
+
+class ClusterClient:
+    """Duck-type of ClusterTransport's outbound half for one target."""
+
+    def __init__(self, channel: grpc.Channel, self_endpoint: str,
+                 timeout_s: float = 10.0):
+        self._step = _uu(channel, svc.CLUSTER_SERVICE, "Step",
+                         opb.StepRequest, opb.StepResponse)
+        self._pull = _us(channel, svc.CLUSTER_SERVICE, "PullBlocks",
+                         common.Envelope, opb.DeliverResponse)
+        self._meta = (("sender-endpoint", self_endpoint),)
+        self._timeout = timeout_s
+
+    def send_consensus(self, channel_id: str, payload: bytes) -> None:
+        req = opb.StepRequest()
+        req.consensus_request.channel = channel_id
+        req.consensus_request.payload = payload
+        self._step(req, metadata=self._meta, timeout=self._timeout)
+
+    def submit(self, channel_id: str,
+               env_bytes: bytes) -> opb.SubmitResponse:
+        req = opb.StepRequest()
+        req.submit_request.channel = channel_id
+        req.submit_request.payload = env_bytes
+        resp = self._step(req, metadata=self._meta,
+                          timeout=self._timeout)
+        return resp.submit_response
+
+    def pull_blocks(self, channel_id: str, start: int,
+                    end: int) -> list[common.Block]:
+        from fabric_tpu.protoutil import protoutil as pu
+        seek = opb.SeekInfo()
+        seek.start.specified.number = start
+        seek.stop.specified.number = end
+        ch = pu.make_channel_header(common.HeaderType.DELIVER_SEEK_INFO,
+                                    channel_id)
+        sh = common.SignatureHeader()
+        payload = pu.make_payload(ch, sh, seek.SerializeToString())
+        env = common.Envelope(payload=payload.SerializeToString())
+        out = []
+        for resp in self._pull(env, metadata=self._meta,
+                               timeout=self._timeout):
+            if resp.WhichOneof("type") == "block":
+                block = common.Block()
+                block.CopyFrom(resp.block)
+                out.append(block)
+        return out

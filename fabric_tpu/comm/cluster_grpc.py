@@ -1,0 +1,129 @@
+"""gRPC cluster transport: orderer↔orderer Step over the network.
+
+Rebuild of `orderer/common/cluster/comm.go` (RemoteContext/Step RPC):
+the outbound half dials fellow consenters' Cluster services; the
+inbound half is comm.services.register_cluster(server, transport) —
+which feeds enqueue_consensus/handle_submit/handle_pull exactly like
+the in-process LocalClusterTransport, so RaftChain runs unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+from fabric_tpu.comm.clients import ClusterClient, channel_to
+from fabric_tpu.orderer.cluster import ClusterTransport
+from fabric_tpu.protos import common, orderer as opb
+
+logger = logging.getLogger("comm.cluster")
+
+
+class GRPCClusterTransport(ClusterTransport):
+    def __init__(self, endpoint: str,
+                 tls_root_ca: Optional[bytes] = None):
+        self.endpoint = endpoint
+        self._tls_root_ca = tls_root_ca
+        self._clients: dict[str, ClusterClient] = {}
+        self._channels = {}
+        self._handlers: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._inbox: queue.Queue = queue.Queue(maxsize=4096)
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._drain, name=f"cluster-grpc-{endpoint}",
+            daemon=True)
+        self._thread.start()
+
+    def _client(self, target: str) -> ClusterClient:
+        with self._lock:
+            c = self._clients.get(target)
+            if c is None:
+                ch = channel_to(target, self._tls_root_ca)
+                self._channels[target] = ch
+                c = ClusterClient(ch, self.endpoint)
+                self._clients[target] = c
+            return c
+
+    # -- ClusterTransport outbound --
+
+    def send_consensus(self, target: str, channel: str,
+                       payload: bytes) -> None:
+        try:
+            self._client(target).send_consensus(channel, payload)
+        except Exception:
+            logger.debug("consensus send to %s failed", target)
+
+    def submit(self, target: str, channel: str,
+               env_bytes: bytes) -> opb.SubmitResponse:
+        try:
+            return self._client(target).submit(channel, env_bytes)
+        except Exception as e:
+            return opb.SubmitResponse(
+                channel=channel,
+                status=common.Status.SERVICE_UNAVAILABLE,
+                info=f"{target}: {e}")
+
+    def pull_blocks(self, target: str, channel: str, start: int,
+                    end: int) -> list[common.Block]:
+        try:
+            return self._client(target).pull_blocks(channel, start,
+                                                    end)
+        except Exception:
+            return []
+
+    # -- handler registry (RaftChain registers itself) --
+
+    def set_handler(self, channel: str, handler) -> None:
+        self._handlers[channel] = handler
+
+    def remove_handler(self, channel: str) -> None:
+        self._handlers.pop(channel, None)
+
+    # -- inbound (comm.services.register_cluster calls these) --
+
+    def enqueue_consensus(self, sender: str, channel: str,
+                          payload: bytes) -> None:
+        try:
+            self._inbox.put_nowait((sender, channel, payload))
+        except queue.Full:
+            logger.warning("[%s] cluster inbox full", self.endpoint)
+
+    def _drain(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sender, channel, payload = self._inbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            handler = self._handlers.get(channel)
+            if handler is None:
+                continue
+            try:
+                handler.on_consensus(sender, payload)
+            except Exception:
+                logger.exception("consensus handler failed")
+
+    def handle_submit(self, channel: str,
+                      env_bytes: bytes) -> opb.SubmitResponse:
+        handler = self._handlers.get(channel)
+        if handler is None:
+            return opb.SubmitResponse(
+                channel=channel, status=common.Status.NOT_FOUND,
+                info=f"channel {channel} not served here")
+        return handler.on_submit(env_bytes)
+
+    def handle_pull(self, channel: str, start: int,
+                    end: int) -> list[common.Block]:
+        handler = self._handlers.get(channel)
+        if handler is None:
+            return []
+        return handler.serve_blocks(start, end)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=2)
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()

@@ -1,0 +1,90 @@
+"""gRPC gossip transport: the cross-process fabric behind the
+gossip Transport seam.
+
+Rebuild of `gossip/comm/comm_impl.go`'s role (gRPC message fabric with
+per-target connection reuse); the in-process LocalNetwork and this
+class are interchangeable behind `fabric_tpu.gossip.transport.
+Transport`. Sender identity rides in call metadata; message-level
+trust comes from the signed gossip envelopes themselves (alive /
+state-info signatures), exactly what the gossip core verifies.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import grpc
+
+from fabric_tpu.comm import services as svc
+from fabric_tpu.comm.clients import _OPTS
+from fabric_tpu.gossip.transport import Transport
+from fabric_tpu.protos import gossip as gpb
+
+logger = logging.getLogger("comm.gossip")
+
+
+class GRPCGossipTransport(Transport):
+    """Outbound half; the inbound half is comm.services.
+    register_gossip(server, transport.deliver_local)."""
+
+    def __init__(self, endpoint: str,
+                 tls_root_ca: Optional[bytes] = None):
+        self.endpoint = endpoint
+        self._tls_root_ca = tls_root_ca
+        self._handler = None
+        self._channels: dict[str, grpc.Channel] = {}
+        self._calls: dict[str, object] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def set_handler(self, handler) -> None:
+        self._handler = handler
+
+    def deliver_local(self, sender: str,
+                      smsg: gpb.SignedGossipMessage) -> None:
+        """Wired as the server-side Send handler."""
+        handler = self._handler
+        if handler is not None:
+            handler(sender, smsg)
+
+    def _call_for(self, endpoint: str):
+        with self._lock:
+            call = self._calls.get(endpoint)
+            if call is None:
+                if self._tls_root_ca is None:
+                    ch = grpc.insecure_channel(endpoint, options=_OPTS)
+                else:
+                    ch = grpc.secure_channel(
+                        endpoint, grpc.ssl_channel_credentials(
+                            root_certificates=self._tls_root_ca),
+                        options=_OPTS)
+                self._channels[endpoint] = ch
+                call = ch.unary_unary(
+                    f"/{svc.GOSSIP_SERVICE}/Send",
+                    request_serializer=lambda m:
+                        m.SerializeToString(),
+                    response_deserializer=gpb.Empty.FromString)
+                self._calls[endpoint] = call
+            return call
+
+    def send(self, endpoint: str, msg: gpb.SignedGossipMessage) -> None:
+        if self._closed:
+            return
+        try:
+            call = self._call_for(endpoint)
+            call.future(msg, timeout=5,
+                        metadata=(("sender-endpoint", self.endpoint),))
+        except Exception:
+            # gossip is loss-tolerant; a dead peer is discovery's
+            # problem, not the sender's
+            logger.debug("gossip send to %s failed", endpoint)
+
+    def close(self) -> None:
+        self._closed = True
+        with self._lock:
+            for ch in self._channels.values():
+                ch.close()
+            self._channels.clear()
+            self._calls.clear()

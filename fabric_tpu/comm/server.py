@@ -1,0 +1,116 @@
+"""gRPC server infrastructure.
+
+Rebuild of `internal/pkg/comm/server.go` (`comm.GRPCServer:45`):
+listener + TLS credential handling + service registration, shared by
+every gRPC surface (endorser, deliver, gateway, gossip, cluster,
+broadcast). Our .proto files generate message codecs only; services are
+registered through grpc's generic-handler API with explicit method
+tables — one mechanism for every service instead of per-service
+codegen (the seam the reference gets from protoc-gen-go-grpc).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import grpc
+
+logger = logging.getLogger("comm.server")
+
+UNARY_UNARY = "uu"
+UNARY_STREAM = "us"
+STREAM_STREAM = "ss"
+
+
+@dataclass
+class ServerConfig:
+    """Reference: comm.ServerConfig / SecureOptions."""
+    address: str = "127.0.0.1:0"
+    tls_cert: Optional[bytes] = None      # PEM
+    tls_key: Optional[bytes] = None       # PEM
+    client_root_cas: Optional[bytes] = None  # PEM bundle → mTLS required
+    max_workers: int = 32
+    max_message_mb: int = 100
+
+
+class GRPCServer:
+    def __init__(self, config: ServerConfig):
+        self._cfg = config
+        opts = [
+            ("grpc.max_send_message_length",
+             config.max_message_mb * 1024 * 1024),
+            ("grpc.max_receive_message_length",
+             config.max_message_mb * 1024 * 1024),
+        ]
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=config.max_workers),
+            options=opts)
+        if config.tls_cert:
+            require_auth = config.client_root_cas is not None
+            creds = grpc.ssl_server_credentials(
+                [(config.tls_key, config.tls_cert)],
+                root_certificates=config.client_root_cas,
+                require_client_auth=require_auth)
+            self.port = self._server.add_secure_port(config.address,
+                                                     creds)
+        else:
+            self.port = self._server.add_insecure_port(config.address)
+        if self.port == 0:
+            raise OSError(f"cannot listen on {config.address}")
+        host = config.address.rsplit(":", 1)[0]
+        self.address = f"{host}:{self.port}"
+        self._started = threading.Event()
+
+    def add_service(self, service_name: str,
+                    methods: dict[str, tuple]) -> None:
+        """`methods`: name → (kind, handler, request_cls, response_cls).
+        Handler signatures by kind:
+          uu: (request, context) -> response
+          us: (request, context) -> iterator[response]
+          ss: (request_iterator, context) -> iterator[response]
+        """
+        table = {}
+        for name, (kind, fn, req_cls, resp_cls) in methods.items():
+            deser = req_cls.FromString if req_cls else (lambda b: b)
+            ser = (lambda m: m.SerializeToString()) if resp_cls \
+                else (lambda b: b)
+            if kind == UNARY_UNARY:
+                table[name] = grpc.unary_unary_rpc_method_handler(
+                    self._wrap(fn), request_deserializer=deser,
+                    response_serializer=ser)
+            elif kind == UNARY_STREAM:
+                table[name] = grpc.unary_stream_rpc_method_handler(
+                    self._wrap(fn), request_deserializer=deser,
+                    response_serializer=ser)
+            else:
+                table[name] = grpc.stream_stream_rpc_method_handler(
+                    self._wrap(fn), request_deserializer=deser,
+                    response_serializer=ser)
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(service_name,
+                                                  table),))
+
+    @staticmethod
+    def _wrap(fn: Callable) -> Callable:
+        def wrapped(request, context):
+            try:
+                return fn(request, context)
+            except grpc.RpcError:
+                raise
+            except Exception as e:
+                logger.exception("handler failed")
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return wrapped
+
+    def start(self) -> None:
+        self._server.start()
+        self._started.set()
+        logger.info("grpc server listening on %s%s", self.address,
+                    " (tls)" if self._cfg.tls_cert else "")
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace).wait(timeout=grace + 2)

@@ -1,0 +1,155 @@
+"""gRPC service registrations: bind transport-free handlers to methods.
+
+The service surface mirrors the reference's registrations
+(`internal/peer/node/start.go:895-911` for the peer,
+`orderer/common/server/main.go` for the orderer): Endorser, Deliver,
+Gateway and Gossip on the peer; AtomicBroadcast, Deliver, Cluster and
+Participation on the orderer. Handlers are the same objects the
+in-process topology uses — this module only adapts calling
+conventions.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from fabric_tpu.comm.server import (
+    GRPCServer, STREAM_STREAM, UNARY_STREAM, UNARY_UNARY,
+)
+from fabric_tpu.protos import common, gateway as gwpb, gossip as gpb
+from fabric_tpu.protos import orderer as opb, proposal as ppb
+
+logger = logging.getLogger("comm.services")
+
+ENDORSER_SERVICE = "ftpu.Endorser"
+DELIVER_SERVICE = "ftpu.Deliver"
+GATEWAY_SERVICE = "ftpu.Gateway"
+GOSSIP_SERVICE = "ftpu.Gossip"
+BROADCAST_SERVICE = "ftpu.AtomicBroadcast"
+CLUSTER_SERVICE = "ftpu.Cluster"
+
+
+def register_endorser(server: GRPCServer, endorser) -> None:
+    server.add_service(ENDORSER_SERVICE, {
+        "ProcessProposal": (
+            UNARY_UNARY,
+            lambda req, ctx: endorser.process_proposal(req),
+            ppb.SignedProposal, ppb.ProposalResponse),
+    })
+
+
+def register_deliver(server: GRPCServer, deliver_handler) -> None:
+    """Works for both peer- and orderer-side deliver (the shared
+    `common/deliver` engine)."""
+    def handle(env, ctx):
+        yield from deliver_handler.handle(env)
+    server.add_service(DELIVER_SERVICE, {
+        "Deliver": (UNARY_STREAM, handle,
+                    common.Envelope, opb.DeliverResponse),
+    })
+
+
+def register_broadcast(server: GRPCServer, broadcast_handler) -> None:
+    server.add_service(BROADCAST_SERVICE, {
+        "Broadcast": (
+            UNARY_UNARY,
+            lambda env, ctx: broadcast_handler.process_message(env),
+            common.Envelope, opb.BroadcastResponse),
+    })
+
+
+def register_gateway(server: GRPCServer, gateway) -> None:
+    from fabric_tpu.protos import transaction as txpb
+
+    def evaluate(req: gwpb.EvaluateRequest, ctx):
+        resp = gateway.evaluate_signed(req.channel_id,
+                                       req.proposed_transaction)
+        return gwpb.EvaluateResponse(result=resp)
+
+    def endorse(req: gwpb.EndorseRequest, ctx):
+        env = gateway.endorse_signed(req.channel_id,
+                                     req.proposed_transaction,
+                                     list(req.endorsing_organizations))
+        return gwpb.EndorseResponse(prepared_transaction=env)
+
+    def submit(req: gwpb.SubmitRequest, ctx):
+        gateway.submit(req.prepared_transaction)
+        return gwpb.SubmitResponse()
+
+    def commit_status(req: gwpb.SignedCommitStatusRequest, ctx):
+        inner = gwpb.CommitStatusRequest()
+        inner.ParseFromString(req.request)
+        code = gateway.commit_status(inner.channel_id,
+                                     inner.transaction_id)
+        return gwpb.CommitStatusResponse(
+            result=code, block_number=0)
+
+    server.add_service(GATEWAY_SERVICE, {
+        "Evaluate": (UNARY_UNARY, evaluate,
+                     gwpb.EvaluateRequest, gwpb.EvaluateResponse),
+        "Endorse": (UNARY_UNARY, endorse,
+                    gwpb.EndorseRequest, gwpb.EndorseResponse),
+        "Submit": (UNARY_UNARY, submit,
+                   gwpb.SubmitRequest, gwpb.SubmitResponse),
+        "CommitStatus": (UNARY_UNARY, commit_status,
+                         gwpb.SignedCommitStatusRequest,
+                         gwpb.CommitStatusResponse),
+    })
+
+
+def register_gossip(server: GRPCServer, on_message) -> None:
+    """`on_message(sender_endpoint, SignedGossipMessage)` — the
+    Transport handler. The sender's endpoint rides in metadata (the
+    reference binds it via the mTLS handshake + ConnEstablish)."""
+    def send(smsg: gpb.SignedGossipMessage, ctx):
+        sender = dict(ctx.invocation_metadata()).get("sender-endpoint",
+                                                     "")
+        on_message(sender, smsg)
+        return gpb.Empty()
+    server.add_service(GOSSIP_SERVICE, {
+        "Send": (UNARY_UNARY, send,
+                 gpb.SignedGossipMessage, gpb.Empty),
+    })
+
+
+def register_cluster(server: GRPCServer, transport_hub) -> None:
+    """`transport_hub`: the node-side GRPCClusterTransport (its
+    handle_* methods mirror LocalClusterTransport)."""
+    def step(req: opb.StepRequest, ctx):
+        which = req.WhichOneof("payload")
+        if which == "consensus_request":
+            cr = req.consensus_request
+            sender = dict(ctx.invocation_metadata()).get(
+                "sender-endpoint", "")
+            transport_hub.enqueue_consensus(sender, cr.channel,
+                                            bytes(cr.payload))
+            return opb.StepResponse()
+        sr = req.submit_request
+        resp = transport_hub.handle_submit(sr.channel,
+                                           bytes(sr.payload))
+        out = opb.StepResponse()
+        out.submit_response.CopyFrom(resp)
+        return out
+
+    def pull(env: common.Envelope, ctx):
+        """Block pull re-uses the SeekInfo wire shape: payload.data =
+        marshaled SeekInfo, channel header carries the channel."""
+        from fabric_tpu.protoutil import protoutil as pu
+        payload = pu.get_payload(env)
+        ch = pu.get_channel_header(payload)
+        seek = opb.SeekInfo()
+        seek.ParseFromString(payload.data)
+        start = seek.start.specified.number
+        end = seek.stop.specified.number
+        for block in transport_hub.handle_pull(ch.channel_id, start,
+                                               end):
+            resp = opb.DeliverResponse()
+            resp.block.CopyFrom(block)
+            yield resp
+
+    server.add_service(CLUSTER_SERVICE, {
+        "Step": (UNARY_UNARY, step,
+                 opb.StepRequest, opb.StepResponse),
+        "PullBlocks": (UNARY_STREAM, pull,
+                       common.Envelope, opb.DeliverResponse),
+    })

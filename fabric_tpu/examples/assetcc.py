@@ -1,0 +1,52 @@
+"""Asset-transfer sample chaincode (the e2e `asset-transfer-basic`
+analog from fabric-samples, used by the nwo integration harness and as
+the in-process chaincode demo)."""
+
+from __future__ import annotations
+
+from fabric_tpu.core.chaincode import Chaincode, shim
+
+
+class AssetChaincode(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            stub.set_event("put", params[0].encode())
+            return shim.success()
+        if fn == "get":
+            val = stub.get_state(params[0])
+            if val is None:
+                return shim.error(f"asset {params[0]} not found")
+            return shim.success(val)
+        if fn == "del":
+            stub.del_state(params[0])
+            return shim.success()
+        if fn == "transfer":
+            src, dst, amt = params[0], params[1], int(params[2])
+            a = int(stub.get_state(src) or b"0")
+            b = int(stub.get_state(dst) or b"0")
+            if a < amt:
+                return shim.error("insufficient funds")
+            stub.put_state(src, str(a - amt).encode())
+            stub.put_state(dst, str(b + amt).encode())
+            return shim.success()
+        if fn == "range":
+            items = [f"{k}={v.decode()}"
+                     for k, v in stub.get_state_by_range(
+                         params[0] if params else "",
+                         params[1] if len(params) > 1 else "")]
+            return shim.success(",".join(items).encode())
+        if fn == "putpvt":
+            stub.put_private_data(params[0], params[1],
+                                  stub.get_transient()["value"])
+            return shim.success()
+        if fn == "getpvt":
+            val = stub.get_private_data(params[0], params[1])
+            if val is None:
+                return shim.error("no private value")
+            return shim.success(val)
+        return shim.error(f"unknown function {fn!r}")

@@ -72,9 +72,10 @@ class Discovery:
     # -- lifecycle --
 
     def start(self, bootstrap: list[str] = ()) -> None:
-        for endpoint in bootstrap:
-            if endpoint != self.self_member.endpoint:
-                self._send(endpoint, self._membership_request())
+        self._bootstrap = [e for e in bootstrap
+                           if e != self.self_member.endpoint]
+        for endpoint in self._bootstrap:
+            self._send(endpoint, self._membership_request())
         self._thread = threading.Thread(target=self._loop,
                                         name="gossip-discovery",
                                         daemon=True)
@@ -90,6 +91,11 @@ class Discovery:
             try:
                 self._emit_alive()
                 self._expire_dead()
+                # isolated node (e.g. bootstrap peers weren't up yet):
+                # keep knocking (reference reconnect loop)
+                if not self._alive and getattr(self, "_bootstrap", None):
+                    for endpoint in self._bootstrap:
+                        self._send(endpoint, self._membership_request())
             except Exception:
                 logger.exception("discovery loop error")
 

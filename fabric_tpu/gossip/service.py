@@ -106,7 +106,11 @@ class GossipService:
         state = GossipStateProvider(self.node, channel_id, peer_channel,
                                     self._mcs)
         privdata = PrivDataProvider(self.node, channel_id, peer_channel,
-                                    self._peer, self._org_of_identity)
+                                    self._peer, self._org_of_identity,
+                                    reconcile_interval_s=max(
+                                        0.5,
+                                        self.node.cfg.alive_interval_s
+                                        * 3))
         res = ChannelGossipResources(election=None, state=state,
                                      privdata=privdata)
 

@@ -71,15 +71,15 @@ class KVLedger:
         hopts = lambda name: metrics_mod.HistogramOpts(  # noqa: E731
             namespace="ledger", name=name, label_names=("channel",))
         self._m_block_time = provider.new_histogram(
-            hopts("block_processing_time")).with_labels(ledger_id)
+            hopts("block_processing_time")).with_labels("channel", ledger_id)
         self._m_store_time = provider.new_histogram(
             hopts("blockstorage_and_pvtdata_commit_time")
-        ).with_labels(ledger_id)
+        ).with_labels("channel", ledger_id)
         self._m_state_time = provider.new_histogram(
-            hopts("statedb_commit_time")).with_labels(ledger_id)
+            hopts("statedb_commit_time")).with_labels("channel", ledger_id)
         self._m_height = provider.new_gauge(metrics_mod.GaugeOpts(
             namespace="ledger", name="blockchain_height",
-            label_names=("channel",))).with_labels(ledger_id)
+            label_names=("channel",))).with_labels("channel", ledger_id)
 
         self._recover_dbs()
         self._commit_hash = self._load_commit_hash()

@@ -1,0 +1,3 @@
+from fabric_tpu.node.operations import OperationsServer  # noqa: F401
+from fabric_tpu.node.peer_node import PeerNode  # noqa: F401
+from fabric_tpu.node.orderer_node import OrdererNode  # noqa: F401

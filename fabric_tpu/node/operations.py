@@ -1,0 +1,151 @@
+"""Operations endpoint: /metrics, /healthz, /logspec, /version.
+
+Rebuild of `core/operations/system.go:67-195` + `common/fabhttp`: one
+HTTP listener per node serving Prometheus metrics, health checks
+(pluggable checkers, reference healthz lib), runtime log-level
+changes (flogging admin) and the version. Extra handlers (orderer
+channel participation) mount under their own prefixes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+from fabric_tpu.common import flogging
+
+logger = logging.getLogger("operations")
+
+VERSION = "0.2.0"
+
+
+class OperationsServer:
+    def __init__(self, address: str = "127.0.0.1:0",
+                 metrics_provider=None, version: str = VERSION):
+        host, port = address.rsplit(":", 1)
+        self._metrics = metrics_provider
+        self._version = version
+        self._checkers: dict[str, Callable[[], None]] = {}
+        self._extra: dict[str, Callable] = {}
+        ops = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                logger.debug("ops: " + fmt, *args)
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                ops._route(self, "GET")
+
+            def do_POST(self):
+                ops._route(self, "POST")
+
+            def do_PUT(self):
+                ops._route(self, "PUT")
+
+            def do_DELETE(self):
+                ops._route(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.port = self._httpd.server_address[1]
+        self.address = f"{host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- plumbing --
+
+    def register_checker(self, component: str,
+                         check: Callable[[], None]) -> None:
+        """`check()` raises when unhealthy (reference: healthz
+        HealthChecker)."""
+        self._checkers[component] = check
+
+    def register_handler(self, prefix: str,
+                         fn: Callable[[str, str, bytes],
+                                      tuple[int, bytes]]) -> None:
+        """Mount `fn(method, path, body) -> (status, json_bytes)`
+        under a path prefix (participation API etc.)."""
+        self._extra[prefix] = fn
+
+    def _route(self, h, method: str) -> None:
+        path = h.path.split("?", 1)[0]
+        try:
+            if path == "/healthz" and method == "GET":
+                self._healthz(h)
+            elif path == "/metrics" and method == "GET":
+                body = (self._metrics.render()
+                        if self._metrics is not None and
+                        hasattr(self._metrics, "render") else "")
+                h._reply(200, body.encode(),
+                         "text/plain; version=0.0.4")
+            elif path == "/version" and method == "GET":
+                h._reply(200, json.dumps(
+                    {"Version": self._version}).encode())
+            elif path == "/logspec":
+                self._logspec(h, method)
+            else:
+                for prefix, fn in self._extra.items():
+                    if path.startswith(prefix):
+                        length = int(h.headers.get("Content-Length",
+                                                   "0") or 0)
+                        body = h.rfile.read(length) if length else b""
+                        status, out = fn(method, path, body)
+                        h._reply(status, out)
+                        return
+                h._reply(404, b'{"Error":"not found"}')
+        except Exception as e:
+            logger.exception("ops handler error")
+            try:
+                h._reply(500, json.dumps({"Error": str(e)}).encode())
+            except Exception:
+                pass
+
+    def _healthz(self, h) -> None:
+        failed = []
+        for name, check in self._checkers.items():
+            try:
+                check()
+            except Exception as e:
+                failed.append({"component": name, "reason": str(e)})
+        if failed:
+            h._reply(503, json.dumps(
+                {"status": "Service Unavailable",
+                 "failed_checks": failed}).encode())
+        else:
+            h._reply(200, json.dumps({"status": "OK"}).encode())
+
+    def _logspec(self, h, method: str) -> None:
+        if method == "GET":
+            h._reply(200, json.dumps(
+                {"spec": flogging.spec()}).encode())
+            return
+        if method == "PUT":
+            length = int(h.headers.get("Content-Length", "0") or 0)
+            body = json.loads(h.rfile.read(length) or b"{}")
+            flogging.activate_spec(body.get("spec", "info"))
+            h._reply(204, b"")
+            return
+        h._reply(405, b'{"Error":"method not allowed"}')
+
+    # -- lifecycle --
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="operations", daemon=True)
+        self._thread.start()
+        logger.info("operations endpoint on %s", self.address)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)

@@ -1,0 +1,161 @@
+"""Orderer node assembly: orderer.yaml → a serving orderer process.
+
+Rebuild of `orderer/common/server/main.go:73-300` Main(): local config
+→ BCCSP → local MSP → multichannel registrar (solo + raft consenters,
+gRPC cluster transport) → gRPC server (AtomicBroadcast, Deliver,
+Cluster) → operations endpoint with the channel-participation admin
+API mounted (reference: admin server + osnadmin). Env overrides
+ORDERER_* (e.g. ORDERER_GENERAL_LISTENADDRESS).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+from fabric_tpu.bccsp import factory as bccsp_factory
+from fabric_tpu.comm import services as comm_services
+from fabric_tpu.comm.cluster_grpc import GRPCClusterTransport
+from fabric_tpu.comm.server import GRPCServer, ServerConfig
+from fabric_tpu.common import metrics as metrics_mod
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.common.viperutil import Config
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.node.operations import OperationsServer
+from fabric_tpu.orderer import raft as raft_mod, solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.channelparticipation import (
+    ChannelParticipation, ParticipationError,
+)
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.protos import common
+
+logger = logging.getLogger("orderer.node")
+
+
+class OrdererNode:
+    def __init__(self, config: Config):
+        self.cfg = config
+        self.server: Optional[GRPCServer] = None
+        self.ops: Optional[OperationsServer] = None
+        self.registrar: Optional[Registrar] = None
+        self.cluster: Optional[GRPCClusterTransport] = None
+
+    def start(self) -> None:
+        cfg = self.cfg
+        provider = metrics_mod.PrometheusProvider() \
+            if cfg.get("Metrics.Provider", "prometheus") == \
+            "prometheus" else metrics_mod.DisabledProvider()
+
+        bccsp_cfg = cfg.get("General.BCCSP") or {}
+        csp = bccsp_factory.new_bccsp(
+            bccsp_factory.FactoryOpts.from_config(bccsp_cfg))
+        msp_dir = cfg.get_path("General.LocalMSPDir")
+        msp_id = cfg.get("General.LocalMSPID", "OrdererMSP")
+        local_msp = X509MSP(csp)
+        local_msp.setup(msp_config_from_dir(msp_dir, msp_id, csp=csp))
+        signer = local_msp.get_default_signing_identity()
+
+        address = cfg.get("General.ListenAddress", "127.0.0.1") + ":" \
+            + str(cfg.get("General.ListenPort", 7050))
+        # cluster endpoint = the advertised consenter endpoint
+        cluster_ep = cfg.get("Cluster.Endpoint", address)
+        self.cluster = GRPCClusterTransport(cluster_ep)
+
+        ledger_dir = cfg.get_path("FileLedger.Location")
+        os.makedirs(ledger_dir, exist_ok=True)
+        tick = cfg.get_duration("Consensus.TickInterval", 0.1)
+        self.registrar = Registrar(
+            ledger_dir, signer, csp,
+            {"solo": solo.consenter,
+             "raft": raft_mod.consenter(self.cluster,
+                                        tick_interval_s=tick),
+             "etcdraft": raft_mod.consenter(self.cluster,
+                                            tick_interval_s=tick)})
+        broadcast = BroadcastHandler(self.registrar)
+        deliver = DeliverHandler(self.registrar.get_chain)
+        participation = ChannelParticipation(self.registrar)
+
+        sc = ServerConfig(address=address)
+        tls_cert = cfg.get_path("General.TLS.Certificate")
+        if cfg.get_bool("General.TLS.Enabled") and tls_cert:
+            sc.tls_cert = open(tls_cert, "rb").read()
+            sc.tls_key = open(
+                cfg.get_path("General.TLS.PrivateKey"), "rb").read()
+        self.server = GRPCServer(sc)
+        self.address = self.server.address
+        comm_services.register_broadcast(self.server, broadcast)
+        comm_services.register_deliver(self.server, deliver)
+        comm_services.register_cluster(self.server, self.cluster)
+        self.server.start()
+
+        ops_addr = cfg.get("Admin.ListenAddress",
+                           cfg.get("Operations.ListenAddress",
+                                   "127.0.0.1:0"))
+        self.ops = OperationsServer(ops_addr,
+                                    metrics_provider=provider)
+        self.ops.register_checker("orderer", lambda: None)
+        self.ops.register_handler("/participation",
+                                  self._participation_http(
+                                      participation))
+        self.ops.start()
+
+        # bootstrap: join channels from configured genesis blocks
+        for path in cfg.get("General.BootstrapFiles") or []:
+            with open(path, "rb") as f:
+                block = common.Block()
+                block.ParseFromString(f.read())
+            try:
+                self.registrar.join(block)
+            except ValueError as e:
+                if "already exists" not in str(e):
+                    raise
+        logger.info("orderer node up: grpc=%s admin=%s", self.address,
+                    self.ops.address)
+
+    @staticmethod
+    def _participation_http(participation: ChannelParticipation):
+        """REST-ish mapping (reference
+        `orderer/common/channelparticipation/rest.go`):
+        GET  /participation/v1/channels
+        GET  /participation/v1/channels/<name>
+        POST /participation/v1/channels        (body: config block)
+        DELETE /participation/v1/channels/<name>"""
+        from google.protobuf.json_format import MessageToDict
+
+        def handler(method: str, path: str,
+                    body: bytes) -> tuple[int, bytes]:
+            parts = [p for p in path.split("/") if p]
+            # ["participation", "v1", "channels", <name>?]
+            try:
+                if method == "GET" and len(parts) == 3:
+                    out = MessageToDict(participation.list())
+                    return 200, json.dumps(out).encode()
+                if method == "GET" and len(parts) == 4:
+                    out = MessageToDict(participation.info(parts[3]))
+                    return 200, json.dumps(out).encode()
+                if method == "POST" and len(parts) == 3:
+                    info = participation.join(body)
+                    return 201, json.dumps(
+                        MessageToDict(info)).encode()
+                if method == "DELETE" and len(parts) == 4:
+                    participation.remove(parts[3])
+                    return 204, b""
+            except ParticipationError as e:
+                return e.status, json.dumps(
+                    {"error": str(e)}).encode()
+            return 405, json.dumps({"error": "bad request"}).encode()
+        return handler
+
+    def stop(self) -> None:
+        if self.registrar:
+            self.registrar.halt()
+        if self.cluster:
+            self.cluster.close()
+        if self.server:
+            self.server.stop()
+        if self.ops:
+            self.ops.stop()

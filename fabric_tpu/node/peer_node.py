@@ -1,0 +1,267 @@
+"""Peer node assembly: core.yaml → a serving peer process.
+
+Rebuild of `internal/peer/node/start.go:189-911` serve(): wire BCCSP →
+local MSP → Peer (ledgers, endorser, chaincode support) → gossip
+service (gRPC transport) → gRPC server (Endorser, Deliver, Gateway,
+Gossip) → operations endpoint (metrics/healthz/logspec/version).
+Config keys mirror core.yaml (`sampleconfig/core.yaml`), env overrides
+CORE_* (e.g. CORE_PEER_ADDRESS) via viperutil.
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+from typing import Optional
+
+from fabric_tpu.bccsp import factory as bccsp_factory
+from fabric_tpu.comm import clients as comm_clients
+from fabric_tpu.comm import services as comm_services
+from fabric_tpu.comm.gossip_grpc import GRPCGossipTransport
+from fabric_tpu.comm.server import GRPCServer, ServerConfig
+from fabric_tpu.common import metrics as metrics_mod
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.common.viperutil import Config
+from fabric_tpu.gossip import GossipService
+from fabric_tpu.gossip.discovery import DiscoveryConfig
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.node.operations import OperationsServer
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer
+from fabric_tpu.peer.gateway import Gateway
+from fabric_tpu.protos import common
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("peer.node")
+
+
+class _FailoverBroadcast:
+    """Broadcast across orderer endpoints with rotation on failure
+    (reference: the SDK/gateway orderer failover behavior; a raft
+    follower also rejects while leaderless, which counts as failure
+    here)."""
+
+    def __init__(self, endpoints):
+        self._endpoints = list(endpoints)
+        self._clients = {}
+
+    def process_message(self, env):
+        last = None
+        for _ in range(len(self._endpoints)):
+            ep = self._endpoints[0]
+            client = self._clients.get(ep)
+            if client is None:
+                client = comm_clients.BroadcastClient(
+                    comm_clients.channel_to(ep), timeout_s=10.0)
+                self._clients[ep] = client
+            try:
+                resp = client.process_message(env)
+                if resp.status == common.Status.SUCCESS:
+                    return resp
+                last = resp
+            except Exception as e:
+                logger.warning("broadcast to %s failed: %s", ep, e)
+                last = None
+            self._endpoints.append(self._endpoints.pop(0))
+        if last is not None:
+            return last
+        from fabric_tpu.protos import orderer as opb
+        return opb.BroadcastResponse(
+            status=common.Status.SERVICE_UNAVAILABLE,
+            info="no orderer reachable")
+
+
+class PeerNode:
+    def __init__(self, config: Config):
+        self.cfg = config
+        self.peer: Optional[Peer] = None
+        self.server: Optional[GRPCServer] = None
+        self.ops: Optional[OperationsServer] = None
+        self.gossip: Optional[GossipService] = None
+        self._orderer_channels = []
+
+    # -- assembly (start.go serve()) --
+
+    def start(self) -> None:
+        cfg = self.cfg
+        provider = metrics_mod.PrometheusProvider() \
+            if cfg.get("metrics.provider", "prometheus") == \
+            "prometheus" else metrics_mod.DisabledProvider()
+        self.metrics = provider
+
+        bccsp_cfg = cfg.get("peer.BCCSP") or {}
+        csp = bccsp_factory.new_bccsp(
+            bccsp_factory.FactoryOpts.from_config(bccsp_cfg))
+
+        msp_dir = cfg.get_path("peer.mspConfigPath")
+        msp_id = cfg.get("peer.localMspId", "SampleOrg")
+        local_msp = X509MSP(csp)
+        local_msp.setup(msp_config_from_dir(msp_dir, msp_id, csp=csp))
+
+        fs_path = cfg.get_path("peer.fileSystemPath")
+        os.makedirs(fs_path, exist_ok=True)
+        self.peer = Peer(fs_path, local_msp, csp,
+                         metrics_provider=provider)
+        self.msp_id = msp_id
+
+        # gossip over gRPC; external endpoint = peer.address
+        address = cfg.get("peer.address", "127.0.0.1:7051")
+        self.gossip = GossipService(
+            self.peer, GRPCGossipTransport(address), self.peer.mcs,
+            org_id=msp_id,
+            config=DiscoveryConfig(
+                alive_interval_s=cfg.get_duration(
+                    "peer.gossip.aliveTimeInterval", 0.3),
+                alive_expiration_s=cfg.get_duration(
+                    "peer.gossip.aliveExpirationTimeout", 1.5)))
+        self.peer.gossip_service = self.gossip
+
+        # gRPC server
+        sc = ServerConfig(address=address)
+        tls_cert = cfg.get_path("peer.tls.cert.file")
+        if cfg.get_bool("peer.tls.enabled") and tls_cert:
+            sc.tls_cert = open(tls_cert, "rb").read()
+            sc.tls_key = open(
+                cfg.get_path("peer.tls.key.file"), "rb").read()
+            root = cfg.get_path("peer.tls.rootcert.file")
+            if cfg.get_bool("peer.tls.clientAuthRequired") and root:
+                sc.client_root_cas = open(root, "rb").read()
+        self.server = GRPCServer(sc)
+        self.address = self.server.address
+
+        gateway = Gateway(self.peer, self._broadcast_client())
+        gateway.endorsers[msp_id] = self.peer.endorser
+        gateway.endorser_source = self._gossip_endorsers
+        self._endorser_clients: dict[str, object] = {}
+        comm_services.register_endorser(self.server,
+                                        self.peer.endorser)
+        comm_services.register_gateway(self.server, gateway)
+        comm_services.register_deliver(
+            self.server, DeliverHandler(
+                lambda cid: self.peer.channel(cid)))
+        comm_services.register_gossip(
+            self.server, self.gossip.node._on_message)
+        self.server.start()
+
+        bootstrap = cfg.get("peer.gossip.bootstrap") or []
+        if isinstance(bootstrap, str):
+            bootstrap = bootstrap.split()
+        self.gossip.start(bootstrap=bootstrap)
+
+        # operations endpoint (+ the local admin surface the peer CLI
+        # uses — the reference routes `peer channel join` through the
+        # in-process cscc; here it is an operator-local HTTP call)
+        ops_addr = cfg.get("operations.listenAddress", "127.0.0.1:0")
+        self.ops = OperationsServer(ops_addr,
+                                    metrics_provider=provider)
+        self.ops.register_checker("peer", lambda: None)
+        self.ops.register_handler("/admin", self._admin_http)
+        self.ops.start()
+
+        # register python chaincodes listed in config (in-process
+        # runtime; external CCaaS chaincodes register over gRPC)
+        for spec in cfg.get("chaincode.registered") or []:
+            name, _, target = spec.partition("=")
+            mod_name, _, cls_name = target.partition(":")
+            cls = getattr(importlib.import_module(mod_name), cls_name)
+            self.peer.chaincode_support.register(name, cls())
+            logger.info("registered in-process chaincode %s (%s)",
+                        name, target)
+
+        # join channels whose genesis blocks are on disk
+        for path in cfg.get("peer.channels") or []:
+            with open(path, "rb") as f:
+                block = common.Block()
+                block.ParseFromString(f.read())
+            self.join_channel(block)
+        logger.info("peer node up: grpc=%s ops=%s", self.address,
+                    self.ops.address)
+
+    def _broadcast_client(self):
+        endpoints = self.cfg.get("peer.ordererEndpoints") or []
+        if not endpoints:
+            return None
+        return _FailoverBroadcast(endpoints)
+
+    def _deliver_client_factory(self):
+        endpoints = list(self.cfg.get("peer.ordererEndpoints") or [])
+
+        def source():
+            if not endpoints:
+                return None
+            # failover rotation (reference blocksprovider endpoint
+            # shuffling)
+            endpoints.append(endpoints.pop(0))
+            return comm_clients.DeliverClient(
+                comm_clients.channel_to(endpoints[-1]))
+        return source
+
+    def join_channel(self, genesis_block) -> None:
+        from fabric_tpu.core.chaincode import ChaincodeDefinition
+        channel = self.peer.join_channel(genesis_block)
+        # lifecycle-lite: registered chaincodes are defined with the
+        # channel-default endorsement policy (the state-backed
+        # _lifecycle flow supersedes this per-definition)
+        for name in self.peer.chaincode_support.registered():
+            channel.define_chaincode(ChaincodeDefinition(name=name))
+        source = self._deliver_client_factory()
+        self.gossip.initialize_channel(
+            channel,
+            lambda adapter: Deliverer(adapter, self.peer.signer,
+                                      source, self.peer.mcs))
+        logger.info("joined channel %s", channel.channel_id)
+
+    def _gossip_endorsers(self, channel_id: str) -> dict:
+        """One endorser per org, resolved from gossip channel
+        membership (the discovery-service feed of the reference's
+        gateway registry)."""
+        out = {}
+        gchannel = self.gossip.node.channel(channel_id)
+        if gchannel is None:
+            return out
+        for m in gchannel.members():
+            if not m.identity:
+                continue
+            org = self.gossip._org_of_identity(m.identity)
+            if org is None or org in out or org == self.msp_id:
+                continue
+            client = self._endorser_clients.get(m.member.endpoint)
+            if client is None:
+                client = comm_clients.EndorserClient(
+                    comm_clients.channel_to(m.member.endpoint))
+                self._endorser_clients[m.member.endpoint] = client
+            out[org] = client
+        return out
+
+    def _admin_http(self, method: str, path: str,
+                    body: bytes) -> tuple[int, bytes]:
+        import json
+        parts = [p for p in path.split("/") if p]
+        try:
+            if method == "POST" and parts[:2] == ["admin", "channels"]:
+                block = common.Block()
+                block.ParseFromString(body)
+                self.join_channel(block)
+                return 201, json.dumps({"status": "joined"}).encode()
+            if method == "GET" and parts[:2] == ["admin", "channels"]:
+                return 200, json.dumps(
+                    {"channels": sorted(self.peer.channels)}).encode()
+            if method == "GET" and parts[:2] == ["admin", "chaincodes"]:
+                return 200, json.dumps(
+                    {"chaincodes":
+                     self.peer.chaincode_support.registered()}).encode()
+        except Exception as e:
+            return 400, json.dumps({"error": str(e)}).encode()
+        return 404, json.dumps({"error": "not found"}).encode()
+
+    def stop(self) -> None:
+        if self.gossip:
+            self.gossip.stop()
+        if self.server:
+            self.server.stop()
+        if self.ops:
+            self.ops.stop()
+        if self.peer:
+            self.peer.close()

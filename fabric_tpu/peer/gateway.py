@@ -30,13 +30,22 @@ class SubmitResult:
 
 
 class Gateway:
-    def __init__(self, peer, broadcast, signer):
+    def __init__(self, peer, broadcast, signer=None):
         """`peer`: the local Peer (endorser + channels); `broadcast`:
         BroadcastHandler (or gRPC adapter) to the ordering service;
-        `signer`: the gateway's client signing identity."""
+        `signer`: a client signing identity for the in-process
+        convenience API (the gRPC surface has no server-side signer —
+        clients sign their own proposals/envelopes)."""
         self._peer = peer
         self._broadcast = broadcast
         self._signer = signer
+        # org MSP id -> endorser-like (process_proposal); discovery
+        # populates this with remote peers, the local peer always works
+        self.endorsers: dict[str, object] = {}
+        # optional dynamic source: fn(channel_id) -> {org: endorser};
+        # the node assembly wires this to gossip-membership discovery
+        # (reference: gateway registry fed by the discovery service)
+        self.endorser_source = None
 
     # -- Evaluate (api.go:38): simulate on one peer, return result --
 
@@ -77,6 +86,51 @@ class Gateway:
             responses.append(resp)
         env = txutils.create_signed_tx(prop, responses, self._signer)
         return env, tx_id
+
+    # -- signed-proposal surface (what the gRPC service exposes; the
+    #    client built + signed the proposal itself) --
+
+    def evaluate_signed(self, channel_id: str, sp) -> pb.Response:
+        resp = self._peer.endorser.process_proposal(sp)
+        return resp.response
+
+    def endorse_signed(self, channel_id: str, sp,
+                       endorsing_organizations: Sequence[str] = (),
+                       ) -> common.Envelope:
+        """Collect endorsements for a client-signed proposal; returns
+        the UNSIGNED prepared transaction (the client signs it before
+        Submit — reference api.go:127 Endorse)."""
+        pool = dict(self.endorsers)
+        if self.endorser_source is not None:
+            try:
+                for org, target in (self.endorser_source(channel_id)
+                                    or {}).items():
+                    pool.setdefault(org, target)
+            except Exception:
+                logger.exception("endorser discovery failed")
+        targets = []
+        if endorsing_organizations:
+            for org in endorsing_organizations:
+                target = pool.get(org)
+                if target is None:
+                    raise GatewayError(
+                        f"no endorsing peer known for org {org}")
+                targets.append(target)
+        else:
+            # one endorser per known org (the layout that satisfies
+            # MAJORITY default policies; explicit orgs override)
+            targets = list(pool.values()) or [self._peer.endorser]
+        responses = []
+        for target in targets:
+            resp = target.process_proposal(sp)
+            if resp.response.status >= 400:
+                raise GatewayError(
+                    f"endorsement refused: {resp.response.status} "
+                    f"{resp.response.message}")
+            responses.append(resp)
+        prop = pb.Proposal()
+        prop.ParseFromString(sp.proposal_bytes)
+        return txutils.create_signed_tx(prop, responses, signer=None)
 
     # -- Submit (api.go:402) --
 

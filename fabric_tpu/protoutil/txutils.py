@@ -97,10 +97,13 @@ def create_proposal_response(proposal_bytes: bytes, results: bytes,
 
 def create_signed_tx(prop: pb.Proposal,
                      responses: Sequence[pb.ProposalResponse],
-                     signer) -> common.Envelope:
+                     signer=None) -> common.Envelope:
     """Assemble the final transaction envelope from a proposal and its
     endorsements. Reference: `protoutil/txutils.go` CreateSignedTx —
-    all responses must carry identical payloads."""
+    all responses must carry identical payloads. With `signer=None` the
+    envelope comes back UNSIGNED (the remote-gateway flow: the server
+    prepares the transaction, the client adds its signature —
+    `internal/pkg/gateway/api.go` Endorse)."""
     if not responses:
         raise ValueError("at least one proposal response is required")
     payloads = {r.payload for r in responses}
@@ -136,6 +139,8 @@ def create_signed_tx(prop: pb.Proposal,
     payload = common.Payload()
     payload.header.CopyFrom(hdr)
     payload.data = pu.marshal(tx)
+    if signer is None:
+        return common.Envelope(payload=pu.marshal(payload))
     return pu.sign_or_panic(signer, payload)
 
 

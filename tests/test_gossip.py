@@ -465,4 +465,4 @@ class TestGossipEndToEnd:
         assert _wait(
             lambda: late_peer.channel(CHANNEL).ledger.get_private_data(
                 "secretcc", "secrets", "k2") == b"late-secret",
-            timeout=25)
+            timeout=60)
