@@ -1,0 +1,46 @@
+"""`ledgerutil` CLI (reference: cmd/ledgerutil — compare/verify).
+
+  ledgerutil verify  --ledger-root DIR -C channel
+  ledgerutil compare --ledger-root-a DIR --ledger-root-b DIR -C channel
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ledgerutil")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    v = sub.add_parser("verify")
+    v.add_argument("--ledger-root", required=True)
+    v.add_argument("-C", "--channel", required=True)
+
+    c = sub.add_parser("compare")
+    c.add_argument("--ledger-root-a", required=True)
+    c.add_argument("--ledger-root-b", required=True)
+    c.add_argument("-C", "--channel", required=True)
+
+    args = p.parse_args(argv)
+    from fabric_tpu.internal import ledgerutil as lu
+    if args.cmd == "verify":
+        res = lu.verify(args.ledger_root, args.channel)
+        print(json.dumps({"height": res.height, "ok": res.ok,
+                          "errors": res.errors}))
+        return 0 if res.ok else 1
+    res = lu.compare(args.ledger_root_a, args.ledger_root_b,
+                     args.channel)
+    print(json.dumps({
+        "heights": list(res.heights),
+        "common_height": res.common_height,
+        "first_divergence": res.first_divergence,
+        "tx_filter_diffs": res.tx_filter_diffs,
+        "identical_prefix": res.identical_prefix}))
+    return 0 if res.identical_prefix else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,59 @@ def cmd_node_start(args) -> int:
     return 0
 
 
+def cmd_node_rollback(args) -> int:
+    from fabric_tpu.internal import nodeops
+    nodeops.rollback(args.ledger_root, args.channel, args.block_number)
+    print(f"rolled {args.channel} back to height {args.block_number}")
+    return 0
+
+
+def cmd_node_rebuild(args) -> int:
+    from fabric_tpu.internal import nodeops
+    done = nodeops.rebuild_dbs(args.ledger_root)
+    print(f"dropped derived DBs for: {', '.join(done) or '(none)'}")
+    return 0
+
+
+def cmd_node_reset(args) -> int:
+    from fabric_tpu.internal import nodeops
+    done = nodeops.reset(args.ledger_root)
+    print(f"reset to genesis: {', '.join(done) or '(none)'}")
+    return 0
+
+
+def cmd_node_unjoin(args) -> int:
+    from fabric_tpu.internal import nodeops
+    nodeops.unjoin(args.ledger_root, args.channel)
+    print(f"unjoined {args.channel}")
+    return 0
+
+
+def cmd_snapshot_submit(args) -> int:
+    body = json.dumps({"height": args.height}).encode()
+    status, out = _http("POST",
+                        f"http://{args.ops}/admin/snapshots/"
+                        f"{args.channel}", body)
+    print(out.decode())
+    return 0 if status < 300 else 1
+
+
+def cmd_snapshot_list(args) -> int:
+    status, out = _http("GET", f"http://{args.ops}/admin/snapshots/"
+                               f"{args.channel}")
+    print(out.decode())
+    return 0 if status == 200 else 1
+
+
+def cmd_snapshot_join(args) -> int:
+    body = json.dumps({"dir": args.snapshot_dir}).encode()
+    status, out = _http("POST",
+                        f"http://{args.ops}/admin/snapshots/"
+                        f"{args.channel}/join", body)
+    print(out.decode())
+    return 0 if status < 300 else 1
+
+
 def cmd_channel_join(args) -> int:
     with open(args.block, "rb") as f:
         block = f.read()
@@ -111,6 +164,34 @@ def main(argv=None) -> int:
     start = node.add_parser("start")
     start.add_argument("--config", required=True)
     start.set_defaults(fn=cmd_node_start)
+    for verb, fn in (("rollback", cmd_node_rollback),
+                     ("rebuild-dbs", cmd_node_rebuild),
+                     ("reset", cmd_node_reset),
+                     ("unjoin", cmd_node_unjoin)):
+        np = node.add_parser(verb)
+        np.add_argument("--ledger-root", required=True)
+        if verb in ("rollback", "unjoin"):
+            np.add_argument("-C", "--channel", required=True)
+        if verb == "rollback":
+            np.add_argument("--block-number", type=int, required=True)
+        np.set_defaults(fn=fn)
+
+    snap = sub.add_parser("snapshot").add_subparsers(dest="sub",
+                                                     required=True)
+    sr = snap.add_parser("submitrequest")
+    sr.add_argument("--ops", required=True)
+    sr.add_argument("-C", "--channel", required=True)
+    sr.add_argument("--height", type=int, default=0)
+    sr.set_defaults(fn=cmd_snapshot_submit)
+    sl = snap.add_parser("listpending")
+    sl.add_argument("--ops", required=True)
+    sl.add_argument("-C", "--channel", required=True)
+    sl.set_defaults(fn=cmd_snapshot_list)
+    sj = snap.add_parser("join")
+    sj.add_argument("--ops", required=True)
+    sj.add_argument("-C", "--channel", required=True)
+    sj.add_argument("--snapshot-dir", required=True)
+    sj.set_defaults(fn=cmd_snapshot_join)
 
     chan = sub.add_parser("channel").add_subparsers(dest="sub",
                                                     required=True)

@@ -1,0 +1,110 @@
+"""ledgerutil: offline block-store comparison and verification.
+
+Rebuild of `internal/ledgerutil` + `cmd/ledgerutil` (SURVEY §2.5):
+  verify   walk a channel's chain checking the hash links, data
+           hashes and index consistency
+  compare  diff two peers' copies of a channel; reports the first
+           divergent block and per-tx validation-code differences
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.protos import common
+
+
+def _open_store(ledger_root: str, channel: str):
+    path = os.path.join(ledger_root, channel)
+    if not os.path.isdir(path):
+        raise ValueError(f"channel {channel!r} not found under "
+                         f"{ledger_root}")
+    kv = KVStore(os.path.join(path, "index.db"))
+    return BlockStore(path, DBHandle(kv, "blkindex")), kv
+
+
+@dataclass
+class VerifyResult:
+    height: int = 0
+    errors: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def verify(ledger_root: str, channel: str) -> VerifyResult:
+    store, kv = _open_store(ledger_root, channel)
+    res = VerifyResult(height=store.height)
+    prev_hash = b""
+    try:
+        for num in range(store.first_block, store.height):
+            block = store.get_block_by_number(num)
+            if block is None:
+                res.errors.append(f"block {num} missing")
+                break
+            if block.header.number != num:
+                res.errors.append(
+                    f"block {num}: header number "
+                    f"{block.header.number}")
+            if num > store.first_block and \
+                    block.header.previous_hash != prev_hash:
+                res.errors.append(f"block {num}: previous_hash broken")
+            data_hash = pu.block_data_hash(block.data)
+            if block.header.data_hash != data_hash:
+                res.errors.append(f"block {num}: data hash mismatch")
+            by_hash = store.get_block_by_hash(
+                pu.block_header_hash(block.header))
+            if by_hash is None or by_hash.header.number != num:
+                res.errors.append(f"block {num}: hash index broken")
+            prev_hash = pu.block_header_hash(block.header)
+    finally:
+        store.close()
+        kv.close()
+    return res
+
+
+@dataclass
+class CompareResult:
+    common_height: int = 0
+    heights: tuple = (0, 0)
+    first_divergence: Optional[int] = None
+    tx_filter_diffs: list[int] = field(default_factory=list)
+
+    @property
+    def identical_prefix(self) -> bool:
+        return self.first_divergence is None and not self.tx_filter_diffs
+
+
+def compare(root_a: str, root_b: str, channel: str) -> CompareResult:
+    sa, ka = _open_store(root_a, channel)
+    sb, kb = _open_store(root_b, channel)
+    res = CompareResult(heights=(sa.height, sb.height))
+    res.common_height = min(sa.height, sb.height)
+    try:
+        for num in range(max(sa.first_block, sb.first_block),
+                         res.common_height):
+            a = sa.get_block_by_number(num)
+            b = sb.get_block_by_number(num)
+            ha = pu.block_header_hash(a.header)
+            hb = pu.block_header_hash(b.header)
+            if ha != hb:
+                res.first_divergence = num
+                break
+            fa = a.metadata.metadata[
+                common.BlockMetadataIndex.TRANSACTIONS_FILTER]
+            fb = b.metadata.metadata[
+                common.BlockMetadataIndex.TRANSACTIONS_FILTER]
+            if bytes(fa) != bytes(fb):
+                res.tx_filter_diffs.append(num)
+    finally:
+        sa.close()
+        ka.close()
+        sb.close()
+        kb.close()
+    return res

@@ -1,0 +1,124 @@
+"""Offline operator commands on a STOPPED peer's ledger data.
+
+Rebuild of `internal/peer/node/{reset,rollback,rebuild_dbs,unjoin}.go`:
+  rebuild_dbs  drop the derived DBs (state/history/pvt bookkeeping);
+               the next start replays them from the block store
+  rollback     truncate a channel to a target height, then drop the
+               derived DBs so replay reconstructs exactly that prefix
+  reset        rollback every channel to height 1 (genesis only)
+  unjoin       remove a channel's ledger entirely
+
+All of these refuse to run while the data dir looks live is the
+operator's responsibility (the reference takes a file lock; a stopped
+process is assumed here).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from fabric_tpu.common.flogging import must_get_logger
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+
+logger = must_get_logger("nodeops")
+
+# keyspaces derived from the block store (rebuilt by replay on start)
+_DERIVED = ("statedb", "historydb", "pvtstore", "blkindex")
+_REBUILD_ONLY = ("statedb", "historydb")
+
+
+def _channels(ledger_root: str) -> list[str]:
+    if not os.path.isdir(ledger_root):
+        return []
+    return [d for d in sorted(os.listdir(ledger_root))
+            if os.path.isdir(os.path.join(ledger_root, d, "chains"))]
+
+
+def _drop_keyspaces(kv: KVStore, names) -> None:
+    for name in names:
+        db = DBHandle(kv, name)
+        batch = db.new_batch()
+        for k, _v in db.iterate():
+            batch.delete(k)
+        if batch.ops:
+            db.write_batch(batch)
+
+
+def rebuild_dbs(ledger_root: str) -> list[str]:
+    """Drop state+history everywhere; keep blocks + committed pvt
+    cleartext (reference rebuild-dbs keeps pvtdata store too)."""
+    done = []
+    for channel in _channels(ledger_root):
+        path = os.path.join(ledger_root, channel, "index.db")
+        kv = KVStore(path)
+        _drop_keyspaces(kv, _REBUILD_ONLY)
+        kv.close()
+        done.append(channel)
+        logger.info("dropped derived DBs for %s", channel)
+    return done
+
+
+def rollback(ledger_root: str, channel: str, target_height: int) -> None:
+    """Truncate `channel` to `target_height` blocks; derived DBs are
+    dropped for full replay (includes the pvt store: cleartext above
+    the target must not survive)."""
+    path = os.path.join(ledger_root, channel)
+    if not os.path.isdir(path):
+        raise ValueError(f"channel {channel!r} does not exist")
+    kv = KVStore(os.path.join(path, "index.db"))
+    store = BlockStore(path, DBHandle(kv, "blkindex"))
+    if target_height >= store.height:
+        store.close()
+        kv.close()
+        raise ValueError(
+            f"target height {target_height} >= current "
+            f"{store.height}")
+    if store.first_block > 0 and target_height <= store.first_block:
+        store.close()
+        kv.close()
+        raise ValueError("cannot roll back past the snapshot boundary")
+    store.truncate_to(target_height)
+    store.close()
+    _drop_keyspaces(kv, ("statedb", "historydb", "snapshotreq"))
+    # pvt cleartext below the target must SURVIVE (replay re-applies it
+    # from the pvt store; it cannot be refetched from blocks) — prune
+    # only entries at/above the target
+    import struct
+    pvtdb = DBHandle(kv, "pvtstore")
+    batch = pvtdb.new_batch()
+    for k, _v in pvtdb.iterate():
+        tag = k[:1]
+        if tag in (b"d", b"m"):
+            (block_num,) = struct.unpack_from(">Q", k, 1)
+            if block_num >= target_height:
+                batch.delete(k)
+        elif tag == b"e":
+            _exp, written = struct.unpack_from(">QQ", k, 1)
+            if written >= target_height:
+                batch.delete(k)
+    if batch.ops:
+        pvtdb.write_batch(batch)
+    kv.close()
+    logger.info("rolled %s back to height %d", channel, target_height)
+
+
+def reset(ledger_root: str) -> list[str]:
+    """Every channel back to its genesis block (reference reset.go)."""
+    done = []
+    for channel in _channels(ledger_root):
+        try:
+            rollback(ledger_root, channel, 1)
+            done.append(channel)
+        except ValueError as e:
+            logger.warning("reset skipped %s: %s", channel, e)
+    return done
+
+
+def unjoin(ledger_root: str, channel: str) -> None:
+    path = os.path.join(ledger_root, channel)
+    if not os.path.isdir(path):
+        raise ValueError(f"channel {channel!r} does not exist")
+    shutil.rmtree(path)
+    logger.info("unjoined %s", channel)

@@ -22,6 +22,10 @@ _LEN = struct.Struct(">I")
 # index key: (suffix, end-offset, height) + last block-header hash,
 # written atomically with every block's index batch
 _CHECKPOINT = b"cp"
+# snapshot-bootstrap marker: (first_block_num, last_hash) — the store
+# begins mid-chain with no files for the prefix (join-by-snapshot,
+# reference: blkstorage BootstrapFromSnapshottedTxIDs)
+_BOOTSTRAP = b"bs"
 
 
 class BlockStoreError(Exception):
@@ -61,6 +65,12 @@ class BlockStore:
         Startup cost is O(blocks since last clean checkpoint), not
         O(chain)."""
         cp = self._index.get(_CHECKPOINT)
+        bs = self._index.get(_BOOTSTRAP)
+        self._first_block = 0
+        if bs is not None:
+            (self._first_block,) = struct.unpack(">Q", bs[:8])
+            self._height = self._first_block
+            self._last_hash = bs[8:]
         scan_suffix = scan_offset = 0
         if cp is not None:
             suffix, offset, height = struct.unpack(">IQQ", cp[:20])
@@ -219,9 +229,96 @@ class BlockStore:
             return None
         num, idx, code = loc
         block = self.get_block_by_number(num)
+        if block is None:
+            # pre-snapshot tx (join-by-snapshot imports txids without
+            # their blocks): the code is known, the envelope is not
+            return txpb.ProcessedTransaction(validation_code=code)
         return txpb.ProcessedTransaction(
             transaction_envelope=block.data.data[idx],
             validation_code=code)
+
+    @property
+    def first_block(self) -> int:
+        """First block physically present (0 unless bootstrapped from
+        a snapshot)."""
+        return getattr(self, "_first_block", 0)
+
+    def bootstrap_from_snapshot(self, first_block: int,
+                                last_hash: bytes,
+                                tx_ids: list[tuple[str, int]]) -> None:
+        """Start this (empty) store mid-chain at `first_block` with the
+        pre-snapshot txids imported for dup detection (reference:
+        blkstorage BootstrapFromSnapshottedTxIDs)."""
+        if self._height != 0:
+            raise BlockStoreError("store is not empty")
+        batch = self._index.new_batch()
+        batch.put(_BOOTSTRAP,
+                  struct.pack(">Q", first_block) + last_hash)
+        for tx_id, code in tx_ids:
+            batch.put(b"t" + tx_id.encode(),
+                      struct.pack(">QIB", 0, 0, code))
+        self._index.write_batch(batch)
+        self._first_block = first_block
+        self._height = first_block
+        self._last_hash = last_hash
+
+    def truncate_to(self, height: int) -> None:
+        """Drop every block >= height (operator rollback —
+        reference: `internal/peer/node/rollback.go` + blkstorage
+        rollback helpers). Index entries and files beyond the target
+        are removed; the checkpoint is rewritten."""
+        if height >= self._height or height < self.first_block:
+            return
+        self._f.close()
+        batch = self._index.new_batch()
+        keep_suffix = keep_offset = 0
+        last_hash = b""
+        suffixes = sorted(
+            int(n.split("_")[1]) for n in os.listdir(self._dir)
+            if n.startswith("blockfile_"))
+        for suffix in suffixes:
+            path = os.path.join(self._dir, _file_name(suffix))
+            good = 0
+            done = False
+            with open(path, "rb") as f:
+                while True:
+                    offset = f.tell()
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        break
+                    (ln,) = _LEN.unpack(hdr)
+                    raw = f.read(ln)
+                    if len(raw) < ln:
+                        break
+                    block = pu.unmarshal_block(raw)
+                    if block.header.number >= height:
+                        done = True
+                        batch.delete(b"n" + struct.pack(
+                            ">Q", block.header.number))
+                        batch.delete(
+                            b"h" + pu.block_header_hash(block.header))
+                        continue
+                    good = f.tell()
+                    keep_suffix, keep_offset = suffix, good
+                    last_hash = pu.block_header_hash(block.header)
+            if done:
+                with open(path, "ab") as f:
+                    f.truncate(good)
+                if good == 0 and suffix > 0:
+                    os.unlink(path)
+        # drop txid entries pointing past the target
+        for k, v in self._index.iterate(start=b"t", end=b"u"):
+            num = struct.unpack(">QIB", v)[0]
+            if num >= height:
+                batch.delete(k)
+        batch.put(_CHECKPOINT,
+                  struct.pack(">IQQ", keep_suffix, keep_offset,
+                              height) + last_hash)
+        self._index.write_batch(batch)
+        self._cur_suffix = keep_suffix
+        self._height = height
+        self._last_hash = last_hash
+        self._f = open(self._cur_path(), "ab")
 
     def iter_blocks(self, start: int = 0,
                     end: Optional[int] = None) -> Iterator[common.Block]:

@@ -81,6 +81,11 @@ class KVLedger:
             namespace="ledger", name="blockchain_height",
             label_names=("channel",))).with_labels("channel", ledger_id)
 
+        from fabric_tpu.ledger.snapshot import SnapshotRequests
+        self.snapshot_requests = SnapshotRequests(
+            DBHandle(self._kv, "snapshotreq"))
+        self._meta = DBHandle(self._kv, "ledgermeta")
+
         self._recover_dbs()
         self._commit_hash = self._load_commit_hash()
 
@@ -102,6 +107,10 @@ class KVLedger:
         if height == 0:
             return b""
         last = self.block_store.get_block_by_number(height - 1)
+        if last is None:
+            # bootstrapped from snapshot, no blocks yet: the adopted
+            # hash was persisted at import
+            return self._meta.get(b"commit_hash") or b""
         md = last.metadata.metadata
         if len(md) > common.BlockMetadataIndex.COMMIT_HASH:
             return bytes(md[common.BlockMetadataIndex.COMMIT_HASH])
@@ -158,6 +167,53 @@ class KVLedger:
     def get_history_for_key(self, ns: str, key: str):
         return self.history_db.get_history_for_key(
             self.block_store, ns, key)
+
+    # -- snapshots (reference: snapshot.go / snapshot_mgmt.go) --
+
+    @property
+    def commit_hash(self) -> bytes:
+        return self._commit_hash
+
+    def adopt_commit_hash(self, commit_hash: bytes,
+                          bootstrap_block: int) -> None:
+        self._meta.put(b"commit_hash", commit_hash)
+        self._commit_hash = commit_hash
+
+    def adopt_bootstrap_config_block(self, block_bytes: bytes) -> None:
+        self._meta.put(b"bootstrap_config_block", block_bytes)
+
+    def bootstrap_config_block(self) -> Optional[common.Block]:
+        raw = self._meta.get(b"bootstrap_config_block")
+        if raw is None:
+            return None
+        block = common.Block()
+        block.ParseFromString(raw)
+        return block
+
+    def generate_snapshot(self, out_dir: Optional[str] = None) -> dict:
+        from fabric_tpu.ledger import snapshot as snap
+        if out_dir is None:
+            out_dir = os.path.join(self._dir, "snapshots", "completed",
+                                   str(self.height - 1))
+        return snap.generate_snapshot(self, out_dir)
+
+    def snapshots_dir(self) -> str:
+        return os.path.join(self._dir, "snapshots", "completed")
+
+    def _maybe_generate_snapshots(self) -> None:
+        due = self.snapshot_requests.due(self.height)
+        for h in due:
+            try:
+                meta = self.generate_snapshot()
+                logger.info("[%s] snapshot generated at height %d "
+                            "(requested %d): %s", self.ledger_id,
+                            self.height, h,
+                            meta["last_block_hash"][:16])
+            except Exception:
+                logger.exception("[%s] snapshot generation failed",
+                                 self.ledger_id)
+            finally:
+                self.snapshot_requests.cancel(h)
 
     # -- commit --
 
@@ -221,6 +277,7 @@ class KVLedger:
                                         Height(block_num, 0))
         t3 = time.perf_counter()
 
+        self._maybe_generate_snapshots()
         self._m_block_time.observe(t3 - t0)
         self._m_store_time.observe(t2 - t1)
         self._m_state_time.observe(t3 - t2)

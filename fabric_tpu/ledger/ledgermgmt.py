@@ -77,6 +77,38 @@ class LedgerManager:
         logger.info("created ledger %s", ledger_id)
         return ledger
 
+    def create_from_snapshot(self, snapshot_dir: str,
+                             ledger_id: str) -> KVLedger:
+        """Join-by-snapshot (reference: CreateLedgerFromSnapshot): the
+        ledger starts at snapshot height with imported state + txids;
+        blocks flow in from deliver/gossip as usual."""
+        from fabric_tpu.ledger import snapshot as snap
+        path = self._path(ledger_id)
+        if ledger_id in self._ledgers or os.path.isdir(path):
+            if not self._is_under_construction(ledger_id) and \
+                    os.path.isdir(path):
+                raise LedgerError(f"ledger {ledger_id!r} already exists")
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+        tmp = path + ".uc-tmp"
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        with open(os.path.join(tmp, _UNDER_CONSTRUCTION), "w"):
+            pass
+        os.replace(tmp, path)
+        ledger = KVLedger(ledger_id, path, self._metrics)
+        try:
+            snap.import_into(ledger, snapshot_dir)
+        except Exception:
+            ledger.close()
+            raise
+        os.remove(os.path.join(path, _UNDER_CONSTRUCTION))
+        self._ledgers[ledger_id] = ledger
+        logger.info("created ledger %s from snapshot at height %d",
+                    ledger_id, ledger.height)
+        return ledger
+
     def open(self, ledger_id: str) -> KVLedger:
         if ledger_id in self._ledgers:
             return self._ledgers[ledger_id]

@@ -102,6 +102,16 @@ class StateDB:
         wb.put(_SAVEPOINT, height.pack())
         self._db.write_batch(wb)
 
+    def iterate_all(self) -> Iterator[tuple[str, str, VersionedValue]]:
+        """Every (ns, key, versioned value), ordered — the snapshot
+        export walk (reference: statedb GetFullScanIterator)."""
+        for k, raw in self._db.iterate(start=b"", end=None):
+            if k == _SAVEPOINT:
+                continue
+            ns, _, key = k.partition(_SEP)
+            yield (ns.decode(), key.decode(),
+                   VersionedValue(raw[16:], Height.unpack(raw[:16])))
+
     def apply_writes_only(self, batch: UpdateBatch) -> None:
         """Apply updates WITHOUT advancing the savepoint — the
         reconciliation path back-fills old-block private data and must

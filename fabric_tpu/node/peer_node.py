@@ -252,9 +252,49 @@ class PeerNode:
                 return 200, json.dumps(
                     {"chaincodes":
                      self.peer.chaincode_support.registered()}).encode()
+            # snapshots (reference: `peer snapshot` CLI → snapshotgrpc)
+            if parts[:2] == ["admin", "snapshots"]:
+                return self._snapshot_http(method, parts, body)
         except Exception as e:
             return 400, json.dumps({"error": str(e)}).encode()
         return 404, json.dumps({"error": "not found"}).encode()
+
+    def _snapshot_http(self, method: str, parts: list[str],
+                       body: bytes) -> tuple[int, bytes]:
+        import json
+        # /admin/snapshots/<channel>  POST body={"height": N} submit
+        #                             GET → pending + completed
+        # /admin/snapshots/<channel>/join  POST body={"dir": path}
+        channel = parts[2] if len(parts) > 2 else ""
+        if len(parts) == 4 and parts[3] == "join" and method == "POST":
+            req = json.loads(body or b"{}")
+            ch = self.peer.join_channel_by_snapshot(req["dir"], channel)
+            from fabric_tpu.core.chaincode import ChaincodeDefinition
+            for name in self.peer.chaincode_support.registered():
+                ch.define_chaincode(ChaincodeDefinition(name=name))
+            source = self._deliver_client_factory()
+            self.gossip.initialize_channel(
+                ch, lambda adapter: Deliverer(
+                    adapter, self.peer.signer, source, self.peer.mcs))
+            return 201, json.dumps(
+                {"status": "joined", "height": ch.ledger.height}
+            ).encode()
+        ch = self.peer.channel(channel)
+        if ch is None:
+            return 404, json.dumps({"error": "unknown channel"}).encode()
+        if method == "POST":
+            req = json.loads(body or b"{}")
+            height = int(req.get("height") or ch.ledger.height)
+            ch.ledger.snapshot_requests.submit(height)
+            return 201, json.dumps({"status": "submitted",
+                                    "height": height}).encode()
+        completed_dir = ch.ledger.snapshots_dir()
+        completed = sorted(os.listdir(completed_dir)) \
+            if os.path.isdir(completed_dir) else []
+        return 200, json.dumps(
+            {"pending": ch.ledger.snapshot_requests.pending(),
+             "completed": completed,
+             "dir": completed_dir}).encode()
 
     def stop(self) -> None:
         if self.gossip:

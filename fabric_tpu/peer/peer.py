@@ -81,6 +81,12 @@ class Channel:
             block = self.ledger.block_store.get_block_by_number(num)
             if block is not None and pu.is_config_block(block):
                 return block
+        # join-by-snapshot: no blocks on disk, the snapshot carried the
+        # governing config block
+        if hasattr(self.ledger, "bootstrap_config_block"):
+            block = self.ledger.bootstrap_config_block()
+            if block is not None:
+                return block
         raise ValueError(f"no config block found on {self.channel_id}")
 
     def _apply_config(self, block: common.Block) -> None:
@@ -291,6 +297,14 @@ class Peer:
         # sanity: the config must parse into a bundle before we commit
         Bundle(channel_id, cfg, self.csp)
         ledger = self.ledger_mgr.create(genesis_block, channel_id)
+        return self._register_channel(channel_id, ledger)
+
+    def join_channel_by_snapshot(self, snapshot_dir: str,
+                                 channel_id: str) -> Channel:
+        """Join without replaying history (reference:
+        `internal/peer/channel/joinbysnapshot.go`)."""
+        ledger = self.ledger_mgr.create_from_snapshot(snapshot_dir,
+                                                      channel_id)
         return self._register_channel(channel_id, ledger)
 
     def channel(self, channel_id: str) -> Optional[Channel]:
