@@ -201,6 +201,9 @@ class TestEndToEnd:
         gw.submit_transaction(CHANNEL, "basic",
                               [b"put", b"carol", b"50"],
                               endorsing_peers=_both_peers(network))
+        # both endorsers must simulate against the same height or the
+        # endorsement payloads diverge (clients retry in production)
+        _sync(network)
         res = gw.submit_transaction(
             CHANNEL, "basic", [b"transfer", b"alice", b"carol", b"30"],
             endorsing_peers=_both_peers(network))
@@ -238,6 +241,7 @@ class TestEndToEnd:
         gw.submit_transaction(CHANNEL, "basic",
                               [b"put", b"race", b"1"],
                               endorsing_peers=_both_peers(network))
+        _sync(network)
         env1, tx1 = gw.endorse(CHANNEL, "basic",
                                [b"transfer", b"race", b"alice", b"1"],
                                endorsing_peers=_both_peers(network))
