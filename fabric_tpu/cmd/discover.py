@@ -1,0 +1,64 @@
+"""`discover` CLI (reference: cmd/discover + discovery/client).
+
+  discover peers     --server host:port --channel ch --msp-dir D --msp-id ID
+  discover config    --server ... --channel ch ...
+  discover endorsers --server ... --channel ch --chaincode cc ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="discover")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("peers", "config", "endorsers"):
+        sp = sub.add_parser(name)
+        sp.add_argument("--server", required=True)
+        sp.add_argument("--channel", required=True)
+        sp.add_argument("--msp-dir", required=True)
+        sp.add_argument("--msp-id", required=True)
+        if name == "endorsers":
+            sp.add_argument("--chaincode", required=True)
+    args = p.parse_args(argv)
+
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.comm import channel_to
+    from fabric_tpu.comm.clients import DiscoveryClient
+    from fabric_tpu.msp import msp_config_from_dir
+    from fabric_tpu.msp.mspimpl import X509MSP
+    csp = SWProvider()
+    msp = X509MSP(csp)
+    msp.setup(msp_config_from_dir(args.msp_dir, args.msp_id, csp=csp))
+    client = DiscoveryClient(channel_to(args.server),
+                             msp.get_default_signing_identity())
+
+    if args.cmd == "peers":
+        out = [{"mspID": dp.msp_id, "endpoint": dp.endpoint,
+                "ledgerHeight": dp.ledger_height,
+                "chaincodes": list(dp.chaincodes)}
+               for dp in client.peers(args.channel)]
+    elif args.cmd == "config":
+        cfg = client.config(args.channel)
+        out = {"msps": sorted(cfg.msps),
+               "orderers": list(cfg.orderer_endpoints)}
+    else:
+        out = []
+        for desc in client.endorsers(args.channel, args.chaincode):
+            out.append({
+                "chaincode": desc.chaincode,
+                "layouts": [dict(lay.quantities_by_org)
+                            for lay in desc.layouts],
+                "endorsersByOrg": {
+                    org: [dp.endpoint for dp in group.peers]
+                    for org, group in desc.endorsers_by_org.items()},
+            })
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -154,6 +154,50 @@ class GatewayClient:
         return tx_id, code
 
 
+class DiscoveryClient:
+    """Client SDK for the discovery service (reference:
+    `discovery/client/`)."""
+
+    def __init__(self, channel: grpc.Channel, signer,
+                 timeout_s: float = 15.0):
+        from fabric_tpu.protos import discovery as dpb
+        self._dpb = dpb
+        self._signer = signer
+        self._timeout = timeout_s
+        self._call = _uu(channel, svc.DISCOVERY_SERVICE, "Discover",
+                         dpb.SignedRequest, dpb.Response)
+
+    def _send(self, query) -> object:
+        dpb = self._dpb
+        req = dpb.Request(authentication=self._signer.serialize())
+        req.queries.add().CopyFrom(query)
+        payload = req.SerializeToString()
+        signed = dpb.SignedRequest(payload=payload,
+                                   signature=self._signer.sign(payload))
+        resp = self._call(signed, timeout=self._timeout)
+        result = resp.results[0]
+        if result.WhichOneof("result") == "error":
+            raise RuntimeError(result.error.content)
+        return result
+
+    def peers(self, channel_id: str):
+        q = self._dpb.Query(channel=channel_id)
+        q.peer_query.SetInParent()
+        return list(self._send(q).members.peers)
+
+    def config(self, channel_id: str):
+        q = self._dpb.Query(channel=channel_id)
+        q.config_query.SetInParent()
+        return self._send(q).config_result
+
+    def endorsers(self, channel_id: str, cc_name: str):
+        q = self._dpb.Query(channel=channel_id)
+        interest = q.cc_query.interests.add()
+        interest.chaincodes.add(name=cc_name)
+        res = self._send(q).cc_query_res
+        return list(res.descriptors)
+
+
 class ClusterClient:
     """Duck-type of ClusterTransport's outbound half for one target."""
 

@@ -112,6 +112,19 @@ def register_gossip(server: GRPCServer, on_message) -> None:
     })
 
 
+DISCOVERY_SERVICE = "ftpu.Discovery"
+
+
+def register_discovery(server: GRPCServer, discovery_service) -> None:
+    from fabric_tpu.protos import discovery as dpb
+    server.add_service(DISCOVERY_SERVICE, {
+        "Discover": (
+            UNARY_UNARY,
+            lambda req, ctx: discovery_service.process(req),
+            dpb.SignedRequest, dpb.Response),
+    })
+
+
 def register_cluster(server: GRPCServer, transport_hub) -> None:
     """`transport_hub`: the node-side GRPCClusterTransport (its
     handle_* methods mirror LocalClusterTransport)."""

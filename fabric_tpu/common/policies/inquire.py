@@ -1,0 +1,94 @@
+"""Policy inquiry: signature policies → satisfying principal sets.
+
+Rebuild of `common/policies/inquire/` (+ the `common/graph` tree
+permutations it builds on): flatten a SignaturePolicyEnvelope into the
+list of minimal principal combinations that satisfy it. Discovery
+turns these into endorsement layouts (`discovery/endorsement/
+endorsement.go:84,160`).
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+
+from fabric_tpu.protos import policies as polpb
+
+MAX_SETS = 1024  # cap combination blow-up (reference caps too)
+
+
+class InquireError(Exception):
+    pass
+
+
+def principal_sets(envelope: polpb.SignaturePolicyEnvelope
+                   ) -> list[tuple[bytes, ...]]:
+    """Each element is a tuple of marshaled MSPPrincipals whose joint
+    signatures satisfy the policy (duplicates preserved — a 2-of-2 over
+    the same org needs two signatures)."""
+    identities = [p.SerializeToString(deterministic=True)
+                  for p in envelope.identities]
+
+    def walk(rule: polpb.SignaturePolicy) -> list[tuple[bytes, ...]]:
+        which = rule.WhichOneof("type")
+        if which == "signed_by":
+            idx = rule.signed_by
+            if idx < 0 or idx >= len(identities):
+                raise InquireError(f"signed_by index {idx} out of range")
+            return [(identities[idx],)]
+        n = rule.n_out_of.n
+        subs = [walk(r) for r in rule.n_out_of.rules]
+        if n > len(subs):
+            raise InquireError("n_out_of larger than rule count")
+        out: list[tuple[bytes, ...]] = []
+        for combo in combinations(range(len(subs)), n):
+            partials: list[tuple[bytes, ...]] = [()]
+            for i in combo:
+                partials = [p + s for p in partials for s in subs[i]]
+                if len(partials) > MAX_SETS:
+                    raise InquireError("principal combination blow-up")
+            out.extend(partials)
+            if len(out) > MAX_SETS:
+                raise InquireError("principal combination blow-up")
+        return out
+
+    return walk(envelope.rule)
+
+
+def org_of_principal(principal_bytes: bytes) -> str:
+    """MSP id of a role/OU principal ('' when not org-scoped)."""
+    p = polpb.MSPPrincipal()
+    p.ParseFromString(principal_bytes)
+    if p.classification == polpb.MSPPrincipal.ROLE:
+        role = polpb.MSPRole()
+        role.ParseFromString(p.principal)
+        return role.msp_identifier
+    if p.classification == polpb.MSPPrincipal.ORGANIZATION_UNIT:
+        ou = polpb.OrganizationUnit()
+        ou.ParseFromString(p.principal)
+        return ou.msp_identifier
+    return ""
+
+
+def layouts_from_envelope(envelope: polpb.SignaturePolicyEnvelope
+                          ) -> list[dict[str, int]]:
+    """Org-quantity layouts, deduped and minimal-first (reference:
+    endorsement.go computeLayouts)."""
+    seen = set()
+    layouts: list[dict[str, int]] = []
+    for pset in principal_sets(envelope):
+        layout: dict[str, int] = {}
+        ok = True
+        for pb in pset:
+            org = org_of_principal(pb)
+            if not org:
+                ok = False
+                break
+            layout[org] = layout.get(org, 0) + 1
+        if not ok:
+            continue
+        key = tuple(sorted(layout.items()))
+        if key not in seen:
+            seen.add(key)
+            layouts.append(layout)
+    layouts.sort(key=lambda d: (sum(d.values()), sorted(d)))
+    return layouts

@@ -1,0 +1,1 @@
+from fabric_tpu.discovery.service import DiscoveryService  # noqa: F401

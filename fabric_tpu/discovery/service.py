@@ -1,0 +1,262 @@
+"""Service discovery: membership, config and endorsement descriptors.
+
+Rebuild of `discovery/{service.go:63,support/,endorsement/}`: clients
+send a signed Request; the peer authenticates it against the channel's
+Readers policy (with a result cache keyed on the identity —
+`discovery/auth` cache), then answers from gossip membership, the
+channel config bundle, and endorsement-policy analysis
+(`endorsement.go:84,160` → layouts via common/policies/inquire).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+from typing import Optional
+
+from fabric_tpu.common.policies import inquire
+from fabric_tpu.common.policies import policy as papi
+from fabric_tpu.protos import discovery as dpb, policies as polpb
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("discovery")
+
+_AUTH_CACHE_MAX = 1000
+
+
+class DiscoveryService:
+    def __init__(self, peer, gossip_service):
+        self._peer = peer
+        self._gossip = gossip_service
+        self._auth_cache: dict[tuple[str, bytes], bool] = {}
+        self._lock = threading.Lock()
+
+    # -- entry point (the gRPC service calls this) --
+
+    def process(self, signed: dpb.SignedRequest) -> dpb.Response:
+        req = dpb.Request()
+        resp = dpb.Response()
+        try:
+            req.ParseFromString(signed.payload)
+        except Exception:
+            r = resp.results.add()
+            r.error.content = "malformed request"
+            return resp
+        for query in req.queries:
+            result = resp.results.add()
+            try:
+                self._one_query(query, req.authentication, signed,
+                                result)
+            except Exception as e:
+                logger.exception("discovery query failed")
+                result.error.content = str(e)
+        return resp
+
+    def _one_query(self, query: dpb.Query, identity: bytes,
+                   signed: dpb.SignedRequest,
+                   result: dpb.QueryResult) -> None:
+        channel = self._peer.channel(query.channel)
+        if channel is None:
+            result.error.content = f"channel {query.channel} not found"
+            return
+        if not self._authorized(channel, identity, signed):
+            result.error.content = "access denied"
+            return
+        which = query.WhichOneof("query")
+        if which == "peer_query":
+            self._peers_of(query.channel, result.members)
+        elif which == "config_query":
+            self._config_of(channel, result.config_result)
+        elif which == "cc_query":
+            for interest in query.cc_query.interests:
+                self._endorsement_descriptor(
+                    channel, query.channel, interest,
+                    result.cc_query_res.descriptors.add())
+        else:
+            result.error.content = "empty query"
+
+    # -- auth (reference discovery/auth cache) --
+
+    def _authorized(self, channel, identity: bytes,
+                    signed: dpb.SignedRequest) -> bool:
+        bundle = channel.bundle()
+        key = (channel.channel_id,
+               hashlib.sha256(identity + signed.signature).digest())
+        with self._lock:
+            cached = self._auth_cache.get(key)
+        if cached is not None:
+            return cached
+        ok = False
+        try:
+            policy = bundle.policy_manager.get_policy(
+                "/Channel/Application/Readers")
+            policy.evaluate_signed_data([pu.SignedData(
+                data=signed.payload, identity=identity,
+                signature=signed.signature)])
+            ok = True
+        except papi.PolicyError:
+            ok = False
+        with self._lock:
+            if len(self._auth_cache) > _AUTH_CACHE_MAX:
+                self._auth_cache.clear()
+            self._auth_cache[key] = ok
+        return ok
+
+    # -- membership (gossip-fed) --
+
+    def _discovered_peers(self, channel_id: str
+                          ) -> list[dpb.DiscoveredPeer]:
+        out = []
+        gchannel = self._gossip.node.channel(channel_id)
+        if gchannel is None:
+            return out
+        heights = gchannel.heights()
+        # self
+        me = dpb.DiscoveredPeer(
+            msp_id=self._gossip.node.org_id,
+            endpoint=self._gossip.node.endpoint,
+            identity=self._gossip.node.identity,
+            ledger_height=self._peer.channel(channel_id).height)
+        me.chaincodes.extend(
+            self._peer.chaincode_support.registered())
+        out.append(me)
+        for m in gchannel.members():
+            org = self._gossip._org_of_identity(m.identity) \
+                if m.identity else None
+            if org is None:
+                continue
+            dp = dpb.DiscoveredPeer(
+                msp_id=org, endpoint=m.member.endpoint,
+                identity=m.identity,
+                ledger_height=heights.get(
+                    bytes(m.member.pki_id), 0))
+            out.append(dp)
+        return out
+
+    def _peers_of(self, channel_id: str,
+                  result: dpb.PeerMembershipResult) -> None:
+        for dp in self._discovered_peers(channel_id):
+            result.peers.add().CopyFrom(dp)
+
+    # -- config --
+
+    def _config_of(self, channel, result: dpb.ConfigResult) -> None:
+        from fabric_tpu.protos import configtx as ctxpb
+        bundle = channel.bundle()
+        root = bundle.config.channel_group
+        for section in ("Application", "Orderer"):
+            group = root.groups.get(section)
+            if group is None:
+                continue
+            for org_name, og in group.groups.items():
+                val = og.values.get("MSP")
+                if val is None:
+                    continue
+                mv = ctxpb.MSPValue()
+                mv.ParseFromString(val.value)
+                result.msps[org_name] = mv.config
+        result.orderer_endpoints.extend(
+            bundle.channel.orderer_addresses)
+        if bundle.orderer is not None:
+            for org in bundle.orderer.orgs.values():
+                for ep in org.endpoints:
+                    if ep not in result.orderer_endpoints:
+                        result.orderer_endpoints.append(ep)
+
+    # -- endorsement descriptors --
+
+    def chaincode_layouts(self, channel, cc_name: str
+                          ) -> list[dict[str, int]]:
+        """Layouts satisfying the chaincode's endorsement policy."""
+        definition = channel.chaincode_definition(cc_name)
+        envelope: Optional[polpb.SignaturePolicyEnvelope] = None
+        if definition is not None and definition.endorsement_policy:
+            app = polpb.ApplicationPolicy()
+            app.ParseFromString(definition.endorsement_policy)
+            if app.WhichOneof("type") == "signature_policy":
+                envelope = app.signature_policy
+            else:
+                envelope = self._channel_policy_envelope(
+                    channel, app.channel_config_policy_reference)
+        else:
+            envelope = self._channel_policy_envelope(
+                channel, "/Channel/Application/Endorsement")
+        if envelope is None:
+            return []
+        return inquire.layouts_from_envelope(envelope)
+
+    def _channel_policy_envelope(self, channel, path: str
+                                 ) -> Optional[polpb.SignaturePolicyEnvelope]:
+        """Resolve a config policy path to a signature policy; an
+        ImplicitMeta over org sub-policies is lowered to OutOf(k,
+        member-of-each-org) like the reference's policy mapping."""
+        bundle = channel.bundle()
+        if bundle.application is None:
+            return None
+        orgs = sorted(org.mspid
+                      for org in bundle.application.orgs.values())
+        n = self._implicit_meta_n(bundle, path, len(orgs))
+        env = polpb.SignaturePolicyEnvelope(version=0)
+        sub_rules = []
+        for i, org in enumerate(orgs):
+            p = env.identities.add(
+                classification=polpb.MSPPrincipal.ROLE)
+            role = polpb.MSPRole(msp_identifier=org,
+                                 role=polpb.MSPRole.MEMBER)
+            p.principal = role.SerializeToString()
+            sp = polpb.SignaturePolicy(signed_by=i)
+            sub_rules.append(sp)
+        env.rule.n_out_of.n = max(n, 1)
+        for sp in sub_rules:
+            env.rule.n_out_of.rules.add().CopyFrom(sp)
+        return env
+
+    @staticmethod
+    def _implicit_meta_n(bundle, path: str, n_orgs: int) -> int:
+        """How many org sub-policy satisfactions the referenced
+        ImplicitMeta policy needs."""
+        rule = polpb.ImplicitMetaPolicy.MAJORITY
+        try:
+            name = path.rsplit("/", 1)[1]
+            group = bundle.config.channel_group.groups["Application"]
+            pol = group.policies[name].policy
+            if pol.type == polpb.Policy.IMPLICIT_META:
+                imp = polpb.ImplicitMetaPolicy()
+                imp.ParseFromString(pol.value)
+                rule = imp.rule
+        except Exception:
+            pass
+        if rule == polpb.ImplicitMetaPolicy.ANY:
+            return 1
+        if rule == polpb.ImplicitMetaPolicy.ALL:
+            return n_orgs
+        return n_orgs // 2 + 1
+
+    def _endorsement_descriptor(self, channel, channel_id: str,
+                                interest: dpb.ChaincodeInterest,
+                                desc: dpb.EndorsementDescriptor) -> None:
+        names = [c.name for c in interest.chaincodes] or [""]
+        desc.chaincode = names[0]
+        # cc2cc interest: intersect layouts by merging requirements —
+        # here: layouts of the FIRST cc filtered to orgs that satisfy
+        # every cc's policy (reference combines principal sets)
+        layouts = self.chaincode_layouts(channel, names[0])
+        peers = self._discovered_peers(channel_id)
+        by_org: dict[str, list[dpb.DiscoveredPeer]] = {}
+        for dp in peers:
+            by_org.setdefault(dp.msp_id, []).append(dp)
+        kept = []
+        for layout in layouts:
+            if all(len(by_org.get(org, ())) >= qty
+                   for org, qty in layout.items()):
+                kept.append(layout)
+        for layout in kept:
+            pl = desc.layouts.add()
+            for org, qty in sorted(layout.items()):
+                pl.quantities_by_org[org] = qty
+            for org in layout:
+                if org not in desc.endorsers_by_org:
+                    group = desc.endorsers_by_org[org]
+                    for dp in by_org[org]:
+                        group.peers.add().CopyFrom(dp)

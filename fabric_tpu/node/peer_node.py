@@ -135,9 +135,16 @@ class PeerNode:
         gateway.endorsers[msp_id] = self.peer.endorser
         gateway.endorser_source = self._gossip_endorsers
         self._endorser_clients: dict[str, object] = {}
+        from fabric_tpu.discovery import DiscoveryService
+        self.discovery = DiscoveryService(self.peer, self.gossip)
+        gateway.layout_source = (
+            lambda cid, cc: self.discovery.chaincode_layouts(
+                self.peer.channel(cid), cc)
+            if self.peer.channel(cid) else [])
         comm_services.register_endorser(self.server,
                                         self.peer.endorser)
         comm_services.register_gateway(self.server, gateway)
+        comm_services.register_discovery(self.server, self.discovery)
         comm_services.register_deliver(
             self.server, DeliverHandler(
                 lambda cid: self.peer.channel(cid)))

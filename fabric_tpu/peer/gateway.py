@@ -23,6 +23,17 @@ class GatewayError(Exception):
     pass
 
 
+def _chaincode_of(sp) -> str:
+    """Chaincode name targeted by a signed proposal."""
+    prop = pb.Proposal()
+    prop.ParseFromString(sp.proposal_bytes)
+    cpp = pb.ChaincodeProposalPayload()
+    cpp.ParseFromString(prop.payload)
+    spec = pb.ChaincodeInvocationSpec()
+    spec.ParseFromString(cpp.input)
+    return spec.chaincode_spec.chaincode_id.name
+
+
 @dataclass
 class SubmitResult:
     tx_id: str
@@ -46,6 +57,10 @@ class Gateway:
         # the node assembly wires this to gossip-membership discovery
         # (reference: gateway registry fed by the discovery service)
         self.endorser_source = None
+        # optional layout planner: fn(channel_id, cc_name) ->
+        # list[{org: qty}] from endorsement-policy analysis (discovery
+        # service); used to endorse with the MINIMAL satisfying org set
+        self.layout_source = None
 
     # -- Evaluate (api.go:38): simulate on one peer, return result --
 
@@ -117,9 +132,7 @@ class Gateway:
                         f"no endorsing peer known for org {org}")
                 targets.append(target)
         else:
-            # one endorser per known org (the layout that satisfies
-            # MAJORITY default policies; explicit orgs override)
-            targets = list(pool.values()) or [self._peer.endorser]
+            targets = self._plan_targets(channel_id, sp, pool)
         responses = []
         for target in targets:
             resp = target.process_proposal(sp)
@@ -131,6 +144,21 @@ class Gateway:
         prop = pb.Proposal()
         prop.ParseFromString(sp.proposal_bytes)
         return txutils.create_signed_tx(prop, responses, signer=None)
+
+    def _plan_targets(self, channel_id: str, sp, pool: dict) -> list:
+        """Pick endorsers: the smallest discovery layout whose orgs are
+        all reachable (reference api.go:127 planFromLayouts); fall back
+        to one endorser per known org."""
+        if self.layout_source is not None:
+            try:
+                cc_name = _chaincode_of(sp)
+                for layout in self.layout_source(channel_id, cc_name):
+                    if all(org in pool for org in layout):
+                        return [pool[org] for org in sorted(layout)]
+            except Exception:
+                logger.exception("endorsement planning failed; "
+                                 "falling back to all-orgs")
+        return list(pool.values()) or [self._peer.endorser]
 
     # -- Submit (api.go:402) --
 

@@ -23,3 +23,6 @@ __all__ = [
 from fabric_tpu.protos import raft_pb2 as raft  # noqa: F401,E402
 
 __all__.append("raft")
+from fabric_tpu.protos import discovery_pb2 as discovery  # noqa: F401,E402
+
+__all__.append("discovery")

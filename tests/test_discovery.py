@@ -1,0 +1,302 @@
+"""Discovery: policy inquiry, layouts, the discovery service, and
+gateway endorsement planning.
+
+Reference: `common/policies/inquire`, `discovery/{service.go,
+endorsement/endorsement.go}`, `internal/pkg/gateway` planFromLayouts.
+"""
+
+import os
+import time
+
+import pytest
+
+from fabric_tpu.common.policies.inquire import (
+    InquireError, layouts_from_envelope, principal_sets,
+)
+from fabric_tpu.common.policies.policydsl import from_string
+from fabric_tpu.protos import discovery as dpb, policies as polpb
+
+
+class TestInquire:
+    def test_or_yields_singleton_sets(self):
+        env = from_string("OR('A.member', 'B.member')")
+        sets = principal_sets(env)
+        assert len(sets) == 2
+        assert all(len(s) == 1 for s in sets)
+
+    def test_and_yields_one_combined_set(self):
+        env = from_string("AND('A.member', 'B.member')")
+        sets = principal_sets(env)
+        assert len(sets) == 1 and len(sets[0]) == 2
+
+    def test_outof_combinations(self):
+        env = from_string(
+            "OutOf(2, 'A.member', 'B.member', 'C.member')")
+        sets = principal_sets(env)
+        assert len(sets) == 3
+        assert all(len(s) == 2 for s in sets)
+
+    def test_nested_policy(self):
+        env = from_string(
+            "AND('A.member', OR('B.member', 'C.member'))")
+        layouts = layouts_from_envelope(env)
+        assert {tuple(sorted(d)) for d in layouts} == \
+            {("A", "B"), ("A", "C")}
+
+    def test_layouts_minimal_first_and_deduped(self):
+        env = from_string("OR('A.member', AND('A.member', 'B.member'))")
+        layouts = layouts_from_envelope(env)
+        assert layouts[0] == {"A": 1}
+
+    def test_duplicate_org_needs_two_signatures(self):
+        env = from_string("AND('A.member', 'A.admin')")
+        layouts = layouts_from_envelope(env)
+        assert layouts == [{"A": 2}]
+
+    def test_blowup_capped(self):
+        names = ", ".join(f"'O{i}.member'" for i in range(30))
+        env = from_string(f"OutOf(15, {names})")
+        with pytest.raises(InquireError):
+            principal_sets(env)
+
+
+# ---------------------------------------------------------------------------
+# Service + planner over an in-proc gossip network
+# ---------------------------------------------------------------------------
+
+from fabric_tpu.bccsp.sw import SWProvider          # noqa: E402
+from fabric_tpu.common.deliver import DeliverHandler  # noqa: E402
+from fabric_tpu.core.chaincode import (             # noqa: E402
+    Chaincode, ChaincodeDefinition, shim,
+)
+from fabric_tpu.discovery import DiscoveryService   # noqa: E402
+from fabric_tpu.gossip import GossipService, LocalNetwork  # noqa: E402
+from fabric_tpu.gossip.discovery import DiscoveryConfig  # noqa: E402
+from fabric_tpu.internal import cryptogen           # noqa: E402
+from fabric_tpu.internal.configtxgen import (       # noqa: E402
+    genesis_block, new_channel_group,
+)
+from fabric_tpu.msp import msp_config_from_dir      # noqa: E402
+from fabric_tpu.msp.mspimpl import X509MSP          # noqa: E402
+from fabric_tpu.orderer import solo                 # noqa: E402
+from fabric_tpu.orderer.broadcast import BroadcastHandler  # noqa: E402
+from fabric_tpu.orderer.multichannel import Registrar      # noqa: E402
+from fabric_tpu.peer import Peer                    # noqa: E402
+from fabric_tpu.peer.deliverclient import Deliverer  # noqa: E402
+from fabric_tpu.peer.gateway import Gateway         # noqa: E402
+from fabric_tpu.protos import transaction as txpb   # noqa: E402
+from fabric_tpu.protoutil import protoutil as pu    # noqa: E402
+
+CHANNEL = "discochannel"
+
+
+class CC(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+        return shim.error("unknown")
+
+
+def _wait(cond, timeout=10.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+@pytest.fixture(scope="class")
+def disco_net(tmp_path_factory):
+    root = tmp_path_factory.mktemp("disco")
+    cdir = str(root / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "100ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+    csp = SWProvider()
+
+    def local_msp(d, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(d, mspid, csp=csp))
+        return m
+
+    omsp = local_msp(os.path.join(ordo, "orderers",
+                                  "orderer0.example.com", "msp"),
+                     "OrdererMSP")
+    reg = Registrar(str(root / "ord"),
+                    omsp.get_default_signing_identity(), csp,
+                    {"solo": solo.consenter})
+    reg.join(genesis)
+    bc = BroadcastHandler(reg)
+    dh = DeliverHandler(reg.get_chain)
+
+    net = LocalNetwork()
+    peers, services, deliverers = {}, {}, []
+    for org_name, org_dir, mspid in (("org1", org1, "Org1MSP"),
+                                     ("org2", org2, "Org2MSP")):
+        ep = f"peer0.{org_name}.example.com:7051"
+        msp = local_msp(
+            os.path.join(org_dir, "peers",
+                         f"peer0.{org_name}.example.com", "msp"),
+            mspid)
+        peer = Peer(str(root / f"p_{org_name}"), msp, csp)
+        ch = peer.join_channel(genesis)
+        peer.chaincode_support.register("cc", CC())
+        ch.define_chaincode(ChaincodeDefinition(name="cc"))
+        gs = GossipService(peer, net.register(ep), peer.mcs,
+                           org_id=mspid,
+                           config=DiscoveryConfig(
+                               alive_interval_s=0.1,
+                               alive_expiration_s=0.8, fanout=4))
+        peer.gossip_service = gs
+        gs.start(bootstrap=["peer0.org1.example.com:7051"])
+        gs.initialize_channel(
+            ch, lambda adapter, p=peer: Deliverer(
+                adapter, p.signer, lambda: dh, p.mcs))
+        peers[org_name] = peer
+        services[org_name] = gs
+
+    user = local_msp(os.path.join(org1, "users",
+                                  "User1@org1.example.com", "msp"),
+                     "Org1MSP").get_default_signing_identity()
+    disco = DiscoveryService(peers["org1"], services["org1"])
+    # wait for cross-org membership
+    assert _wait(lambda: len(
+        services["org1"].node.channel(CHANNEL).members()) >= 1,
+        timeout=15)
+    yield {"disco": disco, "peers": peers, "user": user,
+           "services": services, "bc": bc, "root": root,
+           "org2_dir": org2}
+    for gs in services.values():
+        gs.stop()
+    reg.halt()
+    for p in peers.values():
+        p.close()
+
+
+def _signed_request(user, query) -> dpb.SignedRequest:
+    req = dpb.Request(authentication=user.serialize())
+    req.queries.add().CopyFrom(query)
+    payload = req.SerializeToString()
+    return dpb.SignedRequest(payload=payload,
+                             signature=user.sign(payload))
+
+
+@pytest.mark.usefixtures("disco_net")
+class TestDiscoveryService:
+    def test_peer_membership_query(self, disco_net):
+        q = dpb.Query(channel=CHANNEL)
+        q.peer_query.SetInParent()
+        resp = disco_net["disco"].process(
+            _signed_request(disco_net["user"], q))
+        peers = resp.results[0].members.peers
+        orgs = {p.msp_id for p in peers}
+        assert orgs == {"Org1MSP", "Org2MSP"}
+
+    def test_config_query(self, disco_net):
+        q = dpb.Query(channel=CHANNEL)
+        q.config_query.SetInParent()
+        resp = disco_net["disco"].process(
+            _signed_request(disco_net["user"], q))
+        cfg = resp.results[0].config_result
+        assert set(cfg.msps) == {"Org1", "Org2", "OrdererOrg"}
+        assert "orderer0.example.com:7050" in cfg.orderer_endpoints
+
+    def test_endorsers_query_default_majority(self, disco_net):
+        q = dpb.Query(channel=CHANNEL)
+        q.cc_query.interests.add().chaincodes.add(name="cc")
+        resp = disco_net["disco"].process(
+            _signed_request(disco_net["user"], q))
+        desc = resp.results[0].cc_query_res.descriptors[0]
+        assert desc.chaincode == "cc"
+        # MAJORITY of 2 orgs = both
+        assert len(desc.layouts) >= 1
+        lay = dict(desc.layouts[0].quantities_by_org)
+        assert lay == {"Org1MSP": 1, "Org2MSP": 1}
+        assert set(desc.endorsers_by_org) == {"Org1MSP", "Org2MSP"}
+
+    def test_unknown_channel_and_denied_access(self, disco_net,
+                                               tmp_path):
+        q = dpb.Query(channel="nope")
+        q.peer_query.SetInParent()
+        resp = disco_net["disco"].process(
+            _signed_request(disco_net["user"], q))
+        assert "not found" in resp.results[0].error.content
+
+        outsider_dir = cryptogen.generate_org(
+            str(tmp_path), "evil.example.com", n_peers=1, n_users=1)
+        csp = SWProvider()
+        msp = X509MSP(csp)
+        msp.setup(msp_config_from_dir(
+            os.path.join(outsider_dir, "users",
+                         "User1@evil.example.com", "msp"),
+            "EvilMSP", csp=csp))
+        evil = msp.get_default_signing_identity()
+        q = dpb.Query(channel=CHANNEL)
+        q.peer_query.SetInParent()
+        resp = disco_net["disco"].process(_signed_request(evil, q))
+        assert resp.results[0].error.content == "access denied"
+
+    def test_gateway_plans_minimal_layout(self, disco_net):
+        """An OR policy chaincode needs ONE org: the planner must not
+        fan out to both."""
+        from fabric_tpu.protoutil import txutils
+        peers = disco_net["peers"]
+        app = polpb.ApplicationPolicy(
+            signature_policy=from_string(
+                "OR('Org1MSP.member', 'Org2MSP.member')"))
+        definition = ChaincodeDefinition(
+            name="cc", endorsement_policy=app.SerializeToString())
+        for p in peers.values():
+            p.channel(CHANNEL).define_chaincode(definition)
+
+        disco = disco_net["disco"]
+        gw = Gateway(peers["org1"], disco_net["bc"])
+        gw.endorsers["Org1MSP"] = peers["org1"].endorser
+        gw.endorsers["Org2MSP"] = peers["org2"].endorser
+        gw.layout_source = (
+            lambda cid, cc: disco.chaincode_layouts(
+                peers["org1"].channel(cid), cc))
+
+        user = disco_net["user"]
+        prop, tx_id = txutils.create_proposal(
+            CHANNEL, "cc", [b"put", b"x", b"1"], user.serialize())
+        sp = txutils.sign_proposal(prop, user)
+        env = gw.endorse_signed(CHANNEL, sp)
+        action = pu.get_payload(env)
+        tx = txpb.Transaction()
+        tx.ParseFromString(action.data)
+        cap = txpb.ChaincodeActionPayload()
+        cap.ParseFromString(tx.actions[0].payload)
+        assert len(cap.action.endorsements) == 1
