@@ -1,0 +1,403 @@
+"""External chaincode (chaincode-as-a-service) over gRPC.
+
+Rebuild of the reference's CCaaS flow (`core/container/ccaas_builder`
++ `core/chaincode/handler.go` stream FSM, SURVEY §2.7): the chaincode
+runs as its OWN process hosting a `ftpu.Chaincode/Connect` stream
+service; the peer dials it and drives the reference's message dialog —
+
+  chaincode → REGISTER          (payload = ChaincodeID)
+  peer     → REGISTERED, READY
+  peer     → TRANSACTION        (payload = ChaincodeInput)
+  chaincode → GET_STATE / PUT_STATE / … (peer answers RESPONSE)
+  chaincode → COMPLETED         (payload = Response)
+
+Peer side: `ExternalChaincodeClient` duck-types the in-process
+`Chaincode` (invoke/init), so `ChaincodeSupport.register` and the
+whole endorsement path are oblivious to where the code runs.
+Chaincode side: `ChaincodeServer` hosts any `shim.Chaincode`
+implementation behind a `ProxyStub` that tunnels state access back to
+the peer's TxSimulator.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from typing import Optional
+
+import grpc
+
+from fabric_tpu.comm.server import GRPCServer, ServerConfig, STREAM_STREAM
+from fabric_tpu.protos import ccshim as shimpb, proposal as ppb
+
+logger = logging.getLogger("chaincode.external")
+
+CHAINCODE_SERVICE = "ftpu.Chaincode"
+M = shimpb.ChaincodeMessage
+
+
+# ---------------------------------------------------------------------------
+# peer side
+# ---------------------------------------------------------------------------
+
+class ExternalChaincodeError(Exception):
+    pass
+
+
+class ExternalChaincodeClient:
+    """Peer-side handle to one CCaaS process; duck-types Chaincode."""
+
+    def __init__(self, name: str, address: str,
+                 timeout_s: float = 30.0):
+        self.name = name
+        self._address = address
+        self._timeout = timeout_s
+        self._lock = threading.Lock()     # one tx at a time per stream
+        self._channel: Optional[grpc.Channel] = None
+        self._to_cc: Optional[queue.Queue] = None
+        self._from_cc: Optional[queue.Queue] = None
+        self._stream_thread: Optional[threading.Thread] = None
+
+    # -- connection management --
+
+    def _ensure_stream(self) -> None:
+        if self._channel is not None:
+            return
+        self._channel = grpc.insecure_channel(self._address)
+        call = self._channel.stream_stream(
+            f"/{CHAINCODE_SERVICE}/Connect",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=M.FromString)
+        self._to_cc = queue.Queue()
+        self._from_cc = queue.Queue()
+
+        def outgoing():
+            while True:
+                msg = self._to_cc.get()
+                if msg is None:
+                    return
+                yield msg
+
+        responses = call(outgoing())
+
+        def pump():
+            try:
+                for msg in responses:
+                    self._from_cc.put(msg)
+            except Exception as e:
+                self._from_cc.put(e)
+
+        self._stream_thread = threading.Thread(
+            target=pump, name=f"ccaas-{self.name}", daemon=True)
+        self._stream_thread.start()
+
+        # handshake: REGISTER ← / REGISTERED, READY →
+        first = self._recv()
+        if first.type != M.REGISTER:
+            raise ExternalChaincodeError(
+                f"expected REGISTER, got {first.type}")
+        cc_id = ppb.ChaincodeID()
+        cc_id.ParseFromString(first.payload)
+        if cc_id.name and cc_id.name != self.name:
+            raise ExternalChaincodeError(
+                f"chaincode at {self._address} registered as "
+                f"{cc_id.name!r}, expected {self.name!r}")
+        self._send(M(type=M.REGISTERED))
+        self._send(M(type=M.READY))
+        logger.info("external chaincode %s connected at %s", self.name,
+                    self._address)
+
+    def _send(self, msg) -> None:
+        self._to_cc.put(msg)
+
+    def _recv(self):
+        got = self._from_cc.get(timeout=self._timeout)
+        if isinstance(got, Exception):
+            self._reset()
+            raise ExternalChaincodeError(
+                f"chaincode stream failed: {got}")
+        return got
+
+    def _reset(self) -> None:
+        try:
+            if self._to_cc is not None:
+                self._to_cc.put(None)
+            if self._channel is not None:
+                self._channel.close()
+        except Exception:
+            pass
+        self._channel = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._reset()
+
+    # -- Chaincode duck-type --
+
+    def init(self, stub):
+        return self._execute(stub, is_init=True)
+
+    def invoke(self, stub):
+        return self._execute(stub, is_init=False)
+
+    def _execute(self, stub, is_init: bool):
+        from fabric_tpu.core.chaincode import shim
+        with self._lock:
+            try:
+                self._ensure_stream()
+                return self._dialog(stub, is_init)
+            except ExternalChaincodeError as e:
+                self._reset()
+                return shim.error(str(e))
+            except Exception as e:
+                self._reset()
+                return shim.error(
+                    f"external chaincode {self.name} failed: {e}")
+
+    def _dialog(self, stub, is_init: bool):
+        from fabric_tpu.core.chaincode import shim
+        inp = ppb.ChaincodeInput(is_init=is_init)
+        inp.args.extend(stub.get_args())
+        self._send(M(type=M.INIT if is_init else M.TRANSACTION,
+                     txid=stub.get_tx_id(),
+                     channel_id=stub.get_channel_id(),
+                     payload=inp.SerializeToString()))
+        while True:
+            msg = self._recv()
+            if msg.type == M.COMPLETED:
+                resp = ppb.Response()
+                resp.ParseFromString(msg.payload)
+                return resp
+            if msg.type == M.ERROR:
+                return shim.error(msg.payload.decode(errors="replace"))
+            self._send(self._serve_state(stub, msg))
+
+    def _serve_state(self, stub, msg):
+        """Answer one chaincode→peer state request against the tx's
+        simulator (reference handler.go HandleGetState etc.)."""
+        reply = M(type=M.RESPONSE, txid=msg.txid,
+                  channel_id=msg.channel_id)
+        try:
+            if msg.type == M.GET_STATE:
+                req = shimpb.GetState()
+                req.ParseFromString(msg.payload)
+                val = (stub.get_private_data(req.collection, req.key)
+                       if req.collection else stub.get_state(req.key))
+                reply.payload = val or b""
+            elif msg.type == M.PUT_STATE:
+                req = shimpb.PutState()
+                req.ParseFromString(msg.payload)
+                if req.collection:
+                    stub.put_private_data(req.collection, req.key,
+                                          req.value)
+                else:
+                    stub.put_state(req.key, req.value)
+            elif msg.type == M.DEL_STATE:
+                req = shimpb.DelState()
+                req.ParseFromString(msg.payload)
+                if req.collection:
+                    stub.del_private_data(req.collection, req.key)
+                else:
+                    stub.del_state(req.key)
+            elif msg.type == M.GET_STATE_BY_RANGE:
+                req = shimpb.GetStateByRange()
+                req.ParseFromString(msg.payload)
+                out = shimpb.QueryResponse()
+                for key, value in stub.get_state_by_range(
+                        req.start_key, req.end_key):
+                    kv = shimpb.KV(key=key, value=value)
+                    out.results.add(
+                        result_bytes=kv.SerializeToString())
+                reply.payload = out.SerializeToString()
+            elif msg.type == M.GET_PRIVATE_DATA_HASH:
+                req = shimpb.GetState()
+                req.ParseFromString(msg.payload)
+                reply.payload = stub.get_private_data_hash(
+                    req.collection, req.key) or b""
+            else:
+                reply.type = M.ERROR
+                reply.payload = (f"unsupported request type "
+                                 f"{msg.type}").encode()
+        except Exception as e:
+            reply.type = M.ERROR
+            reply.payload = str(e).encode()
+        return reply
+
+
+# ---------------------------------------------------------------------------
+# chaincode side
+# ---------------------------------------------------------------------------
+
+class ProxyStub:
+    """The stub handed to user chaincode in the external process: state
+    access tunnels back to the peer over the stream."""
+
+    def __init__(self, session, txid: str, channel_id: str, args):
+        self._s = session
+        self._txid = txid
+        self._channel_id = channel_id
+        self._args = list(args)
+        self.chaincode_event = None
+
+    # metadata
+    def get_args(self):
+        return list(self._args)
+
+    def get_function_and_parameters(self):
+        if not self._args:
+            return "", []
+        return (self._args[0].decode("utf-8", "replace"),
+                [a.decode("utf-8", "replace") for a in self._args[1:]])
+
+    def get_tx_id(self):
+        return self._txid
+
+    def get_channel_id(self):
+        return self._channel_id
+
+    # state round-trips
+    def _roundtrip(self, mtype, payload: bytes):
+        reply = self._s.request(
+            M(type=mtype, txid=self._txid,
+              channel_id=self._channel_id, payload=payload))
+        if reply.type == M.ERROR:
+            raise RuntimeError(reply.payload.decode(errors="replace"))
+        return reply.payload
+
+    def get_state(self, key: str):
+        out = self._roundtrip(M.GET_STATE, shimpb.GetState(
+            key=key).SerializeToString())
+        return out or None
+
+    def put_state(self, key: str, value: bytes):
+        self._roundtrip(M.PUT_STATE, shimpb.PutState(
+            key=key, value=value).SerializeToString())
+
+    def del_state(self, key: str):
+        self._roundtrip(M.DEL_STATE, shimpb.DelState(
+            key=key).SerializeToString())
+
+    def get_state_by_range(self, start: str, end: str):
+        raw = self._roundtrip(M.GET_STATE_BY_RANGE,
+                              shimpb.GetStateByRange(
+                                  start_key=start,
+                                  end_key=end).SerializeToString())
+        resp = shimpb.QueryResponse()
+        resp.ParseFromString(raw)
+        for rb in resp.results:
+            kv = shimpb.KV()
+            kv.ParseFromString(rb.result_bytes)
+            yield kv.key, kv.value
+
+    def get_private_data(self, collection: str, key: str):
+        out = self._roundtrip(M.GET_STATE, shimpb.GetState(
+            key=key, collection=collection).SerializeToString())
+        return out or None
+
+    def put_private_data(self, collection: str, key: str,
+                         value: bytes):
+        self._roundtrip(M.PUT_STATE, shimpb.PutState(
+            key=key, value=value,
+            collection=collection).SerializeToString())
+
+    def del_private_data(self, collection: str, key: str):
+        self._roundtrip(M.DEL_STATE, shimpb.DelState(
+            key=key, collection=collection).SerializeToString())
+
+    def get_private_data_hash(self, collection: str, key: str):
+        out = self._roundtrip(M.GET_PRIVATE_DATA_HASH, shimpb.GetState(
+            key=key, collection=collection).SerializeToString())
+        return out or None
+
+    def get_transient(self):
+        return {}   # transient never crosses the CCaaS boundary here
+
+    def set_event(self, name: str, payload: bytes):
+        pass  # events not tunneled in v1
+
+
+class _Session:
+    """One peer connection on the chaincode server."""
+
+    def __init__(self, name: str, chaincode, out_queue: queue.Queue):
+        self._name = name
+        self._cc = chaincode
+        self._out = out_queue
+        self._replies: queue.Queue = queue.Queue()
+
+    def request(self, msg) -> object:
+        self._out.put(msg)
+        return self._replies.get(timeout=30)
+
+    def handle(self, msg) -> None:
+        if msg.type in (M.REGISTERED, M.READY, M.KEEPALIVE):
+            return
+        if msg.type == M.RESPONSE or msg.type == M.ERROR:
+            self._replies.put(msg)
+            return
+        if msg.type in (M.TRANSACTION, M.INIT):
+            threading.Thread(target=self._run_tx, args=(msg,),
+                             daemon=True).start()
+
+    def _run_tx(self, msg) -> None:
+        from fabric_tpu.core.chaincode import shim
+        inp = ppb.ChaincodeInput()
+        inp.ParseFromString(msg.payload)
+        stub = ProxyStub(self, msg.txid, msg.channel_id, inp.args)
+        try:
+            if msg.type == M.INIT:
+                resp = self._cc.init(stub)
+            else:
+                resp = self._cc.invoke(stub)
+        except Exception as e:
+            logger.exception("chaincode %s crashed", self._name)
+            resp = shim.error(f"chaincode {self._name} crashed: {e}")
+        self._out.put(M(type=M.COMPLETED, txid=msg.txid,
+                        channel_id=msg.channel_id,
+                        payload=resp.SerializeToString()))
+
+
+class ChaincodeServer:
+    """Host a shim.Chaincode as a CCaaS process (reference: the
+    chaincode-side server in fabric-chaincode-go's server mode)."""
+
+    def __init__(self, name: str, chaincode,
+                 address: str = "127.0.0.1:0"):
+        self._name = name
+        self._cc = chaincode
+        self._server = GRPCServer(ServerConfig(address=address))
+        self.address = self._server.address
+        self._server.add_service(CHAINCODE_SERVICE, {
+            "Connect": (STREAM_STREAM, self._connect, M, M),
+        })
+
+    def _connect(self, request_iterator, context):
+        out: queue.Queue = queue.Queue()
+        session = _Session(self._name, self._cc, out)
+        cc_id = ppb.ChaincodeID(name=self._name)
+        out.put(M(type=M.REGISTER,
+                  payload=cc_id.SerializeToString()))
+
+        def pump_in():
+            try:
+                for msg in request_iterator:
+                    session.handle(msg)
+            except Exception:
+                pass
+            out.put(None)
+
+        threading.Thread(target=pump_in, daemon=True).start()
+        while True:
+            msg = out.get()
+            if msg is None:
+                return
+            yield msg
+
+    def start(self) -> None:
+        self._server.start()
+        logger.info("chaincode %s serving at %s", self._name,
+                    self.address)
+
+    def stop(self) -> None:
+        self._server.stop()

@@ -63,11 +63,14 @@ class ChaincodeSupport:
         self._chaincodes: dict[str, shim.Chaincode] = {}
         self._timeout = execute_timeout_s
 
-    def register(self, name: str, chaincode: shim.Chaincode) -> None:
-        if not isinstance(chaincode, shim.Chaincode):
-            raise TypeError("chaincode must implement Chaincode")
+    def register(self, name: str, chaincode) -> None:
+        """`chaincode`: anything with init(stub)/invoke(stub) — an
+        in-process shim.Chaincode or an ExternalChaincodeClient."""
+        if not (callable(getattr(chaincode, "invoke", None)) and
+                callable(getattr(chaincode, "init", None))):
+            raise TypeError("chaincode must implement init/invoke")
         self._chaincodes[name] = chaincode
-        logger.info("chaincode %s registered (in-process)", name)
+        logger.info("chaincode %s registered", name)
 
     def is_registered(self, name: str) -> bool:
         return name in self._chaincodes

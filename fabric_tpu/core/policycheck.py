@@ -38,6 +38,35 @@ def prepare_policy(policy, signed_data):
     return OneShotPrepared(policy, signed_data)
 
 
+class CombinedPrepared:
+    """ALL of several prepared policies must pass (collection-level
+    endorsement rules composed with the chaincode policy — reference:
+    the v20 plugin validating each collection's writes)."""
+
+    def __init__(self, parts):
+        self._parts = list(parts)
+        self.items = [it for p in self._parts for it in p.items]
+
+    def finish(self, flags) -> None:
+        pos = 0
+        for p in self._parts:
+            n = len(p.items)
+            p.finish(flags[pos:pos + n])
+            pos += n
+
+
+def org_member_policy_bytes(org: str) -> bytes:
+    """ApplicationPolicy requiring one member signature of `org` (the
+    implicit-collection write rule)."""
+    env = polpb.SignaturePolicyEnvelope(version=0)
+    p = env.identities.add(classification=polpb.MSPPrincipal.ROLE)
+    role = polpb.MSPRole(msp_identifier=org, role=polpb.MSPRole.MEMBER)
+    p.principal = role.SerializeToString()
+    env.rule.signed_by = 0
+    return polpb.ApplicationPolicy(
+        signature_policy=env).SerializeToString()
+
+
 class ApplicationPolicyEvaluator:
     """Reference: `core/policy/application.go` — Evaluate(policyBytes,
     signedData); here split into resolve + evaluate so the txvalidator

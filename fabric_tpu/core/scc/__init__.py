@@ -1,0 +1,14 @@
+SYSTEM_CHAINCODES = frozenset({"_lifecycle", "cscc", "qscc", "lscc"})
+
+from fabric_tpu.core.scc.cscc import CSCC  # noqa: F401,E402
+from fabric_tpu.core.scc.lifecycle import LifecycleSCC  # noqa: F401
+from fabric_tpu.core.scc.qscc import QSCC  # noqa: F401
+
+
+def register_system_chaincodes(peer) -> None:
+    """Wire the in-process system chaincodes (reference:
+    `internal/peer/node/start.go` registering lscc/cscc/qscc +
+    the _lifecycle SCC)."""
+    peer.chaincode_support.register("_lifecycle", LifecycleSCC(peer))
+    peer.chaincode_support.register("cscc", CSCC(peer))
+    peer.chaincode_support.register("qscc", QSCC(peer))

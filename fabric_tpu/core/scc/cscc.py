@@ -1,0 +1,49 @@
+"""cscc — configuration system chaincode.
+
+Rebuild of `core/scc/cscc/configure.go`: JoinChain (hand the peer a
+genesis block), JoinChainBySnapshot, GetChannels, GetConfigBlock.
+State-free: operates on the peer directly, invoked via Evaluate
+(queries) or by the operator path (joins).
+"""
+
+from __future__ import annotations
+
+import json
+
+from fabric_tpu.core.chaincode import Chaincode, shim
+from fabric_tpu.protos import common
+from fabric_tpu.protoutil import protoutil as pu
+
+
+class CSCC(Chaincode):
+    def __init__(self, peer):
+        self._peer = peer
+
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        try:
+            if fn == "JoinChain":
+                block = common.Block()
+                block.ParseFromString(stub._args[1])
+                self._peer.join_channel(block)
+                return shim.success()
+            if fn == "JoinChainBySnapshot":
+                req = json.loads(params[0])
+                self._peer.join_channel_by_snapshot(req["dir"],
+                                                    req["channel"])
+                return shim.success()
+            if fn == "GetChannels":
+                return shim.success(json.dumps(
+                    {"channels": sorted(self._peer.channels)}).encode())
+            if fn == "GetConfigBlock":
+                channel = self._peer.channel(params[0])
+                if channel is None:
+                    return shim.error(f"unknown channel {params[0]!r}")
+                block = channel._find_last_config_block()
+                return shim.success(block.SerializeToString())
+        except Exception as e:
+            return shim.error(f"cscc operation failed: {e}")
+        return shim.error(f"unknown cscc function {fn!r}")

@@ -1,0 +1,191 @@
+"""_lifecycle system chaincode: chaincode definitions as channel state.
+
+Rebuild of `core/chaincode/lifecycle/{lifecycle,scc}.go` (SURVEY §2.7):
+the v2 chaincode governance flow —
+
+  ApproveChaincodeDefinitionForMyOrg
+      the org's approval (the full definition, canonically encoded) is
+      written to the org's IMPLICIT PRIVATE COLLECTION
+      `_implicit_org_<MSPID>`; only its hash lands on-chain
+  CheckCommitReadiness
+      every org's approval hash (publicly readable via
+      get_private_data_hash) is compared with the hash of the proposed
+      definition
+  CommitChaincodeDefinition
+      requires approval by a MAJORITY of application orgs, then writes
+      the definition under the public `_lifecycle` namespace — the
+      source of truth the validator reads endorsement policies from
+  QueryChaincodeDefinition / QueryChaincodeDefinitions
+
+Arguments and results are canonical JSON (the reference uses protobuf
+field serialization; the governance semantics are what matters here).
+"""
+
+from __future__ import annotations
+
+import json
+
+from fabric_tpu.core.chaincode import Chaincode, shim
+from fabric_tpu.core.chaincode.support import ChaincodeDefinition
+from fabric_tpu.ledger.pvtdata import CollectionConfig, value_hash
+
+NAMESPACE = "_lifecycle"
+_DEF_PREFIX = "namespaces/"
+
+
+def implicit_collection(org: str) -> str:
+    return f"_implicit_org_{org}"
+
+
+def implicit_collection_config(org: str) -> CollectionConfig:
+    return CollectionConfig(name=implicit_collection(org),
+                            member_orgs=(org,), block_to_live=0)
+
+
+def canonical_definition(payload: dict) -> bytes:
+    """The byte string every org must approve verbatim."""
+    fields = {
+        "name": payload["name"],
+        "sequence": int(payload.get("sequence", 1)),
+        "version": payload.get("version", "1.0"),
+        "endorsement_policy": payload.get("endorsement_policy", ""),
+        "init_required": bool(payload.get("init_required", False)),
+        "collections": payload.get("collections", []),
+    }
+    return json.dumps(fields, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def definition_from_state(raw: bytes) -> ChaincodeDefinition:
+    d = json.loads(raw)
+    return ChaincodeDefinition(
+        name=d["name"], version=d.get("version", "1.0"),
+        sequence=int(d.get("sequence", 1)),
+        endorsement_policy=bytes.fromhex(
+            d.get("endorsement_policy", "")),
+        init_required=bool(d.get("init_required", False)),
+        collections=tuple(
+            CollectionConfig(
+                name=c["name"],
+                member_orgs=tuple(c.get("member_orgs", ())),
+                required_peer_count=int(
+                    c.get("required_peer_count", 0)),
+                maximum_peer_count=int(c.get("maximum_peer_count", 1)),
+                block_to_live=int(c.get("block_to_live", 0)),
+                member_only_read=bool(c.get("member_only_read", True)),
+                member_only_write=bool(
+                    c.get("member_only_write", True)))
+            for c in d.get("collections", ())))
+
+
+class LifecycleSCC(Chaincode):
+    def __init__(self, peer):
+        self._peer = peer
+
+    def init(self, stub):
+        return shim.success()
+
+    # -- helpers --
+
+    def _org_of_creator(self, stub) -> str:
+        channel = self._peer.channel(stub.get_channel_id())
+        ident = channel.bundle().msp_manager.deserialize_identity(
+            stub.get_creator())
+        return ident.mspid()
+
+    def _application_orgs(self, stub) -> list[str]:
+        channel = self._peer.channel(stub.get_channel_id())
+        app = channel.bundle().application
+        return sorted(org.mspid for org in app.orgs.values())
+
+    @staticmethod
+    def _payload(params) -> dict:
+        if not params:
+            raise ValueError("missing JSON argument")
+        return json.loads(params[0])
+
+    # -- dispatch --
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        try:
+            if fn == "ApproveChaincodeDefinitionForMyOrg":
+                return self._approve(stub, self._payload(params))
+            if fn == "CheckCommitReadiness":
+                return self._readiness(stub, self._payload(params))
+            if fn == "CommitChaincodeDefinition":
+                return self._commit(stub, self._payload(params))
+            if fn == "QueryChaincodeDefinition":
+                return self._query(stub, self._payload(params))
+            if fn == "QueryChaincodeDefinitions":
+                return self._query_all(stub)
+        except ValueError as e:
+            return shim.error(str(e))
+        except Exception as e:
+            return shim.error(f"lifecycle operation failed: {e}")
+        return shim.error(f"unknown lifecycle function {fn!r}")
+
+    # -- operations --
+
+    def _approve(self, stub, payload: dict):
+        org = self._org_of_creator(stub)
+        canon = canonical_definition(payload)
+        key = (f"approval/{payload['name']}/"
+               f"{int(payload.get('sequence', 1))}")
+        stub.put_private_data(implicit_collection(org), key, canon)
+        return shim.success()
+
+    def _approvals(self, stub, payload: dict) -> dict[str, bool]:
+        canon = canonical_definition(payload)
+        want = value_hash(canon)
+        key = (f"approval/{payload['name']}/"
+               f"{int(payload.get('sequence', 1))}")
+        out = {}
+        for org in self._application_orgs(stub):
+            got = stub.get_private_data_hash(implicit_collection(org),
+                                             key)
+            out[org] = got == want
+        return out
+
+    def _readiness(self, stub, payload: dict):
+        return shim.success(json.dumps(
+            {"approvals": self._approvals(stub, payload)}).encode())
+
+    def _commit(self, stub, payload: dict):
+        approvals = self._approvals(stub, payload)
+        yes = sum(1 for v in approvals.values() if v)
+        if yes <= len(approvals) // 2:
+            return shim.error(
+                f"chaincode definition for {payload['name']!r} not "
+                f"approved by a majority of orgs: {approvals}")
+        name = payload["name"]
+        seq = int(payload.get("sequence", 1))
+        current = stub.get_state(_DEF_PREFIX + name)
+        if current is not None:
+            cur_seq = json.loads(current).get("sequence", 0)
+            if seq != cur_seq + 1:
+                return shim.error(
+                    f"requested sequence {seq}, next committable is "
+                    f"{cur_seq + 1}")
+        elif seq != 1:
+            return shim.error(
+                f"requested sequence {seq} but no definition is "
+                "committed yet (next is 1)")
+        stub.put_state(_DEF_PREFIX + name, canonical_definition(payload))
+        stub.set_event("CommitChaincodeDefinition", name.encode())
+        return shim.success()
+
+    def _query(self, stub, payload: dict):
+        raw = stub.get_state(_DEF_PREFIX + payload["name"])
+        if raw is None:
+            return shim.error(
+                f"namespace {payload['name']!r} is not defined")
+        return shim.success(raw)
+
+    def _query_all(self, stub):
+        out = []
+        for _key, raw in stub.get_state_by_range(
+                _DEF_PREFIX, _DEF_PREFIX + "\x7f"):
+            out.append(json.loads(raw))
+        return shim.success(json.dumps(
+            {"chaincode_definitions": out}).encode())

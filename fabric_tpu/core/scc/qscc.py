@@ -1,0 +1,68 @@
+"""qscc — ledger query system chaincode.
+
+Rebuild of `core/scc/qscc/query.go`: GetChainInfo, GetBlockByNumber,
+GetBlockByHash, GetTransactionByID — read-only ledger access through
+the chaincode surface (what SDK "qscc" queries hit).
+"""
+
+from __future__ import annotations
+
+import json
+
+from fabric_tpu.core.chaincode import Chaincode, shim
+from fabric_tpu.protos import common
+from fabric_tpu.protoutil import protoutil as pu
+
+
+class QSCC(Chaincode):
+    def __init__(self, peer):
+        self._peer = peer
+
+    def init(self, stub):
+        return shim.success()
+
+    def _ledger(self, params):
+        if not params:
+            raise ValueError("channel name required")
+        channel = self._peer.channel(params[0])
+        if channel is None:
+            raise ValueError(f"unknown channel {params[0]!r}")
+        return channel.ledger
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        try:
+            ledger = self._ledger(params)
+            if fn == "GetChainInfo":
+                store = ledger.block_store
+                info = common.BlockchainInfo(
+                    height=store.height,
+                    current_block_hash=store.last_block_hash)
+                if store.height > store.first_block:
+                    tip = store.get_block_by_number(store.height - 1)
+                    info.previous_block_hash = \
+                        tip.header.previous_hash
+                return shim.success(info.SerializeToString())
+            if fn == "GetBlockByNumber":
+                block = ledger.block_store.get_block_by_number(
+                    int(params[1]))
+                if block is None:
+                    return shim.error(f"block {params[1]} not found")
+                return shim.success(block.SerializeToString())
+            if fn == "GetBlockByHash":
+                block = ledger.block_store.get_block_by_hash(
+                    stub._args[2])
+                if block is None:
+                    return shim.error("block not found")
+                return shim.success(block.SerializeToString())
+            if fn == "GetTransactionByID":
+                ptx = ledger.get_transaction_by_id(params[1])
+                if ptx is None:
+                    return shim.error(
+                        f"transaction {params[1]} not found")
+                return shim.success(ptx.SerializeToString())
+        except ValueError as e:
+            return shim.error(str(e))
+        except Exception as e:
+            return shim.error(f"qscc operation failed: {e}")
+        return shim.error(f"unknown qscc function {fn!r}")

@@ -36,7 +36,8 @@ from fabric_tpu.protoutil import protoutil as pu
 from fabric_tpu.common.policies import policy as papi
 from fabric_tpu.core import msgvalidation
 from fabric_tpu.core.policycheck import (
-    ApplicationPolicyEvaluator, prepare_policy,
+    ApplicationPolicyEvaluator, CombinedPrepared,
+    org_member_policy_bytes, prepare_policy,
 )
 
 logger = logging.getLogger("txvalidator")
@@ -98,7 +99,38 @@ class TxValidator:
                           identity=e.endorser, signature=e.signature)
             for e in cap.action.endorsements
         ]
-        return cc_action.chaincode_id.name, sd
+        # written collections drive collection-level validation rules
+        # (reference: v20 plugin + implicit-collection policies)
+        from fabric_tpu.protos import rwset as rwpb
+        implicit_orgs: list[str] = []
+        public_writes = False
+        other_coll_writes = False
+        try:
+            txrw = rwpb.TxReadWriteSet()
+            txrw.ParseFromString(cc_action.results)
+            for nsrw in txrw.ns_rwset:
+                if nsrw.namespace != cc_action.chaincode_id.name:
+                    continue
+                kv = rwpb.KVRWSet()
+                kv.ParseFromString(nsrw.rwset)
+                if kv.writes:
+                    public_writes = True
+                for chrw in nsrw.collection_hashed_rwset:
+                    hset = rwpb.HashedRWSet()
+                    hset.ParseFromString(chrw.rwset)
+                    if not hset.hashed_writes:
+                        continue
+                    name = chrw.collection_name
+                    if name.startswith("_implicit_org_"):
+                        implicit_orgs.append(
+                            name[len("_implicit_org_"):])
+                    else:
+                        other_coll_writes = True
+        except Exception:
+            pass
+        write_info = (tuple(implicit_orgs), public_writes,
+                      other_coll_writes)
+        return cc_action.chaincode_id.name, sd, write_info
 
     def _endorsement_policy(self, bundle, cc_name: str):
         """Resolve the chaincode's endorsement policy (reference:
@@ -112,6 +144,31 @@ class TxValidator:
             return evaluator.resolve(definition.endorsement_policy)
         return bundle.policy_manager.get_policy(
             "/Channel/Application/Endorsement")
+
+    def _prepare_validation(self, bundle, cc_name: str,
+                            endorsement_sd, write_info):
+        """Compose the tx's validation policy from the chaincode policy
+        and implicit-collection write rules: a tx writing ONLY its own
+        org's implicit collection (a _lifecycle approval) validates
+        against that org alone; implicit writes mixed with anything
+        else require the org rules AND the chaincode policy."""
+        implicit_orgs, public_writes, other_coll = write_info
+        evaluator = ApplicationPolicyEvaluator(
+            bundle.policy_manager, bundle.msp_manager, self._csp)
+        org_parts = [
+            prepare_policy(evaluator.resolve(
+                org_member_policy_bytes(org)), endorsement_sd)
+            for org in implicit_orgs
+        ]
+        if implicit_orgs and not public_writes and not other_coll:
+            if len(org_parts) == 1:
+                return org_parts[0]
+            return CombinedPrepared(org_parts)
+        base = prepare_policy(
+            self._endorsement_policy(bundle, cc_name), endorsement_sd)
+        if not org_parts:
+            return base
+        return CombinedPrepared([base] + org_parts)
 
     def _validate_config_tx(self, index: int, config_bytes: bytes) -> int:
         """Replay the config update embedded in a CONFIG tx against the
@@ -216,20 +273,20 @@ class TxValidator:
             txids_in_block.add(tx_id)
 
             try:
-                cc_name, endorsement_sd = \
+                cc_name, endorsement_sd, write_info = \
                     self._extract_endorsement_set(checked)
             except Exception as e:
                 logger.debug("tx[%d] bad endorsed action: %s", i, e)
                 codes[i] = TVC.INVALID_ENDORSER_TRANSACTION
                 continue
             try:
-                policy = self._endorsement_policy(bundle, cc_name)
+                prepared = self._prepare_validation(
+                    bundle, cc_name, endorsement_sd, write_info)
             except Exception as e:
                 logger.debug("tx[%d] chaincode %s unresolvable: %s",
                              i, cc_name, e)
                 codes[i] = TVC.INVALID_CHAINCODE
                 continue
-            prepared = prepare_policy(policy, endorsement_sd)
             checks.append(_TxCheck(index=i, creator_item=creator_item,
                                    prepared_policy=prepared,
                                    tx_id=tx_id))

@@ -124,8 +124,16 @@ class TxSimulator:
 
     def get_private_data_hash(self, ns: str, coll: str, key: str
                               ) -> Optional[bytes]:
-        """Readable by non-members too (reference GetPrivateDataHash —
-        no read recorded on the cleartext, only the hash lookup)."""
+        """Readable by non-members too; records a HASHED read so
+        decisions taken on the hash are MVCC-protected (reference
+        GetPrivateDataHash — e.g. _lifecycle commit vs a concurrent
+        re-approval)."""
+        hver = self._db.get_version(
+            pvt.hash_ns(ns, coll),
+            pvt.hashed_key_str(pvt.key_hash(key)))
+        if (ns, coll, key) not in self._pvt_reads and \
+                (ns, coll, key) not in self._pvt_writes:
+            self._pvt_reads[(ns, coll, key)] = hver
         vv = self._db.get_state(
             pvt.hash_ns(ns, coll),
             pvt.hashed_key_str(pvt.key_hash(key)))

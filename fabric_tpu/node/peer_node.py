@@ -176,6 +176,17 @@ class PeerNode:
             self.peer.chaincode_support.register(name, cls())
             logger.info("registered in-process chaincode %s (%s)",
                         name, target)
+        # chaincode-as-a-service processes (reference ccaas_builder):
+        # "name=host:port" — the peer dials the chaincode server
+        from fabric_tpu.core.chaincode.external import (
+            ExternalChaincodeClient,
+        )
+        for spec in cfg.get("chaincode.external") or []:
+            name, _, address = spec.partition("=")
+            self.peer.chaincode_support.register(
+                name, ExternalChaincodeClient(name, address))
+            logger.info("registered external chaincode %s at %s",
+                        name, address)
 
         # join channels whose genesis blocks are on disk
         for path in cfg.get("peer.channels") or []:
@@ -208,11 +219,13 @@ class PeerNode:
     def join_channel(self, genesis_block) -> None:
         from fabric_tpu.core.chaincode import ChaincodeDefinition
         channel = self.peer.join_channel(genesis_block)
-        # lifecycle-lite: registered chaincodes are defined with the
-        # channel-default endorsement policy (the state-backed
+        # lifecycle-lite: registered USER chaincodes are defined with
+        # the channel-default endorsement policy (the state-backed
         # _lifecycle flow supersedes this per-definition)
+        from fabric_tpu.core.scc import SYSTEM_CHAINCODES
         for name in self.peer.chaincode_support.registered():
-            channel.define_chaincode(ChaincodeDefinition(name=name))
+            if name not in SYSTEM_CHAINCODES:
+                channel.define_chaincode(ChaincodeDefinition(name=name))
         source = self._deliver_client_factory()
         self.gossip.initialize_channel(
             channel,

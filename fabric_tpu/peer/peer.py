@@ -127,10 +127,29 @@ class Channel:
 
     def chaincode_definition(self, name: str
                              ) -> Optional[ChaincodeDefinition]:
+        """Committed `_lifecycle` state is the source of truth
+        (reference: the lifecycle cache over the state DB); the
+        in-memory table is the dev-mode / pre-lifecycle fallback."""
+        from fabric_tpu.core.scc import lifecycle as lc
+        raw = self.ledger.get_state(lc.NAMESPACE,
+                                    lc._DEF_PREFIX + name)
+        if raw is not None:
+            try:
+                return lc.definition_from_state(raw)
+            except Exception:
+                logger.exception("[%s] undecodable committed "
+                                 "definition for %s", self.channel_id,
+                                 name)
         with self._lock:
             return self._definitions.get(name)
 
     def _collection_info(self, ns: str, coll: str):
+        from fabric_tpu.core.scc import lifecycle as lc
+        if coll.startswith("_implicit_org_"):
+            # org-scoped implicit collections exist on EVERY namespace
+            # (reference: implicit collections of _lifecycle + per-cc)
+            return lc.implicit_collection_config(
+                coll[len("_implicit_org_"):])
         definition = self.chaincode_definition(ns)
         return definition.collection(coll) if definition else None
 
@@ -253,6 +272,8 @@ class Peer:
         self.gossip_service = None   # attached by node assembly
         self.endorser = endorser_mod.Endorser(
             self.signer, self.chaincode_support, self._channel_support)
+        from fabric_tpu.core.scc import register_system_chaincodes
+        register_system_chaincodes(self)
         # reopen any previously joined channels (start.go:770
         # peerInstance.Initialize)
         for channel_id in self.ledger_mgr.ledger_ids():

@@ -460,9 +460,20 @@ class TestGossipEndToEnd:
         finally:
             net.heal()
         late_peer = gossip_net["peers"]["org1_1"]
+        late_gs = next(
+            gs for gs in gossip_net["services"]
+            if gs.node.endpoint == late)
+        provider = late_gs._channels[CHANNEL].privdata
+
         # block arrives post-heal; cleartext was missed → ledger
-        # records the gap → reconciler pulls it from org1_0
-        assert _wait(
-            lambda: late_peer.channel(CHANNEL).ledger.get_private_data(
-                "secretcc", "secrets", "k2") == b"late-secret",
-            timeout=60)
+        # records the gap → the reconciler pulls it from org1_0
+        # (driven explicitly here so the test isn't hostage to wall-
+        # clock timer alignment under CI load)
+        def reconciled():
+            val = late_peer.channel(CHANNEL).ledger.get_private_data(
+                "secretcc", "secrets", "k2")
+            if val == b"late-secret":
+                return True
+            provider.reconcile_once()
+            return False
+        assert _wait(reconciled, timeout=90, step=0.5)
