@@ -114,6 +114,20 @@ class ChaincodeStub:
         matching the reference's GetStateByRange semantics."""
         return self._sim.get_state_range(self._ns, start, end)
 
+    def get_query_result(self, query: str):
+        """Rich JSON-selector query (reference GetQueryResult; the
+        statecouchdb surface). Yields (key, value)."""
+        results, _bm = self._sim.get_query_result(self._ns, query)
+        return iter(results)
+
+    def get_query_result_with_pagination(self, query: str,
+                                         page_size: int,
+                                         bookmark: str = ""):
+        """Returns (iterator, next_bookmark)."""
+        results, next_bm = self._sim.get_query_result(
+            self._ns, query, page_size=page_size, bookmark=bookmark)
+        return iter(results), next_bm
+
     # -- private data --
 
     def _pvt_sim(self):

@@ -1,0 +1,181 @@
+"""Rich (JSON selector) queries over the state DB.
+
+The role of `core/ledger/kvledger/txmgmt/statedb/statecouchdb/` (~6k
+LoC against an external CouchDB): values that parse as JSON documents
+are queryable with a Mango-style selector — equality, $eq $ne $gt $gte
+$lt $lte $in $nin $exists, nested fields via dots, $and $or $not —
+plus sort, field projection and bookmark pagination. Here the engine
+runs in-process over the embedded ordered KV store: one state database
+serves both key/range and rich queries (no second backend to deploy,
+no HTTP hop — the TPU-native rebuild keeps the ledger self-contained).
+
+Semantics preserved from the reference: rich queries read COMMITTED
+state only (in-simulation writes are invisible), returned keys are
+recorded as reads for MVCC, and phantom results are NOT re-checked at
+validation (the documented CouchDB caveat).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator, Optional
+
+_OPS = {"$eq", "$ne", "$gt", "$gte", "$lt", "$lte", "$in", "$nin",
+        "$exists"}
+
+
+class QueryError(Exception):
+    pass
+
+
+def _field(doc: Any, path: str):
+    """Resolve a dotted path; (found, value)."""
+    cur = doc
+    for part in path.split("."):
+        if isinstance(cur, dict) and part in cur:
+            cur = cur[part]
+        else:
+            return False, None
+    return True, cur
+
+
+def _cmp_ok(a, b) -> bool:
+    return (isinstance(a, (int, float)) and isinstance(b, (int, float))
+            and not isinstance(a, bool) and not isinstance(b, bool)) \
+        or (isinstance(a, str) and isinstance(b, str))
+
+
+def _match_condition(value_found: bool, value, cond) -> bool:
+    if isinstance(cond, dict) and \
+            any(k.startswith("$") for k in cond):
+        for op, operand in cond.items():
+            if op == "$exists":
+                if value_found != bool(operand):
+                    return False
+            elif op == "$eq":
+                if not value_found or value != operand:
+                    return False
+            elif op == "$ne":
+                if value_found and value == operand:
+                    return False
+            elif op in ("$gt", "$gte", "$lt", "$lte"):
+                if not value_found or not _cmp_ok(value, operand):
+                    return False
+                if op == "$gt" and not value > operand:
+                    return False
+                if op == "$gte" and not value >= operand:
+                    return False
+                if op == "$lt" and not value < operand:
+                    return False
+                if op == "$lte" and not value <= operand:
+                    return False
+            elif op == "$in":
+                if not value_found or value not in operand:
+                    return False
+            elif op == "$nin":
+                if value_found and value in operand:
+                    return False
+            else:
+                raise QueryError(f"unsupported operator {op!r}")
+        return True
+    return value_found and value == cond
+
+
+def matches(doc: Any, selector: dict) -> bool:
+    """CouchDB-mango subset evaluation."""
+    if not isinstance(selector, dict):
+        raise QueryError("selector must be an object")
+    for key, cond in selector.items():
+        if key == "$and":
+            if not all(matches(doc, s) for s in cond):
+                return False
+        elif key == "$or":
+            if not any(matches(doc, s) for s in cond):
+                return False
+        elif key == "$not":
+            if matches(doc, cond):
+                return False
+        elif key.startswith("$"):
+            raise QueryError(f"unsupported combinator {key!r}")
+        else:
+            found, value = _field(doc, key)
+            if not _match_condition(found, value, cond):
+                return False
+    return True
+
+
+def execute_query(statedb, ns: str, query: str,
+                  page_size: int = 0, bookmark: str = ""
+                  ) -> tuple[list[tuple[str, bytes, object]], str]:
+    """Run a rich query against `ns`; returns ([(key, raw value,
+    version)], next_bookmark). `query` is the CouchDB-style JSON:
+    {"selector": {...}, "sort": [...], "limit": N, "fields": [...]}.
+    Bookmark = last returned key (resume with key > bookmark)."""
+    try:
+        q = json.loads(query)
+    except Exception as e:
+        raise QueryError(f"invalid query JSON: {e}")
+    selector = q.get("selector")
+    if selector is None:
+        raise QueryError("query lacks a selector")
+    limit = int(q.get("limit") or 0)
+    if page_size:
+        limit = min(limit, page_size) if limit else page_size
+    sort_spec = q.get("sort") or []
+    fields = q.get("fields") or None
+
+    out = []
+    start = bookmark + "\x00" if bookmark else ""
+    for key, vv in statedb.get_state_range(ns, start, ""):
+        try:
+            doc = json.loads(vv.value)
+        except Exception:
+            continue  # non-JSON values are invisible to rich queries
+        if not isinstance(doc, dict) or not matches(doc, selector):
+            continue
+        if fields:
+            doc = {f: doc[f] for f in fields if f in doc}
+            raw = json.dumps(doc, sort_keys=True).encode()
+        else:
+            raw = vv.value
+        out.append((key, raw, vv.version))
+        if limit and len(out) >= limit and not sort_spec:
+            break
+
+    if sort_spec:
+        def sort_key(item):
+            doc = json.loads(item[1])
+            keys = []
+            for s in sort_spec:
+                name, direction = (next(iter(s.items()))
+                                   if isinstance(s, dict) else (s, "asc"))
+                _f, v = _field(doc, name)
+                keys.append(v)
+            return keys
+        reverse = bool(sort_spec and isinstance(sort_spec[0], dict)
+                       and next(iter(sort_spec[0].values())) == "desc")
+        out.sort(key=sort_key, reverse=reverse)
+        if limit:
+            out = out[:limit]
+
+    next_bookmark = out[-1][0] if out and page_size and \
+        len(out) == page_size else ""
+    return out, next_bookmark
+
+
+class IndexRegistry:
+    """Index definitions (META-INF/statedb-style). The embedded engine
+    scans — indexes are accepted for API parity and used as query-plan
+    hints only (reference: CouchDB index JSON files per chaincode)."""
+
+    def __init__(self):
+        self._indexes: dict[tuple[str, str], dict] = {}
+
+    def define(self, ns: str, name: str, index_json: str) -> None:
+        idx = json.loads(index_json)
+        if "index" not in idx or "fields" not in idx["index"]:
+            raise QueryError("index definition lacks index.fields")
+        self._indexes[(ns, name)] = idx
+
+    def list(self, ns: str) -> list[str]:
+        return sorted(n for (s, n) in self._indexes if s == ns)

@@ -101,6 +101,22 @@ class TxSimulator:
         self._range_queries.append((ns, rqi))
         return out
 
+    def get_query_result(self, ns: str, query: str,
+                         page_size: int = 0, bookmark: str = ""
+                         ) -> tuple[list[tuple[str, bytes]], str]:
+        """Rich (JSON selector) query against committed state
+        (reference: statecouchdb ExecuteQuery). Returned keys are
+        recorded as reads; result sets are NOT re-validated for
+        phantoms (the documented CouchDB caveat)."""
+        from fabric_tpu.ledger import richquery
+        results, next_bm = richquery.execute_query(
+            self._db, ns, query, page_size, bookmark)
+        for key, _raw, version in results:
+            if (ns, key) not in self._reads and \
+                    (ns, key) not in self._writes:
+                self._reads[(ns, key)] = version
+        return [(k, raw) for k, raw, _v in results], next_bm
+
     # -- private data (reference: handler HandleGetState/PutState private
     #    variants → simulator GetPrivateData/SetPrivateData) --
 

@@ -1,0 +1,166 @@
+"""Rich (JSON selector) state queries — the statecouchdb analog.
+
+Reference semantics: statecouchdb rich queries (selector subset,
+pagination, committed-state-only visibility, per-key read recording,
+no phantom re-check).
+"""
+
+import json
+
+import pytest
+
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.ledger.richquery import (
+    IndexRegistry, QueryError, matches,
+)
+from fabric_tpu.ledger.statedb import Height, StateDB, UpdateBatch
+from fabric_tpu.ledger.txmgr import TxSimulator
+
+
+class TestSelector:
+    def test_equality_and_nested(self):
+        doc = {"color": "red", "owner": {"name": "alice"}, "size": 5}
+        assert matches(doc, {"color": "red"})
+        assert matches(doc, {"owner.name": "alice"})
+        assert not matches(doc, {"color": "blue"})
+        assert not matches(doc, {"missing": 1})
+
+    def test_comparison_ops(self):
+        doc = {"size": 5, "name": "m"}
+        assert matches(doc, {"size": {"$gt": 4}})
+        assert matches(doc, {"size": {"$gte": 5, "$lte": 5}})
+        assert not matches(doc, {"size": {"$lt": 5}})
+        assert matches(doc, {"size": {"$ne": 6}})
+        assert matches(doc, {"name": {"$gt": "a"}})
+        # cross-type comparisons never match
+        assert not matches(doc, {"name": {"$gt": 3}})
+
+    def test_in_exists_combinators(self):
+        doc = {"color": "red", "size": 5}
+        assert matches(doc, {"color": {"$in": ["red", "blue"]}})
+        assert matches(doc, {"color": {"$exists": True},
+                             "weight": {"$exists": False}})
+        assert matches(doc, {"$or": [{"color": "blue"},
+                                     {"size": {"$gt": 1}}]})
+        assert matches(doc, {"$and": [{"color": "red"},
+                                      {"size": 5}]})
+        assert matches(doc, {"$not": {"color": "blue"}})
+        assert not matches(doc, {"color": {"$nin": ["red"]}})
+
+    def test_unsupported_operator_raises(self):
+        with pytest.raises(QueryError):
+            matches({"a": 1}, {"a": {"$regex": "x"}})
+        with pytest.raises(QueryError):
+            matches({"a": 1}, {"$nor": [{"a": 1}]})
+
+
+def _statedb():
+    db = StateDB(DBHandle(KVStore(":memory:"), "s"))
+    batch = UpdateBatch()
+    marbles = [
+        ("m1", {"color": "red", "size": 1, "owner": "alice"}),
+        ("m2", {"color": "blue", "size": 2, "owner": "bob"}),
+        ("m3", {"color": "red", "size": 3, "owner": "alice"}),
+        ("m4", {"color": "green", "size": 4, "owner": "carol"}),
+        ("m5", {"color": "red", "size": 5, "owner": "bob"}),
+    ]
+    for i, (key, doc) in enumerate(marbles):
+        batch.put("cc", key, json.dumps(doc).encode(), Height(1, i))
+    batch.put("cc", "binary", b"\x00not-json", Height(1, 9))
+    db.apply_updates(batch, Height(1, 9))
+    return db
+
+
+class TestQueryExecution:
+    def test_selector_query_records_reads(self):
+        db = _statedb()
+        sim = TxSimulator(db, "tx1")
+        results, _ = sim.get_query_result(
+            "cc", json.dumps({"selector": {"color": "red"}}))
+        assert [k for k, _ in results] == ["m1", "m3", "m5"]
+        rwset = sim.get_tx_simulation_results()
+        import fabric_tpu.protos.rwset_pb2 as rwpb
+        kv = rwpb.KVRWSet()
+        kv.ParseFromString(rwset.ns_rwset[0].rwset)
+        assert [r.key for r in kv.reads] == ["m1", "m3", "m5"]
+
+    def test_sort_limit_fields(self):
+        db = _statedb()
+        sim = TxSimulator(db, "tx")
+        results, _ = sim.get_query_result("cc", json.dumps({
+            "selector": {"size": {"$gte": 2}},
+            "sort": [{"size": "desc"}],
+            "limit": 2,
+            "fields": ["owner", "size"],
+        }))
+        docs = [json.loads(v) for _k, v in results]
+        assert docs == [{"owner": "bob", "size": 5},
+                        {"owner": "carol", "size": 4}]
+
+    def test_pagination_bookmarks(self):
+        db = _statedb()
+        sim = TxSimulator(db, "tx")
+        q = json.dumps({"selector": {"color": "red"}})
+        page1, bm1 = sim.get_query_result("cc", q, page_size=2)
+        assert [k for k, _ in page1] == ["m1", "m3"] and bm1 == "m3"
+        page2, bm2 = sim.get_query_result("cc", q, page_size=2,
+                                          bookmark=bm1)
+        assert [k for k, _ in page2] == ["m5"] and bm2 == ""
+
+    def test_non_json_invisible_and_writes_not_visible(self):
+        db = _statedb()
+        sim = TxSimulator(db, "tx")
+        sim.put_state("cc", "m9",
+                      json.dumps({"color": "red"}).encode())
+        results, _ = sim.get_query_result(
+            "cc", json.dumps({"selector": {"color": {"$exists":
+                                                     True}}}))
+        keys = [k for k, _ in results]
+        assert "binary" not in keys   # non-JSON skipped
+        assert "m9" not in keys       # committed-state-only visibility
+
+    def test_mvcc_conflict_on_queried_key(self):
+        """A doc returned by a rich query that changes before commit
+        invalidates the tx (per-key read recording)."""
+        from fabric_tpu.ledger.txmgr import TxMgr
+        from fabric_tpu.protos import transaction as txpb
+        db = _statedb()
+        sim = TxSimulator(db, "tx")
+        sim.get_query_result(
+            "cc", json.dumps({"selector": {"owner": "carol"}}))
+        sim.put_state("cc", "result", b"based-on-query")
+        rwset = sim.get_tx_simulation_results()
+        # concurrent update to m4 commits first
+        batch = UpdateBatch()
+        batch.put("cc", "m4", json.dumps(
+            {"color": "green", "size": 4, "owner": "dave"}).encode(),
+            Height(2, 0))
+        db.apply_updates(batch, Height(2, 0))
+        codes, _ = TxMgr(db).validate_and_prepare(3, [rwset])
+        assert codes == [txpb.TxValidationCode.MVCC_READ_CONFLICT]
+
+    def test_index_registry(self):
+        reg = IndexRegistry()
+        reg.define("cc", "byColor", json.dumps(
+            {"index": {"fields": ["color"]}, "name": "byColor",
+             "type": "json"}))
+        assert reg.list("cc") == ["byColor"]
+        with pytest.raises(QueryError):
+            reg.define("cc", "bad", "{}")
+
+
+class TestChaincodeSurface:
+    def test_stub_get_query_result(self):
+        from fabric_tpu.core.chaincode import shim
+        db = _statedb()
+        sim = TxSimulator(db, "tx")
+        stub = shim.ChaincodeStub(
+            channel_id="ch", tx_id="tx", namespace="cc",
+            simulator=sim, args=[b"q"], creator=b"", transient=None,
+            support=None, timestamp=0)
+        rows = list(stub.get_query_result(
+            json.dumps({"selector": {"owner": "alice"}})))
+        assert [k for k, _ in rows] == ["m1", "m3"]
+        rows, bm = stub.get_query_result_with_pagination(
+            json.dumps({"selector": {"color": "red"}}), 2)
+        assert len(list(rows)) == 2 and bm == "m3"
