@@ -45,6 +45,21 @@ class ECDSAPublicKey(api.Key):
         self._pub = pub
         nums = pub.public_numbers()
         self.x, self.y = nums.x, nums.y
+        self._xy_cache = None
+
+    def x_bytes(self):
+        """Cached 32-byte big-endian coordinates (batch-assembly hot
+        path: the same org keys recur thousands of times per block)."""
+        if self._xy_cache is None:
+            import numpy as np
+            self._xy_cache = (
+                np.frombuffer(self.x.to_bytes(32, "big"), np.uint8),
+                np.frombuffer(self.y.to_bytes(32, "big"), np.uint8))
+        return self._xy_cache[0]
+
+    def y_bytes(self):
+        self.x_bytes()
+        return self._xy_cache[1]
 
     def bytes(self) -> bytes:
         return self._pub.public_bytes(

@@ -1,0 +1,106 @@
+"""ctypes binding for the C++ batch-prep extension.
+
+Loads (building on first use if the toolchain is present) the native
+signature-preparation library; `available()` gates use so pure-Python
+environments keep working — the TPU provider falls back transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native",
+                    "batchprep.cpp")
+_LIB = os.path.join(_HERE, "libbatchprep.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:
+        logger.info("native batchprep build unavailable: %s", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or (
+                os.path.exists(_SRC) and
+                os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            logger.info("native batchprep load failed: %s", e)
+            return None
+        lib.ftpu_batch_prep.argtypes = [
+            ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int32, flags="C"),
+            np.ctypeslib.ndpointer(np.int32, flags="C"),
+            ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE"),
+        ]
+        lib.ftpu_batch_prep.restype = None
+        _lib = lib
+        logger.info("native batchprep loaded (%s)", _LIB)
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def batch_prep(signatures: list[bytes]
+               ) -> Optional[tuple[np.ndarray, np.ndarray, np.ndarray,
+                                   np.ndarray]]:
+    """Parse+gate+prepare a batch of DER signatures.
+
+    Returns (ok bool[n], r u8[n,32], rpn u8[n,32], w u8[n,32]) — all
+    big-endian scalars, zeros where ok is False — or None when the
+    native library is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(signatures)
+    blob = b"".join(signatures)
+    offs = np.zeros(n, dtype=np.int32)
+    lens = np.zeros(n, dtype=np.int32)
+    pos = 0
+    for i, sig in enumerate(signatures):
+        offs[i] = pos
+        lens[i] = len(sig)
+        pos += len(sig)
+    r = np.zeros((n, 32), dtype=np.uint8)
+    rpn = np.zeros((n, 32), dtype=np.uint8)
+    w = np.zeros((n, 32), dtype=np.uint8)
+    ok = np.zeros(n, dtype=np.uint8)
+    lib.ftpu_batch_prep(blob, offs, lens, n, r, rpn, w, ok)
+    return ok.astype(bool), r, rpn, w

@@ -1,0 +1,269 @@
+// fabric-tpu native host path: batched ECDSA verify preparation.
+//
+// The TPU provider's CPU-side hot loop (fabric_tpu/bccsp/tpu.py
+// _verify_batch_device): per signature — strict DER parse, positivity,
+// low-S policy, scalar range checks, w = s^-1 mod n, r+n overflow
+// probe — executed here over the whole batch in one C call. Semantics
+// mirror fabric_tpu/bccsp/utils.py (unmarshal_signature/is_low_s),
+// which in turn mirrors the reference's bccsp/utils/ecdsa.go:41-90;
+// differential tests (tests/test_native.py) pin byte-identical
+// accept/reject and identical scalar outputs against the Python path.
+//
+// Build: g++ -O2 -shared -fPIC -o libbatchprep.so batchprep.cpp
+// (tools/build_native.py; loaded via ctypes — no pybind11 needed).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+// ---- u256 little-endian 4x64 limbs ----
+
+struct U256 {
+    uint64_t v[4];
+};
+
+const U256 ZERO = {{0, 0, 0, 0}};
+
+// P-256 group order n and field prime p (big-endian constants folded
+// to limbs).
+const U256 N = {{0xF3B9CAC2FC632551ULL, 0xBCE6FAADA7179E84ULL,
+                 0xFFFFFFFFFFFFFFFFULL, 0xFFFFFFFF00000000ULL}};
+const U256 P = {{0xFFFFFFFFFFFFFFFFULL, 0x00000000FFFFFFFFULL,
+                 0x0000000000000000ULL, 0xFFFFFFFF00000001ULL}};
+// n >> 1 (the low-S boundary)
+const U256 HALF_N = {{0x79DCE5617E3192A8ULL, 0xDE737D56D38BCF42ULL,
+                      0x7FFFFFFFFFFFFFFFULL, 0x7FFFFFFF80000000ULL}};
+
+int cmp(const U256 &a, const U256 &b) {
+    for (int i = 3; i >= 0; --i) {
+        if (a.v[i] < b.v[i]) return -1;
+        if (a.v[i] > b.v[i]) return 1;
+    }
+    return 0;
+}
+
+bool is_zero(const U256 &a) {
+    return (a.v[0] | a.v[1] | a.v[2] | a.v[3]) == 0;
+}
+
+// a += b; returns carry-out
+uint64_t add(U256 &a, const U256 &b) {
+    unsigned __int128 c = 0;
+    for (int i = 0; i < 4; ++i) {
+        c += (unsigned __int128)a.v[i] + b.v[i];
+        a.v[i] = (uint64_t)c;
+        c >>= 64;
+    }
+    return (uint64_t)c;
+}
+
+// a -= b (assumes a >= b)
+void sub(U256 &a, const U256 &b) {
+    unsigned __int128 borrow = 0;
+    for (int i = 0; i < 4; ++i) {
+        unsigned __int128 d =
+            (unsigned __int128)a.v[i] - b.v[i] - borrow;
+        a.v[i] = (uint64_t)d;
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+// a >>= 1 with carry_in as the new top bit
+void shr1(U256 &a, uint64_t carry_in) {
+    for (int i = 0; i < 4; ++i) {
+        uint64_t next = (i < 3) ? (a.v[i + 1] & 1) : carry_in;
+        a.v[i] = (a.v[i] >> 1) | (next << 63);
+    }
+}
+
+// u = u/2 mod n (n odd)
+void halve_mod(U256 &u) {
+    if (u.v[0] & 1) {
+        uint64_t c = add(u, N);
+        shr1(u, c);
+    } else {
+        shr1(u, 0);
+    }
+}
+
+// a = (a - b) mod n, both < n
+void sub_mod(U256 &a, const U256 &b) {
+    if (cmp(a, b) >= 0) {
+        sub(a, b);
+    } else {
+        // a + n - b: the carry out of a+n cancels against b > a
+        add(a, N);
+        sub(a, b);
+    }
+}
+
+// out = in^-1 mod n via binary extended GCD; in must be in (0, n)
+void modinv(const U256 &in, U256 &out) {
+    U256 a = in, b = N;
+    U256 u = {{1, 0, 0, 0}}, w = ZERO;
+    while (!is_zero(a)) {
+        while (!(a.v[0] & 1)) {
+            shr1(a, 0);
+            halve_mod(u);
+        }
+        while (!(b.v[0] & 1)) {
+            shr1(b, 0);
+            halve_mod(w);
+        }
+        if (cmp(a, b) >= 0) {
+            sub(a, b);
+            sub_mod(u, w);
+        } else {
+            sub(b, a);
+            sub_mod(w, u);
+        }
+    }
+    out = w;  // gcd in b == 1 for prime n
+}
+
+void store_be(const U256 &a, uint8_t *out32) {
+    for (int i = 0; i < 4; ++i) {
+        uint64_t limb = a.v[3 - i];
+        for (int j = 0; j < 8; ++j)
+            out32[i * 8 + j] = (uint8_t)(limb >> (56 - 8 * j));
+    }
+}
+
+// ---- DER parsing (exact mirror of utils.py _parse_len/_parse_int) ----
+
+struct Parser {
+    const uint8_t *raw;
+    int32_t len;
+    int32_t off;
+    bool bad;
+
+    uint8_t byte() { return raw[off]; }
+    bool avail(int32_t k) const { return off + k <= len; }
+};
+
+// definite length; false on format error
+bool parse_len(Parser &p, int64_t &out) {
+    if (p.off >= p.len) return false;
+    uint8_t b = p.raw[p.off];
+    if (b < 0x80) {
+        out = b;
+        p.off += 1;
+        return true;
+    }
+    int nbytes = b & 0x7F;
+    if (nbytes == 0 || nbytes > 4) return false;
+    if (p.off + 1 + nbytes > p.len) return false;
+    if (p.raw[p.off + 1] == 0) return false;  // superfluous zeros
+    int64_t val = 0;
+    for (int i = 0; i < nbytes; ++i)
+        val = (val << 8) | p.raw[p.off + 1 + i];
+    if (val < 0x80) return false;             // non-minimal form
+    p.off += 1 + nbytes;
+    out = val;
+    return true;
+}
+
+// INTEGER -> (value as U256 if it fits in 32 bytes, ok flags).
+// Returns false on malformed DER; *fits=false when positive but wider
+// than 256 bits (caller rejects: >= n anyway); *nonpos=true for
+// negative or zero values.
+bool parse_int(Parser &p, U256 &out, bool &fits, bool &nonpos) {
+    if (p.off >= p.len || p.raw[p.off] != 0x02) return false;
+    p.off += 1;
+    int64_t length;
+    if (!parse_len(p, length)) return false;
+    if (length == 0) return false;
+    if (p.off + length > p.len) return false;
+    const uint8_t *content = p.raw + p.off;
+    if (length > 1) {
+        if (content[0] == 0x00 && content[1] < 0x80) return false;
+        if (content[0] == 0xFF && content[1] >= 0x80) return false;
+    }
+    nonpos = false;
+    fits = true;
+    out = ZERO;
+    if (content[0] & 0x80) {
+        nonpos = true;  // negative (two's complement sign bit)
+        p.off += length;
+        return true;
+    }
+    const uint8_t *mag = content;
+    int64_t mlen = length;
+    while (mlen > 0 && mag[0] == 0x00) {
+        ++mag;
+        --mlen;
+    }
+    if (mlen == 0) {
+        nonpos = true;  // value == 0
+        p.off += length;
+        return true;
+    }
+    if (mlen > 32) {
+        fits = false;
+        p.off += length;
+        return true;
+    }
+    for (int64_t i = 0; i < mlen; ++i) {
+        int64_t bit_index = (mlen - 1 - i);
+        out.v[bit_index / 8] |=
+            (uint64_t)mag[i] << (8 * (bit_index % 8));
+    }
+    p.off += length;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// One signature: parse + policy gates + scalar prep.
+// Returns 1 and fills r/rpn/w (32-byte big-endian each) on acceptance.
+int ftpu_prep_one(const uint8_t *der, int32_t der_len, uint8_t *r_out,
+                  uint8_t *rpn_out, uint8_t *w_out) {
+    Parser p{der, der_len, 0, false};
+    if (der_len <= 0 || der[0] != 0x30) return 0;
+    p.off = 1;
+    int64_t seq_len;
+    if (!parse_len(p, seq_len)) return 0;
+    if (p.off + seq_len > p.len) return 0;
+    int64_t end = p.off + seq_len;
+    U256 r, s;
+    bool fits_r, nonpos_r, fits_s, nonpos_s;
+    if (!parse_int(p, r, fits_r, nonpos_r)) return 0;
+    if (!parse_int(p, s, fits_s, nonpos_s)) return 0;
+    if (p.off != end) return 0;  // trailing data inside sequence
+    // (bytes after `end` tolerated — Go asn1 `rest` semantics)
+    if (nonpos_r || nonpos_s) return 0;
+    // low-S policy, then scalar range (mirrors check_signature + the
+    // provider's r/s < n gate; !fits => >= n)
+    if (!fits_s || cmp(s, HALF_N) > 0) return 0;
+    if (!fits_r || cmp(r, N) >= 0 || is_zero(r)) return 0;
+    if (cmp(s, N) >= 0 || is_zero(s)) return 0;
+
+    U256 w;
+    modinv(s, w);
+    U256 rpn = r;
+    uint64_t carry = add(rpn, N);
+    // r+n used only if it stays below the field prime p (no carry and
+    // < p); else fall back to r (tpu.py: rpn = r+N if r+N < P else r)
+    if (carry || cmp(rpn, P) >= 0) rpn = r;
+    store_be(r, r_out);
+    store_be(rpn, rpn_out);
+    store_be(w, w_out);
+    return 1;
+}
+
+// Batch driver: der blob + per-item (offset, length).
+void ftpu_batch_prep(const uint8_t *blob, const int32_t *offs,
+                     const int32_t *lens, int32_t n, uint8_t *r_out,
+                     uint8_t *rpn_out, uint8_t *w_out,
+                     uint8_t *ok_out) {
+    for (int32_t i = 0; i < n; ++i) {
+        ok_out[i] = (uint8_t)ftpu_prep_one(
+            blob + offs[i], lens[i], r_out + 32 * i, rpn_out + 32 * i,
+            w_out + 32 * i);
+    }
+}
+
+}  // extern "C"

@@ -1,0 +1,147 @@
+"""Native (C++) batch-prep: differential parity with the Python path.
+
+The native library must accept/reject EXACTLY the signatures the
+Python gates (utils.unmarshal_signature + is_low_s + range checks)
+accept/reject, and produce identical scalars — over random valid
+signatures AND an adversarial corpus (bad DER, high-S, huge/negative
+integers, trailing data, truncations).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fabric_tpu import native
+from fabric_tpu.bccsp import sw as swmod, utils
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native toolchain unavailable")
+
+N = utils.P256_N
+P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+
+
+def python_prep(sig: bytes):
+    """The pure-Python reference pipeline (tpu.py fallback path)."""
+    try:
+        r, s = utils.unmarshal_signature(sig)
+    except utils.SignatureFormatError:
+        return None
+    if not utils.is_low_s(s):
+        return None
+    if r >= N or s >= N:
+        return None
+    rpn = r + N if r + N < P256_P else r
+    w = pow(s, -1, N)
+    return r, rpn, w
+
+
+def _assert_parity(sigs):
+    ok, r_b, rpn_b, w_b = native.batch_prep(sigs)
+    for i, sig in enumerate(sigs):
+        expected = python_prep(sig)
+        assert bool(ok[i]) == (expected is not None), \
+            (i, sig.hex(), bool(ok[i]))
+        if expected is None:
+            continue
+        r, rpn, w = expected
+        assert int.from_bytes(bytes(r_b[i]), "big") == r, i
+        assert int.from_bytes(bytes(rpn_b[i]), "big") == rpn, i
+        assert int.from_bytes(bytes(w_b[i]), "big") == w, i
+
+
+class TestNativeParity:
+    def test_random_valid_signatures(self):
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec
+        key = ec.generate_private_key(ec.SECP256R1())
+        sigs = []
+        for i in range(64):
+            der = key.sign(f"m{i}".encode(), ec.ECDSA(hashes.SHA256()))
+            r, s = utils.unmarshal_signature(der)
+            sigs.append(utils.marshal_signature(r, utils.to_low_s(s)))
+            sigs.append(der)  # possibly high-S: both paths must agree
+        _assert_parity(sigs)
+
+    def test_adversarial_corpus(self):
+        half = N >> 1
+        corpus = [
+            b"",
+            b"\x30",
+            b"\x30\x00",
+            b"\x02\x01\x01",                       # no SEQUENCE
+            b"\x30\x06\x02\x01\x01\x02\x01\x01",   # valid tiny r,s
+            b"\x30\x06\x02\x01\x01\x02\x01\x01" + b"xx",  # trailing ok
+            b"\x30\x07\x02\x01\x01\x02\x01\x01x",  # trailing INSIDE seq
+            b"\x30\x06\x02\x01\x00\x02\x01\x01",   # r == 0
+            b"\x30\x06\x02\x01\x01\x02\x01\x00",   # s == 0
+            b"\x30\x06\x02\x01\x81\x02\x01\x01",   # r negative
+            b"\x30\x08\x02\x03\x00\x00\x01\x02\x01\x01",  # non-minimal r
+            b"\x30\x07\x02\x02\x00\x80\x02\x01\x01",      # minimal 0x80
+            # s exactly half order (accepted) and half+1 (rejected)
+            utils.marshal_signature(1, half),
+            utils.marshal_signature(1, half + 1),
+            utils.marshal_signature(N - 1, 1),     # r = n-1 ok
+            utils.marshal_signature(N, 1),         # r = n rejected
+            utils.marshal_signature(N + 5, 1),     # r > n rejected
+            utils.marshal_signature(1, 1),
+            utils.marshal_signature(2**256 + 7, 1),  # r wider than 256b
+            utils.marshal_signature(P256_P - N - 1, half),  # rpn = r+n
+            utils.marshal_signature(P256_P - N + 1, half),  # rpn = r
+            b"\x30\x84\x00\x00\x00\x06\x02\x01\x01\x02\x01\x01",  # long-form len (non-minimal)
+            b"\x30\x81\x06\x02\x01\x01\x02\x01\x01",  # 0x81 len < 0x80
+        ]
+        _assert_parity(corpus)
+
+    def test_fuzz_mutations(self):
+        """Bit-flip fuzz over a valid signature: both paths always
+        agree (accept or reject, and scalars when accepted)."""
+        rng = np.random.default_rng(42)
+        base = utils.marshal_signature(1234567890123456789,
+                                       utils.to_low_s(987654321))
+        sigs = [base]
+        for _ in range(300):
+            mutated = bytearray(base)
+            for _ in range(rng.integers(1, 4)):
+                pos = rng.integers(0, len(mutated))
+                mutated[pos] ^= 1 << rng.integers(0, 8)
+            sigs.append(bytes(mutated))
+        for _ in range(100):
+            sigs.append(bytes(rng.integers(0, 256,
+                                           rng.integers(0, 80),
+                                           dtype=np.uint8)))
+        _assert_parity(sigs)
+
+    def test_modinv_edge_scalars(self):
+        sigs = [utils.marshal_signature(1, s) for s in
+                [1, 2, 3, (N >> 1) - 1, N >> 1]]
+        _assert_parity(sigs)
+
+    def test_provider_uses_native_and_matches_sw(self):
+        """End-to-end: TPU provider (native prep) and sw provider agree
+        on a mixed batch."""
+        from fabric_tpu.bccsp import bccsp as api
+        from fabric_tpu.bccsp.sw import SWProvider
+        from fabric_tpu.bccsp.tpu import TPUProvider
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        sw = SWProvider()
+        tpu = TPUProvider(min_batch=1)
+        key = sw.key_gen(api.ECDSAKeyGenOpts(ephemeral=True))
+        items = []
+        for i in range(24):
+            msg = f"payload-{i}".encode()
+            digest = sw.hash(msg)
+            sig = sw.sign(key, digest)
+            if i % 3 == 0:
+                sig = bytearray(sig)
+                sig[-1] ^= 1  # corrupt
+                sig = bytes(sig)
+            items.append(api.VerifyItem(key=key, signature=sig,
+                                        message=msg))
+        want = sw.verify_batch(items)
+        got = tpu.verify_batch(items)
+        assert got == want
+        assert sum(want) > 0 and not all(want)
