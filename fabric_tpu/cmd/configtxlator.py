@@ -1,0 +1,94 @@
+"""`configtxlator` CLI — proto ⇄ JSON translation + config updates.
+
+Reference: `internal/configtxlator` (`cmd/configtxlator`): operators
+inspect and hand-edit channel config as JSON, then compute the
+ConfigUpdate delta between two configs.
+
+  configtxlator proto_decode --type common.Block  --input b.block
+  configtxlator proto_encode --type common.Config --input c.json \
+      --output c.pb
+  configtxlator compute_update --channel_id ch \
+      --original orig.pb --updated new.pb --output update.pb
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from google.protobuf import json_format
+
+
+def _message_class(type_name: str):
+    from fabric_tpu.protos import (  # noqa: F401
+        common, configtx, gossip, msp, orderer, policies, proposal,
+        rwset, transaction,
+    )
+    mods = {"common": common, "configtx": configtx, "msp": msp,
+            "orderer": orderer, "policies": policies,
+            "proposal": proposal, "rwset": rwset,
+            "transaction": transaction, "gossip": gossip}
+    mod_name, _, msg_name = type_name.partition(".")
+    mod = mods.get(mod_name)
+    if mod is None or not hasattr(mod, msg_name):
+        raise SystemExit(f"unknown message type {type_name!r} "
+                         f"(use e.g. common.Block, configtx.Config)")
+    return getattr(mod, msg_name)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="configtxlator")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    dec = sub.add_parser("proto_decode")
+    dec.add_argument("--type", required=True)
+    dec.add_argument("--input", required=True)
+    dec.add_argument("--output", default="")
+
+    enc = sub.add_parser("proto_encode")
+    enc.add_argument("--type", required=True)
+    enc.add_argument("--input", required=True)
+    enc.add_argument("--output", required=True)
+
+    cu = sub.add_parser("compute_update")
+    cu.add_argument("--channel_id", required=True)
+    cu.add_argument("--original", required=True)
+    cu.add_argument("--updated", required=True)
+    cu.add_argument("--output", required=True)
+
+    args = p.parse_args(argv)
+    if args.cmd == "proto_decode":
+        msg = _message_class(args.type)()
+        with open(args.input, "rb") as f:
+            msg.ParseFromString(f.read())
+        out = json_format.MessageToJson(msg, sort_keys=True)
+        if args.output:
+            with open(args.output, "w") as f:
+                f.write(out)
+        else:
+            print(out)
+        return 0
+    if args.cmd == "proto_encode":
+        msg = _message_class(args.type)()
+        with open(args.input) as f:
+            json_format.Parse(f.read(), msg)
+        with open(args.output, "wb") as f:
+            f.write(msg.SerializeToString(deterministic=True))
+        return 0
+    # compute_update
+    from fabric_tpu.common.configtx import compute_update
+    from fabric_tpu.protos import configtx as ctxpb
+    orig, new = ctxpb.Config(), ctxpb.Config()
+    with open(args.original, "rb") as f:
+        orig.ParseFromString(f.read())
+    with open(args.updated, "rb") as f:
+        new.ParseFromString(f.read())
+    update = compute_update(args.channel_id, orig, new)
+    with open(args.output, "wb") as f:
+        f.write(update.SerializeToString(deterministic=True))
+    print(f"wrote config update for {args.channel_id}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""gRPC server interceptors: structured RPC logging + metrics.
+
+Rebuild of `common/grpclogging` + `common/grpcmetrics` (wired at
+`internal/peer/node/start.go:246-255`): every unary/stream RPC is
+logged with service/method/duration/status and counted into the
+operations metrics (`grpc_server_unary_requests_completed` etc.).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import grpc
+
+logger = logging.getLogger("comm.grpc")
+
+
+class ServerObservability(grpc.ServerInterceptor):
+    def __init__(self, metrics_provider=None,
+                 log: Optional[logging.Logger] = None):
+        self._log = log or logger
+        self._m_completed = None
+        self._m_duration = None
+        if metrics_provider is not None:
+            from fabric_tpu.common import metrics as m
+            self._m_completed = metrics_provider.new_counter(
+                m.CounterOpts(namespace="grpc", subsystem="server",
+                              name="requests_completed",
+                              label_names=("service", "method",
+                                           "code")))
+            self._m_duration = metrics_provider.new_histogram(
+                m.HistogramOpts(namespace="grpc", subsystem="server",
+                                name="request_duration",
+                                label_names=("service", "method")))
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        parts = handler_call_details.method.rsplit("/", 2)
+        service = parts[-2] if len(parts) >= 2 else "?"
+        method = parts[-1]
+        outer = self
+
+        def wrap_unary(fn):
+            def inner(request, context):
+                t0 = time.perf_counter()
+                code = "OK"
+                try:
+                    return fn(request, context)
+                except Exception:
+                    code = "INTERNAL"
+                    raise
+                finally:
+                    outer._observe(service, method, code,
+                                   time.perf_counter() - t0)
+            return inner
+
+        def wrap_stream(fn):
+            def inner(request, context):
+                t0 = time.perf_counter()
+                code = "OK"
+                try:
+                    yield from fn(request, context)
+                except Exception:
+                    code = "INTERNAL"
+                    raise
+                finally:
+                    outer._observe(service, method, code,
+                                   time.perf_counter() - t0)
+            return inner
+
+        if handler.unary_unary:
+            return grpc.unary_unary_rpc_method_handler(
+                wrap_unary(handler.unary_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        if handler.unary_stream:
+            return grpc.unary_stream_rpc_method_handler(
+                wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        if handler.stream_stream:
+            return grpc.stream_stream_rpc_method_handler(
+                wrap_stream(handler.stream_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        return handler
+
+    def _observe(self, service: str, method: str, code: str,
+                 dur: float) -> None:
+        self._log.debug("%s/%s completed code=%s in %.1fms", service,
+                        method, code, dur * 1e3)
+        if self._m_completed is not None:
+            self._m_completed.with_labels(
+                "service", service, "method", method,
+                "code", code).add(1)
+            self._m_duration.with_labels(
+                "service", service, "method", method).observe(dur)

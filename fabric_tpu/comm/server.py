@@ -35,6 +35,7 @@ class ServerConfig:
     client_root_cas: Optional[bytes] = None  # PEM bundle → mTLS required
     max_workers: int = 32
     max_message_mb: int = 100
+    metrics_provider: object = None       # enables RPC logging/metrics
 
 
 class GRPCServer:
@@ -46,9 +47,12 @@ class GRPCServer:
             ("grpc.max_receive_message_length",
              config.max_message_mb * 1024 * 1024),
         ]
+        from fabric_tpu.comm.interceptors import ServerObservability
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=config.max_workers),
-            options=opts)
+            options=opts,
+            interceptors=(ServerObservability(
+                config.metrics_provider),))
         if config.tls_cert:
             require_auth = config.client_root_cas is not None
             creds = grpc.ssl_server_credentials(

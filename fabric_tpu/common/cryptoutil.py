@@ -1,0 +1,66 @@
+"""Certificate expiration tracking + secure randomness helpers.
+
+Rebuild of `common/crypto/{expiration,random}.go`: nodes warn (via the
+logger, and again on a timer as the date approaches) when their
+enrollment/TLS certificates near expiry — operators get time to rotate
+instead of a dead node (`TrackExpiration` wired at
+`internal/peer/node/start.go:319`).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger("crypto.expiration")
+
+_WARN_AHEAD = datetime.timedelta(days=7 * 4)   # reference: 4 weeks
+
+
+def get_random_bytes(n: int) -> bytes:
+    return os.urandom(n)
+
+
+def expires_at(cert_pem: bytes) -> Optional[datetime.datetime]:
+    """Expiry of the FIRST certificate in a PEM blob (None if it does
+    not parse)."""
+    try:
+        from cryptography import x509
+        cert = x509.load_pem_x509_certificate(cert_pem)
+        return cert.not_valid_after_utc
+    except Exception:
+        return None
+
+
+def track_expiration(role: str, cert_pem: bytes,
+                     warn: Callable[[str], None] = logger.warning,
+                     now: Optional[datetime.datetime] = None,
+                     schedule: bool = True) -> Optional[threading.Timer]:
+    """Reference `TrackExpiration`: warn immediately if the cert is
+    expired or inside the warning window, else arm a timer that fires
+    when the window opens. Returns the armed timer (caller may cancel)."""
+    expiry = expires_at(cert_pem)
+    if expiry is None:
+        return None
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    if expiry <= now:
+        warn(f"the {role} certificate expired at {expiry.isoformat()}")
+        return None
+    until = expiry - now
+    if until <= _WARN_AHEAD:
+        warn(f"the {role} certificate expires within {until.days} days "
+             f"({expiry.isoformat()})")
+        return None
+    if not schedule:
+        return None
+    delay = (until - _WARN_AHEAD).total_seconds()
+    timer = threading.Timer(
+        delay, lambda: warn(
+            f"the {role} certificate will expire at "
+            f"{expiry.isoformat()}"))
+    timer.daemon = True
+    timer.start()
+    return timer

@@ -1,0 +1,40 @@
+"""Runtime diagnostics: thread dumps on signal.
+
+Rebuild of `common/diag/goroutine.go` (goroutine dumps on SIGUSR1,
+wired at `internal/peer/node/start.go:913`): SIGUSR1 logs every
+thread's stack — the first tool reached for a wedged node.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+import traceback
+
+logger = logging.getLogger("diag")
+
+
+def dump_threads(log=logger.warning) -> str:
+    """Render every live thread's stack; returns (and logs) the text."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    lines = []
+    for ident, frame in sys._current_frames().items():
+        lines.append(f"--- thread {names.get(ident, '?')} "
+                     f"({ident}) ---")
+        lines.extend(
+            line.rstrip()
+            for line in traceback.format_stack(frame))
+    text = "\n".join(lines)
+    log("thread dump:\n%s", text)
+    return text
+
+
+def capture_thread_dumps_on_signal(sig: int = signal.SIGUSR1) -> None:
+    """Install the dump handler (main thread only)."""
+    try:
+        signal.signal(sig, lambda _s, _f: dump_threads())
+        logger.info("thread dumps armed on signal %d", sig)
+    except ValueError:
+        logger.debug("not on the main thread; dump signal not armed")

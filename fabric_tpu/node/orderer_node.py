@@ -79,7 +79,16 @@ class OrdererNode:
         deliver = DeliverHandler(self.registrar.get_chain)
         participation = ChannelParticipation(self.registrar)
 
-        sc = ServerConfig(address=address)
+        from fabric_tpu.common import cryptoutil, diag
+        signcert_dir = os.path.join(msp_dir, "signcerts")
+        if os.path.isdir(signcert_dir):
+            for name in os.listdir(signcert_dir):
+                with open(os.path.join(signcert_dir, name), "rb") as f:
+                    cryptoutil.track_expiration("orderer enrollment",
+                                                f.read())
+        diag.capture_thread_dumps_on_signal()
+
+        sc = ServerConfig(address=address, metrics_provider=provider)
         tls_cert = cfg.get_path("General.TLS.Certificate")
         if cfg.get_bool("General.TLS.Enabled") and tls_cert:
             sc.tls_cert = open(tls_cert, "rb").read()

@@ -118,8 +118,19 @@ class PeerNode:
                     "peer.gossip.aliveExpirationTimeout", 1.5)))
         self.peer.gossip_service = self.gossip
 
+        # cert-expiration tracking + thread-dump diagnostics
+        # (reference start.go:319 TrackExpiration, :913 handleSignals)
+        from fabric_tpu.common import cryptoutil, diag
+        signcert_dir = os.path.join(msp_dir, "signcerts")
+        if os.path.isdir(signcert_dir):
+            for name in os.listdir(signcert_dir):
+                with open(os.path.join(signcert_dir, name), "rb") as f:
+                    cryptoutil.track_expiration("peer enrollment",
+                                                f.read())
+        diag.capture_thread_dumps_on_signal()
+
         # gRPC server
-        sc = ServerConfig(address=address)
+        sc = ServerConfig(address=address, metrics_provider=provider)
         tls_cert = cfg.get_path("peer.tls.cert.file")
         if cfg.get_bool("peer.tls.enabled") and tls_cert:
             sc.tls_cert = open(tls_cert, "rb").read()
