@@ -231,7 +231,11 @@ class Bundle:
             raise ConfigError(f"org {org_name!r} lacks MSP value")
         mc = msppb.MSPConfig()
         mc.ParseFromString(msp_value.config)
-        msp = X509MSP(self.csp)
+        if mc.type == 1:
+            from fabric_tpu.msp.idemix import IdemixMSP
+            msp = IdemixMSP(self.csp)
+        else:
+            msp = X509MSP(self.csp)
         msp.setup(mc)
         self._msps.append(CachedMSP(msp))
         self._mspid_by_org[org_name] = msp.identifier()

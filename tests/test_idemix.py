@@ -1,0 +1,230 @@
+"""Idemix MSP: anonymous pseudonym identities end to end.
+
+Reference behaviors (`msp/idemix.go`, `integration/idemix`): org-bound
+anonymous identities, verifier-side unlinkability, OU/role principal
+matching, and full-channel transactions signed by an idemix client
+while X.509 orgs endorse.
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.msp import msp as mapi
+from fabric_tpu.msp.idemix import (
+    IdemixIssuer, IdemixMSP, idemix_msp_config,
+)
+from fabric_tpu.msp.mspimpl import MSPError
+from fabric_tpu.protos import policies as polpb
+
+
+@pytest.fixture()
+def org():
+    csp = SWProvider()
+    issuer = IdemixIssuer(csp)
+    msp = IdemixMSP(csp)
+    msp.setup(idemix_msp_config("AnonMSP", issuer))
+    msp.add_credentials(issuer.issue("engineering",
+                                     mapi.MSPRole.MEMBER, count=4))
+    return {"csp": csp, "issuer": issuer, "msp": msp}
+
+
+class TestIdemixMSP:
+    def test_sign_verify_round_trip(self, org):
+        signer = org["msp"].get_default_signing_identity()
+        sig = signer.sign(b"hello")
+        ident = org["msp"].deserialize_identity(signer.serialize())
+        ident.validate()
+        assert ident.verify(b"hello", sig)
+        assert not ident.verify(b"tampered", sig)
+        assert ident.mspid() == "AnonMSP"
+
+    def test_unlinkability(self, org):
+        """Two transactions by the same member share NO identifying
+        bytes — a verifier cannot link them."""
+        a = org["msp"].get_default_signing_identity()
+        b = org["msp"].get_default_signing_identity()
+        assert a.credential.nym_pub != b.credential.nym_pub
+        assert a.serialize() != b.serialize()
+        # and neither serialization reveals an enrollment identity:
+        # only org + disclosed OU/role travel
+        assert b"engineering" in a.serialize()
+
+    def test_foreign_issuer_rejected(self, org):
+        evil = IdemixIssuer(org["csp"])
+        forged = evil.issue("engineering", mapi.MSPRole.MEMBER)[0]
+        msp = org["msp"]
+        fake = IdemixMSP(org["csp"])
+        fake.setup(idemix_msp_config("AnonMSP", evil))
+        fake.add_credentials([forged])
+        signer = fake.get_default_signing_identity()
+        ident = msp.deserialize_identity(signer.serialize())
+        with pytest.raises(MSPError, match="issuer"):
+            ident.validate()
+
+    def test_principal_matching(self, org):
+        signer = org["msp"].get_default_signing_identity()
+
+        def role_principal(role):
+            p = polpb.MSPPrincipal(
+                classification=polpb.MSPPrincipal.ROLE)
+            p.principal = polpb.MSPRole(
+                msp_identifier="AnonMSP",
+                role=role).SerializeToString()
+            return p
+
+        signer.satisfies_principal(role_principal(polpb.MSPRole.MEMBER))
+        with pytest.raises(MSPError):
+            signer.satisfies_principal(
+                role_principal(polpb.MSPRole.ADMIN))
+
+        ou = polpb.MSPPrincipal(
+            classification=polpb.MSPPrincipal.ORGANIZATION_UNIT)
+        ou.principal = polpb.OrganizationUnit(
+            msp_identifier="AnonMSP",
+            organizational_unit_identifier="engineering",
+        ).SerializeToString()
+        signer.satisfies_principal(ou)
+        bad_ou = polpb.MSPPrincipal(
+            classification=polpb.MSPPrincipal.ORGANIZATION_UNIT)
+        bad_ou.principal = polpb.OrganizationUnit(
+            msp_identifier="AnonMSP",
+            organizational_unit_identifier="marketing",
+        ).SerializeToString()
+        with pytest.raises(MSPError):
+            signer.satisfies_principal(bad_ou)
+
+    def test_credentials_are_single_use(self, org):
+        for _ in range(4):
+            org["msp"].get_default_signing_identity()
+        with pytest.raises(MSPError, match="no unused"):
+            org["msp"].get_default_signing_identity()
+
+
+# ---------------------------------------------------------------------------
+# Channel integration: idemix client transacts on an X.509 channel
+# ---------------------------------------------------------------------------
+
+from fabric_tpu.common.deliver import DeliverHandler       # noqa: E402
+from fabric_tpu.core.chaincode import (                    # noqa: E402
+    Chaincode, ChaincodeDefinition, shim,
+)
+from fabric_tpu.internal import cryptogen                  # noqa: E402
+from fabric_tpu.internal.configtxgen import (              # noqa: E402
+    genesis_block, new_channel_group,
+)
+from fabric_tpu.msp import msp_config_from_dir             # noqa: E402
+from fabric_tpu.msp.mspimpl import X509MSP                 # noqa: E402
+from fabric_tpu.orderer import solo                        # noqa: E402
+from fabric_tpu.orderer.broadcast import BroadcastHandler  # noqa: E402
+from fabric_tpu.orderer.multichannel import Registrar      # noqa: E402
+from fabric_tpu.peer import Peer                           # noqa: E402
+from fabric_tpu.peer.deliverclient import Deliverer        # noqa: E402
+from fabric_tpu.peer.gateway import Gateway                # noqa: E402
+from fabric_tpu.protos import transaction as txpb          # noqa: E402
+
+CHANNEL = "idemixchannel"
+
+
+class KV(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+        return shim.error("unknown")
+
+
+class TestIdemixOnChannel:
+    def test_idemix_client_submits_transactions(self, tmp_path):
+        root = tmp_path
+        cdir = str(root / "crypto")
+        org1 = cryptogen.generate_org(cdir, "org1.example.com",
+                                      n_peers=1, n_users=1)
+        ordo = cryptogen.generate_org(cdir, "example.com",
+                                      orderer_org=True)
+        csp = SWProvider()
+        issuer = IdemixIssuer(csp)
+        profile = {
+            "Consortium": "SampleConsortium",
+            "Capabilities": {"V2_0": True},
+            "Application": {
+                "Organizations": [
+                    {"Name": "Org1", "ID": "Org1MSP",
+                     "MSPDir": os.path.join(org1, "msp")},
+                    {"Name": "AnonOrg", "ID": "AnonMSP",
+                     "MSPConfig": idemix_msp_config("AnonMSP",
+                                                    issuer)},
+                ],
+                "Capabilities": {"V2_0": True},
+            },
+            "Orderer": {
+                "OrdererType": "solo",
+                "Addresses": ["orderer0.example.com:7050"],
+                "BatchTimeout": "100ms",
+                "BatchSize": {"MaxMessageCount": 10},
+                "Organizations": [
+                    {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                     "MSPDir": os.path.join(ordo, "msp"),
+                     "OrdererEndpoints":
+                         ["orderer0.example.com:7050"]}],
+                "Capabilities": {"V2_0": True},
+            },
+        }
+        genesis = genesis_block(CHANNEL, new_channel_group(profile))
+
+        def local_msp(d, mspid):
+            m = X509MSP(csp)
+            m.setup(msp_config_from_dir(d, mspid, csp=csp))
+            return m
+
+        omsp = local_msp(os.path.join(ordo, "orderers",
+                                      "orderer0.example.com", "msp"),
+                         "OrdererMSP")
+        reg = Registrar(str(root / "ord"),
+                        omsp.get_default_signing_identity(), csp,
+                        {"solo": solo.consenter})
+        reg.join(genesis)
+        bc = BroadcastHandler(reg)
+        dh = DeliverHandler(reg.get_chain)
+
+        pmsp = local_msp(os.path.join(org1, "peers",
+                                      "peer0.org1.example.com", "msp"),
+                         "Org1MSP")
+        peer = Peer(str(root / "peer"), pmsp, csp)
+        ch = peer.join_channel(genesis)
+        peer.chaincode_support.register("kv", KV())
+        # OR policy: the X.509 org endorses; the idemix org transacts
+        from fabric_tpu.common.policies.policydsl import from_string
+        ch.define_chaincode(ChaincodeDefinition(
+            name="kv",
+            endorsement_policy=polpb.ApplicationPolicy(
+                signature_policy=from_string("OR('Org1MSP.member')")
+            ).SerializeToString()))
+        d = Deliverer(ch, peer.signer, lambda: dh, peer.mcs)
+        d.start()
+        try:
+            anon_msp = IdemixMSP(csp)
+            anon_msp.setup(idemix_msp_config("AnonMSP", issuer))
+            anon_msp.add_credentials(issuer.issue(
+                "engineering", mapi.MSPRole.MEMBER, count=2))
+
+            # two transactions under two different pseudonyms
+            for i, key in enumerate((b"anon1", b"anon2")):
+                signer = anon_msp.get_default_signing_identity()
+                gw = Gateway(peer, bc, signer)
+                res = gw.submit_transaction(
+                    CHANNEL, "kv", [b"put", key, b"1"],
+                    endorsing_peers=[peer])
+                assert res.status == txpb.TxValidationCode.VALID, \
+                    txpb.TxValidationCode.Name(res.status)
+            assert ch.ledger.get_state("kv", "anon1") == b"1"
+            assert ch.ledger.get_state("kv", "anon2") == b"1"
+        finally:
+            d.stop()
+            reg.halt()
+            peer.close()
