@@ -105,6 +105,52 @@ def _issue(cn: str, org: str, ou: str, ca_cert, ca_key):
     return cert, key
 
 
+def _issue_tls(cn: str, org: str, ca_cert, ca_key,
+               sans: list[str] = ()):
+    """TLS server/client cert with SANs (gRPC verifies the hostname —
+    dev networks dial 127.0.0.1/localhost)."""
+    import ipaddress
+    key = ec.generate_private_key(ec.SECP256R1())
+    alt_names = [x509.DNSName(cn), x509.DNSName("localhost")]
+    alt_names.append(x509.IPAddress(
+        ipaddress.IPv4Address("127.0.0.1")))
+    for san in sans:
+        alt_names.append(x509.DNSName(san))
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(x509.Name([
+            x509.NameAttribute(NameOID.COMMON_NAME, cn),
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        ]))
+        .issuer_name(ca_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(_NOT_BEFORE).not_valid_after(_NOT_AFTER)
+        .add_extension(x509.BasicConstraints(ca=False,
+                                             path_length=None),
+                       critical=True)
+        .add_extension(x509.SubjectAlternativeName(alt_names),
+                       critical=False)
+        .add_extension(x509.ExtendedKeyUsage(
+            [x509.oid.ExtendedKeyUsageOID.SERVER_AUTH,
+             x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH]),
+            critical=False)
+        .sign(ca_key, hashes.SHA256())
+    )
+    return cert, key
+
+
+def _write_tls_dir(node_dir: str, cn: str, domain: str, tlsca_cert,
+                   tlsca_key) -> None:
+    """The reference layout: <node>/tls/{ca.crt,server.crt,server.key}."""
+    cert, key = _issue_tls(cn, domain, tlsca_cert, tlsca_key)
+    _write(os.path.join(node_dir, "tls", "ca.crt"),
+           _pem_cert(tlsca_cert))
+    _write(os.path.join(node_dir, "tls", "server.crt"),
+           _pem_cert(cert))
+    _write(os.path.join(node_dir, "tls", "server.key"), _pem_key(key))
+
+
 def _write_local_msp(msp_dir: str, ca_cert, cert, key) -> None:
     """A node/user MSP dir: its own cert + key + the org's CA."""
     _write(os.path.join(msp_dir, "cacerts", "ca-cert.pem"),
@@ -128,24 +174,36 @@ def generate_org(out_dir: str, domain: str, n_peers: int = 1,
            _pem_cert(ca_cert))
     _write(os.path.join(org_dir, "ca", "ca-key.pem"), _pem_key(ca_key))
 
+    # dedicated TLS CA (reference: cryptogen emits tlsca/ + per-node tls/)
+    tlsca_cert, tlsca_key = _make_ca(f"tlsca.{domain}", domain)
+    _write(os.path.join(org_dir, "tlsca", f"tlsca.{domain}-cert.pem"),
+           _pem_cert(tlsca_cert))
+
     # org-level (channel) MSP: verification material only
     _write(os.path.join(org_dir, "msp", "cacerts", "ca-cert.pem"),
            _pem_cert(ca_cert))
+    _write(os.path.join(org_dir, "msp", "tlscacerts",
+                        f"tlsca.{domain}-cert.pem"),
+           _pem_cert(tlsca_cert))
     _write(os.path.join(org_dir, "msp", "config.yaml"),
            _NODE_OU_CONFIG.encode())
 
     if orderer_org:
         for i in range(n_orderers):
             cn = f"orderer{i}.{domain}"
+            node_dir = os.path.join(org_dir, "orderers", cn)
             cert, key = _issue(cn, domain, "orderer", ca_cert, ca_key)
-            _write_local_msp(os.path.join(org_dir, "orderers", cn, "msp"),
+            _write_local_msp(os.path.join(node_dir, "msp"),
                              ca_cert, cert, key)
+            _write_tls_dir(node_dir, cn, domain, tlsca_cert, tlsca_key)
     else:
         for i in range(n_peers):
             cn = f"peer{i}.{domain}"
+            node_dir = os.path.join(org_dir, "peers", cn)
             cert, key = _issue(cn, domain, "peer", ca_cert, ca_key)
-            _write_local_msp(os.path.join(org_dir, "peers", cn, "msp"),
+            _write_local_msp(os.path.join(node_dir, "msp"),
                              ca_cert, cert, key)
+            _write_tls_dir(node_dir, cn, domain, tlsca_cert, tlsca_key)
 
     admin_cn = f"Admin@{domain}"
     cert, key = _issue(admin_cn, domain, "admin", ca_cert, ca_key)

@@ -1,0 +1,91 @@
+"""TLS on the gRPC surface: cryptogen TLS material + secure channels.
+
+Reference: cryptogen's tlsca/ + per-node tls/ output and
+`internal/pkg/comm` SecureOptions — a peer serving with its TLS server
+cert, clients verifying against the org's TLS CA.
+"""
+
+import os
+
+import grpc
+import pytest
+
+from fabric_tpu.comm.server import GRPCServer, ServerConfig, UNARY_UNARY
+from fabric_tpu.comm.clients import _uu, channel_to
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.protos import gossip as gpb
+
+
+@pytest.fixture(scope="module")
+def tls_org(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tls"))
+    org = cryptogen.generate_org(root, "org1.example.com", n_peers=1)
+    node = os.path.join(org, "peers", "peer0.org1.example.com")
+    return {
+        "ca": open(os.path.join(org, "tlsca",
+                                "tlsca.org1.example.com-cert.pem"),
+                   "rb").read(),
+        "cert": open(os.path.join(node, "tls", "server.crt"),
+                     "rb").read(),
+        "key": open(os.path.join(node, "tls", "server.key"),
+                    "rb").read(),
+    }
+
+
+def _tls_server(tls_org, client_cas=None) -> GRPCServer:
+    server = GRPCServer(ServerConfig(
+        address="localhost:0", tls_cert=tls_org["cert"],
+        tls_key=tls_org["key"], client_root_cas=client_cas))
+    server.add_service("ftpu.Test", {
+        "Ping": (UNARY_UNARY, lambda req, ctx: gpb.Empty(),
+                 gpb.Empty, gpb.Empty)})
+    server.start()
+    return server
+
+
+class TestTLS:
+    def test_material_layout(self, tls_org):
+        assert b"BEGIN CERTIFICATE" in tls_org["ca"]
+        assert b"BEGIN CERTIFICATE" in tls_org["cert"]
+        assert b"BEGIN PRIVATE KEY" in tls_org["key"]
+
+    def test_tls_round_trip(self, tls_org):
+        server = _tls_server(tls_org)
+        try:
+            ch = channel_to(server.address, tls_root_ca=tls_org["ca"])
+            call = _uu(ch, "ftpu.Test", "Ping", gpb.Empty, gpb.Empty)
+            assert call(gpb.Empty(), timeout=10) is not None
+        finally:
+            server.stop()
+
+    def test_untrusted_root_rejected(self, tls_org, tmp_path):
+        other = cryptogen.generate_org(str(tmp_path),
+                                       "evil.example.com", n_peers=1)
+        wrong_ca = open(os.path.join(
+            other, "tlsca", "tlsca.evil.example.com-cert.pem"),
+            "rb").read()
+        server = _tls_server(tls_org)
+        try:
+            ch = channel_to(server.address, tls_root_ca=wrong_ca)
+            call = _uu(ch, "ftpu.Test", "Ping", gpb.Empty, gpb.Empty)
+            with pytest.raises(grpc.RpcError):
+                call(gpb.Empty(), timeout=5)
+        finally:
+            server.stop()
+
+    def test_mutual_tls_requires_client_cert(self, tls_org):
+        """mTLS: a server demanding client certs rejects bare-TLS
+        clients and accepts ones presenting a cert from the org CA."""
+        server = _tls_server(tls_org, client_cas=tls_org["ca"])
+        try:
+            ch = channel_to(server.address, tls_root_ca=tls_org["ca"])
+            call = _uu(ch, "ftpu.Test", "Ping", gpb.Empty, gpb.Empty)
+            with pytest.raises(grpc.RpcError):
+                call(gpb.Empty(), timeout=5)
+            ch = channel_to(server.address, tls_root_ca=tls_org["ca"],
+                            client_cert=tls_org["cert"],
+                            client_key=tls_org["key"])
+            call = _uu(ch, "ftpu.Test", "Ping", gpb.Empty, gpb.Empty)
+            assert call(gpb.Empty(), timeout=10) is not None
+        finally:
+            server.stop()
