@@ -3,10 +3,9 @@
 Rebuild of `core/aclmgmt/` (`NewACLProvider`, resource names in
 `core/aclmgmt/resources/resources.go`): each named peer resource maps
 to a channel policy path; `check_acl` evaluates the caller's signed
-data against it. Channel config may override per-resource policies via
-the ACLs config value (not yet wired; defaults below mirror the
-reference's `defaultACLProvider`).
-"""
+data against it. The channel config's ACLs value overrides
+per-resource policies (reference: configBasedACLProvider falling back
+to defaultACLProvider)."""
 
 from __future__ import annotations
 
@@ -59,17 +58,27 @@ class ACLProvider:
         if overrides:
             self._map.update(overrides)
 
-    def policy_for(self, resource: str) -> str:
-        path = self._map.get(resource)
+    def policy_for(self, resource: str,
+                   channel_acls: dict | None = None) -> str:
+        """Channel-config ACL overrides win; short names resolve
+        under /Channel/Application (reference semantics)."""
+        path = None
+        if channel_acls:
+            path = channel_acls.get(resource)
+        if path is None:
+            path = self._map.get(resource)
         if path is None:
             raise ACLError(f"unknown resource {resource!r}")
+        if not path.startswith("/"):
+            path = f"/Channel/Application/{path}"
         return path
 
     def check_acl(self, resource: str, policy_manager,
-                  signed_data) -> None:
+                  signed_data, channel_acls: dict | None = None
+                  ) -> None:
         """Raise ACLError unless `signed_data` satisfies the policy
         mapped to `resource` (reference: aclmgmt CheckACL)."""
-        path = self.policy_for(resource)
+        path = self.policy_for(resource, channel_acls)
         try:
             policy = policy_manager.get_policy(path)
         except papi.PolicyError as e:

@@ -33,6 +33,7 @@ class ChannelSupport:
     deserializer: object    # msp manager for the channel
     transient_store: object = None  # TransientStore (pvt distribution)
     pvt_distributor: object = None  # gossip push to collection members
+    acls: dict = None               # channel-config ACL overrides
 
 
 def _error_response(status: int, message: str) -> pb.ProposalResponse:
@@ -79,7 +80,8 @@ class Endorser:
                             signature=sp.signature)]
         try:
             self._acl.check_acl(aclmgmt.PROPOSE,
-                                support.policy_manager, sd)
+                                support.policy_manager, sd,
+                                channel_acls=support.acls)
         except aclmgmt.ACLError as e:
             return _error_response(500, str(e))
 

@@ -303,7 +303,9 @@ class Peer:
             policy_manager=bundle.policy_manager,
             deserializer=bundle.msp_manager,
             transient_store=self.transient_store,
-            pvt_distributor=distributor)
+            pvt_distributor=distributor,
+            acls=(bundle.application.acls
+                  if bundle.application else None))
 
     # -- channel lifecycle (reference: cscc JoinChain →
     #    peer.CreateChannel, core/peer/channel.go) --

@@ -1,0 +1,62 @@
+"""ACL mapping + channel-config overrides (core/aclmgmt)."""
+
+import pytest
+
+from fabric_tpu.common.policies import policy as papi
+from fabric_tpu.core import aclmgmt
+
+
+class _Policy:
+    def __init__(self, allow: bool):
+        self._allow = allow
+
+    def evaluate_signed_data(self, sd):
+        if not self._allow:
+            raise papi.PolicyError("denied")
+
+
+class _Manager:
+    def __init__(self, policies):
+        self._policies = policies
+
+    def get_policy(self, path):
+        if path not in self._policies:
+            raise papi.PolicyError(f"no policy {path}")
+        return self._policies[path]
+
+
+class TestACL:
+    def test_defaults_map_to_channel_policies(self):
+        acl = aclmgmt.ACLProvider()
+        assert acl.policy_for(aclmgmt.PROPOSE) == \
+            "/Channel/Application/Writers"
+        assert acl.policy_for(aclmgmt.QSCC_GET_CHAIN_INFO) == \
+            "/Channel/Application/Readers"
+        with pytest.raises(aclmgmt.ACLError):
+            acl.policy_for("peer/NoSuchResource")
+
+    def test_check_acl_enforces(self):
+        acl = aclmgmt.ACLProvider()
+        mgr = _Manager({"/Channel/Application/Writers": _Policy(False)})
+        with pytest.raises(aclmgmt.ACLError, match="denied"):
+            acl.check_acl(aclmgmt.PROPOSE, mgr, [])
+        mgr = _Manager({"/Channel/Application/Writers": _Policy(True)})
+        acl.check_acl(aclmgmt.PROPOSE, mgr, [])
+
+    def test_channel_config_override(self):
+        """The channel ACLs value rebinds a resource to a custom
+        policy; short names resolve under /Channel/Application."""
+        acl = aclmgmt.ACLProvider()
+        mgr = _Manager({
+            "/Channel/Application/Writers": _Policy(True),
+            "/Channel/Application/StrictPolicy": _Policy(False),
+        })
+        overrides = {aclmgmt.PROPOSE: "StrictPolicy"}
+        acl.check_acl(aclmgmt.PROPOSE, mgr, [])  # default passes
+        with pytest.raises(aclmgmt.ACLError):
+            acl.check_acl(aclmgmt.PROPOSE, mgr, [],
+                          channel_acls=overrides)
+        # absolute override paths pass through untouched
+        assert acl.policy_for(
+            aclmgmt.PROPOSE,
+            {aclmgmt.PROPOSE: "/Channel/Admins"}) == "/Channel/Admins"
