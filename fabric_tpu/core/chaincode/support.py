@@ -42,6 +42,8 @@ class ChaincodeDefinition:
     endorsement_policy: bytes = b""   # marshaled ApplicationPolicy; empty = channel default
     init_required: bool = False
     collections: tuple = ()           # CollectionConfig, ordered
+    endorsement_plugin: str = "escc"  # core/handlers registry name
+    validation_plugin: str = "vscc"
 
     def collection(self, name: str):
         for c in self.collections:

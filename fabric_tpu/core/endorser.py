@@ -34,6 +34,7 @@ class ChannelSupport:
     transient_store: object = None  # TransientStore (pvt distribution)
     pvt_distributor: object = None  # gossip push to collection members
     acls: dict = None               # channel-config ACL overrides
+    cc_definition: object = None    # fn(name) -> ChaincodeDefinition
 
 
 def _error_response(status: int, message: str) -> pb.ProposalResponse:
@@ -112,6 +113,20 @@ class Endorser:
         results = pu.marshal(sim.get_tx_simulation_results())
         events = pu.marshal(event) if event is not None else b""
 
+        # resolve the endorsement plugin from the chaincode definition
+        # (reference: plugin_endorser.go; "escc" is the default)
+        from fabric_tpu.core import handlers
+        plugin_name = handlers.DEFAULT_ENDORSEMENT
+        if support.cc_definition is not None:
+            definition = support.cc_definition(cc_id.name)
+            if definition is not None and \
+                    getattr(definition, "endorsement_plugin", None):
+                plugin_name = definition.endorsement_plugin
+        try:
+            plugin = handlers.endorsement_plugins.get(plugin_name)
+        except handlers.PluginError as e:
+            return _error_response(500, str(e))
+
         # private writes: the cleartext NEVER enters the proposal
         # response — it is parked in the transient store (and, with
         # gossip, pushed to authorized peers) until commit
@@ -133,7 +148,6 @@ class Endorser:
                     logger.exception("private data distribution failed "
                                      "for [%s]", up.tx_id)
 
-        # -- endorse (default plugin, inlined) --
-        return txutils.create_proposal_response(
-            sp.proposal_bytes, results, events, resp, cc_id,
-            self._signer)
+        # -- endorse via the resolved plugin --
+        return plugin(sp.proposal_bytes, results, events, resp, cc_id,
+                      self._signer)

@@ -1,0 +1,75 @@
+"""Pluggable endorsement + validation handlers.
+
+Rebuild of `core/handlers/{endorsement,validation}` + the plugin
+dispatcher (`core/committer/txvalidator/v20/plugindispatcher`): a
+chaincode definition names its endorsement plugin (default "escc") and
+validation plugin (default "vscc"); registries resolve them. The
+defaults reproduce the built-in behaviors (sign prpBytes‖identity /
+batched endorsement-policy evaluation); operators register custom
+plugins under new names — nothing above the registry knows which ran.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+DEFAULT_ENDORSEMENT = "escc"
+DEFAULT_VALIDATION = "vscc"
+
+
+class PluginError(Exception):
+    pass
+
+
+class _Registry:
+    def __init__(self, kind: str):
+        self._kind = kind
+        self._lock = threading.Lock()
+        self._plugins: dict[str, Callable] = {}
+
+    def register(self, name: str, plugin: Callable) -> None:
+        with self._lock:
+            self._plugins[name] = plugin
+
+    def get(self, name: str) -> Callable:
+        with self._lock:
+            plugin = self._plugins.get(name)
+        if plugin is None:
+            raise PluginError(
+                f"no {self._kind} plugin named {name!r}")
+        return plugin
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._plugins)
+
+
+# endorsement plugin: fn(proposal_bytes, results, events, response,
+#   cc_id, signer) -> ProposalResponse
+endorsement_plugins = _Registry("endorsement")
+
+# validation plugin: fn(validator, bundle, cc_name, endorsement_sd,
+#   write_info) -> prepared (two-phase: .items + .finish(flags))
+validation_plugins = _Registry("validation")
+
+
+def _default_endorsement(proposal_bytes, results, events, response,
+                         cc_id, signer):
+    """Reference: default_endorsement.go:35-53 — sign prpBytes‖identity
+    with the peer's signing identity."""
+    from fabric_tpu.protoutil import txutils
+    return txutils.create_proposal_response(
+        proposal_bytes, results, events, response, cc_id, signer)
+
+
+def _default_validation(validator, bundle, cc_name, endorsement_sd,
+                        write_info):
+    """Reference: builtin/v20 VSCC — endorsement-policy evaluation
+    (batched here) with collection-level rules."""
+    return validator.builtin_vscc_prepare(bundle, cc_name,
+                                          endorsement_sd, write_info)
+
+
+endorsement_plugins.register(DEFAULT_ENDORSEMENT, _default_endorsement)
+validation_plugins.register(DEFAULT_VALIDATION, _default_validation)

@@ -51,6 +51,9 @@ def canonical_definition(payload: dict) -> bytes:
         "endorsement_policy": payload.get("endorsement_policy", ""),
         "init_required": bool(payload.get("init_required", False)),
         "collections": payload.get("collections", []),
+        "endorsement_plugin": payload.get("endorsement_plugin",
+                                          "escc"),
+        "validation_plugin": payload.get("validation_plugin", "vscc"),
     }
     return json.dumps(fields, sort_keys=True,
                       separators=(",", ":")).encode()
@@ -64,6 +67,8 @@ def definition_from_state(raw: bytes) -> ChaincodeDefinition:
         endorsement_policy=bytes.fromhex(
             d.get("endorsement_policy", "")),
         init_required=bool(d.get("init_required", False)),
+        endorsement_plugin=d.get("endorsement_plugin", "escc"),
+        validation_plugin=d.get("validation_plugin", "vscc"),
         collections=tuple(
             CollectionConfig(
                 name=c["name"],

@@ -147,6 +147,20 @@ class TxValidator:
 
     def _prepare_validation(self, bundle, cc_name: str,
                             endorsement_sd, write_info):
+        """Dispatch to the chaincode's validation plugin (reference:
+        plugindispatcher.Dispatch); the built-in "vscc" is the default."""
+        from fabric_tpu.core import handlers
+        definition = self._cc_definition(cc_name)
+        name = (definition.validation_plugin
+                if definition is not None and
+                getattr(definition, "validation_plugin", None)
+                else handlers.DEFAULT_VALIDATION)
+        plugin = handlers.validation_plugins.get(name)
+        return plugin(self, bundle, cc_name, endorsement_sd,
+                      write_info)
+
+    def builtin_vscc_prepare(self, bundle, cc_name: str,
+                             endorsement_sd, write_info):
         """Compose the tx's validation policy from the chaincode policy
         and implicit-collection write rules: a tx writing ONLY its own
         org's implicit collection (a _lifecycle approval) validates

@@ -305,7 +305,8 @@ class Peer:
             transient_store=self.transient_store,
             pvt_distributor=distributor,
             acls=(bundle.application.acls
-                  if bundle.application else None))
+                  if bundle.application else None),
+            cc_definition=channel.chaincode_definition)
 
     # -- channel lifecycle (reference: cscc JoinChain →
     #    peer.CreateChannel, core/peer/channel.go) --

@@ -60,3 +60,100 @@ class TestACL:
         assert acl.policy_for(
             aclmgmt.PROPOSE,
             {aclmgmt.PROPOSE: "/Channel/Admins"}) == "/Channel/Admins"
+
+
+class TestHandlerPlugins:
+    """core/handlers plugin registries (endorsement + validation)."""
+
+    def test_defaults_registered(self):
+        from fabric_tpu.core import handlers
+        assert "escc" in handlers.endorsement_plugins.names()
+        assert "vscc" in handlers.validation_plugins.names()
+        with pytest.raises(handlers.PluginError):
+            handlers.endorsement_plugins.get("nope")
+
+    def test_custom_endorsement_plugin_runs(self):
+        """A definition naming a custom plugin routes endorsement
+        through it (marker injected into the response message)."""
+        from fabric_tpu.core import handlers
+        from fabric_tpu.protoutil import txutils
+
+        calls = []
+
+        def marker_plugin(proposal_bytes, results, events, response,
+                          cc_id, signer):
+            calls.append(cc_id.name)
+            return txutils.create_proposal_response(
+                proposal_bytes, results, events, response, cc_id,
+                signer)
+
+        handlers.endorsement_plugins.register("marker", marker_plugin)
+        try:
+            import os
+            from fabric_tpu.bccsp.sw import SWProvider
+            from fabric_tpu.core.chaincode import (
+                Chaincode, ChaincodeDefinition, shim,
+            )
+            from fabric_tpu.internal import cryptogen
+            from fabric_tpu.internal.configtxgen import (
+                genesis_block, new_channel_group,
+            )
+            from fabric_tpu.msp import msp_config_from_dir
+            from fabric_tpu.msp.mspimpl import X509MSP
+            from fabric_tpu.peer import Peer
+
+            class CC(Chaincode):
+                def init(self, stub):
+                    return shim.success()
+
+                def invoke(self, stub):
+                    stub.put_state("k", b"v")
+                    return shim.success()
+
+            import tempfile
+            root = tempfile.mkdtemp()
+            org = cryptogen.generate_org(root, "o.example.com",
+                                         n_peers=1, n_users=1)
+            ordo = cryptogen.generate_org(root, "example.com",
+                                          orderer_org=True)
+            genesis = genesis_block("ch", new_channel_group({
+                "Consortium": "C",
+                "Capabilities": {"V2_0": True},
+                "Application": {
+                    "Organizations": [{"Name": "O", "ID": "OMSP",
+                                       "MSPDir": os.path.join(org,
+                                                              "msp")}],
+                    "Capabilities": {"V2_0": True}},
+                "Orderer": {
+                    "OrdererType": "solo",
+                    "Organizations": [
+                        {"Name": "Ord", "ID": "OrdMSP",
+                         "MSPDir": os.path.join(ordo, "msp")}],
+                    "Capabilities": {"V2_0": True}},
+            }))
+            csp = SWProvider()
+            msp = X509MSP(csp)
+            msp.setup(msp_config_from_dir(
+                os.path.join(org, "peers", "peer0.o.example.com",
+                             "msp"), "OMSP", csp=csp))
+            peer = Peer(os.path.join(root, "p"), msp, csp)
+            ch = peer.join_channel(genesis)
+            peer.chaincode_support.register("cc", CC())
+            ch.define_chaincode(ChaincodeDefinition(
+                name="cc", endorsement_plugin="marker"))
+
+            user = X509MSP(csp)
+            user.setup(msp_config_from_dir(
+                os.path.join(org, "users", "User1@o.example.com",
+                             "msp"), "OMSP", csp=csp))
+            from fabric_tpu.protoutil import txutils as tx
+            signer = user.get_default_signing_identity()
+            prop, _ = tx.create_proposal("ch", "cc", [b"go"],
+                                         signer.serialize())
+            sp = tx.sign_proposal(prop, signer)
+            resp = peer.endorser.process_proposal(sp)
+            assert resp.response.status == 200, resp.response.message
+            assert calls == ["cc"]
+            peer.close()
+        finally:
+            pass
