@@ -106,6 +106,10 @@ class GatewayClient:
         self._status = _uu(channel, svc.GATEWAY_SERVICE, "CommitStatus",
                            gwpb.SignedCommitStatusRequest,
                            gwpb.CommitStatusResponse)
+        self._events = _us(channel, svc.GATEWAY_SERVICE,
+                           "ChaincodeEvents",
+                           gwpb.SignedChaincodeEventsRequest,
+                           gwpb.ChaincodeEventsResponse)
 
     def _proposal(self, channel_id: str, cc_name: str,
                   args: Sequence[bytes], transient=None):
@@ -152,6 +156,20 @@ class GatewayClient:
             request=inner.SerializeToString())
         code = self._status(creq, timeout=timeout_s).result
         return tx_id, code
+
+    def chaincode_events(self, channel_id: str, cc_name: str,
+                         from_genesis: bool = False,
+                         start_block: int = 0, timeout_s: float = 30.0):
+        """Stream committed chaincode events (reference: the client
+        SDK's ChaincodeEvents). Yields ChaincodeEventsResponse."""
+        inner = gwpb.ChaincodeEventsRequest(
+            channel_id=channel_id, chaincode_id=cc_name,
+            identity=self._signer.serialize(),
+            start_block=start_block, from_genesis=from_genesis)
+        req = gwpb.SignedChaincodeEventsRequest(
+            request=inner.SerializeToString(),
+            signature=self._signer.sign(inner.SerializeToString()))
+        yield from self._events(req, timeout=timeout_s)
 
 
 class DiscoveryClient:

@@ -84,7 +84,27 @@ def register_gateway(server: GRPCServer, gateway) -> None:
         return gwpb.CommitStatusResponse(
             result=code, block_number=0)
 
+    def chaincode_events(req: gwpb.SignedChaincodeEventsRequest, ctx):
+        inner = gwpb.ChaincodeEventsRequest()
+        inner.ParseFromString(req.request)
+        start = None
+        if inner.from_genesis:
+            start = 0
+        elif inner.start_block:
+            start = inner.start_block
+        for num, events in gateway.chaincode_events(
+                inner.channel_id, inner.chaincode_id,
+                start_block=start):
+            resp = gwpb.ChaincodeEventsResponse(block_number=num)
+            for e in events:
+                resp.events.add().CopyFrom(e)
+            if resp.events:
+                yield resp
+
     server.add_service(GATEWAY_SERVICE, {
+        "ChaincodeEvents": (UNARY_STREAM, chaincode_events,
+                            gwpb.SignedChaincodeEventsRequest,
+                            gwpb.ChaincodeEventsResponse),
         "Evaluate": (UNARY_UNARY, evaluate,
                      gwpb.EvaluateRequest, gwpb.EvaluateResponse),
         "Endorse": (UNARY_UNARY, endorse,

@@ -137,7 +137,32 @@ class GossipService:
         privdata.start()
         res.election.start()
         self._channels[channel_id] = res
+        self._probe_anchor_peers(peer_channel)
         return res
+
+    def _probe_anchor_peers(self, peer_channel) -> None:
+        """Anchor peers from the channel config seed CROSS-ORG
+        connectivity (reference: gossip joins via anchors in the
+        channel's org groups)."""
+        try:
+            bundle = peer_channel.bundle()
+            if bundle.application is None:
+                return
+            anchors = [f"{host}:{port}"
+                       for org in bundle.application.orgs.values()
+                       for host, port in org.anchor_peers]
+        except Exception:
+            logger.exception("anchor-peer probe failed")
+            return
+        disc = self.node.discovery
+        for endpoint in anchors:
+            if endpoint != self.node.endpoint:
+                disc._send(endpoint, disc._membership_request())
+                # keep knocking from the isolated-node reconnect loop
+                boot = getattr(disc, "_bootstrap", [])
+                if endpoint not in boot:
+                    boot.append(endpoint)
+                    disc._bootstrap = boot
 
     def distribute_private_data(self, channel_id: str, tx_id: str,
                                 height: int, pvt_results) -> None:

@@ -23,6 +23,38 @@ class GatewayError(Exception):
     pass
 
 
+def _block_events(block, cc_name: str):
+    """Extract VALID txs' chaincode events for one chaincode
+    (reference: gateway/commit event extraction)."""
+    from fabric_tpu.protos import gateway as gwpb
+    from fabric_tpu.protos import transaction as txpb
+    filt = b""
+    if len(block.metadata.metadata) > \
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER:
+        filt = bytes(block.metadata.metadata[
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER])
+    out = []
+    for i, env_bytes in enumerate(block.data.data):
+        if i < len(filt) and filt[i] != txpb.TxValidationCode.VALID:
+            continue
+        try:
+            action = txutils.get_action_from_envelope(env_bytes)
+            if not action.events:
+                continue
+            event = pb.ChaincodeEvent()
+            event.ParseFromString(action.events)
+        except Exception:
+            continue
+        if cc_name and event.chaincode_id != cc_name:
+            continue
+        env = pu.unmarshal_envelope(env_bytes)
+        ch = pu.get_channel_header(pu.get_payload(env))
+        out.append(gwpb.ChaincodeEventRecord(
+            chaincode_id=event.chaincode_id, tx_id=ch.tx_id,
+            event_name=event.event_name, payload=event.payload))
+    return out
+
+
 def _chaincode_of(sp) -> str:
     """Chaincode name targeted by a signed proposal."""
     prop = pb.Proposal()
@@ -189,6 +221,35 @@ class Gateway:
                     f"timed out waiting for commit of {tx_id}")
             channel.wait_for_height(channel.ledger.height + 1,
                                     min(remaining, 0.5))
+
+    # -- ChaincodeEvents (api.go:508): stream committed events --
+
+    def chaincode_events(self, channel_id: str, cc_name: str,
+                         start_block: Optional[int] = None,
+                         stop=None):
+        """Yield (block_number, [ChaincodeEventRecord]) per committed
+        block from `start_block` (None = next block), following the
+        chain live. Only VALID txs' events are delivered (reference
+        behavior). `stop`: optional threading.Event ending the stream."""
+        from fabric_tpu.protos import gateway as gwpb
+        channel = self._peer.channel(channel_id)
+        if channel is None:
+            raise GatewayError(f"unknown channel {channel_id}")
+        num = channel.ledger.height if start_block is None \
+            else start_block
+        while stop is None or not stop.is_set():
+            if not channel.wait_for_height(num + 1, timeout=0.5):
+                if stop is not None:
+                    continue
+                if channel.ledger.height <= num:
+                    continue
+            block = channel.get_block(num)
+            if block is None:
+                num += 1
+                continue
+            events = _block_events(block, cc_name)
+            yield num, events
+            num += 1
 
     # -- convenience: the full endorse→submit→wait round trip --
 

@@ -274,3 +274,39 @@ class TestEndToEnd:
         assert len(responses) == 1
         from fabric_tpu.protos import common
         assert responses[0].status == common.Status.FORBIDDEN
+
+
+class TestChaincodeEvents:
+    def test_event_stream_replays_and_tails(self, network):
+        """Gateway ChaincodeEvents: replay from genesis catches the
+        `put` events committed by earlier tests, and a live submit
+        shows up in the tail (reference api.go:508)."""
+        import threading
+        gw = network["gateway"]
+        stop = threading.Event()
+        seen = []
+        stream = gw.chaincode_events(CHANNEL, "basic", start_block=0,
+                                     stop=stop)
+        # drain history until we see at least one committed put event
+        for num, events in stream:
+            seen.extend(events)
+            if any(e.event_name == "put" for e in seen):
+                break
+        assert any(e.event_name == "put" and e.chaincode_id == "basic"
+                   for e in seen)
+        # live tail: submit and expect the new event
+        def tail():
+            for _num, events in gw.chaincode_events(
+                    CHANNEL, "basic", stop=stop):
+                seen.extend(events)
+                if any(e.payload == b"evtkey" for e in events):
+                    stop.set()
+                    return
+        t = threading.Thread(target=tail, daemon=True)
+        t.start()
+        gw.submit_transaction(CHANNEL, "basic",
+                              [b"put", b"evtkey", b"1"],
+                              endorsing_peers=_both_peers(network))
+        t.join(timeout=15)
+        stop.set()
+        assert any(e.payload == b"evtkey" for e in seen)
