@@ -129,6 +129,62 @@ def _gateway_client(args):
     return GatewayClient(channel_to(args.gateway), signer)
 
 
+def _lifecycle_payload(args) -> bytes:
+    payload = {"name": args.name, "version": args.version,
+               "sequence": args.sequence}
+    if args.signature_policy:
+        from fabric_tpu.common.policies.policydsl import from_string
+        from fabric_tpu.protos import policies as polpb
+        app = polpb.ApplicationPolicy(
+            signature_policy=from_string(args.signature_policy))
+        payload["endorsement_policy"] = app.SerializeToString().hex()
+    if args.collections_config:
+        with open(args.collections_config) as f:
+            payload["collections"] = json.load(f)
+    return json.dumps(payload).encode()
+
+
+def _lifecycle_call(args, fn_name: bytes, arg: bytes,
+                    submit: bool) -> int:
+    client = _gateway_client(args)
+    if submit:
+        tx_id, code = client.submit_transaction(
+            args.channel, "_lifecycle", [fn_name, arg])
+        from fabric_tpu.protos import transaction as txpb
+        name = txpb.TxValidationCode.Name(code)
+        print(json.dumps({"tx_id": tx_id, "status": name}))
+        return 0 if code == txpb.TxValidationCode.VALID else 1
+    resp = client.evaluate(args.channel, "_lifecycle", [fn_name, arg])
+    if resp.status == 200:
+        print(resp.payload.decode())
+        return 0
+    print(json.dumps({"status": resp.status,
+                      "message": resp.message}), file=sys.stderr)
+    return 1
+
+
+def cmd_lc_approve(args) -> int:
+    return _lifecycle_call(args,
+                           b"ApproveChaincodeDefinitionForMyOrg",
+                           _lifecycle_payload(args), submit=True)
+
+
+def cmd_lc_readiness(args) -> int:
+    return _lifecycle_call(args, b"CheckCommitReadiness",
+                           _lifecycle_payload(args), submit=False)
+
+
+def cmd_lc_commit(args) -> int:
+    return _lifecycle_call(args, b"CommitChaincodeDefinition",
+                           _lifecycle_payload(args), submit=True)
+
+
+def cmd_lc_query(args) -> int:
+    return _lifecycle_call(args, b"QueryChaincodeDefinition",
+                           json.dumps({"name": args.name}).encode(),
+                           submit=False)
+
+
 def cmd_chaincode_invoke(args) -> int:
     client = _gateway_client(args)
     transient = json.loads(args.transient) if args.transient else None
@@ -202,6 +258,28 @@ def main(argv=None) -> int:
     lst = chan.add_parser("list")
     lst.add_argument("--ops", required=True)
     lst.set_defaults(fn=cmd_channel_list)
+
+    lc = sub.add_parser("lifecycle").add_subparsers(dest="sub",
+                                                    required=True)
+    lcc = lc.add_parser("chaincode").add_subparsers(dest="verb",
+                                                    required=True)
+    for verb, fn in (("approveformyorg", cmd_lc_approve),
+                     ("checkcommitreadiness", cmd_lc_readiness),
+                     ("commit", cmd_lc_commit),
+                     ("querycommitted", cmd_lc_query)):
+        vp = lcc.add_parser(verb)
+        vp.add_argument("--gateway", required=True)
+        vp.add_argument("--msp-dir", required=True)
+        vp.add_argument("--msp-id", required=True)
+        vp.add_argument("-C", "--channel", required=True)
+        vp.add_argument("--name", required=True)
+        if verb != "querycommitted":
+            vp.add_argument("--version", default="1.0")
+            vp.add_argument("--sequence", type=int, default=1)
+            vp.add_argument("--signature-policy", default="")
+            vp.add_argument("--collections-config", default="",
+                            help="JSON file of collection configs")
+        vp.set_defaults(fn=fn)
 
     cc = sub.add_parser("chaincode").add_subparsers(dest="sub",
                                                     required=True)

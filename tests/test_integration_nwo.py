@@ -82,6 +82,35 @@ class TestNwoEndToEnd:
             body = resp.read().decode()
         assert "ledger_blockchain_height" in body
 
+    def test_lifecycle_cli_governs_endorsement_policy(self, network):
+        """peer lifecycle chaincode approveformyorg/commit via the
+        CLI: the committed OR policy lets a single org endorse."""
+        def lc(org, verb, *extra):
+            gport = network.peer_ports[(org, 0)][0]
+            return network._run_cli(
+                "fabric_tpu.cmd.peer", "lifecycle", "chaincode", verb,
+                "--gateway", f"127.0.0.1:{gport}",
+                *network.peer_cli_identity(org),
+                "-C", network.channel, "--name", "assetcc", *extra)
+
+        policy = ["--signature-policy",
+                  "OR('Org1MSP.member', 'Org2MSP.member')"]
+        for org in ("org1", "org2"):
+            out = lc(org, "approveformyorg", *policy)
+            assert json.loads(out)["status"] == "VALID", out
+        ready = json.loads(lc("org1", "checkcommitreadiness",
+                              *policy))
+        assert ready["approvals"] == {"Org1MSP": True,
+                                      "Org2MSP": True}
+        out = lc("org1", "commit", *policy)
+        assert json.loads(out)["status"] == "VALID", out
+        committed = json.loads(lc("org1", "querycommitted"))
+        assert committed["sequence"] == 1
+        # the committed OR policy is live: a single-org endorsement
+        # commits VALID (the default MAJORITY would reject it)
+        out = network.invoke("org2", 0, "put", "lc-governed", "1")
+        assert json.loads(out)["status"] == "VALID"
+
     def test_orderer_crash_failover(self, network):
         """Kill one orderer (possibly the raft leader): the network
         keeps ordering."""
