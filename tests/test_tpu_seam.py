@@ -1,0 +1,178 @@
+"""The north-star seam: BCCSP.Default: TPU drives block validation
+through the batched device pipeline.
+
+Reference shape: the `pkcs11` provider's containment — no layer above
+the factory knows which provider runs. A block produced by a live
+(sw-wired) network is re-validated by a TxValidator wired with the
+factory-built TPU provider (min_batch=1, so the creator + endorsement
+signatures all route through the jitted kernel; the jax CPU backend in
+tests compiles the same XLA program the TPU runs). Verdicts must match
+the sw validator byte for byte, including a tampered-endorsement
+rejection decided ON DEVICE.
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp import factory
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition, shim
+from fabric_tpu.core.txvalidator import TxValidator
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer
+from fabric_tpu.peer.gateway import Gateway
+from fabric_tpu.protos import common, transaction as txpb
+from fabric_tpu.protoutil import protoutil as pu
+
+CHANNEL = "tpuchannel"
+
+
+class KV(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+        return shim.error("unknown")
+
+
+def test_factory_config_selects_tpu():
+    opts = factory.FactoryOpts.from_config(
+        {"Default": "TPU", "TPU": {"MinBatch": 1, "MaxBlocks": 8}})
+    csp = factory.new_bccsp(opts)
+    assert type(csp).__name__ == "TPUProvider"
+    assert csp._min_batch == 1
+
+
+def test_device_validator_matches_sw(tmp_path):
+    # -- stand up a small sw-wired network and commit a block --
+    csp = SWProvider()
+    cdir = str(tmp_path / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com",
+                                  orderer_org=True)
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [{"Name": "Org1", "ID": "Org1MSP",
+                               "MSPDir": os.path.join(org1, "msp")}],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "100ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+
+    def local_msp(d, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(d, mspid, csp=csp))
+        return m
+
+    omsp = local_msp(os.path.join(ordo, "orderers",
+                                  "orderer0.example.com", "msp"),
+                     "OrdererMSP")
+    reg = Registrar(str(tmp_path / "ord"),
+                    omsp.get_default_signing_identity(), csp,
+                    {"solo": solo.consenter})
+    reg.join(genesis)
+    bc = BroadcastHandler(reg)
+    dh = DeliverHandler(reg.get_chain)
+    pmsp = local_msp(os.path.join(org1, "peers",
+                                  "peer0.org1.example.com", "msp"),
+                     "Org1MSP")
+    peer = Peer(str(tmp_path / "peer"), pmsp, csp)
+    ch = peer.join_channel(genesis)
+    peer.chaincode_support.register("kv", KV())
+    ch.define_chaincode(ChaincodeDefinition(name="kv"))
+    d = Deliverer(ch, peer.signer, lambda: dh, peer.mcs)
+    d.start()
+    try:
+        user = local_msp(os.path.join(org1, "users",
+                                      "User1@org1.example.com",
+                                      "msp"), "Org1MSP")
+        gw = Gateway(peer, bc, user.get_default_signing_identity())
+        res = gw.submit_transaction(CHANNEL, "kv",
+                                    [b"put", b"dev", b"tpu"],
+                                    endorsing_peers=[peer])
+        assert res.status == txpb.TxValidationCode.VALID
+        block = ch.get_block(1)
+        assert block is not None
+    finally:
+        d.stop()
+        reg.halt()
+
+    # -- re-validate the SAME block with the TPU provider --
+    tpu_csp = factory.new_bccsp(factory.FactoryOpts.from_config(
+        {"Default": "TPU", "TPU": {"MinBatch": 1, "MaxBlocks": 8}}))
+    validator = TxValidator(
+        CHANNEL, ch.ledger, ch.bundle, tpu_csp,
+        ch.chaincode_definition,
+        configtx_validator_source=ch.configtx_validator)
+
+    # the committed filter says VALID; a fresh device validation of a
+    # COPY must agree... but the txid is already committed, so strip
+    # the dup check by validating against a pristine clone of state:
+    # easiest honest check — tamper vs no-tamper on the same block
+    # must produce DUPLICATE (already committed) vs rejection codes
+    # that only differ in the signature verdict. Use a copy with a
+    # fresh ledger-independent validator instead:
+    pristine = common.Block()
+    pristine.CopyFrom(block)
+    # wipe the commit-time metadata so the validator re-stamps it
+    del pristine.metadata.metadata[:]
+
+    class _NoDupLedger:
+        def get_transaction_by_id(self, tx_id):
+            return None
+
+    validator._ledger = _NoDupLedger()
+    codes = validator.validate(pristine)
+    assert codes == [txpb.TxValidationCode.VALID], codes
+
+    # tampered endorsement: the DEVICE must reject it
+    tampered = common.Block()
+    tampered.CopyFrom(block)
+    del tampered.metadata.metadata[:]
+    env = pu.unmarshal_envelope(tampered.data.data[0])
+    payload = pu.get_payload(env)
+    tx = txpb.Transaction()
+    tx.ParseFromString(payload.data)
+    cap = txpb.ChaincodeActionPayload()
+    cap.ParseFromString(tx.actions[0].payload)
+    sig = bytearray(cap.action.endorsements[0].signature)
+    sig[-1] ^= 1
+    cap.action.endorsements[0].signature = bytes(sig)
+    tx.actions[0].payload = cap.SerializeToString()
+    payload.data = tx.SerializeToString()
+    # a consistent envelope (creator re-signs) so the ONLY defect is
+    # the flipped endorsement signature — the device must catch it
+    env = pu.sign_or_panic(user.get_default_signing_identity(),
+                           payload)
+    tampered.data.data[0] = env.SerializeToString()
+    codes = validator.validate(tampered)
+    assert codes == [txpb.TxValidationCode.ENDORSEMENT_POLICY_FAILURE], \
+        codes
+    peer.close()
