@@ -83,6 +83,20 @@ def cmd_node_unjoin(args) -> int:
     return 0
 
 
+def cmd_node_pause(args) -> int:
+    from fabric_tpu.internal import nodeops
+    nodeops.pause(args.ledger_root, args.channel)
+    print(f"paused {args.channel}")
+    return 0
+
+
+def cmd_node_resume(args) -> int:
+    from fabric_tpu.internal import nodeops
+    nodeops.resume(args.ledger_root, args.channel)
+    print(f"resumed {args.channel}")
+    return 0
+
+
 def cmd_snapshot_submit(args) -> int:
     body = json.dumps({"height": args.height}).encode()
     status, out = _http("POST",
@@ -223,10 +237,12 @@ def main(argv=None) -> int:
     for verb, fn in (("rollback", cmd_node_rollback),
                      ("rebuild-dbs", cmd_node_rebuild),
                      ("reset", cmd_node_reset),
-                     ("unjoin", cmd_node_unjoin)):
+                     ("unjoin", cmd_node_unjoin),
+                     ("pause", cmd_node_pause),
+                     ("resume", cmd_node_resume)):
         np = node.add_parser(verb)
         np.add_argument("--ledger-root", required=True)
-        if verb in ("rollback", "unjoin"):
+        if verb in ("rollback", "unjoin", "pause", "resume"):
             np.add_argument("-C", "--channel", required=True)
         if verb == "rollback":
             np.add_argument("--block-number", type=int, required=True)

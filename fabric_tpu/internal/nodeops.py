@@ -116,6 +116,33 @@ def reset(ledger_root: str) -> list[str]:
     return done
 
 
+_PAUSED = "_paused"
+
+
+def pause(ledger_root: str, channel: str) -> None:
+    """Mark a channel paused on a stopped peer: the next start skips it
+    entirely (reference: `internal/peer/node/pause.go`)."""
+    path = os.path.join(ledger_root, channel)
+    if not os.path.isdir(path):
+        raise ValueError(f"channel {channel!r} does not exist")
+    with open(os.path.join(path, _PAUSED), "w"):
+        pass
+    logger.info("paused %s", channel)
+
+
+def resume(ledger_root: str, channel: str) -> None:
+    """Reference: `internal/peer/node/resume.go`."""
+    marker = os.path.join(ledger_root, channel, _PAUSED)
+    if not os.path.exists(marker):
+        raise ValueError(f"channel {channel!r} is not paused")
+    os.remove(marker)
+    logger.info("resumed %s", channel)
+
+
+def is_paused(ledger_root: str, channel: str) -> bool:
+    return os.path.exists(os.path.join(ledger_root, channel, _PAUSED))
+
+
 def unjoin(ledger_root: str, channel: str) -> None:
     path = os.path.join(ledger_root, channel)
     if not os.path.isdir(path):

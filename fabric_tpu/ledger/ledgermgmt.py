@@ -130,7 +130,11 @@ class LedgerManager:
         return [d for d in sorted(os.listdir(self._root))
                 if os.path.isdir(os.path.join(self._root, d))
                 and not d.endswith(".uc-tmp")
-                and not self._is_under_construction(d)]
+                and not self._is_under_construction(d)
+                # operator-paused channels stay closed until resume
+                # (reference: pause/resume markers)
+                and not os.path.exists(
+                    os.path.join(self._root, d, "_paused"))]
 
     def close(self) -> None:
         for ledger in self._ledgers.values():

@@ -258,3 +258,24 @@ class TestOperatorCommands:
                                  CHANNEL)
         assert res.identical_prefix
         assert res.heights[1] == 3
+
+
+class TestPauseResume:
+    def test_pause_skips_channel_at_startup(self, net, tmp_path):
+        import shutil
+        from fabric_tpu.ledger.ledgermgmt import LedgerManager
+        src = net["roots"][1]
+        dst = str(tmp_path / "pcopy")
+        shutil.copytree(src, dst)
+        nodeops.pause(dst, CHANNEL)
+        mgr = LedgerManager(dst)
+        assert mgr.ledger_ids() == []          # paused: not opened
+        mgr.close()
+        nodeops.resume(dst, CHANNEL)
+        mgr = LedgerManager(dst)
+        assert mgr.ledger_ids() == [CHANNEL]   # resumed
+        led = mgr.open(CHANNEL)
+        assert led.get_state("kv", "k0") == b"v0"
+        mgr.close()
+        with pytest.raises(ValueError, match="not paused"):
+            nodeops.resume(dst, CHANNEL)
