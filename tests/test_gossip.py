@@ -365,11 +365,14 @@ def gossip_net(tmp_path_factory):
             channel = peer.join_channel(genesis)
             peer.chaincode_support.register("secretcc", SecretCC())
             channel.define_chaincode(definition)
+            # generous expiration: the full suite runs on few cores
+            # and a starved scheduler must not flap membership
+            # mid-test (death detection has its own dedicated tests)
             gs = GossipService(peer, net.register(ep), peer.mcs,
                                org_id=mspid,
                                config=DiscoveryConfig(
-                                   alive_interval_s=0.1,
-                                   alive_expiration_s=0.8, fanout=4))
+                                   alive_interval_s=0.2,
+                                   alive_expiration_s=3.0, fanout=4))
             peer.gossip_service = gs
             gs.start(bootstrap=["peer0.org1.example.com:7051"])
             gs.initialize_channel(
