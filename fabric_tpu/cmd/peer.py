@@ -131,6 +131,50 @@ def cmd_channel_join(args) -> int:
     return 0 if status in (200, 201) else 1
 
 
+def cmd_channel_fetch(args) -> int:
+    """Reference: `peer channel fetch` — pull one block from an
+    orderer's deliver service."""
+    from fabric_tpu.comm import DeliverClient, channel_to
+    from fabric_tpu.peer.deliverclient import seek_envelope
+    from fabric_tpu.protos import common, orderer as opb
+    signer = _load_signer(args.msp_dir, args.msp_id)
+    client = DeliverClient(channel_to(args.orderer))
+
+    def fetch_at(num):
+        env = seek_envelope(args.channel, num, signer, stop=num)
+        for resp in client.handle(env):
+            if resp.WhichOneof("type") == "block":
+                block = common.Block()
+                block.CopyFrom(resp.block)
+                return block
+        return None
+
+    which = args.block
+    if which == "oldest":
+        block = fetch_at(0)
+    elif which in ("newest", "config"):
+        env = seek_envelope(args.channel, None, signer, newest=True)
+        block = None
+        for resp in client.handle(env):
+            if resp.WhichOneof("type") == "block":
+                block = common.Block()
+                block.CopyFrom(resp.block)
+                break
+        if which == "config" and block is not None:
+            from fabric_tpu.protoutil import protoutil as pu
+            if not pu.is_config_block(block):
+                block = fetch_at(pu.get_last_config_index(block))
+    else:
+        block = fetch_at(int(which))
+    if block is None:
+        print("block not found", file=sys.stderr)
+        return 1
+    with open(args.output, "wb") as f:
+        f.write(block.SerializeToString())
+    print(f"wrote block {block.header.number} to {args.output}")
+    return 0
+
+
 def cmd_channel_list(args) -> int:
     status, body = _http("GET", f"http://{args.ops}/admin/channels")
     print(body.decode())
@@ -274,6 +318,16 @@ def main(argv=None) -> int:
     lst = chan.add_parser("list")
     lst.add_argument("--ops", required=True)
     lst.set_defaults(fn=cmd_channel_list)
+    fetch = chan.add_parser("fetch")
+    fetch.add_argument("--orderer", required=True,
+                       help="orderer deliver endpoint host:port")
+    fetch.add_argument("--msp-dir", required=True)
+    fetch.add_argument("--msp-id", required=True)
+    fetch.add_argument("-C", "--channel", required=True)
+    fetch.add_argument("block", help="'oldest', 'newest', "
+                                     "'config', or a number")
+    fetch.add_argument("output", help="file to write the block to")
+    fetch.set_defaults(fn=cmd_channel_fetch)
 
     lc = sub.add_parser("lifecycle").add_subparsers(dest="sub",
                                                     required=True)

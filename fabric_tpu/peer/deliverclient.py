@@ -25,12 +25,19 @@ from fabric_tpu.protoutil import protoutil as pu
 logger = logging.getLogger("peer.deliverclient")
 
 
-def seek_envelope(channel_id: str, start: int, signer) -> common.Envelope:
-    """Signed SeekInfo from `start` to MAX (reference:
-    blocksprovider.go:286)."""
+def seek_envelope(channel_id: str, start, signer, stop=None,
+                  newest: bool = False) -> common.Envelope:
+    """Signed SeekInfo (reference: blocksprovider.go:286). Default:
+    from `start` to MAX, blocking at the tip; `stop` bounds the range;
+    `newest=True` fetches just the newest block."""
     seek = ordpb.SeekInfo()
-    seek.start.specified.number = start
-    seek.stop.specified.number = (1 << 63) - 1
+    if newest:
+        seek.start.newest.SetInParent()
+        seek.stop.newest.SetInParent()
+    else:
+        seek.start.specified.number = start
+        seek.stop.specified.number = (1 << 63) - 1 if stop is None \
+            else stop
     seek.behavior = ordpb.SeekInfo.BLOCK_UNTIL_READY
     ch = pu.make_channel_header(common.HeaderType.DELIVER_SEEK_INFO,
                                 channel_id)

@@ -111,6 +111,32 @@ class TestNwoEndToEnd:
         out = network.invoke("org2", 0, "put", "lc-governed", "1")
         assert json.loads(out)["status"] == "VALID"
 
+    def test_channel_fetch_cli(self, network, tmp_path):
+        """peer channel fetch pulls blocks from the orderer deliver
+        service: oldest == genesis, config resolves the governing
+        config block."""
+        from fabric_tpu.protos import common
+        out_path = str(tmp_path / "fetched.block")
+        gport = network.orderer_ports[1][0]
+        network._run_cli(
+            "fabric_tpu.cmd.peer", "channel", "fetch",
+            "--orderer", f"127.0.0.1:{gport}",
+            *network.peer_cli_identity("org1"),
+            "-C", network.channel, "oldest", out_path)
+        block = common.Block()
+        with open(out_path, "rb") as f:
+            block.ParseFromString(f.read())
+        assert block.header.number == 0
+        network._run_cli(
+            "fabric_tpu.cmd.peer", "channel", "fetch",
+            "--orderer", f"127.0.0.1:{gport}",
+            *network.peer_cli_identity("org1"),
+            "-C", network.channel, "config", out_path)
+        with open(out_path, "rb") as f:
+            block.ParseFromString(f.read())
+        from fabric_tpu.protoutil import protoutil as pu
+        assert pu.is_config_block(block)
+
     def test_orderer_crash_failover(self, network):
         """Kill one orderer (possibly the raft leader): the network
         keeps ordering."""
