@@ -115,9 +115,24 @@ class DeliverHandler:
                 if seek.behavior == ordpb.SeekInfo.FAIL_IF_NOT_READY:
                     yield _status(common.Status.NOT_FOUND)
                     return
-                if not ledger.wait_for_block(number, self._timeout_s):
-                    yield _status(common.Status.SERVICE_UNAVAILABLE)
-                    return
+                # bounded wait slices so a stream at the tip notices a
+                # halted/removed chain instead of parking its thread
+                # forever (reference: deliver.go re-checks the chain's
+                # error channel each iteration)
+                waited = 0.0
+                while not ledger.wait_for_block(number, 1.0):
+                    chain_now = self._chain_getter(ch.channel_id)
+                    errored = getattr(chain_now, "chain", None)
+                    if chain_now is None or (
+                            errored is not None and
+                            chain_now.chain.errored()):
+                        yield _status(common.Status.SERVICE_UNAVAILABLE)
+                        return
+                    waited += 1.0
+                    if self._timeout_s is not None and \
+                            waited >= self._timeout_s:
+                        yield _status(common.Status.SERVICE_UNAVAILABLE)
+                        return
             block = ledger.get_block(number)
             if block is None:
                 yield _status(common.Status.INTERNAL_SERVER_ERROR)

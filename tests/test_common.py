@@ -122,3 +122,77 @@ class TestViperutil:
         sub = cfg.sub("bccsp")
         assert sub.get("default") == "SW"
         assert sub.get("sw.hash") == "SHA2"
+
+
+class TestDeliverHaltedChain:
+    def test_tip_stream_ends_when_chain_halts(self):
+        """A deliver stream parked at the chain tip must terminate
+        with SERVICE_UNAVAILABLE when the chain halts instead of
+        blocking its thread forever."""
+        import threading
+        import time as _time
+        from fabric_tpu.common.deliver import DeliverHandler
+        from fabric_tpu.protos import common as cpb, orderer as opb
+
+        class _Ledger:
+            height = 1
+
+            def get_block(self, n):
+                blk = cpb.Block()
+                blk.header.number = n
+                return blk
+
+            def wait_for_block(self, n, timeout=None):
+                _time.sleep(min(timeout or 0.1, 0.1))
+                return False
+
+        class _Chain:
+            def __init__(self):
+                self.halted = False
+
+            def errored(self):
+                return self.halted
+
+        class _Support:
+            def __init__(self):
+                self.ledger = _Ledger()
+                self.chain = _Chain()
+
+            def bundle(self):
+                class _B:
+                    class policy_manager:
+                        @staticmethod
+                        def get_policy(path):
+                            class _P:
+                                @staticmethod
+                                def evaluate_signed_data(sd):
+                                    return None
+                            return _P()
+                return _B()
+
+        support = _Support()
+        handler = DeliverHandler(lambda cid: support)
+        from fabric_tpu.protoutil import protoutil as pu
+        seek = opb.SeekInfo()
+        seek.start.specified.number = 0
+        seek.stop.specified.number = 100
+        seek.behavior = opb.SeekInfo.BLOCK_UNTIL_READY
+        ch = pu.make_channel_header(
+            cpb.HeaderType.DELIVER_SEEK_INFO, "ch")
+        payload = pu.make_payload(ch, cpb.SignatureHeader(),
+                                  seek.SerializeToString())
+        env = cpb.Envelope(payload=payload.SerializeToString())
+
+        results = []
+
+        def run():
+            results.extend(handler.handle(env))
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        _time.sleep(1.0)       # stream reaches the tip and parks
+        support.chain.halted = True
+        t.join(timeout=5)
+        assert not t.is_alive(), "deliver stream leaked its thread"
+        assert results[0].WhichOneof("type") == "block"
+        assert results[-1].status == cpb.Status.SERVICE_UNAVAILABLE
