@@ -68,13 +68,20 @@ class OrdererNode:
         ledger_dir = cfg.get_path("FileLedger.Location")
         os.makedirs(ledger_dir, exist_ok=True)
         tick = cfg.get_duration("Consensus.TickInterval", 0.1)
+        def _kafka_deprecated(support):
+            raise ValueError(
+                f"[{support.channel_id}] the kafka consenter is "
+                "deprecated (as in the reference's 2.x line) and not "
+                "provided; migrate the channel to etcdraft")
+
         self.registrar = Registrar(
             ledger_dir, signer, csp,
             {"solo": solo.consenter,
              "raft": raft_mod.consenter(self.cluster,
                                         tick_interval_s=tick),
              "etcdraft": raft_mod.consenter(self.cluster,
-                                            tick_interval_s=tick)})
+                                            tick_interval_s=tick),
+             "kafka": _kafka_deprecated})
         broadcast = BroadcastHandler(self.registrar)
         deliver = DeliverHandler(self.registrar.get_chain)
         participation = ChannelParticipation(self.registrar)
