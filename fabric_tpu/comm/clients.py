@@ -234,11 +234,12 @@ class ClusterClient:
         req.consensus_request.payload = payload
         self._step(req, metadata=self._meta, timeout=self._timeout)
 
-    def submit(self, channel_id: str,
-               env_bytes: bytes) -> opb.SubmitResponse:
+    def submit(self, channel_id: str, env_bytes: bytes,
+               config_seq: int = 0) -> opb.SubmitResponse:
         req = opb.StepRequest()
         req.submit_request.channel = channel_id
         req.submit_request.payload = env_bytes
+        req.submit_request.last_validation_seq = config_seq
         resp = self._step(req, metadata=self._meta,
                           timeout=self._timeout)
         return resp.submit_response

@@ -1,10 +1,19 @@
 """gRPC cluster transport: orderer↔orderer Step over the network.
 
 Rebuild of `orderer/common/cluster/comm.go` (RemoteContext/Step RPC):
-the outbound half dials fellow consenters' Cluster services; the
-inbound half is comm.services.register_cluster(server, transport) —
-which feeds enqueue_consensus/handle_submit/handle_pull exactly like
-the in-process LocalClusterTransport, so RaftChain runs unchanged.
+the outbound half dials fellow consenters' Cluster services presenting
+this orderer's client TLS certificate; the inbound half is
+comm.services.register_cluster(server, transport) — which feeds
+enqueue_consensus/handle_submit/handle_pull exactly like the in-process
+LocalClusterTransport, so RaftChain runs unchanged.
+
+Caller authentication mirrors `orderer/common/cluster/comm.go`
+(and `service.go` ExpirationCheck): the mTLS-verified client
+certificate is matched against the channel's consenter set
+(client_tls_cert in the channel config), and the sender identity is
+DERIVED from that match — never from spoofable request metadata. When
+the transport is constructed without TLS material (in-process tests,
+dev topologies), enforcement is off and a warning is logged once.
 """
 
 from __future__ import annotations
@@ -21,17 +30,51 @@ from fabric_tpu.protos import common, orderer as opb
 logger = logging.getLogger("comm.cluster")
 
 
+_pem_der_memo: dict[bytes, Optional[bytes]] = {}
+
+
+def _pem_to_der(pem: bytes) -> Optional[bytes]:
+    # memoized: verify_caller runs on every inbound Step RPC (raft
+    # heartbeats included) and the PEM->DER mapping is pure
+    if pem in _pem_der_memo:
+        return _pem_der_memo[pem]
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives.serialization import Encoding
+
+        der = x509.load_pem_x509_certificate(pem).public_bytes(
+            Encoding.DER)
+    except Exception:
+        der = None
+    if len(_pem_der_memo) < 4096:  # bound growth under cert churn
+        _pem_der_memo[pem] = der
+    return der
+
+
+class ClusterAuthError(Exception):
+    """Caller is not an authenticated consenter for the channel."""
+
+
 class GRPCClusterTransport(ClusterTransport):
     def __init__(self, endpoint: str,
-                 tls_root_ca: Optional[bytes] = None):
+                 tls_root_ca: Optional[bytes] = None,
+                 client_cert: Optional[bytes] = None,
+                 client_key: Optional[bytes] = None,
+                 require_client_auth: bool = False):
         self.endpoint = endpoint
         self._tls_root_ca = tls_root_ca
+        self._client_cert = client_cert
+        self._client_key = client_key
+        self.require_client_auth = require_client_auth
         self._clients: dict[str, ClusterClient] = {}
         self._channels = {}
         self._handlers: dict[str, object] = {}
+        # channel -> {client cert DER -> consenter endpoint}
+        self._channel_auth: dict[str, dict[bytes, str]] = {}
         self._lock = threading.Lock()
         self._inbox: queue.Queue = queue.Queue(maxsize=4096)
         self._closed = threading.Event()
+        self._warned_insecure = False
         self._thread = threading.Thread(
             target=self._drain, name=f"cluster-grpc-{endpoint}",
             daemon=True)
@@ -41,7 +84,8 @@ class GRPCClusterTransport(ClusterTransport):
         with self._lock:
             c = self._clients.get(target)
             if c is None:
-                ch = channel_to(target, self._tls_root_ca)
+                ch = channel_to(target, self._tls_root_ca,
+                                self._client_cert, self._client_key)
                 self._channels[target] = ch
                 c = ClusterClient(ch, self.endpoint)
                 self._clients[target] = c
@@ -56,10 +100,11 @@ class GRPCClusterTransport(ClusterTransport):
         except Exception:
             logger.debug("consensus send to %s failed", target)
 
-    def submit(self, target: str, channel: str,
-               env_bytes: bytes) -> opb.SubmitResponse:
+    def submit(self, target: str, channel: str, env_bytes: bytes,
+               config_seq: int = 0) -> opb.SubmitResponse:
         try:
-            return self._client(target).submit(channel, env_bytes)
+            return self._client(target).submit(channel, env_bytes,
+                                               config_seq)
         except Exception as e:
             return opb.SubmitResponse(
                 channel=channel,
@@ -81,6 +126,72 @@ class GRPCClusterTransport(ClusterTransport):
 
     def remove_handler(self, channel: str) -> None:
         self._handlers.pop(channel, None)
+        with self._lock:
+            self._channel_auth.pop(channel, None)
+
+    def set_channel_auth(self, channel: str,
+                         client_certs: dict[str, bytes]) -> None:
+        table: dict[bytes, str] = {}
+        bad = []
+        for ep, pem in client_certs.items():
+            der = _pem_to_der(pem) if pem else None
+            if der:
+                table[der] = ep
+            else:
+                bad.append(ep)
+        if self.require_client_auth and not table:
+            # fail at chain startup, not with per-RPC PERMISSION_DENIED
+            # noise that never forms a quorum
+            raise ValueError(
+                f"[{channel}] cluster TLS enforcement is on but no "
+                f"consenter has a parsable client_tls_cert in the "
+                f"channel config (consenters: {sorted(client_certs)})")
+        if bad and self.require_client_auth:
+            logger.warning("[%s] consenters without parsable client "
+                           "TLS certs will be rejected: %s", channel,
+                           sorted(bad))
+        with self._lock:
+            self._channel_auth[channel] = table
+
+    # -- caller authentication (services.register_cluster calls this) --
+
+    def verify_caller(self, channel: str, auth_context,
+                      require_consenter: bool = True) -> Optional[str]:
+        """Return the consenter endpoint bound to the caller's verified
+        TLS client certificate, or raise ClusterAuthError. With
+        `require_consenter=False` (PullBlocks — onboarding followers
+        are not consenters yet; the reference serves replication over
+        the policy-gated Deliver service) any mTLS-verified cert is
+        accepted and the sender is the matched consenter endpoint or
+        "". With enforcement off (no TLS material) returns None and the
+        caller's claimed identity is used — dev/test topologies only."""
+        if not self.require_client_auth:
+            if not self._warned_insecure:
+                self._warned_insecure = True
+                logger.warning(
+                    "[%s] cluster RPCs are UNAUTHENTICATED (no cluster "
+                    "TLS configured) — do not run this in production",
+                    self.endpoint)
+            return None
+        pems = (auth_context or {}).get("x509_pem_cert") or []
+        if not pems:
+            raise ClusterAuthError("cluster RPC without a verified TLS "
+                                   "client certificate")
+        pem = pems[0]
+        der = _pem_to_der(pem if isinstance(pem, bytes)
+                          else pem.encode())
+        with self._lock:
+            table = self._channel_auth.get(channel)
+        if table is None:
+            raise ClusterAuthError(f"channel {channel} not served here")
+        sender = table.get(der)
+        if sender is None:
+            if require_consenter:
+                raise ClusterAuthError(
+                    f"client certificate is not in channel {channel}'s "
+                    "consenter set")
+            return ""
+        return sender
 
     # -- inbound (comm.services.register_cluster calls these) --
 
@@ -105,14 +216,14 @@ class GRPCClusterTransport(ClusterTransport):
             except Exception:
                 logger.exception("consensus handler failed")
 
-    def handle_submit(self, channel: str,
-                      env_bytes: bytes) -> opb.SubmitResponse:
+    def handle_submit(self, channel: str, env_bytes: bytes,
+                      config_seq: int = 0) -> opb.SubmitResponse:
         handler = self._handlers.get(channel)
         if handler is None:
             return opb.SubmitResponse(
                 channel=channel, status=common.Status.NOT_FOUND,
                 info=f"channel {channel} not served here")
-        return handler.on_submit(env_bytes)
+        return handler.on_submit(env_bytes, config_seq)
 
     def handle_pull(self, channel: str, start: int,
                     end: int) -> list[common.Block]:

@@ -106,6 +106,11 @@ class GRPCServer:
             except grpc.RpcError:
                 raise
             except Exception as e:
+                # a handler that called context.abort() already carries
+                # its status; re-raise instead of clobbering it
+                if getattr(getattr(context, "_state", None), "aborted",
+                           False):
+                    raise
                 logger.exception("handler failed")
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
         return wrapped

@@ -147,19 +147,40 @@ def register_discovery(server: GRPCServer, discovery_service) -> None:
 
 def register_cluster(server: GRPCServer, transport_hub) -> None:
     """`transport_hub`: the node-side GRPCClusterTransport (its
-    handle_* methods mirror LocalClusterTransport)."""
+    handle_* methods mirror LocalClusterTransport). The hub's
+    verify_caller binds the mTLS client certificate to the channel's
+    consenter set and yields the verified sender identity; the
+    spoofable 'sender-endpoint' metadata is only consulted when the
+    hub runs without TLS enforcement (dev/test)."""
+    import grpc
+
+    from fabric_tpu.comm.cluster_grpc import ClusterAuthError
+
+    def _sender(ctx, channel: str, require_consenter: bool = True) -> str:
+        try:
+            verified = transport_hub.verify_caller(
+                channel, ctx.auth_context(),
+                require_consenter=require_consenter)
+        except ClusterAuthError as e:
+            ctx.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
+        if verified is not None:
+            return verified
+        return dict(ctx.invocation_metadata()).get("sender-endpoint",
+                                                   "")
+
     def step(req: opb.StepRequest, ctx):
         which = req.WhichOneof("payload")
         if which == "consensus_request":
             cr = req.consensus_request
-            sender = dict(ctx.invocation_metadata()).get(
-                "sender-endpoint", "")
+            sender = _sender(ctx, cr.channel)
             transport_hub.enqueue_consensus(sender, cr.channel,
                                             bytes(cr.payload))
             return opb.StepResponse()
         sr = req.submit_request
+        _sender(ctx, sr.channel)
         resp = transport_hub.handle_submit(sr.channel,
-                                           bytes(sr.payload))
+                                           bytes(sr.payload),
+                                           sr.last_validation_seq)
         out = opb.StepResponse()
         out.submit_response.CopyFrom(resp)
         return out
@@ -170,6 +191,7 @@ def register_cluster(server: GRPCServer, transport_hub) -> None:
         from fabric_tpu.protoutil import protoutil as pu
         payload = pu.get_payload(env)
         ch = pu.get_channel_header(payload)
+        _sender(ctx, ch.channel_id, require_consenter=False)
         seek = opb.SeekInfo()
         seek.ParseFromString(payload.data)
         start = seek.start.specified.number

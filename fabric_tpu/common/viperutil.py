@@ -92,16 +92,19 @@ class Config:
             return default
         return parse_duration(v)
 
-    def get_path(self, dotted: str, default: str = "") -> str:
-        """Resolve a possibly-relative path against the config file's dir
-        (reference viperutil path translation)."""
-        v = self.get(dotted, default)
-        if not v:
-            return default
-        v = str(v)
+    def resolve_path(self, value: str) -> str:
+        """Resolve a possibly-relative path value against the config
+        file's dir (reference viperutil path translation)."""
+        v = str(value)
         if os.path.isabs(v):
             return v
         return os.path.join(self.config_dir, v)
+
+    def get_path(self, dotted: str, default: str = "") -> str:
+        v = self.get(dotted, default)
+        if not v:
+            return default
+        return self.resolve_path(v)
 
     def sub(self, dotted: str) -> "Config":
         node = self.get(dotted, {})

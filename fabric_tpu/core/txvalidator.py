@@ -126,8 +126,14 @@ class TxValidator:
                             name[len("_implicit_org_"):])
                     else:
                         other_coll_writes = True
-        except Exception:
-            pass
+        except Exception as e:
+            # an unparsable rwset must fail validation loudly: silently
+            # defaulting to "no collection writes" would validate the
+            # tx under a weaker policy composition than what the
+            # commit path later applies (caller maps this to
+            # INVALID_ENDORSER_TRANSACTION)
+            raise ValueError(f"malformed results/rwset in chaincode "
+                             f"action: {e}") from e
         write_info = (tuple(implicit_orgs), public_writes,
                       other_coll_writes)
         return cc_action.chaincode_id.name, sd, write_info

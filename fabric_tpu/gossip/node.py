@@ -302,7 +302,7 @@ class GossipNode:
         elif which == "private_data" and ch.on_pvt_push:
             ch.on_pvt_push(sender, msg)
         elif which == "private_req" and ch.on_pvt_request:
-            ch.on_pvt_request(sender, msg)
+            ch.on_pvt_request(sender, msg, smsg)
         elif which == "private_res" and ch.on_pvt_response:
             ch.on_pvt_response(sender, msg)
 

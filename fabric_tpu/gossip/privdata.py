@@ -150,13 +150,27 @@ class PrivDataProvider:
             sent += 1
         return sent
 
-    def _on_request(self, sender: str, msg: gpb.GossipMessage) -> None:
-        # ACL: the requester's org must be a collection member
+    def _on_request(self, sender: str, msg: gpb.GossipMessage,
+                    smsg: gpb.SignedGossipMessage = None) -> None:
+        # ACL: the requester's org must be a collection member. The
+        # request signature is verified against the resolved member's
+        # identity so the decision binds to a VERIFIED identity, not
+        # the spoofable sender-endpoint claim (reference ties this to
+        # the mTLS connection; gossip requests here are signed).
         requester = None
         for m in self._node.discovery.alive_members():
             if m.member.endpoint == sender:
                 requester = m
                 break
+        if requester is not None and requester.identity and \
+                smsg is not None:
+            if not self._node.mcs.verify_by_channel(
+                    self.channel_id, requester.identity,
+                    smsg.signature, smsg.payload):
+                logger.warning(
+                    "[%s] pvt-data request from %s failed signature "
+                    "verification; dropping", self.channel_id, sender)
+                return
         req_org = self._org_of(requester.identity) \
             if requester is not None and requester.identity else None
         out = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)

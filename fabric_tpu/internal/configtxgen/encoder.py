@@ -168,11 +168,20 @@ def new_orderer_group(ord_cfg: dict) -> ctxpb.ConfigGroup:
     if ctype in ("raft", "etcdraft"):
         raft = ord_cfg.get("Raft") or {}
         meta = ctxpb.ConsensusMetadata()
+        def _cert(c, key):
+            """PEM bytes, or a file path as in the reference's
+            configtx.yaml Consenters (ClientTLSCert: path)."""
+            v = c.get(key, b"")
+            if isinstance(v, str) and v:
+                with open(v, "rb") as f:
+                    return f.read()
+            return v or b""
+
         for c in raft.get("Consenters", []):
             meta.consenters.add(
                 host=c["Host"], port=c["Port"],
-                client_tls_cert=c.get("ClientTLSCert", b""),
-                server_tls_cert=c.get("ServerTLSCert", b""))
+                client_tls_cert=_cert(c, "ClientTLSCert"),
+                server_tls_cert=_cert(c, "ServerTLSCert"))
         opts = raft.get("Options") or {}
         meta.options.tick_interval_ms = opts.get("TickIntervalMs", 500)
         meta.options.election_tick = opts.get("ElectionTick", 10)

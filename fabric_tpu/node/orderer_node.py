@@ -61,9 +61,41 @@ class OrdererNode:
 
         address = cfg.get("General.ListenAddress", "127.0.0.1") + ":" \
             + str(cfg.get("General.ListenPort", 7050))
-        # cluster endpoint = the advertised consenter endpoint
-        cluster_ep = cfg.get("Cluster.Endpoint", address)
-        self.cluster = GRPCClusterTransport(cluster_ep)
+
+        # Cluster transport. With TLS material configured (reference
+        # `General.Cluster` in orderer.yaml), cluster RPCs get a
+        # DEDICATED mutual-TLS listener and callers are authenticated
+        # against each channel's consenter set; without it, the Cluster
+        # service shares the general listener unauthenticated (dev
+        # only — a warning is logged on first use).
+        cluster_server_cert = cfg.get_path("Cluster.ServerCertificate")
+        cluster_tls = bool(cluster_server_cert)
+        cluster_listen = (cfg.get("Cluster.ListenAddress", "127.0.0.1")
+                          + ":" + str(cfg.get("Cluster.ListenPort", 0)))
+        root_ca_paths = cfg.get("Cluster.RootCAs") or []
+        if isinstance(root_ca_paths, str):
+            root_ca_paths = [root_ca_paths]
+        root_cas = b"".join(
+            open(cfg.resolve_path(p), "rb").read()
+            for p in root_ca_paths) or None
+
+        def _read(key):
+            p = cfg.get_path(key)
+            return open(p, "rb").read() if p else None
+
+        client_cert = _read("Cluster.ClientCertificate") or \
+            (_read("Cluster.ServerCertificate") if cluster_tls else None)
+        client_key = _read("Cluster.ClientPrivateKey") or \
+            (_read("Cluster.ServerPrivateKey") if cluster_tls else None)
+
+        # the advertised consenter endpoint
+        cluster_ep = cfg.get("Cluster.Endpoint",
+                             cluster_listen if cluster_tls else address)
+        self.cluster = GRPCClusterTransport(
+            cluster_ep,
+            tls_root_ca=root_cas if cluster_tls else None,
+            client_cert=client_cert, client_key=client_key,
+            require_client_auth=cluster_tls)
 
         ledger_dir = cfg.get_path("FileLedger.Location")
         os.makedirs(ledger_dir, exist_ok=True)
@@ -105,7 +137,24 @@ class OrdererNode:
         self.address = self.server.address
         comm_services.register_broadcast(self.server, broadcast)
         comm_services.register_deliver(self.server, deliver)
-        comm_services.register_cluster(self.server, self.cluster)
+        if cluster_tls:
+            cluster_sc = ServerConfig(
+                address=cluster_listen,
+                tls_cert=open(cluster_server_cert, "rb").read(),
+                tls_key=open(
+                    cfg.get_path("Cluster.ServerPrivateKey"),
+                    "rb").read(),
+                client_root_cas=root_cas,  # mTLS required
+                metrics_provider=provider)
+            self.cluster_server = GRPCServer(cluster_sc)
+            comm_services.register_cluster(self.cluster_server,
+                                           self.cluster)
+            self.cluster_server.start()
+            logger.info("cluster mTLS listener on %s",
+                        self.cluster_server.address)
+        else:
+            self.cluster_server = None
+            comm_services.register_cluster(self.server, self.cluster)
         self.server.start()
 
         ops_addr = cfg.get("Admin.ListenAddress",
@@ -171,6 +220,8 @@ class OrdererNode:
             self.registrar.halt()
         if self.cluster:
             self.cluster.close()
+        if getattr(self, "cluster_server", None):
+            self.cluster_server.stop()
         if self.server:
             self.server.stop()
         if self.ops:

@@ -31,8 +31,12 @@ class ClusterTransport:
                        payload: bytes) -> None:
         raise NotImplementedError
 
-    def submit(self, target: str, channel: str,
-               env_bytes: bytes) -> opb.SubmitResponse:
+    def submit(self, target: str, channel: str, env_bytes: bytes,
+               config_seq: int = 0) -> opb.SubmitResponse:
+        """Forward an envelope to the leader. `config_seq` is the
+        channel-config sequence the ORIGIN validated the message under
+        (reference SubmitRequest.last_validation_seq): the leader
+        re-validates when its own sequence is newer."""
         raise NotImplementedError
 
     def pull_blocks(self, target: str, channel: str, start: int,
@@ -41,9 +45,17 @@ class ClusterTransport:
 
     def set_handler(self, channel: str, handler) -> None:
         """handler duck-type: on_consensus(sender, payload_bytes),
-        on_submit(env_bytes) -> SubmitResponse,
+        on_submit(env_bytes, config_seq) -> SubmitResponse,
         serve_blocks(start, end) -> list[Block]."""
         raise NotImplementedError
+
+    def set_channel_auth(self, channel: str,
+                         client_certs: dict[str, bytes]) -> None:
+        """Register {consenter endpoint -> client TLS cert PEM} for a
+        channel so the inbound half can authenticate cluster callers
+        (reference: `orderer/common/cluster/comm.go` binds the mTLS
+        client cert to the channel's consenter set). Transports without
+        a network boundary (in-process) need no enforcement."""
 
     def close(self) -> None:
         raise NotImplementedError
@@ -73,10 +85,10 @@ class LocalClusterTransport(ClusterTransport):
         self._net.route_consensus(self.endpoint, target, channel,
                                   payload)
 
-    def submit(self, target: str, channel: str,
-               env_bytes: bytes) -> opb.SubmitResponse:
+    def submit(self, target: str, channel: str, env_bytes: bytes,
+               config_seq: int = 0) -> opb.SubmitResponse:
         return self._net.route_submit(self.endpoint, target, channel,
-                                      env_bytes)
+                                      env_bytes, config_seq)
 
     def pull_blocks(self, target: str, channel: str, start: int,
                     end: int) -> list[common.Block]:
@@ -108,15 +120,15 @@ class LocalClusterTransport(ClusterTransport):
                 logger.exception("[%s] consensus handler failed",
                                  self.endpoint)
 
-    def handle_submit(self, channel: str,
-                      env_bytes: bytes) -> opb.SubmitResponse:
+    def handle_submit(self, channel: str, env_bytes: bytes,
+                      config_seq: int = 0) -> opb.SubmitResponse:
         handler = self._handlers.get(channel)
         if handler is None:
             return opb.SubmitResponse(
                 channel=channel,
                 status=common.Status.NOT_FOUND,
                 info=f"channel {channel} not served here")
-        return handler.on_submit(env_bytes)
+        return handler.on_submit(env_bytes, config_seq)
 
     def handle_pull(self, channel: str, start: int,
                     end: int) -> list[common.Block]:
@@ -184,14 +196,15 @@ class LocalClusterNetwork:
             node.enqueue_consensus(sender, channel, payload)
 
     def route_submit(self, sender: str, target: str, channel: str,
-                     env_bytes: bytes) -> opb.SubmitResponse:
+                     env_bytes: bytes,
+                     config_seq: int = 0) -> opb.SubmitResponse:
         node = self._reachable(sender, target)
         if node is None:
             return opb.SubmitResponse(
                 channel=channel,
                 status=common.Status.SERVICE_UNAVAILABLE,
                 info=f"{target} unreachable")
-        return node.handle_submit(channel, env_bytes)
+        return node.handle_submit(channel, env_bytes, config_seq)
 
     def route_pull(self, sender: str, target: str, channel: str,
                    start: int, end: int) -> list[common.Block]:

@@ -56,6 +56,16 @@ def parse_consenters(metadata: bytes) -> dict[int, str]:
     return out
 
 
+def parse_consenter_certs(metadata: bytes) -> dict[str, bytes]:
+    """endpoint -> client TLS cert PEM from the channel's consenter
+    set (reference etcdraft Consenter.client_tls_cert) — the identity
+    table cluster-RPC callers are authenticated against."""
+    meta = ctxpb.ConsensusMetadata()
+    meta.ParseFromString(metadata)
+    return {f"{c.host}:{c.port}": bytes(c.client_tls_cert)
+            for c in meta.consenters}
+
+
 class _BlockCreator:
     """In-flight block assembly, decoupled from the writer (reference:
     etcdraft/blockcreator.go)."""
@@ -108,6 +118,10 @@ class RaftChain:
         self._timer_deadline: Optional[float] = None
         self._applied_since_compact = 0
         self._replay_committed()
+        transport.set_channel_auth(
+            support.channel_id,
+            parse_consenter_certs(
+                support.bundle().orderer.consensus_metadata))
         transport.set_handler(support.channel_id, self)
 
     # -- restart replay: committed-but-unwritten entries --
@@ -176,7 +190,7 @@ class RaftChain:
             raise MsgProcessorError(f"unknown raft leader {leader}")
         resp = self._transport.submit(target,
                                       self._support.channel_id,
-                                      pu.marshal(env))
+                                      pu.marshal(env), config_seq)
         if resp.status != common.Status.SUCCESS:
             raise MsgProcessorError(
                 f"leader {target} rejected submission: {resp.info}")
@@ -199,7 +213,8 @@ class RaftChain:
             logger.warning("[%s] raft event queue full",
                            self._support.channel_id)
 
-    def on_submit(self, env_bytes: bytes) -> opb.SubmitResponse:
+    def on_submit(self, env_bytes: bytes,
+                  config_seq: int = 0) -> opb.SubmitResponse:
         channel = self._support.channel_id
         if self.node.leader_id != self.node_id:
             return opb.SubmitResponse(
@@ -207,14 +222,17 @@ class RaftChain:
                 info="not the leader")
         try:
             env = pu.unmarshal_envelope(env_bytes)
-            # a forwarded message was validated by the origin's
-            # msgprocessor; classify config-ness here
+            # classify config-ness here; carry the ORIGIN's validation
+            # sequence so _process_order re-runs the msgprocessor when
+            # the forwarder validated under a stale channel config
+            # (reference chain.go Submit/Order last_validation_seq).
+            # The default 0 is conservative: unknown origin sequence
+            # means the leader always re-validates.
             payload = pu.get_payload(env)
             ch = pu.get_channel_header(payload)
             is_config = ch.type in (common.HeaderType.CONFIG,
                                     common.HeaderType.ORDERER_TRANSACTION)
-            self._events.put(("order", env, self._support.sequence(),
-                              is_config))
+            self._events.put(("order", env, config_seq, is_config))
         except Exception as e:
             return opb.SubmitResponse(channel=channel,
                                       status=common.Status.BAD_REQUEST,
@@ -395,9 +413,16 @@ class RaftChain:
     def _reconfigure(self) -> None:
         """A config block committed: adopt the (possibly) new consenter
         set; the leader drives the raft membership change."""
-        new = parse_consenters(
-            self._support.bundle().orderer.consensus_metadata)
-        if not new or new == self._consenters:
+        meta = self._support.bundle().orderer.consensus_metadata
+        new = parse_consenters(meta)
+        if not new:
+            return
+        # refresh the caller-auth table even when the endpoint set is
+        # unchanged: a config update may rotate a consenter's client
+        # TLS cert in place
+        self._transport.set_channel_auth(self._support.channel_id,
+                                         parse_consenter_certs(meta))
+        if new == self._consenters:
             return
         logger.info("[%s] consenter set change: %s -> %s",
                     self._support.channel_id,

@@ -339,7 +339,12 @@ class RaftNode:
         if msg.commit > self.commit_index:
             self._set_commit(min(msg.commit, last_new if msg.entries
                                  else self.last_index()))
-        resp.last_log_index = self.last_index()
+        # Ack the highest index KNOWN to match the leader's log (etcd
+        # MsgAppResp semantics), never our raw last_index(): a stale
+        # divergent tail from an old term must not inflate the leader's
+        # match_index, or it could commit entries never replicated to a
+        # majority (ledger fork after failover).
+        resp.last_log_index = last_new
         self._send(msg.from_, resp)
 
     def _handle_append_resp(self, msg: rpb.RaftMessage) -> None:
@@ -353,9 +358,13 @@ class RaftNode:
                        self.next_index.get(peer, 1) - 1))
             self._send_append(peer)
             return
+        # last_log_index is the follower's confirmed-match position
+        # (prev + len(entries) of the APPEND it acked); monotonic max
+        # guards against stale reordered acks only.
         self.match_index[peer] = max(self.match_index.get(peer, 0),
                                      msg.last_log_index)
-        self.next_index[peer] = self.match_index[peer] + 1
+        self.next_index[peer] = max(self.next_index.get(peer, 1),
+                                    self.match_index[peer] + 1)
         self._maybe_commit()
         if self.next_index[peer] <= self.last_index():
             self._send_append(peer)
@@ -416,7 +425,7 @@ class RaftNode:
             rpb.Entry(index=meta.last_index, term=meta.last_term,
                       type=rpb.Entry.NORMAL, data=b""))
         resp = self._base(msg.from_, rpb.RaftMessage.APPEND_RESP)
-        resp.last_log_index = self.last_index()
+        resp.last_log_index = meta.last_index  # matched through snapshot
         self._send(msg.from_, resp)
 
     def compact(self, upto_index: int, block_height: int) -> None:

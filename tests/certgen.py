@@ -51,15 +51,17 @@ def make_self_signed(cn: str):
 
 
 def make_leaf(cn: str, ca_cert, ca_priv, org: str | None = None,
-              ou: str | None = None, not_after=None):
-    """Leaf cert signed by the given CA (CA:FALSE), optional OU."""
+              ou: str | None = None, not_after=None,
+              sans: list[str] | None = None):
+    """Leaf cert signed by the given CA (CA:FALSE), optional OU.
+    `sans` adds DNS SubjectAlternativeNames (TLS hostname checks)."""
     priv = ec.generate_private_key(ec.SECP256R1())
     attrs = [x509.NameAttribute(NameOID.COMMON_NAME, cn)]
     if org:
         attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
     if ou:
         attrs.append(x509.NameAttribute(NameOID.ORGANIZATIONAL_UNIT_NAME, ou))
-    cert = (
+    builder = (
         x509.CertificateBuilder()
         .subject_name(x509.Name(attrs))
         .issuer_name(ca_cert.subject)
@@ -69,8 +71,13 @@ def make_leaf(cn: str, ca_cert, ca_priv, org: str | None = None,
         .not_valid_after(not_after or _NOT_AFTER)
         .add_extension(x509.BasicConstraints(ca=False, path_length=None),
                        critical=True)
-        .sign(ca_priv, hashes.SHA256())
     )
+    if sans:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName(
+                [x509.DNSName(s) for s in sans]),
+            critical=False)
+    cert = builder.sign(ca_priv, hashes.SHA256())
     return cert, priv
 
 

@@ -86,7 +86,8 @@ class Network:
         self.n_orderers = n_orderers
         self.peers_per_org = peers_per_org
         self.nodes: dict[str, Node] = {}
-        self.orderer_ports = [(free_port(), free_port())
+        # (general grpc, ops, mTLS cluster listener) per orderer
+        self.orderer_ports = [(free_port(), free_port(), free_port())
                               for _ in range(n_orderers)]
         self.peer_ports = {}   # (org, i) -> (grpc, ops)
         for org in ("org1", "org2"):
@@ -119,8 +120,15 @@ class Network:
                       os.path.join(self.root, "crypto-config.yaml"),
                       "--output", crypto)
 
-        orderer_eps = [f"127.0.0.1:{g}" for g, _o in
+        orderer_eps = [f"127.0.0.1:{g}" for g, _o, _c in
                        self.orderer_ports]
+
+        def _otls(i: int) -> str:
+            return os.path.join(
+                crypto, "ordererOrganizations", "example.com",
+                "orderers", f"orderer{i}.example.com", "tls",
+                "server.crt")
+
         profile = {
             "Consortium": "SampleConsortium",
             "Capabilities": {"V2_0": True},
@@ -143,8 +151,11 @@ class Network:
                 "BatchTimeout": "250ms",
                 "BatchSize": {"MaxMessageCount": 10},
                 "Raft": {"Consenters": [
-                    {"Host": "127.0.0.1", "Port": g}
-                    for g, _o in self.orderer_ports]},
+                    {"Host": "127.0.0.1", "Port": c,
+                     "ClientTLSCert": _otls(i),
+                     "ServerTLSCert": _otls(i)}
+                    for i, (_g, _o, c) in
+                    enumerate(self.orderer_ports)]},
                 "Organizations": [{
                     "Name": "OrdererOrg", "ID": "OrdererMSP",
                     "MSPDir": os.path.join(
@@ -179,8 +190,11 @@ class Network:
     # -- node lifecycle --
 
     def start_orderer(self, i: int) -> Node:
-        grpc_port, ops_port = self.orderer_ports[i]
+        grpc_port, ops_port, cluster_port = self.orderer_ports[i]
         crypto = os.path.join(self.root, "crypto")
+        tls_dir = os.path.join(
+            crypto, "ordererOrganizations", "example.com", "orderers",
+            f"orderer{i}.example.com", "tls")
         cfg = {
             "General": {
                 "ListenAddress": "127.0.0.1",
@@ -193,7 +207,18 @@ class Network:
             },
             "FileLedger": {"Location": os.path.join(
                 self.root, f"orderer{i}", "ledger")},
-            "Cluster": {"Endpoint": f"127.0.0.1:{grpc_port}"},
+            "Cluster": {
+                "Endpoint": f"127.0.0.1:{cluster_port}",
+                "ListenAddress": "127.0.0.1",
+                "ListenPort": cluster_port,
+                "ServerCertificate": os.path.join(tls_dir,
+                                                  "server.crt"),
+                "ServerPrivateKey": os.path.join(tls_dir, "server.key"),
+                "ClientCertificate": os.path.join(tls_dir,
+                                                  "server.crt"),
+                "ClientPrivateKey": os.path.join(tls_dir, "server.key"),
+                "RootCAs": [os.path.join(tls_dir, "ca.crt")],
+            },
             "Consensus": {"TickInterval": "100ms"},
             "Admin": {"ListenAddress": f"127.0.0.1:{ops_port}"},
         }
@@ -211,7 +236,7 @@ class Network:
                    bootstrap: str = "") -> Node:
         grpc_port, ops_port = self.peer_ports[(org, i)]
         crypto = os.path.join(self.root, "crypto")
-        orderer_eps = [f"127.0.0.1:{g}" for g, _o in
+        orderer_eps = [f"127.0.0.1:{g}" for g, _o, _c in
                        self.orderer_ports]
         cfg = {
             "peer": {

@@ -1,0 +1,148 @@
+"""Cluster RPC authentication: mTLS client certs bound to the
+channel's consenter set.
+
+Reference: `orderer/common/cluster/comm.go` authenticates Step callers
+by matching the TLS client certificate against the channel's consenter
+set; the sender identity derives from the verified cert, never from
+request metadata. These tests drive a real mTLS gRPC server +
+GRPCClusterTransport end to end.
+"""
+
+import grpc
+import pytest
+
+from fabric_tpu.comm import services as comm_services
+from fabric_tpu.comm.clients import ClusterClient, channel_to
+from fabric_tpu.comm.cluster_grpc import GRPCClusterTransport
+from fabric_tpu.comm.server import GRPCServer, ServerConfig
+from fabric_tpu.protos import common, orderer as opb
+from tests import certgen
+
+CHANNEL = "authchan"
+
+
+def _pem(cert) -> bytes:
+    from cryptography.hazmat.primitives.serialization import Encoding
+
+    return cert.public_bytes(Encoding.PEM)
+
+
+def _key_pem(key) -> bytes:
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, NoEncryption, PrivateFormat,
+    )
+
+    return key.private_bytes(Encoding.PEM, PrivateFormat.PKCS8,
+                             NoEncryption())
+
+
+class _RecordingHandler:
+    def __init__(self):
+        self.consensus = []
+        self.submits = []
+
+    def on_consensus(self, sender, payload):
+        self.consensus.append((sender, payload))
+
+    def on_submit(self, env_bytes, config_seq=0):
+        self.submits.append((env_bytes, config_seq))
+        return opb.SubmitResponse(channel=CHANNEL,
+                                  status=common.Status.SUCCESS)
+
+    def serve_blocks(self, start, end):
+        return []
+
+
+@pytest.fixture(scope="module")
+def tls():
+    """CA + three leaf identities: two consenters, one outsider signed
+    by the same CA (valid TLS, NOT in the consenter set)."""
+    ca_cert, ca_key = certgen.make_self_signed("tlsca.test")
+    out = {"ca": _pem(ca_cert)}
+    for name in ("consenter1", "consenter2", "outsider"):
+        cert, key = certgen.make_leaf(f"{name}.test", ca_cert, ca_key,
+                                      sans=["localhost"])
+        out[name] = (_pem(cert), _key_pem(key))
+    return out
+
+
+@pytest.fixture()
+def serving(tls):
+    """An mTLS cluster listener whose channel auth admits consenter1+2."""
+    hub = GRPCClusterTransport("127.0.0.1:0", tls_root_ca=tls["ca"],
+                               client_cert=tls["consenter1"][0],
+                               client_key=tls["consenter1"][1],
+                               require_client_auth=True)
+    handler = _RecordingHandler()
+    hub.set_handler(CHANNEL, handler)
+    hub.set_channel_auth(CHANNEL, {
+        "127.0.0.1:9001": tls["consenter1"][0],
+        "127.0.0.1:9002": tls["consenter2"][0],
+    })
+    server = GRPCServer(ServerConfig(
+        address="localhost:0", tls_cert=tls["consenter1"][0],
+        tls_key=tls["consenter1"][1], client_root_cas=tls["ca"]))
+    comm_services.register_cluster(server, hub)
+    server.start()
+    yield server, hub, handler
+    server.stop()
+    hub.close()
+
+
+def _client(server, tls, who):
+    ch = channel_to(f"localhost:{server.port}", tls["ca"],
+                    tls[who][0], tls[who][1])
+    return ClusterClient(ch, self_endpoint="127.0.0.1:9999",
+                         timeout_s=5.0)
+
+
+class TestClusterAuth:
+    def test_consenter_cert_accepted_sender_from_cert(self, serving,
+                                                      tls):
+        server, _hub, handler = serving
+        client = _client(server, tls, "consenter2")
+        client.send_consensus(CHANNEL, b"raftmsg")
+        resp = client.submit(CHANNEL, b"env", config_seq=7)
+        assert resp.status == common.Status.SUCCESS
+        import time
+
+        deadline = time.monotonic() + 5
+        while not handler.consensus and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # sender derived from the VERIFIED cert (consenter2's slot),
+        # not the metadata claim ("127.0.0.1:9999")
+        assert handler.consensus[0][0] == "127.0.0.1:9002"
+        assert handler.submits == [(b"env", 7)]
+
+    def test_outsider_cert_denied(self, serving, tls):
+        server, _hub, handler = serving
+        client = _client(server, tls, "outsider")
+        with pytest.raises(grpc.RpcError) as ei:
+            client.submit(CHANNEL, b"forged")
+        assert ei.value.code() == grpc.StatusCode.PERMISSION_DENIED
+        with pytest.raises(grpc.RpcError):
+            client.send_consensus(CHANNEL, b"forged-raft")
+        assert handler.submits == [] and handler.consensus == []
+
+    def test_no_client_cert_rejected_at_handshake(self, serving, tls):
+        server, _hub, handler = serving
+        ch = channel_to(f"localhost:{server.port}", tls["ca"])
+        client = ClusterClient(ch, "127.0.0.1:9999", timeout_s=3.0)
+        with pytest.raises(grpc.RpcError):
+            client.submit(CHANNEL, b"anon")
+        assert handler.submits == []
+
+    def test_outsider_may_pull_blocks_but_not_step(self, serving, tls):
+        # onboarding followers are not consenters yet: PullBlocks only
+        # requires a CA-verified cert (reference: replication rides the
+        # policy-gated Deliver service)
+        server, _hub, _handler = serving
+        client = _client(server, tls, "outsider")
+        assert client.pull_blocks(CHANNEL, 0, 10) == []
+
+    def test_unknown_channel_denied(self, serving, tls):
+        server, _hub, _handler = serving
+        client = _client(server, tls, "consenter1")
+        with pytest.raises(grpc.RpcError) as ei:
+            client.submit("nosuchchannel", b"env")
+        assert ei.value.code() == grpc.StatusCode.PERMISSION_DENIED

@@ -198,6 +198,59 @@ class TestRaftCore:
         assert set(c.nodes[victim].peers) == set(keep) or \
             victim not in c.nodes[leader].peers
 
+    def test_inflight_stale_ack_cannot_commit_unreplicated(self):
+        """A follower's ack must report the confirmed-match prefix of the
+        leader's log (etcd MsgAppResp semantics), never its raw last
+        index: a stale divergent tail acked against an empty heartbeat,
+        landing after the new leader appended current-term entries at
+        those indices, must not let the leader commit entries that were
+        never replicated to a majority (ledger fork)."""
+        c = Cluster(3)
+        A = c.tick_until_leader()
+        B, C_ = [i for i in c.ids if i != A]
+        c.nodes[A].propose(b"base")
+        c.settle(5)
+        assert all(c.applied[i] == [b"base"] for i in c.ids)
+        # A builds a divergent uncommitted tail while isolated
+        c.down = {B, C_}
+        c.nodes[A].propose(b"stale1")
+        c.nodes[A].propose(b"stale2")
+        c.route()
+        assert c.nodes[A].last_index() == 3
+        # A crashes; B and C elect a new leader on the canonical log
+        c.down = {A}
+        NL = c.tick_until_leader()
+        nl = c.nodes[NL]
+        base = nl.commit_index
+        assert base == 1 and nl.last_index() == 1
+        c.route()
+        # A rejoins; the leader heartbeats it with an empty APPEND
+        c.down = set()
+        nl.tick()
+        nl.tick()  # heartbeat_tick == 2
+        msgs = nl.ready().messages
+        hb = [m for m in msgs if m.to == A and
+              m.type == rpb.RaftMessage.APPEND]
+        assert hb and not hb[0].entries
+        a = c.nodes[A]
+        a.step(hb[0])
+        acks = [m for m in a.ready().messages
+                if m.type == rpb.RaftMessage.APPEND_RESP]
+        assert acks and not acks[0].reject
+        # the ack must cover only the confirmed-match prefix, not A's
+        # stale last_index
+        assert acks[0].last_log_index == base
+        # while the ack is in flight, the leader appends two
+        # current-term entries at the same heights as A's stale tail
+        nl.propose(b"new1")
+        nl.propose(b"new2")
+        nl.ready()  # outgoing appends lost (other follower is slow)
+        before = nl.commit_index
+        nl.step(acks[0])  # stale ack lands
+        assert nl.commit_index == before, \
+            "leader committed entries never replicated to a majority"
+        assert nl.match_index[A] == base
+
     def test_log_compaction_and_snapshot_catchup(self):
         c = Cluster(3)
         leader = c.tick_until_leader()
