@@ -2,17 +2,26 @@
 
 Reproduces BASELINE.json config 2/5 shape: a 10k-tx block with a 2-of-3
 endorsement policy = 2 endorsement signatures + 1 creator signature per tx
-→ 30k independent ECDSA-P256 verifications over SHA-256 digests.
+→ 30k independent ECDSA-P256 verifications over SHA-256 digests, signed by
+3 distinct org keys — the structural reality of a Fabric block (a handful
+of org endorser/creator keys signs everything).
 
 Baseline ("bccsp/sw"): the reference verifies each signature on CPU inside
 a worker pool of size NumCPU (`core/peer/peer.go:501`,
 `core/committer/txvalidator/v20/validator.go:180-237`). We measure OpenSSL
 (`cryptography`) single-thread verify latency — the same asm-optimized
 class of implementation as Go's crypto/ecdsa — and credit the baseline
-with *ideal* linear scaling across every CPU core.
+with *ideal* linear scaling across every CPU core of this box. (Framing
+caveat: this box has few cores; a production peer with more cores gets a
+proportionally larger baseline credit.)
 
-TPU path: one fused fixed-shape XLA program (SHA-256 + P-256 verify) over
-the whole padded batch, steady-state timed. Prints ONE JSON line.
+TPU path (fabric_tpu/ops/comb.py): per-key comb tables built once on
+device, then fixed-shape chunked dispatches — gather + 63 complete adds
+per signature, zero doublings. Steady-state timing includes the per-batch
+table build and all chunk dispatches. Host prep (C++ DER parse + s^-1) is
+timed separately, and `e2e_pipelined_sigs_per_s` shows the wall-clock rate
+when host prep of chunk k+1 overlaps device execution of chunk k (the
+provider's double-buffered path). Prints ONE JSON line.
 """
 
 from __future__ import annotations
@@ -25,10 +34,12 @@ import numpy as np
 
 BLOCK_TXS = int(os.environ.get("BENCH_TXS", "10240"))
 SIGS_PER_TX = 3
+NKEYS = 3
 MSG_LEN = 256          # typical proposal-response payload scale
 NB = (MSG_LEN + 9 + 63) // 64   # ceil((len + padding) / block) — no slack
 CPU_SAMPLE = 300
 TPU_ITERS = 5
+CHUNK = int(os.environ.get("BENCH_CHUNK", "7680"))
 
 
 def main():
@@ -40,31 +51,34 @@ def main():
         decode_dss_signature,
     )
 
-    from fabric_tpu.ops import limb, p256, sha256, verify as verify_ops
+    from fabric_tpu.common import jaxenv
+    from fabric_tpu.ops import comb, limb, p256, sha256
 
+    jaxenv.enable_compilation_cache()
     rng = np.random.default_rng(1234)
     batch = BLOCK_TXS * SIGS_PER_TX
+    assert batch % CHUNK == 0, "chunk must divide batch"
 
-    # --- build the workload: 3 org keys, `batch` signed messages ---
-    keys = [ec.generate_private_key(ec.SECP256R1()) for _ in range(3)]
+    # --- build the workload: NKEYS org keys, `batch` signed messages ---
+    keys = [ec.generate_private_key(ec.SECP256R1()) for _ in range(NKEYS)]
     pubs = [k.public_key().public_numbers() for k in keys]
     msgs = [rng.bytes(MSG_LEN) for _ in range(batch)]
     t0 = time.perf_counter()
-    sigs = [keys[i % 3].sign(m, ec.ECDSA(hashes.SHA256()))
+    sigs = [keys[i % NKEYS].sign(m, ec.ECDSA(hashes.SHA256()))
             for i, m in enumerate(msgs)]
     sign_s = time.perf_counter() - t0
 
     # --- CPU baseline: single-thread verify, ideal-scaled to all cores ---
     t0 = time.perf_counter()
     for i in range(CPU_SAMPLE):
-        keys[i % 3].public_key().verify(
+        keys[i % NKEYS].public_key().verify(
             sigs[i], msgs[i], ec.ECDSA(hashes.SHA256()))
     cpu_per_sig = (time.perf_counter() - t0) / CPU_SAMPLE
     ncpu = os.cpu_count() or 1
     cpu_sigs_per_s = ncpu / cpu_per_sig          # ideal scaling credit
 
-    # --- stage TPU inputs (host prep, timed separately; the same
-    #     C++ native batch-prep the provider uses, python fallback) ---
+    # --- host prep (timed): same C++ native batch-prep the provider
+    #     uses (DER parse, low-S, range, w = s^-1 mod n) + limb packing
     from fabric_tpu import native
     from fabric_tpu.bccsp import utils as butils
     # low-S-normalize once (the endorser signs low-S; openssl may not)
@@ -72,51 +86,102 @@ def main():
         r, s = decode_dss_signature(der)
         sigs[i] = butils.marshal_signature(r, butils.to_low_s(s))
 
+    def host_prep(sig_slice, msg_slice):
+        blocks, nblocks = sha256.pack_messages(msg_slice, NB)
+        prep = native.batch_prep(sig_slice) if native.available() else None
+        if prep is not None:
+            ok, r_b, rpn_b, w_b = prep
+            if not ok.all():
+                raise SystemExit("host prep rejected a valid signature")
+            r_l = limb.be_bytes_to_limbs(r_b)
+            rpn_l = limb.be_bytes_to_limbs(rpn_b)
+            w_l = limb.be_bytes_to_limbs(w_b)
+        else:
+            rs, ws, rpns = [], [], []
+            for der in sig_slice:
+                r, s = decode_dss_signature(der)
+                rs.append(r)
+                ws.append(pow(s, -1, p256.N))
+                rpns.append(r + p256.N if r + p256.N < p256.P else r)
+            r_l = limb.ints_to_limbs(rs)
+            rpn_l = limb.ints_to_limbs(rpns)
+            w_l = limb.ints_to_limbs(ws)
+        n = len(sig_slice)
+        return (blocks, nblocks, r_l, rpn_l, w_l,
+                np.ones((n,), dtype=bool))
+
     t0 = time.perf_counter()
-    blocks, nblocks = sha256.pack_messages(msgs, NB)
-    key_limbs = [(limb.int_to_limbs(p.x), limb.int_to_limbs(p.y))
-                 for p in pubs]
-    qx = np.stack([key_limbs[i % 3][0] for i in range(batch)])
-    qy = np.stack([key_limbs[i % 3][1] for i in range(batch)])
-    prep = native.batch_prep(sigs) if native.available() else None
-    if prep is not None:
-        ok, r_b, rpn_b, w_b = prep
-        if not ok.all():
-            raise SystemExit("host prep rejected a valid signature")
-        r_l = limb.be_bytes_to_limbs(r_b)
-        rpn_l = limb.be_bytes_to_limbs(rpn_b)
-        w_l = limb.be_bytes_to_limbs(w_b)
-    else:
-        rs, ws, rpns = [], [], []
-        for der in sigs:
-            r, s = decode_dss_signature(der)
-            rs.append(r)
-            ws.append(pow(s, -1, p256.N))
-            rpns.append(r + p256.N if r + p256.N < p256.P else r)
-        r_l = limb.ints_to_limbs(rs)
-        rpn_l = limb.ints_to_limbs(rpns)
-        w_l = limb.ints_to_limbs(ws)
-    premask = np.ones((batch,), dtype=bool)
+    full = host_prep(sigs, msgs)
     host_prep_s = time.perf_counter() - t0
 
-    dev_args = tuple(jnp.asarray(a) for a in
-                     (blocks, nblocks, qx, qy, r_l, rpn_l, w_l, premask))
-    fn = jax.jit(verify_ops.verify_pipeline)
+    # --- device staging ---
+    qx_k = jnp.asarray(limb.ints_to_limbs([p.x for p in pubs]))
+    qy_k = jnp.asarray(limb.ints_to_limbs([p.y for p in pubs]))
+    key_idx = (np.arange(batch, dtype=np.int32) % NKEYS)
+    digests0 = np.zeros((batch, 8), dtype=np.uint32)
+    nodigest = np.zeros((batch,), dtype=bool)
+
+    build_fn = jax.jit(comb.build_q_tables)
+
+    def fused(blocks, nblocks, kidx, q_flat, r, rpn, w, premask,
+              digests, has_digest):
+        hashed = sha256.sha256_blocks(blocks, nblocks)
+        words = jnp.where(has_digest[:, None], digests, hashed)
+        return comb.comb_verify_with_tables(
+            words, kidx, q_flat, r, rpn, w, premask)
+
+    fn = jax.jit(fused)
+
+    def run_chunks(prepped, q_flat):
+        blocks, nblocks, r_l, rpn_l, w_l, premask = prepped
+        outs = []
+        for lo in range(0, batch, CHUNK):
+            hi = lo + CHUNK
+            outs.append(fn(
+                jnp.asarray(blocks[lo:hi]), jnp.asarray(nblocks[lo:hi]),
+                jnp.asarray(key_idx[lo:hi]), q_flat,
+                jnp.asarray(r_l[lo:hi]), jnp.asarray(rpn_l[lo:hi]),
+                jnp.asarray(w_l[lo:hi]), jnp.asarray(premask[lo:hi]),
+                jnp.asarray(digests0[lo:hi]),
+                jnp.asarray(nodigest[lo:hi])))
+        return np.concatenate([np.asarray(o) for o in outs])
 
     t0 = time.perf_counter()
-    out = fn(*dev_args)
-    out.block_until_ready()
+    q_flat = build_fn(qx_k, qy_k)
+    out = run_chunks(full, q_flat)
     compile_s = time.perf_counter() - t0
-    if not bool(np.asarray(out).all()):
+    if not out.all():
         raise SystemExit("correctness failure: valid signatures rejected")
 
+    # --- steady state: table build + chunked verify of the whole block ---
     times = []
     for _ in range(TPU_ITERS):
         t0 = time.perf_counter()
-        fn(*dev_args).block_until_ready()
+        q_flat = build_fn(qx_k, qy_k)
+        out = run_chunks(full, q_flat)
         times.append(time.perf_counter() - t0)
     tpu_s = min(times)
     tpu_sigs_per_s = batch / tpu_s
+
+    # --- end-to-end pipelined: host prep of chunk k+1 overlaps device
+    #     execution of chunk k (async dispatch; ctypes releases the GIL)
+    t0 = time.perf_counter()
+    q_flat = build_fn(qx_k, qy_k)
+    outs = []
+    for lo in range(0, batch, CHUNK):
+        hi = lo + CHUNK
+        blocks, nblocks, r_l, rpn_l, w_l, premask = host_prep(
+            sigs[lo:hi], msgs[lo:hi])
+        outs.append(fn(
+            jnp.asarray(blocks), jnp.asarray(nblocks),
+            jnp.asarray(key_idx[lo:hi]), q_flat,
+            jnp.asarray(r_l), jnp.asarray(rpn_l), jnp.asarray(w_l),
+            jnp.asarray(premask), jnp.asarray(digests0[lo:hi]),
+            jnp.asarray(nodigest[lo:hi])))
+    out = np.concatenate([np.asarray(o) for o in outs])
+    e2e_s = time.perf_counter() - t0
+    if not out.all():
+        raise SystemExit("correctness failure in pipelined path")
 
     result = {
         "metric": "block-validation sig-verify throughput (10k-tx block, 2-of-3 P-256)",
@@ -125,8 +190,13 @@ def main():
         "vs_baseline": round(tpu_sigs_per_s / cpu_sigs_per_s, 3),
         "detail": {
             "batch": batch,
+            "distinct_keys": NKEYS,
+            "kernel": "fixed-base comb, 8-bit windows (ops/comb.py)",
+            "chunk": CHUNK,
             "tpu_steady_s": round(tpu_s, 4),
             "tpu_block_tx_per_s": round(BLOCK_TXS / tpu_s, 1),
+            "e2e_pipelined_sigs_per_s": round(batch / e2e_s, 1),
+            "e2e_pipelined_s": round(e2e_s, 4),
             "cpu_single_thread_us_per_sig": round(cpu_per_sig * 1e6, 1),
             "cpu_ideal_cores": ncpu,
             "cpu_ideal_sigs_per_s": round(cpu_sigs_per_s, 1),

@@ -39,12 +39,20 @@ N = utils.P256_N
 
 class TPUProvider(api.BCCSP):
     def __init__(self, keystore=None, min_batch: int = 16,
-                 max_blocks: int = 64, mesh=None):
+                 max_blocks: int = 64, mesh=None, max_keys: int = 16,
+                 chunk: int = 8192):
         self._sw = swmod.SWProvider(keystore)
         self._min_batch = min_batch
         self._max_blocks = max_blocks
         self._mesh = mesh
-        self._fn = None          # lazily-built jitted pipeline
+        self._max_keys = max_keys   # comb path cutoff (distinct pubkeys)
+        self._chunk = chunk         # double-buffer chunk size (sigs)
+        self._fn = None             # lazily-built generic jitted pipeline
+        self._comb_fns = {}         # (K,) -> jitted comb pipeline
+        self._qtab_fns = {}         # K -> jitted table builder
+        # observability: perf-cliff counters surfaced via provider stats
+        self.stats = {"comb_batches": 0, "ladder_batches": 0,
+                      "host_hash_fallbacks": 0, "sw_fallbacks": 0}
 
     # -- everything non-batch delegates (pkcs11-style containment) --
 
@@ -101,6 +109,8 @@ class TPUProvider(api.BCCSP):
         w_b = np.zeros((bucket, 32), dtype=np.uint8)
         qx_b = np.zeros((bucket, 32), dtype=np.uint8)
         qy_b = np.zeros((bucket, 32), dtype=np.uint8)
+        key_idx = np.zeros(bucket, dtype=np.int32)
+        key_map: dict[bytes, int] = {}
         msgs: list[bytes] = []
         digests = np.zeros((bucket, 8), dtype=np.uint32)
         has_digest = np.zeros(bucket, dtype=bool)
@@ -152,6 +162,8 @@ class TPUProvider(api.BCCSP):
                 w_b[i] = np.frombuffer(w.to_bytes(32, "big"), np.uint8)
             qx_b[i] = pub.x_bytes()
             qy_b[i] = pub.y_bytes()
+            kb = qx_b[i].tobytes() + qy_b[i].tobytes()
+            key_idx[i] = key_map.setdefault(kb, len(key_map))
             if it.digest is not None:
                 digests[i] = np.frombuffer(it.digest, dtype=">u4")
                 has_digest[i] = True
@@ -166,6 +178,10 @@ class TPUProvider(api.BCCSP):
             # a message too large for the block budget: hash host-side and
             # turn every message lane into a digest lane so the nb=1 pack
             # below only ever sees empty messages
+            self.stats["host_hash_fallbacks"] += 1
+            logger.info("message of %d bytes exceeds the %d-block device "
+                        "budget; hashing the batch host-side", max_len,
+                        self._max_blocks)
             for i, m in enumerate(msgs[:n]):
                 if premask[i] and not has_digest[i]:
                     digests[i] = np.frombuffer(
@@ -178,20 +194,91 @@ class TPUProvider(api.BCCSP):
         # count and inject the digest after the hash stage via select
         nblocks = np.where(has_digest, 0, nblocks).astype(np.int32)
 
-        args = (
-            jnp.asarray(blocks),
-            jnp.asarray(nblocks),
-            jnp.asarray(limb.be_bytes_to_limbs(qx_b)),
-            jnp.asarray(limb.be_bytes_to_limbs(qy_b)),
-            jnp.asarray(limb.be_bytes_to_limbs(r_b)),
-            jnp.asarray(limb.be_bytes_to_limbs(rpn_b)),
-            jnp.asarray(limb.be_bytes_to_limbs(w_b)),
-            jnp.asarray(premask),
-            jnp.asarray(digests),
-            jnp.asarray(has_digest),
-        )
-        out = np.asarray(self._pipeline()(*args))
+        r_l = limb.be_bytes_to_limbs(r_b)
+        rpn_l = limb.be_bytes_to_limbs(rpn_b)
+        w_l = limb.be_bytes_to_limbs(w_b)
+
+        if 0 < len(key_map) <= self._max_keys:
+            self.stats["comb_batches"] += 1
+            out = self._dispatch_comb(bucket, key_map, key_idx, blocks,
+                                      nblocks, r_l, rpn_l, w_l, premask,
+                                      digests, has_digest)
+        else:
+            self.stats["ladder_batches"] += 1
+            qx_l = limb.be_bytes_to_limbs(qx_b)
+            qy_l = limb.be_bytes_to_limbs(qy_b)
+            args = tuple(jnp.asarray(a) for a in
+                         (blocks, nblocks, qx_l, qy_l, r_l, rpn_l, w_l,
+                          premask, digests, has_digest))
+            out = np.asarray(self._pipeline()(*args))
         return out[:n].tolist()
+
+    def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
+                       r_l, rpn_l, w_l, premask, digests, has_digest):
+        """Comb-method path: per-key tables built once, then the batch is
+        dispatched in chunks so host staging of chunk k+1 overlaps device
+        execution of chunk k (jax dispatch is async)."""
+        import jax.numpy as jnp
+
+        from fabric_tpu.ops import limb
+
+        K = 1
+        while K < len(key_map):
+            K *= 2
+        qk = np.zeros((K, 64), dtype=np.uint8)
+        for kb, i in key_map.items():
+            qk[i] = np.frombuffer(kb, dtype=np.uint8)
+        qx_k = limb.be_bytes_to_limbs(qk[:, :32])
+        qy_k = limb.be_bytes_to_limbs(qk[:, 32:])
+        q_flat = self._qtab_fn(K)(jnp.asarray(qx_k), jnp.asarray(qy_k))
+
+        chunk = min(bucket, self._chunk)
+        fn = self._comb_pipeline(K)
+        outs = []
+        for lo in range(0, bucket, chunk):
+            hi = lo + chunk
+            outs.append(fn(
+                jnp.asarray(blocks[lo:hi]), jnp.asarray(nblocks[lo:hi]),
+                jnp.asarray(key_idx[lo:hi]), q_flat,
+                jnp.asarray(r_l[lo:hi]), jnp.asarray(rpn_l[lo:hi]),
+                jnp.asarray(w_l[lo:hi]), jnp.asarray(premask[lo:hi]),
+                jnp.asarray(digests[lo:hi]),
+                jnp.asarray(has_digest[lo:hi])))
+        return np.concatenate([np.asarray(o) for o in outs])
+
+    def _qtab_fn(self, K: int):
+        if K not in self._qtab_fns:
+            import jax
+
+            from fabric_tpu.ops import comb
+            self._qtab_fns[K] = jax.jit(comb.build_q_tables)
+        return self._qtab_fns[K]
+
+    def _comb_pipeline(self, K: int):
+        if K not in self._comb_fns:
+            import jax
+
+            from fabric_tpu.ops import comb, sha256
+
+            def fused(blocks, nblocks, key_idx, q_flat, r, rpn, w,
+                      premask, digests, has_digest):
+                import jax.numpy as jnp
+                hashed = sha256.sha256_blocks(blocks, nblocks)
+                words = jnp.where(has_digest[:, None], digests, hashed)
+                return comb.comb_verify_with_tables(
+                    words, key_idx, q_flat, r, rpn, w, premask)
+
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                s = NamedSharding(self._mesh, P("batch"))
+                rep = NamedSharding(self._mesh, P())
+                self._comb_fns[K] = jax.jit(
+                    fused,
+                    in_shardings=(s, s, s, rep, s, s, s, s, s, s),
+                    out_shardings=s)
+            else:
+                self._comb_fns[K] = jax.jit(fused)
+        return self._comb_fns[K]
 
     def _pipeline(self):
         if self._fn is None:

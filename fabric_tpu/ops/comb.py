@@ -1,0 +1,202 @@
+"""Fixed-base comb ECDSA-P256 verification for key-grouped batches.
+
+The reference verifies each signature independently on CPU
+(`bccsp/sw/ecdsa.go:41-57`), so it cannot exploit the dominant structural
+fact about a Fabric block: the same handful of org endorser/creator keys
+signs thousands of transactions. This kernel does.
+
+For a batch whose signatures use K distinct public keys (K small — block
+reality is 2-8 orgs), R = u1*G + u2*Q is computed with the fixed-base comb
+method on BOTH bases:
+
+    R = sum_i  T_G[i][win_i(u1)]  +  sum_i  T_Q[key][i][win_i(u2)]
+
+with 8-bit windows (NWIN = 32 per scalar):
+  * T_G[i][j] = j * 2^(8i) * G  — host-precomputed constants (1.9 MB).
+  * T_Q[k][i][j] = j * 2^(8i) * Q_k — built ON DEVICE once per batch with
+    two lax.scans (~500 point ops at width NWIN*K), amortized over every
+    signature that shares the key.
+  * Per signature: 64 gathered points, tree-reduced with 6 vectorized
+    complete-add levels (63 adds) — and ZERO doublings, vs the generic
+    Shamir ladder's 256 doublings + 128 adds (fabric_tpu/ops/p256.py
+    double_scalar_mul). ~4.8x fewer field ops.
+
+Everything is branchless/fixed-shape; window j=0 gathers the point at
+infinity and the complete addition law absorbs it, so zero scalars and
+padded lanes need no special casing. Batches with many distinct keys fall
+back to the generic ladder in the provider (fabric_tpu/bccsp/tpu.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from fabric_tpu.ops import limb, p256
+from fabric_tpu.ops.limb import L, W
+from fabric_tpu.ops.p256 import FN, FP, cadd, cdbl
+
+WBITS = 8                   # comb window width (bits)
+NWIN = 256 // WBITS         # windows per 256-bit scalar
+NENT = 1 << WBITS           # table entries per window
+
+
+# ---------------------------------------------------------------------------
+# G-side tables (host-precomputed constants)
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=None)
+def g_tables() -> np.ndarray:
+    """(NWIN * NENT, 3, L) int32 — projective T_G[i*NENT + j] = j*2^(8i)*G.
+
+    Entry j=0 is the point at infinity (0 : 1 : 0). Built once per
+    process over Python ints (exact), cached.
+    """
+    out = np.zeros((NWIN * NENT, 3, L), dtype=np.int32)
+    base = (p256.GX, p256.GY, 1)
+    for i in range(NWIN):
+        acc = (0, 1, 0)
+        for j in range(NENT):
+            for c in range(3):
+                out[i * NENT + j, c] = limb.int_to_limbs(acc[c])
+            acc = p256.cadd_int(acc, base)
+        for _ in range(WBITS):
+            base = p256.cdbl_int(base)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Q-side tables (device, per distinct key)
+# ---------------------------------------------------------------------------
+
+def build_q_tables(qx, qy):
+    """(K, L) affine key coords -> (NWIN * K * NENT, 3, L) projective table.
+
+    flat[(i * K + k) * NENT + j] = j * 2^(8i) * Q_k.  Two scans:
+      1. window bases b_i = 2^(8i) * Q (31 steps of 8 doublings, width K);
+      2. running multiples j*b (NENT-2 adds, width NWIN*K).
+    Entries are semi-reduced projective coordinates — gathers copy bits,
+    and the complete add accepts semi-reduced inputs.
+    """
+    K = qx.shape[0]
+    ones = jnp.broadcast_to(jnp.asarray(limb.int_to_limbs(1)), (K, L))
+    zeros = jnp.zeros((K, L), dtype=jnp.int32)
+    q1 = (qx, qy, ones)
+
+    def dbl8(pt, _):
+        for _ in range(WBITS):
+            pt = cdbl(pt)
+        return pt, pt
+
+    _, shifted = lax.scan(dbl8, q1, None, length=NWIN - 1)
+    # bases: (NWIN, K, L) per coordinate
+    bases = tuple(
+        jnp.concatenate([q1[c][None], shifted[c]], axis=0) for c in range(3)
+    )
+
+    def step(acc, _):
+        nxt = cadd(acc, bases)
+        return nxt, nxt
+
+    _, multiples = lax.scan(step, bases, None, length=NENT - 2)
+    inf = (jnp.zeros((NWIN, K, L), jnp.int32),
+           jnp.broadcast_to(jnp.asarray(limb.int_to_limbs(1)), (NWIN, K, L)),
+           jnp.zeros((NWIN, K, L), jnp.int32))
+    # entries: (NENT, NWIN, K, L) per coord = [inf, base, 2*base, ...]
+    flat = []
+    for c in range(3):
+        ent = jnp.concatenate(
+            [inf[c][None], bases[c][None], multiples[c]], axis=0)
+        flat.append(jnp.transpose(ent, (1, 2, 0, 3)))   # (NWIN, K, NENT, L)
+    # (NWIN*K*NENT, 3, L)
+    return jnp.stack(
+        [f.reshape(NWIN * K * NENT, L) for f in flat], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Window extraction + combination
+# ---------------------------------------------------------------------------
+
+def _windows(u):
+    """Canonical (B, L) scalar -> (B, NWIN) int32 of 8-bit windows.
+
+    Window bit positions are static, so limb indices/shifts resolve at
+    trace time — no dynamic slicing.
+    """
+    cols = []
+    for i in range(NWIN):
+        bit0 = i * WBITS
+        j0, off = bit0 // W, bit0 % W
+        v = u[:, j0] >> off
+        if off + WBITS > W and j0 + 1 < L:
+            v = v | (u[:, j0 + 1] << (W - off))
+        cols.append(v & (NENT - 1))
+    return jnp.stack(cols, axis=1)
+
+
+def _tree_reduce(X, Y, Z):
+    """(B, M, L) point arrays -> (B, L) sum via log2(M) cadd levels."""
+    while X.shape[1] > 1:
+        if X.shape[1] % 2:          # pad with infinity
+            pad = [(0, 0), (0, 1), (0, 0)]
+            X = jnp.pad(X, pad)
+            Y = jnp.pad(Y, pad, constant_values=0)
+            Y = Y.at[:, -1, 0].set(1)
+            Z = jnp.pad(Z, pad)
+        X, Y, Z = cadd((X[:, 0::2], Y[:, 0::2], Z[:, 0::2]),
+                       (X[:, 1::2], Y[:, 1::2], Z[:, 1::2]))
+    return X[:, 0], Y[:, 0], Z[:, 0]
+
+
+def comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K: int):
+    """R = u1*G + u2*Q_{key_idx} for a batch, via two combs.
+
+    u1, u2: (B, L) canonical scalars; key_idx: (B,) int32 in [0, K);
+    g_flat: (NWIN*NENT, 3, L); q_flat: (NWIN*K*NENT, 3, L).
+    Returns projective (X, Y, Z) each (B, L).
+    """
+    w1 = _windows(u1)                       # (B, NWIN)
+    w2 = _windows(u2)
+    win = jnp.arange(NWIN, dtype=jnp.int32)[None, :]
+    g_idx = win * NENT + w1
+    q_idx = (win * K + key_idx[:, None]) * NENT + w2
+    pts_g = jnp.take(g_flat, g_idx, axis=0)     # (B, NWIN, 3, L)
+    pts_q = jnp.take(q_flat, q_idx, axis=0)
+    pts = jnp.concatenate([pts_g, pts_q], axis=1)
+    return _tree_reduce(pts[:, :, 0], pts[:, :, 1], pts[:, :, 2])
+
+
+def comb_verify_with_tables(digest_words, key_idx, q_flat, r, rpn, w,
+                            premask):
+    """Batched ECDSA accept/reject against a prebuilt Q-table.
+
+    q_flat: (NWIN*K*NENT, 3, L) from build_q_tables — built once per
+    block/batch and reused across pipelined chunks.
+    """
+    K = q_flat.shape[0] // (NWIN * NENT)
+    g_flat = jnp.asarray(g_tables())
+    e = limb.words_be_to_limbs(digest_words)
+    u1 = FN.canonical(FN.mulmod(e, w))
+    u2 = FN.canonical(FN.mulmod(r, w))
+    X, _, Z = comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K)
+    nonzero = jnp.any(FP.canonical(Z) != 0, axis=-1)
+    x_canon = FP.canonical(X)
+    ok1 = jnp.all(x_canon == FP.canonical(FP.mulmod(r, Z)), axis=-1)
+    ok2 = jnp.all(x_canon == FP.canonical(FP.mulmod(rpn, Z)), axis=-1)
+    return premask & nonzero & (ok1 | ok2)
+
+
+def comb_verify_core(digest_words, key_idx, qx_k, qy_k, r, rpn, w, premask):
+    """Batched ECDSA accept/reject over K distinct keys via comb tables.
+
+    digest_words: (B, 8) uint32; key_idx: (B,) int32 in [0, K);
+    qx_k, qy_k: (K, L) distinct-key affine limbs; r/rpn/w: (B, L)
+    canonical limbs (same contract as p256.verify_core); premask: (B,).
+    """
+    q_flat = build_q_tables(qx_k, qy_k)
+    return comb_verify_with_tables(
+        digest_words, key_idx, q_flat, r, rpn, w, premask)

@@ -1,5 +1,7 @@
 from fabric_tpu.parallel.mesh import (  # noqa: F401
+    BATCH_AXIS,
     batch_mesh,
     shard_batch,
+    sharded_comb_fns,
     sharded_verify_fn,
 )

@@ -53,3 +53,25 @@ def sharded_verify_fn(mesh: Mesh):
         in_shardings=_shardings(mesh),
         out_shardings=NamedSharding(mesh, P(BATCH_AXIS)),
     )
+
+
+def sharded_comb_fns(mesh: Mesh):
+    """(table_builder, verify_fn) for the comb kernel over `mesh`.
+
+    The per-key tables are small and read by every lane, so they are
+    REPLICATED to all devices; every per-signature operand is sharded on
+    the batch axis. This is the flagship kernel's multi-chip layout: no
+    collectives in the main path at all — each chip combs its own batch
+    shard against its local table copy.
+    """
+    from fabric_tpu.ops import comb
+
+    rep = NamedSharding(mesh, P())
+    s = NamedSharding(mesh, P(BATCH_AXIS))
+    build = jax.jit(comb.build_q_tables,
+                    in_shardings=(rep, rep), out_shardings=rep)
+    verify = jax.jit(
+        comb.comb_verify_with_tables,
+        in_shardings=(s, s, rep, s, s, s, s),
+        out_shardings=s)
+    return build, verify
