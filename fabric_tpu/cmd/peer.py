@@ -69,6 +69,13 @@ def cmd_node_rebuild(args) -> int:
     return 0
 
 
+def cmd_node_upgrade(args) -> int:
+    from fabric_tpu.internal import nodeops
+    done = nodeops.upgrade_dbs(args.ledger_root)
+    print(f"upgraded: {', '.join(done) or '(none — all current)'}")
+    return 0
+
+
 def cmd_node_reset(args) -> int:
     from fabric_tpu.internal import nodeops
     done = nodeops.reset(args.ledger_root)
@@ -280,6 +287,7 @@ def main(argv=None) -> int:
     start.set_defaults(fn=cmd_node_start)
     for verb, fn in (("rollback", cmd_node_rollback),
                      ("rebuild-dbs", cmd_node_rebuild),
+                     ("upgrade-dbs", cmd_node_upgrade),
                      ("reset", cmd_node_reset),
                      ("unjoin", cmd_node_unjoin),
                      ("pause", cmd_node_pause),

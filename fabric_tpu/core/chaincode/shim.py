@@ -23,6 +23,10 @@ OK = 200
 ERRORTHRESHOLD = 400
 ERROR = 500
 
+# metadata key under which state-based endorsement policies live
+# (reference: pkg/statedata + shim SetStateValidationParameter)
+VALIDATION_PARAMETER = "VALIDATION_PARAMETER"
+
 Response = pb.Response
 
 
@@ -56,7 +60,8 @@ class ChaincodeStub:
                  creator: bytes = b"",
                  transient: Optional[dict] = None,
                  support=None,
-                 timestamp: int = 0):
+                 timestamp: int = 0,
+                 ledger=None):
         self._channel_id = channel_id
         self._tx_id = tx_id
         self._ns = namespace
@@ -66,6 +71,7 @@ class ChaincodeStub:
         self._transient = dict(transient or {})
         self._support = support
         self._timestamp = timestamp
+        self._ledger = ledger
         self._event: Optional[pb.ChaincodeEvent] = None
 
     # -- invocation context --
@@ -109,10 +115,39 @@ class ChaincodeStub:
     def del_state(self, key: str) -> None:
         self._sim.del_state(self._ns, key)
 
+    def set_state_validation_parameter(self, key: str,
+                                       policy: bytes) -> None:
+        """Attach a key-level endorsement policy (state-based
+        endorsement; reference shim SetStateValidationParameter →
+        metadata write of VALIDATION_PARAMETER). Empty bytes removes
+        the parameter, restoring the chaincode-level policy."""
+        md = self._sim.get_state_metadata(self._ns, key)
+        if policy:
+            md[VALIDATION_PARAMETER] = policy
+        else:
+            md.pop(VALIDATION_PARAMETER, None)
+        self._sim.set_state_metadata(self._ns, key, md)
+
+    def get_state_validation_parameter(self, key: str) -> Optional[bytes]:
+        return self._sim.get_state_metadata(self._ns, key).get(
+            VALIDATION_PARAMETER)
+
     def get_state_by_range(self, start: str, end: str):
         """Iterate (key, value) in [start, end); '' means unbounded,
         matching the reference's GetStateByRange semantics."""
         return self._sim.get_state_range(self._ns, start, end)
+
+    def get_history_for_key(self, key: str):
+        """Newest-first history of committed values for `key` —
+        {tx_id, value, is_delete, block, tx} dicts (reference:
+        `handler.go` HandleGetHistoryForKey → ledger history DB). A
+        committed-state query: results are NOT recorded in the rwset,
+        exactly like the reference."""
+        if self._ledger is None:
+            raise NotImplementedError(
+                "history queries need a ledger-wired stub (endorser "
+                "invocations have one; this context does not)")
+        return self._ledger.get_history_for_key(self._ns, key)
 
     def get_query_result(self, query: str):
         """Rich JSON-selector query (reference GetQueryResult; the
@@ -152,6 +187,22 @@ class ChaincodeStub:
 
     def del_private_data(self, collection: str, key: str) -> None:
         self._pvt_sim().del_private_data(self._ns, collection, key)
+
+    def set_private_data_validation_parameter(self, collection: str,
+                                              key: str,
+                                              policy: bytes) -> None:
+        sim = self._pvt_sim()
+        md = sim.get_private_data_metadata(self._ns, collection, key)
+        if policy:
+            md[VALIDATION_PARAMETER] = policy
+        else:
+            md.pop(VALIDATION_PARAMETER, None)
+        sim.set_private_data_metadata(self._ns, collection, key, md)
+
+    def get_private_data_validation_parameter(self, collection: str,
+                                              key: str) -> Optional[bytes]:
+        return self._pvt_sim().get_private_data_metadata(
+            self._ns, collection, key).get(VALIDATION_PARAMETER)
 
     # -- events --
 

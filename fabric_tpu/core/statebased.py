@@ -1,0 +1,218 @@
+"""Key-level (state-based) endorsement validation.
+
+Rebuild of `core/common/validation/statebased/validator_keylevel.go:1`
+and `vpmanagerimpl.go`, wired into the default VSCC the way
+`core/handlers/validation/builtin/v20/validation_logic.go:185` does.
+
+Semantics (matching the reference):
+  * A key may carry a VALIDATION_PARAMETER metadata entry — an
+    endorsement policy that OVERRIDES the chaincode-level policy for
+    writes (and metadata updates) to that key.
+  * A tx must satisfy the key-level policy of EVERY key it writes that
+    has one; the chaincode-level policy is evaluated only if the tx
+    writes at least one key with no key-level policy (or writes no keys
+    at all).
+  * Same-block ordering: if an earlier tx in the block updates a key's
+    validation parameter and is VALID, later txs in the block see the
+    NEW parameter; if it is invalid, the committed parameter applies.
+    The reference resolves this with a dependency/wait graph across its
+    parallel validator pool (vpmanagerimpl.go); this validator's policy
+    phase is sequential in block order, so the graph degenerates to the
+    `BlockOverlay` dict updated as verdicts land.
+
+Batch-first shape: the endorsement signature set is registered ONCE per
+tx in the block-wide verify batch (phase 2); every policy — chaincode
+level, implicit-collection org rules, and key-level parameters resolved
+at finish time — is then pure principal matching over the recovered
+valid identities (phase 3, no crypto).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from fabric_tpu.common.policies import cauthdsl
+from fabric_tpu.common.policies import policy as papi
+from fabric_tpu.core.chaincode.shim import VALIDATION_PARAMETER
+from fabric_tpu.ledger import pvtdata as pvt
+from fabric_tpu.ledger.txmgr import deserialize_metadata
+from fabric_tpu.protos import policies as polpb
+
+logger = logging.getLogger("statebased")
+
+
+@dataclass
+class WriteSetInfo:
+    """What the VSCC learned from a tx's rwset (extraction phase)."""
+    namespace: str = ""              # the chaincode whose rwset this is
+    implicit_orgs: tuple = ()        # orgs whose _implicit_org_ colls are written
+    public_writes: bool = False
+    other_coll_writes: bool = False
+    # every written/metadata-updated key: public ones as (None, key),
+    # named-collection ones as (coll, hashed_key_str)
+    written_keys: list = field(default_factory=list)
+    # this tx's VALIDATION_PARAMETER updates, applied to the overlay if
+    # the tx commits VALID: (coll_or_None, key) -> policy bytes
+    # (b"" = parameter removed — key deleted or VP entry dropped)
+    vp_updates: dict = field(default_factory=dict)
+
+
+class BlockOverlay:
+    """VALIDATION_PARAMETER updates by earlier VALID txs of this block,
+    keyed by (chaincode namespace, collection, key) — two chaincodes
+    writing the same key name must never see each other's parameters."""
+
+    def __init__(self):
+        self._vp: dict[tuple[str, Optional[str], str], bytes] = {}
+
+    def get(self, ns: str, coll: Optional[str],
+            key: str) -> Optional[bytes]:
+        """None = no in-block update; b'' = parameter removed."""
+        return self._vp.get((ns, coll, key))
+
+    def apply(self, info: WriteSetInfo) -> None:
+        for (coll, key), vp in info.vp_updates.items():
+            self._vp[(info.namespace, coll, key)] = vp
+
+
+def extract_write_info(cc_name: str, txrw, kv_parser, hashed_parser
+                       ) -> WriteSetInfo:
+    """Walk a parsed TxReadWriteSet for the VSCC (helper for
+    txvalidator._extract_endorsement_set)."""
+    info = WriteSetInfo(namespace=cc_name)
+    implicit: list[str] = []
+    for nsrw in txrw.ns_rwset:
+        if nsrw.namespace != cc_name:
+            continue
+        kv = kv_parser(nsrw.rwset)
+        for w in kv.writes:
+            info.written_keys.append((None, w.key))
+            if w.is_delete:
+                info.vp_updates[(None, w.key)] = b""
+        for mw in kv.metadata_writes:
+            info.written_keys.append((None, mw.key))
+            vp = b""
+            for e in mw.entries:
+                if e.name == VALIDATION_PARAMETER:
+                    vp = e.value
+            info.vp_updates[(None, mw.key)] = vp
+        if kv.writes:
+            info.public_writes = True
+        for chrw in nsrw.collection_hashed_rwset:
+            hset = hashed_parser(chrw.rwset)
+            name = chrw.collection_name
+            is_implicit = name.startswith("_implicit_org_")
+            if is_implicit:
+                if hset.hashed_writes or hset.metadata_writes:
+                    implicit.append(name[len("_implicit_org_"):])
+                continue
+            for hw in hset.hashed_writes:
+                hkey = pvt.hashed_key_str(hw.key_hash)
+                info.other_coll_writes = True
+                info.written_keys.append((name, hkey))
+                if hw.is_delete:
+                    info.vp_updates[(name, hkey)] = b""
+            for mw in hset.metadata_writes:
+                hkey = pvt.hashed_key_str(mw.key_hash)
+                info.other_coll_writes = True
+                info.written_keys.append((name, hkey))
+                vp = b""
+                for e in mw.entries:
+                    if e.name == VALIDATION_PARAMETER:
+                        vp = e.value
+                info.vp_updates[(name, hkey)] = vp
+    info.implicit_orgs = tuple(implicit)
+    return info
+
+
+def resolve_vp_policy(vp_bytes: bytes, evaluator, deserializer, csp):
+    """A validation parameter is ApplicationPolicy bytes (the lifecycle
+    format) or a bare SignaturePolicyEnvelope (what the reference's
+    statebased shim helpers emit). Accept both."""
+    try:
+        app = polpb.ApplicationPolicy()
+        app.ParseFromString(vp_bytes)
+        if app.WhichOneof("type") is not None:
+            return evaluator.resolve(vp_bytes)
+    except Exception:
+        pass
+    return cauthdsl.SignaturePolicy.from_bytes(vp_bytes, deserializer, csp)
+
+
+class KeyLevelPrepared:
+    """Two-phase VSCC evaluation with key-level policy resolution
+    deferred to finish() — when the block overlay is authoritative for
+    every earlier tx.
+
+    items: the endorsement SignedData set, registered ONCE; all policy
+    math happens over the valid identities it yields.
+    """
+
+    def __init__(self, *, cc_policy, org_policies, info: WriteSetInfo,
+                 overlay: BlockOverlay, cc_name: str,
+                 metadata_getter: Callable[[Optional[str], str],
+                                           Optional[bytes]],
+                 evaluator, deserializer, csp, endorsement_sd):
+        self._cc_policy = cc_policy
+        self._org_policies = list(org_policies)
+        self._info = info
+        self._overlay = overlay
+        self._cc_name = cc_name
+        self._get_md = metadata_getter
+        self._evaluator = evaluator
+        self._deserializer = deserializer
+        self._csp = csp
+        self._prepared = papi.prepare_signature_set(
+            endorsement_sd, deserializer)
+
+    @property
+    def items(self):
+        return self._prepared.items
+
+    def _validation_parameter(self, coll: Optional[str],
+                              key: str) -> bytes:
+        vp = self._overlay.get(self._cc_name, coll, key)
+        if vp is not None:
+            return vp
+        raw = self._get_md(coll, key)
+        return deserialize_metadata(raw).get(VALIDATION_PARAMETER, b"")
+
+    def finish(self, flags) -> None:
+        identities = self._prepared.finish(flags)
+        # implicit-collection org rules always apply to their writes
+        for pol in self._org_policies:
+            pol.evaluate_identities(identities)
+
+        info = self._info
+        uncovered = not info.written_keys    # no writes → cc policy
+        evaluated: set[bytes] = set()
+        for coll, key in info.written_keys:
+            vp = self._validation_parameter(coll, key)
+            if not vp:
+                uncovered = True
+                continue
+            if vp in evaluated:
+                continue
+            evaluated.add(vp)
+            try:
+                pol = resolve_vp_policy(vp, self._evaluator,
+                                        self._deserializer, self._csp)
+            except Exception as e:
+                raise papi.PolicyError(
+                    f"unresolvable validation parameter on key "
+                    f"[{self._cc_name}/{coll or ''}/{key}]: {e}") from e
+            pol.evaluate_identities(identities)
+
+        if info.implicit_orgs and not info.written_keys:
+            # a pure _lifecycle approval (implicit-collection writes
+            # only) validates against the org rules alone
+            return
+        if uncovered and self._cc_policy is not None:
+            self._cc_policy.evaluate_identities(identities)
+
+    def record_valid(self) -> None:
+        """Called by the validator when this tx's verdict is VALID —
+        its VP updates become visible to later txs in the block."""
+        self._overlay.apply(self._info)

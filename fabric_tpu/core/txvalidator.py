@@ -34,10 +34,9 @@ from typing import Callable, Optional
 from fabric_tpu.protos import common, proposal as pb, transaction as txpb
 from fabric_tpu.protoutil import protoutil as pu
 from fabric_tpu.common.policies import policy as papi
-from fabric_tpu.core import msgvalidation
+from fabric_tpu.core import msgvalidation, statebased
 from fabric_tpu.core.policycheck import (
-    ApplicationPolicyEvaluator, CombinedPrepared,
-    org_member_policy_bytes, prepare_policy,
+    ApplicationPolicyEvaluator, org_member_policy_bytes,
 )
 
 logger = logging.getLogger("txvalidator")
@@ -75,6 +74,7 @@ class TxValidator:
         self._csp = csp
         self._cc_definition = cc_definition
         self._configtx_validator_source = configtx_validator_source
+        self._overlay = statebased.BlockOverlay()
 
     # -- phase 1 helpers --
 
@@ -99,33 +99,27 @@ class TxValidator:
                           identity=e.endorser, signature=e.signature)
             for e in cap.action.endorsements
         ]
-        # written collections drive collection-level validation rules
-        # (reference: v20 plugin + implicit-collection policies)
+        # written keys/collections drive collection-level and key-level
+        # (state-based) validation rules (reference: v20 plugin +
+        # statebased validator_keylevel)
         from fabric_tpu.protos import rwset as rwpb
-        implicit_orgs: list[str] = []
-        public_writes = False
-        other_coll_writes = False
+
+        def kv_parser(raw):
+            kv = rwpb.KVRWSet()
+            kv.ParseFromString(raw)
+            return kv
+
+        def hashed_parser(raw):
+            h = rwpb.HashedRWSet()
+            h.ParseFromString(raw)
+            return h
+
         try:
             txrw = rwpb.TxReadWriteSet()
             txrw.ParseFromString(cc_action.results)
-            for nsrw in txrw.ns_rwset:
-                if nsrw.namespace != cc_action.chaincode_id.name:
-                    continue
-                kv = rwpb.KVRWSet()
-                kv.ParseFromString(nsrw.rwset)
-                if kv.writes:
-                    public_writes = True
-                for chrw in nsrw.collection_hashed_rwset:
-                    hset = rwpb.HashedRWSet()
-                    hset.ParseFromString(chrw.rwset)
-                    if not hset.hashed_writes:
-                        continue
-                    name = chrw.collection_name
-                    if name.startswith("_implicit_org_"):
-                        implicit_orgs.append(
-                            name[len("_implicit_org_"):])
-                    else:
-                        other_coll_writes = True
+            write_info = statebased.extract_write_info(
+                cc_action.chaincode_id.name, txrw, kv_parser,
+                hashed_parser)
         except Exception as e:
             # an unparsable rwset must fail validation loudly: silently
             # defaulting to "no collection writes" would validate the
@@ -134,8 +128,6 @@ class TxValidator:
             # INVALID_ENDORSER_TRANSACTION)
             raise ValueError(f"malformed results/rwset in chaincode "
                              f"action: {e}") from e
-        write_info = (tuple(implicit_orgs), public_writes,
-                      other_coll_writes)
         return cc_action.chaincode_id.name, sd, write_info
 
     def _endorsement_policy(self, bundle, cc_name: str):
@@ -167,28 +159,42 @@ class TxValidator:
 
     def builtin_vscc_prepare(self, bundle, cc_name: str,
                              endorsement_sd, write_info):
-        """Compose the tx's validation policy from the chaincode policy
-        and implicit-collection write rules: a tx writing ONLY its own
-        org's implicit collection (a _lifecycle approval) validates
-        against that org alone; implicit writes mixed with anything
-        else require the org rules AND the chaincode policy."""
-        implicit_orgs, public_writes, other_coll = write_info
+        """Compose the tx's validation policy from the chaincode policy,
+        implicit-collection write rules, and key-level (state-based)
+        endorsement parameters: a tx writing ONLY its own org's implicit
+        collection (a _lifecycle approval) validates against that org
+        alone; keys carrying a VALIDATION_PARAMETER validate against
+        that policy (resolved at finish time so same-block parameter
+        updates by earlier valid txs apply); the chaincode-level policy
+        is required whenever any written key has no key-level policy."""
         evaluator = ApplicationPolicyEvaluator(
             bundle.policy_manager, bundle.msp_manager, self._csp)
-        org_parts = [
-            prepare_policy(evaluator.resolve(
-                org_member_policy_bytes(org)), endorsement_sd)
-            for org in implicit_orgs
+        org_policies = [
+            evaluator.resolve(org_member_policy_bytes(org))
+            for org in write_info.implicit_orgs
         ]
-        if implicit_orgs and not public_writes and not other_coll:
-            if len(org_parts) == 1:
-                return org_parts[0]
-            return CombinedPrepared(org_parts)
-        base = prepare_policy(
-            self._endorsement_policy(bundle, cc_name), endorsement_sd)
-        if not org_parts:
-            return base
-        return CombinedPrepared([base] + org_parts)
+        state_db = getattr(self._ledger, "state_db", None)
+
+        def metadata_getter(coll, key):
+            if state_db is None:
+                return None
+            if coll is None:
+                return state_db.get_state_metadata(cc_name, key)
+            from fabric_tpu.ledger import pvtdata as pvt
+            return state_db.get_state_metadata(
+                pvt.hash_ns(cc_name, coll), key)
+
+        return statebased.KeyLevelPrepared(
+            cc_policy=self._endorsement_policy(bundle, cc_name),
+            org_policies=org_policies,
+            info=write_info,
+            overlay=self._overlay,
+            cc_name=cc_name,
+            metadata_getter=metadata_getter,
+            evaluator=evaluator,
+            deserializer=bundle.msp_manager,
+            csp=self._csp,
+            endorsement_sd=endorsement_sd)
 
     def _validate_config_tx(self, index: int, config_bytes: bytes) -> int:
         """Replay the config update embedded in a CONFIG tx against the
@@ -245,6 +251,9 @@ class TxValidator:
         later, at commit (`kvledger.commit_block`)."""
         t0 = time.perf_counter()
         bundle = self._bundle_source()
+        # fresh per-block overlay for same-block validation-parameter
+        # updates (statebased.BlockOverlay)
+        self._overlay = statebased.BlockOverlay()
         n = len(block.data.data)
         codes: list[int] = [TVC.NOT_VALIDATED] * n
         checks: list[_TxCheck] = []
@@ -348,6 +357,12 @@ class TxValidator:
                 codes[c.index] = TVC.INVALID_OTHER_REASON
                 continue
             codes[c.index] = TVC.VALID
+            # a VALID tx's validation-parameter updates become visible
+            # to later txs in this block (reference: vpmanagerimpl
+            # SetTxValidationResult → dependency release)
+            record = getattr(c.prepared_policy, "record_valid", None)
+            if record is not None:
+                record()
 
         # init-extend metadata first (reference protoutil.CopyBlockMetadata
         # semantics): a block from a rogue orderer may arrive with no

@@ -60,6 +60,50 @@ def rebuild_dbs(ledger_root: str) -> list[str]:
     return done
 
 
+def upgrade_dbs(ledger_root: str) -> list[str]:
+    """Migrate ledgers whose derived DBs were written by an older
+    binary (reference: `internal/peer/node/upgrade_dbs.go`): drop the
+    format-bound keyspaces and stamp the current data format; the next
+    `peer node start` replays them from the block store in the new
+    encoding. Ledgers already at the current format are untouched."""
+    from fabric_tpu.ledger.kvledger import KVLedger
+
+    done = []
+    for channel in _channels(ledger_root):
+        path = os.path.join(ledger_root, channel, "index.db")
+        kv = KVStore(path)
+        meta = DBHandle(kv, "ledgermeta")
+        fmt = meta.get(b"datafmt") or b"1.0"
+        if fmt == KVLedger.DATA_FORMAT:
+            kv.close()
+            logger.info("%s already at data format %s", channel,
+                        fmt.decode())
+            continue
+        # a snapshot-bootstrapped channel has no blocks before the
+        # boundary — dropping its statedb would destroy state that can
+        # NEVER be replayed locally (rollback() guards the same edge)
+        store = BlockStore(os.path.join(ledger_root, channel),
+                           DBHandle(kv, "blkindex"))
+        first = store.first_block
+        store.close()
+        if first > 0:
+            kv.close()
+            logger.warning(
+                "%s was bootstrapped from a snapshot (first local "
+                "block %d): cannot upgrade in place — unjoin and "
+                "re-join from a snapshot taken by an upgraded peer",
+                channel, first)
+            continue
+        _drop_keyspaces(kv, _REBUILD_ONLY)
+        meta.put(b"datafmt", KVLedger.DATA_FORMAT)
+        kv.close()
+        done.append(channel)
+        logger.info("upgraded %s: %s -> %s (derived DBs dropped for "
+                    "replay)", channel, fmt.decode(),
+                    KVLedger.DATA_FORMAT.decode())
+    return done
+
+
 def rollback(ledger_root: str, channel: str, target_height: int) -> None:
     """Truncate `channel` to `target_height` blocks; derived DBs are
     dropped for full replay (includes the pvt store: cleartext above

@@ -86,8 +86,31 @@ class KVLedger:
             DBHandle(self._kv, "snapshotreq"))
         self._meta = DBHandle(self._kv, "ledgermeta")
 
+        self._check_data_format()
         self._recover_dbs()
         self._commit_hash = self._load_commit_hash()
+
+    DATA_FORMAT = b"2.0"   # bump when derived-DB encodings change
+
+    def _check_data_format(self) -> None:
+        """Refuse to serve data written in an older derived-DB format
+        (reference: dataformat.CheckVersion → 'run peer node
+        upgrade-dbs'). Fresh ledgers are stamped with the current
+        format; `peer node upgrade-dbs` drops derived DBs and restamps
+        so the next open replays them in the new encoding."""
+        fmt = self._meta.get(b"datafmt")
+        if fmt is None:
+            if self.block_store.height == 0 and \
+                    self.state_db.savepoint() is None:
+                self._meta.put(b"datafmt", self.DATA_FORMAT)
+                return
+            fmt = b"1.0"   # pre-versioning data
+        if fmt != self.DATA_FORMAT:
+            raise LedgerError(
+                f"ledger {self.ledger_id!r} holds data in format "
+                f"{fmt.decode()} but this binary requires "
+                f"{self.DATA_FORMAT.decode()}; run "
+                f"`peer node upgrade-dbs` first")
 
     # -- lifecycle --
 

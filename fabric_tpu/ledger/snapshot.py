@@ -78,7 +78,7 @@ def generate_snapshot(ledger, out_dir: str) -> dict:
             if "$$p$" in ns:
                 continue  # private cleartext stays home
             _write_record(f, ns.encode(), key.encode(),
-                          vv.version.pack(), vv.value)
+                          vv.version.pack(), vv.value, vv.metadata)
 
     txids_path = os.path.join(out_dir, TXIDS_FILE)
     with open(txids_path, "wb") as f:
@@ -99,6 +99,10 @@ def generate_snapshot(ledger, out_dir: str) -> dict:
         f.write(cfg_block.SerializeToString())
 
     meta = {
+        # record arity of public_state.data: "2.0" = 5 fields
+        # (ns, key, version, value, metadata); absent = the 4-field
+        # pre-metadata format — import_into reads both
+        "data_format": "2.0",
         "channel_name": ledger.ledger_id,
         "last_block_number": height - 1,
         "last_block_hash": pu.block_header_hash(last.header).hex(),
@@ -146,10 +150,13 @@ def import_into(ledger, snapshot_dir: str) -> None:
 
     batch = UpdateBatch()
     count = 0
-    for ns, key, ver, value in _read_records(
-            os.path.join(snapshot_dir, STATE_FILE), 4):
+    arity = 5 if meta.get("data_format") == "2.0" else 4
+    for rec in _read_records(
+            os.path.join(snapshot_dir, STATE_FILE), arity):
+        ns, key, ver, value = rec[:4]
+        metadata = rec[4] if arity == 5 else b""
         batch.put(ns.decode(), key.decode(), value,
-                  Height.unpack(ver))
+                  Height.unpack(ver), metadata=metadata)
         count += 1
         if count % 10000 == 0:
             ledger.state_db.apply_writes_only(batch)

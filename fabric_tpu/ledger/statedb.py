@@ -37,6 +37,10 @@ class Height:
 class VersionedValue:
     value: bytes
     version: Height
+    # serialized repeated KVMetadataEntry (state-based endorsement
+    # parameters etc.) — shares the key's version, exactly like the
+    # reference's statedb.VersionedValue{Value, Metadata, Version}
+    metadata: bytes = b""
 
 
 class UpdateBatch:
@@ -46,8 +50,9 @@ class UpdateBatch:
     def __init__(self):
         self.updates: dict[tuple[str, str], Optional[VersionedValue]] = {}
 
-    def put(self, ns: str, key: str, value: bytes, version: Height) -> None:
-        self.updates[(ns, key)] = VersionedValue(value, version)
+    def put(self, ns: str, key: str, value: bytes, version: Height,
+            metadata: bytes = b"") -> None:
+        self.updates[(ns, key)] = VersionedValue(value, version, metadata)
 
     def delete(self, ns: str, key: str, version: Height) -> None:
         self.updates[(ns, key)] = None
@@ -57,6 +62,18 @@ class UpdateBatch:
         if (ns, key) in self.updates:
             return True, self.updates[(ns, key)]
         return False, None
+
+
+def _encode(vv: VersionedValue) -> bytes:
+    """version(16) | u32 metadata length | metadata | value."""
+    md = vv.metadata or b""
+    return vv.version.pack() + struct.pack(">I", len(md)) + md + vv.value
+
+
+def _decode(raw: bytes) -> VersionedValue:
+    version = Height.unpack(raw[:16])
+    (mdlen,) = struct.unpack(">I", raw[16:20])
+    return VersionedValue(raw[20 + mdlen:], version, raw[20:20 + mdlen])
 
 
 class StateDB:
@@ -71,8 +88,13 @@ class StateDB:
         raw = self._db.get(self._k(ns, key))
         if raw is None:
             return None
-        version = Height.unpack(raw[:16])
-        return VersionedValue(raw[16:], version)
+        return _decode(raw)
+
+    def get_state_metadata(self, ns: str, key: str) -> Optional[bytes]:
+        """Serialized metadata entries of a key, or None when the key is
+        absent/has no metadata (reference: statedb GetStateMetadata)."""
+        vv = self.get_state(ns, key)
+        return vv.metadata if vv and vv.metadata else None
 
     def get_version(self, ns: str, key: str) -> Optional[Height]:
         vv = self.get_state(ns, key)
@@ -88,7 +110,7 @@ class StateDB:
         hi = self._k(ns, end_key) if end_key else ns.encode() + b"\x01"
         for k, raw in self._db.iterate(lo, hi):
             key = k.split(_SEP, 1)[1].decode()
-            yield key, VersionedValue(raw[16:], Height.unpack(raw[:16]))
+            yield key, _decode(raw)
 
     def apply_updates(self, batch: UpdateBatch, height: Height) -> None:
         """Atomically apply a block's updates + the savepoint
@@ -98,7 +120,7 @@ class StateDB:
             if vv is None:
                 wb.delete(self._k(ns, key))
             else:
-                wb.put(self._k(ns, key), vv.version.pack() + vv.value)
+                wb.put(self._k(ns, key), _encode(vv))
         wb.put(_SAVEPOINT, height.pack())
         self._db.write_batch(wb)
 
@@ -109,8 +131,7 @@ class StateDB:
             if k == _SAVEPOINT:
                 continue
             ns, _, key = k.partition(_SEP)
-            yield (ns.decode(), key.decode(),
-                   VersionedValue(raw[16:], Height.unpack(raw[:16])))
+            yield (ns.decode(), key.decode(), _decode(raw))
 
     def apply_writes_only(self, batch: UpdateBatch) -> None:
         """Apply updates WITHOUT advancing the savepoint — the
@@ -121,7 +142,7 @@ class StateDB:
             if vv is None:
                 wb.delete(self._k(ns, key))
             else:
-                wb.put(self._k(ns, key), vv.version.pack() + vv.value)
+                wb.put(self._k(ns, key), _encode(vv))
         self._db.write_batch(wb)
 
     def savepoint(self) -> Optional[Height]:

@@ -32,6 +32,24 @@ class PvtDataNotAvailable(Exception):
     cleartext — the chaincode call must fail, not silently read None."""
 
 
+# -- key metadata codec (state-based endorsement parameters etc.) --
+# Stored form: a KVMetadataWrite with only `entries` set, deterministic.
+
+def serialize_metadata(entries: dict[str, bytes]) -> bytes:
+    mw = rwpb.KVMetadataWrite()
+    for name in sorted(entries):
+        mw.entries.add(name=name, value=entries[name])
+    return mw.SerializeToString(deterministic=True)
+
+
+def deserialize_metadata(raw: Optional[bytes]) -> dict[str, bytes]:
+    if not raw:
+        return {}
+    mw = rwpb.KVMetadataWrite()
+    mw.ParseFromString(raw)
+    return {e.name: e.value for e in mw.entries}
+
+
 def _pb_version(v: Optional[Height]) -> Optional[rwpb.Version]:
     if v is None:
         return None
@@ -61,6 +79,11 @@ class TxSimulator:
                               Optional[Height]] = {}
         self._pvt_writes: dict[tuple[str, str, str],
                                Optional[bytes]] = {}
+        # key metadata updates (VALIDATION_PARAMETER etc.) — full-map
+        # replacement per key, like the reference's SetStateMetadata
+        self._metadata_writes: dict[tuple[str, str], dict[str, bytes]] = {}
+        self._pvt_metadata_writes: dict[tuple[str, str, str],
+                                        dict[str, bytes]] = {}
         self._done = False
 
     # -- chaincode-facing ops --
@@ -81,6 +104,22 @@ class TxSimulator:
 
     def del_state(self, ns: str, key: str) -> None:
         self._writes[(ns, key)] = None
+
+    def get_state_metadata(self, ns: str, key: str) -> dict[str, bytes]:
+        """Key metadata map (read-your-writes). NOT recorded in the
+        read-set — like the reference's queryExecutor metadata reads,
+        which are not MVCC-tracked (the VSCC re-reads committed
+        metadata at validation time instead)."""
+        if (ns, key) in self._metadata_writes:
+            return dict(self._metadata_writes[(ns, key)])
+        return deserialize_metadata(
+            self._db.get_state_metadata(ns, key))
+
+    def set_state_metadata(self, ns: str, key: str,
+                           metadata: dict[str, bytes]) -> None:
+        if not key:
+            raise ValueError("empty key")
+        self._metadata_writes[(ns, key)] = dict(metadata)
 
     def get_state_range(self, ns: str, start: str, end: str,
                         limit: int = 0) -> list[tuple[str, bytes]]:
@@ -164,6 +203,19 @@ class TxSimulator:
     def del_private_data(self, ns: str, coll: str, key: str) -> None:
         self._pvt_writes[(ns, coll, key)] = None
 
+    def get_private_data_metadata(self, ns: str, coll: str, key: str
+                                  ) -> dict[str, bytes]:
+        if (ns, coll, key) in self._pvt_metadata_writes:
+            return dict(self._pvt_metadata_writes[(ns, coll, key)])
+        return deserialize_metadata(self._db.get_state_metadata(
+            pvt.hash_ns(ns, coll), pvt.hashed_key_str(pvt.key_hash(key))))
+
+    def set_private_data_metadata(self, ns: str, coll: str, key: str,
+                                  metadata: dict[str, bytes]) -> None:
+        if not key:
+            raise ValueError("empty key")
+        self._pvt_metadata_writes[(ns, coll, key)] = dict(metadata)
+
     # -- result --
 
     def get_tx_simulation_results(self) -> rwpb.TxReadWriteSet:
@@ -187,6 +239,10 @@ class TxSimulator:
                 kw.is_delete = True
             else:
                 kw.value = value
+        for (ns, key), entries in sorted(self._metadata_writes.items()):
+            mw = ns_set(ns).metadata_writes.add(key=key)
+            for name in sorted(entries):
+                mw.entries.add(name=name, value=entries[name])
 
         # hashed collection rwsets ride in the PUBLIC results — that is
         # what goes on-chain and what MVCC replays on every peer
@@ -203,6 +259,12 @@ class TxSimulator:
                 hw.is_delete = True
             else:
                 hw.value_hash = pvt.value_hash(value)
+        for (ns, coll, key), entries in sorted(
+                self._pvt_metadata_writes.items()):
+            h = hashed_by_nc.setdefault((ns, coll), rwpb.HashedRWSet())
+            mw = h.metadata_writes.add(key_hash=pvt.key_hash(key))
+            for name in sorted(entries):
+                mw.entries.add(name=name, value=entries[name])
 
         pvt_colls = self._pvt_collection_rwsets()
         txrw = rwpb.TxReadWriteSet(data_model=rwpb.TxReadWriteSet.KV)
@@ -366,23 +428,61 @@ class TxMgr:
             current = current[:len(expected)]
         return current == expected
 
+    def _existing(self, ns: str, key: str, batch: UpdateBatch):
+        """Current VersionedValue: this block's batch first, then
+        committed state. None when absent/deleted."""
+        in_batch, vv = batch.get(ns, key)
+        if in_batch:
+            return vv
+        return self.statedb.get_state(ns, key)
+
+    def _apply_ns_writes(self, ns: str, writes, metadata_writes,
+                        batch: UpdateBatch, height: Height) -> None:
+        """Value + metadata writes of one tx within one namespace.
+
+        Reference semantics (validator batch preparation + statedb):
+        a value write preserves the key's existing metadata unless the
+        same tx also writes metadata; a metadata-only write to an
+        absent key is a no-op; a delete clears both.
+        """
+        md_map = {}
+        for mw in metadata_writes:
+            md_map[mw.key] = serialize_metadata(
+                {e.name: e.value for e in mw.entries})
+        for w in writes:
+            if w.is_delete:
+                md_map.pop(w.key, None)
+                batch.delete(ns, w.key, height)
+                continue
+            if w.key in md_map:
+                md = md_map.pop(w.key)
+            else:
+                cur = self._existing(ns, w.key, batch)
+                md = cur.metadata if cur else b""
+            batch.put(ns, w.key, w.value, height, metadata=md)
+        for key, md in md_map.items():          # metadata-only updates
+            cur = self._existing(ns, key, batch)
+            if cur is None:
+                continue
+            batch.put(ns, key, cur.value, height, metadata=md)
+
     def _apply_writes(self, txrw, batch: UpdateBatch,
                       height: Height) -> None:
         for nsrw in txrw.ns_rwset:
             kv = rwpb.KVRWSet()
             kv.ParseFromString(nsrw.rwset)
-            for w in kv.writes:
-                if w.is_delete:
-                    batch.delete(nsrw.namespace, w.key, height)
-                else:
-                    batch.put(nsrw.namespace, w.key, w.value, height)
+            self._apply_ns_writes(nsrw.namespace, kv.writes,
+                                  kv.metadata_writes, batch, height)
             for chrw in nsrw.collection_hashed_rwset:
                 hset = rwpb.HashedRWSet()
                 hset.ParseFromString(chrw.rwset)
                 hns = pvt.hash_ns(nsrw.namespace, chrw.collection_name)
-                for hw in hset.hashed_writes:
-                    hkey = pvt.hashed_key_str(hw.key_hash)
-                    if hw.is_delete:
-                        batch.delete(hns, hkey, height)
-                    else:
-                        batch.put(hns, hkey, hw.value_hash, height)
+                writes = [rwpb.KVWrite(
+                    key=pvt.hashed_key_str(hw.key_hash),
+                    is_delete=hw.is_delete, value=hw.value_hash)
+                    for hw in hset.hashed_writes]
+                mwrites = [rwpb.KVMetadataWrite(
+                    key=pvt.hashed_key_str(mw.key_hash),
+                    entries=mw.entries)
+                    for mw in hset.metadata_writes]
+                self._apply_ns_writes(hns, writes, mwrites, batch, height)

@@ -1,0 +1,363 @@
+"""Key-level (state-based) endorsement policy tests.
+
+Reference semantics being pinned:
+`core/common/validation/statebased/validator_keylevel.go` (key-level
+policies override the chaincode policy per key; the chaincode policy is
+required iff some written key has no key-level policy) and
+`vpmanagerimpl.go` (same-block ordering: a VALID tx's parameter updates
+govern later txs in the same block; an invalid tx's do not).
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition, shim
+from fabric_tpu.core.policycheck import org_member_policy_bytes
+from fabric_tpu.core.txvalidator import TxValidator
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.ledger.statedb import Height, StateDB, UpdateBatch
+from fabric_tpu.ledger.txmgr import (
+    TxMgr, TxSimulator, deserialize_metadata, serialize_metadata,
+)
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer
+from fabric_tpu.peer.gateway import Gateway
+from fabric_tpu.protos import common, transaction as txpb
+from fabric_tpu.protoutil import protoutil as pu
+
+CHANNEL = "sbechannel"
+TVC = txpb.TxValidationCode
+
+
+# ---------------------------------------------------------------------------
+# Ledger-level: metadata write semantics through TxMgr
+# ---------------------------------------------------------------------------
+
+class TestMetadataCommit:
+    @pytest.fixture()
+    def db(self, tmp_path):
+        kv = KVStore(str(tmp_path / "s.db"))
+        return StateDB(DBHandle(kv, "s"))
+
+    def _commit(self, db, block, sims):
+        mgr = TxMgr(db)
+        codes, batch = mgr.validate_and_prepare(
+            block, [s.get_tx_simulation_results() for s in sims])
+        db.apply_updates(batch, Height(block, 0))
+        return codes
+
+    def test_metadata_roundtrip_and_preservation(self, db):
+        sim = TxSimulator(db)
+        sim.put_state("cc", "k", b"v1")
+        sim.set_state_metadata("cc", "k", {"VALIDATION_PARAMETER": b"P1"})
+        self._commit(db, 1, [sim])
+        assert deserialize_metadata(db.get_state_metadata("cc", "k")) == \
+            {"VALIDATION_PARAMETER": b"P1"}
+
+        # a value-only write preserves existing metadata
+        sim = TxSimulator(db)
+        sim.put_state("cc", "k", b"v2")
+        self._commit(db, 2, [sim])
+        assert db.get_state("cc", "k").value == b"v2"
+        assert deserialize_metadata(db.get_state_metadata("cc", "k")) == \
+            {"VALIDATION_PARAMETER": b"P1"}
+
+        # a metadata-only write replaces the map and bumps the version
+        sim = TxSimulator(db)
+        sim.set_state_metadata("cc", "k", {"OTHER": b"x"})
+        self._commit(db, 3, [sim])
+        assert db.get_state("cc", "k").value == b"v2"
+        assert deserialize_metadata(db.get_state_metadata("cc", "k")) == \
+            {"OTHER": b"x"}
+        assert db.get_version("cc", "k") == Height(3, 0)
+
+        # delete clears value and metadata
+        sim = TxSimulator(db)
+        sim.del_state("cc", "k")
+        self._commit(db, 4, [sim])
+        assert db.get_state("cc", "k") is None
+
+    def test_metadata_write_to_absent_key_is_noop(self, db):
+        sim = TxSimulator(db)
+        sim.set_state_metadata("cc", "ghost", {"m": b"1"})
+        self._commit(db, 1, [sim])
+        assert db.get_state("cc", "ghost") is None
+
+    def test_metadata_read_your_writes_and_rwset(self, db):
+        sim = TxSimulator(db)
+        sim.put_state("cc", "k", b"v")
+        sim.set_state_metadata("cc", "k", {"VP": b"pol"})
+        assert sim.get_state_metadata("cc", "k") == {"VP": b"pol"}
+        txrw = sim.get_tx_simulation_results()
+        from fabric_tpu.protos import rwset as rwpb
+        kv = rwpb.KVRWSet()
+        kv.ParseFromString(txrw.ns_rwset[0].rwset)
+        assert [mw.key for mw in kv.metadata_writes] == ["k"]
+        assert kv.metadata_writes[0].entries[0].name == "VP"
+
+    def test_private_metadata_hashed_rwset_and_commit(self, db):
+        from fabric_tpu.ledger import pvtdata as pvt
+        from fabric_tpu.protos import rwset as rwpb
+        sim = TxSimulator(db)
+        sim.put_private_data("cc", "col", "pk", b"secret")
+        sim.set_private_data_metadata("cc", "col", "pk", {"VP": b"q"})
+        txrw = sim.get_tx_simulation_results()
+        hset = rwpb.HashedRWSet()
+        hset.ParseFromString(
+            txrw.ns_rwset[0].collection_hashed_rwset[0].rwset)
+        assert len(hset.metadata_writes) == 1
+        assert hset.metadata_writes[0].key_hash == pvt.key_hash("pk")
+        self._commit(db, 1, [sim])
+        hns = pvt.hash_ns("cc", "col")
+        hkey = pvt.hashed_key_str(pvt.key_hash("pk"))
+        assert deserialize_metadata(
+            db.get_state_metadata(hns, hkey)) == {"VP": b"q"}
+
+
+class TestBlockOverlayNamespacing:
+    def test_vp_updates_do_not_bleed_across_chaincodes(self):
+        """Two chaincodes writing the same key name in one block must
+        not see each other's validation parameters."""
+        from fabric_tpu.core.statebased import BlockOverlay, WriteSetInfo
+        ov = BlockOverlay()
+        info = WriteSetInfo(namespace="ccA",
+                            vp_updates={(None, "k"): b"POLICY-A"})
+        ov.apply(info)
+        assert ov.get("ccA", None, "k") == b"POLICY-A"
+        assert ov.get("ccB", None, "k") is None
+
+
+# ---------------------------------------------------------------------------
+# Validator-level: a 2-org network enforcing key-level policies
+# ---------------------------------------------------------------------------
+
+class SBEChaincode(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+        if fn == "lock":        # key now requires an org-member sig
+            stub.set_state_validation_parameter(
+                params[0], org_member_policy_bytes(params[1]))
+            return shim.success()
+        if fn == "unlock":
+            stub.set_state_validation_parameter(params[0], b"")
+            return shim.success()
+        if fn == "getvp":
+            return shim.success(
+                stub.get_state_validation_parameter(params[0]) or b"")
+        return shim.error(f"unknown {fn}")
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sbe")
+    cdir = str(root / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "100ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+    csp = SWProvider()
+
+    def local_msp(d, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(d, mspid, csp=csp))
+        return m
+
+    omsp = local_msp(os.path.join(ordo, "orderers",
+                                  "orderer0.example.com", "msp"),
+                     "OrdererMSP")
+    reg = Registrar(str(root / "ord"),
+                    omsp.get_default_signing_identity(), csp,
+                    {"solo": solo.consenter})
+    reg.join(genesis)
+    broadcast = BroadcastHandler(reg)
+    deliver = DeliverHandler(reg.get_chain)
+
+    # the chaincode-level policy: ONE Org1 member — so org1-only
+    # endorsements pass unless a key-level parameter tightens the key
+    definition = ChaincodeDefinition(
+        name="sbe", endorsement_policy=org_member_policy_bytes("Org1MSP"))
+
+    peers = {}
+    deliverers = []
+    for org_name, org_dir, mspid in (("org1", org1, "Org1MSP"),
+                                     ("org2", org2, "Org2MSP")):
+        msp = local_msp(
+            os.path.join(org_dir, "peers",
+                         f"peer0.{org_name}.example.com", "msp"), mspid)
+        p = Peer(str(root / f"peer_{org_name}"), msp, csp)
+        ch = p.join_channel(genesis)
+        p.chaincode_support.register("sbe", SBEChaincode())
+        ch.define_chaincode(definition)
+        d = Deliverer(ch, p.signer, lambda: deliver, p.mcs)
+        d.start()
+        peers[org_name] = p
+        deliverers.append(d)
+
+    user = local_msp(os.path.join(org1, "users",
+                                  "User1@org1.example.com", "msp"),
+                     "Org1MSP")
+    gw = Gateway(peers["org1"], broadcast,
+                 user.get_default_signing_identity())
+    yield {"peers": peers, "gw": gw, "reg": reg, "deliver": deliver,
+           "csp": csp}
+    for d in deliverers:
+        d.stop()
+    reg.halt()
+    for p in peers.values():
+        p.close()
+
+
+def _sync(net, timeout_s=10.0):
+    chans = [p.channel(CHANNEL) for p in net["peers"].values()]
+    target = max(ch.ledger.height for ch in chans)
+    for ch in chans:
+        assert ch.wait_for_height(target, timeout_s)
+
+
+class TestKeyLevelPolicies:
+    def test_grant_enforce_and_revoke_across_blocks(self, net):
+        gw = net["gw"]
+        org1 = [net["peers"]["org1"]]
+        both = list(net["peers"].values())
+
+        # baseline: cc policy (Org1) lets an org1-only endorsement in
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"put", b"a", b"1"],
+                                  endorsing_peers=org1)
+        assert r.status == TVC.VALID
+
+        # lock: attach VP = Org2 member (key has no VP yet, so the cc
+        # policy gates this metadata write — org1 suffices)
+        r = gw.submit_transaction(CHANNEL, "sbe",
+                                  [b"lock", b"a", b"Org2MSP"],
+                                  endorsing_peers=org1)
+        assert r.status == TVC.VALID
+        _sync(net)
+
+        # now an org1-only write to `a` must FAIL the key-level policy
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"put", b"a", b"2"],
+                                  endorsing_peers=org1)
+        assert r.status == TVC.ENDORSEMENT_POLICY_FAILURE
+
+        # an uncovered key still validates under the cc policy alone
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"put", b"b", b"9"],
+                                  endorsing_peers=org1)
+        assert r.status == TVC.VALID
+
+        # writing `a` WITH org2's endorsement passes (VP satisfied; cc
+        # policy not required — every written key is covered)
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"put", b"a", b"3"],
+                                  endorsing_peers=both)
+        assert r.status == TVC.VALID
+
+        # removing the VP is itself gated by the current VP
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"unlock", b"a"],
+                                  endorsing_peers=org1)
+        assert r.status == TVC.ENDORSEMENT_POLICY_FAILURE
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"unlock", b"a"],
+                                  endorsing_peers=both)
+        assert r.status == TVC.VALID
+        _sync(net)
+
+        # revoked: org1-only writes work again
+        r = gw.submit_transaction(CHANNEL, "sbe", [b"put", b"a", b"4"],
+                                  endorsing_peers=org1)
+        assert r.status == TVC.VALID
+
+    def _manual_block(self, net, envelopes):
+        blk = common.Block()
+        blk.header.number = 99
+        for env in envelopes:
+            blk.data.data.append(pu.marshal(env))
+        return blk
+
+    def test_same_block_parameter_ordering(self, net):
+        """tx1 locks key `c` to Org2; tx2 (later in the SAME block)
+        writes `c` with org1-only endorsement → tx2 must fail. If tx1
+        is invalid, tx2 must pass (committed state has no VP)."""
+        gw = net["gw"]
+        org1 = [net["peers"]["org1"]]
+        p1 = net["peers"]["org1"]
+        ch = p1.channel(CHANNEL)
+        _sync(net)
+
+        env_lock, _ = gw.endorse(
+            CHANNEL, "sbe", [b"lock", b"c", b"Org2MSP"],
+            endorsing_peers=org1)
+        env_put, _ = gw.endorse(
+            CHANNEL, "sbe", [b"put", b"c", b"7"], endorsing_peers=org1)
+
+        validator = TxValidator(
+            CHANNEL, ch.ledger, ch.bundle, net["csp"],
+            ch.chaincode_definition,
+            configtx_validator_source=ch.configtx_validator)
+
+        codes = validator.validate(
+            self._manual_block(net, [env_lock, env_put]))
+        assert codes == [TVC.VALID, TVC.ENDORSEMENT_POLICY_FAILURE]
+
+        # tamper tx1's endorsement: it goes invalid, so its parameter
+        # update must NOT govern tx2
+        tampered = common.Envelope()
+        tampered.CopyFrom(env_lock)
+        payload = pu.get_payload(tampered)
+        tx = txpb.Transaction()
+        tx.ParseFromString(payload.data)
+        cap = txpb.ChaincodeActionPayload()
+        cap.ParseFromString(tx.actions[0].payload)
+        sig = bytearray(cap.action.endorsements[0].signature)
+        sig[-1] ^= 1
+        cap.action.endorsements[0].signature = bytes(sig)
+        tx.actions[0].payload = cap.SerializeToString()
+        payload.data = tx.SerializeToString()
+        tampered.payload = pu.marshal(payload)
+        # re-sign the envelope so only the endorsement is broken
+        env2 = pu.sign_or_panic(gw._signer, payload)
+
+        env_put2, _ = gw.endorse(
+            CHANNEL, "sbe", [b"put", b"c", b"8"], endorsing_peers=org1)
+        codes = validator.validate(
+            self._manual_block(net, [env2, env_put2]))
+        assert codes[0] != TVC.VALID
+        assert codes[1] == TVC.VALID
