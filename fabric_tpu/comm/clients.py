@@ -89,6 +89,28 @@ class DeliverClient:
         yield from self._call(env)
 
 
+class PeerDeliverClient(DeliverClient):
+    """The peer's event-stream variants (reference peer deliver service:
+    DeliverFiltered / DeliverWithPrivateData — what event-consuming
+    client SDKs dial)."""
+
+    def __init__(self, channel: grpc.Channel):
+        super().__init__(channel)
+        from fabric_tpu.protos import events as evpb
+        self._filtered = _us(channel, svc.DELIVER_SERVICE,
+                             "DeliverFiltered",
+                             common.Envelope, evpb.DeliverResponse)
+        self._pvt = _us(channel, svc.DELIVER_SERVICE,
+                        "DeliverWithPrivateData",
+                        common.Envelope, evpb.DeliverResponse)
+
+    def handle_filtered(self, env: common.Envelope):
+        yield from self._filtered(env)
+
+    def handle_with_pvtdata(self, env: common.Envelope):
+        yield from self._pvt(env)
+
+
 class GatewayClient:
     """Client-side SDK over the Gateway service: builds and SIGNS
     proposals/envelopes locally (the reference's client SDK role)."""

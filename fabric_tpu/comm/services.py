@@ -49,6 +49,31 @@ def register_deliver(server: GRPCServer, deliver_handler) -> None:
     })
 
 
+def register_peer_deliver(server: GRPCServer, events_handler) -> None:
+    """The peer's three deliver variants (reference peer/events.proto
+    service Deliver: Deliver, DeliverFiltered, DeliverWithPrivateData
+    — core/peer/deliverevents.go)."""
+    from fabric_tpu.protos import events as evpb
+
+    def handle(env, ctx):
+        yield from events_handler.handle(env)
+
+    def handle_filtered(env, ctx):
+        yield from events_handler.handle_filtered(env)
+
+    def handle_pvt(env, ctx):
+        yield from events_handler.handle_with_pvtdata(env)
+
+    server.add_service(DELIVER_SERVICE, {
+        "Deliver": (UNARY_STREAM, handle,
+                    common.Envelope, opb.DeliverResponse),
+        "DeliverFiltered": (UNARY_STREAM, handle_filtered,
+                            common.Envelope, evpb.DeliverResponse),
+        "DeliverWithPrivateData": (UNARY_STREAM, handle_pvt,
+                                   common.Envelope, evpb.DeliverResponse),
+    })
+
+
 def register_broadcast(server: GRPCServer, broadcast_handler) -> None:
     server.add_service(BROADCAST_SERVICE, {
         "Broadcast": (

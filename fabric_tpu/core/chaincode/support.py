@@ -61,9 +61,15 @@ class ChaincodeSupport:
     processes register themselves at connect time (CCaaS).
     """
 
-    def __init__(self, execute_timeout_s: float = 30.0):
+    def __init__(self, execute_timeout_s: float = 30.0,
+                 channel_source=None):
+        """`channel_source(channel_id)` → peer Channel (or None) — the
+        seam cross-channel chaincode-to-chaincode queries resolve
+        through (reference: handler.go InvokeChaincode → peer.Channel
+        lookup)."""
         self._chaincodes: dict[str, shim.Chaincode] = {}
         self._timeout = execute_timeout_s
+        self._channel_source = channel_source
 
     def register(self, name: str, chaincode) -> None:
         """`chaincode`: anything with init(stub)/invoke(stub) — an
@@ -84,14 +90,19 @@ class ChaincodeSupport:
                 spec: pb.ChaincodeInvocationSpec, simulator,
                 creator: bytes = b"",
                 transient: Optional[dict] = None,
-                timestamp: int = 0) -> tuple[pb.Response,
-                                             Optional[pb.ChaincodeEvent],
-                                             pb.ChaincodeID]:
+                timestamp: int = 0,
+                ledger=None) -> tuple[pb.Response,
+                                      Optional[pb.ChaincodeEvent],
+                                      pb.ChaincodeID]:
         """Reference: `ChaincodeSupport.Execute` → `Invoke` → handler
         round-trips; returns (response, event, resolved chaincode id).
         Raises ExecuteError only for infrastructure faults; contract
         errors come back as Response.status >= 400 like the reference
         (endorser propagates them, `core/endorser/endorser.go:178`).
+        A call exceeding the execute timeout fails the proposal
+        (reference: chaincode_support.go:160 ExecuteTimeout) — the
+        runaway worker thread is abandoned with a warning (in-process
+        Python has no kill; the reference kills the container).
         """
         cc_id = spec.chaincode_spec.chaincode_id
         cc = self._chaincodes.get(cc_id.name)
@@ -102,16 +113,43 @@ class ChaincodeSupport:
             simulator=simulator,
             args=list(spec.chaincode_spec.input.args),
             creator=creator, transient=transient, support=self,
-            timestamp=timestamp)
-        try:
-            if spec.chaincode_spec.input.is_init:
-                resp = cc.init(stub)
-            else:
-                resp = cc.invoke(stub)
-        except Exception as e:
-            logger.exception("chaincode %s panicked", cc_id.name)
+            timestamp=timestamp, ledger=ledger)
+
+        # a dedicated daemon thread per invocation: a hung chaincode
+        # abandons ITS thread only — no shared pool whose workers a
+        # chain of timeouts could permanently exhaust
+        import threading
+        outcome: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                if spec.chaincode_spec.input.is_init:
+                    outcome["resp"] = cc.init(stub)
+                else:
+                    outcome["resp"] = cc.invoke(stub)
+            except Exception as e:          # noqa: BLE001
+                outcome["exc"] = e
+            finally:
+                done.set()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"cc-exec-{cc_id.name}").start()
+        if not done.wait(self._timeout):
+            logger.warning("chaincode %s exceeded the %.0fs execute "
+                           "timeout in tx %s; abandoning the worker",
+                           cc_id.name, self._timeout, tx_id)
+            resp = shim.error(
+                f"chaincode {cc_id.name} timed out after "
+                f"{self._timeout:.0f}s")
+        elif "exc" in outcome:
+            logger.error("chaincode %s panicked: %s", cc_id.name,
+                         outcome["exc"])
             # reference: a chaincode panic fails the proposal, not the peer
-            resp = shim.error(f"chaincode {cc_id.name} crashed: {e}")
+            resp = shim.error(
+                f"chaincode {cc_id.name} crashed: {outcome['exc']}")
+        else:
+            resp = outcome["resp"]
         if not isinstance(resp, pb.Response):
             resp = shim.error(
                 f"chaincode {cc_id.name} returned invalid response type")
@@ -119,23 +157,53 @@ class ChaincodeSupport:
 
     def invoke_chaincode(self, caller_stub: shim.ChaincodeStub,
                          name: str, args: list, channel: str) -> pb.Response:
-        """cc2cc: same-channel shares the caller's simulator (writes
-        merge into one rwset, reference `handler.go:1081`)."""
+        """cc2cc (reference `handler.go:1081` HandleInvokeChaincode):
+        same-channel calls share the caller's simulator so their writes
+        merge into one rwset; cross-channel calls run READ-ONLY on the
+        target channel's committed state — their rwset is discarded and
+        never ordered (reference semantics: queries only)."""
         cc = self._chaincodes.get(name)
         if cc is None:
             return shim.error(f"chaincode {name} not found")
-        if channel != caller_stub.get_channel_id():
-            return shim.error(
-                "cross-channel chaincode invocation is read-only and "
-                "not yet supported")
+        same_channel = channel == caller_stub.get_channel_id()
+        ledger = caller_stub._ledger
+        if same_channel:
+            sim = caller_stub._sim
+        else:
+            if self._channel_source is None:
+                return shim.error(
+                    "cross-channel invocation unavailable: no channel "
+                    "source wired")
+            target = self._channel_source(channel)
+            if target is None:
+                return shim.error(f"channel {channel} not found")
+            ledger = target.ledger
+            sim = target.ledger.new_tx_simulator(
+                caller_stub.get_tx_id())
         stub = shim.ChaincodeStub(
             channel_id=channel, tx_id=caller_stub.get_tx_id(),
-            namespace=name, simulator=caller_stub._sim,
+            namespace=name, simulator=sim,
             args=args, creator=caller_stub.get_creator(),
             transient=caller_stub.get_transient(), support=self,
-            timestamp=caller_stub.get_tx_timestamp())
+            timestamp=caller_stub.get_tx_timestamp(), ledger=ledger)
         try:
-            return cc.invoke(stub)
+            resp = cc.invoke(stub)
         except Exception as e:
             logger.exception("chaincode %s panicked in cc2cc", name)
             return shim.error(f"chaincode {name} crashed: {e}")
+        if not same_channel:
+            results = sim.get_tx_simulation_results()
+            if any(_has_writes(nsrw) for nsrw in results.ns_rwset):
+                logger.warning(
+                    "cross-channel cc2cc %s->%s attempted writes on "
+                    "channel %s; discarded (queries only)",
+                    caller_stub._ns, name, channel)
+        return resp
+
+
+def _has_writes(nsrw) -> bool:
+    from fabric_tpu.protos import rwset as rwpb
+    kv = rwpb.KVRWSet()
+    kv.ParseFromString(nsrw.rwset)
+    return bool(kv.writes or kv.metadata_writes or
+                nsrw.collection_hashed_rwset)

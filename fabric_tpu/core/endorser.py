@@ -97,7 +97,8 @@ class Endorser:
                 up.channel_id, up.tx_id, up.input, sim,
                 creator=up.signature_header.creator,
                 transient=up.transient,
-                timestamp=up.channel_header.timestamp)
+                timestamp=up.channel_header.timestamp,
+                ledger=support.ledger)
         except Exception as e:
             logger.warning("chaincode execution failed for [%s]: %s",
                            up.tx_id, e)

@@ -1,0 +1,176 @@
+"""Peer deliver event streams: filtered blocks + blocks with private data.
+
+Rebuild of `core/peer/deliverevents.go:1` (DeliverFiltered,
+DeliverWithPrivateData) over the shared deliver engine
+(`common/deliver/deliver.go:173` — here fabric_tpu/common/deliver.py):
+the engine handles SeekInfo parsing, Readers-policy session AC and
+block streaming; this module transforms each block into the stream's
+payload shape:
+
+  * Filtered: per-tx verdicts + chaincode events with the PAYLOAD
+    STRIPPED — what event-consumer SDKs subscribe to.
+  * BlockAndPrivateData: the raw block plus every cleartext private
+    rwset this peer holds for it, with collections the REQUESTER is not
+    a member of removed (the reference's CollectionPolicyChecker; here
+    membership = the requester MSP appearing in the collection's
+    member_orgs, fail-closed when the config is unresolvable).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterator
+
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.protos import common, events as evpb, orderer as ordpb
+from fabric_tpu.protos import proposal as ppb, transaction as txpb
+from fabric_tpu.protoutil import protoutil as pu
+
+logger = logging.getLogger("deliverevents")
+
+
+def filter_block(channel_id: str, block: common.Block
+                 ) -> evpb.FilteredBlock:
+    """Reference: blockEvent.toFilteredBlock (deliverevents.go)."""
+    fb = evpb.FilteredBlock(channel_id=channel_id,
+                            number=block.header.number)
+    flags = b""
+    meta = block.metadata.metadata
+    if len(meta) > common.BlockMetadataIndex.TRANSACTIONS_FILTER:
+        flags = meta[common.BlockMetadataIndex.TRANSACTIONS_FILTER]
+    for i, env_bytes in enumerate(block.data.data):
+        ft = fb.filtered_transactions.add()
+        if i < len(flags):
+            ft.tx_validation_code = flags[i]
+        else:
+            ft.tx_validation_code = txpb.TxValidationCode.NOT_VALIDATED
+        try:
+            env = pu.unmarshal_envelope(env_bytes)
+            payload = pu.get_payload(env)
+            ch = pu.get_channel_header(payload)
+        except Exception:
+            continue
+        ft.txid = ch.tx_id
+        ft.type = ch.type
+        if ch.type != common.HeaderType.ENDORSER_TRANSACTION:
+            continue
+        try:
+            tx = txpb.Transaction()
+            tx.ParseFromString(payload.data)
+            actions = ft.transaction_actions
+            for action in tx.actions:
+                cap = txpb.ChaincodeActionPayload()
+                cap.ParseFromString(action.payload)
+                prp = ppb.ProposalResponsePayload()
+                prp.ParseFromString(cap.action.proposal_response_payload)
+                cc_action = ppb.ChaincodeAction()
+                cc_action.ParseFromString(prp.extension)
+                fca = actions.chaincode_actions.add()
+                if cc_action.events:
+                    ev = ppb.ChaincodeEvent()
+                    ev.ParseFromString(cc_action.events)
+                    ev.payload = b""        # stripped by contract
+                    fca.chaincode_event.CopyFrom(ev)
+        except Exception:
+            logger.debug("block %d tx %d: unparsable endorser tx in "
+                         "filtered stream", block.header.number, i)
+    return fb
+
+
+class EventsDeliverHandler:
+    """The peer's three deliver stream variants over one engine.
+
+    `channel_getter(channel_id)` returns the peer Channel (exposing
+    `.ledger` with `get_pvt_data_by_num`, `.bundle()` and
+    `.chaincode_definition(name)`); the base engine resolves chains
+    through the same getter.
+    """
+
+    def __init__(self, channel_getter,
+                 timeout_s=None):
+        self._channels = channel_getter
+        self._base = DeliverHandler(channel_getter, timeout_s=timeout_s)
+
+    # -- plain blocks (parity with the orderer-style stream) --
+
+    def handle(self, env) -> Iterator[ordpb.DeliverResponse]:
+        yield from self._base.handle(env)
+
+    # -- filtered blocks --
+
+    def handle_filtered(self, env) -> Iterator[evpb.DeliverResponse]:
+        channel_id = _channel_of(env)
+        for resp in self._base.handle(env):
+            if resp.WhichOneof("type") == "block":
+                yield evpb.DeliverResponse(
+                    filtered_block=filter_block(channel_id, resp.block))
+            else:
+                yield evpb.DeliverResponse(status=resp.status)
+
+    # -- blocks + private data --
+
+    def handle_with_pvtdata(self, env) -> Iterator[evpb.DeliverResponse]:
+        channel_id = _channel_of(env)
+        requester_msp = self._requester_msp(channel_id, env)
+        chan = self._channels(channel_id)
+        for resp in self._base.handle(env):
+            if resp.WhichOneof("type") != "block":
+                yield evpb.DeliverResponse(status=resp.status)
+                continue
+            bpd = evpb.BlockAndPrivateData()
+            bpd.block.CopyFrom(resp.block)
+            ledger = getattr(chan, "ledger", None)
+            if ledger is not None:
+                num = resp.block.header.number
+                for i in range(len(resp.block.data.data)):
+                    txpvt = ledger.get_pvt_data_by_num(num, i)
+                    if txpvt is None:
+                        continue
+                    visible = self._filter_collections(
+                        chan, txpvt, requester_msp)
+                    if visible is not None:
+                        bpd.private_data_map[i].CopyFrom(visible)
+            yield evpb.DeliverResponse(block_and_private_data=bpd)
+
+    def _requester_msp(self, channel_id: str, env) -> str:
+        """MSP ID of the stream's signer — collection visibility pivot."""
+        try:
+            chan = self._channels(channel_id)
+            sd = pu.envelope_as_signed_data(env)[0]
+            ident = chan.bundle().msp_manager.deserialize_identity(
+                sd.identity)
+            return ident.mspid()
+        except Exception:
+            return ""
+
+    def _filter_collections(self, chan, txpvt, requester_msp: str):
+        """Drop collections the requester is not a member of
+        (reference: CollectionPolicyChecker in deliverevents.go);
+        unresolvable configs fail closed."""
+        out = type(txpvt)()
+        out.data_model = txpvt.data_model
+        kept = False
+        for nspvt in txpvt.ns_pvt_rwset:
+            try:
+                definition = chan.chaincode_definition(nspvt.namespace)
+            except Exception:
+                definition = None
+            ns_out = None
+            for coll in nspvt.collection_pvt_rwset:
+                cfg = definition.collection(coll.collection_name) \
+                    if definition is not None else None
+                if cfg is None or requester_msp not in cfg.member_orgs:
+                    continue
+                if ns_out is None:
+                    ns_out = out.ns_pvt_rwset.add(
+                        namespace=nspvt.namespace)
+                ns_out.collection_pvt_rwset.add().CopyFrom(coll)
+                kept = True
+        return out if kept else None
+
+
+def _channel_of(env) -> str:
+    try:
+        return pu.get_channel_header(pu.get_payload(env)).channel_id
+    except Exception:
+        return ""

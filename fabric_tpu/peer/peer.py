@@ -262,7 +262,8 @@ class Peer:
                                         metrics_provider=metrics_provider)
         self.transient_store = TransientStore(
             os.path.join(ledger_root, "transient.db"))
-        self.chaincode_support = ChaincodeSupport()
+        self.chaincode_support = ChaincodeSupport(
+            channel_source=lambda cid: self.channels.get(cid))
         self.channels: dict[str, Channel] = {}
         self._lock = threading.Lock()
         self.mcs = MSPMessageCryptoService(

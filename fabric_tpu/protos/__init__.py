@@ -26,3 +26,6 @@ __all__.append("raft")
 from fabric_tpu.protos import discovery_pb2 as discovery  # noqa: F401,E402
 
 __all__.append("discovery")
+from fabric_tpu.protos import events_pb2 as events  # noqa: F401,E402
+
+__all__.append("events")

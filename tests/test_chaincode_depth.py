@@ -1,0 +1,225 @@
+"""Chaincode runtime depth: history queries from the shim,
+chaincode-to-chaincode invocation (same- and cross-channel), execute
+timeouts.
+
+Reference behaviors pinned: `core/chaincode/handler.go:1081`
+(HandleInvokeChaincode: same-channel shares the tx rwset, cross-channel
+is queries-only), HandleGetHistoryForKey (history DB reachable from the
+shim), `core/chaincode/chaincode_support.go:160` (ExecuteTimeout fails
+the proposal).
+"""
+
+import os
+import time
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition, shim
+from fabric_tpu.core.chaincode.support import ChaincodeSupport
+from fabric_tpu.core.policycheck import org_member_policy_bytes
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer
+from fabric_tpu.peer.gateway import Gateway
+from fabric_tpu.protos import proposal as ppb, transaction as txpb
+
+CH1, CH2 = "depthone", "depthtwo"
+
+
+class AssetCC(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+        if fn == "del":
+            stub.del_state(params[0])
+            return shim.success()
+        if fn == "history":
+            out = []
+            for e in stub.get_history_for_key(params[0]):
+                val = "DEL" if e["is_delete"] else e["value"].decode()
+                out.append(val)
+            return shim.success(",".join(out).encode())
+        if fn == "audit":        # cc2cc same channel: read via audit cc
+            return stub.invoke_chaincode(
+                "audit", [b"check", params[0].encode()
+                          if isinstance(params[0], str) else params[0]])
+        if fn == "xread":        # cc2cc cross channel (queries only)
+            return stub.invoke_chaincode(
+                "asset", [b"get", params[1].encode()], channel=params[0])
+        if fn == "get":
+            v = stub.get_state(params[0])
+            return shim.success(v or b"")
+        return shim.error("unknown")
+
+
+class AuditCC(Chaincode):
+    """Reads the caller's namespace via cc2cc and writes its own mark."""
+
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "check":
+            r = stub.invoke_chaincode("asset", [b"get",
+                                               params[0].encode()])
+            stub.put_state("last-audit", params[0].encode())
+            return shim.success(b"audited:" + r.payload)
+        return shim.error("unknown")
+
+
+def _mknet(root, channel, orgdirs=None):
+    cdir = str(root / "crypto")
+    if orgdirs is None:
+        org1 = cryptogen.generate_org(cdir, "org1.example.com",
+                                      n_peers=1, n_users=1)
+        ordo = cryptogen.generate_org(cdir, "example.com",
+                                      orderer_org=True)
+    else:
+        org1, ordo = orgdirs
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [{"Name": "Org1", "ID": "Org1MSP",
+                               "MSPDir": os.path.join(org1, "msp")}],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "100ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    return genesis_block(channel, new_channel_group(profile)), org1, ordo
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    root = tmp_path_factory.mktemp("depth")
+    genesis1, org1, ordo = _mknet(root, CH1)
+    genesis2, _, _ = _mknet(root, CH2, (org1, ordo))
+    csp = SWProvider()
+
+    def local_msp(d, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(d, mspid, csp=csp))
+        return m
+
+    omsp = local_msp(os.path.join(ordo, "orderers",
+                                  "orderer0.example.com", "msp"),
+                     "OrdererMSP")
+    reg = Registrar(str(root / "ord"),
+                    omsp.get_default_signing_identity(), csp,
+                    {"solo": solo.consenter})
+    reg.join(genesis1)
+    reg.join(genesis2)
+    broadcast = BroadcastHandler(reg)
+    deliver = DeliverHandler(reg.get_chain)
+
+    pmsp = local_msp(os.path.join(org1, "peers",
+                                  "peer0.org1.example.com", "msp"),
+                     "Org1MSP")
+    peer = Peer(str(root / "peer"), pmsp, csp)
+    definition = ChaincodeDefinition(
+        name="asset",
+        endorsement_policy=org_member_policy_bytes("Org1MSP"))
+    audit_def = ChaincodeDefinition(
+        name="audit",
+        endorsement_policy=org_member_policy_bytes("Org1MSP"))
+    deliverers = []
+    for genesis in (genesis1, genesis2):
+        ch = peer.join_channel(genesis)
+        ch.define_chaincode(definition)
+        ch.define_chaincode(audit_def)
+        d = Deliverer(ch, peer.signer, lambda: deliver, peer.mcs)
+        d.start()
+        deliverers.append(d)
+    peer.chaincode_support.register("asset", AssetCC())
+    peer.chaincode_support.register("audit", AuditCC())
+
+    user = local_msp(os.path.join(org1, "users",
+                                  "User1@org1.example.com", "msp"),
+                     "Org1MSP")
+    gw = Gateway(peer, broadcast, user.get_default_signing_identity())
+    yield {"peer": peer, "gw": gw}
+    for d in deliverers:
+        d.stop()
+    reg.halt()
+    peer.close()
+
+
+class TestHistory:
+    def test_shim_history_newest_first(self, net):
+        gw = net["gw"]
+        for v in (b"1", b"2"):
+            r = gw.submit_transaction(CH1, "asset", [b"put", b"h", v])
+            assert r.status == txpb.TxValidationCode.VALID
+        r = gw.submit_transaction(CH1, "asset", [b"del", b"h"])
+        assert r.status == txpb.TxValidationCode.VALID
+        resp = gw.evaluate(CH1, "asset", [b"history", b"h"])
+        assert resp.status == 200
+        assert resp.payload == b"DEL,2,1"
+
+
+class TestCC2CC:
+    def test_same_channel_shares_rwset(self, net):
+        gw = net["gw"]
+        r = gw.submit_transaction(CH1, "asset", [b"put", b"x", b"42"])
+        assert r.status == txpb.TxValidationCode.VALID
+        r = gw.submit_transaction(CH1, "asset", [b"audit", b"x"])
+        assert r.status == txpb.TxValidationCode.VALID
+        # the callee's write landed in the same tx's rwset
+        resp = gw.evaluate(CH1, "audit", [b"check", b"x"])
+        assert resp.payload.startswith(b"audited:")
+        ch = net["peer"].channel(CH1)
+        assert ch.ledger.get_state("audit", "last-audit") == b"x"
+
+    def test_cross_channel_read_only(self, net):
+        gw = net["gw"]
+        r = gw.submit_transaction(CH2, "asset", [b"put", b"ck", b"99"])
+        assert r.status == txpb.TxValidationCode.VALID
+        resp = gw.evaluate(CH1, "asset",
+                           [b"xread", CH2.encode(), b"ck"])
+        assert resp.status == 200
+        assert resp.payload == b"99"
+
+
+class TestExecuteTimeout:
+    def test_slow_chaincode_fails_the_proposal(self):
+        class Sleeper(Chaincode):
+            def init(self, stub):
+                return shim.success()
+
+            def invoke(self, stub):
+                time.sleep(2.0)
+                return shim.success()
+
+        support = ChaincodeSupport(execute_timeout_s=0.2)
+        support.register("slow", Sleeper())
+        spec = ppb.ChaincodeInvocationSpec()
+        spec.chaincode_spec.chaincode_id.name = "slow"
+        t0 = time.perf_counter()
+        resp, _ev, _id = support.execute("ch", "tx1", spec, None)
+        assert resp.status == shim.ERROR
+        assert b"timed out" in resp.message.encode()
+        assert time.perf_counter() - t0 < 1.5

@@ -1,0 +1,204 @@
+"""Peer deliver event-stream tests: filtered blocks + block-with-pvtdata.
+
+Reference behavior pinned: `core/peer/deliverevents.go` —
+DeliverFiltered strips event payloads and carries per-tx verdicts;
+DeliverWithPrivateData attaches held cleartext collections, filtered by
+the requester's collection membership.
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition, shim
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.ledger.pvtdata import CollectionConfig
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.deliverclient import Deliverer, seek_envelope
+from fabric_tpu.peer.deliverevents import EventsDeliverHandler
+from fabric_tpu.protos import common, transaction as txpb
+
+CHANNEL = "evchannel"
+
+
+class EvCC(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        if fn == "put":
+            stub.put_state(params[0], params[1].encode())
+            stub.set_event("put-event", b"secret-payload")
+            return shim.success()
+        if fn == "pvt":
+            stub.put_private_data("col1", params[0], params[1].encode())
+            return shim.success()
+        return shim.error("unknown")
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ev")
+    cdir = str(root / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "100ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+    csp = SWProvider()
+
+    def local_msp(d, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(d, mspid, csp=csp))
+        return m
+
+    omsp = local_msp(os.path.join(ordo, "orderers",
+                                  "orderer0.example.com", "msp"),
+                     "OrdererMSP")
+    reg = Registrar(str(root / "ord"),
+                    omsp.get_default_signing_identity(), csp,
+                    {"solo": solo.consenter})
+    reg.join(genesis)
+    broadcast = BroadcastHandler(reg)
+    deliver = DeliverHandler(reg.get_chain)
+
+    # col1 is Org1-members-only; cc policy Org1-member so the single
+    # org1 peer can endorse alone
+    from fabric_tpu.core.policycheck import org_member_policy_bytes
+    definition = ChaincodeDefinition(
+        name="ev",
+        endorsement_policy=org_member_policy_bytes("Org1MSP"),
+        collections=(CollectionConfig(name="col1",
+                                      member_orgs=("Org1MSP",)),))
+    msp1 = local_msp(os.path.join(org1, "peers",
+                                  "peer0.org1.example.com", "msp"),
+                     "Org1MSP")
+    peer = Peer(str(root / "peer1"), msp1, csp)
+    ch = peer.join_channel(genesis)
+    peer.chaincode_support.register("ev", EvCC())
+    ch.define_chaincode(definition)
+    d = Deliverer(ch, peer.signer, lambda: deliver, peer.mcs)
+    d.start()
+
+    from fabric_tpu.peer.gateway import Gateway
+    user1 = local_msp(os.path.join(org1, "users",
+                                   "User1@org1.example.com", "msp"),
+                      "Org1MSP")
+    user2 = local_msp(os.path.join(org2, "users",
+                                   "User1@org2.example.com", "msp"),
+                      "Org2MSP")
+    gw = Gateway(peer, broadcast, user1.get_default_signing_identity())
+
+    r = gw.submit_transaction(CHANNEL, "ev", [b"put", b"a", b"1"])
+    assert r.status == txpb.TxValidationCode.VALID
+    r = gw.submit_transaction(CHANNEL, "ev", [b"pvt", b"p", b"2"])
+    assert r.status == txpb.TxValidationCode.VALID
+
+    yield {"peer": peer, "ch": ch,
+           "signer1": user1.get_default_signing_identity(),
+           "signer2": user2.get_default_signing_identity()}
+    d.stop()
+    reg.halt()
+    peer.close()
+
+
+def _collect(stream, want_blocks):
+    """Drain `want_blocks` data items + the trailing cursor position."""
+    out = []
+    for resp in stream:
+        which = resp.WhichOneof("type")
+        if which == "status":
+            break
+        out.append(resp)
+        if len(out) >= want_blocks:
+            break
+    return out
+
+
+class TestFilteredStream:
+    def test_filtered_blocks_carry_verdicts_not_payloads(self, net):
+        h = EventsDeliverHandler(
+            lambda cid: net["ch"] if cid == CHANNEL else None)
+        env = seek_envelope(CHANNEL, 0, net["signer1"],
+                            stop=net["ch"].ledger.height - 1)
+        got = _collect(h.handle_filtered(env), net["ch"].ledger.height)
+        assert got, "no filtered blocks streamed"
+        fbs = [r.filtered_block for r in got]
+        assert fbs[0].channel_id == CHANNEL
+        assert [fb.number for fb in fbs] == list(range(len(fbs)))
+        # find the endorser tx that set an event
+        events = [
+            (ft.txid, ft.tx_validation_code, fca.chaincode_event)
+            for fb in fbs
+            for ft in fb.filtered_transactions
+            for fca in ft.transaction_actions.chaincode_actions
+            if ft.type == common.HeaderType.ENDORSER_TRANSACTION
+        ]
+        named = [e for _, _, e in events if e.event_name == "put-event"]
+        assert named, "put-event missing from the filtered stream"
+        assert named[0].chaincode_id == "ev"
+        assert named[0].payload == b"", "payload must be stripped"
+        assert all(code == txpb.TxValidationCode.VALID
+                   for _, code, _ in events)
+
+
+class TestBlockWithPrivateData:
+    def _pvt_stream(self, net, signer):
+        h = EventsDeliverHandler(
+            lambda cid: net["ch"] if cid == CHANNEL else None)
+        env = seek_envelope(CHANNEL, 0, signer,
+                            stop=net["ch"].ledger.height - 1)
+        return _collect(h.handle_with_pvtdata(env),
+                        net["ch"].ledger.height)
+
+    def test_member_sees_cleartext(self, net):
+        got = self._pvt_stream(net, net["signer1"])
+        maps = [r.block_and_private_data.private_data_map for r in got]
+        colls = [
+            coll.collection_name
+            for m in maps for txpvt in m.values()
+            for ns in txpvt.ns_pvt_rwset
+            for coll in ns.collection_pvt_rwset
+        ]
+        assert "col1" in colls
+
+    def test_non_member_collections_filtered_out(self, net):
+        got = self._pvt_stream(net, net["signer2"])
+        assert got, "org2 reader should still receive blocks"
+        maps = [r.block_and_private_data.private_data_map for r in got]
+        assert all(len(m) == 0 for m in maps), \
+            "org2 must not receive org1-only collection cleartext"
