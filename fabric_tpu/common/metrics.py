@@ -5,13 +5,15 @@ Equivalent of the reference's ``common/metrics`` (go-kit style; see reference
 instruments from ``*Opts``; ``with_labels(...)`` returns a curried instrument.
 Backends: ``PrometheusProvider`` (in-process registry rendered as Prometheus
 text exposition on the operations endpoint, like the reference's
-``/metrics``), ``StatsdProvider`` is TODO, and ``DisabledProvider`` (no-ops,
-reference ``common/metrics/disabled``).
+``/metrics``), ``StatsdProvider`` (UDP push with a flush loop, reference
+``common/metrics/statsd`` + ``operations/system.go`` statsd wiring), and
+``DisabledProvider`` (no-ops, reference ``common/metrics/disabled``).
 """
 
 from __future__ import annotations
 
 import math
+import socket
 import threading
 from dataclasses import dataclass, field
 
@@ -239,6 +241,93 @@ def _fmt(v: float) -> str:
     if float(v).is_integer():
         return str(int(v))
     return repr(float(v))
+
+
+class StatsdProvider(PrometheusProvider):
+    """Statsd backend: instruments accumulate exactly like the registry
+    provider; a flush loop (or explicit `flush()`) emits the current
+    readings as statsd lines over UDP — `name.label1.label2:value|type`
+    (counters `|c`, gauges `|g`, histogram observations summarized as
+    `.sum`/`.count` gauges), matching the reference's go-kit statsd
+    bridge's dotted-path naming (`common/metrics/statsd/provider.go`
+    NewCounter/NewGauge/NewHistogram + operations/system.go flusher)."""
+
+    def __init__(self, address: str = "127.0.0.1:8125",
+                 prefix: str = "", flush_interval_s: float = 10.0):
+        super().__init__()
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._prefix = prefix
+        self._interval = flush_interval_s
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_counts: dict[str, float] = {}
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="statsd-flush", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._interval)
+            self._thread = None
+        self.flush()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.flush()
+            except Exception:
+                pass    # a statsd outage must never hurt the node
+
+    def _path(self, name: str, key) -> str:
+        parts = [self._prefix] if self._prefix else []
+        parts.append(name)
+        parts.extend(_escape_statsd(v) for _n, v in key if v)
+        return ".".join(parts)
+
+    def flush(self) -> list[str]:
+        """Emit current readings; returns the lines (for tests)."""
+        lines: list[str] = []
+        with self._lock:
+            instruments = dict(self._instruments)
+        for name, inst in sorted(instruments.items()):
+            if isinstance(inst, Histogram):
+                with inst._lock:
+                    states = {k: (s.sum, s.total)
+                              for k, s in inst._states.items()}
+                for key, (s, n) in sorted(states.items()):
+                    p = self._path(name, key)
+                    lines.append(f"{p}.sum:{_fmt(s)}|g")
+                    lines.append(f"{p}.count:{n}|g")
+                continue
+            with inst._lock:
+                values = dict(inst._values)
+            for key, v in sorted(values.items()):
+                p = self._path(name, key)
+                if isinstance(inst, Counter):
+                    # statsd counters are deltas; send the increment
+                    delta = v - self._last_counts.get(p, 0.0)
+                    self._last_counts[p] = v
+                    if delta:
+                        lines.append(f"{p}:{_fmt(delta)}|c")
+                else:
+                    lines.append(f"{p}:{_fmt(v)}|g")
+        for line in lines:
+            try:
+                self._sock.sendto(line.encode(), self._addr)
+            except OSError:
+                break
+        return lines
+
+
+def _escape_statsd(v: str) -> str:
+    return str(v).replace(".", "_").replace(":", "_").replace("|", "_")
 
 
 class _NoopInstrument:

@@ -46,9 +46,22 @@ class OrdererNode:
 
     def start(self) -> None:
         cfg = self.cfg
-        provider = metrics_mod.PrometheusProvider() \
-            if cfg.get("Metrics.Provider", "prometheus") == \
-            "prometheus" else metrics_mod.DisabledProvider()
+        from fabric_tpu.common import jaxenv
+        jaxenv.enable_compilation_cache(
+            cfg.get("General.XLACompilationCacheDir"))
+        which = cfg.get("Metrics.Provider", "prometheus")
+        if which == "statsd":
+            provider = metrics_mod.StatsdProvider(
+                address=cfg.get("Metrics.Statsd.Address",
+                                "127.0.0.1:8125"),
+                prefix=cfg.get("Metrics.Statsd.Prefix", ""),
+                flush_interval_s=cfg.get_duration(
+                    "Metrics.Statsd.WriteInterval", 10.0))
+            provider.start()
+        elif which == "prometheus":
+            provider = metrics_mod.PrometheusProvider()
+        else:
+            provider = metrics_mod.DisabledProvider()
 
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(

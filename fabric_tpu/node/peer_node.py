@@ -21,7 +21,6 @@ from fabric_tpu.comm import services as comm_services
 from fabric_tpu.comm.gossip_grpc import GRPCGossipTransport
 from fabric_tpu.comm.server import GRPCServer, ServerConfig
 from fabric_tpu.common import metrics as metrics_mod
-from fabric_tpu.common.deliver import DeliverHandler
 from fabric_tpu.common.viperutil import Config
 from fabric_tpu.gossip import GossipService
 from fabric_tpu.gossip.discovery import DiscoveryConfig
@@ -86,9 +85,24 @@ class PeerNode:
 
     def start(self) -> None:
         cfg = self.cfg
-        provider = metrics_mod.PrometheusProvider() \
-            if cfg.get("metrics.provider", "prometheus") == \
-            "prometheus" else metrics_mod.DisabledProvider()
+        # persistent XLA cache: a restarting peer must not recompile the
+        # verify kernel before its first big block (BENCH_r01: ~2 min)
+        from fabric_tpu.common import jaxenv
+        jaxenv.enable_compilation_cache(
+            cfg.get("peer.xlaCompilationCacheDir"))
+        which = cfg.get("metrics.provider", "prometheus")
+        if which == "statsd":
+            provider = metrics_mod.StatsdProvider(
+                address=cfg.get("metrics.statsd.address",
+                                "127.0.0.1:8125"),
+                prefix=cfg.get("metrics.statsd.prefix", ""),
+                flush_interval_s=cfg.get_duration(
+                    "metrics.statsd.writeInterval", 10.0))
+            provider.start()
+        elif which == "prometheus":
+            provider = metrics_mod.PrometheusProvider()
+        else:
+            provider = metrics_mod.DisabledProvider()
         self.metrics = provider
 
         bccsp_cfg = cfg.get("peer.BCCSP") or {}
@@ -156,8 +170,9 @@ class PeerNode:
                                         self.peer.endorser)
         comm_services.register_gateway(self.server, gateway)
         comm_services.register_discovery(self.server, self.discovery)
-        comm_services.register_deliver(
-            self.server, DeliverHandler(
+        from fabric_tpu.peer.deliverevents import EventsDeliverHandler
+        comm_services.register_peer_deliver(
+            self.server, EventsDeliverHandler(
                 lambda cid: self.peer.channel(cid)))
         comm_services.register_gossip(
             self.server, self.gossip.node._on_message)

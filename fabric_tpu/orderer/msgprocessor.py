@@ -34,6 +34,10 @@ NORMAL = 0
 CONFIG_UPDATE = 1
 CONFIG = 2
 
+# ConsensusType.state values (configtx.proto)
+STATE_NORMAL = 0
+STATE_MAINTENANCE = 1
+
 
 def classify(ch: common.ChannelHeader) -> int:
     """Reference: `standardchannel.go:82` ClassifyMsg."""
@@ -78,6 +82,84 @@ class StandardChannel:
             raise PermissionDenied(
                 f"{policy_name} policy rejected message: {e}")
 
+    # -- maintenance mode (reference: msgprocessor/maintenancefilter.go) --
+
+    def _consensus_state(self) -> int:
+        return getattr(self._support.bundle().orderer,
+                       "consensus_state", STATE_NORMAL)
+
+    def _check_maintenance_normal(self) -> None:
+        """Reference maintenancefilter.go Apply: while the channel is in
+        maintenance, normal transactions are rejected — only config
+        updates (the migration itself) may be ordered."""
+        if self._consensus_state() == STATE_MAINTENANCE:
+            raise MsgProcessorError(
+                "normal transactions are rejected during maintenance")
+
+    def _check_maintenance_config(self, current: ctxpb.Config,
+                                  proposed: ctxpb.Config) -> None:
+        """Gate ConsensusType changes on maintenance mode (reference:
+        maintenancefilter.go — the consensus-type migration state
+        machine):
+          * NORMAL → NORMAL: the consensus type must not change.
+          * NORMAL → MAINTENANCE / MAINTENANCE → NORMAL: the update may
+            change NOTHING except ConsensusType.state.
+          * MAINTENANCE → MAINTENANCE: type/metadata may change (the
+            migration step itself).
+        """
+        from fabric_tpu.common.channelconfig.bundle import (
+            CONSENSUS_TYPE_KEY, ORDERER,
+        )
+
+        def ct_of(cfg: ctxpb.Config) -> ctxpb.ConsensusType:
+            grp = cfg.channel_group.groups[ORDERER]
+            ct = ctxpb.ConsensusType()
+            ct.ParseFromString(grp.values[CONSENSUS_TYPE_KEY].value)
+            return ct
+
+        try:
+            cur, nxt = ct_of(current), ct_of(proposed)
+        except Exception as e:
+            raise MsgProcessorError(
+                f"config update drops the ConsensusType value: {e}")
+        if cur.state == STATE_NORMAL and nxt.state == STATE_NORMAL:
+            if nxt.type != cur.type:
+                raise MsgProcessorError(
+                    f"attempted to change consensus type from "
+                    f"{cur.type} to {nxt.type} outside of maintenance "
+                    f"mode")
+            return
+        if cur.state != nxt.state:
+            # entry/exit must change ONLY ConsensusType.state
+            a, b = ctxpb.Config(), ctxpb.Config()
+            a.CopyFrom(current)
+            b.CopyFrom(proposed)
+            for cfg in (a, b):
+                grp = cfg.channel_group.groups[ORDERER]
+                grp.values[CONSENSUS_TYPE_KEY].value = b""
+                grp.values[CONSENSUS_TYPE_KEY].ClearField("mod_policy")
+            # version bumps accompany any value change; normalize them
+            a.sequence = 0
+            b.sequence = 0
+            grp_a = a.channel_group.groups[ORDERER]
+            grp_b = b.channel_group.groups[ORDERER]
+            grp_a.values[CONSENSUS_TYPE_KEY].version = 0
+            grp_b.values[CONSENSUS_TYPE_KEY].version = 0
+            if pu.marshal(a) != pu.marshal(b):
+                direction = "entry to" \
+                    if nxt.state == STATE_MAINTENANCE else "exit from"
+                raise MsgProcessorError(
+                    f"config update for {direction} maintenance mode "
+                    f"may change only ConsensusType.state")
+            if nxt.state == STATE_MAINTENANCE and nxt.type != cur.type:
+                raise MsgProcessorError(
+                    "cannot change consensus type while entering "
+                    "maintenance mode")
+            if nxt.state == STATE_NORMAL and nxt.type != cur.type:
+                raise MsgProcessorError(
+                    "cannot change consensus type while exiting "
+                    "maintenance mode")
+
     def process_normal_msg(self, env: common.Envelope) -> int:
         """Reference `ProcessNormalMsg:100`: capture the config
         sequence FIRST, then filter — if a config change races the
@@ -85,6 +167,7 @@ class StandardChannel:
         revalidate (standardchannel.go takes Sequence() before
         Apply for exactly this reason)."""
         seq = self._support.configtx_validator().sequence()
+        self._check_maintenance_normal()
         self._apply_filters(env, "/Channel/Writers")
         return seq
 
@@ -105,6 +188,7 @@ class StandardChannel:
             raise MsgProcessorError(f"bad config update envelope: {e}")
         validator = self._support.configtx_validator()
         new_config = validator.propose_config_update(update_env)
+        self._check_maintenance_config(validator.config, new_config)
 
         cfg_env = ctxpb.ConfigEnvelope()
         cfg_env.config.CopyFrom(new_config)

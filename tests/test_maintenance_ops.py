@@ -1,0 +1,213 @@
+"""Maintenance-mode filter, upgrade-dbs, statsd provider tests.
+
+Reference behaviors pinned: `orderer/common/msgprocessor/
+maintenancefilter.go` (consensus-type migration state machine),
+`internal/peer/node/upgrade_dbs.go` (format-gated derived-DB drop),
+`common/metrics/statsd` (dotted-path statsd emission).
+"""
+
+import os
+import socket
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common import metrics as metrics_mod
+from fabric_tpu.common.channelconfig.bundle import (
+    Bundle, CONSENSUS_TYPE_KEY, ORDERER,
+)
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.internal.configtxgen.genesis import config_from_block
+from fabric_tpu.orderer import msgprocessor
+from fabric_tpu.protos import configtx as ctxpb
+
+
+@pytest.fixture()
+def profile(tmp_path):
+    from fabric_tpu.internal import cryptogen
+    cdir = str(tmp_path / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    return {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [{"Name": "Org1", "ID": "Org1MSP",
+                               "MSPDir": os.path.join(org1, "msp")}],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "250ms",
+            "BatchSize": {"MaxMessageCount": 10},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0.example.com:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+
+
+def _config(profile) -> ctxpb.Config:
+    return config_from_block(
+        genesis_block("mchannel", new_channel_group(profile)))
+
+
+def _set_consensus(cfg: ctxpb.Config, *, ctype=None, state=None,
+                   bump=True) -> ctxpb.Config:
+    out = ctxpb.Config()
+    out.CopyFrom(cfg)
+    val = out.channel_group.groups[ORDERER].values[CONSENSUS_TYPE_KEY]
+    ct = ctxpb.ConsensusType()
+    ct.ParseFromString(val.value)
+    if ctype is not None:
+        ct.type = ctype
+    if state is not None:
+        ct.state = state
+    val.value = ct.SerializeToString(deterministic=True)
+    if bump:
+        val.version += 1
+        out.sequence += 1
+    return out
+
+
+class _Proc(msgprocessor.StandardChannel):
+    def __init__(self):
+        super().__init__("mchannel", None)
+
+
+class TestMaintenanceFilter:
+    def test_type_change_outside_maintenance_rejected(self, profile):
+        cur = _config(profile)
+        nxt = _set_consensus(cur, ctype="raft")
+        with pytest.raises(msgprocessor.MsgProcessorError,
+                           match="outside of maintenance"):
+            _Proc()._check_maintenance_config(cur, nxt)
+
+    def test_state_only_entry_and_exit_allowed(self, profile):
+        cur = _config(profile)
+        entry = _set_consensus(cur,
+                               state=msgprocessor.STATE_MAINTENANCE)
+        _Proc()._check_maintenance_config(cur, entry)      # no raise
+        maint = _set_consensus(cur,
+                               state=msgprocessor.STATE_MAINTENANCE,
+                               bump=False)
+        exit_ = _set_consensus(maint,
+                               state=msgprocessor.STATE_NORMAL)
+        _Proc()._check_maintenance_config(maint, exit_)    # no raise
+
+    def test_entry_with_other_changes_rejected(self, profile):
+        cur = _config(profile)
+        nxt = _set_consensus(cur, state=msgprocessor.STATE_MAINTENANCE)
+        # smuggle an unrelated change into the entry update
+        grp = nxt.channel_group.groups[ORDERER]
+        bs = ctxpb.BatchSize()
+        bs.ParseFromString(grp.values["BatchSize"].value)
+        bs.max_message_count = 99
+        grp.values["BatchSize"].value = bs.SerializeToString(
+            deterministic=True)
+        grp.values["BatchSize"].version += 1
+        with pytest.raises(msgprocessor.MsgProcessorError,
+                           match="only ConsensusType.state"):
+            _Proc()._check_maintenance_config(cur, nxt)
+
+    def test_migration_inside_maintenance_allowed(self, profile):
+        cur = _set_consensus(_config(profile),
+                             state=msgprocessor.STATE_MAINTENANCE,
+                             bump=False)
+        nxt = _set_consensus(cur, ctype="raft")
+        _Proc()._check_maintenance_config(cur, nxt)        # no raise
+
+    def test_normal_txs_rejected_during_maintenance(self, profile):
+        cfg = _set_consensus(_config(profile),
+                             state=msgprocessor.STATE_MAINTENANCE,
+                             bump=False)
+        bundle = Bundle("mchannel", cfg, SWProvider())
+
+        class _Support:
+            def bundle(self):
+                return bundle
+
+            def configtx_validator(self):
+                class _V:
+                    def sequence(self):
+                        return 0
+                return _V()
+
+        proc = msgprocessor.StandardChannel("mchannel", _Support())
+        with pytest.raises(msgprocessor.MsgProcessorError,
+                           match="maintenance"):
+            proc.process_normal_msg(__import__(
+                "fabric_tpu.protos", fromlist=["common"]
+            ).common.Envelope(payload=b"x"))
+
+
+class TestUpgradeDbs:
+    def test_old_format_refused_then_upgraded(self, tmp_path, profile):
+        from fabric_tpu.internal import nodeops
+        from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+        from fabric_tpu.ledger.kvledger import KVLedger, LedgerError
+        from fabric_tpu.ledger.ledgermgmt import LedgerManager
+
+        root = str(tmp_path / "ledgers")
+        mgr = LedgerManager(root)
+        ledger = mgr.create(
+            genesis_block("mchannel", new_channel_group(profile)),
+            "mchannel")
+        assert ledger.height == 1
+        mgr.close()
+
+        # simulate data written by an older binary: stamp an old format
+        kv = KVStore(os.path.join(root, "mchannel", "index.db"))
+        DBHandle(kv, "ledgermeta").put(b"datafmt", b"1.0")
+        kv.close()
+
+        with pytest.raises(LedgerError, match="upgrade-dbs"):
+            KVLedger("mchannel", os.path.join(root, "mchannel"))
+
+        done = nodeops.upgrade_dbs(root)
+        assert done == ["mchannel"]
+        # reopens clean; derived state was rebuilt from the block store
+        ledger = KVLedger("mchannel", os.path.join(root, "mchannel"))
+        assert ledger.height == 1
+        # idempotent: second run is a no-op
+        assert nodeops.upgrade_dbs(root) == []
+
+
+class TestStatsdProvider:
+    def test_flush_emits_dotted_lines(self):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        sock.settimeout(2.0)
+        port = sock.getsockname()[1]
+        p = metrics_mod.StatsdProvider(address=f"127.0.0.1:{port}",
+                                       prefix="ftpu")
+        c = p.new_counter(metrics_mod.CounterOpts(
+            namespace="orderer", name="txs",
+            label_names=("channel",))).with_labels("channel", "ch1")
+        g = p.new_gauge(metrics_mod.GaugeOpts(
+            namespace="ledger", name="height",
+            label_names=("channel",))).with_labels("channel", "ch1")
+        h = p.new_histogram(metrics_mod.HistogramOpts(
+            namespace="ledger", name="commit",
+            label_names=("channel",))).with_labels("channel", "ch1")
+        c.add(3)
+        g.set(7)
+        h.observe(0.5)
+        h.observe(1.5)
+        lines = p.flush()
+        assert "ftpu.orderer_txs.ch1:3|c" in lines
+        assert "ftpu.ledger_height.ch1:7|g" in lines
+        assert "ftpu.ledger_commit.ch1.sum:2|g" in lines
+        assert "ftpu.ledger_commit.ch1.count:2|g" in lines
+        got = set()
+        for _ in range(len(lines)):
+            got.add(sock.recv(4096).decode())
+        assert got == set(lines)
+        # counters emit deltas: a second flush with no activity is quiet
+        c.add(1)
+        lines2 = p.flush()
+        assert "ftpu.orderer_txs.ch1:1|c" in lines2
+        sock.close()
