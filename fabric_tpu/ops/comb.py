@@ -69,9 +69,73 @@ def g_tables() -> np.ndarray:
     return out
 
 
+# G-side 16-bit windows: halve the G points in the per-signature tree.
+NWIN_G16 = 16
+NENT_G16 = 1 << 16
+
+_g16_cache: list = []
+
+
+def g16_tables():
+    """(NWIN_G16 * NENT_G16, 3, L) device array —
+    T16[i*65536 + j] = j * 2^(16i) * G.
+
+    Too large to build with host ints (1M point ops); built ON DEVICE
+    once per process from the 8-bit host tables with one vectorized
+    complete add: T16_i[j] = T8_{2i}[j & 255] + T8_{2i+1}[j >> 8].
+    ~252 MB resident in HBM for the life of the process — the G
+    tables are universal constants, exactly the precompute a
+    long-lived validating peer wants.
+    """
+    if _g16_cache:
+        return _g16_cache[0]
+    import jax
+
+    g8 = jnp.asarray(g_tables())            # (32*256, 3, L)
+
+    def build(g8):
+        idx = jnp.arange(NENT_G16, dtype=jnp.int32)
+        lo, hi = idx & 255, idx >> 8
+        outs = []
+        for i in range(NWIN_G16):
+            a = jnp.take(g8, (2 * i) * NENT + lo, axis=0)
+            b = jnp.take(g8, (2 * i + 1) * NENT + hi, axis=0)
+            X, Y, Z = cadd((a[:, 0], a[:, 1], a[:, 2]),
+                           (b[:, 0], b[:, 1], b[:, 2]))
+            outs.append(jnp.stack([X, Y, Z], axis=1))
+        return jnp.concatenate(outs, axis=0)
+
+    _g16_cache.append(jax.jit(build)(g8))
+    return _g16_cache[0]
+
+
 # ---------------------------------------------------------------------------
 # Q-side tables (device, per distinct key)
 # ---------------------------------------------------------------------------
+
+def build_q16_tables(q_flat, K: int):
+    """8-bit Q tables -> 16-bit Q tables by pairwise window combining:
+    T16_{i,k}[j] = T8_{2i,k}[j & 255] + T8_{2i+1,k}[j >> 8].
+
+    ~1M*K point adds as ONE vectorized complete add — expensive per
+    call (and ~252*K MB resident), so callers cache the result per key
+    set: a validating peer sees the same org keys on every block, which
+    makes this a once-per-channel-config cost, not a per-block one.
+    Layout: flat16[(i * K + k) * 65536 + j].
+    """
+    idx = jnp.arange(NENT_G16, dtype=jnp.int32)
+    lo, hi = idx & 255, idx >> 8
+    outs = []
+    for i in range(NWIN_G16):
+        for k in range(K):
+            a = jnp.take(q_flat, ((2 * i) * K + k) * NENT + lo, axis=0)
+            b = jnp.take(q_flat, ((2 * i + 1) * K + k) * NENT + hi,
+                         axis=0)
+            X, Y, Z = cadd((a[:, 0], a[:, 1], a[:, 2]),
+                           (b[:, 0], b[:, 1], b[:, 2]))
+            outs.append(jnp.stack([X, Y, Z], axis=1))
+    return jnp.concatenate(outs, axis=0)
+
 
 def build_q_tables(qx, qy):
     """(K, L) affine key coords -> (NWIN * K * NENT, 3, L) projective table.
@@ -121,20 +185,25 @@ def build_q_tables(qx, qy):
 # Window extraction + combination
 # ---------------------------------------------------------------------------
 
-def _windows(u):
-    """Canonical (B, L) scalar -> (B, NWIN) int32 of 8-bit windows.
+def _windows(u, wbits: int = WBITS):
+    """Canonical (B, L) scalar -> (B, 256//wbits) int32 windows.
 
     Window bit positions are static, so limb indices/shifts resolve at
-    trace time — no dynamic slicing.
+    trace time — no dynamic slicing. A window spans at most three
+    13-bit limbs for wbits <= 16.
     """
     cols = []
-    for i in range(NWIN):
-        bit0 = i * WBITS
+    for i in range(256 // wbits):
+        bit0 = i * wbits
         j0, off = bit0 // W, bit0 % W
         v = u[:, j0] >> off
-        if off + WBITS > W and j0 + 1 < L:
-            v = v | (u[:, j0 + 1] << (W - off))
-        cols.append(v & (NENT - 1))
+        got = W - off
+        j = j0 + 1
+        while got < wbits and j < L:
+            v = v | (u[:, j] << got)
+            got += W
+            j += 1
+        cols.append(v & ((1 << wbits) - 1))
     return jnp.stack(cols, axis=1)
 
 
@@ -152,37 +221,55 @@ def _tree_reduce(X, Y, Z):
     return X[:, 0], Y[:, 0], Z[:, 0]
 
 
-def comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K: int):
+def comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K: int,
+                           g16=None, q16: bool = False):
     """R = u1*G + u2*Q_{key_idx} for a batch, via two combs.
 
     u1, u2: (B, L) canonical scalars; key_idx: (B,) int32 in [0, K);
     g_flat: (NWIN*NENT, 3, L); q_flat: (NWIN*K*NENT, 3, L).
+    With g16 (the 16-bit G table), the G side contributes 16 points
+    instead of 32 — a 48-point tree (25% fewer adds per signature).
     Returns projective (X, Y, Z) each (B, L).
     """
-    w1 = _windows(u1)                       # (B, NWIN)
-    w2 = _windows(u2)
-    win = jnp.arange(NWIN, dtype=jnp.int32)[None, :]
-    g_idx = win * NENT + w1
-    q_idx = (win * K + key_idx[:, None]) * NENT + w2
-    pts_g = jnp.take(g_flat, g_idx, axis=0)     # (B, NWIN, 3, L)
+    if g16 is not None:
+        w1 = _windows(u1, 16)               # (B, 16)
+        win = jnp.arange(NWIN_G16, dtype=jnp.int32)[None, :]
+        pts_g = jnp.take(g16, win * NENT_G16 + w1, axis=0)
+    else:
+        w1 = _windows(u1)                   # (B, NWIN)
+        win = jnp.arange(NWIN, dtype=jnp.int32)[None, :]
+        pts_g = jnp.take(g_flat, win * NENT + w1, axis=0)
+    if q16:                             # 16-bit Q tables (build_q16_tables)
+        w2 = _windows(u2, 16)
+        win = jnp.arange(NWIN_G16, dtype=jnp.int32)[None, :]
+        q_idx = (win * K + key_idx[:, None]) * NENT_G16 + w2
+    else:
+        w2 = _windows(u2)
+        win = jnp.arange(NWIN, dtype=jnp.int32)[None, :]
+        q_idx = (win * K + key_idx[:, None]) * NENT + w2
     pts_q = jnp.take(q_flat, q_idx, axis=0)
     pts = jnp.concatenate([pts_g, pts_q], axis=1)
     return _tree_reduce(pts[:, :, 0], pts[:, :, 1], pts[:, :, 2])
 
 
 def comb_verify_with_tables(digest_words, key_idx, q_flat, r, rpn, w,
-                            premask):
+                            premask, g16=None, q16: bool = False):
     """Batched ECDSA accept/reject against a prebuilt Q-table.
 
-    q_flat: (NWIN*K*NENT, 3, L) from build_q_tables — built once per
-    block/batch and reused across pipelined chunks.
+    q_flat: from build_q_tables (8-bit windows; q16=False) or
+    build_q16_tables (16-bit; q16=True) — built once per key set and
+    reused across blocks/chunks. g16: optional 16-bit G-window table
+    (g16_tables()); with both 16-bit sides the per-signature tree has
+    32 points.
     """
-    K = q_flat.shape[0] // (NWIN * NENT)
-    g_flat = jnp.asarray(g_tables())
+    ent = NWIN_G16 * NENT_G16 if q16 else NWIN * NENT
+    K = q_flat.shape[0] // ent
+    g_flat = jnp.asarray(g_tables()) if g16 is None else None
     e = limb.words_be_to_limbs(digest_words)
     u1 = FN.canonical(FN.mulmod(e, w))
     u2 = FN.canonical(FN.mulmod(r, w))
-    X, _, Z = comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K)
+    X, _, Z = comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K,
+                                     g16=g16, q16=q16)
     nonzero = jnp.any(FP.canonical(Z) != 0, axis=-1)
     x_canon = FP.canonical(X)
     ok1 = jnp.all(x_canon == FP.canonical(FP.mulmod(r, Z)), axis=-1)

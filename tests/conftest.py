@@ -31,3 +31,5 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "integration: multi-process nwo integration tests")
+    config.addinivalue_line(
+        "markers", "slow: long-running crypto tests")

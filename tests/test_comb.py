@@ -5,9 +5,12 @@ pinned against OpenSSL in test_p256.py.
 """
 
 import hashlib
+import os
 import random
 
 import numpy as np
+
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -94,6 +97,84 @@ class TestCombDoubleScalarMul:
                     u2s[i],
                     (key_pts[key_idx[i]][0], key_pts[key_idx[i]][1], 1)),
             )
+            got = tuple(
+                limb.limbs_to_int(np.asarray(p256.FP.canonical(v[i])))
+                for v in (X, Y, Z))
+            assert (p256.to_affine_int(got) ==
+                    p256.to_affine_int(want)), f"lane {i}"
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTPU_SLOW") != "1",
+    reason="heavy differential; set FTPU_SLOW=1 (10+ min compile)")
+class TestG16Windows:
+    def test_g16_matches_generic_ladder(self):
+        """16-bit G-side windows (48-point tree) agree with the int
+        reference on R = u1*G + u2*Q."""
+        B, K = 4, 2
+        key_pts = [_point(rng.randrange(1, p256.N)) for _ in range(K)]
+        u1s = [rng.randrange(0, p256.N) for _ in range(B)]
+        u2s = [rng.randrange(0, p256.N) for _ in range(B)]
+        u1s[1] = 0
+        key_idx = [i % K for i in range(B)]
+        u1 = jnp.asarray(limb.ints_to_limbs(u1s))
+        u2 = jnp.asarray(limb.ints_to_limbs(u2s))
+        qx = jnp.asarray(limb.ints_to_limbs([p[0] for p in key_pts]))
+        qy = jnp.asarray(limb.ints_to_limbs([p[1] for p in key_pts]))
+        g16 = comb.g16_tables()
+
+        def run(u1, u2, idx, qx, qy, g16):
+            q = comb.build_q_tables(qx, qy)
+            return comb.comb_double_scalar_mul(
+                u1, u2, idx, None, q, K, g16=g16)
+
+        X, Y, Z = jax.jit(run)(
+            u1, u2, jnp.asarray(key_idx, dtype=jnp.int32), qx, qy, g16)
+        for i in range(B):
+            want = p256.cadd_int(
+                p256.scalar_mul_int(u1s[i], (p256.GX, p256.GY, 1)),
+                p256.scalar_mul_int(
+                    u2s[i],
+                    (key_pts[key_idx[i]][0], key_pts[key_idx[i]][1], 1)))
+            got = tuple(
+                limb.limbs_to_int(np.asarray(p256.FP.canonical(v[i])))
+                for v in (X, Y, Z))
+            assert (p256.to_affine_int(got) ==
+                    p256.to_affine_int(want)), f"lane {i}"
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTPU_SLOW") != "1",
+    reason="heavy differential; set FTPU_SLOW=1 (20+ min compile)")
+class TestQ16Windows:
+    def test_q16_matches_int_reference(self):
+        """16-bit windows on BOTH sides (32-point tree)."""
+        B, K = 3, 2
+        key_pts = [_point(rng.randrange(1, p256.N)) for _ in range(K)]
+        u1s = [rng.randrange(0, p256.N) for _ in range(B)]
+        u2s = [rng.randrange(0, p256.N) for _ in range(B)]
+        key_idx = [i % K for i in range(B)]
+        u1 = jnp.asarray(limb.ints_to_limbs(u1s))
+        u2 = jnp.asarray(limb.ints_to_limbs(u2s))
+        qx = jnp.asarray(limb.ints_to_limbs([p[0] for p in key_pts]))
+        qy = jnp.asarray(limb.ints_to_limbs([p[1] for p in key_pts]))
+        g16 = comb.g16_tables()
+        q8 = jax.jit(comb.build_q_tables)(qx, qy)
+        q16 = jax.jit(comb.build_q16_tables,
+                      static_argnums=1)(q8, K)
+
+        def run(u1, u2, idx, q16, g16):
+            return comb.comb_double_scalar_mul(
+                u1, u2, idx, None, q16, K, g16=g16, q16=True)
+
+        X, Y, Z = jax.jit(run)(
+            u1, u2, jnp.asarray(key_idx, dtype=jnp.int32), q16, g16)
+        for i in range(B):
+            want = p256.cadd_int(
+                p256.scalar_mul_int(u1s[i], (p256.GX, p256.GY, 1)),
+                p256.scalar_mul_int(
+                    u2s[i],
+                    (key_pts[key_idx[i]][0], key_pts[key_idx[i]][1], 1)))
             got = tuple(
                 limb.limbs_to_int(np.asarray(p256.FP.canonical(v[i])))
                 for v in (X, Y, Z))
