@@ -1,0 +1,362 @@
+"""Batched BN254 optimal-ate Miller loop on TPU (idemix stretch).
+
+The reference's identity mixer verifies BBS+ credentials with pairings
+over BN254 (vendored `IBM/idemix`, wired at `msp/idemix.go`). Its hot
+verify path computes a pairing product PER credential on CPU; here the
+Miller loop — the data-dependent bulk of the pairing — runs for a whole
+batch of (P, Q) pairs as one fixed-shape XLA program over the
+Montgomery limb engine (fabric_tpu/ops/mont.py).
+
+TPU-first shape:
+  * G2 state stays on the twist E'(Fp2): y^2 = x^3 + 3/(9+u), in
+    HOMOGENEOUS projective coordinates with the complete a=0
+    add/double formulas (Renes-Costello-Batina Algs 7/9) — branchless,
+    fixed-shape, safe at every edge case.
+  * Line functions are evaluated sparsely: l = A + B*w + C*w^3 with
+    A,B,C in Fp2 (coefficients scaled by Fp2 denominators, which the
+    final exponentiation kills).
+  * The loop is one lax.scan over the STATIC bit array of 6t+2; the
+    addition step is always computed and folded in with a lane-wide
+    select (bits are compile-time constants but a scan keeps the HLO
+    one-body-sized).
+  * The optimal-ate Frobenius correction points pi_p(Q), -pi_{p^2}(Q)
+    live on the twist, so the host precomputes them with exact int
+    arithmetic (fabric_tpu/ops/bn254_ref.g2_frobenius) and the device
+    runs two more add+line steps.
+
+The final exponentiation stays on the host for now (one f12_pow per
+batch element over the int reference) — the Miller loop is ~99% of the
+per-credential field work once the exponent bits are fixed.
+
+Differential oracle: fabric_tpu/ops/bn254_ref.miller_loop at matching
+loop counts (tests run truncated loops on CPU; the full 6t+2 loop is
+exercised on real hardware via bench paths).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from fabric_tpu.ops import bn254_ref as ref
+from fabric_tpu.ops import limb
+from fabric_tpu.ops.limb import L
+from fabric_tpu.ops.mont import MontMod
+
+# compact-HLO Montgomery: the Miller scan body holds hundreds of muls
+F = MontMod(ref.P, unroll=False)
+
+# b3 = 3 * b' = 9/(9+u) on the twist, as exact Fp2 ints
+_XI_INV = ref.f2_inv(ref.XI)
+_B_TW = ref.f2_mul((3, 0), _XI_INV)
+_B3_TW = ref.f2_mul((3, 0), ref.f2_mul((3, 0), _XI_INV))
+
+
+def _const_fp2(c):
+    """Exact Fp2 int pair -> broadcastable Montgomery limb constants."""
+    return (jnp.asarray(F.to_mont(c[0])), jnp.asarray(F.to_mont(c[1])))
+
+
+# ---------------------------------------------------------------------------
+# Tower arithmetic over Montgomery limb tensors
+# Fp2 = (a0, a1); Fp6 = (c0, c1, c2) of Fp2; Fp12 = (d0, d1) of Fp6
+# ---------------------------------------------------------------------------
+
+def f2_add(a, b):
+    return (F.add(a[0], b[0]), F.add(a[1], b[1]))
+
+
+def f2_sub(a, b):
+    return (F.sub(a[0], b[0]), F.sub(a[1], b[1]))
+
+
+def f2_mul(a, b):
+    """Karatsuba: 3 base multiplications."""
+    m0 = F.mul(a[0], b[0])
+    m1 = F.mul(a[1], b[1])
+    m2 = F.mul(F.add(a[0], a[1]), F.add(b[0], b[1]))
+    return (F.sub(m0, m1), F.sub(F.sub(m2, m0), m1))
+
+
+def f2_sqr(a):
+    return f2_mul(a, a)
+
+
+def f2_scale(a, s):
+    """Fp2 times an Fp element."""
+    return (F.mul(a[0], s), F.mul(a[1], s))
+
+
+def f2_neg(a):
+    return (F.neg(a[0]), F.neg(a[1]))
+
+
+def f2_mul_xi(a):
+    """Multiply by xi = 9 + u: (9a0 - a1, a0 + 9a1)."""
+    def x9(x):
+        x2 = F.add(x, x)
+        x4 = F.add(x2, x2)
+        x8 = F.add(x4, x4)
+        return F.add(x8, x)
+    return (F.sub(x9(a[0]), a[1]), F.add(a[0], x9(a[1])))
+
+
+def f2_small(a, k: int):
+    """Multiply by a small positive int via a binary add chain."""
+    acc = None
+    base = a
+    while k:
+        if k & 1:
+            acc = base if acc is None else f2_add(acc, base)
+        k >>= 1
+        if k:
+            base = f2_add(base, base)
+    return acc
+
+
+def f6_add(a, b):
+    return tuple(f2_add(x, y) for x, y in zip(a, b))
+
+
+def f6_sub(a, b):
+    return tuple(f2_sub(x, y) for x, y in zip(a, b))
+
+
+def f6_mul(a, b):
+    c0, c1, c2 = a
+    d0, d1, d2 = b
+    t0, t1, t2 = f2_mul(c0, d0), f2_mul(c1, d1), f2_mul(c2, d2)
+    r0 = f2_add(t0, f2_mul_xi(f2_add(f2_mul(c1, d2), f2_mul(c2, d1))))
+    r1 = f2_add(f2_add(f2_mul(c0, d1), f2_mul(c1, d0)), f2_mul_xi(t2))
+    r2 = f2_add(f2_add(f2_mul(c0, d2), f2_mul(c2, d0)), t1)
+    return (r0, r1, r2)
+
+
+def f6_mul_v(a):
+    """Multiply an Fp6 element by v (v^3 = xi)."""
+    return (f2_mul_xi(a[2]), a[0], a[1])
+
+
+def f12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    r0 = f6_add(t0, f6_mul_v(t1))
+    r1 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)),
+                f6_add(t0, t1))
+    return (r0, r1)
+
+
+def f12_sqr(a):
+    return f12_mul(a, a)
+
+
+def _f2_zero_like(x):
+    z = jnp.zeros_like(x[0])
+    return (z, z)
+
+
+def f12_one_like(x):
+    """Fp12 one, broadcast to the batch shape of Fp element x."""
+    one = jnp.broadcast_to(jnp.asarray(F.to_mont(1)), x.shape)
+    z = jnp.zeros_like(x)
+    return (((one, z), (z, z), (z, z)), ((z, z), (z, z), (z, z)))
+
+
+def line_to_f12(A, B, C):
+    """Sparse line A + B*w + C*w^3 as a full Fp12 element
+    (w^3 = v*w -> coefficient c1 of the second Fp6 component)."""
+    z = _f2_zero_like(A)
+    return ((A, z, z), (B, C, z))
+
+
+# ---------------------------------------------------------------------------
+# Twist-curve steps with line evaluation
+# ---------------------------------------------------------------------------
+
+def g2_dbl_line(T, xP, yP):
+    """Complete a=0 doubling (RCB15 Alg 9 with b3 on the twist) plus
+    the tangent line at T evaluated at P = (xP, yP) in G1.
+
+    T: ((X0,X1),(Y0,Y1),(Z0,Z1)) Fp2 limb tensors. Line coefficients
+    (see module docstring): scaled by Z^3,
+      A = 2*Y*Z^2 * yP,  B = -3*X^2*Z * xP,  C = 3*X^3 - 2*Y^2*Z.
+    """
+    X, Y, Z = T
+    b3 = tuple(jnp.broadcast_to(c, X[0].shape)
+               for c in _const_fp2(_B3_TW))
+    # line first (uses the pre-doubling T)
+    Z2 = f2_sqr(Z)
+    X2 = f2_sqr(X)
+    YZ = f2_mul(Y, Z)
+    A = f2_scale(f2_small(f2_mul(Y, Z2), 2), yP)
+    B = f2_scale(f2_neg(f2_small(f2_mul(X2, Z), 3)), xP)
+    C = f2_sub(f2_small(f2_mul(X2, X), 3), f2_small(f2_mul(Y, YZ), 2))
+    # RCB15 Alg 9 doubling
+    t0 = f2_sqr(Y)
+    Z3 = f2_small(t0, 8)
+    t1 = YZ
+    t2 = f2_sqr(Z)
+    t2 = f2_mul(b3, t2)
+    X3 = f2_mul(t2, Z3)
+    Y3 = f2_add(t0, t2)
+    Z3 = f2_mul(t1, Z3)
+    t1 = f2_small(t2, 2)
+    t2 = f2_add(t1, t2)
+    t0 = f2_sub(t0, t2)
+    Y3 = f2_mul(t0, Y3)
+    Y3 = f2_add(X3, Y3)
+    t1 = f2_mul(X, Y)
+    X3 = f2_mul(t0, t1)
+    X3 = f2_small(X3, 2)
+    return (X3, Y3, Z3), line_to_f12(A, B, C)
+
+
+def g2_add_line(T, Q, xP, yP):
+    """Complete a=0 mixed addition T + Q (RCB15 Alg 7 with Z2=1) plus
+    the chord line through T, Q evaluated at P.
+
+    Chord coefficients scaled by Z:
+      A = (X - xQ*Z) * yP,  B = -(Y - yQ*Z) * xP,
+      C = (Y - yQ*Z)*xQ - (X - xQ*Z)*yQ.
+    """
+    X1, Y1, Z1 = T
+    xQ, yQ = Q
+    b3 = tuple(jnp.broadcast_to(c, X1[0].shape)
+               for c in _const_fp2(_B3_TW))
+    # line
+    dX = f2_sub(X1, f2_mul(xQ, Z1))
+    dY = f2_sub(Y1, f2_mul(yQ, Z1))
+    A = f2_scale(dX, yP)
+    B = f2_scale(f2_neg(dY), xP)
+    C = f2_sub(f2_mul(dY, xQ), f2_mul(dX, yQ))
+    # RCB15 Alg 7, complete addition for a=0 (general Z2; the twist
+    # point Q is affine so Z2 = mont(1))
+    one = jnp.broadcast_to(jnp.asarray(F.to_mont(1)), X1[0].shape)
+    zero = jnp.zeros_like(one)
+    X2, Y2, Z2 = xQ, yQ, (one, zero)
+    t0 = f2_mul(X1, X2)
+    t1 = f2_mul(Y1, Y2)
+    t2 = f2_mul(Z1, Z2)
+    t3 = f2_mul(f2_add(X1, Y1), f2_add(X2, Y2))
+    t3 = f2_sub(t3, f2_add(t0, t1))
+    t4 = f2_mul(f2_add(Y1, Z1), f2_add(Y2, Z2))
+    t4 = f2_sub(t4, f2_add(t1, t2))
+    X3 = f2_mul(f2_add(X1, Z1), f2_add(X2, Z2))
+    Y3 = f2_sub(X3, f2_add(t0, t2))      # Y3 = X1*Z2 + X2*Z1
+    t0 = f2_small(t0, 3)                 # 3*X1*X2
+    t2 = f2_mul(b3, t2)
+    Z3 = f2_add(t1, t2)
+    t1 = f2_sub(t1, t2)
+    Y3 = f2_mul(b3, Y3)
+    X3 = f2_mul(t4, Y3)
+    X3 = f2_sub(f2_mul(t3, t1), X3)
+    Y3 = f2_mul(Y3, t0)
+    Y3 = f2_add(f2_mul(t1, Z3), Y3)
+    Z3 = f2_mul(Z3, t4)
+    Z3 = f2_add(Z3, f2_mul(t0, t3))
+    return (X3, Y3, Z3), line_to_f12(A, B, C)
+
+
+# ---------------------------------------------------------------------------
+# Batched Miller loop
+# ---------------------------------------------------------------------------
+
+def _select_pt(mask, a, b):
+    """Lane select between two Fp2 point triples; mask: (B,) bool."""
+    m = mask[:, None]
+    return tuple(
+        (jnp.where(m, x[0], y[0]), jnp.where(m, x[1], y[1]))
+        for x, y in zip(a, b))
+
+
+def _select_f12(mask, a, b):
+    m = mask[:, None]
+
+    def sel(x, y):
+        return jnp.where(m, x, y)
+
+    return tuple(
+        tuple((sel(x[0], y[0]), sel(x[1], y[1]))
+              for x, y in zip(c6a, c6b))
+        for c6a, c6b in zip(a, b))
+
+
+def miller_loop_batch(xP, yP, Q, Q1, nQ2, loop: int = ref.ATE_LOOP):
+    """f_{loop,Q}(P) for a batch, with optimal-ate corrections.
+
+    xP, yP: (B, L) Montgomery limbs of the G1 points.
+    Q, Q1, nQ2: affine twist points as ((x0,x1),(y0,y1)) of (B, L)
+    Montgomery limbs — Q1 = pi_p(Q) and nQ2 = -pi_{p^2}(Q) are
+    host-precomputed (exact ints, ref.g2_frobenius).
+    Returns the Fp12 Miller value as nested tuples of (B, L) tensors.
+    """
+    bits = [int(b) for b in bin(loop)[3:]]
+    bit_arr = jnp.asarray(np.array(bits, dtype=bool))
+    one = jnp.broadcast_to(jnp.asarray(F.to_mont(1)), xP.shape)
+    zero = jnp.zeros_like(one)
+    T0 = (Q[0], Q[1], ((one, zero)))
+    f0 = f12_one_like(xP)
+
+    def body(carry, bit):
+        T, f = carry
+        f = f12_sqr(f)
+        T, l = g2_dbl_line(T, xP, yP)
+        f = f12_mul(f, l)
+        Ta, la = g2_add_line(T, Q, xP, yP)
+        fa = f12_mul(f, la)
+        mask = jnp.broadcast_to(bit, xP.shape[:1])
+        T = _select_pt(mask, Ta, T)
+        f = _select_f12(mask, fa, f)
+        return (T, f), None
+
+    (T, f), _ = lax.scan(body, (T0, f0), bit_arr)
+    # optimal-ate corrections
+    T, l1 = g2_add_line(T, Q1, xP, yP)
+    f = f12_mul(f, l1)
+    _, l2 = g2_add_line(T, nQ2, xP, yP)
+    f = f12_mul(f, l2)
+    return f
+
+
+# ---------------------------------------------------------------------------
+# Host staging + verification helpers
+# ---------------------------------------------------------------------------
+
+def stage_g1(points) -> tuple[np.ndarray, np.ndarray]:
+    """[(x, y) ints] -> (B, L) Montgomery limb arrays."""
+    xs = np.stack([F.to_mont(p[0]) for p in points])
+    ys = np.stack([F.to_mont(p[1]) for p in points])
+    return xs, ys
+
+
+def stage_g2(points):
+    """[((x0,x1),(y0,y1)) ints] -> twist-point limb tuples + the
+    host-precomputed Frobenius correction points."""
+    def pack(pts):
+        return ((np.stack([F.to_mont(p[0][0]) for p in pts]),
+                 np.stack([F.to_mont(p[0][1]) for p in pts])),
+                (np.stack([F.to_mont(p[1][0]) for p in pts]),
+                 np.stack([F.to_mont(p[1][1]) for p in pts])))
+
+    q1s = [ref.g2_frobenius(q) for q in points]
+    nq2s = [ref.g2_neg_tw(ref.g2_frobenius(q1)) for q1 in q1s]
+    return pack(points), pack(q1s), pack(nq2s)
+
+
+def f12_from_device(f) -> list:
+    """Device Fp12 (nested tuples of (B, L) mont limbs) -> list of
+    int-reference Fp12 elements, for differential comparison."""
+    d0, d1 = f
+    B = d0[0][0].shape[0]
+    out = []
+    for i in range(B):
+        def cvt_f2(c):
+            return (F.from_limbs(np.asarray(c[0][i])),
+                    F.from_limbs(np.asarray(c[1][i])))
+        out.append((tuple(cvt_f2(c) for c in d0),
+                    tuple(cvt_f2(c) for c in d1)))
+    return out

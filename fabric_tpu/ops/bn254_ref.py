@@ -1,0 +1,337 @@
+"""BN254 optimal-ate pairing — exact Python-int reference.
+
+This is the correctness oracle for the batched TPU pairing kernels
+(the idemix stretch: the reference's identity mixer signs with BBS+
+over this curve — vendored `IBM/idemix` under `msp/idemix.go`). It is
+deliberately the SIMPLEST correct formulation, not a fast one:
+
+  * tower Fp -> Fp2 = Fp[u]/(u^2+1) -> Fp6 = Fp2[v]/(v^3 - (9+u))
+    -> Fp12 = Fp6[w]/(w^2 - v);
+  * G2 points are untwisted into E(Fp12) (x*w^2, y*w^3), so the Miller
+    loop uses plain affine chord-and-tangent lines with field division
+    and plain coordinate-wise Frobenius x -> x^p — no twist constants
+    to get subtly wrong;
+  * the final exponentiation is a single pow by (p^12-1)/r.
+
+Correctness is pinned by algebraic laws (bilinearity, non-degeneracy,
+unit output for infinity inputs) in tests/test_bn254.py; the TPU
+kernels are then differentially tested against THIS module.
+
+Curve: y^2 = x^3 + 3 over Fp; twist E': y^2 = x^3 + 3/(9+u) over Fp2
+(the alt_bn128 / EIP-197 parameter set — public domain parameters).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
+R = 21888242871839275222246405745257275088548364400416034343698204186575808495617
+T_BN = 4965661367192848881               # the BN parameter t
+ATE_LOOP = 6 * T_BN + 2
+
+G1 = (1, 2)
+# standard generator of the order-r subgroup of E'(Fp2)
+G2_X = (10857046999023057135944570762232829481370756359578518086990519993285655852781,
+        11559732032986387107991004021392285783925812861821192530917403151452391805634)
+G2_Y = (8495653923123431417604973247489272438418190587263600148770280649306958101930,
+        4082367875863433681332203403145435568316851327593401208105741076214120093531)
+
+
+# ---------------------------------------------------------------------------
+# Tower arithmetic over Python ints
+# ---------------------------------------------------------------------------
+
+def f2_add(a, b):
+    return ((a[0] + b[0]) % P, (a[1] + b[1]) % P)
+
+
+def f2_sub(a, b):
+    return ((a[0] - b[0]) % P, (a[1] - b[1]) % P)
+
+
+def f2_mul(a, b):
+    return ((a[0] * b[0] - a[1] * b[1]) % P,
+            (a[0] * b[1] + a[1] * b[0]) % P)
+
+
+def f2_inv(a):
+    d = pow(a[0] * a[0] + a[1] * a[1], -1, P)
+    return (a[0] * d % P, -a[1] * d % P)
+
+
+XI = (9, 1)                              # v^3 = 9 + u
+
+F2_ZERO = (0, 0)
+F2_ONE = (1, 0)
+
+
+def f6_add(a, b):
+    return tuple(f2_add(x, y) for x, y in zip(a, b))
+
+
+def f6_sub(a, b):
+    return tuple(f2_sub(x, y) for x, y in zip(a, b))
+
+
+def f6_mul(a, b):
+    c0, c1, c2 = a
+    d0, d1, d2 = b
+    t0, t1, t2 = f2_mul(c0, d0), f2_mul(c1, d1), f2_mul(c2, d2)
+    # schoolbook with v^3 = XI
+    r0 = f2_add(t0, f2_mul(XI, f2_add(f2_mul(c1, d2), f2_mul(c2, d1))))
+    r1 = f2_add(f2_add(f2_mul(c0, d1), f2_mul(c1, d0)),
+                f2_mul(XI, t2))
+    r2 = f2_add(f2_add(f2_mul(c0, d2), f2_mul(c2, d0)), t1)
+    return (r0, r1, r2)
+
+
+F6_ZERO = (F2_ZERO, F2_ZERO, F2_ZERO)
+F6_ONE = (F2_ONE, F2_ZERO, F2_ZERO)
+
+
+def f6_inv(a):
+    """Inverse via the adjoint/norm method over Fp2."""
+    c0, c1, c2 = a
+    t0 = f2_sub(f2_mul(c0, c0), f2_mul(XI, f2_mul(c1, c2)))
+    t1 = f2_sub(f2_mul(XI, f2_mul(c2, c2)), f2_mul(c0, c1))
+    t2 = f2_sub(f2_mul(c1, c1), f2_mul(c0, c2))
+    norm = f2_add(f2_mul(c0, t0),
+                  f2_mul(XI, f2_add(f2_mul(c2, t1), f2_mul(c1, t2))))
+    ninv = f2_inv(norm)
+    return (f2_mul(t0, ninv), f2_mul(t1, ninv), f2_mul(t2, ninv))
+
+
+def f12_add(a, b):
+    return (f6_add(a[0], b[0]), f6_add(a[1], b[1]))
+
+
+def f12_sub(a, b):
+    return (f6_sub(a[0], b[0]), f6_sub(a[1], b[1]))
+
+
+def f12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = f6_mul(a0, b0)
+    t1 = f6_mul(a1, b1)
+    # w^2 = v: multiply an Fp6 element by v
+    t1v = (f2_mul(XI, t1[2]), t1[0], t1[1])
+    r0 = f6_add(t0, t1v)
+    r1 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)),
+                f6_add(t0, t1))
+    return (r0, r1)
+
+
+F12_ZERO = (F6_ZERO, F6_ZERO)
+F12_ONE = (F6_ONE, F6_ZERO)
+
+
+def f12_inv(a):
+    a0, a1 = a
+    t1 = f6_mul(a1, a1)
+    t1v = (f2_mul(XI, t1[2]), t1[0], t1[1])
+    norm = f6_sub(f6_mul(a0, a0), t1v)
+    ninv = f6_inv(norm)
+    return (f6_mul(a0, ninv),
+            f6_sub(F6_ZERO, f6_mul(a1, ninv)))
+
+
+def f12_pow(a, e: int):
+    out = F12_ONE
+    base = a
+    while e:
+        if e & 1:
+            out = f12_mul(out, base)
+        base = f12_mul(base, base)
+        e >>= 1
+    return out
+
+
+def f12_frob(a):
+    """x -> x^p, computed the slow certain way."""
+    return f12_pow(a, P)
+
+
+def f12_eq(a, b) -> bool:
+    return a == b
+
+
+def f12_scalar(x: int):
+    """Embed Fp into Fp12."""
+    return (((x % P, 0), F2_ZERO, F2_ZERO), F6_ZERO)
+
+
+# w and its powers as Fp12 elements: w = (0, 1) in the Fp6[w] basis
+F12_W = (F6_ZERO, F6_ONE)
+F12_W2 = f12_mul(F12_W, F12_W)
+F12_W3 = f12_mul(F12_W2, F12_W)
+
+
+# ---------------------------------------------------------------------------
+# Curve over Fp12 (affine; None = point at infinity)
+# ---------------------------------------------------------------------------
+
+def ec_add(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if f12_eq(x1, x2):
+        if f12_eq(y1, y2):
+            if f12_eq(y1, F12_ZERO):
+                return None
+            lam = f12_mul(f12_mul(f12_scalar(3), f12_mul(x1, x1)),
+                          f12_inv(f12_mul(f12_scalar(2), y1)))
+        else:
+            return None
+    else:
+        lam = f12_mul(f12_sub(y2, y1), f12_inv(f12_sub(x2, x1)))
+    x3 = f12_sub(f12_sub(f12_mul(lam, lam), x1), x2)
+    y3 = f12_sub(f12_mul(lam, f12_sub(x1, x3)), y1)
+    return (x3, y3)
+
+
+def ec_mul(k: int, p):
+    out = None
+    for bit in bin(k)[2:] if k else "":
+        out = ec_add(out, out)
+        if bit == "1":
+            out = ec_add(out, p)
+    return out
+
+
+def ec_neg(p):
+    if p is None:
+        return None
+    return (p[0], f12_sub(F12_ZERO, p[1]))
+
+
+def untwist(q):
+    """E'(Fp2) affine (x, y) -> E(Fp12)."""
+    if q is None:
+        return None
+    (x, y) = q
+    ex = (((x[0], x[1]), F2_ZERO, F2_ZERO), F6_ZERO)
+    ey = (((y[0], y[1]), F2_ZERO, F2_ZERO), F6_ZERO)
+    return (f12_mul(ex, F12_W2), f12_mul(ey, F12_W3))
+
+
+def g1_embed(p):
+    if p is None:
+        return None
+    return (f12_scalar(p[0]), f12_scalar(p[1]))
+
+
+def on_curve_g1(p) -> bool:
+    if p is None:
+        return True
+    x, y = p
+    return (y * y - x * x * x - 3) % P == 0
+
+
+def on_curve_g2(q) -> bool:
+    if q is None:
+        return True
+    x, y = untwist(q)
+    lhs = f12_mul(y, y)
+    rhs = f12_add(f12_mul(x, f12_mul(x, x)), f12_scalar(3))
+    return f12_eq(lhs, rhs)
+
+
+# ---------------------------------------------------------------------------
+# Miller loop + pairing
+# ---------------------------------------------------------------------------
+
+def _line(t, q, p):
+    """l_{T,Q}(P) for affine T, Q, P on E(Fp12); handles T == Q
+    (tangent) and vertical lines."""
+    xt, yt = t
+    xq, yq = q
+    xp, yp = p
+    if f12_eq(xt, xq) and not f12_eq(yt, yq):
+        return f12_sub(xp, xt)            # vertical
+    if f12_eq(xt, xq):
+        lam = f12_mul(f12_mul(f12_scalar(3), f12_mul(xt, xt)),
+                      f12_inv(f12_mul(f12_scalar(2), yt)))
+    else:
+        lam = f12_mul(f12_sub(yq, yt), f12_inv(f12_sub(xq, xt)))
+    return f12_sub(f12_sub(yp, yt), f12_mul(lam, f12_sub(xp, xt)))
+
+
+def miller_loop(q_tw, p, loop: int = ATE_LOOP) -> tuple:
+    """f_{loop, Q}(P) with the optimal-ate Frobenius corrections.
+
+    q_tw: affine E'(Fp2) point (or None); p: affine G1 (or None).
+    Returns an Fp12 element (ONE for infinity inputs).
+    """
+    if q_tw is None or p is None:
+        return F12_ONE
+    q = untwist(q_tw)
+    pe = g1_embed(p)
+    f = F12_ONE
+    t = q
+    for bit in bin(loop)[3:]:
+        f = f12_mul(f12_mul(f, f), _line(t, t, pe))
+        t = ec_add(t, t)
+        if bit == "1":
+            f = f12_mul(f, _line(t, q, pe))
+            t = ec_add(t, q)
+    # optimal-ate corrections: Q1 = pi_p(Q), Q2 = pi_{p^2}(Q)
+    q1 = (f12_frob(q[0]), f12_frob(q[1]))
+    q2 = (f12_frob(q1[0]), f12_frob(q1[1]))
+    nq2 = ec_neg(q2)
+    f = f12_mul(f, _line(t, q1, pe))
+    t = ec_add(t, q1)
+    f = f12_mul(f, _line(t, nq2, pe))
+    return f
+
+
+@lru_cache(maxsize=None)
+def _final_exp_exponent() -> int:
+    return (P ** 12 - 1) // R
+
+
+def final_exponentiation(f) -> tuple:
+    return f12_pow(f, _final_exp_exponent())
+
+
+def pairing(q_tw, p) -> tuple:
+    """e(P, Q) — the full optimal-ate pairing into GT."""
+    return final_exponentiation(miller_loop(q_tw, p))
+
+
+def _retwist(p12):
+    """E(Fp12) point in the image of the untwist -> E'(Fp2) coords."""
+    x = f12_mul(p12[0], f12_inv(F12_W2))
+    y = f12_mul(p12[1], f12_inv(F12_W3))
+    return ((x[0][0][0], x[0][0][1]), (y[0][0][0], y[0][0][1]))
+
+
+def g2_mul(k: int, q):
+    """Scalar mul on the twist (through the untwist, for tests)."""
+    if q is None or k % R == 0:
+        return None
+    out = ec_mul(k % R, untwist(q))
+    if out is None:
+        return None
+    return _retwist(out)
+
+
+def g2_frobenius(q):
+    """The twisted Frobenius endomorphism psi^{-1} o pi_p o psi on
+    E'(Fp2) — exact int computation through the untwist (the device
+    Miller loop takes these correction points precomputed)."""
+    if q is None:
+        return None
+    u = untwist(q)
+    return _retwist((f12_frob(u[0]), f12_frob(u[1])))
+
+
+def g2_neg_tw(q):
+    if q is None:
+        return None
+    return (q[0], ((-q[1][0]) % P, (-q[1][1]) % P))

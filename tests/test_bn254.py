@@ -1,0 +1,66 @@
+"""BN254 pairing reference tests — algebraic-law pinning.
+
+The int reference (fabric_tpu/ops/bn254_ref.py) is the oracle for the
+TPU pairing kernels, so its own correctness is established here by the
+defining laws of a pairing: bilinearity in both arguments,
+non-degeneracy on the generators, and unit output at infinity. A buggy
+Miller loop or tower cannot satisfy bilinearity for random scalars.
+"""
+
+import random
+
+import pytest
+
+from fabric_tpu.ops import bn254_ref as bn
+
+rng = random.Random(271828)
+
+
+class TestTower:
+    def test_f2_f6_f12_inverses(self):
+        for _ in range(3):
+            a2 = (rng.randrange(bn.P), rng.randrange(1, bn.P))
+            assert bn.f2_mul(a2, bn.f2_inv(a2)) == bn.F2_ONE
+            a6 = tuple((rng.randrange(bn.P), rng.randrange(bn.P))
+                       for _ in range(3))
+            assert bn.f6_mul(a6, bn.f6_inv(a6)) == bn.F6_ONE
+            a12 = (a6, tuple((rng.randrange(bn.P), rng.randrange(bn.P))
+                             for _ in range(3)))
+            assert bn.f12_mul(a12, bn.f12_inv(a12)) == bn.F12_ONE
+
+    def test_w_squared_is_v(self):
+        # w^2 = v: (0,1,0) in the Fp6 c-basis of the first component
+        assert bn.F12_W2 == ((bn.F2_ZERO, bn.F2_ONE, bn.F2_ZERO),
+                             bn.F6_ZERO)
+
+
+class TestCurve:
+    def test_generators_on_curve(self):
+        assert bn.on_curve_g1(bn.G1)
+        assert bn.on_curve_g2((bn.G2_X, bn.G2_Y))
+
+    def test_generators_have_order_r(self):
+        assert bn.ec_mul(bn.R, bn.g1_embed(bn.G1)) is None
+        assert bn.ec_mul(bn.R, bn.untwist((bn.G2_X, bn.G2_Y))) is None
+
+
+@pytest.mark.slow
+class TestPairing:
+    def test_bilinearity_and_nondegeneracy(self):
+        q = (bn.G2_X, bn.G2_Y)
+        e = bn.pairing(q, bn.G1)
+        assert e != bn.F12_ONE, "pairing is degenerate"
+        # e(aP, bQ) == e(P, Q)^(ab)
+        a = rng.randrange(2, 1 << 40)
+        b = rng.randrange(2, 1 << 40)
+        ap = bn.ec_mul(a, bn.g1_embed(bn.G1))
+        ap = (ap[0][0][0][0], ap[1][0][0][0])     # back to Fp coords
+        bq = bn.g2_mul(b, q)
+        lhs = bn.pairing(bq, ap)
+        rhs = bn.f12_pow(e, a * b % bn.R)
+        assert lhs == rhs, "bilinearity violated"
+
+    def test_infinity_maps_to_one(self):
+        q = (bn.G2_X, bn.G2_Y)
+        assert bn.miller_loop(None, bn.G1) == bn.F12_ONE
+        assert bn.miller_loop(q, None) == bn.F12_ONE
