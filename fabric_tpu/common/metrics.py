@@ -288,7 +288,7 @@ class StatsdProvider(PrometheusProvider):
     def _path(self, name: str, key) -> str:
         parts = [self._prefix] if self._prefix else []
         parts.append(name)
-        parts.extend(_escape_statsd(v) for _n, v in key if v)
+        parts.extend(_escape_statsd(v) for _n, v in key)
         return ".".join(parts)
 
     def flush(self) -> list[str]:
@@ -327,7 +327,27 @@ class StatsdProvider(PrometheusProvider):
 
 
 def _escape_statsd(v: str) -> str:
-    return str(v).replace(".", "_").replace(":", "_").replace("|", "_")
+    out = str(v).replace(".", "_").replace(":", "_").replace("|", "_")
+    # empty label values must still occupy a path segment, or two
+    # distinct label sets would merge into one statsd series (and the
+    # counter delta bookkeeping would cross the streams)
+    return out or "unknown"
+
+
+def provider_from_config(which: str, statsd_address: str = "127.0.0.1:8125",
+                         statsd_prefix: str = "",
+                         statsd_interval_s: float = 10.0) -> Provider:
+    """One provider-selection path for both node assemblies (the config
+    key SPELLING differs between core.yaml and orderer.yaml; the
+    semantics must not)."""
+    if which == "statsd":
+        p = StatsdProvider(address=statsd_address, prefix=statsd_prefix,
+                           flush_interval_s=statsd_interval_s)
+        p.start()
+        return p
+    if which == "prometheus":
+        return PrometheusProvider()
+    return DisabledProvider()
 
 
 class _NoopInstrument:

@@ -49,19 +49,14 @@ class OrdererNode:
         from fabric_tpu.common import jaxenv
         jaxenv.enable_compilation_cache(
             cfg.get("General.XLACompilationCacheDir"))
-        which = cfg.get("Metrics.Provider", "prometheus")
-        if which == "statsd":
-            provider = metrics_mod.StatsdProvider(
-                address=cfg.get("Metrics.Statsd.Address",
-                                "127.0.0.1:8125"),
-                prefix=cfg.get("Metrics.Statsd.Prefix", ""),
-                flush_interval_s=cfg.get_duration(
-                    "Metrics.Statsd.WriteInterval", 10.0))
-            provider.start()
-        elif which == "prometheus":
-            provider = metrics_mod.PrometheusProvider()
-        else:
-            provider = metrics_mod.DisabledProvider()
+        provider = metrics_mod.provider_from_config(
+            cfg.get("Metrics.Provider", "prometheus"),
+            statsd_address=cfg.get("Metrics.Statsd.Address",
+                                   "127.0.0.1:8125"),
+            statsd_prefix=cfg.get("Metrics.Statsd.Prefix", ""),
+            statsd_interval_s=cfg.get_duration(
+                "Metrics.Statsd.WriteInterval", 10.0))
+        self.metrics = provider
 
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(
@@ -239,3 +234,7 @@ class OrdererNode:
             self.server.stop()
         if self.ops:
             self.ops.stop()
+        stop_metrics = getattr(getattr(self, "metrics", None), "stop",
+                               None)
+        if stop_metrics is not None:
+            stop_metrics()

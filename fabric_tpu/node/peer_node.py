@@ -90,19 +90,13 @@ class PeerNode:
         from fabric_tpu.common import jaxenv
         jaxenv.enable_compilation_cache(
             cfg.get("peer.xlaCompilationCacheDir"))
-        which = cfg.get("metrics.provider", "prometheus")
-        if which == "statsd":
-            provider = metrics_mod.StatsdProvider(
-                address=cfg.get("metrics.statsd.address",
-                                "127.0.0.1:8125"),
-                prefix=cfg.get("metrics.statsd.prefix", ""),
-                flush_interval_s=cfg.get_duration(
-                    "metrics.statsd.writeInterval", 10.0))
-            provider.start()
-        elif which == "prometheus":
-            provider = metrics_mod.PrometheusProvider()
-        else:
-            provider = metrics_mod.DisabledProvider()
+        provider = metrics_mod.provider_from_config(
+            cfg.get("metrics.provider", "prometheus"),
+            statsd_address=cfg.get("metrics.statsd.address",
+                                   "127.0.0.1:8125"),
+            statsd_prefix=cfg.get("metrics.statsd.prefix", ""),
+            statsd_interval_s=cfg.get_duration(
+                "metrics.statsd.writeInterval", 10.0))
         self.metrics = provider
 
         bccsp_cfg = cfg.get("peer.BCCSP") or {}
@@ -351,3 +345,8 @@ class PeerNode:
             self.ops.stop()
         if self.peer:
             self.peer.close()
+        # final metrics flush + flusher-thread shutdown (statsd)
+        stop_metrics = getattr(getattr(self, "metrics", None), "stop",
+                               None)
+        if stop_metrics is not None:
+            stop_metrics()

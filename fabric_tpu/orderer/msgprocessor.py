@@ -151,14 +151,16 @@ class StandardChannel:
                 raise MsgProcessorError(
                     f"config update for {direction} maintenance mode "
                     f"may change only ConsensusType.state")
-            if nxt.state == STATE_MAINTENANCE and nxt.type != cur.type:
+            direction = "entering" if nxt.state == STATE_MAINTENANCE \
+                else "exiting"
+            if nxt.type != cur.type:
                 raise MsgProcessorError(
-                    "cannot change consensus type while entering "
-                    "maintenance mode")
-            if nxt.state == STATE_NORMAL and nxt.type != cur.type:
+                    f"cannot change consensus type while {direction} "
+                    f"maintenance mode")
+            if nxt.metadata != cur.metadata:
                 raise MsgProcessorError(
-                    "cannot change consensus type while exiting "
-                    "maintenance mode")
+                    f"cannot change consensus metadata while "
+                    f"{direction} maintenance mode")
 
     def process_normal_msg(self, env: common.Envelope) -> int:
         """Reference `ProcessNormalMsg:100`: capture the config

@@ -58,15 +58,24 @@ class TestNwoEndToEnd:
             network.query("org2", 0, "get", "alice")
 
     def test_transfer_and_query_round_trip(self, network):
+        # self-contained: fund fresh accounts here rather than relying
+        # on state from other tests (any-order/solo runs must pass),
+        # and wait until org2's peer SEES the funding before asking it
+        # to endorse a transfer against that state
         assert _wait(lambda: json.loads(network.invoke(
-            "org1", 0, "put", "bob", "10"))["status"] == "VALID")
-        out = network.invoke("org2", 0, "transfer", "alice", "bob",
+            "org1", 0, "put", "carol", "100"))["status"] == "VALID",
+            timeout=60)
+        assert _wait(lambda: json.loads(network.invoke(
+            "org1", 0, "put", "dave", "10"))["status"] == "VALID")
+        assert _wait(lambda: network.query(
+            "org2", 0, "get", "carol").strip() == "100")
+        out = network.invoke("org2", 0, "transfer", "carol", "dave",
                              "30")
         assert json.loads(out)["status"] == "VALID"
         assert _wait(lambda: network.query(
-            "org1", 0, "get", "bob").strip() == "40")
-        assert network.query("org1", 0, "get",
-                             "alice").strip() == "70"
+            "org1", 0, "get", "dave").strip() == "40")
+        assert _wait(lambda: network.query(
+            "org1", 0, "get", "carol").strip() == "70")
 
     def test_osnadmin_lists_channel(self, network):
         out = network.osnadmin(0, "list")
