@@ -49,7 +49,7 @@ CPU_SAMPLE = 300
 TPU_ITERS = 5
 CHUNK = int(os.environ.get("BENCH_CHUNK", "30720"))
 USE_G16 = os.environ.get("BENCH_G16", "1") == "1"
-USE_Q16 = os.environ.get("BENCH_Q16", "0") == "1"
+USE_Q16 = os.environ.get("BENCH_Q16", "1") == "1"
 
 
 def main():

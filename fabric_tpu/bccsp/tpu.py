@@ -47,10 +47,14 @@ class TPUProvider(api.BCCSP):
         self._mesh = mesh
         self._max_keys = max_keys   # comb path cutoff (distinct pubkeys)
         self._chunk = chunk         # double-buffer chunk size (sigs)
-        # 16-bit G-side windows: 25% fewer tree adds per signature at
-        # the cost of a ~252 MB resident device table — the right trade
-        # on a real chip, off by default for CPU-mesh test runs
+        # 16-bit windows on BOTH bases: the per-signature tree drops
+        # from 64 to 32 points (measured 1.6x on the v5e) at the cost
+        # of large resident device tables (~252 MB for G, ~252*K MB per
+        # cached key set for Q). Off by default for CPU-mesh test runs;
+        # the Q tables are cached per key set because a validating peer
+        # sees the same org keys on every block.
         self._use_g16 = use_g16
+        self._qflat_cache: dict = {}     # key-set bytes -> q16 table
         self._fn = None             # lazily-built generic jitted pipeline
         self._comb_fns = {}         # (K,) -> jitted comb pipeline
         self._qtab_fns = {}         # K -> jitted table builder
@@ -234,11 +238,21 @@ class TPUProvider(api.BCCSP):
             qk[i] = np.frombuffer(kb, dtype=np.uint8)
         qx_k = limb.be_bytes_to_limbs(qk[:, :32])
         qy_k = limb.be_bytes_to_limbs(qk[:, 32:])
-        q_flat = self._qtab_fn(K)(jnp.asarray(qx_k), jnp.asarray(qy_k))
         if self._use_g16:
             from fabric_tpu.ops import comb
             g16 = comb.g16_tables()
+            cache_key = tuple(sorted(key_map))
+            q_flat = self._qflat_cache.get(cache_key)
+            if q_flat is None:
+                q8 = self._qtab_fn(K)(jnp.asarray(qx_k),
+                                      jnp.asarray(qy_k))
+                q_flat = self._q16_fn(K)(q8, K)
+                if len(self._qflat_cache) >= 4:   # bound device memory
+                    self._qflat_cache.pop(next(iter(self._qflat_cache)))
+                self._qflat_cache[cache_key] = q_flat
         else:
+            q_flat = self._qtab_fn(K)(jnp.asarray(qx_k),
+                                      jnp.asarray(qy_k))
             g16 = jnp.zeros((0, 3, r_l.shape[-1]), dtype=jnp.int32)
 
         chunk = min(bucket, self._chunk)
@@ -263,6 +277,16 @@ class TPUProvider(api.BCCSP):
             self._qtab_fns[K] = jax.jit(comb.build_q_tables)
         return self._qtab_fns[K]
 
+    def _q16_fn(self, K: int):
+        key = ("q16", K)
+        if key not in self._qtab_fns:
+            import jax
+
+            from fabric_tpu.ops import comb
+            self._qtab_fns[key] = jax.jit(
+                comb.build_q16_tables, static_argnums=1)
+        return self._qtab_fns[key]
+
     def _comb_pipeline(self, K: int):
         if K not in self._comb_fns:
             import jax
@@ -278,7 +302,7 @@ class TPUProvider(api.BCCSP):
                 words = jnp.where(has_digest[:, None], digests, hashed)
                 return comb.comb_verify_with_tables(
                     words, key_idx, q_flat, r, rpn, w, premask,
-                    g16=g16 if use_g16 else None)
+                    g16=g16 if use_g16 else None, q16=use_g16)
 
             if self._mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P

@@ -220,3 +220,43 @@ class TestCombVerifyCore:
         )
         assert np.asarray(out).tolist() == [
             True, True, True, True, False, False, False, False]
+
+
+@pytest.mark.skipif(
+    os.environ.get("FTPU_SLOW") != "1",
+    reason="heavy differential; set FTPU_SLOW=1 (20+ min compile)")
+class TestProvider16BitPath:
+    def test_provider_g16_q16_matches_sw_and_caches(self):
+        """TPUProvider(use_g16=True): the 32-point-tree product path
+        agrees with the sw oracle and reuses the cached per-key-set
+        Q tables on a second batch."""
+        from fabric_tpu.bccsp import bccsp as api
+        from fabric_tpu.bccsp.sw import SWProvider
+        from fabric_tpu.bccsp.tpu import TPUProvider
+
+        sw = SWProvider()
+        tpu = TPUProvider(min_batch=1, use_g16=True)
+        privs = [ec.generate_private_key(ec.SECP256R1())
+                 for _ in range(2)]
+        keys = [tpu.key_import(p.public_key(),
+                               api.ECDSAPublicKeyImportOpts())
+                for p in privs]
+
+        def batch(tag):
+            items = []
+            for i in range(12):
+                msg = f"{tag} {i}".encode() * 2
+                sig = privs[i % 2].sign(msg, ec.ECDSA(hashes.SHA256()))
+                if i % 4 == 3:
+                    msg += b"!"
+                items.append(api.VerifyItem(key=keys[i % 2],
+                                            signature=sig, message=msg))
+            return items
+
+        b1 = batch("one")
+        assert tpu.verify_batch(b1) == sw.verify_batch(b1)
+        assert len(tpu._qflat_cache) == 1
+        b2 = batch("two")        # same keys: cached tables reused
+        assert tpu.verify_batch(b2) == sw.verify_batch(b2)
+        assert len(tpu._qflat_cache) == 1
+        assert tpu.stats["comb_batches"] == 2
