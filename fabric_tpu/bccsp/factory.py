@@ -32,6 +32,14 @@ class TpuOpts:
     min_batch: int = 16
     max_blocks: int = 64
     n_devices: Optional[int] = None   # None = single-device (no mesh)
+    # comb-path knobs (fabric_tpu/bccsp/tpu.py): these select the
+    # flagship 16-bit-window configuration; use_g16=None auto-resolves
+    # to True on TPU backends so `BCCSP.Default: TPU` in core.yaml
+    # gets the measured kernel, not a degraded one.
+    use_g16: Optional[bool] = None
+    chunk: int = 32768
+    max_keys: int = 16
+    table_cache_bytes: int = 6 << 30
 
 
 @dataclass
@@ -60,6 +68,12 @@ class FactoryOpts:
                 max_blocks=int(tpu_cfg.get("MaxBlocks", 64)),
                 n_devices=(int(tpu_cfg["Devices"])
                            if tpu_cfg.get("Devices") is not None else None),
+                use_g16=(bool(tpu_cfg["UseG16"])
+                         if tpu_cfg.get("UseG16") is not None else None),
+                chunk=int(tpu_cfg.get("Chunk", 32768)),
+                max_keys=int(tpu_cfg.get("MaxKeys", 16)),
+                table_cache_bytes=(
+                    int(tpu_cfg.get("TableCacheMB", 6144)) << 20),
             ),
         )
 
@@ -78,7 +92,11 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
             from fabric_tpu.parallel import batch_mesh
             mesh = batch_mesh(opts.tpu.n_devices)
         return TPUProvider(ks, min_batch=opts.tpu.min_batch,
-                           max_blocks=opts.tpu.max_blocks, mesh=mesh)
+                           max_blocks=opts.tpu.max_blocks, mesh=mesh,
+                           max_keys=opts.tpu.max_keys,
+                           chunk=opts.tpu.chunk,
+                           use_g16=opts.tpu.use_g16,
+                           table_cache_bytes=opts.tpu.table_cache_bytes)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

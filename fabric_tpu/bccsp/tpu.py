@@ -40,7 +40,8 @@ N = utils.P256_N
 class TPUProvider(api.BCCSP):
     def __init__(self, keystore=None, min_batch: int = 16,
                  max_blocks: int = 64, mesh=None, max_keys: int = 16,
-                 chunk: int = 32768, use_g16: bool = False):
+                 chunk: int = 32768, use_g16: Optional[bool] = None,
+                 table_cache_bytes: int = 6 << 30):
         self._sw = swmod.SWProvider(keystore)
         self._min_batch = min_batch
         self._max_blocks = max_blocks
@@ -50,17 +51,57 @@ class TPUProvider(api.BCCSP):
         # 16-bit windows on BOTH bases: the per-signature tree drops
         # from 64 to 32 points (measured 1.6x on the v5e) at the cost
         # of large resident device tables (~252 MB for G, ~252*K MB per
-        # cached key set for Q). Off by default for CPU-mesh test runs;
-        # the Q tables are cached per key set because a validating peer
-        # sees the same org keys on every block.
+        # cached key set for Q). None = auto: on for TPU backends, off
+        # for CPU meshes (where the table build takes minutes and HBM
+        # budgets don't apply). The Q tables are cached per key set
+        # because a validating peer sees the same org keys on every
+        # block; the cache is bounded by BYTES (not entries) and
+        # evicted least-recently-used.
         self._use_g16 = use_g16
-        self._qflat_cache: dict = {}     # key-set bytes -> q16 table
+        self._table_cache_bytes = table_cache_bytes
+        self._qflat_cache: dict = {}     # key-set tuple -> q16 table (LRU)
+        self._qflat_cache_bytes = 0
         self._fn = None             # lazily-built generic jitted pipeline
-        self._comb_fns = {}         # (K,) -> jitted comb pipeline
+        self._comb_fns = {}         # (K, q16) -> jitted comb pipeline
         self._qtab_fns = {}         # K -> jitted table builder
         # observability: perf-cliff counters surfaced via provider stats
         self.stats = {"comb_batches": 0, "ladder_batches": 0,
-                      "host_hash_fallbacks": 0, "sw_fallbacks": 0}
+                      "host_hash_fallbacks": 0, "sw_fallbacks": 0,
+                      "q16_builds": 0, "q16_evictions": 0,
+                      "q16_oversize_skips": 0, "q16_cache_bytes": 0}
+
+    @staticmethod
+    def _on_tpu() -> bool:
+        import jax
+        d = jax.devices()[0]
+        return ("tpu" in d.platform.lower()
+                or "TPU" in getattr(d, "device_kind", ""))
+
+    def _g16_enabled(self) -> bool:
+        """Resolve the use_g16 auto default: big resident tables are the
+        right trade on a real TPU backend, not on CPU test meshes."""
+        if self._use_g16 is None:
+            self._use_g16 = self._on_tpu()
+            logger.info("BCCSP TPU provider: use_g16 auto-resolved to %s",
+                        self._use_g16)
+        return self._use_g16
+
+    def _tree_impl(self) -> str:
+        """Pick the tree-reduction implementation for the comb path.
+
+        "pallas" (ops/ptree.py — the whole complete-add tree in VMEM)
+        on real TPU backends; "xla" on CPU meshes and under GSPMD
+        sharding (a pallas_call is a custom call XLA cannot
+        auto-partition; the mesh path keeps the fusion-island graph).
+        FTPU_PALLAS=0/1 overrides for experiments.
+        """
+        import os
+        env = os.environ.get("FTPU_PALLAS")
+        if env is not None:
+            return "pallas" if env == "1" else "xla"
+        if self._mesh is not None:
+            return "xla"
+        return "pallas" if self._on_tpu() else "xla"
 
     # -- everything non-batch delegates (pkcs11-style containment) --
 
@@ -221,6 +262,61 @@ class TPUProvider(api.BCCSP):
             out = np.asarray(self._pipeline()(*args))
         return out[:n].tolist()
 
+    @staticmethod
+    def _canonical_key_order(key_map: dict, key_idx: np.ndarray):
+        """Reassign key indices by sorted key bytes.
+
+        key_map is built in first-appearance order, which varies between
+        batches over the SAME key set; table slot order and the cache key
+        must not depend on it (a cache hit with mismatched slot order
+        would comb every signature against the wrong public key).
+        Returns (ordered key bytes, remapped key_idx).
+        """
+        order = sorted(key_map)
+        remap = np.zeros(len(key_map), dtype=np.int32)
+        for j, kb in enumerate(order):
+            remap[key_map[kb]] = j
+        return order, remap[key_idx]
+
+    def _q16_est_bytes(self, K: int) -> int:
+        from fabric_tpu.ops import comb, limb
+        return comb.NWIN_G16 * K * comb.NENT_G16 * 3 * limb.L * 4
+
+    def _q16_cached(self, cache_key, K, qx_k, qy_k):
+        """LRU per-key-set 16-bit Q table, bounded by total bytes.
+
+        Returns None when a single table for this K would blow the
+        byte budget — the caller then stays on the 8-bit Q path rather
+        than thrashing HBM (the G side keeps its 16-bit table either
+        way)."""
+        import jax.numpy as jnp
+        q_flat = self._qflat_cache.pop(cache_key, None)
+        if q_flat is not None:
+            self._qflat_cache[cache_key] = q_flat   # move to MRU
+            return q_flat
+        est = self._q16_est_bytes(K)
+        if est > self._table_cache_bytes:
+            self.stats["q16_oversize_skips"] += 1
+            logger.warning(
+                "16-bit Q table for %d keys needs %.1f GB > TableCacheMB "
+                "budget (%.1f GB); staying on the 8-bit Q path for this "
+                "key set — raise BCCSP.TPU.TableCacheMB to restore the "
+                "flagship configuration", K, est / 2**30,
+                self._table_cache_bytes / 2**30)
+            return None
+        while (self._qflat_cache
+               and self._qflat_cache_bytes + est > self._table_cache_bytes):
+            evicted = self._qflat_cache.pop(next(iter(self._qflat_cache)))
+            self._qflat_cache_bytes -= evicted.size * 4
+            self.stats["q16_evictions"] += 1
+        q8 = self._qtab_fn(K)(jnp.asarray(qx_k), jnp.asarray(qy_k))
+        q_flat = self._q16_fn(K)(q8, K)
+        self.stats["q16_builds"] += 1
+        self._qflat_cache[cache_key] = q_flat
+        self._qflat_cache_bytes += q_flat.size * 4
+        self.stats["q16_cache_bytes"] = self._qflat_cache_bytes
+        return q_flat
+
     def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
                        r_l, rpn_l, w_l, premask, digests, has_digest):
         """Comb-method path: per-key tables built once, then the batch is
@@ -230,33 +326,32 @@ class TPUProvider(api.BCCSP):
 
         from fabric_tpu.ops import limb
 
+        order, key_idx = self._canonical_key_order(key_map, key_idx)
         K = 1
-        while K < len(key_map):
+        while K < len(order):
             K *= 2
         qk = np.zeros((K, 64), dtype=np.uint8)
-        for kb, i in key_map.items():
+        for i, kb in enumerate(order):
             qk[i] = np.frombuffer(kb, dtype=np.uint8)
         qx_k = limb.be_bytes_to_limbs(qk[:, :32])
         qy_k = limb.be_bytes_to_limbs(qk[:, 32:])
-        if self._use_g16:
+        q16 = False
+        if self._g16_enabled():
             from fabric_tpu.ops import comb
             g16 = comb.g16_tables()
-            cache_key = tuple(sorted(key_map))
-            q_flat = self._qflat_cache.get(cache_key)
-            if q_flat is None:
-                q8 = self._qtab_fn(K)(jnp.asarray(qx_k),
-                                      jnp.asarray(qy_k))
-                q_flat = self._q16_fn(K)(q8, K)
-                if len(self._qflat_cache) >= 4:   # bound device memory
-                    self._qflat_cache.pop(next(iter(self._qflat_cache)))
-                self._qflat_cache[cache_key] = q_flat
+            q_flat = self._q16_cached(tuple(order), K, qx_k, qy_k)
+            if q_flat is not None:
+                q16 = True
+            else:
+                q_flat = self._qtab_fn(K)(jnp.asarray(qx_k),
+                                          jnp.asarray(qy_k))
         else:
             q_flat = self._qtab_fn(K)(jnp.asarray(qx_k),
                                       jnp.asarray(qy_k))
             g16 = jnp.zeros((0, 3, r_l.shape[-1]), dtype=jnp.int32)
 
         chunk = min(bucket, self._chunk)
-        fn = self._comb_pipeline(K)
+        fn = self._comb_pipeline(K, q16)
         outs = []
         for lo in range(0, bucket, chunk):
             hi = lo + chunk
@@ -287,13 +382,15 @@ class TPUProvider(api.BCCSP):
                 comb.build_q16_tables, static_argnums=1)
         return self._qtab_fns[key]
 
-    def _comb_pipeline(self, K: int):
-        if K not in self._comb_fns:
+    def _comb_pipeline(self, K: int, q16: bool = False):
+        key = (K, q16)
+        if key not in self._comb_fns:
             import jax
 
             from fabric_tpu.ops import comb, sha256
 
-            use_g16 = self._use_g16
+            use_g16 = self._g16_enabled()
+            tree = self._tree_impl()
 
             def fused(blocks, nblocks, key_idx, q_flat, g16, r, rpn, w,
                       premask, digests, has_digest):
@@ -302,19 +399,19 @@ class TPUProvider(api.BCCSP):
                 words = jnp.where(has_digest[:, None], digests, hashed)
                 return comb.comb_verify_with_tables(
                     words, key_idx, q_flat, r, rpn, w, premask,
-                    g16=g16 if use_g16 else None, q16=use_g16)
+                    g16=g16 if use_g16 else None, q16=q16, tree=tree)
 
             if self._mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 s = NamedSharding(self._mesh, P("batch"))
                 rep = NamedSharding(self._mesh, P())
-                self._comb_fns[K] = jax.jit(
+                self._comb_fns[key] = jax.jit(
                     fused,
                     in_shardings=(s, s, s, rep, rep, s, s, s, s, s, s),
                     out_shardings=s)
             else:
-                self._comb_fns[K] = jax.jit(fused)
-        return self._comb_fns[K]
+                self._comb_fns[key] = jax.jit(fused)
+        return self._comb_fns[key]
 
     def _pipeline(self):
         if self._fn is None:

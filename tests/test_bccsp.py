@@ -121,6 +121,32 @@ class TestFactory:
         assert opts.default == "TPU"
         assert opts.sw.keystore_path == "/tmp/ks"
         assert opts.tpu.min_batch == 8
+        # flagship comb knobs default sanely: use_g16 auto (None); the
+        # 6 GiB table budget admits a max_keys=16 q16 table (~4 GiB)
+        assert opts.tpu.use_g16 is None
+        assert opts.tpu.chunk == 32768
+        assert opts.tpu.max_keys == 16
+        assert opts.tpu.table_cache_bytes == 6 << 30
+
+    def test_config_parse_comb_knobs(self):
+        """UseG16/Chunk/MaxKeys/TableCacheMB reach the provider through
+        new_bccsp — the measured configuration must be the shipped one
+        (round-2 verdict: factory never plumbed use_g16)."""
+        opts = factory.FactoryOpts.from_config({
+            "Default": "TPU",
+            "TPU": {"UseG16": True, "Chunk": 1024, "MaxKeys": 8,
+                    "TableCacheMB": 512},
+        })
+        assert opts.tpu.use_g16 is True
+        assert opts.tpu.chunk == 1024
+        assert opts.tpu.max_keys == 8
+        assert opts.tpu.table_cache_bytes == 512 << 20
+        csp = factory.new_bccsp(opts)
+        assert isinstance(csp, TPUProvider)
+        assert csp._use_g16 is True
+        assert csp._chunk == 1024
+        assert csp._max_keys == 8
+        assert csp._table_cache_bytes == 512 << 20
 
     def test_singleton(self):
         factory._reset_for_tests()
@@ -128,6 +154,122 @@ class TestFactory:
         b = factory.get_default()
         assert a is b
         factory._reset_for_tests()
+
+
+class TestQ16TableCache:
+    """Regression tests for the q16 table cache (round-2 advisor HIGH:
+    cache keyed by sorted keys but slots in first-appearance order —
+    a later batch with a different appearance order combed every
+    signature against the wrong key)."""
+
+    @staticmethod
+    def _stubbed_provider(monkeypatch, **kw):
+        """TPUProvider with the heavy table builds and the jitted comb
+        pipeline replaced by recorders, so cache keying/slot-order
+        logic runs the real dispatch path without device math."""
+        import jax.numpy as jnp
+
+        from fabric_tpu.ops import comb, limb
+
+        kw.setdefault("min_batch", 1)
+        kw.setdefault("use_g16", True)
+        tpu = TPUProvider(**kw)
+        calls = {"q8_builds": [], "pipeline_key_idx": []}
+        monkeypatch.setattr(comb, "g16_tables",
+                            lambda: jnp.zeros((0, 3, limb.L), jnp.int32))
+
+        def fake_qtab_fn(K):
+            def build(qx, qy):
+                calls["q8_builds"].append(np.asarray(qx).copy())
+                return np.zeros((K,))
+            return build
+
+        def fake_q16_fn(K):
+            return lambda q8, K_: FakeTable(10)
+
+        class FakeTable:
+            def __init__(self, n):
+                self.size = n
+
+        def fake_pipeline(K, q16=False):
+            def run(blocks, nblocks, key_idx, q_flat, g16, r, rpn, w,
+                    premask, digests, has_digest):
+                calls["pipeline_key_idx"].append(np.asarray(key_idx).copy())
+                return np.asarray(premask)
+            return run
+
+        monkeypatch.setattr(tpu, "_qtab_fn", fake_qtab_fn)
+        monkeypatch.setattr(tpu, "_q16_fn", fake_q16_fn)
+        monkeypatch.setattr(tpu, "_comb_pipeline", fake_pipeline)
+        return tpu, calls
+
+    @staticmethod
+    def _items(keys, order):
+        """One VerifyItem per entry of `order` (indices into keys),
+        signature irrelevant (stub pipeline returns premask)."""
+        sw = SWProvider()
+        out = []
+        for i, ki in enumerate(order):
+            m = f"m{i}".encode()
+            sig = sw.sign(keys[ki], hashlib.sha256(m).digest())
+            out.append(VerifyItem(key=keys[ki].public_key(), signature=sig,
+                                  message=m))
+        return out
+
+    def test_canonical_key_order_pure(self):
+        key_map = {b"bbb": 0, b"aaa": 1, b"ccc": 2}
+        key_idx = np.array([0, 1, 2, 0], dtype=np.int32)
+        order, remapped = TPUProvider._canonical_key_order(key_map, key_idx)
+        assert order == [b"aaa", b"bbb", b"ccc"]
+        assert remapped.tolist() == [1, 0, 2, 1]
+
+    def test_cache_hit_with_different_appearance_order(self, monkeypatch):
+        keys = [SWProvider().key_gen(ECDSAKeyGenOpts(ephemeral=True))
+                for _ in range(2)]
+        tpu, calls = self._stubbed_provider(monkeypatch)
+        # appearance order key0-first, then key1-first: same key SET
+        tpu.verify_batch(self._items(keys, [0, 1, 0, 1]))
+        tpu.verify_batch(self._items(keys, [1, 0, 1, 0]))
+        # one cache entry, one build — the second batch HIT the cache
+        assert len(tpu._qflat_cache) == 1
+        assert len(calls["q8_builds"]) == 1
+        assert tpu.stats["q16_builds"] == 1
+        # and the key_idx sent to the kernel is canonical in BOTH
+        # batches: same key must get the same slot regardless of
+        # appearance order
+        ki1, ki2 = calls["pipeline_key_idx"]
+        slot = {0: ki1[0], 1: ki1[1]}          # batch-1 slot per key
+        assert ki1.tolist()[:4] == [slot[0], slot[1], slot[0], slot[1]]
+        assert ki2.tolist()[:4] == [slot[1], slot[0], slot[1], slot[0]]
+
+    def test_lru_eviction_by_bytes(self, monkeypatch):
+        keys = [SWProvider().key_gen(ECDSAKeyGenOpts(ephemeral=True))
+                for _ in range(3)]
+        tpu, calls = self._stubbed_provider(monkeypatch)
+        # fake tables are 40 bytes each (size 10 * 4); budget fits two
+        tpu._table_cache_bytes = 100
+        monkeypatch.setattr(tpu, "_q16_est_bytes", lambda K: 40)
+        tpu.verify_batch(self._items(keys, [0, 0]))      # set {0}
+        tpu.verify_batch(self._items(keys, [1, 1]))      # set {1}
+        tpu.verify_batch(self._items(keys, [0, 0]))      # hit {0} -> MRU
+        tpu.verify_batch(self._items(keys, [2, 2]))      # evicts LRU {1}
+        assert tpu.stats["q16_evictions"] == 1
+        assert len(tpu._qflat_cache) == 2
+        tpu.verify_batch(self._items(keys, [1, 1]))      # {1} rebuilt
+        assert tpu.stats["q16_builds"] == 4
+
+    def test_oversize_key_set_skips_q16(self, monkeypatch):
+        keys = [SWProvider().key_gen(ECDSAKeyGenOpts(ephemeral=True))
+                for _ in range(2)]
+        tpu, calls = self._stubbed_provider(monkeypatch)
+        tpu._table_cache_bytes = 8   # smaller than any table estimate
+        monkeypatch.setattr(tpu, "_q16_est_bytes", lambda K: 40)
+        out = tpu.verify_batch(self._items(keys, [0, 1]))
+        assert out == [True, True]   # stub premask passthrough
+        assert tpu.stats["q16_oversize_skips"] == 1
+        assert not tpu._qflat_cache
+        # q8 tables were built instead (uncached fallback)
+        assert len(calls["q8_builds"]) == 1
 
 
 def _corpus():
