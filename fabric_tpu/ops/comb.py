@@ -221,15 +221,13 @@ def _tree_reduce(X, Y, Z):
     return X[:, 0], Y[:, 0], Z[:, 0]
 
 
-def comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K: int,
-                           g16=None, q16: bool = False):
-    """R = u1*G + u2*Q_{key_idx} for a batch, via two combs.
+def comb_gather_points(u1, u2, key_idx, g_flat, q_flat, K: int,
+                       g16=None, q16: bool = False):
+    """Gather the per-signature comb points: (B, M, 3, L).
 
-    u1, u2: (B, L) canonical scalars; key_idx: (B,) int32 in [0, K);
-    g_flat: (NWIN*NENT, 3, L); q_flat: (NWIN*K*NENT, 3, L).
-    With g16 (the 16-bit G table), the G side contributes 16 points
-    instead of 32 — a 48-point tree (25% fewer adds per signature).
-    Returns projective (X, Y, Z) each (B, L).
+    M = (16 or 32 G-side) + (16 or 32 Q-side) depending on window
+    widths. The subsequent tree sum is done either by `_tree_reduce`
+    (XLA) or by the Pallas VMEM kernel (fabric_tpu/ops/ptree.py).
     """
     if g16 is not None:
         w1 = _windows(u1, 16)               # (B, 16)
@@ -248,19 +246,35 @@ def comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K: int,
         win = jnp.arange(NWIN, dtype=jnp.int32)[None, :]
         q_idx = (win * K + key_idx[:, None]) * NENT + w2
     pts_q = jnp.take(q_flat, q_idx, axis=0)
-    pts = jnp.concatenate([pts_g, pts_q], axis=1)
+    return jnp.concatenate([pts_g, pts_q], axis=1)
+
+
+def comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K: int,
+                           g16=None, q16: bool = False):
+    """R = u1*G + u2*Q_{key_idx} for a batch, via two combs.
+
+    u1, u2: (B, L) canonical scalars; key_idx: (B,) int32 in [0, K);
+    g_flat: (NWIN*NENT, 3, L); q_flat: (NWIN*K*NENT, 3, L).
+    With g16 (the 16-bit G table), the G side contributes 16 points
+    instead of 32 — a 48-point tree (25% fewer adds per signature).
+    Returns projective (X, Y, Z) each (B, L).
+    """
+    pts = comb_gather_points(u1, u2, key_idx, g_flat, q_flat, K,
+                             g16=g16, q16=q16)
     return _tree_reduce(pts[:, :, 0], pts[:, :, 1], pts[:, :, 2])
 
 
 def comb_verify_with_tables(digest_words, key_idx, q_flat, r, rpn, w,
-                            premask, g16=None, q16: bool = False):
+                            premask, g16=None, q16: bool = False,
+                            tree: str = "xla"):
     """Batched ECDSA accept/reject against a prebuilt Q-table.
 
     q_flat: from build_q_tables (8-bit windows; q16=False) or
     build_q16_tables (16-bit; q16=True) — built once per key set and
     reused across blocks/chunks. g16: optional 16-bit G-window table
     (g16_tables()); with both 16-bit sides the per-signature tree has
-    32 points.
+    32 points. tree: "xla" (fusion-island graph) or "pallas" (the
+    VMEM tree kernel, ops/ptree.py — the fast path on real TPUs).
     """
     ent = NWIN_G16 * NENT_G16 if q16 else NWIN * NENT
     K = q_flat.shape[0] // ent
@@ -268,6 +282,11 @@ def comb_verify_with_tables(digest_words, key_idx, q_flat, r, rpn, w,
     e = limb.words_be_to_limbs(digest_words)
     u1 = FN.canonical(FN.mulmod(e, w))
     u2 = FN.canonical(FN.mulmod(r, w))
+    if tree == "pallas":
+        from fabric_tpu.ops import ptree
+        pts = comb_gather_points(u1, u2, key_idx, g_flat, q_flat, K,
+                                 g16=g16, q16=q16)
+        return ptree.tree_verify_points(pts, r, rpn, premask)
     X, _, Z = comb_double_scalar_mul(u1, u2, key_idx, g_flat, q_flat, K,
                                      g16=g16, q16=q16)
     nonzero = jnp.any(FP.canonical(Z) != 0, axis=-1)

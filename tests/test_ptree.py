@@ -1,0 +1,237 @@
+"""Pallas VMEM tree-kernel tests (fabric_tpu/ops/ptree.py).
+
+Ground truth: the Python-int projective reference in ops/p256.py (itself
+pinned against OpenSSL in test_p256.py). The kernel body (tree_body) is
+plain jnp, so most coverage runs it directly; one test goes through
+pallas_call in interpreter mode.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from fabric_tpu.ops import comb, limb, p256, ptree
+
+rng = random.Random(777)
+
+
+def _rand_point():
+    k = rng.randrange(1, p256.N)
+    nums = ec.derive_private_key(k, ec.SECP256R1()) \
+        .public_key().public_numbers()
+    return (nums.x, nums.y, 1)
+
+
+def _to_leading(vals, tile):
+    """list of ints -> (L, *tile) limb array (canonical)."""
+    arr = limb.ints_to_limbs(vals)                  # (B, L)
+    return jnp.asarray(arr.T.reshape((limb.L,) + tile))
+
+
+def _from_leading(a):
+    """(L, *tile) -> flat list of ints."""
+    flat = np.asarray(a).reshape(limb.L, -1)
+    return [limb.limbs_to_int(flat[:, i]) for i in range(flat.shape[1])]
+
+
+class TestKMod:
+    def test_mul_add_sub_canonical_match_int(self):
+        F = ptree._fpk()
+        tile = (2, 4)
+        n = 8
+        xs = [rng.randrange(0, p256.P) for _ in range(n)]
+        ys = [rng.randrange(0, p256.P) for _ in range(n)]
+        a = _to_leading(xs, tile)
+        b = _to_leading(ys, tile)
+        got_mul = _from_leading(jax.jit(
+            lambda a, b: F.canonical(F.mulmod(a, b)))(a, b))
+        got_add = _from_leading(jax.jit(
+            lambda a, b: F.canonical(F.addmod(a, b)))(a, b))
+        got_sub = _from_leading(jax.jit(
+            lambda a, b: F.canonical(F.submod(a, b)))(a, b))
+        for i in range(n):
+            assert got_mul[i] == xs[i] * ys[i] % p256.P
+            assert got_add[i] == (xs[i] + ys[i]) % p256.P
+            assert got_sub[i] == (xs[i] - ys[i]) % p256.P
+
+    def test_semi_reduced_inputs_accepted(self):
+        """mulmod over outputs of mulmod (semi-reduced) stays exact."""
+        F = ptree._fpk()
+        xs = [rng.randrange(0, p256.P) for _ in range(4)]
+        a = _to_leading(xs, (1, 4))
+
+        def chain(a):
+            s = F.mulmod(a, a)
+            s = F.mulmod(s, a)
+            s = F.addmod(s, s)
+            return F.canonical(F.submod(s, a))
+        got = _from_leading(jax.jit(chain)(a))
+        for i, x in enumerate(xs):
+            assert got[i] == (2 * pow(x, 3, p256.P) - x) % p256.P
+
+
+class TestCaddK:
+    def test_matches_int_reference(self):
+        pts1, pts2 = [], []
+        p0 = _rand_point()
+        cases = [
+            (_rand_point(), _rand_point()),     # generic
+            (p0, p0),                           # doubling via cadd
+            (p0, (0, 1, 0)),                    # P + inf
+            ((0, 1, 0), p0),                    # inf + P
+            ((0, 1, 0), (0, 1, 0)),             # inf + inf
+            (p0, (p0[0], p256.P - p0[1], 1)),   # P + (-P) -> inf
+            (_rand_point(), _rand_point()),
+            (_rand_point(), _rand_point()),
+        ]
+        pts1 = [c[0] for c in cases]
+        pts2 = [c[1] for c in cases]
+        tile = (2, 4)
+        A = tuple(_to_leading([p[c] for p in pts1], tile) for c in range(3))
+        B = tuple(_to_leading([p[c] for p in pts2], tile) for c in range(3))
+        X, Y, Z = jax.jit(ptree.cadd_k)(A, B)
+        F = ptree._fpk()
+        got = [
+            tuple(vals)
+            for vals in zip(*[_from_leading(F.canonical(v))
+                              for v in (X, Y, Z)])
+        ]
+        for i, (g, (q1, q2)) in enumerate(zip(got, cases)):
+            want = p256.cadd_int(q1, q2)
+            assert (p256.to_affine_int(g) ==
+                    p256.to_affine_int(want)), f"case {i}"
+
+
+class TestTreeBody:
+    @pytest.mark.parametrize("m,b", [(32, 128), (48, 128), (8, 256)])
+    def test_collapse_tile_matches_body(self, m, b):
+        X = jnp.zeros((limb.L, m, b), jnp.int32)
+        ts, tr = ptree._collapse_tile(m, b)
+        r = jnp.zeros((limb.L, ts, tr), jnp.int32)
+        pm = jnp.ones((ts, tr), jnp.int32)
+        out = ptree.tree_body(X, X, X, r, r, pm)
+        assert out.shape == (ts, tr)
+
+    def test_sum_matches_int_reference(self):
+        """M=8 random points per lane, B=128 lanes (2 interesting)."""
+        M, B = 8, 128
+        lanes = [[_rand_point() for _ in range(M)] for _ in range(2)]
+        # lane 1 gets some infinities mixed in
+        lanes[1][2] = (0, 1, 0)
+        lanes[1][5] = (0, 1, 0)
+        pts = np.zeros((B, M, 3, limb.L), np.int32)
+        for ln in range(2):
+            for m in range(M):
+                for c in range(3):
+                    pts[ln, m, c] = limb.int_to_limbs(lanes[ln][m][c])
+        # remaining lanes: infinity everywhere (premask off)
+        for ln in range(2, B):
+            for m in range(M):
+                pts[ln, m, 1] = limb.int_to_limbs(1)
+
+        # expected sums
+        want = []
+        for ln in range(2):
+            acc = (0, 1, 0)
+            for m in range(M):
+                acc = p256.cadd_int(acc, lanes[ln][m])
+            want.append(p256.to_affine_int(acc))
+
+        # drive through the full kernel contract: accept iff x(R) == r
+        r_vals = []
+        for ln in range(B):
+            if ln < 2 and want[ln] is not None:
+                r_vals.append(want[ln][0] % p256.N)
+            else:
+                r_vals.append(1)
+        rpn_vals = [rv + p256.N if rv + p256.N < p256.P else rv
+                    for rv in r_vals]
+        premask = np.zeros(B, bool)
+        premask[:2] = True
+        out = ptree.tree_verify_points(
+            jnp.asarray(pts), jnp.asarray(limb.ints_to_limbs(r_vals)),
+            jnp.asarray(limb.ints_to_limbs(rpn_vals)),
+            jnp.asarray(premask), interpret=True)
+        out = np.asarray(out)
+        assert out[:2].all()            # correct x(R) accepted
+        assert not out[2:].any()        # premask honored
+
+    def test_wrong_r_rejected(self):
+        M, B = 4, 128
+        lane = [_rand_point() for _ in range(M)]
+        pts = np.zeros((B, M, 3, limb.L), np.int32)
+        for m in range(M):
+            for c in range(3):
+                pts[0, m, c] = limb.int_to_limbs(lane[m][c])
+        for ln in range(1, B):
+            for m in range(M):
+                pts[ln, m, 1] = limb.int_to_limbs(1)
+        acc = (0, 1, 0)
+        for m in range(M):
+            acc = p256.cadd_int(acc, lane[m])
+        x_aff = p256.to_affine_int(acc)[0]
+        wrong = (x_aff + 1) % p256.N or 1
+        r_vals = [wrong] * B
+        rpn_vals = [rv + p256.N if rv + p256.N < p256.P else rv
+                    for rv in r_vals]
+        premask = np.ones(B, bool)
+        out = np.asarray(ptree.tree_verify_points(
+            jnp.asarray(pts), jnp.asarray(limb.ints_to_limbs(r_vals)),
+            jnp.asarray(limb.ints_to_limbs(rpn_vals)),
+            jnp.asarray(premask), interpret=True))
+        assert not out[0]
+
+
+class TestCombPallasParity:
+    def test_comb_verify_pallas_matches_xla(self):
+        """Full 8-bit comb verify: tree='pallas' (interpret) ==
+        tree='xla' over valid + tampered + masked lanes."""
+        import hashlib
+
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+        )
+
+        B, K = 8, 2
+        privs = [ec.generate_private_key(ec.SECP256R1()) for _ in range(K)]
+        key_pts = [p.public_key().public_numbers() for p in privs]
+        words = np.zeros((B, 8), dtype=np.uint32)
+        rs, ws, rpns, key_idx = [], [], [], []
+        for i in range(B):
+            k = i % K
+            msg = f"ptree tx {i}".encode() * (i + 1)
+            der = privs[k].sign(msg, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
+            if i == 5:
+                msg += b"!"             # tamper
+            words[i] = np.frombuffer(
+                hashlib.sha256(msg).digest(), dtype=">u4")
+            rs.append(r)
+            ws.append(pow(s, -1, p256.N))
+            rpns.append(r + p256.N if r + p256.N < p256.P else r)
+            key_idx.append(k)
+        premask = np.ones(B, bool)
+        premask[6] = False
+
+        qx = jnp.asarray(limb.ints_to_limbs([p.x for p in key_pts]))
+        qy = jnp.asarray(limb.ints_to_limbs([p.y for p in key_pts]))
+        q_flat = jax.jit(comb.build_q_tables)(qx, qy)
+        args = (jnp.asarray(words),
+                jnp.asarray(key_idx, dtype=jnp.int32), q_flat,
+                jnp.asarray(limb.ints_to_limbs(rs)),
+                jnp.asarray(limb.ints_to_limbs(rpns)),
+                jnp.asarray(limb.ints_to_limbs(ws)),
+                jnp.asarray(premask))
+        got_x = np.asarray(comb.comb_verify_with_tables(*args))
+        got_p = np.asarray(comb.comb_verify_with_tables(
+            *args, tree="pallas"))
+        assert got_x.tolist() == got_p.tolist()
+        assert got_x.tolist() == [True, True, True, True, True,
+                                  False, False, True]

@@ -1,0 +1,56 @@
+"""Probe: Mosaic compile time + steady throughput of the ptree kernel
+alone (random point data — timing only, no crypto validity).
+
+The tree kernel's cost is value-independent (branchless), so random
+13-bit limbs measure the real thing without paying for table builds or
+the gather/SHA XLA graph. Run: `python -u tools/probe_tree_only.py`.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BATCH = int(os.environ.get("PROBE_BATCH", "30720"))
+M = int(os.environ.get("PROBE_M", "32"))
+ITERS = int(os.environ.get("PROBE_ITERS", "5"))
+BLOCK_B = int(os.environ.get("PROBE_BLOCK_B", "512"))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from fabric_tpu.common import jaxenv
+    from fabric_tpu.ops import limb, ptree
+
+    jaxenv.enable_compilation_cache()
+    rng = np.random.default_rng(7)
+    pts = rng.integers(0, 1 << 13, size=(BATCH, M, 3, limb.L),
+                       dtype=np.int32)
+    r = rng.integers(0, 1 << 13, size=(BATCH, limb.L), dtype=np.int32)
+    pm = np.ones(BATCH, dtype=bool)
+
+    args = [jnp.asarray(a) for a in (pts, r, r, pm)]
+    jax.block_until_ready(args)
+    fn = jax.jit(lambda p, a, b, m: ptree.tree_verify_points(
+        p, a, b, m, block_b=BLOCK_B))
+    t0 = time.perf_counter()
+    out = np.asarray(fn(*args))
+    print(f"compile+first: {time.perf_counter() - t0:.1f}s "
+          f"(block_b={BLOCK_B}, M={M}, batch={BATCH})", flush=True)
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    print(f"steady={best*1e3:.1f}ms  {BATCH/best:.0f} sigs/s  "
+          f"times={[round(t*1e3) for t in times]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
