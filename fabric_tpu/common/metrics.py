@@ -294,6 +294,10 @@ class StatsdProvider(PrometheusProvider):
     def flush(self) -> list[str]:
         """Emit current readings; returns the lines (for tests)."""
         lines: list[str] = []
+        # counter-total commits, parallel to lines: _last_counts is
+        # only advanced AFTER a successful send, so a failed sendto
+        # re-emits the delta on the next flush instead of losing it
+        commits: list = []
         with self._lock:
             instruments = dict(self._instruments)
         for name, inst in sorted(instruments.items()):
@@ -305,6 +309,7 @@ class StatsdProvider(PrometheusProvider):
                     p = self._path(name, key)
                     lines.append(f"{p}.sum:{_fmt(s)}|g")
                     lines.append(f"{p}.count:{n}|g")
+                    commits.extend([None, None])
                 continue
             with inst._lock:
                 values = dict(inst._values)
@@ -313,16 +318,19 @@ class StatsdProvider(PrometheusProvider):
                 if isinstance(inst, Counter):
                     # statsd counters are deltas; send the increment
                     delta = v - self._last_counts.get(p, 0.0)
-                    self._last_counts[p] = v
                     if delta:
                         lines.append(f"{p}:{_fmt(delta)}|c")
+                        commits.append((p, v))
                 else:
                     lines.append(f"{p}:{_fmt(v)}|g")
-        for line in lines:
+                    commits.append(None)
+        for line, commit in zip(lines, commits):
             try:
                 self._sock.sendto(line.encode(), self._addr)
             except OSError:
                 break
+            if commit is not None:
+                self._last_counts[commit[0]] = commit[1]
         return lines
 
 

@@ -61,7 +61,8 @@ class ChaincodeStub:
                  transient: Optional[dict] = None,
                  support=None,
                  timestamp: int = 0,
-                 ledger=None):
+                 ledger=None,
+                 fence: Optional[dict] = None):
         self._channel_id = channel_id
         self._tx_id = tx_id
         self._ns = namespace
@@ -73,6 +74,28 @@ class ChaincodeStub:
         self._timestamp = timestamp
         self._ledger = ledger
         self._event: Optional[pb.ChaincodeEvent] = None
+        # the fence is a SHARED token: cc2cc child stubs are created
+        # with the parent's fence, so cancelling the top-level stub
+        # fences every stub in the invocation tree at once
+        self._fence: dict = fence if fence is not None else {"reason": None}
+
+    def cancel(self, reason: str) -> None:
+        """Fence off the stub (and every child stub sharing the fence):
+        every later state access raises.
+
+        Called by the support layer when an execute timeout abandons
+        the worker thread — the simulator is shared with the endorser
+        (and, for same-channel cc2cc, with the caller), so a
+        late-finishing chaincode must not keep mutating simulation
+        state after the proposal already failed."""
+        self._fence["reason"] = reason
+
+    def _live(self):
+        if self._fence["reason"] is not None:
+            raise RuntimeError(
+                "chaincode invocation cancelled: "
+                f"{self._fence['reason']}")
+        return self._sim
 
     # -- invocation context --
 
@@ -107,13 +130,13 @@ class ChaincodeStub:
     # -- state --
 
     def get_state(self, key: str) -> Optional[bytes]:
-        return self._sim.get_state(self._ns, key)
+        return self._live().get_state(self._ns, key)
 
     def put_state(self, key: str, value: bytes) -> None:
-        self._sim.put_state(self._ns, key, value)
+        self._live().put_state(self._ns, key, value)
 
     def del_state(self, key: str) -> None:
-        self._sim.del_state(self._ns, key)
+        self._live().del_state(self._ns, key)
 
     def set_state_validation_parameter(self, key: str,
                                        policy: bytes) -> None:
@@ -121,21 +144,21 @@ class ChaincodeStub:
         endorsement; reference shim SetStateValidationParameter →
         metadata write of VALIDATION_PARAMETER). Empty bytes removes
         the parameter, restoring the chaincode-level policy."""
-        md = self._sim.get_state_metadata(self._ns, key)
+        md = self._live().get_state_metadata(self._ns, key)
         if policy:
             md[VALIDATION_PARAMETER] = policy
         else:
             md.pop(VALIDATION_PARAMETER, None)
-        self._sim.set_state_metadata(self._ns, key, md)
+        self._live().set_state_metadata(self._ns, key, md)
 
     def get_state_validation_parameter(self, key: str) -> Optional[bytes]:
-        return self._sim.get_state_metadata(self._ns, key).get(
+        return self._live().get_state_metadata(self._ns, key).get(
             VALIDATION_PARAMETER)
 
     def get_state_by_range(self, start: str, end: str):
         """Iterate (key, value) in [start, end); '' means unbounded,
         matching the reference's GetStateByRange semantics."""
-        return self._sim.get_state_range(self._ns, start, end)
+        return self._live().get_state_range(self._ns, start, end)
 
     def get_history_for_key(self, key: str):
         """Newest-first history of committed values for `key` —
@@ -152,25 +175,26 @@ class ChaincodeStub:
     def get_query_result(self, query: str):
         """Rich JSON-selector query (reference GetQueryResult; the
         statecouchdb surface). Yields (key, value)."""
-        results, _bm = self._sim.get_query_result(self._ns, query)
+        results, _bm = self._live().get_query_result(self._ns, query)
         return iter(results)
 
     def get_query_result_with_pagination(self, query: str,
                                          page_size: int,
                                          bookmark: str = ""):
         """Returns (iterator, next_bookmark)."""
-        results, next_bm = self._sim.get_query_result(
+        results, next_bm = self._live().get_query_result(
             self._ns, query, page_size=page_size, bookmark=bookmark)
         return iter(results), next_bm
 
     # -- private data --
 
     def _pvt_sim(self):
-        if not hasattr(self._sim, "get_private_data"):
+        sim = self._live()
+        if not hasattr(sim, "get_private_data"):
             raise NotImplementedError(
                 "private data collections require a pvtdata-enabled "
                 "simulator (TxSimulator without pvtdata support)")
-        return self._sim
+        return sim
 
     def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
         return self._pvt_sim().get_private_data(self._ns, collection, key)
@@ -209,6 +233,8 @@ class ChaincodeStub:
     def set_event(self, name: str, payload: bytes) -> None:
         if not name:
             raise ValueError("event name must not be empty")
+        self._live()   # an abandoned worker must not overwrite the
+        #                event after the proposal already failed
         self._event = pb.ChaincodeEvent(
             chaincode_id=self._ns, tx_id=self._tx_id,
             event_name=name, payload=payload)
@@ -229,5 +255,7 @@ class ChaincodeStub:
         """
         if self._support is None:
             return error("chaincode-to-chaincode unavailable")
+        self._live()   # a fenced (timed-out) stub must not spawn an
+        #                unfenced child stub over the shared simulator
         return self._support.invoke_chaincode(
             self, name, list(args), channel or self._channel_id)

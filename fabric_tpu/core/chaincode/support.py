@@ -139,9 +139,17 @@ class ChaincodeSupport:
             logger.warning("chaincode %s exceeded the %.0fs execute "
                            "timeout in tx %s; abandoning the worker",
                            cc_id.name, self._timeout, tx_id)
-            resp = shim.error(
+            # fence the stub: the abandoned thread keeps a reference to
+            # the SHARED simulator (endorser-owned; caller-owned for
+            # same-channel cc2cc) — a late finisher must not mutate
+            # simulation state after the proposal already failed
+            stub.cancel(f"execute timeout after {self._timeout:.0f}s "
+                        f"in tx {tx_id}")
+            # events of a failed, abandoned invocation must not escape
+            # (the reference only emits events for successful runs)
+            return (shim.error(
                 f"chaincode {cc_id.name} timed out after "
-                f"{self._timeout:.0f}s")
+                f"{self._timeout:.0f}s"), None, cc_id)
         elif "exc" in outcome:
             logger.error("chaincode %s panicked: %s", cc_id.name,
                          outcome["exc"])
@@ -185,7 +193,9 @@ class ChaincodeSupport:
             namespace=name, simulator=sim,
             args=args, creator=caller_stub.get_creator(),
             transient=caller_stub.get_transient(), support=self,
-            timestamp=caller_stub.get_tx_timestamp(), ledger=ledger)
+            timestamp=caller_stub.get_tx_timestamp(), ledger=ledger,
+            fence=caller_stub._fence)   # share the cancellation fence:
+        #   a timeout on the parent must stop the whole invocation tree
         try:
             resp = cc.invoke(stub)
         except Exception as e:

@@ -27,6 +27,13 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Persistent compilation cache: the heavy differential tests jit the
+# same pipelines on every run; caching makes re-runs minutes faster on
+# this 1-core box (keyed by HLO hash — safe across code edits).
+from fabric_tpu.common import jaxenv  # noqa: E402
+
+jaxenv.enable_compilation_cache()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -223,3 +223,88 @@ class TestExecuteTimeout:
         assert resp.status == shim.ERROR
         assert b"timed out" in resp.message.encode()
         assert time.perf_counter() - t0 < 1.5
+
+    def test_abandoned_worker_cannot_mutate_simulator(self):
+        """After a timeout the stub is fenced: the late-finishing
+        worker's writes to the SHARED simulator must not land
+        (round-2 advisor: the endorser owns that simulator)."""
+        import threading
+
+        class Writes:
+            def __init__(self):
+                self.puts = []
+
+            def put_state(self, ns, key, value):
+                self.puts.append((ns, key, value))
+
+        wrote_late = threading.Event()
+
+        class LateWriter(Chaincode):
+            def init(self, stub):
+                return shim.success()
+
+            def invoke(self, stub):
+                time.sleep(0.5)
+                try:
+                    stub.put_state("k", b"poison")
+                finally:
+                    wrote_late.set()
+                return shim.success()
+
+        sim = Writes()
+        support = ChaincodeSupport(execute_timeout_s=0.1)
+        support.register("late", LateWriter())
+        spec = ppb.ChaincodeInvocationSpec()
+        spec.chaincode_spec.chaincode_id.name = "late"
+        resp, _ev, _id = support.execute("ch", "tx2", spec, sim)
+        assert resp.status == shim.ERROR
+        assert wrote_late.wait(3.0)
+        assert sim.puts == []           # fence held: no late write
+
+    def test_timeout_fences_cc2cc_child_and_suppresses_event(self):
+        """The fence is shared down the cc2cc tree: a worker abandoned
+        INSIDE a same-channel child invocation must not write through
+        the child stub, and the abandoned run's event must not escape
+        with the error response."""
+        import threading
+
+        class Writes:
+            def __init__(self):
+                self.puts = []
+
+            def put_state(self, ns, k, v):
+                self.puts.append((ns, k, v))
+
+        child_done = threading.Event()
+
+        class Child(Chaincode):
+            def init(self, stub):
+                return shim.success()
+
+            def invoke(self, stub):
+                time.sleep(0.5)         # outlive the parent's timeout
+                try:
+                    stub.put_state("k", b"poison-via-child")
+                finally:
+                    child_done.set()
+                return shim.success()
+
+        class Parent(Chaincode):
+            def init(self, stub):
+                return shim.success()
+
+            def invoke(self, stub):
+                stub.set_event("ev", b"partial")
+                return stub.invoke_chaincode("child", [b"go"])
+
+        sim = Writes()
+        support = ChaincodeSupport(execute_timeout_s=0.1)
+        support.register("parent", Parent())
+        support.register("child", Child())
+        spec = ppb.ChaincodeInvocationSpec()
+        spec.chaincode_spec.chaincode_id.name = "parent"
+        resp, ev, _id = support.execute("ch", "tx3", spec, sim)
+        assert resp.status == shim.ERROR
+        assert ev is None               # failed run's event suppressed
+        assert child_done.wait(3.0)
+        assert sim.puts == []           # child stub fenced too

@@ -211,3 +211,34 @@ class TestStatsdProvider:
         lines2 = p.flush()
         assert "ftpu.orderer_txs.ch1:1|c" in lines2
         sock.close()
+
+    def test_failed_send_retries_counter_delta(self):
+        """A sendto failure must NOT consume the counter delta — the
+        next flush re-emits it (round-2 advisor: _last_counts advanced
+        before the send, losing deltas on OSError)."""
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        sock.settimeout(2.0)
+        port = sock.getsockname()[1]
+        p = metrics_mod.StatsdProvider(address=f"127.0.0.1:{port}",
+                                       prefix="ftpu")
+        c = p.new_counter(metrics_mod.CounterOpts(
+            namespace="peer", name="verifies")).with_labels()
+        c.add(5)
+
+        real_sock = p._sock
+
+        class Boom:
+            def sendto(self, *_a):
+                raise OSError("network down")
+        p._sock = Boom()
+        lines = p.flush()               # send fails; delta must survive
+        assert any(":5|c" in ln for ln in lines)
+        p._sock = real_sock
+        lines = p.flush()               # same delta re-emitted
+        assert any(":5|c" in ln for ln in lines)
+        assert sock.recv(4096).decode().endswith(":5|c")
+        c.add(2)
+        lines = p.flush()               # and consumed once sent
+        assert any(":2|c" in ln for ln in lines)
+        sock.close()
