@@ -8,6 +8,13 @@ broadcasting DECLARATIONS; followers relinquish. A leader that falls
 silent past the alive threshold triggers re-election; a declaration
 from a smaller PKI-ID pre-empts a sitting leader (the reference's
 `leadershipMsg` handling).
+
+Split like the raft consenter (orderer/raft/core.py): `ElectionCore` is
+a pure, clock-free decision machine — callers feed it explicit `now`
+values and it returns actions — so whole multi-peer elections are
+unit-tested synchronously with simulated message orderings, drops and
+partitions (tests/test_election_core.py). `LeaderElectionService` wraps
+the core with the thread, the wall clock and the gossip wiring.
 """
 
 from __future__ import annotations
@@ -21,6 +28,72 @@ from fabric_tpu.gossip import message as gmsg
 from fabric_tpu.protos import gossip as gpb
 
 logger = logging.getLogger("gossip.election")
+
+# actions emitted by the core
+PROPOSE = "propose"
+DECLARE = "declare"
+GAIN = "gain"
+LOSE = "lose"
+
+
+class ElectionCore:
+    """Deterministic election state machine (no clock, no IO).
+
+    The caller invokes `on_leadership(pki, is_declaration, now)` for
+    every received leadership message and `tick(now)` once per propose
+    interval; both return an ordered list of actions from
+    {PROPOSE, DECLARE, GAIN, LOSE} for the caller to execute.
+    """
+
+    def __init__(self, pki: bytes, leader_alive: float):
+        self.pki = pki
+        self.leader_alive = leader_alive
+        self.is_leader = False
+        self.leader_pki: Optional[bytes] = None
+        self._leader_seen = 0.0
+        self._proposals: dict[bytes, float] = {}
+
+    def on_leadership(self, pki: bytes, is_declaration: bool,
+                      now: float) -> list:
+        if pki == self.pki:
+            return []
+        actions: list = []
+        if is_declaration:
+            if self.leader_pki is None or pki <= self.leader_pki \
+                    or now - self._leader_seen > self.leader_alive:
+                self.leader_pki = pki
+                self._leader_seen = now
+            if self.is_leader and pki < self.pki:
+                self.is_leader = False
+                actions.append(LOSE)
+        else:
+            self._proposals[pki] = now
+        return actions
+
+    def tick(self, now: float) -> list:
+        leader_fresh = (self.leader_pki is not None and
+                        now - self._leader_seen <= self.leader_alive)
+        if leader_fresh and not self.is_leader:
+            return []           # someone else leads and is alive
+        self._proposals = {
+            p: t for p, t in self._proposals.items()
+            if now - t <= self.leader_alive}
+        contenders = set(self._proposals)
+        contenders.add(self.pki)
+        i_win = min(contenders) == self.pki
+        if self.is_leader:
+            if i_win:
+                self.leader_pki = self.pki
+                self._leader_seen = now
+                return [DECLARE]
+            self.is_leader = False
+            return [LOSE]
+        if i_win:
+            self.is_leader = True
+            self.leader_pki = self.pki
+            self._leader_seen = now
+            return [GAIN, DECLARE]
+        return [PROPOSE]
 
 
 class LeaderElectionService:
@@ -36,13 +109,9 @@ class LeaderElectionService:
         self._on_gain = on_gain
         self._on_lose = on_lose
         self._interval = propose_interval_s
-        self._leader_alive = leader_alive_s
 
         self._lock = threading.Lock()
-        self.is_leader = False
-        self._leader_pki: Optional[bytes] = None
-        self._leader_seen = 0.0
-        self._proposals: dict[bytes, float] = {}
+        self._core = ElectionCore(node.pki_id, leader_alive_s)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -56,12 +125,21 @@ class LeaderElectionService:
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=2)
-        self._relinquish()
+        with self._lock:
+            was_leader = self._core.is_leader
+            self._core.is_leader = False
+        if was_leader:
+            self._run_actions([LOSE])
+
+    @property
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._core.is_leader
 
     @property
     def leader(self) -> Optional[bytes]:
         with self._lock:
-            return self._leader_pki
+            return self._core.leader_pki
 
     # -- protocol --
 
@@ -75,12 +153,37 @@ class LeaderElectionService:
         self._node.gossip_channel(
             self._channel, gmsg.sign_message(msg, self._node.signer))
 
+    def _run_actions(self, actions: list) -> None:
+        for act in actions:
+            if act == PROPOSE:
+                self._send(is_declaration=False)
+            elif act == DECLARE:
+                self._send(is_declaration=True)
+            elif act == GAIN:
+                logger.info("[%s] %s became leader", self.channel_id,
+                            self._node.endpoint)
+                try:
+                    self._on_gain()
+                except Exception:
+                    logger.exception("on_gain callback failed")
+            elif act == LOSE:
+                logger.info("[%s] %s relinquished leadership",
+                            self.channel_id, self._node.endpoint)
+                try:
+                    self._on_lose()
+                except Exception:
+                    logger.exception("on_lose callback failed")
+
     def _handle(self, sender: str, msg: gpb.GossipMessage,
-                smsg: gpb.SignedGossipMessage) -> None:
+                smsg: gpb.SignedGossipMessage) -> bool:
+        """Returns True iff the message verified and was processed —
+        the node relays ONLY on True (see node._on_message: relaying
+        or dedup-recording unverified messages would let forgeries
+        suppress genuine declarations)."""
         lm = msg.leadership_msg
         pki = bytes(lm.pki_id)
         if pki == self._node.pki_id:
-            return
+            return False            # own echo: no relay needed
         info = self._node.discovery.lookup(pki)
         if info is not None and info.identity:
             if not self._node.mcs.verify_by_channel(
@@ -89,23 +192,15 @@ class LeaderElectionService:
                         info.identity, smsg.signature, smsg.payload):
                 logger.warning("leadership msg from %s failed "
                                "verification", sender)
-                return
-        now = time.monotonic()
-        yield_leadership = False
+                return False
         with self._lock:
-            if lm.is_declaration:
-                if self._leader_pki is None or pki <= self._leader_pki \
-                        or now - self._leader_seen > self._leader_alive:
-                    self._leader_pki = pki
-                    self._leader_seen = now
-                if self.is_leader and pki < self._node.pki_id:
-                    yield_leadership = True
-            else:
-                self._proposals[pki] = now
-        if yield_leadership:
+            actions = self._core.on_leadership(
+                pki, lm.is_declaration, time.monotonic())
+        if actions:
             logger.info("[%s] yielding leadership to %s",
                         self.channel_id, pki.hex()[:8])
-            self._relinquish()
+        self._run_actions(actions)
+        return True
 
     def _loop(self) -> None:
         # stagger the first proposal so peers see each other's
@@ -113,62 +208,8 @@ class LeaderElectionService:
         self._send(is_declaration=False)
         while not self._stop.wait(self._interval):
             try:
-                self._round()
+                with self._lock:
+                    actions = self._core.tick(time.monotonic())
+                self._run_actions(actions)
             except Exception:
                 logger.exception("election round failed")
-
-    def _round(self) -> None:
-        now = time.monotonic()
-        with self._lock:
-            leader_fresh = (self._leader_pki is not None and
-                            now - self._leader_seen <=
-                            self._leader_alive)
-            if leader_fresh and not self.is_leader:
-                return  # someone else leads and is alive
-            # drop stale proposals
-            self._proposals = {
-                p: t for p, t in self._proposals.items()
-                if now - t <= self._leader_alive}
-            contenders = set(self._proposals)
-            contenders.add(self._node.pki_id)
-            i_win = min(contenders) == self._node.pki_id
-        if self.is_leader:
-            if i_win:
-                self._send(is_declaration=True)
-                with self._lock:
-                    self._leader_pki = self._node.pki_id
-                    self._leader_seen = now
-            else:
-                self._relinquish()
-            return
-        if i_win:
-            self._claim()
-        else:
-            self._send(is_declaration=False)
-
-    def _claim(self) -> None:
-        with self._lock:
-            if self.is_leader:
-                return
-            self.is_leader = True
-            self._leader_pki = self._node.pki_id
-            self._leader_seen = time.monotonic()
-        logger.info("[%s] %s became leader", self.channel_id,
-                    self._node.endpoint)
-        self._send(is_declaration=True)
-        try:
-            self._on_gain()
-        except Exception:
-            logger.exception("on_gain callback failed")
-
-    def _relinquish(self) -> None:
-        with self._lock:
-            if not self.is_leader:
-                return
-            self.is_leader = False
-        logger.info("[%s] %s relinquished leadership", self.channel_id,
-                    self._node.endpoint)
-        try:
-            self._on_lose()
-        except Exception:
-            logger.exception("on_lose callback failed")

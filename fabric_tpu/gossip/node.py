@@ -187,6 +187,8 @@ class GossipNode:
             on_membership_change=self._membership_changed)
         self._channels: dict[str, ChannelGossip] = {}
         self._lock = threading.Lock()
+        # relay dedup for leadership msgs: (pki, inc, seq) -> None
+        self._leadership_seen: dict = {}
         self._on_membership_change: list[Callable] = []
         self._stop = threading.Event()
         self._pull_thread: Optional[threading.Thread] = None
@@ -247,18 +249,24 @@ class GossipNode:
     def gossip_channel(self, ch: ChannelGossip,
                        smsg: gpb.SignedGossipMessage,
                        exclude: set = frozenset()) -> None:
-        """Push to a fanout of the channel's members; falls back to all
-        alive peers while state-info hasn't propagated yet (channel
-        membership is itself learned by gossip)."""
+        """Push to a RANDOM fanout subset of the channel's members;
+        falls back to all alive peers while state-info hasn't
+        propagated yet (channel membership is itself learned by
+        gossip).
+
+        Random selection is load-bearing, not cosmetic (reference:
+        `gossip/gossip_impl.go` selects random peers per emit): a
+        deterministic first-k prefix starves the same peers on every
+        round, and a starved peer that elected itself leader would
+        never hear the real leader's declarations — a PERSISTENT
+        dual-deliverer state (the round-2 gossip e2e flake).
+        """
+        import random as _random
         members = ch.members() or self.discovery.alive_members()
-        sent = 0
-        for m in members:
-            if m.member.endpoint in exclude:
-                continue
+        eligible = [m for m in members if m.member.endpoint not in exclude]
+        k = min(self.cfg.fanout, len(eligible))
+        for m in _random.sample(eligible, k):
             self._send_raw(m.member.endpoint, smsg)
-            sent += 1
-            if sent >= self.cfg.fanout:
-                break
 
     def gossip_block(self, channel_id: str, seq: int,
                      block_bytes: bytes) -> None:
@@ -293,8 +301,31 @@ class GossipNode:
             self._handle_data(sender, ch, msg, smsg)
         elif which in ("hello", "data_dig", "data_req", "data_update"):
             ch.pull.handle(sender, msg)
-        elif which == "leadership_msg" and ch.on_leadership:
-            ch.on_leadership(sender, msg, smsg)
+        elif which == "leadership_msg":
+            # relay fresh leadership msgs (push epidemic, like
+            # data_msg): election correctness depends on declarations
+            # reaching EVERY member, not just the sender's fanout.
+            # ORDER MATTERS: the handler VERIFIES the signature first
+            # and only a verified message is dedup-recorded + relayed —
+            # recording first would let a forged message with a
+            # predicted (pki, inc, seq) poison the dedup cache and
+            # suppress the genuine declaration network-wide.
+            lm = msg.leadership_msg
+            key = (bytes(lm.pki_id), lm.timestamp.inc_num,
+                   lm.timestamp.seq_num)
+            with self._lock:
+                if key in self._leadership_seen:
+                    return
+            if ch.on_leadership is None:
+                return          # nobody to verify it -> do not relay
+            if not ch.on_leadership(sender, msg, smsg):
+                return          # failed verification -> drop silently
+            with self._lock:
+                self._leadership_seen[key] = None
+                while len(self._leadership_seen) > 4096:
+                    self._leadership_seen.pop(
+                        next(iter(self._leadership_seen)))
+            self.gossip_channel(ch, smsg, exclude={sender})
         elif which == "state_request" and ch.on_state_request:
             ch.on_state_request(sender, msg)
         elif which == "state_response" and ch.on_state_response:

@@ -1,0 +1,268 @@
+"""Deterministic leader-election tests (fabric_tpu/gossip/election.py
+ElectionCore) — the synchronous harness the round-2 verdict asked for:
+whole multi-peer elections driven with simulated time, message drops,
+partitions and adversarial orderings; no threads, no wall clock.
+
+Also pins the two node-level transport properties the e2e flake traced
+back to: random fanout selection and leadership-message relay
+(fabric_tpu/gossip/node.py gossip_channel/_on_message).
+"""
+
+import itertools
+import random
+import threading
+from types import SimpleNamespace
+
+from fabric_tpu.gossip.election import (
+    DECLARE,
+    GAIN,
+    LOSE,
+    PROPOSE,
+    ElectionCore,
+)
+
+ALIVE = 1.5
+TICK = 0.3
+
+
+class SimElection:
+    """N ElectionCores + a message fabric with drops/partitions.
+
+    Deterministic: all randomness from the seeded rng; peers tick in a
+    shuffled order each round; messages deliver next round unless
+    dropped or partitioned.
+    """
+
+    def __init__(self, n, seed=0, drop=0.0):
+        self.rng = random.Random(seed)
+        self.pkis = [bytes([i + 1]) * 4 for i in range(n)]
+        self.cores = {p: ElectionCore(p, ALIVE) for p in self.pkis}
+        self.alive = set(self.pkis)
+        self.now = 0.0
+        self.drop = drop
+        self.cut = set()            # frozenset({a, b}) partitions
+        self.inflight = []          # (dst, src, is_declaration)
+
+    def partition(self, a, b):
+        self.cut.add(frozenset((a, b)))
+
+    def heal(self):
+        self.cut.clear()
+
+    def _broadcast(self, src, is_declaration):
+        for dst in self.pkis:
+            if dst == src or dst not in self.alive:
+                continue
+            if frozenset((src, dst)) in self.cut:
+                continue
+            if self.rng.random() < self.drop:
+                continue
+            self.inflight.append((dst, src, is_declaration))
+
+    def step(self):
+        """One propose interval: deliver last round's messages in a
+        random order, then tick every alive peer in a random order."""
+        self.now += TICK
+        msgs, self.inflight = self.inflight, []
+        self.rng.shuffle(msgs)
+        for dst, src, decl in msgs:
+            if dst not in self.alive:
+                continue
+            for act in self.cores[dst].on_leadership(src, decl, self.now):
+                if act in (PROPOSE, DECLARE):
+                    self._broadcast(dst, act == DECLARE)
+        order = [p for p in self.pkis if p in self.alive]
+        self.rng.shuffle(order)
+        for p in order:
+            for act in self.cores[p].tick(self.now):
+                if act in (PROPOSE, DECLARE):
+                    self._broadcast(p, act == DECLARE)
+
+    def leaders(self):
+        return [p for p in self.pkis
+                if p in self.alive and self.cores[p].is_leader]
+
+    def settle(self, rounds=30):
+        for _ in range(rounds):
+            self.step()
+
+
+class TestConvergence:
+    def test_single_leader_from_cold_start_many_seeds(self):
+        for seed in range(20):
+            sim = SimElection(5, seed=seed)
+            sim.settle(20)
+            assert sim.leaders() == [sim.pkis[0]], f"seed {seed}"
+            # stability: 20 more rounds, leadership never flaps
+            for _ in range(20):
+                sim.step()
+                assert sim.leaders() == [sim.pkis[0]], f"seed {seed}"
+
+    def test_convergence_under_30pct_message_loss(self):
+        for seed in range(10):
+            sim = SimElection(4, seed=seed, drop=0.3)
+            sim.settle(60)
+            assert sim.leaders() == [sim.pkis[0]], f"seed {seed}"
+
+    def test_followers_quiet_while_leader_fresh(self):
+        sim = SimElection(3, seed=1)
+        sim.settle(20)
+        follower = sim.cores[sim.pkis[2]]
+        assert follower.tick(sim.now) == []   # fresh leader -> silence
+
+
+class TestFailover:
+    def test_leader_crash_triggers_reelection(self):
+        sim = SimElection(4, seed=7)
+        sim.settle(20)
+        sim.alive.discard(sim.pkis[0])        # leader dies silently
+        # next-smallest takes over after the alive window expires
+        sim.settle(int(ALIVE / TICK) + 10)
+        assert sim.leaders() == [sim.pkis[1]]
+
+    def test_smaller_pki_preempts_sitting_leader(self):
+        sim = SimElection(3, seed=3)
+        small = sim.pkis[0]
+        sim.alive.discard(small)              # start without the small
+        sim.settle(20)
+        assert sim.leaders() == [sim.pkis[1]]
+        sim.alive.add(small)                  # small pki joins late
+        sim.settle(20)
+        assert sim.leaders() == [small]
+
+    def test_partition_heal_collapses_dual_leaders(self):
+        """The round-2 flake scenario: two leaders form during a split;
+        after healing, declarations must collapse it to one within the
+        alive window."""
+        for seed in range(10):
+            sim = SimElection(4, seed=seed)
+            a, b, c, d = sim.pkis
+            for x, y in [(a, c), (a, d), (b, c), (b, d)]:
+                sim.partition(x, y)
+            sim.settle(20)
+            assert sorted(sim.leaders()) == sorted([a, c]), f"seed {seed}"
+            sim.heal()
+            sim.settle(int(ALIVE / TICK) + 10)
+            assert sim.leaders() == [a], f"seed {seed}"
+            # the ex-leader must have actually emitted LOSE exactly once
+            # (its deliverer stops): is_leader False suffices here since
+            # the service maps the transition 1:1
+
+
+class TestAdversarialOrderings:
+    def test_all_declaration_interleavings_two_peers(self):
+        """Exhaustive: two peers both claim during a race; every
+        delivery interleaving of their declarations converges."""
+        a, b = bytes([1]) * 4, bytes([2]) * 4
+        for order in itertools.permutations([(a, True), (b, True),
+                                             (a, False), (b, False)]):
+            ca, cb = ElectionCore(a, ALIVE), ElectionCore(b, ALIVE)
+            # both self-elected (split brain)
+            ca.tick(0.3)
+            cb.tick(0.3)
+            assert ca.is_leader and cb.is_leader
+            now = 0.6
+            for src, decl in order:
+                ca.on_leadership(src, decl, now) if src != a else None
+                cb.on_leadership(src, decl, now) if src != b else None
+            # one more round of declarations both ways
+            for acts, core, other in [(ca.tick(0.9), ca, cb),
+                                      (cb.tick(0.9), cb, ca)]:
+                if DECLARE in acts:
+                    other.on_leadership(core.pki, True, 0.9)
+            cb.tick(1.2)
+            assert ca.is_leader and not cb.is_leader, order
+
+
+class TestNodeTransport:
+    """The two transport properties the flake traced to."""
+
+    def _member(self, ep):
+        return SimpleNamespace(member=SimpleNamespace(endpoint=ep))
+
+    def test_gossip_channel_fanout_is_randomized(self):
+        from fabric_tpu.gossip.node import GossipNode
+        node = GossipNode.__new__(GossipNode)
+        node.cfg = SimpleNamespace(fanout=1)
+        sent = []
+        node._send_raw = lambda ep, smsg: sent.append(ep)
+        ch = SimpleNamespace(
+            members=lambda: [self._member(f"e{i}") for i in range(3)])
+        for _ in range(200):
+            node.gossip_channel(ch, object())
+        # a deterministic first-k prefix would starve e1/e2 forever
+        assert set(sent) == {"e0", "e1", "e2"}
+
+    def test_leadership_messages_are_relayed_once(self):
+        from fabric_tpu.gossip import message as gmsg
+        from fabric_tpu.gossip.node import GossipNode
+        from fabric_tpu.protos import gossip as gpb
+
+        node = GossipNode.__new__(GossipNode)
+        node.cfg = SimpleNamespace(fanout=8)
+        node._lock = threading.Lock()
+        node._leadership_seen = {}
+        node.discovery = SimpleNamespace(
+            handle_message=lambda *a: False)
+        handled = []
+        forwarded = []
+        # handler returns True = verified (the service's _handle
+        # contract); relay only happens on True
+        ch = SimpleNamespace(
+            members=lambda: [self._member("peerB"),
+                             self._member("peerC")],
+            on_leadership=lambda s, m, sm: (handled.append(s), True)[1])
+        node.channel = lambda cid: ch
+        node._send_raw = lambda ep, smsg: forwarded.append(ep)
+
+        msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_AND_ORG,
+                                channel=b"ch1")
+        msg.leadership_msg.pki_id = b"\x09" * 4
+        msg.leadership_msg.is_declaration = True
+        msg.leadership_msg.timestamp.inc_num = 1
+        msg.leadership_msg.timestamp.seq_num = 42
+        smsg = gmsg.unsigned(msg)
+        node._on_message("peerA", smsg)
+        assert handled == ["peerA"]
+        assert sorted(forwarded) == ["peerB", "peerC"]   # relayed
+        # duplicate copy: neither re-handled nor re-relayed
+        node._on_message("peerB", smsg)
+        assert handled == ["peerA"]
+        assert sorted(forwarded) == ["peerB", "peerC"]
+
+    def test_unverified_leadership_not_relayed_nor_dedup_poisoned(self):
+        """A forged message must not be relayed NOR consume the dedup
+        key — otherwise the genuine declaration with the same
+        (pki, inc, seq) would be suppressed network-wide."""
+        from fabric_tpu.gossip import message as gmsg
+        from fabric_tpu.gossip.node import GossipNode
+        from fabric_tpu.protos import gossip as gpb
+
+        node = GossipNode.__new__(GossipNode)
+        node.cfg = SimpleNamespace(fanout=8)
+        node._lock = threading.Lock()
+        node._leadership_seen = {}
+        node.discovery = SimpleNamespace(
+            handle_message=lambda *a: False)
+        verdicts = iter([False, True])   # forgery fails, genuine passes
+        handled = []
+        forwarded = []
+        ch = SimpleNamespace(
+            members=lambda: [self._member("peerB")],
+            on_leadership=lambda s, m, sm:
+                (handled.append(s), next(verdicts))[1])
+        node.channel = lambda cid: ch
+        node._send_raw = lambda ep, smsg: forwarded.append(ep)
+
+        msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_AND_ORG,
+                                channel=b"ch1")
+        msg.leadership_msg.pki_id = b"\x09" * 4
+        msg.leadership_msg.is_declaration = True
+        msg.leadership_msg.timestamp.inc_num = 1
+        msg.leadership_msg.timestamp.seq_num = 7
+        smsg = gmsg.unsigned(msg)
+        node._on_message("attacker", smsg)       # forged: verify fails
+        assert forwarded == [] and not node._leadership_seen
+        node._on_message("leader", smsg)         # genuine same key
+        assert forwarded == ["peerB"]            # NOT suppressed
+        assert handled == ["attacker", "leader"]
