@@ -64,6 +64,16 @@ class ExternalChaincodeClient:
     def _ensure_stream(self) -> None:
         if self._channel is not None:
             return
+        try:
+            self._connect()
+        except Exception:
+            # a half-open stream must not look connected: the next
+            # caller (e.g. the external-builder launch retry loop)
+            # would skip the handshake and block on a dead dialog
+            self._reset()
+            raise
+
+    def _connect(self) -> None:
         self._channel = grpc.insecure_channel(self._address)
         call = self._channel.stream_stream(
             f"/{CHAINCODE_SERVICE}/Connect",
@@ -132,6 +142,13 @@ class ExternalChaincodeClient:
     def close(self) -> None:
         with self._lock:
             self._reset()
+
+    def ping(self) -> None:
+        """Readiness probe: establish the stream + REGISTER handshake
+        (used by the external-builder launch path to wait for a
+        freshly spawned chaincode process)."""
+        with self._lock:
+            self._ensure_stream()
 
     # -- Chaincode duck-type --
 

@@ -2,6 +2,7 @@ SYSTEM_CHAINCODES = frozenset({"_lifecycle", "cscc", "qscc", "lscc"})
 
 from fabric_tpu.core.scc.cscc import CSCC  # noqa: F401,E402
 from fabric_tpu.core.scc.lifecycle import LifecycleSCC  # noqa: F401
+from fabric_tpu.core.scc.lscc import LSCC  # noqa: F401,E402
 from fabric_tpu.core.scc.qscc import QSCC  # noqa: F401
 
 
@@ -12,3 +13,4 @@ def register_system_chaincodes(peer) -> None:
     peer.chaincode_support.register("_lifecycle", LifecycleSCC(peer))
     peer.chaincode_support.register("cscc", CSCC(peer))
     peer.chaincode_support.register("qscc", QSCC(peer))
+    peer.chaincode_support.register("lscc", LSCC(peer))

@@ -1,0 +1,107 @@
+"""lscc — legacy lifecycle system chaincode (query subset).
+
+Rebuild of `core/scc/lscc/lscc.go`'s SDK-facing query surface:
+`getchaincodes`, `getccdata`, `getid`, `getcollectionsconfig` — the
+calls older SDKs and `peer chaincode list` still issue against 2.x
+peers. This framework has no legacy deploy path (the v2 `_lifecycle`
+SCC is the only governance flow, `core/scc/lifecycle.py`), so:
+
+  * queries are served FROM the committed `_lifecycle` definitions —
+    a documented divergence: the reference answers these from the
+    lscc namespace written by legacy `deploy`, which cannot exist
+    here; serving the new-lifecycle view keeps `getchaincodes`
+    truthful for SDKs that only use it for discovery;
+  * mutating legacy operations (`install`, `deploy`, `upgrade`) are
+    rejected with an explicit deprecation error, exactly like the
+    kafka consenter (orderer rejects with a clear message rather than
+    silently missing).
+"""
+
+from __future__ import annotations
+
+import json
+
+from fabric_tpu.core.chaincode import Chaincode, shim
+from fabric_tpu.core.scc import lifecycle as lc
+from fabric_tpu.protos import proposal as ppb
+
+_DEPRECATED = frozenset({"install", "deploy", "upgrade"})
+_DEF_PREFIX = lc._DEF_PREFIX
+
+
+class LSCC(Chaincode):
+    def __init__(self, peer):
+        self._peer = peer
+
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        fn_l = fn.lower()
+        try:
+            if fn_l in _DEPRECATED:
+                return shim.error(
+                    f"lscc {fn!r} is deprecated: the legacy chaincode "
+                    "lifecycle is not supported by this peer; use the "
+                    "_lifecycle system chaincode (peer lifecycle "
+                    "chaincode approveformyorg/commit)")
+            if fn_l in ("getchaincodes", "getinstalledchaincodes"):
+                return self._get_chaincodes(stub)
+            if fn_l in ("getccdata", "getdepspec", "getid"):
+                return self._get_ccdata(stub, params)
+            if fn_l == "getcollectionsconfig":
+                return self._get_collections(stub, params)
+        except Exception as e:
+            return shim.error(f"lscc operation failed: {e}")
+        return shim.error(f"unknown lscc function {fn!r}")
+
+    # -- queries (served from committed _lifecycle definitions;
+    # read-only committed state, like qscc — lscc runs in its own
+    # namespace and cannot range another one through the simulator) --
+
+    def _ledger(self, stub):
+        channel = self._peer.channel(stub.get_channel_id())
+        if channel is None:
+            raise ValueError(
+                f"unknown channel {stub.get_channel_id()!r}")
+        return channel.ledger
+
+    def _definitions(self, stub):
+        ledger = self._ledger(stub)
+        for _key, vv in ledger.state_db.get_state_range(
+                lc.NAMESPACE, _DEF_PREFIX, _DEF_PREFIX + "\x7f"):
+            yield json.loads(vv.value)
+
+    def _get_chaincodes(self, stub):
+        resp = ppb.ChaincodeQueryResponse()
+        for d in self._definitions(stub):
+            resp.chaincodes.add(
+                name=d["name"], version=d.get("version", "1.0"),
+                escc=d.get("endorsement_plugin", "escc"),
+                vscc=d.get("validation_plugin", "vscc"))
+        return shim.success(resp.SerializeToString())
+
+    def _get_definition(self, stub, params):
+        # reference signature: getccdata(channel, name)
+        name = params[-1] if params else ""
+        if not name:
+            raise ValueError("chaincode name required")
+        raw = self._ledger(stub).get_state(lc.NAMESPACE,
+                                           _DEF_PREFIX + name)
+        if raw is None:
+            raise ValueError(f"chaincode {name!r} not found")
+        return json.loads(raw)
+
+    def _get_ccdata(self, stub, params):
+        d = self._get_definition(stub, params)
+        info = ppb.ChaincodeInfo(
+            name=d["name"], version=d.get("version", "1.0"),
+            escc=d.get("endorsement_plugin", "escc"),
+            vscc=d.get("validation_plugin", "vscc"))
+        return shim.success(info.SerializeToString())
+
+    def _get_collections(self, stub, params):
+        d = self._get_definition(stub, params)
+        return shim.success(json.dumps(
+            {"collections": d.get("collections", [])}).encode())

@@ -1,0 +1,199 @@
+"""External-builder contract tests (core/chaincode/externalbuilder.py).
+
+A fixture builder directory with real bin/{detect,build,release,run}
+executables drives the reference's 4-phase pipeline
+(`core/container/externalbuilder/externalbuilder.go`): detection by
+metadata, build into BUILD_DIR, release of server-mode connection
+info, and run-mode process launch with peer-assigned listen address.
+"""
+
+import json
+import os
+import stat
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from fabric_tpu.core.chaincode import shim
+from fabric_tpu.core.chaincode.external import ChaincodeServer
+from fabric_tpu.core.chaincode.externalbuilder import (
+    BuilderConfig,
+    BuildError,
+    ExternalBuilderRegistry,
+    registry_from_config,
+    write_package,
+)
+from fabric_tpu.core.chaincode.support import ChaincodeSupport
+from fabric_tpu.protos import proposal as ppb
+
+
+class EchoCC(shim.Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        return shim.success(f"echo:{fn}".encode())
+
+
+def _script(path, body):
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n" + textwrap.dedent(body))
+    os.chmod(path, os.stat(path).st_mode | stat.S_IEXEC)
+
+
+def _mk_builder(root, name, release_body="", run_body=None,
+                claim_type="testcc"):
+    bdir = root / name / "bin"
+    bdir.mkdir(parents=True)
+    _script(bdir / "detect", f"""
+        grep -q '"type": *"{claim_type}"' "$2/metadata.json"
+        """)
+    _script(bdir / "build", """
+        cp -r "$1/." "$3/"
+        """)
+    if release_body:
+        _script(bdir / "release", release_body)
+    if run_body:
+        _script(bdir / "run", run_body)
+    return BuilderConfig(name=name, path=str(root / name),
+                         propagate_environment=("PYTHONPATH",))
+
+
+def _package(tmp_path, cc_type="testcc"):
+    return write_package(
+        str(tmp_path / "cc.tgz"),
+        {"type": cc_type, "label": "extcc_1.0"},
+        {"main.txt": b"chaincode source"})
+
+
+def _invoke(support, name, fn=b"hello"):
+    spec = ppb.ChaincodeInvocationSpec()
+    spec.chaincode_spec.chaincode_id.name = name
+    spec.chaincode_spec.input.args.extend([fn])
+    resp, _ev, _id = support.execute("ch", "tx1", spec, None)
+    return resp
+
+
+class TestDetect:
+    def test_first_claiming_builder_wins_and_none_is_error(self, tmp_path):
+        b1 = _mk_builder(tmp_path, "wrong", claim_type="other")
+        b2 = _mk_builder(tmp_path, "right", claim_type="testcc")
+        reg = ExternalBuilderRegistry([b1, b2], str(tmp_path / "work"))
+        pkg = _package(tmp_path)
+        support = ChaincodeSupport()
+        # 'right' claims; but with no release/run it must fail loudly
+        with pytest.raises(BuildError, match="no connection.json"):
+            reg.launch("extcc", pkg, support)
+
+        reg_none = ExternalBuilderRegistry(
+            [_mk_builder(tmp_path, "never", claim_type="zzz")],
+            str(tmp_path / "work2"))
+        with pytest.raises(BuildError, match="no configured external"):
+            reg_none.launch("extcc", pkg, support)
+
+    def test_unsafe_package_paths_rejected(self, tmp_path):
+        import io
+        import tarfile
+        pkg = str(tmp_path / "evil.tgz")
+        with tarfile.open(pkg, "w:gz") as tar:
+            data = b"{}"
+            info = tarfile.TarInfo("../../escape")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        reg = ExternalBuilderRegistry(
+            [_mk_builder(tmp_path, "b")], str(tmp_path / "w"))
+        with pytest.raises(BuildError, match="unsafe path"):
+            reg.launch("x", pkg, ChaincodeSupport())
+
+
+class TestServerMode:
+    def test_release_connection_json_connects_ccaas(self, tmp_path):
+        server = ChaincodeServer("extcc", EchoCC())
+        server.start()
+        try:
+            release = f"""
+                mkdir -p "$2/chaincode/server"
+                echo '{{"address": "{server.address}"}}' \\
+                    > "$2/chaincode/server/connection.json"
+                """
+            b = _mk_builder(tmp_path, "ccaas", release_body=release)
+            reg = ExternalBuilderRegistry([b], str(tmp_path / "work"))
+            support = ChaincodeSupport()
+            launched = reg.launch("extcc", _package(tmp_path), support)
+            try:
+                assert launched.process is None
+                resp = _invoke(support, "extcc")
+                assert resp.status == shim.OK
+                assert resp.payload == b"echo:hello"
+            finally:
+                launched.stop()
+        finally:
+            server.stop()
+
+
+RUNNER = """
+import json, sys, time
+sys.path.insert(0, {repo!r})
+from fabric_tpu.core.chaincode import shim
+from fabric_tpu.core.chaincode.external import ChaincodeServer
+
+class CC(shim.Chaincode):
+    def init(self, stub):
+        return shim.success()
+    def invoke(self, stub):
+        fn, _ = stub.get_function_and_parameters()
+        return shim.success(("run:" + fn).encode())
+
+meta = json.load(open(sys.argv[2] + "/chaincode.json"))
+srv = ChaincodeServer(meta["name"], CC(), address=meta["address"])
+srv.start()
+while True:
+    time.sleep(3600)
+"""
+
+
+class TestRunMode:
+    def test_bin_run_spawns_and_peer_connects(self, tmp_path):
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        runner = tmp_path / "runner.py"
+        runner.write_text(RUNNER.format(repo=repo))
+        run_body = f"""
+            exec {sys.executable} {runner} "$1" "$2"
+            """
+        b = _mk_builder(tmp_path, "runner", run_body=run_body)
+        reg = ExternalBuilderRegistry([b], str(tmp_path / "work"))
+        support = ChaincodeSupport()
+        os.environ.setdefault("PYTHONPATH", repo)
+        launched = reg.launch("runcc", _package(tmp_path), support,
+                              connect_timeout_s=30)
+        try:
+            assert launched.process is not None
+            assert launched.process.poll() is None
+            resp = _invoke(support, "runcc", b"go")
+            assert resp.status == shim.OK
+            assert resp.payload == b"run:go"
+        finally:
+            launched.stop()
+        assert launched.process.poll() is not None   # stopped
+
+    def test_run_exit_before_serving_reports_rc(self, tmp_path):
+        b = _mk_builder(tmp_path, "dies", run_body="exit 3\n")
+        reg = ExternalBuilderRegistry([b], str(tmp_path / "work"))
+        with pytest.raises(BuildError, match="exited rc 3"):
+            reg.launch("dcc", _package(tmp_path), ChaincodeSupport(),
+                       connect_timeout_s=10)
+
+
+class TestConfig:
+    def test_registry_from_core_yaml_shape(self, tmp_path):
+        reg = registry_from_config(
+            {"externalBuilders": [
+                {"Name": "b1", "Path": "/opt/b1",
+                 "PropagateEnvironment": ["HOME"]},
+                {"name": "b2", "path": "/opt/b2"},
+            ]}, str(tmp_path / "w"))
+        assert [b.name for b in reg._builders] == ["b1", "b2"]
+        assert reg._builders[0].propagate_environment == ("HOME",)
