@@ -47,6 +47,15 @@ class ECDSAPublicKey(api.Key):
         self.x, self.y = nums.x, nums.y
         self._xy_cache = None
 
+    def is_p256(self) -> bool:
+        """The TPU comb/ladder kernels are P-256; other curves verify
+        on the sw path (reference: sw dispatches per key type)."""
+        return isinstance(self._pub.curve, ec.SECP256R1)
+
+    @property
+    def order(self) -> int:
+        return utils.curve_order(self._pub.curve)
+
     def x_bytes(self):
         """Cached 32-byte big-endian coordinates (batch-assembly hot
         path: the same org keys recur thousands of times per block)."""
@@ -138,7 +147,8 @@ _HASHERS = {
 
 
 def check_signature(key, signature: bytes) -> Optional[tuple[int, int]]:
-    """Shared pre-validation: strict DER + positivity + low-S.
+    """Shared pre-validation: strict DER + positivity + low-S against
+    the KEY's curve order (reference: GetCurveHalfOrdersAt).
 
     Returns (r, s) if the signature passes the format gates, else None.
     Both providers call this, so their accept/reject sets can only differ
@@ -148,7 +158,11 @@ def check_signature(key, signature: bytes) -> Optional[tuple[int, int]]:
         r, s = utils.unmarshal_signature(signature)
     except utils.SignatureFormatError:
         return None
-    if not utils.is_low_s(s):
+    try:
+        n = key.order if hasattr(key, "order") else utils.P256_N
+    except ValueError:
+        return None                 # curve without a tracked half-order
+    if not utils.is_low_s(s, n):
         return None
     return (r, s)
 
@@ -237,9 +251,21 @@ class SWProvider(api.BCCSP):
         `bccsp/sw/ecdsa.go:27-39` signECDSA → ToLowS → marshal)."""
         if not isinstance(key, ECDSAPrivateKey):
             raise TypeError("sign requires an ECDSA private key")
-        der = key.raw.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+        alg = self._PREHASH_BY_LEN.get(len(digest))
+        if alg is None:
+            raise ValueError(f"unsupported digest length {len(digest)}")
+        der = key.raw.sign(digest, ec.ECDSA(Prehashed(alg)))
         r, s = decode_dss_signature(der)
-        return utils.marshal_signature(r, utils.to_low_s(s))
+        n = utils.curve_order(key.raw.curve)
+        return utils.marshal_signature(r, utils.to_low_s(s, n))
+
+    # Prehashed() in `cryptography` requires digest length == the named
+    # algorithm's size; Go's ecdsa.Verify takes any hash bytes. Support
+    # the standard sizes (a SHA2-256 provider hashes messages to 32
+    # bytes; P-384/521 identities may present longer precomputed
+    # digests) and reject others rather than crash mid-batch.
+    _PREHASH_BY_LEN = {32: hashes.SHA256(), 48: hashes.SHA384(),
+                       64: hashes.SHA512()}
 
     def verify(self, key: api.Key, signature: bytes, digest: bytes,
                opts=None) -> bool:
@@ -249,14 +275,17 @@ class SWProvider(api.BCCSP):
         rs = check_signature(pub, signature)
         if rs is None:
             return False
+        alg = self._PREHASH_BY_LEN.get(len(digest))
+        if alg is None:
+            return False
         try:
             pub.raw.verify(
                 encode_dss_signature(*rs),
                 digest,
-                ec.ECDSA(Prehashed(hashes.SHA256())),
+                ec.ECDSA(Prehashed(alg)),
             )
             return True
-        except InvalidSignature:
+        except (InvalidSignature, ValueError):
             return False
 
     def verify_batch(self, items: Sequence[api.VerifyItem]) -> list[bool]:

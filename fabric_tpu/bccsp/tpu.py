@@ -68,7 +68,8 @@ class TPUProvider(api.BCCSP):
         self.stats = {"comb_batches": 0, "ladder_batches": 0,
                       "host_hash_fallbacks": 0, "sw_fallbacks": 0,
                       "q16_builds": 0, "q16_evictions": 0,
-                      "q16_oversize_skips": 0, "q16_cache_bytes": 0}
+                      "q16_oversize_skips": 0, "q16_cache_bytes": 0,
+                      "nonp256_sw_lanes": 0}
 
     @staticmethod
     def _on_tpu() -> bool:
@@ -139,6 +140,7 @@ class TPUProvider(api.BCCSP):
         try:
             return self._verify_batch_device(items)
         except Exception:
+            self.stats["sw_fallbacks"] += 1
             logger.exception(
                 "TPU batch verify failed; falling back to sw for %d items",
                 len(items))
@@ -177,11 +179,20 @@ class TPUProvider(api.BCCSP):
                  else b"" for it in items])
 
         max_len = 0
+        sw_lanes: list[int] = []    # non-P-256 ECDSA keys: per-lane sw
         for i, it in enumerate(items):
             pub = it.key.public_key()
             if not isinstance(pub, swmod.ECDSAPublicKey):
                 msgs.append(b"")
                 continue            # premask stays False -> reject
+            if not pub.is_p256() or (it.digest is not None
+                                     and len(it.digest) != 32):
+                # the device kernels are P-256 over 32-byte digests;
+                # other curves / digest sizes verify on the sw path
+                # WITHOUT degrading the rest of the batch
+                sw_lanes.append(i)
+                msgs.append(b"")
+                continue
             if native_out is not None:
                 ok_i, r_all, rpn_all, w_all = native_out
                 if not ok_i[i]:
@@ -260,7 +271,13 @@ class TPUProvider(api.BCCSP):
                          (blocks, nblocks, qx_l, qy_l, r_l, rpn_l, w_l,
                           premask, digests, has_digest))
             out = np.asarray(self._pipeline()(*args))
-        return out[:n].tolist()
+        result = out[:n].tolist()
+        if sw_lanes:
+            self.stats["nonp256_sw_lanes"] += len(sw_lanes)
+            sub = self._sw.verify_batch([items[i] for i in sw_lanes])
+            for i, v in zip(sw_lanes, sub):
+                result[i] = v
+        return result
 
     @staticmethod
     def _canonical_key_order(key_map: dict, key_idx: np.ndarray):

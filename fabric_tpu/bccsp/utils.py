@@ -13,10 +13,31 @@ parity between them is structural, not incidental.
 
 from __future__ import annotations
 
-# NIST P-256 group order and half-order (reference precomputes these per
-# curve — `bccsp/utils/ecdsa.go:26-31`)
+# NIST group orders and half-orders (reference precomputes these per
+# curve — `bccsp/utils/ecdsa.go:26-39` GetCurveHalfOrdersAt)
 P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
 P256_HALF_N = P256_N >> 1
+
+CURVE_ORDERS = {
+    "secp224r1": 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFF16A2E0B8F03E13DD29455C5C2A3D,
+    "secp256r1": P256_N,
+    "secp384r1": int(
+        "FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFC7634D81F4372DDF"
+        "581A0DB248B0A77AECEC196ACCC52973", 16),
+    "secp521r1": int(
+        "01FFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFF"
+        "FFFA51868783BF2F966B7FCC0148F709A5D03BB5C9B8899C47AEBB6FB71E9138"
+        "6409", 16),
+}
+
+
+def curve_order(curve) -> int:
+    """Group order for a `cryptography` curve object; raises for curves
+    the reference does not track half-orders for."""
+    try:
+        return CURVE_ORDERS[curve.name.lower()]
+    except KeyError:
+        raise ValueError(f"unsupported curve {curve.name!r}") from None
 
 
 class SignatureFormatError(ValueError):
