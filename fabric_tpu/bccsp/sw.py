@@ -296,6 +296,24 @@ class SWProvider(api.BCCSP):
             out.append(self.verify(it.key, it.signature, digest))
         return out
 
+    # -- pairings (host oracle; the TPU provider batches these on
+    #    device — reference consumer: idemix credential verification) --
+
+    def pairing_check_batch(self, products) -> list[bool]:
+        from fabric_tpu.ops import bn254_ref as bref
+        out = []
+        for lanes in products:
+            acc = bref.F12_ONE
+            for p, q in lanes:
+                acc = bref.f12_mul(acc, bref.miller_loop(q, p))
+            out.append(bref.final_exponentiation(acc) == bref.F12_ONE)
+        return out
+
+    def bls_verify_batch(self, pk_tw, msgs, sig_points) -> list[bool]:
+        from fabric_tpu.ops import bn254_ref as bref
+        return [s is not None and bref.bls_verify(pk_tw, m, s)
+                for m, s in zip(msgs, sig_points)]
+
     # -- AES-CBC-PKCS7 (reference: `bccsp/sw/aes.go`) --
 
     def encrypt(self, key: api.Key, plaintext: bytes, opts=None) -> bytes:

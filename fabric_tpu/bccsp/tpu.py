@@ -23,6 +23,7 @@ outage must degrade, not halt (SURVEY §7 step 3).
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -64,6 +65,8 @@ class TPUProvider(api.BCCSP):
         self._fn = None             # lazily-built generic jitted pipeline
         self._comb_fns = {}         # (K, q16) -> jitted comb pipeline
         self._qtab_fns = {}         # K -> jitted table builder
+        self._jit_lock = threading.Lock()   # prewarm thread vs first
+        #                                     block: build each jit once
         # observability: perf-cliff counters surfaced via provider stats
         self.stats = {"comb_batches": 0, "ladder_batches": 0,
                       "host_hash_fallbacks": 0, "sw_fallbacks": 0,
@@ -382,25 +385,31 @@ class TPUProvider(api.BCCSP):
         return np.concatenate([np.asarray(o) for o in outs])
 
     def _qtab_fn(self, K: int):
-        if K not in self._qtab_fns:
-            import jax
+        with self._jit_lock:
+            if K not in self._qtab_fns:
+                import jax
 
-            from fabric_tpu.ops import comb
-            self._qtab_fns[K] = jax.jit(comb.build_q_tables)
-        return self._qtab_fns[K]
+                from fabric_tpu.ops import comb
+                self._qtab_fns[K] = jax.jit(comb.build_q_tables)
+            return self._qtab_fns[K]
 
     def _q16_fn(self, K: int):
         key = ("q16", K)
-        if key not in self._qtab_fns:
-            import jax
+        with self._jit_lock:
+            if key not in self._qtab_fns:
+                import jax
 
-            from fabric_tpu.ops import comb
-            self._qtab_fns[key] = jax.jit(
-                comb.build_q16_tables, static_argnums=1)
-        return self._qtab_fns[key]
+                from fabric_tpu.ops import comb
+                self._qtab_fns[key] = jax.jit(
+                    comb.build_q16_tables, static_argnums=1)
+            return self._qtab_fns[key]
 
     def _comb_pipeline(self, K: int, q16: bool = False):
         key = (K, q16)
+        with self._jit_lock:
+            return self._comb_pipeline_locked(key, K, q16)
+
+    def _comb_pipeline_locked(self, key, K: int, q16: bool):
         if key not in self._comb_fns:
             import jax
 
@@ -451,6 +460,128 @@ class TPUProvider(api.BCCSP):
             else:
                 self._fn = jax.jit(fused)
         return self._fn
+
+    def prewarm(self, buckets=(4096, 32768), key_counts=(4,),
+                msg_nbs=(1, 8)) -> None:
+        """AOT-compile the standard validation shapes (and build the
+        16-bit G table) BEFORE the node joins channels, so a cold peer
+        does not stall its first blocks on device compilation
+        (round-2 verdict: cold compile was minutes; with the
+        persistent cache this makes restart-to-first-validated-block
+        fast). Safe to call on any backend; failures only log."""
+        import jax  # noqa: F401  (jax.ShapeDtypeStruct below)
+
+        from fabric_tpu.ops import comb
+        try:
+            q16 = self._g16_enabled()
+            if q16:
+                comb.g16_tables()
+            for K in key_counts:
+                ent = (comb.NWIN_G16 * comb.NENT_G16 if q16
+                       else comb.NWIN * comb.NENT)
+                # nb values must match production shapes exactly:
+                # _nb_bucket only produces powers of two (1 = digest
+                # lanes / tiny msgs; 8 covers the typical proposal
+                # payload sizes) — a mismatched nb would precompile a
+                # module no real batch ever uses
+                for bucket in buckets:
+                    chunk = min(bucket, self._chunk)
+                    fn = self._comb_pipeline(K, q16)
+                    sd = jax.ShapeDtypeStruct
+                    import numpy as _np
+                    for nb in msg_nbs:
+                        args = (
+                            sd((chunk, nb, 16), _np.uint32),  # blocks
+                            sd((chunk,), _np.int32),          # nblocks
+                            sd((chunk,), _np.int32),          # key_idx
+                            sd((ent * K, 3, 20), _np.int32),  # q_flat
+                            (sd((comb.NWIN_G16 * comb.NENT_G16, 3, 20),
+                                _np.int32) if q16 else
+                             sd((0, 3, 20), _np.int32)),      # g16
+                            sd((chunk, 20), _np.int32),       # r
+                            sd((chunk, 20), _np.int32),       # rpn
+                            sd((chunk, 20), _np.int32),       # w
+                            sd((chunk,), bool),               # premask
+                            sd((chunk, 8), _np.uint32),       # digests
+                            sd((chunk,), bool),               # has_digest
+                        )
+                        fn.lower(*args).compile()
+                        logger.info("prewarmed comb pipeline K=%d "
+                                    "chunk=%d nb=%d q16=%s", K, chunk,
+                                    nb, q16)
+        except Exception:
+            logger.exception("prewarm failed (continuing; first block "
+                             "will pay the compile)")
+
+    # -- pairings (idemix stretch: BASELINE config 4) --
+
+    def pairing_check_batch(self, products) -> list[bool]:
+        """prod_j e(P_j, Q_j) == 1 per lane, on device.
+
+        products: [[(P_int_affine, Q_twist_int_affine), ...] per lane]
+        with a uniform term count. Small batches and device failures
+        fall back to the exact host pairing (fabric_tpu/ops/bn254_ref)
+        — same degrade-don't-halt contract as verify_batch. Reference
+        consumer: `msp/idemix.go` credential verification (vendored
+        IBM/idemix pairing checks).
+        """
+        from fabric_tpu.ops import bn254_ref as bref
+        if len(products) < max(2, self._min_batch // 4):
+            return self._pairing_host(products)
+        try:
+            import jax
+
+            from fabric_tpu.ops import bn254 as bdev
+            nterms = len(products[0])
+            n = len(products)
+            bucket = 1
+            while bucket < n:
+                bucket *= 2
+            # pad with a trivially-true product: e(inf...) is not
+            # representable affine, so pad with a VALID identity
+            # product e(P, Q) * e(P, -Q) using lane 0's first term
+            p0, q0 = products[0][0]
+            pad_lane = [(p0, q0), (p0, bref.g2_neg_tw(q0))]
+            if nterms != 2:
+                pad_lane = [(p0, q0)] * nterms  # caller beware; rare
+            padded = list(products) + [pad_lane] * (bucket - n)
+            if nterms != 2 and bucket != n:
+                return self._pairing_host(products)
+            staged = bdev.stage_pairing_products(padded)
+            key = ("pairing", nterms, bucket)
+            if key not in self._qtab_fns:
+                self._qtab_fns[key] = jax.jit(
+                    lambda xPs, yPs, Qs, Q1s, nQ2s:
+                    bdev.pairing_product_is_one(xPs, yPs, Qs, Q1s,
+                                                nQ2s))
+            out = np.asarray(self._qtab_fns[key](*staged))
+            return out[:n].tolist()
+        except Exception:
+            self.stats["sw_fallbacks"] += 1
+            logger.exception("device pairing check failed; host fallback"
+                             " for %d products", len(products))
+            return self._pairing_host(products)
+
+    def _pairing_host(self, products) -> list[bool]:
+        # pkcs11-style containment: the exact host pairing lives on the
+        # embedded sw provider; one implementation, not three
+        return self._sw.pairing_check_batch(products)
+
+    def bls_verify_batch(self, pk_tw, msgs, sig_points) -> list[bool]:
+        """Issuer-credential BLS verify: e(sig, G2)·e(H(m), -pk) == 1
+        per lane. `sig_points` entries may be None (malformed) — those
+        lanes are False without touching the device."""
+        from fabric_tpu.ops import bn254 as bdev
+        idx = [i for i, s in enumerate(sig_points) if s is not None]
+        out = [False] * len(msgs)
+        if idx:
+            prods = bdev.bls_products(
+                pk_tw, [msgs[i] for i in idx],
+                [sig_points[i] for i in idx])
+            res = self.pairing_check_batch(prods)
+            for i, v in zip(idx, res):
+                out[i] = v
+        return out
 
     def _bucket(self, n: int) -> int:
         b = self._min_batch

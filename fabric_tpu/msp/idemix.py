@@ -49,17 +49,36 @@ def _credential_digest(nym_pub: bytes, ou: str, role: int) -> bytes:
 
 class IdemixIssuer:
     """Org-side credential issuer (the reference's idemixgen +
-    issuer role)."""
+    issuer role).
 
-    def __init__(self, csp, signing_key=None):
+    Two signature schemes over the SAME credential digest:
+      * "ecdsa" (default): issuer binding by P-256 — rides the batched
+        TPU ECDSA verify path with zero extra kernels;
+      * "bls": issuer binding by a BLS signature over BN254 — verified
+        with PAIRINGS, device-batched (`bls_verify_batch`), the
+        measurable analog of the reference's pairing-based credential
+        check (`msp/idemix.go` → vendored IBM/idemix; BASELINE
+        config 4).
+    """
+
+    def __init__(self, csp, signing_key=None, scheme: str = "ecdsa"):
         self._csp = csp
+        self.scheme = scheme
         self._key = signing_key or ec.generate_private_key(
             ec.SECP256R1())
+        if scheme == "bls":
+            from fabric_tpu.ops import bn254_ref as bref
+            import os as _os
+            self._bls_sk, self._bls_pk = bref.bls_keygen(_os.urandom(32))
 
     def public_key_pem(self) -> bytes:
         return self._key.public_key().public_bytes(
             serialization.Encoding.PEM,
             serialization.PublicFormat.SubjectPublicKeyInfo)
+
+    def bls_public_key_bytes(self) -> bytes:
+        from fabric_tpu.ops import bn254_ref as bref
+        return bref.g2_to_bytes(self._bls_pk)
 
     def issue(self, ou: str, role: int = api.MSPRole.MEMBER,
               count: int = 1) -> list[tuple[object,
@@ -74,6 +93,13 @@ class IdemixIssuer:
                 serialization.Encoding.DER,
                 serialization.PublicFormat.SubjectPublicKeyInfo)
             digest = _credential_digest(nym_pub, ou, role)
+            if self.scheme == "bls":
+                from fabric_tpu.ops import bn254_ref as bref
+                sig_pt = bref.bls_sign(self._bls_sk, digest)
+                out.append((nym_priv, msppb.IdemixCredential(
+                    nym_pub=nym_pub, ou=ou, role=role,
+                    bls_sig=bref.g1_to_bytes(sig_pt))))
+                continue
             from cryptography.hazmat.primitives.asymmetric.utils import (
                 Prehashed,
             )
@@ -170,6 +196,11 @@ class IdemixMSP(api.MSP):
             self._issuer_pub_raw)
         self._issuer_pub = self.csp.key_import(
             issuer_key, bapi.ECDSAPublicKeyImportOpts())
+        self._issuer_bls_pk = None
+        if idc.issuer_bls_public_key:
+            from fabric_tpu.ops import bn254_ref as bref
+            self._issuer_bls_pk = bref.g2_from_bytes(
+                bytes(idc.issuer_bls_public_key))
 
     # -- credential intake (member side) --
 
@@ -207,7 +238,7 @@ class IdemixMSP(api.MSP):
         wrapped = msppb.SerializedIdemixIdentity()
         wrapped.ParseFromString(sid.id_bytes)
         cred = wrapped.credential
-        if not cred.nym_pub or not cred.issuer_sig:
+        if not cred.nym_pub or not (cred.issuer_sig or cred.bls_sig):
             raise MSPError("idemix identity lacks a credential")
         nym_key = self._import_nym(bytes(cred.nym_pub))
         return IdemixIdentity(self, cred, nym_key)
@@ -217,15 +248,55 @@ class IdemixMSP(api.MSP):
 
     def validate(self, identity: IdemixIdentity) -> None:
         """Issuer binding: the credential must carry a valid issuer
-        signature over (nym, disclosed attributes)."""
-        cred = identity.credential
-        digest = _credential_digest(bytes(cred.nym_pub), cred.ou,
-                                    cred.role)
-        if not self.csp.verify(self._issuer_pub,
-                               bytes(cred.issuer_sig), digest):
+        signature over (nym, disclosed attributes) — P-256, or BLS
+        verified by PAIRING when the org configured a BLS issuer key."""
+        if not self.validate_credentials_batch([identity])[0]:
             raise MSPError(
                 f"idemix credential not signed by the {self._id} "
                 "issuer")
+
+    def validate_credentials_batch(self, identities) -> list[bool]:
+        """Batched issuer-binding checks; BLS credentials go through
+        ONE pairing-product dispatch (`csp.bls_verify_batch` — the
+        device path on the TPU provider), ECDSA credentials through
+        the ordinary batched verify. This is the measurable surface
+        for BASELINE config 4."""
+        out = [False] * len(identities)
+        bls_idx, bls_digests, bls_sigs = [], [], []
+        ec_idx, ec_items = [], []
+        for i, ident in enumerate(identities):
+            cred = ident.credential
+            digest = _credential_digest(bytes(cred.nym_pub), cred.ou,
+                                        cred.role)
+            if cred.bls_sig:
+                if self._issuer_bls_pk is None:
+                    continue                      # no BLS trust anchor
+                from fabric_tpu.ops import bn254_ref as bref
+                try:
+                    pt = bref.g1_from_bytes(bytes(cred.bls_sig))
+                except ValueError:
+                    pt = None
+                bls_idx.append(i)
+                bls_digests.append(digest)
+                bls_sigs.append(pt)
+            else:
+                ec_idx.append(i)
+                ec_items.append(bapi.VerifyItem(
+                    key=self._issuer_pub,
+                    signature=bytes(cred.issuer_sig), digest=digest))
+        if ec_items:
+            for i, ok in zip(ec_idx, self.csp.verify_batch(ec_items)):
+                out[i] = ok
+        if bls_idx:
+            csp = self.csp
+            if not hasattr(csp, "bls_verify_batch"):
+                from fabric_tpu.bccsp.sw import SWProvider
+                csp = SWProvider()       # exact host pairing fallback
+            res = csp.bls_verify_batch(
+                self._issuer_bls_pk, bls_digests, bls_sigs)
+            for i, ok in zip(bls_idx, res):
+                out[i] = ok
+        return out
 
     def satisfies_principal(self, identity: IdemixIdentity,
                             principal: polpb.MSPPrincipal) -> None:
@@ -269,5 +340,7 @@ def idemix_msp_config(name: str,
     idemixgen output consumed by configtxgen)."""
     idc = msppb.IdemixMSPConfig(
         name=name, issuer_public_key=issuer.public_key_pem())
+    if issuer.scheme == "bls":
+        idc.issuer_bls_public_key = issuer.bls_public_key_bytes()
     return msppb.MSPConfig(type=1,
                            config=idc.SerializeToString())

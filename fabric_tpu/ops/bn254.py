@@ -323,6 +323,341 @@ def miller_loop_batch(xP, yP, Q, Q1, nQ2, loop: int = ref.ATE_LOOP):
 
 
 # ---------------------------------------------------------------------------
+# Final exponentiation (device)
+# ---------------------------------------------------------------------------
+
+def _f2_pow_int(a, e: int):
+    """Host: exact Fp2 pow (for Frobenius constants)."""
+    out = (1, 0)
+    base = a
+    while e:
+        if e & 1:
+            out = ref.f2_mul(out, base)
+        base = ref.f2_mul(base, base)
+        e >>= 1
+    return out
+
+
+# gamma = xi^((p-1)/6); (v^j w^i)^p = conj-coeffs * gamma^(2j+i)
+_GAMMA = [_f2_pow_int(ref.XI, k * (ref.P - 1) // 6) for k in range(6)]
+
+
+def f2_conj(a):
+    return (a[0], F.neg(a[1]))
+
+
+def f12_conj(f):
+    """x -> x^(p^6): negate the w half. Inverse inside the cyclotomic
+    subgroup (post easy part)."""
+    d0, d1 = f
+    return (d0, tuple(f2_neg(c) for c in d1))
+
+
+def f12_frob(f):
+    """x -> x^p: coefficient-wise Fp2 conjugation times the gamma
+    constants (host-exact, differentially pinned vs ref.f12_frob)."""
+    d0, d1 = f
+
+    def g(k, c):
+        const = tuple(jnp.broadcast_to(v, c[0].shape)
+                      for v in _const_fp2(_GAMMA[k]))
+        return f2_mul(f2_conj(c), const)
+
+    return ((f2_conj(d0[0]), g(2, d0[1]), g(4, d0[2])),
+            (g(1, d1[0]), g(3, d1[1]), g(5, d1[2])))
+
+
+def _pow_scan(x, e: int, mul, sqr, select):
+    """Square-and-multiply by a STATIC positive exponent as a lax.scan
+    (keeps the HLO one-body-sized for multi-thousand-bit chains)."""
+    bits = [int(b) for b in bin(e)[3:]]          # skip the leading 1
+    if not bits:
+        return x
+    bit_arr = jnp.asarray(np.array(bits, dtype=bool))
+
+    def body(acc, bit):
+        acc = sqr(acc)
+        acc = select(bit, mul(acc, x), acc)
+        return acc, None
+
+    out, _ = lax.scan(body, x, bit_arr)
+    return out
+
+
+def fp_inv(x):
+    """Montgomery Fermat inverse: x^(p-2) via a 254-bit scan."""
+    def select(bit, a, b):
+        return jnp.where(bit, a, b)
+
+    return _pow_scan(x, ref.P - 2, F.mul, lambda a: F.mul(a, a), select)
+
+
+def f2_inv(a):
+    d = fp_inv(F.add(F.mul(a[0], a[0]), F.mul(a[1], a[1])))
+    return (F.mul(a[0], d), F.mul(F.neg(a[1]), d))
+
+
+def f6_inv(a):
+    """Adjoint/norm method (mirrors ref.f6_inv)."""
+    c0, c1, c2 = a
+    t0 = f2_sub(f2_sqr(c0), f2_mul_xi(f2_mul(c1, c2)))
+    t1 = f2_sub(f2_mul_xi(f2_sqr(c2)), f2_mul(c0, c1))
+    t2 = f2_sub(f2_sqr(c1), f2_mul(c0, c2))
+    norm = f2_add(f2_mul(c0, t0),
+                  f2_mul_xi(f2_add(f2_mul(c2, t1), f2_mul(c1, t2))))
+    ninv = f2_inv(norm)
+    return (f2_mul(t0, ninv), f2_mul(t1, ninv), f2_mul(t2, ninv))
+
+
+def f12_inv(a):
+    a0, a1 = a
+    t1 = f6_mul(a1, a1)
+    norm = f6_sub(f6_mul(a0, a0), f6_mul_v(t1))
+    ninv = f6_inv(norm)
+    return (f6_mul(a0, ninv),
+            tuple(f2_neg(c) for c in f6_mul(a1, ninv)))
+
+
+def _f12_select(bit, a, b):
+    mask = jnp.broadcast_to(bit, a[0][0][0].shape[:1])
+    return _select_f12(mask, a, b)
+
+
+def f12_pow_t(m):
+    """m^t for the BN parameter t (63-bit static scan)."""
+    return _pow_scan(m, ref.T_BN, f12_mul, f12_sqr, _f12_select)
+
+
+# -- the final-exp REGISTER MACHINE --
+#
+# A monolithic unrolled chain (3 pow-by-t + ~25 Fp12 muls, each 54
+# Montgomery muls) produces an HLO the compilers refuse: the tunnel's
+# remote TPU compiler SIGKILLs and the CPU jit OOMs. Instead the whole
+# post-inversion exponentiation runs as ONE lax.scan whose body is a
+# tiny f12-op interpreter (MUL/CONJ/FROB over a register file), driven
+# by a static ~310-instruction program assembled from the SAME chain
+# that ref.final_exponentiation_chain pins against the single-pow
+# oracle. HLO cost: one multiply body, regardless of chain length.
+
+_OP_MUL, _OP_CONJ, _OP_FROB = 0, 1, 2
+_NREG = 8
+
+
+def _flat_from_f12(f):
+    """Nested-tuple f12 -> (12, ...) stacked coeff tensor."""
+    coeffs = [c for half in f for fp2 in half for c in fp2]
+    return jnp.stack(coeffs, axis=0)
+
+
+def _f12_from_flat(x):
+    return tuple(
+        tuple((x[h * 6 + j * 2], x[h * 6 + j * 2 + 1])
+              for j in range(3))
+        for h in range(2))
+
+
+class _Asm:
+    """Assembles the final-exp chain into (op, dst, a, b) rows."""
+
+    def __init__(self):
+        self.rows = []
+
+    def emit(self, op, dst, a, b=0):
+        self.rows.append((op, dst, a, b))
+
+    def mul(self, dst, a, b):
+        self.emit(_OP_MUL, dst, a, b)
+
+    def sqr(self, dst, a):
+        self.emit(_OP_MUL, dst, a, a)
+
+    def conj(self, dst, a):
+        self.emit(_OP_CONJ, dst, a)
+
+    def frob(self, dst, a):
+        self.emit(_OP_FROB, dst, a)
+
+    def copy(self, dst, a):
+        self.conj(dst, a)            # conj . conj = identity
+        self.conj(dst, dst)
+
+    def pow_t(self, dst, src, tmp):
+        """dst = src^t: square-and-multiply over t's static bits
+        (src, tmp, dst must be distinct registers)."""
+        assert len({dst, src, tmp}) == 3
+        self.copy(tmp, src)          # acc <- src (leading bit)
+        for b in bin(ref.T_BN)[3:]:
+            self.sqr(tmp, tmp)
+            if b == "1":
+                self.mul(tmp, tmp, src)
+        self.copy(dst, tmp)
+
+
+def _final_exp_program() -> np.ndarray:
+    """Registers: 0=f (input), 1=inv_f (input), 2=m, 3=mx, 4=mx2,
+    5=mx3, 6=t0/scratch, 7=t1/scratch. Mirrors
+    ref.final_exponentiation_chain exactly (oracle-pinned)."""
+    A = _Asm()
+    # easy part: m = frob^2(f^(p^6-1)) * f^(p^6-1)
+    A.conj(2, 0)                 # m <- conj(f)
+    A.mul(2, 2, 1)               # m <- conj(f)*inv(f) = f^(p^6-1)
+    A.frob(6, 2)
+    A.frob(6, 6)                 # t0 <- m^(p^2)
+    A.mul(2, 6, 2)               # m <- m^(p^2+1)
+    # hard part powers of t
+    A.pow_t(3, 2, 6)             # mx  = m^t
+    A.pow_t(4, 3, 6)             # mx2 = mx^t
+    A.pow_t(5, 4, 6)             # mx3 = mx2^t
+    # y0 = mp*mp2*mp3 -> reg 6
+    A.frob(6, 2)                 # mp
+    A.frob(7, 6)                 # mp2
+    A.mul(6, 6, 7)               # mp*mp2
+    A.frob(7, 7)                 # mp3
+    A.mul(6, 6, 7)               # y0
+    # y4 = conj(mx * frob(mx2)) -> reg 7 ... build T0 incrementally:
+    # T0 = y6^2 * y4 * y5;  y6 = conj(mx3 * frob(mx3))
+    # use reg 0 (f no longer needed) and reg 1 (inv_f done) as scratch
+    A.frob(0, 5)                 # frob(mx3)
+    A.mul(0, 5, 0)               # mx3*mx3p
+    A.conj(0, 0)                 # y6
+    A.sqr(0, 0)                  # y6^2
+    A.frob(1, 4)                 # mx2p
+    A.mul(1, 3, 1)               # mx*mx2p
+    A.conj(1, 1)                 # y4
+    A.mul(0, 0, 1)               # y6^2*y4
+    A.conj(1, 4)                 # y5
+    A.mul(0, 0, 1)               # T0 = y6^2*y4*y5
+    # T1 = y3*y5*T0; y3 = conj(frob(mx))
+    A.frob(7, 3)
+    A.conj(7, 7)                 # y3
+    A.mul(7, 7, 1)               # y3*y5
+    A.mul(7, 7, 0)               # T1
+    # T0 = T0 * y2; y2 = frob^2(mx2)
+    A.frob(1, 4)
+    A.frob(1, 1)                 # y2
+    A.mul(0, 0, 1)               # T0*y2
+    # T1 = T1^2 * T0; T1 = T1^2
+    A.sqr(7, 7)
+    A.mul(7, 7, 0)
+    A.sqr(7, 7)
+    # T0 = T1 * y1; y1 = conj(m)
+    A.conj(1, 2)                 # y1
+    A.mul(0, 7, 1)               # T0 = T1*y1
+    # T1 = T1 * y0 (y0 in reg 6)
+    A.mul(7, 7, 6)
+    # result = T0^2 * T1 -> reg 0
+    A.sqr(0, 0)
+    A.mul(0, 0, 7)
+    return np.asarray(A.rows, dtype=np.int32)
+
+
+def final_exp_batch(f):
+    """The full final exponentiation on device: easy part
+    (p^6-1)(p^2+1) then the BN hard part via the parameter-t addition
+    chain (mirrors ref.final_exponentiation_chain, which is pinned
+    against the single-pow oracle). Runs as a register-machine scan —
+    see the note above the assembler."""
+    inv = f12_inv(f)
+    regs0 = jnp.stack([_flat_from_f12(f), _flat_from_f12(inv)] +
+                      [jnp.zeros_like(_flat_from_f12(f))] * (_NREG - 2),
+                      axis=0)                    # (NREG, 12, ...)
+    program = jnp.asarray(_final_exp_program())
+
+    def body(regs, instr):
+        op, dst, a, b = instr[0], instr[1], instr[2], instr[3]
+        A = _f12_from_flat(jnp.take(regs, a, axis=0))
+        Bv = _f12_from_flat(jnp.take(regs, b, axis=0))
+        res = lax.switch(op, [
+            lambda: _flat_from_f12(f12_mul(A, Bv)),
+            lambda: _flat_from_f12(f12_conj(A)),
+            lambda: _flat_from_f12(f12_frob(A)),
+        ])
+        regs = lax.dynamic_update_index_in_dim(regs, res, dst, axis=0)
+        return regs, None
+
+    regs, _ = lax.scan(body, regs0, program)
+    return _f12_from_flat(regs[0])
+
+
+def gt_is_one(f):
+    """(B,) bool: is the GT element the identity? Canonical-compare
+    every coefficient (mont(1) for c000, zero elsewhere)."""
+    one = jnp.asarray(F.to_mont(1))
+    coeffs = [c for d in f for fp2 in d for c in fp2]
+    first = coeffs[0]
+    ok = jnp.all(F.canonical(first) ==
+                 F.canonical(jnp.broadcast_to(one, first.shape)),
+                 axis=-1)
+    for c in coeffs[1:]:
+        ok = ok & jnp.all(F.canonical(c) == 0, axis=-1)
+    return ok
+
+
+def pairing_product_is_one(xPs, yPs, Qs, Q1s, nQ2s,
+                           loop: int = ref.ATE_LOOP):
+    """prod_i e(P_i, Q_i) == 1 for a batch of pairing PRODUCTS.
+
+    Each argument is a list over the product terms; list element i
+    carries the (B, L) staged tensors of that term. One shared final
+    exponentiation over the multiplied Miller values — the standard
+    product-of-pairings trick (and why the BBS+ verify equation
+    e(A, X) = e(B, Y) is checked as e(A, X)·e(B, -Y) == 1).
+    """
+    import jax
+
+    # ONE shared Miller scan with the product terms STACKED into the
+    # batch axis: T terms of B lanes run as one (T*B)-lane loop, so
+    # the (large) scan body appears once in the HLO instead of T
+    # times — without this the tunnel's remote TPU compiler is killed
+    # on program size.
+    nterms = len(xPs)
+    B = xPs[0].shape[0]
+    cat = lambda ts: jax.tree_util.tree_map(  # noqa: E731
+        lambda *xs: jnp.concatenate(xs, axis=0), *ts)
+    f_all = miller_loop_batch(cat(xPs), cat(yPs), cat(Qs), cat(Q1s),
+                              cat(nQ2s), loop=loop)
+    acc = None
+    for t in range(nterms):
+        fi = jax.tree_util.tree_map(
+            lambda x: x[t * B:(t + 1) * B], f_all)
+        acc = fi if acc is None else f12_mul(acc, fi)
+    return gt_is_one(final_exp_batch(acc))
+
+
+def stage_pairing_products(products):
+    """[[(P_int, Q_tw_int), ...] per lane] (uniform term count) ->
+    the staged tensor lists pairing_product_is_one consumes."""
+    nterms = len(products[0])
+    assert all(len(p) == nterms for p in products)
+    xPs, yPs, Qs, Q1s, nQ2s = [], [], [], [], []
+    for t in range(nterms):
+        g1s = [p[t][0] for p in products]
+        g2s = [p[t][1] for p in products]
+        xP, yP = stage_g1(g1s)
+        Q, Q1, nQ2 = stage_g2(g2s)
+        xPs.append(jnp.asarray(xP))
+        yPs.append(jnp.asarray(yP))
+        Qs.append(jax_tree(Q))
+        Q1s.append(jax_tree(Q1))
+        nQ2s.append(jax_tree(nQ2))
+    return xPs, yPs, Qs, Q1s, nQ2s
+
+
+def jax_tree(t):
+    import jax
+    return jax.tree_util.tree_map(jnp.asarray, t)
+
+
+def bls_products(pk_tw, msgs, sig_points):
+    """Per-lane BLS verify as a 2-term pairing product:
+    e(sig, G2) * e(H(m), -pk) == 1."""
+    g2 = (ref.G2_X, ref.G2_Y)
+    npk = ref.g2_neg_tw(pk_tw)
+    return [[(sig, g2), (ref.hash_to_g1(m), npk)]
+            for m, sig in zip(msgs, sig_points)]
+
+
+# ---------------------------------------------------------------------------
 # Host staging + verification helpers
 # ---------------------------------------------------------------------------
 

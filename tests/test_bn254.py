@@ -44,6 +44,26 @@ class TestCurve:
         assert bn.ec_mul(bn.R, bn.untwist((bn.G2_X, bn.G2_Y))) is None
 
 
+class TestFinalExpChain:
+    def test_chain_equals_single_pow(self):
+        """The structured easy+hard chain (what the device transcribes)
+        must equal f^((p^12-1)/r) exactly."""
+        rng = random.Random(17)
+        for _ in range(2):
+            f = tuple(tuple((rng.randrange(bn.P), rng.randrange(bn.P))
+                            for _ in range(3)) for _ in range(2))
+            assert bn.final_exponentiation_chain(f) == \
+                bn.final_exponentiation(f)
+
+    def test_chain_lands_in_cyclotomic_subgroup(self):
+        rng = random.Random(18)
+        f = tuple(tuple((rng.randrange(bn.P), rng.randrange(bn.P))
+                        for _ in range(3)) for _ in range(2))
+        out = bn.final_exponentiation_chain(f)
+        # order divides r: out^r == 1
+        assert bn.f12_pow(out, bn.R) == bn.F12_ONE
+
+
 @pytest.mark.slow
 class TestPairing:
     def test_bilinearity_and_nondegeneracy(self):

@@ -1,0 +1,57 @@
+"""Probe: device BLS credential verification (pairing products) on TPU.
+
+Measures TPUProvider.bls_verify_batch — BASELINE config 4's kernel —
+against the host (int-reference) pairing. `python -u tools/probe_pairing.py`.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+B = int(os.environ.get("PROBE_B", "256"))
+ITERS = int(os.environ.get("PROBE_ITERS", "3"))
+
+
+def main():
+    from fabric_tpu.bccsp.tpu import TPUProvider
+    from fabric_tpu.common import jaxenv
+    from fabric_tpu.ops import bn254_ref as ref
+
+    jaxenv.enable_compilation_cache()
+    sk, pk = ref.bls_keygen(b"probe")
+    msgs = [f"cred {i}".encode() for i in range(B)]
+    t0 = time.perf_counter()
+    sigs = [ref.bls_sign(sk, m) for m in msgs]
+    print(f"host sign x{B}: {time.perf_counter()-t0:.1f}s", flush=True)
+    sigs[3] = ref.hash_to_g1(b"forged")          # one invalid lane
+
+    # host baseline on a small sample
+    t0 = time.perf_counter()
+    ok = [ref.bls_verify(pk, m, s) for m, s in zip(msgs[:4], sigs[:4])]
+    host_per = (time.perf_counter() - t0) / 4
+    assert ok == [True, True, True, False]
+    print(f"host verify: {host_per*1e3:.0f} ms/credential", flush=True)
+
+    prov = TPUProvider(min_batch=1)
+    t0 = time.perf_counter()
+    out = prov.bls_verify_batch(pk, msgs, sigs)
+    print(f"device compile+first: {time.perf_counter()-t0:.1f}s",
+          flush=True)
+    assert out == [i != 3 for i in range(B)], "device/host disagree"
+    assert prov.stats["sw_fallbacks"] == 0, "fell back to host!"
+    times = []
+    for _ in range(ITERS):
+        t0 = time.perf_counter()
+        out = prov.bls_verify_batch(pk, msgs, sigs)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    print(f"device steady: {best:.2f}s for {B} = "
+          f"{best/B*1e3:.1f} ms/credential "
+          f"({host_per/(best/B):.1f}x one host core) "
+          f"times={[round(t,2) for t in times]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
