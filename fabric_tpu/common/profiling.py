@@ -1,0 +1,104 @@
+"""Profiling surfaces for the operations endpoint.
+
+Rebuild of the reference's pprof wiring (`cmd/peer/main.go:10` imports
+net/http/pprof; served on the operations listener when
+`peer.profile.enabled`, `internal/peer/node/start.go:842-850`) —
+adapted to this runtime:
+
+  * `sample_profile(seconds)` — a sampling CPU profiler over
+    `sys._current_frames()` (the pprof "profile" analog for Python:
+    no instrumentation, safe on a live node);
+  * `capture_jax_trace(out_dir, seconds)` — a JAX profiler capture
+    producing an xplane trace of whatever runs on the devices during
+    the window (SURVEY §5: the rebuild adds xplane capture on the
+    compute path). View with TensorBoard / xprof.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import sys
+import threading
+import time
+
+SAMPLE_HZ = 100
+
+
+def sample_profile(seconds: float = 5.0, hz: int = SAMPLE_HZ) -> str:
+    """Sample every thread's stack for `seconds`; returns a text
+    report of the hottest stacks (collapsed, most-sampled first)."""
+    interval = 1.0 / hz
+    counts: collections.Counter = collections.Counter()
+    nsamples = 0
+    me = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue
+            stack = []
+            f = frame
+            while f is not None and len(stack) < 48:
+                code = f.f_code
+                stack.append(f"{os.path.basename(code.co_filename)}:"
+                             f"{f.f_lineno}:{code.co_name}")
+                f = f.f_back
+            counts["; ".join(reversed(stack))] += 1
+        nsamples += 1
+        time.sleep(interval)
+    lines = [f"# {nsamples} samples over {seconds:.1f}s at {hz} Hz"]
+    for stack, n in counts.most_common(40):
+        pct = 100.0 * n / max(1, nsamples)
+        lines.append(f"{pct:5.1f}%  {n:6d}  {stack}")
+    return "\n".join(lines) + "\n"
+
+
+_trace_lock = threading.Lock()
+
+
+def capture_jax_trace(out_dir: str, seconds: float = 3.0) -> str:
+    """Capture a JAX/xplane profiler trace of device activity for
+    `seconds`; returns the trace directory. Serialized: the JAX
+    profiler supports one live session per process."""
+    import jax
+
+    with _trace_lock:
+        os.makedirs(out_dir, exist_ok=True)
+        jax.profiler.start_trace(out_dir)
+        try:
+            time.sleep(seconds)
+        finally:
+            jax.profiler.stop_trace()
+    return out_dir
+
+
+def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
+    """Expose a BCCSP provider's `stats` counters as gauges
+    (`fabric_bccsp_<name>`), refreshed by a daemon poller — the TPU
+    path's perf-cliff counters (comb vs ladder dispatches, sw
+    fallbacks, table cache bytes/evictions) become scrapeable instead
+    of debugger-only. Returns the poller thread (daemon, running)."""
+    from fabric_tpu.common import metrics as metrics_mod
+
+    stats = getattr(csp, "stats", None)
+    if not isinstance(stats, dict):
+        return None
+    gauges = {
+        name: metrics_provider.new_gauge(metrics_mod.GaugeOpts(
+            namespace="bccsp", name=name)).with_labels()
+        for name in stats
+    }
+
+    def poll():
+        while True:
+            for name, g in gauges.items():
+                try:
+                    g.set(float(stats.get(name, 0)))
+                except Exception:
+                    pass
+            time.sleep(poll_s)
+
+    t = threading.Thread(target=poll, name="bccsp-stats", daemon=True)
+    t.start()
+    return t

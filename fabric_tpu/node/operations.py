@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -24,10 +25,12 @@ VERSION = "0.2.0"
 
 class OperationsServer:
     def __init__(self, address: str = "127.0.0.1:0",
-                 metrics_provider=None, version: str = VERSION):
+                 metrics_provider=None, version: str = VERSION,
+                 profile_enabled: bool = False):
         host, port = address.rsplit(":", 1)
         self._metrics = metrics_provider
         self._version = version
+        self._profile_enabled = profile_enabled
         self._checkers: dict[str, Callable[[], None]] = {}
         self._extra: dict[str, Callable] = {}
         ops = self
@@ -92,6 +95,8 @@ class OperationsServer:
                     {"Version": self._version}).encode())
             elif path == "/logspec":
                 self._logspec(h, method)
+            elif path.startswith("/debug/") and method == "GET":
+                self._debug(h, path)
             else:
                 for prefix, fn in self._extra.items():
                     if path.startswith(prefix):
@@ -122,6 +127,49 @@ class OperationsServer:
                  "failed_checks": failed}).encode())
         else:
             h._reply(200, json.dumps({"status": "OK"}).encode())
+
+    def _debug(self, h, path: str) -> None:
+        """pprof-analog surfaces (reference: net/http/pprof on the ops
+        listener when peer.profile.enabled — `cmd/peer/main.go:10`,
+        `internal/peer/node/start.go:842-850`):
+          /debug/threads            thread stacks (goroutine dump twin)
+          /debug/profile?seconds=N  sampling CPU profile
+          /debug/jax/trace?seconds=N         xplane capture of device
+                                             activity (SURVEY §5)
+        Gated by `operations.profile.enabled` exactly like the
+        reference's pprof listener; trace output always lands in a
+        server-chosen temp directory (clients must not pick filesystem
+        paths).
+        """
+        from urllib.parse import parse_qs, urlparse
+
+        from fabric_tpu.common import diag, profiling
+        if not self._profile_enabled:
+            h._reply(403, b'{"Error":"profiling disabled: set '
+                          b'operations.profile.enabled"}')
+            return
+        q = parse_qs(urlparse(h.path).query)
+
+        def qf(name, default):
+            try:
+                return float(q[name][0])
+            except (KeyError, ValueError, IndexError):
+                return default
+
+        if path == "/debug/threads":
+            h._reply(200, diag.dump_threads(log=lambda *a: None)
+                     .encode(), "text/plain")
+        elif path == "/debug/profile":
+            secs = min(60.0, qf("seconds", 5.0))
+            h._reply(200, profiling.sample_profile(secs).encode(),
+                     "text/plain")
+        elif path == "/debug/jax/trace":
+            secs = min(60.0, qf("seconds", 3.0))
+            out = tempfile.mkdtemp(prefix="jax_trace_")
+            traced = profiling.capture_jax_trace(out, secs)
+            h._reply(200, json.dumps({"trace_dir": traced}).encode())
+        else:
+            h._reply(404, b'{"Error":"unknown debug surface"}')
 
     def _logspec(self, h, method: str) -> None:
         if method == "GET":

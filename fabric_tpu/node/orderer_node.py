@@ -168,8 +168,10 @@ class OrdererNode:
         ops_addr = cfg.get("Admin.ListenAddress",
                            cfg.get("Operations.ListenAddress",
                                    "127.0.0.1:0"))
-        self.ops = OperationsServer(ops_addr,
-                                    metrics_provider=provider)
+        self.ops = OperationsServer(
+            ops_addr, metrics_provider=provider,
+            profile_enabled=bool(cfg.get("Operations.Profile.Enabled",
+                                         False)))
         self.ops.register_checker("orderer", lambda: None)
         self.ops.register_handler("/participation",
                                   self._participation_http(

@@ -102,6 +102,18 @@ class PeerNode:
         bccsp_cfg = cfg.get("peer.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(
             bccsp_factory.FactoryOpts.from_config(bccsp_cfg))
+        # the TPU provider's perf-cliff counters become scrapeable
+        # gauges (fabric_bccsp_*) on /metrics
+        from fabric_tpu.common import profiling
+        profiling.publish_provider_stats(provider, csp)
+        # pre-compile the standard validation shapes in the background
+        # so the first blocks after (re)start don't stall on device
+        # compilation (BCCSP.TPU.Prewarm: false to disable)
+        if hasattr(csp, "prewarm") and \
+                (bccsp_cfg.get("TPU") or {}).get("Prewarm", True):
+            import threading as _threading
+            _threading.Thread(target=csp.prewarm, name="bccsp-prewarm",
+                              daemon=True).start()
 
         msp_dir = cfg.get_path("peer.mspConfigPath")
         msp_id = cfg.get("peer.localMspId", "SampleOrg")
@@ -181,8 +193,10 @@ class PeerNode:
         # uses — the reference routes `peer channel join` through the
         # in-process cscc; here it is an operator-local HTTP call)
         ops_addr = cfg.get("operations.listenAddress", "127.0.0.1:0")
-        self.ops = OperationsServer(ops_addr,
-                                    metrics_provider=provider)
+        self.ops = OperationsServer(
+            ops_addr, metrics_provider=provider,
+            profile_enabled=bool(cfg.get("operations.profile.enabled",
+                                         False)))
         self.ops.register_checker("peer", lambda: None)
         self.ops.register_handler("/admin", self._admin_http)
         self.ops.start()

@@ -74,6 +74,7 @@ NWIN_G16 = 16
 NENT_G16 = 1 << 16
 
 _g16_cache: list = []
+_g16_lock = __import__("threading").Lock()
 
 
 def g16_tables():
@@ -87,26 +88,28 @@ def g16_tables():
     tables are universal constants, exactly the precompute a
     long-lived validating peer wants.
     """
-    if _g16_cache:
+    with _g16_lock:     # a prewarm thread must not race the first
+        #                 block into building the ~252 MB table twice
+        if _g16_cache:
+            return _g16_cache[0]
+        import jax
+
+        g8 = jnp.asarray(g_tables())        # (32*256, 3, L)
+
+        def build(g8):
+            idx = jnp.arange(NENT_G16, dtype=jnp.int32)
+            lo, hi = idx & 255, idx >> 8
+            outs = []
+            for i in range(NWIN_G16):
+                a = jnp.take(g8, (2 * i) * NENT + lo, axis=0)
+                b = jnp.take(g8, (2 * i + 1) * NENT + hi, axis=0)
+                X, Y, Z = cadd((a[:, 0], a[:, 1], a[:, 2]),
+                               (b[:, 0], b[:, 1], b[:, 2]))
+                outs.append(jnp.stack([X, Y, Z], axis=1))
+            return jnp.concatenate(outs, axis=0)
+
+        _g16_cache.append(jax.jit(build)(g8))
         return _g16_cache[0]
-    import jax
-
-    g8 = jnp.asarray(g_tables())            # (32*256, 3, L)
-
-    def build(g8):
-        idx = jnp.arange(NENT_G16, dtype=jnp.int32)
-        lo, hi = idx & 255, idx >> 8
-        outs = []
-        for i in range(NWIN_G16):
-            a = jnp.take(g8, (2 * i) * NENT + lo, axis=0)
-            b = jnp.take(g8, (2 * i + 1) * NENT + hi, axis=0)
-            X, Y, Z = cadd((a[:, 0], a[:, 1], a[:, 2]),
-                           (b[:, 0], b[:, 1], b[:, 2]))
-            outs.append(jnp.stack([X, Y, Z], axis=1))
-        return jnp.concatenate(outs, axis=0)
-
-    _g16_cache.append(jax.jit(build)(g8))
-    return _g16_cache[0]
 
 
 # ---------------------------------------------------------------------------
