@@ -198,6 +198,23 @@ def main():
     tpu_s = min(times)
     tpu_sigs_per_s = batch / tpu_s
 
+    # --- BASELINE config 3: the REAL pipeline (endorse -> raft order
+    #     -> TxValidator -> commit), TPU peer vs sw peer ---
+    # default e2e block = the SAME signature bucket as the headline
+    # (10240 txs -> 30720 sigs -> bucket 32768), so the provider's
+    # already-compiled pipeline is reused and the e2e section adds
+    # ZERO fresh device compiles
+    pipeline = None
+    if os.environ.get("BENCH_E2E", "1") == "1":
+        try:
+            import bench_pipeline
+            pipeline = bench_pipeline.run(
+                prov,
+                ntxs=int(os.environ.get("BENCH_E2E_TXS",
+                                        str(BLOCK_TXS))))
+        except Exception as e:          # noqa: BLE001
+            pipeline = {"error": f"{type(e).__name__}: {e}"}
+
     on_tpu = type(prov)._on_tpu()
     result = {
         "metric": "block-validation sig-verify throughput "
@@ -228,6 +245,7 @@ def main():
             "warm_pass_s": round(warm_s, 1),
             "sign_s": round(sign_s, 2),
             "provider_stats": dict(prov.stats),
+            "pipeline": pipeline,
             "devices": [str(d) for d in jax.devices()],
         },
     }

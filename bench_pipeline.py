@@ -1,0 +1,223 @@
+"""BASELINE config 3: block validation through the REAL tx pipeline.
+
+Stands up an in-process 2-org network with a single-node etcdraft
+orderer (real RaftChain: WAL, ready loop, block signing), endorses
+`ntxs` transactions through the gateway (2 endorsements + 1 creator
+signature each), orders them into one block, then times the peer-side
+block pipeline — `Channel.process_block` = TxValidator (batched
+verify) → pvt-data gather → kvledger commit — for BOTH a TPU-provider
+peer and a sw-provider peer over the SAME ordered block.
+
+Reference analog: `integration/e2e/e2e_test.go`; the timings mirror
+"Validated block [n] in Tms" (`validator.go:262`) and the commit
+breakdown (`kv_ledger.go:673-681`). Used by bench.py (BENCH_E2E=1) to
+emit the `pipeline` section of the headline JSON.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+
+def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition
+    from fabric_tpu.core.chaincode import shim
+    from fabric_tpu.internal import cryptogen
+    from fabric_tpu.internal.configtxgen import (
+        genesis_block,
+        new_channel_group,
+    )
+    from fabric_tpu.msp import msp_config_from_dir
+    from fabric_tpu.msp.mspimpl import X509MSP
+    from fabric_tpu.orderer import raft as raft_mod
+    from fabric_tpu.orderer.broadcast import BroadcastHandler
+    from fabric_tpu.orderer.cluster import LocalClusterNetwork
+    from fabric_tpu.orderer.multichannel import Registrar
+    from fabric_tpu.peer import Peer
+    from fabric_tpu.peer.gateway import Gateway
+    from fabric_tpu.protos import transaction as txpb
+
+    channel = "benchchannel"
+    orderer_ep = "orderer0.example.com:7050"
+    root = tempfile.mkdtemp(prefix="bench_e2e_")
+    cdir = os.path.join(root, "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    sw_csp = SWProvider()
+
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "etcdraft",
+            "Addresses": [orderer_ep],
+            "BatchTimeout": "500ms",
+            # bytes limits sized so MaxMessageCount governs: the point
+            # is ONE ntxs-transaction block through the validator
+            # (config 3's shape), not the blockcutter's byte policy
+            "BatchSize": {"MaxMessageCount": ntxs,
+                          "PreferredMaxBytes": 1 << 30,
+                          "AbsoluteMaxBytes": 1 << 30},
+            "Raft": {"Consenters": [
+                {"Host": orderer_ep.split(":")[0], "Port": 7050}]},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": [orderer_ep]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(channel, new_channel_group(profile))
+
+    def local_msp(msp_dir, mspid):
+        m = X509MSP(sw_csp)
+        m.setup(msp_config_from_dir(msp_dir, mspid, csp=sw_csp))
+        return m
+
+    # ---- single-node raft ordering service ----
+    net = LocalClusterNetwork()
+    transport = net.register(orderer_ep)
+    orderer_msp = local_msp(
+        os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
+        "OrdererMSP")
+    registrar = Registrar(
+        os.path.join(root, "orderer"),
+        orderer_msp.get_default_signing_identity(), sw_csp,
+        {"etcdraft": raft_mod.consenter(transport,
+                                        tick_interval_s=0.03,
+                                        election_tick=8)})
+    registrar.join(genesis)
+    broadcast = BroadcastHandler(registrar)
+
+    class KV(Chaincode):
+        def init(self, stub):
+            return shim.success()
+
+        def invoke(self, stub):
+            fn, params = stub.get_function_and_parameters()
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+
+    # ---- two validating peers: TPU provider vs sw provider ----
+    peers = {}
+    for org_name, org_dir, mspid, csp in (
+            ("org1", org1, "Org1MSP", tpu_csp),
+            ("org2", org2, "Org2MSP", sw_csp)):
+        msp = local_msp(
+            os.path.join(org_dir, "peers",
+                         f"peer0.{org_name}.example.com", "msp"), mspid)
+        peer = Peer(os.path.join(root, f"peer_{org_name}"), msp, csp)
+        peer.join_channel(genesis)
+        peer.chaincode_support.register("bench", KV())
+        peer.channel(channel).define_chaincode(
+            ChaincodeDefinition(name="bench"))
+        peers[org_name] = peer
+
+    user_msp = local_msp(
+        os.path.join(org1, "users", "User1@org1.example.com", "msp"),
+        "Org1MSP")
+    gw = Gateway(peers["org1"], broadcast,
+                 user_msp.get_default_signing_identity())
+
+    endorsing = list(peers.values())[:endorsements]
+
+    print("pipeline: network up; endorsing", flush=True)
+    # ---- endorse everything first (CPU signing work, untimed) ----
+    t0 = time.perf_counter()
+    envs = [gw.endorse(channel, "bench",
+                       [b"put", f"k{i}".encode(), f"v{i}".encode()],
+                       endorsing_peers=endorsing)[0]
+            for i in range(ntxs)]
+    endorse_s = time.perf_counter() - t0
+
+    print(f"pipeline: endorsed {ntxs} in {endorse_s:.1f}s; ordering",
+          flush=True)
+    # ---- order through raft into one block ----
+    t0 = time.perf_counter()
+    for env in envs:
+        gw.submit(env)
+    chain = registrar.get_chain(channel)
+    deadline = time.monotonic() + 60
+    while True:
+        blocks = [chain.ledger.block_store.get_block_by_number(n)
+                  for n in range(1, chain.ledger.height)]
+        done = (all(b is not None for b in blocks) and
+                sum(len(b.data.data) for b in blocks
+                    if b is not None) >= ntxs)
+        if done:
+            break
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"ordering stalled at height {chain.ledger.height}")
+        time.sleep(0.05)
+    order_s = time.perf_counter() - t0
+    data_blocks = [b for b in blocks if b.data.data]
+    nsigs = ntxs * (endorsements + 1)
+
+    print(f"pipeline: ordered in {order_s:.1f}s; validating", flush=True)
+    # ---- peer-side pipeline: validate (repeatable) + commit (once) ----
+    out: dict = {
+        "ntxs": ntxs, "endorsements_per_tx": endorsements,
+        "signatures": nsigs, "endorse_s": round(endorse_s, 2),
+        "order_raft_s": round(order_s, 2),
+        "blocks": len(data_blocks),
+    }
+    for org_name, peer in peers.items():
+        ch = peer.channel(channel)
+        label = "tpu_peer" if org_name == "org1" else "sw_peer"
+        # warm (compiles on the tpu peer), then best-of-3 validation
+        for b in data_blocks:
+            flags = ch.validator.validate(b)
+            assert all(f == txpb.TxValidationCode.VALID for f in flags), \
+                f"{label}: invalid flags {set(flags)}"
+        best = None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for b in data_blocks:
+                ch.validator.validate(b)
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        t0 = time.perf_counter()
+        for b in data_blocks:
+            codes = ch.process_block(b)
+            assert all(c == txpb.TxValidationCode.VALID for c in codes)
+        commit_s = time.perf_counter() - t0
+        out[label] = {
+            "validate_s": round(best, 4),
+            "validate_tx_per_s": round(ntxs / best, 1),
+            "validate_sigs_per_s": round(nsigs / best, 1),
+            "process_block_s": round(commit_s, 4),
+            "commit_tx_per_s": round(ntxs / commit_s, 1),
+        }
+    registrar.halt()
+    transport.close()
+    return out
+
+
+if __name__ == "__main__":
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fabric_tpu.bccsp import factory
+    from fabric_tpu.common import jaxenv
+
+    jaxenv.enable_compilation_cache()
+    prov = factory.new_bccsp(factory.FactoryOpts.from_config(
+        {"Default": "TPU", "TPU": {"MinBatch": 16}}))
+    print(json.dumps(run(prov, ntxs=int(
+        os.environ.get("BENCH_E2E_TXS", "1024")))))
