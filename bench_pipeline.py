@@ -67,7 +67,10 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
         "Orderer": {
             "OrdererType": "etcdraft",
             "Addresses": [orderer_ep],
-            "BatchTimeout": "500ms",
+            # long timeout: submission of a full 10k-tx block takes
+            # seconds; the cutter must cut on COUNT (one block), not
+            # mid-submission timeouts
+            "BatchTimeout": "30s",
             # bytes limits sized so MaxMessageCount governs: the point
             # is ONE ntxs-transaction block through the validator
             # (config 3's shape), not the blockcutter's byte policy

@@ -118,13 +118,27 @@ class Discovery:
 
     def _emit_alive(self) -> None:
         alive = self._next_alive()
-        for endpoint in self._sample_endpoints(self.cfg.fanout):
+        targets = self._sample_endpoints(self.cfg.fanout)
+        for endpoint in targets:
             self._send(endpoint, alive)
-        # keep probing a few dead peers for resurrection
+        # keep probing dead peers for resurrection — ROTATED so every
+        # dead peer is eventually probed (a fixed prefix starved the
+        # third+ entries: after a full partition heals, a peer that
+        # never lands in the prefix stays invisible forever — the
+        # round-2/3 reconciler flake)
         with self._lock:
-            dead = [m.member.endpoint for m in self._dead.values()][:2]
-        for endpoint in dead:
-            self._send(endpoint, alive)
+            dead = [m.member.endpoint for m in self._dead.values()]
+        if dead:
+            start = self._seq % len(dead)
+            for endpoint in (dead[start:] + dead[:start])[:2]:
+                self._send(endpoint, alive)
+        # periodic pull: a membership request to one alive peer per
+        # round repairs one-sided views (the reference's pull-based
+        # membership sync — without it, two peers that expired each
+        # other relied on direct probe luck to reconnect)
+        if targets:
+            self._send(targets[self._seq % len(targets)],
+                       self._membership_request())
 
     def _sample_endpoints(self, n: int) -> list[str]:
         with self._lock:

@@ -37,6 +37,12 @@ class PrivDataProvider:
         self._gchannel.on_pvt_push = self._on_push
         self._gchannel.on_pvt_request = self._on_request
         self._gchannel.on_pvt_response = self._on_response
+        # reconciliation observability: every dropped request is a
+        # debugging dead-end without these (the round-3 flake hunt)
+        self.stats = {"req_received": 0, "req_unknown_requester": 0,
+                      "req_sig_failed": 0, "req_served": 0,
+                      "req_no_data": 0, "res_committed": 0,
+                      "res_rejected": 0, "reconcile_requests": 0}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -145,6 +151,7 @@ class PrivDataProvider:
             d.block_seq = m.block_num
             d.seq_in_block = m.tx_num
             smsg = gmsg.sign_message(msg, self._node.signer)
+            self.stats["reconcile_requests"] += 1
             self._node.send_endpoint(endpoints[sent % len(endpoints)],
                                      smsg)
             sent += 1
@@ -157,22 +164,30 @@ class PrivDataProvider:
         # identity so the decision binds to a VERIFIED identity, not
         # the spoofable sender-endpoint claim (reference ties this to
         # the mTLS connection; gossip requests here are signed).
+        self.stats["req_received"] += 1
         requester = None
         for m in self._node.discovery.alive_members():
             if m.member.endpoint == sender:
                 requester = m
                 break
-        if requester is not None and requester.identity and \
-                smsg is not None:
+        if requester is None or not requester.identity:
+            # cannot authorize an unknown requester; it will retry
+            # after membership sync catches up
+            self.stats["req_unknown_requester"] += 1
+            logger.info("[%s] pvt-data request from %s: requester not "
+                        "in membership view yet; dropping",
+                        self.channel_id, sender)
+            return
+        if smsg is not None:
             if not self._node.mcs.verify_by_channel(
                     self.channel_id, requester.identity,
                     smsg.signature, smsg.payload):
+                self.stats["req_sig_failed"] += 1
                 logger.warning(
                     "[%s] pvt-data request from %s failed signature "
                     "verification; dropping", self.channel_id, sender)
                 return
-        req_org = self._org_of(requester.identity) \
-            if requester is not None and requester.identity else None
+        req_org = self._org_of(requester.identity)
         out = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
         self._gchannel._tag_channel(out)
         ledger = self._peer_channel.ledger
@@ -194,7 +209,10 @@ class PrivDataProvider:
                     el.digest.CopyFrom(d)
                     el.payload.append(cpvt.rwset)
         if out.private_res.elements:
+            self.stats["req_served"] += 1
             self._node.send_endpoint(sender, gmsg.unsigned(out))
+        else:
+            self.stats["req_no_data"] += 1
 
     def _on_response(self, sender: str, msg: gpb.GossipMessage) -> None:
         ledger = self._peer_channel.ledger
@@ -204,6 +222,8 @@ class PrivDataProvider:
                     el.digest.block_seq, el.digest.seq_in_block,
                     el.digest.namespace, el.digest.collection,
                     bytes(payload))
+                self.stats["res_committed" if ok
+                           else "res_rejected"] += 1
                 if ok:
                     logger.info("[%s] reconciled pvt data for block %d "
                                 "tx %d [%s/%s]", self.channel_id,

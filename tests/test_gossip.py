@@ -372,7 +372,7 @@ def gossip_net(tmp_path_factory):
                                org_id=mspid,
                                config=DiscoveryConfig(
                                    alive_interval_s=0.2,
-                                   alive_expiration_s=3.0, fanout=4))
+                                   alive_expiration_s=6.0, fanout=4))
             peer.gossip_service = gs
             gs.start(bootstrap=["peer0.org1.example.com:7051"])
             gs.initialize_channel(
@@ -443,7 +443,22 @@ class TestGossipEndToEnd:
             return sum(1 for gs in gossip_net["services"]
                        for r in gs._channels.values()
                        if r.deliverer is not None)
-        assert _wait(lambda: count() == 1, timeout=15), count()
+        assert _wait(lambda: count() == 1, timeout=15), {
+            "deliverers": [
+                gs.node.endpoint for gs in gossip_net["services"]
+                for r in gs._channels.values()
+                if r.deliverer is not None],
+            "views": {
+                gs.node.endpoint: {
+                    "is_leader": r.election.is_leader,
+                    "leader": (r.election.leader or b"").hex()[:8],
+                    "alive": sorted(
+                        m.member.endpoint for m in
+                        gs.node.discovery.alive_members()),
+                }
+                for gs in gossip_net["services"]
+                for r in gs._channels.values()},
+        }
 
     def test_reconciler_backfills_late_peer(self, gossip_net):
         """A peer partitioned during endorsement misses the pvt push;
@@ -479,4 +494,21 @@ class TestGossipEndToEnd:
                 return True
             provider.reconcile_once()
             return False
-        assert _wait(reconciled, timeout=90, step=0.5)
+        led = late_peer.channel(CHANNEL).ledger
+        assert _wait(reconciled, timeout=90, step=0.5), {
+            "height": led.height,
+            "missing": [(m.block_num, m.tx_num, m.namespace,
+                         m.collection)
+                        for m in led.missing_pvt_data(16)],
+            "members": [m.member.endpoint
+                        for m in late_gs.node.channel(CHANNEL)
+                        .members()],
+            "alive": [m.member.endpoint for m in
+                      late_gs.node.discovery.alive_members()],
+            "late_stats": dict(provider.stats),
+            "peer_stats": {
+                gs.node.endpoint: dict(
+                    gs._channels[CHANNEL].privdata.stats)
+                for gs in gossip_net["services"]
+                if gs.node.endpoint != late},
+        }
