@@ -156,7 +156,7 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     for env in envs:
         gw.submit(env)
     chain = registrar.get_chain(channel)
-    deadline = time.monotonic() + 60
+    deadline = time.monotonic() + 150
     while True:
         blocks = [chain.ledger.block_store.get_block_by_number(n)
                   for n in range(1, chain.ledger.height)]
