@@ -152,9 +152,20 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     print(f"pipeline: endorsed {ntxs} in {endorse_s:.1f}s; ordering",
           flush=True)
     # ---- order through raft into one block ----
+    from fabric_tpu.protos import common as cpb
     t0 = time.perf_counter()
     for env in envs:
-        gw.submit(env)
+        # check + retry: the raft chain rejects with SERVICE_UNAVAILABLE
+        # while still electing; a dropped envelope would leave the
+        # block short and the count-based cut waiting forever
+        deadline0 = time.monotonic() + 30
+        while True:
+            resp = broadcast.process_message(env)
+            if resp.status == cpb.Status.SUCCESS:
+                break
+            if time.monotonic() > deadline0:
+                raise RuntimeError(f"broadcast rejected: {resp.status}")
+            time.sleep(0.05)
     chain = registrar.get_chain(channel)
     deadline = time.monotonic() + 150
     while True:
