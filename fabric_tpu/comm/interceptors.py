@@ -9,12 +9,103 @@ operations metrics (`grpc_server_unary_requests_completed` etc.).
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Optional
 
 import grpc
 
 logger = logging.getLogger("comm.grpc")
+
+
+def _split_method(full_method: str) -> tuple[str, str]:
+    """'/ftpu.Endorser/ProcessProposal' → (service, method)."""
+    parts = full_method.rsplit("/", 2)
+    return (parts[-2] if len(parts) >= 2 else "?"), parts[-1]
+
+
+def _abort_code(context) -> str:
+    """The status a handler set via context.abort/set_code, if any
+    (grpc Python surfaces aborts as bare exceptions — the real code
+    lives on the servicer context state)."""
+    code = getattr(getattr(context, "_state", None), "code", None)
+    return code.name if code is not None else "INTERNAL"
+
+
+class ConcurrencyLimiter(grpc.ServerInterceptor):
+    """Per-service concurrency caps.
+
+    Rebuild of `internal/peer/node/grpc_limiters.go:19-75`: a semaphore
+    per service name; requests over the cap are rejected immediately
+    (TryAcquire semantics — no queueing) and the slot is held for the
+    full handler duration, including the whole life of a server stream.
+    Divergence: rejections carry RESOURCE_EXHAUSTED rather than the
+    reference's untyped error (which gRPC maps to UNKNOWN).
+    """
+
+    def __init__(self, limits: dict[str, int]):
+        self._limits = {svc: n for svc, n in limits.items()
+                        if n and n > 0}
+        self._sems = {svc: threading.BoundedSemaphore(n)
+                      for svc, n in self._limits.items()}
+        for svc, n in self._limits.items():
+            logger.info("concurrency limit for %s is %d", svc, n)
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None:
+            return None
+        service, _ = _split_method(handler_call_details.method)
+        sema = self._sems.get(service)
+        if sema is None:
+            return handler
+        limit = self._limits[service]
+
+        def reject(context):
+            logger.error(
+                "Too many requests for %s, exceeding concurrency "
+                "limit (%d)", service, limit)
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                f"too many requests for {service}, exceeding "
+                f"concurrency limit ({limit})")
+
+        def wrap_unary(fn):
+            def inner(request, context):
+                if not sema.acquire(blocking=False):
+                    reject(context)
+                try:
+                    return fn(request, context)
+                finally:
+                    sema.release()
+            return inner
+
+        def wrap_stream(fn):
+            def inner(request, context):
+                if not sema.acquire(blocking=False):
+                    reject(context)
+                try:
+                    yield from fn(request, context)
+                finally:
+                    sema.release()
+            return inner
+
+        if handler.unary_unary:
+            return grpc.unary_unary_rpc_method_handler(
+                wrap_unary(handler.unary_unary),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        if handler.unary_stream:
+            return grpc.unary_stream_rpc_method_handler(
+                wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        if handler.stream_stream:
+            return grpc.stream_stream_rpc_method_handler(
+                wrap_stream(handler.stream_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        return handler
 
 
 class ServerObservability(grpc.ServerInterceptor):
@@ -39,9 +130,7 @@ class ServerObservability(grpc.ServerInterceptor):
         handler = continuation(handler_call_details)
         if handler is None:
             return None
-        parts = handler_call_details.method.rsplit("/", 2)
-        service = parts[-2] if len(parts) >= 2 else "?"
-        method = parts[-1]
+        service, method = _split_method(handler_call_details.method)
         outer = self
 
         def wrap_unary(fn):
@@ -51,7 +140,10 @@ class ServerObservability(grpc.ServerInterceptor):
                 try:
                     return fn(request, context)
                 except Exception:
-                    code = "INTERNAL"
+                    # an abort carries its real status (e.g. the
+                    # limiter's RESOURCE_EXHAUSTED); only an
+                    # unhandled handler error is INTERNAL
+                    code = _abort_code(context)
                     raise
                 finally:
                     outer._observe(service, method, code,
@@ -65,7 +157,7 @@ class ServerObservability(grpc.ServerInterceptor):
                 try:
                     yield from fn(request, context)
                 except Exception:
-                    code = "INTERNAL"
+                    code = _abort_code(context)
                     raise
                 finally:
                     outer._observe(service, method, code,

@@ -36,6 +36,9 @@ class ServerConfig:
     max_workers: int = 32
     max_message_mb: int = 100
     metrics_provider: object = None       # enables RPC logging/metrics
+    # service name → max concurrent requests (0/absent = unlimited);
+    # reference: peer.limits.concurrency.* via grpc_limiters.go
+    concurrency_limits: Optional[dict] = None
 
 
 class GRPCServer:
@@ -47,12 +50,18 @@ class GRPCServer:
             ("grpc.max_receive_message_length",
              config.max_message_mb * 1024 * 1024),
         ]
-        from fabric_tpu.comm.interceptors import ServerObservability
+        from fabric_tpu.comm.interceptors import (
+            ConcurrencyLimiter,
+            ServerObservability,
+        )
+        interceptors = [ServerObservability(config.metrics_provider)]
+        if config.concurrency_limits:
+            interceptors.append(
+                ConcurrencyLimiter(config.concurrency_limits))
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=config.max_workers),
             options=opts,
-            interceptors=(ServerObservability(
-                config.metrics_provider),))
+            interceptors=tuple(interceptors))
         if config.tls_cert:
             require_auth = config.client_root_cas is not None
             creds = grpc.ssl_server_credentials(

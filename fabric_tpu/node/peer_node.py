@@ -149,8 +149,19 @@ class PeerNode:
                                                 f.read())
         diag.capture_thread_dumps_on_signal()
 
-        # gRPC server
-        sc = ServerConfig(address=address, metrics_provider=provider)
+        # gRPC server (+ per-service concurrency caps —
+        # reference internal/peer/node/grpc_limiters.go, keys
+        # peer.limits.concurrency.* in core.yaml:473-485)
+        limits = {}
+        for key, svc in (
+                ("endorserService", comm_services.ENDORSER_SERVICE),
+                ("deliverService", comm_services.DELIVER_SERVICE),
+                ("gatewayService", comm_services.GATEWAY_SERVICE)):
+            n = int(cfg.get(f"peer.limits.concurrency.{key}", 0) or 0)
+            if n > 0:
+                limits[svc] = n
+        sc = ServerConfig(address=address, metrics_provider=provider,
+                          concurrency_limits=limits or None)
         tls_cert = cfg.get_path("peer.tls.cert.file")
         if cfg.get_bool("peer.tls.enabled") and tls_cert:
             sc.tls_cert = open(tls_cert, "rb").read()
