@@ -63,8 +63,28 @@ class PrivDataProvider:
         definition = self._peer_channel.chaincode_definition(ns)
         return definition.collection(coll) if definition else None
 
+    def _collection_config_at(self, ns: str, coll: str,
+                              block_num: int):
+        """The collection config that governed `ns.coll` AT
+        `block_num` — a chaincode upgrade must not rewrite the
+        eligibility/BTL of older gaps. Resolved through the ledger's
+        confighistory (reference reconciler: MostRecentCollectionConfigBelow,
+        `gossip/privdata/reconcile.go` + `core/ledger/confighistory/mgr.go`);
+        falls back to the current definition when no history entry is
+        below the block (definition committed in that very block, or
+        pre-history ledgers)."""
+        hist = getattr(self._peer_channel.ledger, "config_history",
+                       None)
+        if hist is not None:
+            found = hist.most_recent_below(ns, block_num)
+            if found is not None:
+                return found[1].collection(coll)
+        return self._collection_config(ns, coll)
+
     def _member_endpoints(self, ns: str, coll: str) -> list[str]:
-        cfg = self._collection_config(ns, coll)
+        return self._endpoints_for(self._collection_config(ns, coll))
+
+    def _endpoints_for(self, cfg) -> list[str]:
         if cfg is None:
             return []
         out = []
@@ -137,10 +157,14 @@ class PrivDataProvider:
         missing = ledger.missing_pvt_data(max_entries=64)
         sent = 0
         for m in missing:
-            if not self._i_am_member(m.namespace, m.collection):
+            # eligibility under the config that governed the gap's own
+            # block, not today's (confighistory; see
+            # _collection_config_at)
+            cfg = self._collection_config_at(m.namespace, m.collection,
+                                             m.block_num)
+            if cfg is None or self._node.org_id not in cfg.member_orgs:
                 continue
-            endpoints = self._member_endpoints(m.namespace,
-                                               m.collection)
+            endpoints = self._endpoints_for(cfg)
             if not endpoints:
                 continue
             msg = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
@@ -192,7 +216,13 @@ class PrivDataProvider:
         self._gchannel._tag_channel(out)
         ledger = self._peer_channel.ledger
         for d in msg.private_req.digests:
-            cfg = self._collection_config(d.namespace, d.collection)
+            # authorize under the config that governed the requested
+            # block — the SAME rule the requester applies, so both
+            # sides of a membership-changing upgrade agree (an org
+            # removed later may still fetch its historical gaps; an
+            # org added later is not granted the old cleartext)
+            cfg = self._collection_config_at(d.namespace, d.collection,
+                                             d.block_seq)
             if cfg is None or req_org not in cfg.member_orgs:
                 continue
             stored = ledger.get_pvt_data_by_num(d.block_seq,

@@ -25,8 +25,12 @@ from fabric_tpu.ledger.kvdb import DBHandle, KVStore
 logger = must_get_logger("nodeops")
 
 # keyspaces derived from the block store (rebuilt by replay on start)
-_DERIVED = ("statedb", "historydb", "pvtstore", "blkindex")
-_REBUILD_ONLY = ("statedb", "historydb")
+_DERIVED = ("statedb", "historydb", "confighist", "pvtstore",
+            "blkindex")
+# droppable + rebuilt by replay: dropping statedb resets the savepoint,
+# so the next open replays every block — re-running MVCC, history and
+# the state listeners (which rebuild confighist)
+_REBUILD_ONLY = ("statedb", "historydb", "confighist")
 
 
 def _channels(ledger_root: str) -> list[str]:

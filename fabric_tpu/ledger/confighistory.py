@@ -1,0 +1,132 @@
+"""Collection-config history — a reconciliation-grade store.
+
+Rebuild of `core/ledger/confighistory/{mgr,db_helper}.go`: a state
+listener that, whenever a block commits an updated chaincode definition
+carrying an explicit collection-config package, persists that package
+keyed `(namespace, committing block)`. The private-data reconciler asks
+`most_recent_below(ns, block)` to learn which collection config — BTL,
+member orgs — governed a missing-data entry AT ITS OWN HEIGHT rather
+than today's (a chaincode upgrade must not rewrite the eligibility of
+old gaps). The history is exported into ledger snapshots and rebuilt on
+import, mirroring `mgr.go ExportConfigHistory/ImportFromSnapshot`.
+
+Storage: one keyspace in the ledger's KV store. Key =
+`ns \\x00 inverted(block)` where `inverted = 2^64-1 - block`, so a
+forward iteration from `(ns, inverted(block-1))` yields entries in
+DESCENDING block order and the first hit IS the most recent config
+strictly below `block` (reference `db_helper.go mostRecentEntryBelow`).
+Value = the committed canonical definition JSON (which embeds the
+collection configs — the analog of `peer.CollectionConfigPackage`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Optional
+
+from fabric_tpu.ledger.kvdb import DBHandle
+
+DATA_FILE = "confighistory.data"
+
+_SEP = b"\x00"
+_INV = 0xFFFFFFFFFFFFFFFF
+
+
+def _key(ns: str, block_num: int) -> bytes:
+    return ns.encode() + _SEP + struct.pack(">Q", _INV - block_num)
+
+
+def _unkey(raw: bytes) -> tuple[str, int]:
+    # fixed layout: ns + SEP + 8-byte inverted block (the inverted
+    # block bytes may themselves contain \x00 — no splitting on SEP)
+    ns, inv = raw[:-9], raw[-8:]
+    return ns.decode(), _INV - struct.unpack(">Q", inv)[0]
+
+
+class ConfigHistoryMgr:
+    """Reference: `confighistory.Mgr` (`mgr.go:37-112`)."""
+
+    # the lifecycle namespace whose writes define chaincodes
+    # (reference: ccInfoProvider.Namespaces() → "lscc"/"_lifecycle")
+    def __init__(self, db: DBHandle):
+        self._db = db
+
+    def interested_in_namespaces(self) -> tuple[str, ...]:
+        from fabric_tpu.core.scc import lifecycle as lc
+        return (lc.NAMESPACE,)
+
+    def handle_state_updates(self, block_num: int, updates) -> None:
+        """`updates`: {(ns, key) → VersionedValue|None} — the committed
+        public write-set of one block (reference HandleStateUpdates,
+        `mgr.go:76-112`). Persists each updated chaincode definition
+        that carries an explicit (non-empty) collection config."""
+        from fabric_tpu.core.scc import lifecycle as lc
+        for (ns, key), vv in updates.items():
+            if ns != lc.NAMESPACE or vv is None or \
+                    not key.startswith(lc._DEF_PREFIX):
+                continue
+            try:
+                d = json.loads(vv.value)
+            except (ValueError, TypeError):
+                continue
+            # reference: skip definitions without explicit collections
+            if not d.get("collections"):
+                continue
+            cc_name = key[len(lc._DEF_PREFIX):]
+            self._db.put(_key(cc_name, block_num), vv.value)
+
+    def most_recent_below(self, ns: str, block_num: int
+                          ) -> Optional[tuple[int, object]]:
+        """(committing_block, ChaincodeDefinition) of the most recent
+        collection config committed STRICTLY below `block_num`, or
+        None (reference `MostRecentCollectionConfigBelow`)."""
+        if block_num <= 0:
+            return None
+        from fabric_tpu.core.scc import lifecycle as lc
+        start = _key(ns, block_num - 1)
+        end = ns.encode() + _SEP + b"\xff" * 8 + b"\xff"
+        for raw_key, raw_val in self._db.iterate(start=start, end=end):
+            got_ns, blk = _unkey(raw_key)
+            if got_ns != ns:
+                break
+            return blk, lc.definition_from_state(raw_val)
+        return None
+
+    # -- snapshot participation (reference mgr.go ExportConfigHistory /
+    #    ImportFromSnapshot) --
+
+    def export_snapshot(self, out_dir: str) -> Optional[str]:
+        """Write every entry to `confighistory.data`; returns the file
+        path, or None when the history is empty (reference: no files
+        are produced for an empty history)."""
+        rows = list(self._db.iterate())
+        if not rows:
+            return None
+        path = os.path.join(out_dir, DATA_FILE)
+        with open(path, "wb") as f:
+            for k, v in rows:
+                f.write(struct.pack(">I", len(k)) + k)
+                f.write(struct.pack(">I", len(v)) + v)
+        return path
+
+    def import_from_snapshot(self, snapshot_dir: str) -> int:
+        path = os.path.join(snapshot_dir, DATA_FILE)
+        if not os.path.exists(path):
+            return 0   # ledger never had a collection config
+        n = 0
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(4)
+                if not hdr:
+                    break
+                k = f.read(struct.unpack(">I", hdr)[0])
+                vlen = struct.unpack(">I", f.read(4))[0]
+                self._db.put(k, f.read(vlen))
+                n += 1
+        return n
+
+    def entries(self) -> list[tuple[str, int]]:
+        """(namespace, committing_block) pairs, for observability."""
+        return [_unkey(k) for k, _ in self._db.iterate()]

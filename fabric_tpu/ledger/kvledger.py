@@ -86,11 +86,24 @@ class KVLedger:
             DBHandle(self._kv, "snapshotreq"))
         self._meta = DBHandle(self._kv, "ledgermeta")
 
+        # collection-config history: a state listener over the commit
+        # path (reference core/ledger/confighistory — registered as a
+        # ledger.StateListener on the lifecycle namespaces)
+        from fabric_tpu.ledger.confighistory import ConfigHistoryMgr
+        self.config_history = ConfigHistoryMgr(
+            DBHandle(self._kv, "confighist"))
+        self._state_listeners = [self.config_history]
+
         self._check_data_format()
         self._recover_dbs()
         self._commit_hash = self._load_commit_hash()
 
-    DATA_FORMAT = b"2.0"   # bump when derived-DB encodings change
+    # bump when derived-DB encodings change
+    # 2.1: confighist keyspace added (rebuilt from block replay by
+    #      `peer node upgrade-dbs` — without the bump an existing
+    #      ledger would silently serve an EMPTY config history and
+    #      resolve historical private-data gaps under today's configs)
+    DATA_FORMAT = b"2.1"
 
     def _check_data_format(self) -> None:
         """Refuse to serve data written in an older derived-DB format
@@ -288,6 +301,12 @@ class KVLedger:
         # the reverse order would permanently lose block N's history
         if batch is not None:
             self.history_db.commit_block(block, codes)
+            # listeners BEFORE the savepoint advances: a crash in
+            # between is healed by replay re-notifying (idempotent
+            # writes); the reverse order would lose block N's
+            # confighistory forever (recovery starts above the
+            # savepoint)
+            self._notify_state_listeners(block_num, batch)
             self.state_db.apply_updates(batch,
                                         Height(block_num, max(n - 1, 0)))
             # bookkeeping for purged entries is dropped only AFTER the
@@ -338,11 +357,28 @@ class KVLedger:
             if stored is not None:
                 pvt_data[tx_num] = stored
         self._commit_pvt_data(block_num, rwsets, codes, pvt_data, batch)
-        # same history-before-savepoint ordering as commit_block
+        # same history/listener-before-savepoint ordering as
+        # commit_block
         self.history_db.commit_block(block, codes)
+        self._notify_state_listeners(block_num, batch)
         self.state_db.apply_updates(
             batch, Height(block_num, max(len(rwsets) - 1, 0)))
         self._drop_expired_bookkeeping(block_num)
+
+    def _notify_state_listeners(self, block_num: int,
+                                batch: UpdateBatch) -> None:
+        """Reference: ledger.StateListener.HandleStateUpdates invoked
+        with the block's committed public updates (kv_ledger commit →
+        confighistory.Mgr). Runs before the statedb savepoint advances
+        and PROPAGATES failures (reference semantics: a listener error
+        fails the commit) — crash recovery then replays the block and
+        re-notifies; listener writes are idempotent."""
+        for listener in self._state_listeners:
+            interest = listener.interested_in_namespaces()
+            updates = {k: v for k, v in batch.updates.items()
+                       if k[0] in interest}
+            if updates:
+                listener.handle_state_updates(block_num, updates)
 
     # -- private data commit (reference: commitToPvtAndBlockStore +
     #    pvtdatastorage Commit + expiry keeper) --

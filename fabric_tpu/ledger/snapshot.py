@@ -98,6 +98,11 @@ def generate_snapshot(ledger, out_dir: str) -> dict:
     with open(cfg_path, "wb") as f:
         f.write(cfg_block.SerializeToString())
 
+    # collection-config history rides along so a joining peer can
+    # reconcile old private data under the config that governed it
+    # (reference confighistory mgr.go ExportConfigHistory)
+    confighist_path = ledger.config_history.export_snapshot(out_dir)
+
     meta = {
         # record arity of public_state.data: "2.0" = 5 fields
         # (ns, key, version, value, metadata); absent = the 4-field
@@ -114,6 +119,9 @@ def generate_snapshot(ledger, out_dir: str) -> dict:
             CONFIG_FILE: _file_hash(cfg_path),
         },
     }
+    if confighist_path is not None:
+        from fabric_tpu.ledger.confighistory import DATA_FILE
+        meta["files"][DATA_FILE] = _file_hash(confighist_path)
     with open(os.path.join(out_dir, METADATA_FILE), "w") as f:
         json.dump(meta, f, indent=2, sort_keys=True)
     return meta
@@ -164,6 +172,7 @@ def import_into(ledger, snapshot_dir: str) -> None:
     ledger.state_db.apply_updates(batch, Height(last_num, 0))
     with open(os.path.join(snapshot_dir, CONFIG_FILE), "rb") as f:
         ledger.adopt_bootstrap_config_block(f.read())
+    ledger.config_history.import_from_snapshot(snapshot_dir)
     ledger.adopt_commit_hash(bytes.fromhex(meta["commit_hash"]),
                              bootstrap_block=last_num)
 

@@ -1,0 +1,222 @@
+"""Collection-config history store.
+
+Reference: `core/ledger/confighistory/{mgr,db_helper}.go` — a state
+listener persisting each committed chaincode definition that carries
+collections, keyed (namespace, committing block); queried by the
+private-data reconciler via MostRecentCollectionConfigBelow; exported
+into and imported from ledger snapshots.
+"""
+
+import json
+
+import pytest
+
+from fabric_tpu import protoutil as pu
+from fabric_tpu.core.scc import lifecycle as lc
+from fabric_tpu.ledger import KVLedger
+from fabric_tpu.ledger.confighistory import ConfigHistoryMgr, _key, _unkey
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.ledger.statedb import Height, VersionedValue
+
+from tests.test_ledger import append_block, make_tx_envelope
+
+
+def _definition(name, colls, sequence=1):
+    return lc.canonical_definition({
+        "name": name, "sequence": sequence,
+        "collections": colls,
+    })
+
+
+def _vv(value, block=1):
+    return VersionedValue(value, Height(block, 0), b"")
+
+
+@pytest.fixture()
+def mgr(tmp_path):
+    kv = KVStore(str(tmp_path / "ch.db"))
+    yield ConfigHistoryMgr(DBHandle(kv, "confighist"))
+    kv.close()
+
+
+COLL_A = [{"name": "secrets", "member_orgs": ["Org1MSP"],
+           "block_to_live": 10}]
+COLL_B = [{"name": "secrets", "member_orgs": ["Org1MSP", "Org2MSP"],
+           "block_to_live": 0}]
+
+
+class TestKeyCodec:
+    def test_roundtrip_blocks_with_zero_bytes_in_inverted(self):
+        # inverted(2^64-1 - b) contains \x00 bytes for many b values;
+        # decoding must not split on them
+        for blk in (0, 1, 255, 256, 2**32, 2**40 - 1):
+            ns, got = _unkey(_key("mycc", blk))
+            assert (ns, got) == ("mycc", blk)
+
+    def test_descending_order_per_namespace(self):
+        keys = [_key("cc", b) for b in (5, 9, 200)]
+        assert sorted(keys) == [_key("cc", 200), _key("cc", 9),
+                                _key("cc", 5)]
+
+
+class TestMgr:
+    def test_records_only_definitions_with_collections(self, mgr):
+        mgr.handle_state_updates(4, {
+            ("_lifecycle", "namespaces/mycc"):
+                _vv(_definition("mycc", COLL_A)),
+            ("_lifecycle", "namespaces/plain"):
+                _vv(_definition("plain", [])),
+            ("_lifecycle", "unrelated/key"): _vv(b"{}"),
+            ("othercc", "namespaces/x"): _vv(b"{}"),
+        })
+        assert mgr.entries() == [("mycc", 4)]
+
+    def test_most_recent_below_picks_governing_config(self, mgr):
+        mgr.handle_state_updates(4, {
+            ("_lifecycle", "namespaces/mycc"):
+                _vv(_definition("mycc", COLL_A))})
+        mgr.handle_state_updates(9, {
+            ("_lifecycle", "namespaces/mycc"):
+                _vv(_definition("mycc", COLL_B, sequence=2))})
+        # a gap at block 6 is governed by the block-4 config (BTL 10)
+        blk, d = mgr.most_recent_below("mycc", 6)
+        assert blk == 4
+        assert d.collection("secrets").block_to_live == 10
+        assert d.collection("secrets").member_orgs == ("Org1MSP",)
+        # a gap at block 12 sees the upgraded config
+        blk, d = mgr.most_recent_below("mycc", 12)
+        assert blk == 9
+        assert d.collection("secrets").member_orgs == \
+            ("Org1MSP", "Org2MSP")
+        # strictly below: the config committed AT block 4 does not
+        # govern block 4 itself
+        assert mgr.most_recent_below("mycc", 4) is None
+        assert mgr.most_recent_below("mycc", 0) is None
+        assert mgr.most_recent_below("nope", 100) is None
+
+    def test_namespaces_do_not_bleed(self, mgr):
+        mgr.handle_state_updates(3, {
+            ("_lifecycle", "namespaces/cc"):
+                _vv(_definition("cc", COLL_A))})
+        mgr.handle_state_updates(5, {
+            ("_lifecycle", "namespaces/cc2"):
+                _vv(_definition("cc2", COLL_B))})
+        blk, d = mgr.most_recent_below("cc", 100)
+        assert (blk, d.name) == (3, "cc")
+        blk, d = mgr.most_recent_below("cc2", 100)
+        assert (blk, d.name) == (5, "cc2")
+
+    def test_undecodable_definition_skipped(self, mgr):
+        mgr.handle_state_updates(2, {
+            ("_lifecycle", "namespaces/bad"): _vv(b"\xff not json")})
+        assert mgr.entries() == []
+
+    def test_snapshot_roundtrip(self, mgr, tmp_path):
+        mgr.handle_state_updates(4, {
+            ("_lifecycle", "namespaces/mycc"):
+                _vv(_definition("mycc", COLL_A))})
+        mgr.handle_state_updates(9, {
+            ("_lifecycle", "namespaces/mycc"):
+                _vv(_definition("mycc", COLL_B, sequence=2))})
+        out = str(tmp_path / "snap")
+        import os
+        os.makedirs(out)
+        assert mgr.export_snapshot(out) is not None
+
+        kv2 = KVStore(str(tmp_path / "fresh.db"))
+        mgr2 = ConfigHistoryMgr(DBHandle(kv2, "confighist"))
+        assert mgr2.import_from_snapshot(out) == 2
+        blk, d = mgr2.most_recent_below("mycc", 6)
+        assert blk == 4
+        assert d.collection("secrets").block_to_live == 10
+        kv2.close()
+
+    def test_empty_history_exports_nothing(self, mgr, tmp_path):
+        assert mgr.export_snapshot(str(tmp_path)) is None
+        # importing from a dir without the file is a no-op
+        assert mgr.import_from_snapshot(str(tmp_path)) == 0
+
+
+class TestLedgerWiring:
+    def test_commit_of_definition_records_history(self, tmp_path):
+        led = KVLedger("ch1", str(tmp_path / "ch1"))
+        genesis = pu.new_block(0, b"")
+        genesis.data.data.append(b"config-placeholder")
+        genesis.header.data_hash = pu.block_data_hash(genesis.data)
+        led.initialize_from_genesis(genesis)
+        try:
+            sim = led.new_tx_simulator()
+            sim.put_state("_lifecycle", "namespaces/mycc",
+                          _definition("mycc", COLL_A))
+            env, _ = make_tx_envelope("ch1", sim, cc="_lifecycle")
+            led.commit_block(append_block(led, [env]))
+            assert led.config_history.entries() == [("mycc", 1)]
+            # an invalid (flagged) tx's writes never reach the batch →
+            # no history either
+            sim2 = led.new_tx_simulator()
+            sim2.put_state("_lifecycle", "namespaces/other",
+                           _definition("other", COLL_B))
+            env2, _ = make_tx_envelope("ch1", sim2, cc="_lifecycle")
+            from fabric_tpu.protos import transaction as txpb
+            led.commit_block(
+                append_block(led, [env2]),
+                flags=[txpb.TxValidationCode.ENDORSEMENT_POLICY_FAILURE])
+            assert led.config_history.entries() == [("mycc", 1)]
+        finally:
+            led.close()
+
+    def test_upgrade_dbs_rebuilds_history_for_old_format(self,
+                                                         tmp_path):
+        """A pre-2.1 ledger holds committed definitions but an empty
+        confighist; the format gate forces `peer node upgrade-dbs`,
+        which drops the derived DBs so replay rebuilds the history
+        (reference: dataformat.CheckVersion + upgrade_dbs.go)."""
+        from fabric_tpu.internal import nodeops
+        from fabric_tpu.ledger.kvdb import DBHandle as DBH, KVStore
+        from fabric_tpu.ledger.kvledger import LedgerError
+
+        led = KVLedger("ch1", str(tmp_path / "ch1"))
+        genesis = pu.new_block(0, b"")
+        genesis.data.data.append(b"config-placeholder")
+        genesis.header.data_hash = pu.block_data_hash(genesis.data)
+        led.initialize_from_genesis(genesis)
+        sim = led.new_tx_simulator()
+        sim.put_state("_lifecycle", "namespaces/mycc",
+                      _definition("mycc", COLL_A))
+        env, _ = make_tx_envelope("ch1", sim, cc="_lifecycle")
+        led.commit_block(append_block(led, [env]))
+        led.close()
+
+        # simulate the ledger having been written by a 2.0 binary:
+        # restamp the format and wipe the confighist keyspace
+        kv = KVStore(str(tmp_path / "ch1" / "index.db"))
+        DBH(kv, "ledgermeta").put(b"datafmt", b"2.0")
+        nodeops._drop_keyspaces(kv, ("confighist",))
+        kv.close()
+
+        with pytest.raises(LedgerError, match="upgrade-dbs"):
+            KVLedger("ch1", str(tmp_path / "ch1"))
+        assert nodeops.upgrade_dbs(str(tmp_path)) == ["ch1"]
+        led2 = KVLedger("ch1", str(tmp_path / "ch1"))
+        try:
+            assert led2.config_history.entries() == [("mycc", 1)]
+        finally:
+            led2.close()
+
+    def test_recovery_replay_is_idempotent(self, tmp_path):
+        led = KVLedger("ch1", str(tmp_path / "ch1"))
+        genesis = pu.new_block(0, b"")
+        genesis.data.data.append(b"config-placeholder")
+        genesis.header.data_hash = pu.block_data_hash(genesis.data)
+        led.initialize_from_genesis(genesis)
+        sim = led.new_tx_simulator()
+        sim.put_state("_lifecycle", "namespaces/mycc",
+                      _definition("mycc", COLL_A))
+        env, _ = make_tx_envelope("ch1", sim, cc="_lifecycle")
+        led.commit_block(append_block(led, [env]))
+        led.close()
+        led2 = KVLedger("ch1", str(tmp_path / "ch1"))
+        try:
+            assert led2.config_history.entries() == [("mycc", 1)]
+        finally:
+            led2.close()
