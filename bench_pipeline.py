@@ -163,8 +163,14 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
             resp = broadcast.process_message(env)
             if resp.status == cpb.Status.SUCCESS:
                 break
+            if resp.status != cpb.Status.SERVICE_UNAVAILABLE:
+                # permanent rejection (BAD_REQUEST/FORBIDDEN/...):
+                # retrying cannot help — fail fast with the info string
+                raise RuntimeError(
+                    f"broadcast rejected: {resp.status} {resp.info}")
             if time.monotonic() > deadline0:
-                raise RuntimeError(f"broadcast rejected: {resp.status}")
+                raise RuntimeError(
+                    f"broadcast unavailable for 30s: {resp.info}")
             time.sleep(0.05)
     chain = registrar.get_chain(channel)
     deadline = time.monotonic() + 150
