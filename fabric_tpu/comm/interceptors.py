@@ -119,11 +119,15 @@ class ServerObservability(grpc.ServerInterceptor):
             self._m_completed = metrics_provider.new_counter(
                 m.CounterOpts(namespace="grpc", subsystem="server",
                               name="requests_completed",
+                              help="The number of gRPC requests "
+                                   "completed, by status code.",
                               label_names=("service", "method",
                                            "code")))
             self._m_duration = metrics_provider.new_histogram(
                 m.HistogramOpts(namespace="grpc", subsystem="server",
                                 name="request_duration",
+                                help="The time a gRPC request took "
+                                     "to complete.",
                                 label_names=("service", "method")))
 
     def intercept_service(self, continuation, handler_call_details):

@@ -24,21 +24,77 @@ def _status(code) -> ordpb.DeliverResponse:
     return ordpb.DeliverResponse(status=code)
 
 
+from fabric_tpu.common import metrics as _m
+
+STREAMS_OPENED = _m.CounterOpts(
+    namespace="deliver", name="streams_opened",
+    help="The number of deliver streams opened.")
+STREAMS_CLOSED = _m.CounterOpts(
+    namespace="deliver", name="streams_closed",
+    help="The number of deliver streams closed.")
+BLOCKS_SENT = _m.CounterOpts(
+    namespace="deliver", name="blocks_sent",
+    help="The number of blocks sent over deliver streams.",
+    label_names=("channel",))
+REQUESTS_COMPLETED = _m.CounterOpts(
+    namespace="deliver", name="requests_completed",
+    help="The number of deliver seek requests completed, by final "
+         "status.", label_names=("channel", "status"))
+
+
+class DeliverMetrics:
+    """Reference: `common/deliver/metrics.go`."""
+
+    def __init__(self, provider=None):
+        provider = provider or _m.DisabledProvider()
+        self.streams_opened = provider.new_counter(STREAMS_OPENED)
+        self.streams_closed = provider.new_counter(STREAMS_CLOSED)
+        self.blocks_sent = provider.new_counter(BLOCKS_SENT)
+        self.requests_completed = provider.new_counter(
+            REQUESTS_COMPLETED)
+
+
 class DeliverHandler:
     """`chain_getter(channel_id)` must return an object with `.ledger`
     (height / get_block / wait_for_block) and `.bundle()` — the
     orderer's ChainSupport or the peer's Channel both satisfy it."""
 
     def __init__(self, chain_getter, policy_name: str = "/Channel/Readers",
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 metrics: DeliverMetrics = None):
         self._chain_getter = chain_getter
         self._policy_name = policy_name
         self._timeout_s = timeout_s
+        self.metrics = metrics or DeliverMetrics()
 
     def handle(self, env: common.Envelope
                ) -> Iterator[ordpb.DeliverResponse]:
         """One SeekInfo envelope → a stream of blocks then a status
-        (reference deliver.go:198 deliverBlocks)."""
+        (reference deliver.go:198 deliverBlocks). Wraps the engine to
+        count stream lifecycle, blocks sent and final status."""
+        self.metrics.streams_opened.add(1)
+        try:
+            channel = pu.get_channel_header(
+                pu.get_payload(env)).channel_id
+        except Exception:
+            channel = ""
+        # curry once: deliver is the block-fanout hot path — no
+        # per-block instrument allocation
+        sent = self.metrics.blocks_sent.with_labels("channel", channel)
+        try:
+            for resp in self._handle(env):
+                if resp.WhichOneof("type") == "block":
+                    sent.add(1)
+                else:
+                    self.metrics.requests_completed.with_labels(
+                        "channel", channel, "status",
+                        common.Status.Name(resp.status)).add(1)
+                yield resp
+        finally:
+            self.metrics.streams_closed.add(1)
+
+    def _handle(self, env: common.Envelope
+                ) -> Iterator[ordpb.DeliverResponse]:
         try:
             payload = pu.get_payload(env)
             ch = pu.get_channel_header(payload)

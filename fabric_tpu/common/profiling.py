@@ -86,7 +86,9 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
         return None
     gauges = {
         name: metrics_provider.new_gauge(metrics_mod.GaugeOpts(
-            namespace="bccsp", name=name)).with_labels()
+            namespace="bccsp", name=name,
+            help="BCCSP provider runtime counter "
+                 "(TPUProvider.stats)")).with_labels()
         for name in stats
     }
 

@@ -17,6 +17,7 @@ same `Runtime` seam.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -24,6 +25,19 @@ from fabric_tpu.protos import proposal as pb
 from fabric_tpu.core.chaincode import shim
 
 logger = logging.getLogger("chaincode")
+
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+EXECUTE_TIMEOUTS = _m.CounterOpts(
+    namespace="chaincode", name="execute_timeouts",
+    help="The number of chaincode invocations that exceeded the "
+         "execute timeout and were abandoned.",
+    label_names=("chaincode",))
+EXECUTE_DURATION = _m.HistogramOpts(
+    namespace="chaincode", name="shim_request_duration",
+    help="The time a chaincode invocation took end to end (init or "
+         "invoke), including cc2cc sub-calls.",
+    label_names=("chaincode", "success"))
 
 
 class ExecuteError(Exception):
@@ -62,7 +76,7 @@ class ChaincodeSupport:
     """
 
     def __init__(self, execute_timeout_s: float = 30.0,
-                 channel_source=None):
+                 channel_source=None, metrics_provider=None):
         """`channel_source(channel_id)` → peer Channel (or None) — the
         seam cross-channel chaincode-to-chaincode queries resolve
         through (reference: handler.go InvokeChaincode → peer.Channel
@@ -70,6 +84,9 @@ class ChaincodeSupport:
         self._chaincodes: dict[str, shim.Chaincode] = {}
         self._timeout = execute_timeout_s
         self._channel_source = channel_source
+        provider = metrics_provider or _m.DisabledProvider()
+        self._m_timeouts = provider.new_counter(EXECUTE_TIMEOUTS)
+        self._m_duration = provider.new_histogram(EXECUTE_DURATION)
 
     def register(self, name: str, chaincode) -> None:
         """`chaincode`: anything with init(stub)/invoke(stub) — an
@@ -133,9 +150,15 @@ class ChaincodeSupport:
             finally:
                 done.set()
 
+        t0 = time.perf_counter()
         threading.Thread(target=run, daemon=True,
                          name=f"cc-exec-{cc_id.name}").start()
         if not done.wait(self._timeout):
+            self._m_timeouts.with_labels(
+                "chaincode", cc_id.name).add(1)
+            self._m_duration.with_labels(
+                "chaincode", cc_id.name, "success", "false").observe(
+                time.perf_counter() - t0)
             logger.warning("chaincode %s exceeded the %.0fs execute "
                            "timeout in tx %s; abandoning the worker",
                            cc_id.name, self._timeout, tx_id)
@@ -161,6 +184,10 @@ class ChaincodeSupport:
         if not isinstance(resp, pb.Response):
             resp = shim.error(
                 f"chaincode {cc_id.name} returned invalid response type")
+        self._m_duration.with_labels(
+            "chaincode", cc_id.name, "success",
+            "true" if resp.status < shim.ERRORTHRESHOLD else "false",
+        ).observe(time.perf_counter() - t0)
         return resp, stub.chaincode_event, cc_id
 
     def invoke_chaincode(self, caller_stub: shim.ChaincodeStub,

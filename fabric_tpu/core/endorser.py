@@ -10,6 +10,7 @@ sign prpBytes‖identity with the peer's signing identity).
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -37,6 +38,64 @@ class ChannelSupport:
     cc_definition: object = None    # fn(name) -> ChaincodeDefinition
 
 
+from fabric_tpu.common import metrics as _m
+
+PROPOSALS_RECEIVED = _m.CounterOpts(
+    namespace="endorser", name="proposals_received",
+    help="The number of proposals received.")
+SUCCESSFUL_PROPOSALS = _m.CounterOpts(
+    namespace="endorser", name="successful_proposals",
+    help="The number of successful proposals.")
+PROPOSAL_VALIDATION_FAILURES = _m.CounterOpts(
+    namespace="endorser", name="proposal_validation_failures",
+    help="The number of proposals that have failed initial "
+         "validation (malformed envelope or bad creator signature).")
+PROPOSAL_ACL_CHECK_FAILURES = _m.CounterOpts(
+    namespace="endorser", name="proposal_acl_check_failures",
+    help="The number of proposals that failed the channel ACL check.",
+    label_names=("channel",))
+PROPOSAL_SIMULATION_FAILURES = _m.CounterOpts(
+    namespace="endorser", name="proposal_simulation_failures",
+    help="The number of proposals that failed chaincode simulation.",
+    label_names=("channel", "chaincode"))
+ENDORSEMENT_FAILURES = _m.CounterOpts(
+    namespace="endorser", name="endorsement_failures",
+    help="The number of proposals the endorsement plugin refused "
+         "(including chaincode-level errors).",
+    label_names=("channel", "chaincode"))
+DUPLICATE_TXS_FAILURES = _m.CounterOpts(
+    namespace="endorser", name="duplicate_transaction_failures",
+    help="The number of proposals rejected as duplicate "
+         "transaction IDs.", label_names=("channel",))
+PROPOSAL_DURATION = _m.HistogramOpts(
+    namespace="endorser", name="proposal_duration",
+    help="The time to complete a proposal end to end.",
+    label_names=("channel", "chaincode", "success"))
+
+
+class EndorserMetrics:
+    """Reference: `core/endorser/metrics.go`."""
+
+    def __init__(self, provider=None):
+        provider = provider or _m.DisabledProvider()
+        self.proposals_received = provider.new_counter(
+            PROPOSALS_RECEIVED)
+        self.successful_proposals = provider.new_counter(
+            SUCCESSFUL_PROPOSALS)
+        self.validation_failures = provider.new_counter(
+            PROPOSAL_VALIDATION_FAILURES)
+        self.acl_failures = provider.new_counter(
+            PROPOSAL_ACL_CHECK_FAILURES)
+        self.simulation_failures = provider.new_counter(
+            PROPOSAL_SIMULATION_FAILURES)
+        self.endorsement_failures = provider.new_counter(
+            ENDORSEMENT_FAILURES)
+        self.duplicate_failures = provider.new_counter(
+            DUPLICATE_TXS_FAILURES)
+        self.proposal_duration = provider.new_histogram(
+            PROPOSAL_DURATION)
+
+
 def _error_response(status: int, message: str) -> pb.ProposalResponse:
     resp = pb.ProposalResponse(version=1)
     resp.response.status = status
@@ -54,15 +113,35 @@ class Endorser:
         self._cc = cc_support
         self._channel = channel_support
         self._acl = acl_provider or aclmgmt.ACLProvider()
+        self.metrics = metrics or EndorserMetrics()
 
     def process_proposal(self, sp: pb.SignedProposal) -> pb.ProposalResponse:
         """gRPC-facing entry (reference: endorser.go:304). All failures
         come back as a ProposalResponse with status>=500, mirroring the
         reference's error envelope behavior."""
+        self.metrics.proposals_received.add(1)
+        labels = {"channel": "", "chaincode": ""}
+        t0 = time.perf_counter()
+        resp = self._process(sp, labels)
+        ok = resp.response.status < shim.ERRORTHRESHOLD
+        if ok:
+            self.metrics.successful_proposals.add(1)
+        self.metrics.proposal_duration.with_labels(
+            "channel", labels["channel"],
+            "chaincode", labels["chaincode"],
+            "success", "true" if ok else "false",
+        ).observe(time.perf_counter() - t0)
+        return resp
+
+    def _process(self, sp: pb.SignedProposal,
+                 labels: dict) -> pb.ProposalResponse:
         try:
             up = UnpackedProposal.unpack(sp)
         except ProposalValidationError as e:
+            self.metrics.validation_failures.add(1)
             return _error_response(500, str(e))
+        labels["channel"] = up.channel_id
+        labels["chaincode"] = up.chaincode_name
 
         support = self._channel(up.channel_id)
         if support is None:
@@ -73,6 +152,7 @@ class Endorser:
         try:
             up.validate(support.deserializer)
         except ProposalValidationError as e:
+            self.metrics.validation_failures.add(1)
             return _error_response(
                 500, f"error validating proposal: {e}")
 
@@ -84,9 +164,13 @@ class Endorser:
                                 support.policy_manager, sd,
                                 channel_acls=support.acls)
         except aclmgmt.ACLError as e:
+            self.metrics.acl_failures.with_labels(
+                "channel", up.channel_id).add(1)
             return _error_response(500, str(e))
 
         if support.ledger.get_transaction_by_id(up.tx_id) is not None:
+            self.metrics.duplicate_failures.with_labels(
+                "channel", up.channel_id).add(1)
             return _error_response(
                 500, f"duplicate transaction found [{up.tx_id}]")
 
@@ -102,11 +186,17 @@ class Endorser:
         except Exception as e:
             logger.warning("chaincode execution failed for [%s]: %s",
                            up.tx_id, e)
+            self.metrics.simulation_failures.with_labels(
+                "channel", up.channel_id,
+                "chaincode", up.chaincode_name).add(1)
             return _error_response(500, f"chaincode execute failed: {e}")
 
         if resp.status >= shim.ERRORTHRESHOLD:
             # contract refused: propagate without endorsement
             # (reference endorser.go:343-349)
+            self.metrics.endorsement_failures.with_labels(
+                "channel", up.channel_id,
+                "chaincode", up.chaincode_name).add(1)
             out = pb.ProposalResponse(version=1)
             out.response.CopyFrom(resp)
             return out

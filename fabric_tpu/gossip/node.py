@@ -156,19 +156,44 @@ class ChannelGossip:
             self.pull.initiate(eps[:self._node.cfg.fanout])
 
 
+from fabric_tpu.common import metrics as _metrics
+
+MESSAGES_SENT = _metrics.CounterOpts(
+    namespace="gossip", subsystem="comm", name="messages_sent",
+    help="The number of gossip messages sent by this node.")
+MESSAGES_RECEIVED = _metrics.CounterOpts(
+    namespace="gossip", subsystem="comm", name="messages_received",
+    help="The number of gossip messages received by this node.")
+TOTAL_PEERS_KNOWN = _metrics.GaugeOpts(
+    namespace="gossip", subsystem="membership",
+    name="total_peers_known",
+    help="The number of alive peers in this node's membership view.")
+
+
+class GossipMetrics:
+    """Reference: `gossip/metrics/metrics.go` (comm + membership)."""
+
+    def __init__(self, provider=None):
+        provider = provider or _metrics.DisabledProvider()
+        self.sent = provider.new_counter(MESSAGES_SENT)
+        self.received = provider.new_counter(MESSAGES_RECEIVED)
+        self.total_peers_known = provider.new_gauge(TOTAL_PEERS_KNOWN)
+
+
 class GossipNode:
     """Reference: gossip/gossip/gossip_impl.go Node."""
 
     def __init__(self, endpoint: str, identity_bytes: bytes, signer,
                  transport: Transport, mcs,
                  config: Optional[DiscoveryConfig] = None,
-                 org_id: str = ""):
+                 org_id: str = "", metrics_provider=None):
         self.endpoint = endpoint
         self.identity = identity_bytes
         self.pki_id = gmsg.pki_id_of(identity_bytes)
         self.signer = signer
         self.mcs = mcs
         self.org_id = org_id
+        self.metrics = GossipMetrics(metrics_provider)
         self.cfg = config or DiscoveryConfig()
         self.incarnation = int(time.time() * 1000)
         self._seq_lock = threading.Lock()
@@ -240,6 +265,7 @@ class GossipNode:
 
     def _send_raw(self, endpoint: str,
                   smsg: gpb.SignedGossipMessage) -> None:
+        self.metrics.sent.add(1)
         self._transport.send(endpoint, smsg)
 
     def send_endpoint(self, endpoint: str,
@@ -283,6 +309,7 @@ class GossipNode:
 
     def _on_message(self, sender: str,
                     smsg: gpb.SignedGossipMessage) -> None:
+        self.metrics.received.add(1)
         try:
             msg = gmsg.parse(smsg)
         except Exception:
@@ -363,6 +390,11 @@ class GossipNode:
                    for cid in channels)
 
     def _membership_changed(self) -> None:
+        try:
+            self.metrics.total_peers_known.set(
+                len(self.discovery.alive_members()))
+        except Exception:
+            pass
         for cb in list(self._on_membership_change):
             try:
                 cb()

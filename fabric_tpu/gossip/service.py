@@ -64,9 +64,10 @@ class GossipService:
                  org_id: str,
                  config: Optional[DiscoveryConfig] = None):
         identity = peer.signer.serialize()
-        self.node = GossipNode(transport.endpoint, identity,
-                               peer.signer, transport, mcs,
-                               config=config, org_id=org_id)
+        self.node = GossipNode(
+            transport.endpoint, identity, peer.signer, transport, mcs,
+            config=config, org_id=org_id,
+            metrics_provider=getattr(peer, "metrics_provider", None))
         self._peer = peer
         self._mcs = mcs
         self._org_id = org_id
@@ -103,8 +104,10 @@ class GossipService:
         """`deliverer_factory(channel_like)` → a Deliverer-like object
         with start()/stop(); started only while this peer leads."""
         channel_id = peer_channel.channel_id
-        state = GossipStateProvider(self.node, channel_id, peer_channel,
-                                    self._mcs)
+        state = GossipStateProvider(
+            self.node, channel_id, peer_channel, self._mcs,
+            metrics_provider=getattr(self._peer, "metrics_provider",
+                                     None))
         privdata = PrivDataProvider(self.node, channel_id, peer_channel,
                                     self._peer, self._org_of_identity,
                                     reconcile_interval_s=max(

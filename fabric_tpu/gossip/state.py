@@ -22,6 +22,18 @@ logger = logging.getLogger("gossip.state")
 
 MAX_RANGE = 10  # blocks per state request (reference defAntiEntropyBatchSize)
 
+from fabric_tpu.common import metrics as _mdefs  # noqa: E402
+
+STATE_HEIGHT = _mdefs.GaugeOpts(
+    namespace="gossip", subsystem="state", name="height",
+    help="The ledger height this peer has committed through the "
+         "gossip state pipeline.", label_names=("channel",))
+PAYLOAD_BUFFER_SIZE = _mdefs.GaugeOpts(
+    namespace="gossip", subsystem="payload_buffer", name="size",
+    help="The number of out-of-order blocks parked in the payload "
+         "buffer awaiting the next in-sequence block.",
+    label_names=("channel",))
+
 
 class PayloadBuffer:
     """Min-buffer keyed by seq; pops only the exact next height
@@ -49,6 +61,10 @@ class PayloadBuffer:
             if seq == self._next:
                 self.ready.set()
 
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._payloads)
+
     def pop(self) -> Optional[tuple[int, bytes]]:
         with self._lock:
             data = self._payloads.pop(self._next, None)
@@ -71,7 +87,8 @@ class GossipStateProvider:
     """Glues a ChannelGossip to a peer channel (ledger)."""
 
     def __init__(self, node, channel_id: str, peer_channel, mcs,
-                 anti_entropy_interval_s: float = 0.5):
+                 anti_entropy_interval_s: float = 0.5,
+                 metrics_provider=None):
         """`peer_channel` duck-type: .ledger.height, .get_block(num),
         .process_block(block) — fabric_tpu.peer.Channel satisfies it."""
         self._node = node
@@ -82,6 +99,12 @@ class GossipStateProvider:
         self._interval = anti_entropy_interval_s
         self.buffer = PayloadBuffer()
         self.buffer.set_next(peer_channel.ledger.height)
+
+        provider = metrics_provider or _mdefs.DisabledProvider()
+        self._m_height = provider.new_gauge(STATE_HEIGHT).with_labels(
+            "channel", channel_id)
+        self._m_buffer = provider.new_gauge(
+            PAYLOAD_BUFFER_SIZE).with_labels("channel", channel_id)
 
         self._gchannel.on_block = self._on_block
         self._gchannel.on_state_request = self._on_state_request
@@ -157,7 +180,10 @@ class GossipStateProvider:
 
     def _publish_height(self) -> None:
         try:
-            self._gchannel.publish_state_info(self._peer.ledger.height)
+            height = self._peer.ledger.height
+            self._m_height.set(height)
+            self._m_buffer.set(len(self.buffer))
+            self._gchannel.publish_state_info(height)
         except Exception:
             logger.exception("state-info publish failed")
 

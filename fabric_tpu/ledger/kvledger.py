@@ -28,6 +28,24 @@ from fabric_tpu.protos import common, rwset as rwpb, transaction as txpb
 
 logger = must_get_logger("kvledger")
 
+BLOCK_PROCESSING_TIME = metrics_mod.HistogramOpts(
+    namespace="ledger", name="block_processing_time",
+    help="The time to commit one block end to end: MVCC validation, "
+         "block + private-data storage, state and history commit.",
+    label_names=("channel",))
+BLOCKSTORAGE_COMMIT_TIME = metrics_mod.HistogramOpts(
+    namespace="ledger", name="blockstorage_and_pvtdata_commit_time",
+    help="The time to append the block and its private data to "
+         "durable storage.", label_names=("channel",))
+STATEDB_COMMIT_TIME = metrics_mod.HistogramOpts(
+    namespace="ledger", name="statedb_commit_time",
+    help="The time to apply a block's write-set to the state DB.",
+    label_names=("channel",))
+BLOCKCHAIN_HEIGHT = metrics_mod.GaugeOpts(
+    namespace="ledger", name="blockchain_height",
+    help="The height of the chain (number of committed blocks).",
+    label_names=("channel",))
+
 
 class LedgerError(Exception):
     pass
@@ -68,18 +86,14 @@ class KVLedger:
             = lambda ns, coll: None
 
         provider = metrics_provider or metrics_mod.DisabledProvider()
-        hopts = lambda name: metrics_mod.HistogramOpts(  # noqa: E731
-            namespace="ledger", name=name, label_names=("channel",))
         self._m_block_time = provider.new_histogram(
-            hopts("block_processing_time")).with_labels("channel", ledger_id)
+            BLOCK_PROCESSING_TIME).with_labels("channel", ledger_id)
         self._m_store_time = provider.new_histogram(
-            hopts("blockstorage_and_pvtdata_commit_time")
-        ).with_labels("channel", ledger_id)
+            BLOCKSTORAGE_COMMIT_TIME).with_labels("channel", ledger_id)
         self._m_state_time = provider.new_histogram(
-            hopts("statedb_commit_time")).with_labels("channel", ledger_id)
-        self._m_height = provider.new_gauge(metrics_mod.GaugeOpts(
-            namespace="ledger", name="blockchain_height",
-            label_names=("channel",))).with_labels("channel", ledger_id)
+            STATEDB_COMMIT_TIME).with_labels("channel", ledger_id)
+        self._m_height = provider.new_gauge(
+            BLOCKCHAIN_HEIGHT).with_labels("channel", ledger_id)
 
         from fabric_tpu.ledger.snapshot import SnapshotRequests
         self.snapshot_requests = SnapshotRequests(

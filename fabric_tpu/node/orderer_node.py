@@ -118,12 +118,18 @@ class OrdererNode:
             ledger_dir, signer, csp,
             {"solo": solo.consenter,
              "raft": raft_mod.consenter(self.cluster,
-                                        tick_interval_s=tick),
+                                        tick_interval_s=tick,
+                                        metrics_provider=provider),
              "etcdraft": raft_mod.consenter(self.cluster,
-                                            tick_interval_s=tick),
+                                            tick_interval_s=tick,
+                                            metrics_provider=provider),
              "kafka": _kafka_deprecated})
-        broadcast = BroadcastHandler(self.registrar)
-        deliver = DeliverHandler(self.registrar.get_chain)
+        from fabric_tpu.orderer.broadcast import BroadcastMetrics
+        broadcast = BroadcastHandler(
+            self.registrar, metrics=BroadcastMetrics(provider))
+        from fabric_tpu.common.deliver import DeliverMetrics
+        deliver = DeliverHandler(self.registrar.get_chain,
+                                 metrics=DeliverMetrics(provider))
         participation = ChannelParticipation(self.registrar)
 
         from fabric_tpu.common import cryptoutil, diag

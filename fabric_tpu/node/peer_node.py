@@ -190,7 +190,8 @@ class PeerNode:
         from fabric_tpu.peer.deliverevents import EventsDeliverHandler
         comm_services.register_peer_deliver(
             self.server, EventsDeliverHandler(
-                lambda cid: self.peer.channel(cid)))
+                lambda cid: self.peer.channel(cid),
+                metrics_provider=provider))
         comm_services.register_gossip(
             self.server, self.gossip.node._on_message)
         self.server.start()

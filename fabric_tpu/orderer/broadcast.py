@@ -9,17 +9,53 @@ the consenter chain via Order/Configure.
 from __future__ import annotations
 
 import logging
+import time
 
+from fabric_tpu.common import metrics as _m
 from fabric_tpu.protos import common, orderer as ordpb
 from fabric_tpu.protoutil import protoutil as pu
 from fabric_tpu.orderer import msgprocessor
 
 logger = logging.getLogger("orderer.broadcast")
 
+VALIDATE_DURATION = _m.HistogramOpts(
+    namespace="broadcast", name="validate_duration",
+    help="The time to validate a broadcast transaction through the "
+         "channel's message processor.",
+    label_names=("channel", "type", "status"))
+ENQUEUE_DURATION = _m.HistogramOpts(
+    namespace="broadcast", name="enqueue_duration",
+    help="The time to enqueue a validated transaction into the "
+         "consenter chain.", label_names=("channel", "type", "status"))
+PROCESSED_COUNT = _m.CounterOpts(
+    namespace="broadcast", name="processed_count",
+    help="The number of broadcast transactions processed.",
+    label_names=("channel", "type", "status"))
+
+
+class BroadcastMetrics:
+    """Reference: `orderer/common/broadcast/metrics.go`."""
+
+    def __init__(self, provider=None):
+        provider = provider or _m.DisabledProvider()
+        self.validate_duration = provider.new_histogram(
+            VALIDATE_DURATION)
+        self.enqueue_duration = provider.new_histogram(
+            ENQUEUE_DURATION)
+        self.processed_count = provider.new_counter(PROCESSED_COUNT)
+
 
 class BroadcastHandler:
-    def __init__(self, registrar):
+    def __init__(self, registrar, metrics: BroadcastMetrics = None):
         self._registrar = registrar
+        self.metrics = metrics or BroadcastMetrics()
+
+    def _observe(self, hist_or_counter, channel: str, kind: str,
+                 status: int, dur: float = None) -> None:
+        inst = hist_or_counter.with_labels(
+            "channel", channel, "type", kind,
+            "status", common.Status.Name(status))
+        inst.observe(dur) if dur is not None else inst.add(1)
 
     def process_message(self, env: common.Envelope
                         ) -> ordpb.BroadcastResponse:
@@ -46,26 +82,65 @@ class BroadcastHandler:
                 info="consenter is in an errored state")
 
         kind = msgprocessor.classify(ch)
+        kname = "config" if kind != msgprocessor.NORMAL else "normal"
+        cid = ch.channel_id
+
+        def done(status: int, info: str = "",
+                 enqueue_t0: float = None) -> ordpb.BroadcastResponse:
+            if enqueue_t0 is not None:
+                self._observe(self.metrics.enqueue_duration, cid, kname,
+                              status, time.perf_counter() - enqueue_t0)
+            self._observe(self.metrics.processed_count, cid, kname,
+                          status)
+            return ordpb.BroadcastResponse(status=status, info=info)
+
+        t0 = time.perf_counter()
         try:
             if kind == msgprocessor.NORMAL:
                 seq = support.processor.process_normal_msg(env)
-                support.chain.order(env, seq)
+                to_order, configure = env, False
+            elif kind == msgprocessor.CONFIG_UPDATE:
+                to_order, seq = \
+                    support.processor.process_config_update_msg(env)
+                configure = True
             else:
-                if kind == msgprocessor.CONFIG_UPDATE:
-                    wrapped, seq = \
-                        support.processor.process_config_update_msg(env)
-                else:
-                    wrapped, seq = \
-                        support.processor.process_config_msg(env)
-                support.chain.configure(wrapped, seq)
+                to_order, seq = \
+                    support.processor.process_config_msg(env)
+                configure = True
         except msgprocessor.PermissionDenied as e:
-            return ordpb.BroadcastResponse(
-                status=common.Status.FORBIDDEN, info=str(e))
+            self._observe(self.metrics.validate_duration, cid, kname,
+                          common.Status.FORBIDDEN,
+                          time.perf_counter() - t0)
+            return done(common.Status.FORBIDDEN, str(e))
         except msgprocessor.MsgProcessorError as e:
-            return ordpb.BroadcastResponse(
-                status=common.Status.BAD_REQUEST, info=str(e))
+            self._observe(self.metrics.validate_duration, cid, kname,
+                          common.Status.BAD_REQUEST,
+                          time.perf_counter() - t0)
+            return done(common.Status.BAD_REQUEST, str(e))
         except Exception as e:
-            logger.exception("[%s] broadcast failure", ch.channel_id)
-            return ordpb.BroadcastResponse(
-                status=common.Status.INTERNAL_SERVER_ERROR, info=str(e))
-        return ordpb.BroadcastResponse(status=common.Status.SUCCESS)
+            logger.exception("[%s] broadcast validation failure", cid)
+            self._observe(self.metrics.validate_duration, cid, kname,
+                          common.Status.INTERNAL_SERVER_ERROR,
+                          time.perf_counter() - t0)
+            return done(common.Status.INTERNAL_SERVER_ERROR, str(e))
+        self._observe(self.metrics.validate_duration, cid, kname,
+                      common.Status.SUCCESS, time.perf_counter() - t0)
+
+        t1 = time.perf_counter()
+        try:
+            if configure:
+                support.chain.configure(to_order, seq)
+            else:
+                support.chain.order(to_order, seq)
+        except msgprocessor.MsgProcessorError as e:
+            # enqueue-side rejections are transient leadership/halt
+            # conditions (no leader yet, halted mid-reconfig, forward
+            # refused) — clients should back off and retry (reference:
+            # Order on a halted/leaderless chain → SERVICE_UNAVAILABLE)
+            return done(common.Status.SERVICE_UNAVAILABLE, str(e),
+                        enqueue_t0=t1)
+        except Exception as e:
+            logger.exception("[%s] broadcast failure", cid)
+            return done(common.Status.INTERNAL_SERVER_ERROR, str(e),
+                        enqueue_t0=t1)
+        return done(common.Status.SUCCESS, enqueue_t0=t1)

@@ -39,6 +39,71 @@ logger = logging.getLogger("orderer.raft.chain")
 
 COMPACT_EVERY = 64   # entries between raft-log compactions
 
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+IS_LEADER = _m.GaugeOpts(
+    namespace="consensus", subsystem="etcdraft", name="is_leader",
+    help="The leadership status of this node on the channel: 1 if it "
+         "is the raft leader, 0 otherwise.", label_names=("channel",))
+LEADER_CHANGES = _m.CounterOpts(
+    namespace="consensus", subsystem="etcdraft", name="leader_changes",
+    help="The number of leader changes observed since process start.",
+    label_names=("channel",))
+COMMITTED_BLOCK_NUMBER = _m.GaugeOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="committed_block_number",
+    help="The number of the latest block committed through raft.",
+    label_names=("channel",))
+PROPOSAL_FAILURES = _m.CounterOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="proposal_failures",
+    help="The number of proposal failures on the leader (cut blocks "
+         "that could not be proposed to raft).",
+    label_names=("channel",))
+CLUSTER_SIZE = _m.GaugeOpts(
+    namespace="consensus", subsystem="etcdraft", name="cluster_size",
+    help="The number of consenters in the channel's raft cluster.",
+    label_names=("channel",))
+SNAPSHOT_BLOCK_NUMBER = _m.GaugeOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="snapshot_block_number",
+    help="The block number of the latest raft snapshot (log "
+         "compaction point).", label_names=("channel",))
+NORMAL_PROPOSALS_RECEIVED = _m.CounterOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="normal_proposals_received",
+    help="The number of normal (non-config) proposals received by "
+         "this node.", label_names=("channel",))
+CONFIG_PROPOSALS_RECEIVED = _m.CounterOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="config_proposals_received",
+    help="The number of config proposals received by this node.",
+    label_names=("channel",))
+
+
+class RaftMetrics:
+    """Reference: `orderer/consensus/etcdraft/metrics.go`."""
+
+    def __init__(self, provider=None, channel: str = ""):
+        provider = provider or _m.DisabledProvider()
+        lbl = ("channel", channel)
+        self.is_leader = provider.new_gauge(
+            IS_LEADER).with_labels(*lbl)
+        self.leader_changes = provider.new_counter(
+            LEADER_CHANGES).with_labels(*lbl)
+        self.committed_block_number = provider.new_gauge(
+            COMMITTED_BLOCK_NUMBER).with_labels(*lbl)
+        self.proposal_failures = provider.new_counter(
+            PROPOSAL_FAILURES).with_labels(*lbl)
+        self.cluster_size = provider.new_gauge(
+            CLUSTER_SIZE).with_labels(*lbl)
+        self.snapshot_block_number = provider.new_gauge(
+            SNAPSHOT_BLOCK_NUMBER).with_labels(*lbl)
+        self.normal_proposals = provider.new_counter(
+            NORMAL_PROPOSALS_RECEIVED).with_labels(*lbl)
+        self.config_proposals = provider.new_counter(
+            CONFIG_PROPOSALS_RECEIVED).with_labels(*lbl)
+
 
 def endpoint_id(endpoint: str) -> int:
     """Stable 63-bit raft node id for a consenter endpoint."""
@@ -88,11 +153,15 @@ class RaftChain:
     """consensus.Chain over the raft core."""
 
     def __init__(self, support, transport, tick_interval_s: float = 0.1,
-                 election_tick: int = 10, heartbeat_tick: int = 1):
+                 election_tick: int = 10, heartbeat_tick: int = 1,
+                 metrics_provider=None):
         self._support = support
         self._transport = transport
         self.endpoint = transport.endpoint
         self._tick_s = tick_interval_s
+        self.metrics = RaftMetrics(metrics_provider,
+                                   channel=support.channel_id)
+        self._last_leader = None   # soft_leader sentinel: None = no leader
 
         self._consenters = parse_consenters(
             support.bundle().orderer.consensus_metadata)
@@ -117,6 +186,7 @@ class RaftChain:
         self._creator: Optional[_BlockCreator] = None
         self._timer_deadline: Optional[float] = None
         self._applied_since_compact = 0
+        self.metrics.cluster_size.set(len(self._consenters))
         self._replay_committed()
         transport.set_channel_auth(
             support.channel_id,
@@ -176,6 +246,8 @@ class RaftChain:
 
     def _submit(self, env: common.Envelope, config_seq: int,
                 is_config: bool) -> None:
+        (self.metrics.config_proposals if is_config
+         else self.metrics.normal_proposals).add(1)
         if self._halted.is_set():
             raise MsgProcessorError("chain is halted")
         leader = self.node.leader_id
@@ -286,6 +358,14 @@ class RaftChain:
 
     def _drain_ready(self) -> None:
         ready = self.node.ready()
+        if ready.soft_leader != self._last_leader:
+            # count only elections of a real node: X→None (leader
+            # lost) must not double-count the following None→Y
+            if ready.soft_leader is not None:
+                self.metrics.leader_changes.add(1)
+            self._last_leader = ready.soft_leader
+            self.metrics.is_leader.set(
+                1 if ready.soft_leader == self.node_id else 0)
         for msg in ready.messages:
             target = self._consenters.get(msg.to)
             if target is not None:
@@ -347,6 +427,7 @@ class RaftChain:
         if not ok:
             logger.warning("[%s] proposal dropped (not leader)",
                            self._support.channel_id)
+            self.metrics.proposal_failures.add(1)
             self._creator = None
 
     def _creator_from_tail(self) -> _BlockCreator:
@@ -401,8 +482,11 @@ class RaftChain:
             self._applied_since_compact = 0
             self.node.compact(self.node.applied_index,
                               self._support.ledger.height)
+            self.metrics.snapshot_block_number.set(
+                self._support.ledger.height - 1)
 
     def _write_committed_block(self, block: common.Block) -> None:
+        self.metrics.committed_block_number.set(block.header.number)
         support = self._support
         if pu.is_config_block(block):
             support.write_config_block(block)
@@ -429,6 +513,7 @@ class RaftChain:
                     sorted(self._consenters.values()),
                     sorted(new.values()))
         self._consenters = new
+        self.metrics.cluster_size.set(len(new))
         if self.node.state == LEADER:
             self.node.propose_conf_change(list(new))
 
@@ -467,7 +552,7 @@ class RaftChain:
 
 
 def consenter(transport, tick_interval_s: float = 0.1,
-              election_tick: int = 10):
+              election_tick: int = 10, metrics_provider=None):
     """Factory-of-factories for the registrar's consenter map:
     `{"etcdraft": raft.consenter(transport)}`. An orderer outside the
     channel's consenter set comes up as a FOLLOWER (onboarding mode)
@@ -483,5 +568,6 @@ def consenter(transport, tick_interval_s: float = 0.1,
             return FollowerChain(support, transport)
         return RaftChain(support, transport,
                          tick_interval_s=tick_interval_s,
-                         election_tick=election_tick)
+                         election_tick=election_tick,
+                         metrics_provider=metrics_provider)
     return factory

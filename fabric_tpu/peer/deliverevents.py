@@ -87,9 +87,12 @@ class EventsDeliverHandler:
     """
 
     def __init__(self, channel_getter,
-                 timeout_s=None):
+                 timeout_s=None, metrics_provider=None):
+        from fabric_tpu.common.deliver import DeliverMetrics
         self._channels = channel_getter
-        self._base = DeliverHandler(channel_getter, timeout_s=timeout_s)
+        self._base = DeliverHandler(
+            channel_getter, timeout_s=timeout_s,
+            metrics=DeliverMetrics(metrics_provider))
 
     # -- plain blocks (parity with the orderer-style stream) --
 

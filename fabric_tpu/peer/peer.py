@@ -50,10 +50,13 @@ class Channel:
         # channel's chaincode definitions
         ledger.set_collection_info_source(self._collection_info)
 
+        from fabric_tpu.core.txvalidator import TxValidatorMetrics
         self.validator = TxValidator(
             channel_id, ledger, self.bundle, peer.csp,
             self.chaincode_definition,
-            configtx_validator_source=self.configtx_validator)
+            configtx_validator_source=self.configtx_validator,
+            metrics=TxValidatorMetrics(peer.metrics_provider,
+                                       channel=channel_id))
         self.committer = LedgerCommitter(
             ledger, on_config_block=self._on_config_block)
 
@@ -257,13 +260,15 @@ class Peer:
                  metrics_provider=None):
         self.csp = csp
         self.local_msp = local_msp
+        self.metrics_provider = metrics_provider
         self.signer = local_msp.get_default_signing_identity()
         self.ledger_mgr = LedgerManager(ledger_root,
                                         metrics_provider=metrics_provider)
         self.transient_store = TransientStore(
             os.path.join(ledger_root, "transient.db"))
         self.chaincode_support = ChaincodeSupport(
-            channel_source=lambda cid: self.channels.get(cid))
+            channel_source=lambda cid: self.channels.get(cid),
+            metrics_provider=metrics_provider)
         self.channels: dict[str, Channel] = {}
         self._lock = threading.Lock()
         self.mcs = MSPMessageCryptoService(
@@ -272,7 +277,8 @@ class Peer:
             local_deserializer=local_msp)
         self.gossip_service = None   # attached by node assembly
         self.endorser = endorser_mod.Endorser(
-            self.signer, self.chaincode_support, self._channel_support)
+            self.signer, self.chaincode_support, self._channel_support,
+            metrics=endorser_mod.EndorserMetrics(metrics_provider))
         from fabric_tpu.core.scc import register_system_chaincodes
         register_system_chaincodes(self)
         # reopen any previously joined channels (start.go:770
