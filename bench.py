@@ -51,6 +51,150 @@ TPU_ITERS = 5
 CHUNK = int(os.environ.get("BENCH_CHUNK", "32768"))
 
 
+def bench_idemix(prov) -> dict:
+    """BASELINE config 4: idemix credential verification.
+
+    The measurable surface is `IdemixMSP.validate_credentials_batch`
+    (reference analog: `msp/idemix.go` credential verify via vendored
+    IBM/idemix BN254 pairing checks). BLS-issued credentials resolve to
+    ONE batched pairing-product dispatch (`csp.bls_verify_batch` →
+    `pairing_check_batch` → device Miller loop + final exp); the host
+    baseline is the exact integer pairing (`ops/bn254_ref`), the same
+    arithmetic class as the reference's pure-Go IBM/mathlib.
+    """
+    import time as t
+
+    from fabric_tpu.msp import msp as mapi
+    from fabric_tpu.msp.idemix import (
+        IdemixIssuer, IdemixMSP, idemix_msp_config,
+    )
+
+    n = int(os.environ.get("BENCH_IDEMIX_N", "256"))
+    issuer = IdemixIssuer(prov, scheme="bls")
+    msp = IdemixMSP(prov)
+    msp.setup(idemix_msp_config("AnonBLS", issuer))
+    creds = issuer.issue("research", mapi.MSPRole.MEMBER, count=n)
+    msp.add_credentials(creds)
+    # all issued credentials as deserialized identities
+    from fabric_tpu.protos import msp as msppb
+    idents = []
+    for _priv, cred in creds:
+        wrapped = msppb.SerializedIdemixIdentity()
+        wrapped.credential.CopyFrom(cred)
+        sid = msppb.SerializedIdentity(
+            mspid="AnonBLS", id_bytes=wrapped.SerializeToString())
+        idents.append(msp.deserialize_identity(
+            sid.SerializeToString()))
+
+    t0 = t.perf_counter()
+    ok = msp.validate_credentials_batch(idents)
+    warm_s = t.perf_counter() - t0
+    if not all(ok):
+        raise RuntimeError("valid idemix credentials rejected")
+    times = []
+    for _ in range(3):
+        t0 = t.perf_counter()
+        ok = msp.validate_credentials_batch(idents)
+        times.append(t.perf_counter() - t0)
+    steady = min(times)
+
+    # host baseline: exact integer pairing on a small sample
+    from fabric_tpu.bccsp.sw import SWProvider
+    sw_msp = IdemixMSP(SWProvider())
+    sw_msp.setup(idemix_msp_config("AnonBLS", issuer))
+    sample = idents[:4]
+    t0 = t.perf_counter()
+    assert all(sw_msp.validate_credentials_batch(sample))
+    host_per_cred = (t.perf_counter() - t0) / len(sample)
+    ncpu = os.cpu_count() or 1
+    host_ideal = ncpu / host_per_cred
+    return {
+        "creds": n,
+        "creds_per_s": round(n / steady, 1),
+        "warm_s": round(warm_s, 2),
+        "steady_s": round(steady, 4),
+        "host_single_thread_ms_per_cred":
+            round(host_per_cred * 1e3, 1),
+        "host_ideal_creds_per_s": round(host_ideal, 1),
+        "vs_host_ideal": round((n / steady) / host_ideal, 2),
+        "surface": "IdemixMSP.validate_credentials_batch -> "
+                   "bls_verify_batch (BN254 pairing product on "
+                   "device)",
+    }
+
+
+def bench_blocksig(prov) -> dict:
+    """BASELINE config 5: gossip identity + orderer block-signature
+    verify at a simulated 10k tx/s load.
+
+    At 10k tx/s with 500-tx blocks the peer sees 20 blocks/s, each
+    needing ~1 orderer block-metadata signature plus a handful of
+    gossip message-auth verifies — latency-critical 3-5 sig batches,
+    NOT throughput batches. By design these ride the provider's small-
+    batch fast path (CPU, no device round-trip: a 4-sig set must not
+    wait on a 32k-lane pipeline — SURVEY §7 'a 3-sig policy on a 1-tx
+    block must not wait for a batch'). Reported: per-set latency and
+    the fraction of one core the whole 10k tx/s control-plane load
+    consumes, alongside the device pipeline the data-plane (config
+    2/3) uses.
+    """
+    import time as t
+
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    from fabric_tpu.bccsp import VerifyItem, utils as butils
+    from fabric_tpu.bccsp.bccsp import ECDSAPublicKeyImportOpts
+
+    sigs_per_set = 4          # 1 block sig + 3 gossip identity checks
+    sets = 200
+    priv = ec.generate_private_key(ec.SECP256R1())
+    key = prov.key_import(priv.public_key(), ECDSAPublicKeyImportOpts())
+    rng = np.random.default_rng(7)
+    batches = []
+    for _ in range(sets):
+        items = []
+        for _ in range(sigs_per_set):
+            m = rng.bytes(96)
+            r, s = decode_dss_signature(
+                priv.sign(m, ec.ECDSA(hashes.SHA256())))
+            items.append(VerifyItem(
+                key=key,
+                signature=butils.marshal_signature(
+                    r, butils.to_low_s(s)),
+                message=m))
+        batches.append(items)
+    # warm
+    assert all(prov.verify_batch(batches[0]))
+    lat = []
+    t_all0 = t.perf_counter()
+    for items in batches:
+        t0 = t.perf_counter()
+        out = prov.verify_batch(items)
+        lat.append(t.perf_counter() - t0)
+        if not all(out):
+            raise RuntimeError("valid block-sig set rejected")
+    total = t.perf_counter() - t_all0
+    lat.sort()
+    sets_per_s = sets / total
+    blocks_per_s_at_10k = 10000 / 500.0
+    return {
+        "sigs_per_set": sigs_per_set,
+        "sets": sets,
+        "p50_latency_us": round(lat[len(lat) // 2] * 1e6, 1),
+        "p99_latency_us": round(lat[int(len(lat) * 0.99) - 1] * 1e6,
+                                1),
+        "sets_per_s": round(sets_per_s, 1),
+        "core_fraction_at_10k_tx_s":
+            round(blocks_per_s_at_10k / sets_per_s, 4),
+        "path": "small-batch fast path (latency-critical sets bypass "
+                "the device pipeline by design)",
+    }
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -131,9 +275,25 @@ def main():
     from fabric_tpu.ops import comb, limb, sha256
 
     bucket = prov._bucket(batch)       # the shape verify_batch compiled
-    nb = prov._nb_bucket(MSG_LEN)
-    blocks, nblocks = sha256.pack_messages(
-        msgs + [b""] * (bucket - batch), nb)
+    if prov._hash_on_host:
+        # the shipped default: host SHA-256 → 32-byte digest lanes,
+        # device runs pure ECDSA on nb=1 empty blocks (same shapes
+        # verify_batch compiled)
+        import hashlib
+        nb = 1
+        blocks, nblocks = sha256.pack_messages([b""] * bucket, nb)
+        nblocks = np.zeros(bucket, dtype=np.int32)
+        digests0 = np.zeros((bucket, 8), dtype=np.uint32)
+        for i, m in enumerate(msgs):
+            digests0[i] = np.frombuffer(
+                hashlib.sha256(m).digest(), dtype=">u4")
+        nodigest = np.ones(bucket, dtype=bool)   # has_digest per lane
+    else:
+        nb = prov._nb_bucket(MSG_LEN)
+        blocks, nblocks = sha256.pack_messages(
+            msgs + [b""] * (bucket - batch), nb)
+        digests0 = np.zeros((bucket, 8), dtype=np.uint32)
+        nodigest = np.zeros(bucket, dtype=bool)
     ok_n, r_b, rpn_b, w_b = native.batch_prep(
         [it.signature for it in items])
     assert ok_n.all()
@@ -170,8 +330,6 @@ def main():
         fn = prov._comb_fns[(K, False)]
     premask = np.zeros(bucket, dtype=bool)
     premask[:batch] = True
-    digests0 = np.zeros((bucket, 8), dtype=np.uint32)
-    nodigest = np.zeros(bucket, dtype=bool)
 
     chunk = min(bucket, CHUNK)
     staged = []
@@ -215,6 +373,22 @@ def main():
         except Exception as e:          # noqa: BLE001
             pipeline = {"error": f"{type(e).__name__}: {e}"}
 
+    # ---- BASELINE config 4: idemix pairing verify ----
+    idemix = None
+    if os.environ.get("BENCH_IDEMIX", "1") == "1":
+        try:
+            idemix = bench_idemix(prov)
+        except Exception as e:          # noqa: BLE001
+            idemix = {"error": f"{type(e).__name__}: {e}"}
+
+    # ---- BASELINE config 5: block-sig + gossip auth under load ----
+    blocksig = None
+    if os.environ.get("BENCH_BLOCKSIG", "1") == "1":
+        try:
+            blocksig = bench_blocksig(prov)
+        except Exception as e:          # noqa: BLE001
+            blocksig = {"error": f"{type(e).__name__}: {e}"}
+
     on_tpu = type(prov)._on_tpu()
     result = {
         "metric": "block-validation sig-verify throughput "
@@ -233,6 +407,10 @@ def main():
                     "provider's own compiled pipeline + cached tables",
             "chunk": chunk,
             "tpu_steady_s": round(tpu_s, 4),
+            "hash_mode": ("host SHA-256 -> 32B digest lanes (default; "
+                          "reference-matching CPU hash, minimal "
+                          "transfer)" if prov._hash_on_host else
+                          "fused device SHA-256"),
             "staging": "device-resident operands (tunnel transfer "
                        "excluded; see provider_verify_batch_*)",
             "tpu_block_tx_per_s": round(BLOCK_TXS / tpu_s, 1),
@@ -246,6 +424,8 @@ def main():
             "sign_s": round(sign_s, 2),
             "provider_stats": dict(prov.stats),
             "pipeline": pipeline,
+            "idemix": idemix,
+            "blocksig": blocksig,
             "devices": [str(d) for d in jax.devices()],
         },
     }

@@ -40,6 +40,10 @@ class TpuOpts:
     chunk: int = 32768
     max_keys: int = 16
     table_cache_bytes: int = 6 << 30
+    # True (default): hash message lanes on host, ship 32-byte digests
+    # (reference-matching CPU hash; minimal device transfer). False:
+    # fuse SHA-256 into the device pipeline (PCIe-attached hosts).
+    hash_on_host: bool = True
 
 
 @dataclass
@@ -74,6 +78,7 @@ class FactoryOpts:
                 max_keys=int(tpu_cfg.get("MaxKeys", 16)),
                 table_cache_bytes=(
                     int(tpu_cfg.get("TableCacheMB", 6144)) << 20),
+                hash_on_host=bool(tpu_cfg.get("HashOnHost", True)),
             ),
         )
 
@@ -96,7 +101,8 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            max_keys=opts.tpu.max_keys,
                            chunk=opts.tpu.chunk,
                            use_g16=opts.tpu.use_g16,
-                           table_cache_bytes=opts.tpu.table_cache_bytes)
+                           table_cache_bytes=opts.tpu.table_cache_bytes,
+                           hash_on_host=opts.tpu.hash_on_host)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

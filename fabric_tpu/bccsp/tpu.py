@@ -42,10 +42,21 @@ class TPUProvider(api.BCCSP):
     def __init__(self, keystore=None, min_batch: int = 16,
                  max_blocks: int = 64, mesh=None, max_keys: int = 16,
                  chunk: int = 32768, use_g16: Optional[bool] = None,
-                 table_cache_bytes: int = 6 << 30):
+                 table_cache_bytes: int = 6 << 30,
+                 hash_on_host: bool = True):
         self._sw = swmod.SWProvider(keystore)
         self._min_batch = min_batch
         self._max_blocks = max_blocks
+        # hash message lanes on host (OpenSSL-class C SHA-256) and ship
+        # 32-byte digests instead of padded SHA blocks: transfer drops
+        # from O(message bytes) to 32 B/lane and the device runs pure
+        # ECDSA. This also mirrors the reference's split —
+        # `msp/identities.go:179` hashes via bccsp on CPU, only the
+        # curve math is "hardware". Set HashOnHost: false (core.yaml)
+        # to fuse SHA-256 into the device pipeline instead — the right
+        # trade when the accelerator link is PCIe-fast and host cores
+        # are the scarce resource.
+        self._hash_on_host = hash_on_host
         self._mesh = mesh
         self._max_keys = max_keys   # comb path cutoff (distinct pubkeys)
         self._chunk = chunk         # double-buffer chunk size (sigs)
@@ -70,6 +81,7 @@ class TPUProvider(api.BCCSP):
         # observability: perf-cliff counters surfaced via provider stats
         self.stats = {"comb_batches": 0, "ladder_batches": 0,
                       "host_hash_fallbacks": 0, "sw_fallbacks": 0,
+                      "host_hashed_lanes": 0,
                       "q16_builds": 0, "q16_evictions": 0,
                       "q16_oversize_skips": 0, "q16_cache_bytes": 0,
                       "nonp256_sw_lanes": 0}
@@ -236,6 +248,18 @@ class TPUProvider(api.BCCSP):
                 max_len = max(max_len, len(it.message))
 
         msgs += [b""] * (bucket - n)
+        if self._hash_on_host and max_len > 0:
+            # default path: host SHA-256 → 32-byte digest lanes
+            hashed = 0
+            for i in range(n):
+                if premask[i] and not has_digest[i]:
+                    digests[i] = np.frombuffer(
+                        self._sw.hash(msgs[i]), dtype=">u4")
+                    has_digest[i] = True
+                    msgs[i] = b""
+                    hashed += 1
+            self.stats["host_hashed_lanes"] += hashed
+            max_len = 0
         nb = self._nb_bucket(max_len)
         if nb is None:
             # a message too large for the block budget: hash host-side and
@@ -462,7 +486,7 @@ class TPUProvider(api.BCCSP):
         return self._fn
 
     def prewarm(self, buckets=(4096, 32768), key_counts=(4,),
-                msg_nbs=(1, 8)) -> None:
+                msg_nbs=None) -> None:
         """AOT-compile the standard validation shapes (and build the
         16-bit G table) BEFORE the node joins channels, so a cold peer
         does not stall its first blocks on device compilation
@@ -472,6 +496,10 @@ class TPUProvider(api.BCCSP):
         import jax  # noqa: F401  (jax.ShapeDtypeStruct below)
 
         from fabric_tpu.ops import comb
+        if msg_nbs is None:
+            # host-hash mode only ever ships nb=1 digest lanes; device-
+            # hash mode also needs the typical proposal-payload shape
+            msg_nbs = (1,) if self._hash_on_host else (1, 8)
         try:
             q16 = self._g16_enabled()
             if q16:

@@ -74,15 +74,17 @@ class DeliverHandler:
         count stream lifecycle, blocks sent and final status."""
         self.metrics.streams_opened.add(1)
         try:
-            channel = pu.get_channel_header(
-                pu.get_payload(env)).channel_id
+            payload = pu.get_payload(env)
+            ch = pu.get_channel_header(payload)
+            channel = ch.channel_id
+            parsed = (payload, ch)
         except Exception:
-            channel = ""
+            channel, parsed = "", None
         # curry once: deliver is the block-fanout hot path — no
         # per-block instrument allocation
         sent = self.metrics.blocks_sent.with_labels("channel", channel)
         try:
-            for resp in self._handle(env):
+            for resp in self._handle(env, parsed):
                 if resp.WhichOneof("type") == "block":
                     sent.add(1)
                 else:
@@ -93,14 +95,12 @@ class DeliverHandler:
         finally:
             self.metrics.streams_closed.add(1)
 
-    def _handle(self, env: common.Envelope
+    def _handle(self, env: common.Envelope, parsed=None
                 ) -> Iterator[ordpb.DeliverResponse]:
-        try:
-            payload = pu.get_payload(env)
-            ch = pu.get_channel_header(payload)
-        except Exception:
+        if parsed is None:
             yield _status(common.Status.BAD_REQUEST)
             return
+        payload, ch = parsed
         chain = self._chain_getter(ch.channel_id)
         if chain is None:
             yield _status(common.Status.NOT_FOUND)

@@ -28,7 +28,7 @@ DOC_RELPATH = os.path.join("docs", "metrics_reference.md")
 EPILOGUE = """\
 ## Dynamically-named instruments
 
-- `fabric_bccsp_<stat>` — one gauge per `TPUProvider.stats` counter
+- `bccsp_<stat>` — one gauge per `TPUProvider.stats` counter
   (comb/ladder dispatches, q16 table cache bytes and evictions, sw
   fallbacks …), published by
   `fabric_tpu/common/profiling.py publish_provider_stats`.

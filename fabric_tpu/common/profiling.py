@@ -75,7 +75,7 @@ def capture_jax_trace(out_dir: str, seconds: float = 3.0) -> str:
 
 def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
     """Expose a BCCSP provider's `stats` counters as gauges
-    (`fabric_bccsp_<name>`), refreshed by a daemon poller — the TPU
+    (`bccsp_<name>`), refreshed by a daemon poller — the TPU
     path's perf-cliff counters (comb vs ladder dispatches, sw
     fallbacks, table cache bytes/evictions) become scrapeable instead
     of debugger-only. Returns the poller thread (daemon, running)."""

@@ -103,7 +103,7 @@ class PeerNode:
         csp = bccsp_factory.new_bccsp(
             bccsp_factory.FactoryOpts.from_config(bccsp_cfg))
         # the TPU provider's perf-cliff counters become scrapeable
-        # gauges (fabric_bccsp_*) on /metrics
+        # gauges (bccsp_*) on /metrics
         from fabric_tpu.common import profiling
         profiling.publish_provider_stats(provider, csp)
         # pre-compile the standard validation shapes in the background

@@ -61,25 +61,32 @@ class BroadcastHandler:
                         ) -> ordpb.BroadcastResponse:
         """One envelope in, one status out (the gRPC stream layer maps
         this 1:1 — reference broadcast.go Handle loop)."""
+
+        def reject(channel: str, status: int,
+                   info: str) -> ordpb.BroadcastResponse:
+            # pre-classification rejections count too — a storm of
+            # NOT_FOUND/BAD_REQUEST traffic must be visible in
+            # broadcast_processed_count (reference records these)
+            self._observe(self.metrics.processed_count, channel,
+                          "unknown", status)
+            return ordpb.BroadcastResponse(status=status, info=info)
+
         try:
             ch = pu.get_channel_header(pu.get_payload(env))
         except Exception as e:
-            return ordpb.BroadcastResponse(
-                status=common.Status.BAD_REQUEST,
-                info=f"malformed envelope: {e}")
+            return reject("", common.Status.BAD_REQUEST,
+                          f"malformed envelope: {e}")
         if not ch.channel_id:
-            return ordpb.BroadcastResponse(
-                status=common.Status.BAD_REQUEST,
-                info="empty channel id")
+            return reject("", common.Status.BAD_REQUEST,
+                          "empty channel id")
         support = self._registrar.get_chain(ch.channel_id)
         if support is None:
-            return ordpb.BroadcastResponse(
-                status=common.Status.NOT_FOUND,
-                info=f"channel {ch.channel_id} not found")
+            return reject(ch.channel_id, common.Status.NOT_FOUND,
+                          f"channel {ch.channel_id} not found")
         if support.chain.errored():
-            return ordpb.BroadcastResponse(
-                status=common.Status.SERVICE_UNAVAILABLE,
-                info="consenter is in an errored state")
+            return reject(ch.channel_id,
+                          common.Status.SERVICE_UNAVAILABLE,
+                          "consenter is in an errored state")
 
         kind = msgprocessor.classify(ch)
         kname = "config" if kind != msgprocessor.NORMAL else "normal"

@@ -356,6 +356,29 @@ class TestDifferential:
         tpu._sw.verify_batch = boom
         assert tpu.verify_batch(items) == [e for e, _ in expected_and_items]
 
+    def test_hash_on_host_and_device_hash_agree(self):
+        """The default (host SHA-256 → digest lanes) and the fused
+        device-SHA pipeline (HashOnHost: false) must be bit-identical
+        on a mixed valid/tampered/digest-lane batch — and both must run
+        the device path, not the sw fallback."""
+        expected_and_items = _corpus()
+        items = [it for _, it in expected_and_items]
+        expected = [e for e, _ in expected_and_items]
+        host = TPUProvider(min_batch=4, hash_on_host=True)
+        dev = TPUProvider(min_batch=4, hash_on_host=False)
+
+        def boom(_items):
+            raise AssertionError("sw fallback ran; device path failed")
+        host._sw.verify_batch = boom
+        dev._sw.verify_batch = boom
+        got_host = host.verify_batch(items)
+        got_dev = dev.verify_batch(items)
+        assert got_host == expected
+        assert got_dev == expected
+        # prove the modes actually diverged in staging
+        assert host.stats["host_hashed_lanes"] > 0
+        assert dev.stats["host_hashed_lanes"] == 0
+
     def test_oversize_message_hashes_host_side_on_device_path(self):
         """A message beyond the SHA block budget (nb bucket = None) must
         be hashed host-side and the batch still verified on-device."""

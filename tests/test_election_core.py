@@ -198,8 +198,10 @@ class TestNodeTransport:
         from fabric_tpu.gossip.node import GossipNode
         from fabric_tpu.protos import gossip as gpb
 
+        from fabric_tpu.gossip.node import GossipMetrics
         node = GossipNode.__new__(GossipNode)
         node.cfg = SimpleNamespace(fanout=8)
+        node.metrics = GossipMetrics()
         node._lock = threading.Lock()
         node._leadership_seen = {}
         node.discovery = SimpleNamespace(
@@ -238,8 +240,10 @@ class TestNodeTransport:
         from fabric_tpu.gossip.node import GossipNode
         from fabric_tpu.protos import gossip as gpb
 
+        from fabric_tpu.gossip.node import GossipMetrics
         node = GossipNode.__new__(GossipNode)
         node.cfg = SimpleNamespace(fanout=8)
+        node.metrics = GossipMetrics()
         node._lock = threading.Lock()
         node._leadership_seen = {}
         node.discovery = SimpleNamespace(
