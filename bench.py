@@ -104,8 +104,10 @@ def bench_idemix(prov) -> dict:
     sw_msp.setup(idemix_msp_config("AnonBLS", issuer))
     sample = idents[:4]
     t0 = t.perf_counter()
-    assert all(sw_msp.validate_credentials_batch(sample))
+    sample_ok = sw_msp.validate_credentials_batch(sample)
     host_per_cred = (t.perf_counter() - t0) / len(sample)
+    if not all(sample_ok):
+        raise RuntimeError("host pairing rejected valid credentials")
     ncpu = os.cpu_count() or 1
     host_ideal = ncpu / host_per_cred
     return {
@@ -168,7 +170,9 @@ def bench_blocksig(prov) -> dict:
                 message=m))
         batches.append(items)
     # warm
-    assert all(prov.verify_batch(batches[0]))
+    warm_ok = prov.verify_batch(batches[0])
+    if not all(warm_ok):
+        raise RuntimeError("valid warm-up set rejected")
     lat = []
     t_all0 = t.perf_counter()
     for items in batches:
@@ -236,11 +240,12 @@ def main():
     sign_s = time.perf_counter() - t0
 
     # --- CPU baseline: single-thread verify, ideal-scaled to all cores ---
+    sample = min(CPU_SAMPLE, batch)
     t0 = time.perf_counter()
-    for i in range(CPU_SAMPLE):
+    for i in range(sample):
         privs[i % NKEYS].public_key().verify(
             items[i].signature, msgs[i], ec.ECDSA(hashes.SHA256()))
-    cpu_per_sig = (time.perf_counter() - t0) / CPU_SAMPLE
+    cpu_per_sig = (time.perf_counter() - t0) / sample
     ncpu = os.cpu_count() or 1
     cpu_sigs_per_s = ncpu / cpu_per_sig          # ideal scaling credit
 
@@ -277,23 +282,22 @@ def main():
     bucket = prov._bucket(batch)       # the shape verify_batch compiled
     if prov._hash_on_host:
         # the shipped default: host SHA-256 → 32-byte digest lanes,
-        # device runs pure ECDSA on nb=1 empty blocks (same shapes
-        # verify_batch compiled)
+        # device runs pure ECDSA; the block tensor is inert shape
+        # (mirrors _verify_batch_device's fast path)
         import hashlib
-        nb = 1
-        blocks, nblocks = sha256.pack_messages([b""] * bucket, nb)
+        blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
         nblocks = np.zeros(bucket, dtype=np.int32)
         digests0 = np.zeros((bucket, 8), dtype=np.uint32)
         for i, m in enumerate(msgs):
             digests0[i] = np.frombuffer(
                 hashlib.sha256(m).digest(), dtype=">u4")
-        nodigest = np.ones(bucket, dtype=bool)   # has_digest per lane
+        has_digest = np.ones(bucket, dtype=bool)
     else:
         nb = prov._nb_bucket(MSG_LEN)
         blocks, nblocks = sha256.pack_messages(
             msgs + [b""] * (bucket - batch), nb)
         digests0 = np.zeros((bucket, 8), dtype=np.uint32)
-        nodigest = np.zeros(bucket, dtype=bool)
+        has_digest = np.zeros(bucket, dtype=bool)
     ok_n, r_b, rpn_b, w_b = native.batch_prep(
         [it.signature for it in items])
     assert ok_n.all()
@@ -338,7 +342,7 @@ def main():
         staged.append(tuple(jnp.asarray(a) for a in (
             blocks[lo:hi], nblocks[lo:hi], key_idx[lo:hi],
             r_l[lo:hi], rpn_l[lo:hi], w_l[lo:hi], premask[lo:hi],
-            digests0[lo:hi], nodigest[lo:hi])))
+            digests0[lo:hi], has_digest[lo:hi])))
     jax.block_until_ready(staged)
 
     def run_chunks():

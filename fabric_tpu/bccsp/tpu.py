@@ -260,6 +260,18 @@ class TPUProvider(api.BCCSP):
                     hashed += 1
             self.stats["host_hashed_lanes"] += hashed
             max_len = 0
+            # every lane is a digest (or dead) lane: the SHA stage is
+            # select-injected away, so the block tensor is just shape —
+            # build the zeros directly instead of packing 32k empties
+            blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
+            nblocks = np.zeros(bucket, dtype=np.int32)
+            r_l = limb.be_bytes_to_limbs(r_b)
+            rpn_l = limb.be_bytes_to_limbs(rpn_b)
+            w_l = limb.be_bytes_to_limbs(w_b)
+            return self._finish_dispatch(
+                bucket, key_map, key_idx, blocks, nblocks, r_l, rpn_l,
+                w_l, premask, digests, has_digest, qx_b, qy_b, n,
+                items, sw_lanes)
         nb = self._nb_bucket(max_len)
         if nb is None:
             # a message too large for the block budget: hash host-side and
@@ -284,6 +296,17 @@ class TPUProvider(api.BCCSP):
         r_l = limb.be_bytes_to_limbs(r_b)
         rpn_l = limb.be_bytes_to_limbs(rpn_b)
         w_l = limb.be_bytes_to_limbs(w_b)
+        return self._finish_dispatch(
+            bucket, key_map, key_idx, blocks, nblocks, r_l, rpn_l, w_l,
+            premask, digests, has_digest, qx_b, qy_b, n, items,
+            sw_lanes)
+
+    def _finish_dispatch(self, bucket, key_map, key_idx, blocks,
+                         nblocks, r_l, rpn_l, w_l, premask, digests,
+                         has_digest, qx_b, qy_b, n, items, sw_lanes):
+        import jax.numpy as jnp
+
+        from fabric_tpu.ops import limb
 
         if 0 < len(key_map) <= self._max_keys:
             self.stats["comb_batches"] += 1

@@ -14,6 +14,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -96,6 +97,47 @@ void sub_mod(U256 &a, const U256 &b) {
         add(a, N);
         sub(a, b);
     }
+}
+
+// ---- Montgomery arithmetic mod n (R = 2^256) ----
+// N0INV = -n^-1 mod 2^64; RR = R^2 mod n (both precomputed offline)
+const uint64_t N0INV = 0xccd1c8aaee00bc4fULL;
+const U256 RR = {{0x83244c95be79eea2ULL, 0x4699799c49bd6fa6ULL,
+                  0x2845b2392b6bec59ULL, 0x66e12d94f3d95620ULL}};
+const U256 ONE_U = {{1, 0, 0, 0}};
+
+// out = a*b*R^-1 mod n (CIOS)
+void mont_mul(const U256 &a, const U256 &b, U256 &out) {
+    uint64_t t[6] = {0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < 4; ++i) {
+        unsigned __int128 c = 0;
+        for (int j = 0; j < 4; ++j) {
+            c += (unsigned __int128)t[j] +
+                 (unsigned __int128)a.v[i] * b.v[j];
+            t[j] = (uint64_t)c;
+            c >>= 64;
+        }
+        c += t[4];
+        t[4] = (uint64_t)c;
+        t[5] = (uint64_t)(c >> 64);
+
+        uint64_t m = t[0] * N0INV;
+        c = (unsigned __int128)t[0] + (unsigned __int128)m * N.v[0];
+        c >>= 64;
+        for (int j = 1; j < 4; ++j) {
+            c += (unsigned __int128)t[j] +
+                 (unsigned __int128)m * N.v[j];
+            t[j - 1] = (uint64_t)c;
+            c >>= 64;
+        }
+        c += t[4];
+        t[3] = (uint64_t)c;
+        t[4] = t[5] + (uint64_t)(c >> 64);
+        t[5] = 0;
+    }
+    U256 res = {{t[0], t[1], t[2], t[3]}};
+    if (t[4] || cmp(res, N) >= 0) sub(res, N);
+    out = res;
 }
 
 // out = in^-1 mod n via binary extended GCD; in must be in (0, n)
@@ -215,12 +257,10 @@ bool parse_int(Parser &p, U256 &out, bool &fits, bool &nonpos) {
 
 }  // namespace
 
-extern "C" {
-
-// One signature: parse + policy gates + scalar prep.
-// Returns 1 and fills r/rpn/w (32-byte big-endian each) on acceptance.
-int ftpu_prep_one(const uint8_t *der, int32_t der_len, uint8_t *r_out,
-                  uint8_t *rpn_out, uint8_t *w_out) {
+// Parse + policy gates + r/rpn staging; s returned for the caller to
+// invert (singly or via the batched Montgomery trick).
+int prep_parse(const uint8_t *der, int32_t der_len, uint8_t *r_out,
+               uint8_t *rpn_out, U256 &s_out) {
     Parser p{der, der_len, 0, false};
     if (der_len <= 0 || der[0] != 0x30) return 0;
     p.off = 1;
@@ -241,8 +281,6 @@ int ftpu_prep_one(const uint8_t *der, int32_t der_len, uint8_t *r_out,
     if (!fits_r || cmp(r, N) >= 0 || is_zero(r)) return 0;
     if (cmp(s, N) >= 0 || is_zero(s)) return 0;
 
-    U256 w;
-    modinv(s, w);
     U256 rpn = r;
     uint64_t carry = add(rpn, N);
     // r+n used only if it stays below the field prime p (no carry and
@@ -250,19 +288,62 @@ int ftpu_prep_one(const uint8_t *der, int32_t der_len, uint8_t *r_out,
     if (carry || cmp(rpn, P) >= 0) rpn = r;
     store_be(r, r_out);
     store_be(rpn, rpn_out);
+    s_out = s;
+    return 1;
+}
+
+extern "C" {
+
+// One signature: parse + policy gates + scalar prep.
+// Returns 1 and fills r/rpn/w (32-byte big-endian each) on acceptance.
+int ftpu_prep_one(const uint8_t *der, int32_t der_len, uint8_t *r_out,
+                  uint8_t *rpn_out, uint8_t *w_out) {
+    U256 s;
+    if (!prep_parse(der, der_len, r_out, rpn_out, s)) return 0;
+    U256 w;
+    modinv(s, w);
     store_be(w, w_out);
     return 1;
 }
 
-// Batch driver: der blob + per-item (offset, length).
+// Batch driver: der blob + per-item (offset, length). The s^-1 mod n
+// for the whole batch costs ONE binary-GCD inversion via Montgomery's
+// batch-inversion trick (prefix products; ~5 Montgomery muls per
+// accepted signature instead of a ~15us GCD each).
 void ftpu_batch_prep(const uint8_t *blob, const int32_t *offs,
                      const int32_t *lens, int32_t n, uint8_t *r_out,
                      uint8_t *rpn_out, uint8_t *w_out,
                      uint8_t *ok_out) {
+    std::vector<U256> s_mont(n), prefix(n);
+    std::vector<int32_t> live(n);
+    int32_t k = 0;
     for (int32_t i = 0; i < n; ++i) {
-        ok_out[i] = (uint8_t)ftpu_prep_one(
+        U256 s;
+        ok_out[i] = (uint8_t)prep_parse(
             blob + offs[i], lens[i], r_out + 32 * i, rpn_out + 32 * i,
-            w_out + 32 * i);
+            s);
+        if (!ok_out[i]) continue;
+        mont_mul(s, RR, s_mont[k]);        // to Montgomery domain
+        if (k == 0) prefix[0] = s_mont[0];
+        else mont_mul(prefix[k - 1], s_mont[k], prefix[k]);
+        live[k] = i;
+        ++k;
+    }
+    if (k == 0) return;
+    // invert the full prefix product: one real inversion
+    U256 pf, ipf, acc;
+    mont_mul(prefix[k - 1], ONE_U, pf);    // out of Montgomery domain
+    modinv(pf, ipf);
+    mont_mul(ipf, RR, acc);                // back into the domain
+    for (int32_t j = k - 1; j >= 0; --j) {
+        U256 inv_j, w;
+        if (j > 0) mont_mul(acc, prefix[j - 1], inv_j);
+        else inv_j = acc;
+        mont_mul(inv_j, ONE_U, w);         // out of Montgomery domain
+        store_be(w, w_out + 32 * live[j]);
+        U256 next;
+        mont_mul(acc, s_mont[j], next);
+        acc = next;
     }
 }
 
