@@ -23,6 +23,7 @@ outage must degrade, not halt (SURVEY §7 step 3).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional, Sequence
 
@@ -43,7 +44,8 @@ class TPUProvider(api.BCCSP):
                  max_blocks: int = 64, mesh=None, max_keys: int = 16,
                  chunk: int = 32768, use_g16: Optional[bool] = None,
                  table_cache_bytes: int = 6 << 30,
-                 hash_on_host: bool = True):
+                 hash_on_host: bool = True,
+                 warm_keys_dir: Optional[str] = None):
         self._sw = swmod.SWProvider(keystore)
         self._min_batch = min_batch
         self._max_blocks = max_blocks
@@ -71,6 +73,10 @@ class TPUProvider(api.BCCSP):
         # evicted least-recently-used.
         self._use_g16 = use_g16
         self._table_cache_bytes = table_cache_bytes
+        # org key sets persist across restarts so prewarm can rebuild
+        # their Q tables BEFORE the first block needs them (the comb
+        # tables are data, not code — the XLA cache can't carry them)
+        self._warm_keys_dir = warm_keys_dir
         self._qflat_cache: dict = {}     # key-set tuple -> q16 table (LRU)
         self._qflat_cache_bytes = 0
         self._fn = None             # lazily-built generic jitted pipeline
@@ -382,7 +388,81 @@ class TPUProvider(api.BCCSP):
         self._qflat_cache[cache_key] = q_flat
         self._qflat_cache_bytes += q_flat.size * 4
         self.stats["q16_cache_bytes"] = self._qflat_cache_bytes
+        self._record_warm_keys(cache_key)
         return q_flat
+
+    # -- warm-key persistence (restart-to-first-block latency) --
+
+    _WARM_FILE = "warm_keysets.json"
+    _WARM_MAX_SETS = 8
+
+    def _record_warm_keys(self, cache_key) -> None:
+        """Persist the key set (pubkey bytes, canonical order) so the
+        next process's prewarm rebuilds its tables before the first
+        block arrives. Best-effort: failures only log."""
+        if not self._warm_keys_dir:
+            return
+        try:
+            import json
+            os.makedirs(self._warm_keys_dir, exist_ok=True)
+            path = os.path.join(self._warm_keys_dir, self._WARM_FILE)
+            sets = self._load_warm_keys()
+            entry = [kb.hex() for kb in cache_key]
+            if entry in sets:
+                sets.remove(entry)
+            sets.insert(0, entry)          # MRU first
+            del sets[self._WARM_MAX_SETS:]
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(sets, f)
+            os.replace(tmp, path)
+        except Exception:
+            logger.exception("could not persist warm key set")
+
+    def _load_warm_keys(self) -> list:
+        if not self._warm_keys_dir:
+            return []
+        import json
+        path = os.path.join(self._warm_keys_dir, self._WARM_FILE)
+        try:
+            with open(path) as f:
+                sets = json.load(f)
+            return [s for s in sets
+                    if isinstance(s, list) and
+                    all(isinstance(k, str) and len(k) == 128
+                        for k in s)]
+        except FileNotFoundError:
+            return []
+        except Exception:
+            logger.exception("unreadable warm key sets; ignoring")
+            return []
+
+    def _prewarm_tables(self) -> int:
+        """Rebuild the Q tables for every persisted key set (and the
+        G table). Returns the number of sets warmed."""
+        from fabric_tpu.ops import limb
+        sets = self._load_warm_keys()
+        warmed = 0
+        for entry in reversed(sets):       # oldest first, MRU last
+            try:
+                order = [bytes.fromhex(k) for k in entry]
+                K = 1
+                while K < len(order):
+                    K *= 2
+                qk = np.zeros((K, 64), dtype=np.uint8)
+                for i, kb in enumerate(order):
+                    qk[i] = np.frombuffer(kb, dtype=np.uint8)
+                if self._q16_cached(
+                        tuple(order), K,
+                        limb.be_bytes_to_limbs(qk[:, :32]),
+                        limb.be_bytes_to_limbs(qk[:, 32:])) is not None:
+                    warmed += 1
+            except Exception:
+                logger.exception("warm table build failed for one set")
+        if warmed:
+            logger.info("prewarmed Q tables for %d persisted key "
+                        "set(s)", warmed)
+        return warmed
 
     def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
                        r_l, rpn_l, w_l, premask, digests, has_digest):
