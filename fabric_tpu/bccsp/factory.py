@@ -44,6 +44,11 @@ class TpuOpts:
     # (reference-matching CPU hash; minimal device transfer). False:
     # fuse SHA-256 into the device pipeline (PCIe-attached hosts).
     hash_on_host: bool = True
+    # directory where the provider persists the org key sets it has
+    # built Q tables for, so `prewarm()` rebuilds them BEFORE the first
+    # block after a restart (node assembly defaults this under
+    # peer.fileSystemPath); None disables persistence
+    warm_keys_dir: Optional[str] = None
 
 
 @dataclass
@@ -79,6 +84,7 @@ class FactoryOpts:
                 table_cache_bytes=(
                     int(tpu_cfg.get("TableCacheMB", 6144)) << 20),
                 hash_on_host=bool(tpu_cfg.get("HashOnHost", True)),
+                warm_keys_dir=tpu_cfg.get("WarmKeysDir") or None,
             ),
         )
 
@@ -102,7 +108,8 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            chunk=opts.tpu.chunk,
                            use_g16=opts.tpu.use_g16,
                            table_cache_bytes=opts.tpu.table_cache_bytes,
-                           hash_on_host=opts.tpu.hash_on_host)
+                           hash_on_host=opts.tpu.hash_on_host,
+                           warm_keys_dir=opts.tpu.warm_keys_dir)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

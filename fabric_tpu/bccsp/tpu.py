@@ -79,6 +79,13 @@ class TPUProvider(api.BCCSP):
         self._warm_keys_dir = warm_keys_dir
         self._qflat_cache: dict = {}     # key-set tuple -> q16 table (LRU)
         self._qflat_cache_bytes = 0
+        # adaptive anti-thrash state: when the working set of key sets
+        # exceeds the byte budget, pin the resident tables and serve
+        # the overflow sets on the 8-bit path instead of rebuilding
+        # multi-minute tables every few blocks (see _q16_cached)
+        self._q16_batch_no = 0           # lookup counter (time base)
+        self._q16_last_use: dict = {}    # cache_key -> batch no
+        self._q16_denied: dict = {}      # cache_key -> batch no denied
         self._fn = None             # lazily-built generic jitted pipeline
         self._comb_fns = {}         # (K, q16) -> jitted comb pipeline
         self._qtab_fns = {}         # K -> jitted table builder
@@ -90,6 +97,7 @@ class TPUProvider(api.BCCSP):
                       "host_hashed_lanes": 0,
                       "q16_builds": 0, "q16_evictions": 0,
                       "q16_oversize_skips": 0, "q16_cache_bytes": 0,
+                      "q16_adaptive_skips": 0,
                       "nonp256_sw_lanes": 0}
 
     @staticmethod
@@ -112,17 +120,16 @@ class TPUProvider(api.BCCSP):
         """Pick the tree-reduction implementation for the comb path.
 
         "pallas" (ops/ptree.py — the whole complete-add tree in VMEM)
-        on real TPU backends; "xla" on CPU meshes and under GSPMD
-        sharding (a pallas_call is a custom call XLA cannot
-        auto-partition; the mesh path keeps the fusion-island graph).
-        FTPU_PALLAS=0/1 overrides for experiments.
+        on real TPU backends; "xla" on CPU meshes. Under a device mesh
+        the comb pipeline runs inside `shard_map` (per-shard programs,
+        not GSPMD auto-partitioning), so the pallas tree is legal there
+        too — each shard issues its own pallas_call over its local
+        batch. FTPU_PALLAS=0/1 overrides for experiments.
         """
         import os
         env = os.environ.get("FTPU_PALLAS")
         if env is not None:
             return "pallas" if env == "1" else "xla"
-        if self._mesh is not None:
-            return "xla"
         return "pallas" if self._on_tpu() else "xla"
 
     # -- everything non-batch delegates (pkcs11-style containment) --
@@ -307,18 +314,23 @@ class TPUProvider(api.BCCSP):
             premask, digests, has_digest, qx_b, qy_b, n, items,
             sw_lanes)
 
-    def _finish_dispatch(self, bucket, key_map, key_idx, blocks,
+    def _dispatch_arrays(self, bucket, key_map, key_idx, blocks,
                          nblocks, r_l, rpn_l, w_l, premask, digests,
-                         has_digest, qx_b, qy_b, n, items, sw_lanes):
+                         has_digest, qx_b, qy_b, async_out=False):
+        """Array core shared by the item path and the prepared-block
+        path: comb (bounded key count) or generic ladder dispatch.
+        With async_out the DISPATCH happens now and a thunk returning
+        the materialized np result is returned (jax compute proceeds
+        in the background while the caller works)."""
         import jax.numpy as jnp
 
         from fabric_tpu.ops import limb
 
         if 0 < len(key_map) <= self._max_keys:
             self.stats["comb_batches"] += 1
-            out = self._dispatch_comb(bucket, key_map, key_idx, blocks,
-                                      nblocks, r_l, rpn_l, w_l, premask,
-                                      digests, has_digest)
+            thunk = self._dispatch_comb(
+                bucket, key_map, key_idx, blocks, nblocks, r_l, rpn_l,
+                w_l, premask, digests, has_digest, async_out=True)
         else:
             self.stats["ladder_batches"] += 1
             qx_l = limb.be_bytes_to_limbs(qx_b)
@@ -326,7 +338,16 @@ class TPUProvider(api.BCCSP):
             args = tuple(jnp.asarray(a) for a in
                          (blocks, nblocks, qx_l, qy_l, r_l, rpn_l, w_l,
                           premask, digests, has_digest))
-            out = np.asarray(self._pipeline()(*args))
+            out = self._pipeline()(*args)
+            thunk = lambda: np.asarray(out)  # noqa: E731
+        return thunk if async_out else thunk()
+
+    def _finish_dispatch(self, bucket, key_map, key_idx, blocks,
+                         nblocks, r_l, rpn_l, w_l, premask, digests,
+                         has_digest, qx_b, qy_b, n, items, sw_lanes):
+        out = self._dispatch_arrays(bucket, key_map, key_idx, blocks,
+                                    nblocks, r_l, rpn_l, w_l, premask,
+                                    digests, has_digest, qx_b, qy_b)
         result = out[:n].tolist()
         if sw_lanes:
             self.stats["nonp256_sw_lanes"] += len(sw_lanes)
@@ -334,6 +355,163 @@ class TPUProvider(api.BCCSP):
             for i, v in zip(sw_lanes, sub):
                 result[i] = v
         return result
+
+    # -- the prepared-block path (native host pipeline) --
+
+    def verify_prepared(self, digests: np.ndarray, r: np.ndarray,
+                        rpn: np.ndarray, w: np.ndarray,
+                        der_ok: np.ndarray, key_idx: np.ndarray,
+                        keys, get_sig) -> list[bool]:
+        return self.verify_prepared_start(
+            digests, r, rpn, w, der_ok, key_idx, keys, get_sig)()
+
+    def verify_prepared_start(self, digests: np.ndarray, r: np.ndarray,
+                              rpn: np.ndarray, w: np.ndarray,
+                              der_ok: np.ndarray, key_idx: np.ndarray,
+                              keys, get_sig):
+        """Batched verify over pre-staged operand arrays.
+
+        The host pipeline (native/blockprep.cpp via the TxValidator
+        fast path) has already: hashed every lane to a 32-byte digest,
+        DER-parsed + policy-gated each signature (der_ok), computed
+        r/rpn/w big-endian scalars, and grouped lanes by key via
+        `key_idx` into `keys` (bccsp Key objects, one per unique key).
+        `get_sig(i)` returns lane i's DER bytes — only consulted on the
+        sw paths (small batch, non-P256 key, device failure).
+
+        Returns a RESOLVER: staging + the device dispatch happen now
+        (jax dispatch is async), calling the resolver materializes the
+        flags — so the caller's CPU work (policy preparation) overlaps
+        device execution. `verify_prepared(...)` is the synchronous
+        wrapper.
+
+        Per-lane accept/reject is IDENTICAL to verify_batch over the
+        equivalent VerifyItems (differential-tested); only the staging
+        cost differs.
+        """
+        n = len(der_ok)
+        if n == 0:
+            return lambda: []
+        pubs = []
+        for k in keys:
+            try:
+                pub = k.public_key() if k is not None else None
+            except Exception:
+                pub = None
+            pubs.append(pub if isinstance(pub, swmod.ECDSAPublicKey)
+                        else None)
+        if n < self._min_batch:
+            out = self._verify_prepared_sw(
+                range(n), digests, key_idx, keys, pubs, get_sig)
+            return lambda: out
+
+        def fallback():
+            self.stats["sw_fallbacks"] += 1
+            logger.exception("TPU prepared-batch verify failed; "
+                             "falling back to sw for %d lanes", n)
+            return self._verify_prepared_sw(
+                range(n), digests, key_idx, keys, pubs, get_sig)
+
+        try:
+            resolve = self._verify_prepared_device(
+                digests, r, rpn, w, der_ok, key_idx, keys, pubs,
+                get_sig)
+        except Exception:
+            out = fallback()
+            return lambda: out
+
+        def finish():
+            try:
+                return resolve()
+            except Exception:
+                return fallback()
+        return finish
+
+    def _verify_prepared_sw(self, lanes, digests, key_idx, keys, pubs,
+                            get_sig) -> list[bool]:
+        out = []
+        for i in lanes:
+            k = keys[key_idx[i]]
+            if k is None:
+                out.append(False)
+                continue
+            try:
+                out.append(self._sw.verify(
+                    k, get_sig(i), digests[i].tobytes()))
+            except Exception:
+                out.append(False)
+        return out
+
+    def _verify_prepared_device(self, digests, r, rpn, w, der_ok,
+                                key_idx, keys, pubs, get_sig
+                                ) -> list[bool]:
+        from fabric_tpu.ops import limb
+
+        n = len(der_ok)
+        bucket = self._bucket(n)
+        premask = np.zeros(bucket, dtype=bool)
+        premask[:n] = der_ok.astype(bool)
+
+        # per-key gating: lanes on a non-ECDSA key reject; lanes on a
+        # non-P256 ECDSA key verify on the sw path without degrading
+        # the batch (same contract as the item path)
+        key_ok = np.array([p is not None and p.is_p256()
+                           for p in pubs], dtype=bool)
+        key_sw = np.array([p is not None and not p.is_p256()
+                           for p in pubs], dtype=bool)
+        lane_key = np.asarray(key_idx, dtype=np.int32)
+        premask[:n] &= key_ok[lane_key]
+        sw_lanes = np.nonzero(key_sw[lane_key])[0]
+
+        key_map: dict[bytes, int] = {}
+        qx_b = np.zeros((bucket, 32), dtype=np.uint8)
+        qy_b = np.zeros((bucket, 32), dtype=np.uint8)
+        # build the key table over P-256 keys only; dead lanes keep
+        # slot 0 (masked out by premask)
+        slot_of = np.zeros(len(keys), dtype=np.int32)
+        kx = np.zeros((max(len(keys), 1), 32), dtype=np.uint8)
+        ky = np.zeros((max(len(keys), 1), 32), dtype=np.uint8)
+        for j, p in enumerate(pubs):
+            if p is None or not p.is_p256():
+                continue
+            xb = np.asarray(p.x_bytes(), dtype=np.uint8)
+            yb = np.asarray(p.y_bytes(), dtype=np.uint8)
+            kbytes = xb.tobytes() + yb.tobytes()
+            slot_of[j] = key_map.setdefault(kbytes, len(key_map))
+            kx[j] = xb
+            ky[j] = yb
+        lane_slot = np.zeros(bucket, dtype=np.int32)
+        lane_slot[:n] = slot_of[lane_key]
+        qx_b[:n] = kx[lane_key]
+        qy_b[:n] = ky[lane_key]
+
+        dg = np.zeros((bucket, 8), dtype=np.uint32)
+        dg[:n] = np.ascontiguousarray(digests).view(">u4").reshape(n, 8)
+        blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
+        nblocks = np.zeros(bucket, dtype=np.int32)
+        has_digest = np.ones(bucket, dtype=bool)
+
+        def pad32(a):
+            out = np.zeros((bucket, 32), dtype=np.uint8)
+            out[:n] = a
+            return limb.be_bytes_to_limbs(out)
+
+        thunk = self._dispatch_arrays(
+            bucket, key_map, lane_slot, blocks, nblocks, pad32(r),
+            pad32(rpn), pad32(w), premask, dg, has_digest, qx_b, qy_b,
+            async_out=True)
+
+        def resolve() -> list[bool]:
+            result = thunk()[:n].tolist()
+            if len(sw_lanes):
+                self.stats["nonp256_sw_lanes"] += len(sw_lanes)
+                sub = self._verify_prepared_sw(
+                    sw_lanes.tolist(), digests, key_idx, keys, pubs,
+                    get_sig)
+                for i, v in zip(sw_lanes.tolist(), sub):
+                    result[i] = v
+            return result
+        return resolve
 
     @staticmethod
     def _canonical_key_order(key_map: dict, key_idx: np.ndarray):
@@ -355,17 +533,28 @@ class TPUProvider(api.BCCSP):
         from fabric_tpu.ops import comb, limb
         return comb.NWIN_G16 * K * comb.NENT_G16 * 3 * limb.L * 4
 
+    # a victim used within this many lookups is "hot" — never evicted
+    # for a newcomer; the newcomer is denied q16 for _DENY_TTL lookups
+    # instead (stability beats fairness: a working set larger than the
+    # budget pins the resident tables and serves the overflow on the
+    # 8-bit path, rather than rebuilding multi-minute tables per block)
+    _HOT_WINDOW = 16
+    _DENY_TTL = 256
+
     def _q16_cached(self, cache_key, K, qx_k, qy_k):
         """LRU per-key-set 16-bit Q table, bounded by total bytes.
 
-        Returns None when a single table for this K would blow the
-        byte budget — the caller then stays on the 8-bit Q path rather
-        than thrashing HBM (the G side keeps its 16-bit table either
-        way)."""
+        Returns None when this key set should stay on the 8-bit Q path:
+        a single table would blow the byte budget (oversize), or the
+        budget is full of recently-used tables (adaptive anti-thrash).
+        The G side keeps its 16-bit table either way."""
         import jax.numpy as jnp
+        self._q16_batch_no += 1
+        now = self._q16_batch_no
         q_flat = self._qflat_cache.pop(cache_key, None)
         if q_flat is not None:
             self._qflat_cache[cache_key] = q_flat   # move to MRU
+            self._q16_last_use[cache_key] = now
             return q_flat
         est = self._q16_est_bytes(K)
         if est > self._table_cache_bytes:
@@ -377,9 +566,29 @@ class TPUProvider(api.BCCSP):
                 "flagship configuration", K, est / 2**30,
                 self._table_cache_bytes / 2**30)
             return None
+        denied_at = self._q16_denied.get(cache_key)
+        if denied_at is not None and now - denied_at < self._DENY_TTL:
+            self.stats["q16_adaptive_skips"] += 1
+            return None
         while (self._qflat_cache
                and self._qflat_cache_bytes + est > self._table_cache_bytes):
-            evicted = self._qflat_cache.pop(next(iter(self._qflat_cache)))
+            victim = next(iter(self._qflat_cache))
+            if now - self._q16_last_use.get(victim, 0) < \
+                    self._HOT_WINDOW:
+                # every resident table is in active use: adding this
+                # set would thrash — deny it the 16-bit path for a
+                # while and surface the decision
+                self._q16_denied[cache_key] = now
+                self.stats["q16_adaptive_skips"] += 1
+                logger.warning(
+                    "q16 table budget (%.1f GB) is full of hot key "
+                    "sets; serving this %d-key set on the 8-bit path "
+                    "(bccsp_q16_adaptive_skips counts these — raise "
+                    "BCCSP.TPU.TableCacheMB to fit the working set)",
+                    self._table_cache_bytes / 2**30, K)
+                return None
+            evicted = self._qflat_cache.pop(victim)
+            self._q16_last_use.pop(victim, None)
             self._qflat_cache_bytes -= evicted.size * 4
             self.stats["q16_evictions"] += 1
         q8 = self._qtab_fn(K)(jnp.asarray(qx_k), jnp.asarray(qy_k))
@@ -387,6 +596,8 @@ class TPUProvider(api.BCCSP):
         self.stats["q16_builds"] += 1
         self._qflat_cache[cache_key] = q_flat
         self._qflat_cache_bytes += q_flat.size * 4
+        self._q16_last_use[cache_key] = now
+        self._q16_denied.pop(cache_key, None)
         self.stats["q16_cache_bytes"] = self._qflat_cache_bytes
         self._record_warm_keys(cache_key)
         return q_flat
@@ -465,7 +676,8 @@ class TPUProvider(api.BCCSP):
         return warmed
 
     def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
-                       r_l, rpn_l, w_l, premask, digests, has_digest):
+                       r_l, rpn_l, w_l, premask, digests, has_digest,
+                       async_out=False):
         """Comb-method path: per-key tables built once, then the batch is
         dispatched in chunks so host staging of chunk k+1 overlaps device
         execution of chunk k (jax dispatch is async)."""
@@ -497,7 +709,31 @@ class TPUProvider(api.BCCSP):
                                       jnp.asarray(qy_k))
             g16 = jnp.zeros((0, 3, r_l.shape[-1]), dtype=jnp.int32)
 
+        if self._mesh is not None:
+            # replicate the tables onto the mesh ONCE: the replicated
+            # arrays are stored back (q16 cache / provider attribute)
+            # so later dispatches pass already-placed arrays and the
+            # device_put short-circuits instead of re-broadcasting
+            # gigabytes per block. Chunk slices stay divisible by the
+            # mesh size for shard_map.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self._mesh, P())
+            q_flat = jax.device_put(q_flat, rep)
+            if q16 and tuple(order) in self._qflat_cache:
+                self._qflat_cache[tuple(order)] = q_flat
+            if getattr(g16, "size", 0):
+                cached = getattr(self, "_g16_rep", None)
+                if cached is None:
+                    cached = jax.device_put(g16, rep)
+                    self._g16_rep = cached
+                g16 = cached
+            else:
+                g16 = jax.device_put(g16, rep)
         chunk = min(bucket, self._chunk)
+        if self._mesh is not None:
+            m = self._mesh.size
+            chunk = max(m, (chunk // m) * m)
         fn = self._comb_pipeline(K, q16)
         outs = []
         for lo in range(0, bucket, chunk):
@@ -509,7 +745,9 @@ class TPUProvider(api.BCCSP):
                 jnp.asarray(w_l[lo:hi]), jnp.asarray(premask[lo:hi]),
                 jnp.asarray(digests[lo:hi]),
                 jnp.asarray(has_digest[lo:hi])))
-        return np.concatenate([np.asarray(o) for o in outs])
+        thunk = lambda: np.concatenate(  # noqa: E731
+            [np.asarray(o) for o in outs])
+        return thunk if async_out else thunk()
 
     def _qtab_fn(self, K: int):
         with self._jit_lock:
@@ -555,13 +793,19 @@ class TPUProvider(api.BCCSP):
                     g16=g16 if use_g16 else None, q16=q16, tree=tree)
 
             if self._mesh is not None:
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                s = NamedSharding(self._mesh, P("batch"))
-                rep = NamedSharding(self._mesh, P())
-                self._comb_fns[key] = jax.jit(
-                    fused,
-                    in_shardings=(s, s, s, rep, rep, s, s, s, s, s, s),
-                    out_shardings=s)
+                # shard_map, not GSPMD: the flagship q16 + pallas-tree
+                # configuration contains a pallas_call XLA cannot
+                # auto-partition, but as a per-shard program each chip
+                # simply combs its own batch slice against replicated
+                # tables — no collectives in the main path at all
+                from jax import shard_map
+                from jax.sharding import PartitionSpec as P
+                s = P("batch")
+                rep = P()
+                self._comb_fns[key] = jax.jit(shard_map(
+                    fused, mesh=self._mesh,
+                    in_specs=(s, s, s, rep, rep, s, s, s, s, s, s),
+                    out_specs=s, check_vma=False))
             else:
                 self._comb_fns[key] = jax.jit(fused)
         return self._comb_fns[key]
@@ -607,6 +851,12 @@ class TPUProvider(api.BCCSP):
             q16 = self._g16_enabled()
             if q16:
                 comb.g16_tables()
+                # rebuild the Q tables for the key sets persisted by the
+                # previous process FIRST — they are the multi-minute
+                # cost a restarted peer would otherwise pay on its first
+                # block (the XLA cache below covers only code, not the
+                # table data)
+                self._prewarm_tables()
             for K in key_counts:
                 ent = (comb.NWIN_G16 * comb.NENT_G16 if q16
                        else comb.NWIN * comb.NENT)

@@ -26,9 +26,18 @@ def _split_method(full_method: str) -> tuple[str, str]:
 
 def _abort_code(context) -> str:
     """The status a handler set via context.abort/set_code, if any
-    (grpc Python surfaces aborts as bare exceptions — the real code
-    lives on the servicer context state)."""
-    code = getattr(getattr(context, "_state", None), "code", None)
+    (grpc Python surfaces aborts as bare exceptions). Prefer the
+    public `context.code()` accessor; fall back to the private state
+    attribute on grpcio versions that lack it."""
+    code = None
+    code_fn = getattr(context, "code", None)
+    if callable(code_fn):
+        try:
+            code = code_fn()
+        except Exception:
+            code = None
+    if code is None:
+        code = getattr(getattr(context, "_state", None), "code", None)
     return code.name if code is not None else "INTERNAL"
 
 
@@ -98,6 +107,11 @@ class ConcurrencyLimiter(grpc.ServerInterceptor):
         if handler.unary_stream:
             return grpc.unary_stream_rpc_method_handler(
                 wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        if handler.stream_unary:
+            return grpc.stream_unary_rpc_method_handler(
+                wrap_unary(handler.stream_unary),
                 request_deserializer=handler.request_deserializer,
                 response_serializer=handler.response_serializer)
         if handler.stream_stream:
@@ -176,6 +190,11 @@ class ServerObservability(grpc.ServerInterceptor):
         if handler.unary_stream:
             return grpc.unary_stream_rpc_method_handler(
                 wrap_stream(handler.unary_stream),
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer)
+        if handler.stream_unary:
+            return grpc.stream_unary_rpc_method_handler(
+                wrap_unary(handler.stream_unary),
                 request_deserializer=handler.request_deserializer,
                 response_serializer=handler.response_serializer)
         if handler.stream_stream:

@@ -127,6 +127,27 @@ def extract_write_info(cc_name: str, txrw, kv_parser, hashed_parser
     return info
 
 
+def memoized_evaluate(cache, pol, identities) -> None:
+    """pol.evaluate_identities with optional block-scope memoing keyed
+    by (policy, identity sequence) object ids — a pure function over
+    block-lifetime objects. Exception-transparent: a cached
+    PolicyError re-raises. cache=None evaluates directly."""
+    if cache is None:
+        pol.evaluate_identities(identities)
+        return
+    key = (id(pol), tuple(map(id, identities)))
+    hit = cache.get(key)
+    if hit is None:
+        try:
+            pol.evaluate_identities(identities)
+            cache[key] = True
+        except papi.PolicyError as e:
+            cache[key] = e
+            raise
+    elif hit is not True:
+        raise hit
+
+
 def resolve_vp_policy(vp_bytes: bytes, evaluator, deserializer, csp):
     """A validation parameter is ApplicationPolicy bytes (the lifecycle
     format) or a bare SignaturePolicyEnvelope (what the reference's
@@ -154,7 +175,15 @@ class KeyLevelPrepared:
                  overlay: BlockOverlay, cc_name: str,
                  metadata_getter: Callable[[Optional[str], str],
                                            Optional[bytes]],
-                 evaluator, deserializer, csp, endorsement_sd):
+                 evaluator, deserializer, csp, endorsement_sd=None,
+                 prepared=None, eval_cache=None, vp_cache=None):
+        """`endorsement_sd` (SignedData list) is the item-path input;
+        the block fast path passes a ready `prepared`
+        (PreparedSignatureSet with already-deduped identities) instead.
+        `eval_cache`/`vp_cache` are optional block-scope memo dicts:
+        policy evaluation is a pure function of (policy, identities)
+        and vp resolution of the parameter bytes, so a block that
+        repeats them (the common case) pays once."""
         self._cc_policy = cc_policy
         self._org_policies = list(org_policies)
         self._info = info
@@ -164,12 +193,17 @@ class KeyLevelPrepared:
         self._evaluator = evaluator
         self._deserializer = deserializer
         self._csp = csp
-        self._prepared = papi.prepare_signature_set(
-            endorsement_sd, deserializer)
+        self._prepared = prepared if prepared is not None else \
+            papi.prepare_signature_set(endorsement_sd, deserializer)
+        self._eval_cache = eval_cache
+        self._vp_cache = vp_cache
 
     @property
     def items(self):
         return self._prepared.items
+
+    def _eval(self, pol, identities) -> None:
+        memoized_evaluate(self._eval_cache, pol, identities)
 
     def _validation_parameter(self, coll: Optional[str],
                               key: str) -> bytes:
@@ -183,7 +217,7 @@ class KeyLevelPrepared:
         identities = self._prepared.finish(flags)
         # implicit-collection org rules always apply to their writes
         for pol in self._org_policies:
-            pol.evaluate_identities(identities)
+            self._eval(pol, identities)
 
         info = self._info
         uncovered = not info.written_keys    # no writes → cc policy
@@ -196,21 +230,28 @@ class KeyLevelPrepared:
             if vp in evaluated:
                 continue
             evaluated.add(vp)
-            try:
-                pol = resolve_vp_policy(vp, self._evaluator,
-                                        self._deserializer, self._csp)
-            except Exception as e:
-                raise papi.PolicyError(
-                    f"unresolvable validation parameter on key "
-                    f"[{self._cc_name}/{coll or ''}/{key}]: {e}") from e
-            pol.evaluate_identities(identities)
+            pol = None if self._vp_cache is None \
+                else self._vp_cache.get(vp)
+            if pol is None:
+                try:
+                    pol = resolve_vp_policy(vp, self._evaluator,
+                                            self._deserializer,
+                                            self._csp)
+                except Exception as e:
+                    raise papi.PolicyError(
+                        f"unresolvable validation parameter on key "
+                        f"[{self._cc_name}/{coll or ''}/{key}]: {e}"
+                    ) from e
+                if self._vp_cache is not None:
+                    self._vp_cache[vp] = pol
+            self._eval(pol, identities)
 
         if info.implicit_orgs and not info.written_keys:
             # a pure _lifecycle approval (implicit-collection writes
             # only) validates against the org rules alone
             return
         if uncovered and self._cc_policy is not None:
-            self._cc_policy.evaluate_identities(identities)
+            self._eval(self._cc_policy, identities)
 
     def record_valid(self) -> None:
         """Called by the validator when this tx's verdict is VALID —

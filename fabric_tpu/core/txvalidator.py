@@ -75,13 +75,13 @@ class TxValidatorMetrics:
         self._txs = provider.new_counter(TXS_VALIDATED)
         self._channel = channel
 
-    def count_tx(self, code: int) -> None:
+    def count_tx(self, code: int, n: int = 1) -> None:
         try:
             name = txpb.TxValidationCode.Name(code)
         except ValueError:
             name = str(code)
         self._txs.with_labels("channel", self._channel,
-                              "code", name).add(1)
+                              "code", name).add(n)
 
 
 @dataclass
@@ -287,6 +287,90 @@ class TxValidator:
 
     # -- the entry point --
 
+    def _phase1_tx(self, i: int, env_bytes: bytes, bundle,
+                   txids_in_block: set) -> tuple[int, Optional[_TxCheck]]:
+        """Phase-1 work for ONE tx: structural checks, creator identity,
+        duplicate-txid, VSCC artifact extraction, validation prepare.
+        Returns (code, check); code == NOT_VALIDATED means the check is
+        pending crypto (its items join the block batch)."""
+        try:
+            env = pu.unmarshal_envelope(env_bytes)
+        except Exception:
+            return TVC.MARSHAL_TX_ERROR, None
+        code, checked = msgvalidation.check_envelope(
+            env, self._channel_id)
+        if code != TVC.NOT_VALIDATED:
+            return code, None
+
+        # creator identity: deserialize + validity now, sig later
+        sd = checked.creator_signed_data
+        try:
+            ident = bundle.msp_manager.deserialize_identity(
+                sd.identity)
+            ident.validate()
+        except Exception as e:
+            logger.debug("tx[%d] creator invalid: %s", i, e)
+            return TVC.BAD_CREATOR_SIGNATURE, None
+        creator_item = ident.verify_item(sd.data, sd.signature)
+
+        if checked.config_envelope is not None:
+            # config txs: creator (orderer) signature joins the
+            # batch; the config itself is replayed against the
+            # running configtx.Validator in phase 3 before the
+            # peer adopts it
+            return TVC.NOT_VALIDATED, _TxCheck(
+                index=i, creator_item=creator_item,
+                config_envelope=checked.config_envelope)
+
+        tx_id = checked.channel_header.tx_id
+        if tx_id in txids_in_block or \
+                self._ledger.get_transaction_by_id(tx_id) is not None:
+            return TVC.DUPLICATE_TXID, None
+        txids_in_block.add(tx_id)
+
+        try:
+            cc_name, endorsement_sd, write_info = \
+                self._extract_endorsement_set(checked)
+        except Exception as e:
+            logger.debug("tx[%d] bad endorsed action: %s", i, e)
+            return TVC.INVALID_ENDORSER_TRANSACTION, None
+        try:
+            prepared = self._prepare_validation(
+                bundle, cc_name, endorsement_sd, write_info)
+        except Exception as e:
+            logger.debug("tx[%d] chaincode %s unresolvable: %s",
+                         i, cc_name, e)
+            return TVC.INVALID_CHAINCODE, None
+        return TVC.NOT_VALIDATED, _TxCheck(
+            index=i, creator_item=creator_item,
+            prepared_policy=prepared, tx_id=tx_id)
+
+    def finish_check(self, c: _TxCheck, creator_ok: bool,
+                     flags) -> int:
+        """Phase-3 verdict for one pending check given its batch
+        results (shared by the reference path and the fast path)."""
+        if not creator_ok:
+            return TVC.BAD_CREATOR_SIGNATURE
+        if c.config_envelope is not None:
+            return self._validate_config_tx(c.index, c.config_envelope)
+        try:
+            c.prepared_policy.finish(flags)
+        except papi.PolicyError as e:
+            logger.debug("tx[%d] endorsement policy failed: %s",
+                         c.index, e)
+            return TVC.ENDORSEMENT_POLICY_FAILURE
+        except Exception as e:
+            logger.warning("tx[%d] validation plugin error: %s",
+                           c.index, e)
+            return TVC.INVALID_OTHER_REASON
+        # a VALID tx's validation-parameter updates become visible
+        # to later txs in this block (reference: vpmanagerimpl
+        # SetTxValidationResult → dependency release)
+        record = getattr(c.prepared_policy, "record_valid", None)
+        if record is not None:
+            record()
+        return TVC.VALID
+
     def validate(self, block: common.Block) -> list[int]:
         """Validate every tx; returns and stamps per-tx validation codes
         (TRANSACTIONS_FILTER — reference validator.go:259). MVCC runs
@@ -297,70 +381,65 @@ class TxValidator:
         # updates (statebased.BlockOverlay)
         self._overlay = statebased.BlockOverlay()
         n = len(block.data.data)
+
+        result = None
+        from fabric_tpu.core import fastvalidate
+        if fastvalidate.available(self._csp):
+            try:
+                result = fastvalidate.validate_fast(self, block, bundle)
+            except Exception:
+                logger.exception(
+                    "fast validation path failed; falling back to the "
+                    "reference path for block [%d]",
+                    block.header.number)
+                self._overlay = statebased.BlockOverlay()
+                result = None
+        if result is None:
+            result = self._validate_reference_path(block, bundle)
+        codes, n_items = result
+
+        # init-extend metadata first (reference protoutil.CopyBlockMetadata
+        # semantics): a block from a rogue orderer may arrive with no
+        # metadata slots at all, and that must invalidate txs, not crash
+        # the deliverer
+        while len(block.metadata.metadata) <= \
+                common.BlockMetadataIndex.TRANSACTIONS_FILTER:
+            block.metadata.metadata.append(b"")
+        block.metadata.metadata[
+            common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(codes)
+        dur = time.perf_counter() - t0
+        self.metrics.validation_duration.observe(dur)
+        self.metrics.signatures_batched.add(n_items)
+        # aggregate per distinct code: validation codes repeat heavily
+        # within a block, so one labeled add per code, not per tx
+        from collections import Counter
+        for code, cnt in Counter(codes).items():
+            self.metrics.count_tx(code, cnt)
+        logger.info("[%s] validated block [%d] in %.0fms (%d txs, "
+                    "%d signatures batched)",
+                    self._channel_id, block.header.number,
+                    dur * 1e3, n, n_items)
+        return codes
+
+    def _validate_reference_path(self, block, bundle
+                                 ) -> tuple[list[int], int]:
+        """The per-tx unmarshal pipeline (semantics oracle). The fast
+        path (core/fastvalidate.py) must agree with this byte for
+        byte; it is also the fallback whenever the native library is
+        unavailable."""
+        n = len(block.data.data)
         codes: list[int] = [TVC.NOT_VALIDATED] * n
         checks: list[_TxCheck] = []
         txids_in_block: set[str] = set()
 
         # ---- phase 1: CPU structural + collect ----
         for i, env_bytes in enumerate(block.data.data):
-            try:
-                env = pu.unmarshal_envelope(env_bytes)
-            except Exception:
-                codes[i] = TVC.MARSHAL_TX_ERROR
-                continue
-            code, checked = msgvalidation.check_envelope(
-                env, self._channel_id)
+            code, check = self._phase1_tx(i, env_bytes, bundle,
+                                          txids_in_block)
             if code != TVC.NOT_VALIDATED:
                 codes[i] = code
-                continue
-
-            # creator identity: deserialize + validity now, sig later
-            sd = checked.creator_signed_data
-            try:
-                ident = bundle.msp_manager.deserialize_identity(
-                    sd.identity)
-                ident.validate()
-            except Exception as e:
-                logger.debug("tx[%d] creator invalid: %s", i, e)
-                codes[i] = TVC.BAD_CREATOR_SIGNATURE
-                continue
-            creator_item = ident.verify_item(sd.data, sd.signature)
-
-            if checked.config_envelope is not None:
-                # config txs: creator (orderer) signature joins the
-                # batch; the config itself is replayed against the
-                # running configtx.Validator in phase 3 before the
-                # peer adopts it
-                checks.append(_TxCheck(
-                    index=i, creator_item=creator_item,
-                    config_envelope=checked.config_envelope))
-                continue
-
-            tx_id = checked.channel_header.tx_id
-            if tx_id in txids_in_block or \
-                    self._ledger.get_transaction_by_id(tx_id) is not None:
-                codes[i] = TVC.DUPLICATE_TXID
-                continue
-            txids_in_block.add(tx_id)
-
-            try:
-                cc_name, endorsement_sd, write_info = \
-                    self._extract_endorsement_set(checked)
-            except Exception as e:
-                logger.debug("tx[%d] bad endorsed action: %s", i, e)
-                codes[i] = TVC.INVALID_ENDORSER_TRANSACTION
-                continue
-            try:
-                prepared = self._prepare_validation(
-                    bundle, cc_name, endorsement_sd, write_info)
-            except Exception as e:
-                logger.debug("tx[%d] chaincode %s unresolvable: %s",
-                             i, cc_name, e)
-                codes[i] = TVC.INVALID_CHAINCODE
-                continue
-            checks.append(_TxCheck(index=i, creator_item=creator_item,
-                                   prepared_policy=prepared,
-                                   tx_id=tx_id))
+            else:
+                checks.append(check)
 
         # ---- phase 2: ONE batched verify for the whole block ----
         items = []
@@ -379,49 +458,5 @@ class TxValidator:
                 if c.prepared_policy is not None else 0
             flags = ok[pos:pos + n_items]
             pos += n_items
-            if not creator_ok:
-                codes[c.index] = TVC.BAD_CREATOR_SIGNATURE
-                continue
-            if c.config_envelope is not None:
-                codes[c.index] = self._validate_config_tx(
-                    c.index, c.config_envelope)
-                continue
-            try:
-                c.prepared_policy.finish(flags)
-            except papi.PolicyError as e:
-                logger.debug("tx[%d] endorsement policy failed: %s",
-                             c.index, e)
-                codes[c.index] = TVC.ENDORSEMENT_POLICY_FAILURE
-                continue
-            except Exception as e:
-                logger.warning("tx[%d] validation plugin error: %s",
-                               c.index, e)
-                codes[c.index] = TVC.INVALID_OTHER_REASON
-                continue
-            codes[c.index] = TVC.VALID
-            # a VALID tx's validation-parameter updates become visible
-            # to later txs in this block (reference: vpmanagerimpl
-            # SetTxValidationResult → dependency release)
-            record = getattr(c.prepared_policy, "record_valid", None)
-            if record is not None:
-                record()
-
-        # init-extend metadata first (reference protoutil.CopyBlockMetadata
-        # semantics): a block from a rogue orderer may arrive with no
-        # metadata slots at all, and that must invalidate txs, not crash
-        # the deliverer
-        while len(block.metadata.metadata) <= \
-                common.BlockMetadataIndex.TRANSACTIONS_FILTER:
-            block.metadata.metadata.append(b"")
-        block.metadata.metadata[
-            common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(codes)
-        dur = time.perf_counter() - t0
-        self.metrics.validation_duration.observe(dur)
-        self.metrics.signatures_batched.add(len(items))
-        for code in codes:
-            self.metrics.count_tx(code)
-        logger.info("[%s] validated block [%d] in %.0fms (%d txs, "
-                    "%d signatures batched)",
-                    self._channel_id, block.header.number,
-                    dur * 1e3, n, len(items))
-        return codes
+            codes[c.index] = self.finish_check(c, creator_ok, flags)
+        return codes, len(items)

@@ -223,6 +223,13 @@ class BlockStore:
             return None
         return struct.unpack(">QIB", loc)
 
+    def existing_tx_ids(self, tx_ids: list[str]) -> set[str]:
+        """The subset of tx_ids already committed — one index probe per
+        block for the validator's duplicate-txid check."""
+        keys = [b"t" + t.encode() for t in tx_ids]
+        found = self._index.get_many(keys)
+        return {t for t, k in zip(tx_ids, keys) if k in found}
+
     def get_tx_by_id(self, tx_id: str) -> Optional[txpb.ProcessedTransaction]:
         loc = self.get_tx_loc(tx_id)
         if loc is None:

@@ -45,6 +45,20 @@ class KVStore:
                 "SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
         return row[0] if row else None
 
+    def get_many(self, keys: list[bytes]) -> dict[bytes, bytes]:
+        """Present keys only — one SELECT..IN per 500 keys instead of a
+        round trip each (the block validator's dup-txid and key-metadata
+        probes are whole-block batches)."""
+        out: dict[bytes, bytes] = {}
+        with self._lock:
+            for lo in range(0, len(keys), 500):
+                chunk = keys[lo:lo + 500]
+                q = ("SELECT k, v FROM kv WHERE k IN (%s)"
+                     % ",".join("?" * len(chunk)))
+                for k, v in self._conn.execute(q, chunk):
+                    out[bytes(k)] = bytes(v)
+        return out
+
     def put(self, key: bytes, value: bytes) -> None:
         with self._lock:
             self._conn.execute(
@@ -106,6 +120,12 @@ class DBHandle:
 
     def get(self, key: bytes) -> Optional[bytes]:
         return self._store.get(self._k(key))
+
+    def get_many(self, keys: list[bytes]) -> dict[bytes, bytes]:
+        """Present keys only, unprefixed."""
+        plen = len(self._prefix)
+        got = self._store.get_many([self._k(k) for k in keys])
+        return {k[plen:]: v for k, v in got.items()}
 
     def put(self, key: bytes, value: bytes) -> None:
         self._store.put(self._k(key), value)

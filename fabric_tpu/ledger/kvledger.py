@@ -193,6 +193,10 @@ class KVLedger:
     def get_transaction_by_id(self, tx_id: str):
         return self.block_store.get_tx_by_id(tx_id)
 
+    def existing_tx_ids(self, tx_ids: list[str]) -> set[str]:
+        """Batched duplicate-txid probe (validator fast path)."""
+        return self.block_store.existing_tx_ids(tx_ids)
+
     def set_collection_info_source(self, fn) -> None:
         self._collection_info = fn
 

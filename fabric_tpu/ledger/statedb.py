@@ -96,6 +96,24 @@ class StateDB:
         vv = self.get_state(ns, key)
         return vv.metadata if vv and vv.metadata else None
 
+    def get_state_metadata_many(
+            self, pairs: list[tuple[str, str]]
+    ) -> dict[tuple[str, str], Optional[bytes]]:
+        """Batched get_state_metadata over (ns, key) pairs — one probe
+        per block for the key-level validation-parameter lookups instead
+        of one per written key."""
+        uniq = list(dict.fromkeys(pairs))
+        raw = self._db.get_many([self._k(ns, k) for ns, k in uniq])
+        out: dict[tuple[str, str], Optional[bytes]] = {}
+        for ns, k in uniq:
+            r = raw.get(self._k(ns, k))
+            if r is None:
+                out[(ns, k)] = None
+            else:
+                vv = _decode(r)
+                out[(ns, k)] = vv.metadata if vv.metadata else None
+        return out
+
     def get_version(self, ns: str, key: str) -> Optional[Height]:
         vv = self.get_state(ns, key)
         return vv.version if vv else None

@@ -19,8 +19,10 @@ import numpy as np
 logger = logging.getLogger("native")
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native",
-                    "batchprep.cpp")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "native")
+_SRCS = [os.path.join(_SRC_DIR, "batchprep.cpp"),
+         os.path.join(_SRC_DIR, "blockprep.cpp")]
+_SRC = _SRCS[0]
 _LIB = os.path.join(_HERE, "libbatchprep.so")
 
 _lock = threading.Lock()
@@ -29,16 +31,33 @@ _tried = False
 
 
 def _build() -> bool:
-    if not os.path.exists(_SRC):
+    srcs = [s for s in _SRCS if os.path.exists(s)]
+    if not srcs:
         return False
+    # unlink first: if the old .so was already dlopen'd in this
+    # process, rewriting the same inode would make a re-CDLL return
+    # the stale mapping — a fresh inode guarantees fresh symbols
+    try:
+        os.unlink(_LIB)
+    except OSError:
+        pass
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", _LIB, _SRC],
-            check=True, capture_output=True, timeout=120)
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB] + srcs +
+            ["-lpthread"],
+            check=True, capture_output=True, timeout=180)
         return True
     except Exception as e:
         logger.info("native batchprep build unavailable: %s", e)
         return False
+
+
+def _stale() -> bool:
+    if not os.path.exists(_LIB):
+        return True
+    lib_mtime = os.path.getmtime(_LIB)
+    return any(os.path.exists(s) and os.path.getmtime(s) > lib_mtime
+               for s in _SRCS)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -47,9 +66,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB) or (
-                os.path.exists(_SRC) and
-                os.path.getmtime(_SRC) > os.path.getmtime(_LIB)):
+        if _stale():
             if not _build():
                 return None
         try:
@@ -57,6 +74,23 @@ def _load() -> Optional[ctypes.CDLL]:
         except OSError as e:
             logger.info("native batchprep load failed: %s", e)
             return None
+        # a stale .so from an older source tree may predate the block
+        # prep symbols even when mtimes look fresh (build caches, tars
+        # with preserved mtimes): rebuild once, else stay unavailable
+        if not hasattr(lib, "ftpu_block_prep"):
+            logger.info("native library lacks block-prep symbols; "
+                        "rebuilding")
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError as e:
+                logger.info("native batchprep reload failed: %s", e)
+                return None
+            if not hasattr(lib, "ftpu_block_prep"):
+                logger.warning("rebuilt native library still lacks "
+                               "block-prep symbols; native path off")
+                return None
         lib.ftpu_batch_prep.argtypes = [
             ctypes.c_char_p,
             np.ctypeslib.ndpointer(np.int32, flags="C"),
@@ -68,6 +102,34 @@ def _load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE"),
         ]
         lib.ftpu_batch_prep.restype = None
+        _i32 = np.ctypeslib.ndpointer(np.int32, flags="C,WRITEABLE")
+        _i64 = np.ctypeslib.ndpointer(np.int64, flags="C,WRITEABLE")
+        _u8 = np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE")
+        lib.ftpu_block_prep.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),        # envs
+            np.ctypeslib.ndpointer(np.int64, flags="C"),  # env_lens
+            ctypes.c_int32,                          # n
+            ctypes.c_char_p, ctypes.c_int32,         # channel_id
+            ctypes.c_int32,                          # max_e
+            _i32, _i64, _i32, _i32, _i64, _i32,      # status..csig
+            _u8,                                     # payload_digest
+            _i64, _i32, _i64, _i32, _i64, _i32,      # txid, config, ccname
+            _i64, _i32, _i64, _i32,                  # results, prp
+            _i32, _i32, _i64, _i32,                  # rw_mode/nkeys/keys
+            _i32,                                    # e_count
+            _i64, _i32, _i32, _i64, _i32,            # e_ident, e_uid, e_sig
+            _u8,                                     # e_digest
+            _u8, _u8, _u8, _u8,                      # c_r/rpn/w/ok
+            _u8, _u8, _u8, _u8,                      # e_r/rpn/w/ok
+            _i32, _i64, _i32,                        # uid table
+        ]
+        lib.ftpu_block_prep.restype = ctypes.c_int32
+        lib.ftpu_sha256.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    _u8]
+        lib.ftpu_sha256.restype = None
+        lib.ftpu_utf8_valid.argtypes = [ctypes.c_char_p,
+                                        ctypes.c_int64]
+        lib.ftpu_utf8_valid.restype = ctypes.c_int32
         _lib = lib
         logger.info("native batchprep loaded (%s)", _LIB)
         return _lib
@@ -75,6 +137,131 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+# ftpu_block_prep status values (native/blockprep.cpp)
+BP_OK_ENDORSER = 0
+BP_OK_CONFIG = 1
+BP_NEEDS_PYTHON = 2
+BP_FAIL_BASE = 100          # + TxValidationCode
+
+# rw_mode values (native/blockprep.cpp scan_results)
+RW_PLAIN = 1                # clean parse, only simple public writes
+RW_RICH = 2                 # clean parse, features for the Python walk
+RW_UNPARSED = 3             # not clean: the Python parser decides
+MAX_K = 16                  # plain written keys per tx in the flat table
+
+
+class BlockPrep:
+    """Flat per-tx arrays from one native pass over a block.
+
+    All offsets are LOCAL to that tx's envelope bytes; identity uids
+    index `unique_identities`. See native/blockprep.cpp for the
+    clean-parse contract (status == BP_NEEDS_PYTHON routes the tx to
+    the Python oracle)."""
+
+    __slots__ = (
+        "envs", "status", "creator_off", "creator_len", "creator_uid",
+        "csig_off", "csig_len", "payload_digest", "txid_off",
+        "txid_len", "config_off", "config_len", "ccname_off",
+        "ccname_len", "results_off", "results_len", "prp_off",
+        "prp_len", "rw_mode", "rw_nkeys", "rw_key_off", "rw_key_len",
+        "e_count", "e_ident_off", "e_ident_len", "e_uid",
+        "e_sig_off", "e_sig_len", "e_digest", "c_r", "c_rpn", "c_w",
+        "c_ok", "e_r", "e_rpn", "e_w", "e_ok", "n_unique", "uid_env",
+        "uid_off", "uid_len")
+
+    def slice(self, i: int, off_a, len_a) -> bytes:
+        o = int(off_a[i])
+        return self.envs[i][o:o + int(len_a[i])]
+
+    def tx_id(self, i: int) -> str:
+        o = int(self.txid_off[i])
+        return self.envs[i][o:o + int(self.txid_len[i])].decode()
+
+    def unique_identity(self, uid: int) -> bytes:
+        env = self.envs[int(self.uid_env[uid])]
+        o = int(self.uid_off[uid])
+        return env[o:o + int(self.uid_len[uid])]
+
+
+def block_prep(envs: list[bytes], channel_id: str,
+               max_e: int = 8) -> Optional[BlockPrep]:
+    """One native pass over a block's envelopes: wire-format field
+    extraction, digest lanes, identity dedup, DER signature staging.
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(envs)
+    bp = BlockPrep()
+    bp.envs = envs
+    arr = (ctypes.c_char_p * n)(*envs)
+    env_lens = np.array([len(e) for e in envs], dtype=np.int64)
+    bp.status = np.zeros(n, dtype=np.int32)
+    for name in ("creator", "csig", "txid", "config", "ccname",
+                 "results", "prp"):
+        setattr(bp, name + "_off", np.zeros(n, dtype=np.int64))
+        setattr(bp, name + "_len", np.zeros(n, dtype=np.int32))
+    bp.creator_uid = np.full(n, -1, dtype=np.int32)
+    bp.payload_digest = np.zeros((n, 32), dtype=np.uint8)
+    bp.rw_mode = np.zeros(n, dtype=np.int32)
+    bp.rw_nkeys = np.zeros(n, dtype=np.int32)
+    bp.rw_key_off = np.zeros((n, MAX_K), dtype=np.int64)
+    bp.rw_key_len = np.zeros((n, MAX_K), dtype=np.int32)
+    bp.e_count = np.zeros(n, dtype=np.int32)
+    bp.e_ident_off = np.zeros((n, max_e), dtype=np.int64)
+    bp.e_ident_len = np.zeros((n, max_e), dtype=np.int32)
+    bp.e_uid = np.full((n, max_e), -1, dtype=np.int32)
+    bp.e_sig_off = np.zeros((n, max_e), dtype=np.int64)
+    bp.e_sig_len = np.zeros((n, max_e), dtype=np.int32)
+    bp.e_digest = np.zeros((n, max_e, 32), dtype=np.uint8)
+    bp.c_r = np.zeros((n, 32), dtype=np.uint8)
+    bp.c_rpn = np.zeros((n, 32), dtype=np.uint8)
+    bp.c_w = np.zeros((n, 32), dtype=np.uint8)
+    bp.c_ok = np.zeros(n, dtype=np.uint8)
+    bp.e_r = np.zeros((n, max_e, 32), dtype=np.uint8)
+    bp.e_rpn = np.zeros((n, max_e, 32), dtype=np.uint8)
+    bp.e_w = np.zeros((n, max_e, 32), dtype=np.uint8)
+    bp.e_ok = np.zeros((n, max_e), dtype=np.uint8)
+    cap = max(n * (max_e + 1), 1)
+    bp.uid_env = np.zeros(cap, dtype=np.int32)
+    bp.uid_off = np.zeros(cap, dtype=np.int64)
+    bp.uid_len = np.zeros(cap, dtype=np.int32)
+    chan = channel_id.encode()
+    bp.n_unique = lib.ftpu_block_prep(
+        arr, env_lens, n, chan, len(chan), max_e,
+        bp.status, bp.creator_off, bp.creator_len, bp.creator_uid,
+        bp.csig_off, bp.csig_len, bp.payload_digest,
+        bp.txid_off, bp.txid_len, bp.config_off, bp.config_len,
+        bp.ccname_off, bp.ccname_len, bp.results_off, bp.results_len,
+        bp.prp_off, bp.prp_len,
+        bp.rw_mode, bp.rw_nkeys, bp.rw_key_off, bp.rw_key_len,
+        bp.e_count,
+        bp.e_ident_off, bp.e_ident_len, bp.e_uid,
+        bp.e_sig_off, bp.e_sig_len, bp.e_digest,
+        bp.c_r, bp.c_rpn, bp.c_w, bp.c_ok,
+        bp.e_r, bp.e_rpn, bp.e_w, bp.e_ok,
+        bp.uid_env, bp.uid_off, bp.uid_len)
+    if bp.n_unique < 0:
+        return None
+    return bp
+
+
+def sha256(data: bytes) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.zeros(32, dtype=np.uint8)
+    lib.ftpu_sha256(data, len(data), out)
+    return out.tobytes()
+
+
+def utf8_valid(data: bytes) -> Optional[bool]:
+    lib = _load()
+    if lib is None:
+        return None
+    return bool(lib.ftpu_utf8_valid(data, len(data)))
 
 
 def batch_prep(signatures: list[bytes]

@@ -99,7 +99,17 @@ class PeerNode:
                 "metrics.statsd.writeInterval", 10.0))
         self.metrics = provider
 
-        bccsp_cfg = cfg.get("peer.BCCSP") or {}
+        fs_path = cfg.get_path("peer.fileSystemPath")
+        os.makedirs(fs_path, exist_ok=True)
+
+        bccsp_cfg = dict(cfg.get("peer.BCCSP") or {})
+        # default the warm-key persistence under the peer's data dir so
+        # a restarted peer's prewarm rebuilds its Q tables before the
+        # first block needs them (BCCSP.TPU.WarmKeysDir overrides)
+        tpu_cfg = dict(bccsp_cfg.get("TPU") or {})
+        tpu_cfg.setdefault("WarmKeysDir",
+                           os.path.join(fs_path, "bccsp-warm"))
+        bccsp_cfg["TPU"] = tpu_cfg
         csp = bccsp_factory.new_bccsp(
             bccsp_factory.FactoryOpts.from_config(bccsp_cfg))
         # the TPU provider's perf-cliff counters become scrapeable
@@ -120,8 +130,6 @@ class PeerNode:
         local_msp = X509MSP(csp)
         local_msp.setup(msp_config_from_dir(msp_dir, msp_id, csp=csp))
 
-        fs_path = cfg.get_path("peer.fileSystemPath")
-        os.makedirs(fs_path, exist_ok=True)
         self.peer = Peer(fs_path, local_msp, csp,
                          metrics_provider=provider)
         self.msp_id = msp_id

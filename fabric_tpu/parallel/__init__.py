@@ -4,4 +4,5 @@ from fabric_tpu.parallel.mesh import (  # noqa: F401
     shard_batch,
     sharded_comb_fns,
     sharded_verify_fn,
+    shardmap_comb_verify,
 )

@@ -55,6 +55,34 @@ def sharded_verify_fn(mesh: Mesh):
     )
 
 
+def shardmap_comb_verify(mesh: Mesh, q16: bool, tree: str = "xla"):
+    """The flagship comb pipeline as a per-shard program (shard_map).
+
+    This is the SAME layout the TPU provider compiles under a mesh
+    (bccsp/tpu.py _comb_pipeline_locked): batch-sharded operand lanes,
+    replicated tables, no collectives — shard_map rather than GSPMD so
+    the pallas VMEM tree (a custom call the partitioner cannot split)
+    is legal per shard. With q16=True the 16-bit window configuration
+    (the measured single-chip headline) is exercised; tree="xla" keeps
+    the gate runnable on CPU meshes where pallas cannot lower.
+    """
+    from jax import shard_map
+
+    from fabric_tpu.ops import comb
+
+    def local(words, key_idx, q_flat, g16, r, rpn, w, premask):
+        return comb.comb_verify_with_tables(
+            words, key_idx, q_flat, r, rpn, w, premask,
+            g16=g16 if q16 else None, q16=q16, tree=tree)
+
+    s = P(BATCH_AXIS)
+    rep = P()
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(s, s, rep, rep, s, s, s, s), out_specs=s,
+        check_vma=False))
+
+
 def sharded_comb_fns(mesh: Mesh):
     """(table_builder, verify_fn) for the comb kernel over `mesh`.
 

@@ -306,22 +306,22 @@ int ftpu_prep_one(const uint8_t *der, int32_t der_len, uint8_t *r_out,
     return 1;
 }
 
-// Batch driver: der blob + per-item (offset, length). The s^-1 mod n
-// for the whole batch costs ONE binary-GCD inversion via Montgomery's
-// batch-inversion trick (prefix products; ~5 Montgomery muls per
-// accepted signature instead of a ~15us GCD each).
-void ftpu_batch_prep(const uint8_t *blob, const int32_t *offs,
-                     const int32_t *lens, int32_t n, uint8_t *r_out,
-                     uint8_t *rpn_out, uint8_t *w_out,
-                     uint8_t *ok_out) {
+// Batch driver over a pointer table (one entry per signature; nullptr
+// or len<=0 rejects the lane). The s^-1 mod n for the whole batch
+// costs ONE binary-GCD inversion via Montgomery's batch-inversion
+// trick (prefix products; ~5 Montgomery muls per accepted signature
+// instead of a ~15us GCD each).
+void ftpu_batch_prep_ptrs(const uint8_t *const *ptrs,
+                          const int32_t *lens, int32_t n,
+                          uint8_t *r_out, uint8_t *rpn_out,
+                          uint8_t *w_out, uint8_t *ok_out) {
     std::vector<U256> s_mont(n), prefix(n);
     std::vector<int32_t> live(n);
     int32_t k = 0;
     for (int32_t i = 0; i < n; ++i) {
         U256 s;
-        ok_out[i] = (uint8_t)prep_parse(
-            blob + offs[i], lens[i], r_out + 32 * i, rpn_out + 32 * i,
-            s);
+        ok_out[i] = ptrs[i] != nullptr && (uint8_t)prep_parse(
+            ptrs[i], lens[i], r_out + 32 * i, rpn_out + 32 * i, s);
         if (!ok_out[i]) continue;
         mont_mul(s, RR, s_mont[k]);        // to Montgomery domain
         if (k == 0) prefix[0] = s_mont[0];
@@ -345,6 +345,17 @@ void ftpu_batch_prep(const uint8_t *blob, const int32_t *offs,
         mont_mul(acc, s_mont[j], next);
         acc = next;
     }
+}
+
+// Contiguous-blob variant (the original ctypes entry point).
+void ftpu_batch_prep(const uint8_t *blob, const int32_t *offs,
+                     const int32_t *lens, int32_t n, uint8_t *r_out,
+                     uint8_t *rpn_out, uint8_t *w_out,
+                     uint8_t *ok_out) {
+    std::vector<const uint8_t *> ptrs(n);
+    for (int32_t i = 0; i < n; ++i) ptrs[i] = blob + offs[i];
+    ftpu_batch_prep_ptrs(ptrs.data(), lens, n, r_out, rpn_out, w_out,
+                         ok_out);
 }
 
 }  // extern "C"

@@ -148,3 +148,85 @@ class TestShardedComb:
                       *(jax.device_put(a, s_) for a in args[2:]))
         sharded = np.asarray(sharded)
         assert sharded.tolist() == base.tolist() == want
+
+        # the provider's mesh layout (shard_map, per-shard comb
+        # programs) must agree bit for bit too
+        from fabric_tpu.parallel import shardmap_comb_verify
+        smap = shardmap_comb_verify(mesh, q16=False, tree="xla")
+        out = smap(jax.device_put(args[0], s_),
+                   jax.device_put(args[1], s_), q_flat,
+                   jax.device_put(
+                       jnp.zeros((0, 3, limb.L), jnp.int32), rep),
+                   *(jax.device_put(a, s_) for a in args[2:]))
+        assert np.asarray(out).tolist() == want
+
+    def test_shardmap_q16_gate_runs(self, mesh8):
+        """The 16-bit-window (flagship) configuration compiles and
+        executes under shard_map at full production table shapes —
+        zero-filled tables (building real ones is the single-chip
+        bench's multi-minute job), premask all False, so every lane
+        must reject without touching table contents."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fabric_tpu.ops import comb
+        from fabric_tpu.parallel import BATCH_AXIS, shardmap_comb_verify
+
+        B = 16
+        rep = NamedSharding(mesh8, P())
+        s_ = NamedSharding(mesh8, P(BATCH_AXIS))
+        q16 = jax.device_put(
+            jnp.zeros((comb.NWIN_G16 * comb.NENT_G16, 3, limb.L),
+                      jnp.int32), rep)
+        g16 = jax.device_put(
+            jnp.zeros((comb.NWIN_G16 * comb.NENT_G16, 3, limb.L),
+                      jnp.int32), rep)
+        fn = shardmap_comb_verify(mesh8, q16=True, tree="xla")
+        out = fn(jax.device_put(np.zeros((B, 8), np.uint32), s_),
+                 jax.device_put(np.zeros(B, np.int32), s_), q16, g16,
+                 *(jax.device_put(np.zeros((B, limb.L), np.int32), s_)
+                   for _ in range(3)),
+                 jax.device_put(np.zeros(B, bool), s_))
+        assert np.asarray(out).tolist() == [False] * B
+
+    def test_mesh_provider_verify_prepared(self, mesh8):
+        """TPUProvider with a mesh: the prepared-array entry compiles
+        the shard_map comb pipeline and matches the sw oracle."""
+        from fabric_tpu.bccsp.sw import SWProvider
+        from fabric_tpu.bccsp.tpu import TPUProvider
+        from fabric_tpu.bccsp import utils as butils
+
+        from fabric_tpu.bccsp.bccsp import ECDSAKeyGenOpts
+
+        sw = SWProvider()
+        prov = TPUProvider(min_batch=8, mesh=mesh8, use_g16=False,
+                           max_keys=4)
+        key = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+        n = 16
+        digests, r_a, rpn_a, w_a, ok_a, sigs = [], [], [], [], [], []
+        for i in range(n):
+            digest = hashlib.sha256(f"lane {i}".encode()).digest()
+            sig = sw.sign(key, digest)
+            if i % 4 == 3:
+                sig = butils.marshal_signature(
+                    1234567, butils.unmarshal_signature(sig)[1])
+            sigs.append(sig)
+            digests.append(np.frombuffer(digest, np.uint8))
+            rr, ss = butils.unmarshal_signature(sig)
+            r_a.append(np.frombuffer(rr.to_bytes(32, "big"), np.uint8))
+            rpn = rr + p256.N if rr + p256.N < p256.P else rr
+            rpn_a.append(np.frombuffer(rpn.to_bytes(32, "big"),
+                                       np.uint8))
+            w_a.append(np.frombuffer(
+                pow(ss, -1, p256.N).to_bytes(32, "big"), np.uint8))
+            ok_a.append(1)
+        out = prov.verify_prepared(
+            np.stack(digests), np.stack(r_a), np.stack(rpn_a),
+            np.stack(w_a), np.asarray(ok_a, np.uint8),
+            np.zeros(n, np.int32), [key], lambda i: sigs[i])
+        want = [sw.verify(key, sigs[i],
+                          bytes(digests[i].tobytes()))
+                for i in range(n)]
+        assert out == want
+        assert want == [i % 4 != 3 for i in range(n)]
+        assert prov.stats["comb_batches"] >= 1

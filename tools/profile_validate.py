@@ -1,0 +1,171 @@
+"""Profile the TxValidator host pipeline in isolation.
+
+Builds an endorsed block (same shapes as bench_pipeline / BASELINE
+config 3) and cProfiles `validator.validate` with the crypto stubbed
+to all-True, so what remains is EXACTLY the host-side work the TPU
+kernel cannot hide: envelope parsing, identity handling, policy prep,
+item staging. Used to target the native host-pipeline work (round 4).
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import os
+import pstats
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def build_network(ntxs: int, endorsements: int = 2):
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition
+    from fabric_tpu.core.chaincode import shim
+    from fabric_tpu.internal import cryptogen
+    from fabric_tpu.internal.configtxgen import (
+        genesis_block,
+        new_channel_group,
+    )
+    from fabric_tpu.msp import msp_config_from_dir
+    from fabric_tpu.msp.mspimpl import X509MSP
+    from fabric_tpu.peer import Peer
+    from fabric_tpu.peer.gateway import Gateway
+    from fabric_tpu.protoutil import protoutil as pu
+    from fabric_tpu.protos import common as cpb
+
+    channel = "profchannel"
+    root = tempfile.mkdtemp(prefix="prof_validate_")
+    cdir = os.path.join(root, "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
+                                  n_users=1)
+    sw_csp = SWProvider()
+
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [
+                {"Name": "Org1", "ID": "Org1MSP",
+                 "MSPDir": os.path.join(org1, "msp")},
+                {"Name": "Org2", "ID": "Org2MSP",
+                 "MSPDir": os.path.join(org2, "msp")},
+            ],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0.example.com:7050"],
+            "BatchTimeout": "1s",
+            "BatchSize": {"MaxMessageCount": ntxs,
+                          "PreferredMaxBytes": 1 << 30,
+                          "AbsoluteMaxBytes": 1 << 30},
+            "Organizations": [],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(channel, new_channel_group(profile))
+
+    def local_msp(msp_dir, mspid):
+        m = X509MSP(sw_csp)
+        m.setup(msp_config_from_dir(msp_dir, mspid, csp=sw_csp))
+        return m
+
+    class KV(Chaincode):
+        def init(self, stub):
+            return shim.success()
+
+        def invoke(self, stub):
+            fn, params = stub.get_function_and_parameters()
+            stub.put_state(params[0], params[1].encode())
+            return shim.success()
+
+    peers = {}
+    for org_name, org_dir, mspid in (("org1", org1, "Org1MSP"),
+                                     ("org2", org2, "Org2MSP")):
+        msp = local_msp(
+            os.path.join(org_dir, "peers",
+                         f"peer0.{org_name}.example.com", "msp"), mspid)
+        peer = Peer(os.path.join(root, f"peer_{org_name}"), msp, sw_csp)
+        peer.join_channel(genesis)
+        peer.chaincode_support.register("bench", KV())
+        peer.channel(channel).define_chaincode(
+            ChaincodeDefinition(name="bench"))
+        peers[org_name] = peer
+
+    user_msp = local_msp(
+        os.path.join(org1, "users", "User1@org1.example.com", "msp"),
+        "Org1MSP")
+    gw = Gateway(peers["org1"], None,
+                 user_msp.get_default_signing_identity())
+    endorsing = list(peers.values())[:endorsements]
+
+    t0 = time.perf_counter()
+    envs = [gw.endorse(channel, "bench",
+                       [b"put", f"k{i}".encode(), f"v{i}".encode()],
+                       endorsing_peers=endorsing)[0]
+            for i in range(ntxs)]
+    print(f"endorsed {ntxs} in {time.perf_counter()-t0:.1f}s")
+
+    # assemble the block directly (skip ordering)
+    block = pu.new_block(1, b"\x00" * 32)
+    for env in envs:
+        block.data.data.append(pu.marshal(env))
+    block.header.data_hash = pu.block_data_hash(block.data)
+    while len(block.metadata.metadata) <= \
+            cpb.BlockMetadataIndex.TRANSACTIONS_FILTER:
+        block.metadata.metadata.append(b"")
+    return peers["org1"], channel, block
+
+
+class PassThroughCSP:
+    """verify_batch -> all True; everything else delegates."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def verify_batch(self, items):
+        return [True] * len(items)
+
+
+def main():
+    ntxs = int(os.environ.get("PROF_TXS", "2048"))
+    peer, channel, block = build_network(ntxs)
+    ch = peer.channel(channel)
+    validator = ch.validator
+    validator._csp = PassThroughCSP(validator._csp)
+
+    from fabric_tpu.protos import transaction as txpb
+    # warm
+    codes = validator.validate(block)
+    assert all(c == txpb.TxValidationCode.VALID for c in codes), \
+        set(codes)
+
+    for _ in range(2):
+        t0 = time.perf_counter()
+        validator.validate(block)
+        dt = time.perf_counter() - t0
+        print(f"validate (crypto stubbed): {dt:.3f}s = "
+              f"{ntxs/dt:.0f} tx/s, {ntxs*3/dt:.0f} sig-lanes/s")
+
+    pr = cProfile.Profile()
+    pr.enable()
+    validator.validate(block)
+    pr.disable()
+    s = io.StringIO()
+    ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+    ps.print_stats(45)
+    print(s.getvalue())
+
+
+if __name__ == "__main__":
+    main()
