@@ -50,6 +50,13 @@ def _us(channel, service, method, req_cls, resp_cls):
         response_deserializer=resp_cls.FromString)
 
 
+def _ss(channel, service, method, req_cls, resp_cls):
+    return channel.stream_stream(
+        f"/{service}/{method}",
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=resp_cls.FromString)
+
+
 class EndorserClient:
     """Duck-type of `peer.endorser` (process_proposal)."""
 
@@ -65,16 +72,25 @@ class EndorserClient:
 
 
 class BroadcastClient:
-    """Duck-type of BroadcastHandler (process_message)."""
+    """Duck-type of BroadcastHandler (process_message /
+    process_messages)."""
 
     def __init__(self, channel: grpc.Channel, timeout_s: float = 30.0):
         self._call = _uu(channel, svc.BROADCAST_SERVICE, "Broadcast",
                          common.Envelope, opb.BroadcastResponse)
+        self._stream = _ss(channel, svc.BROADCAST_SERVICE,
+                           "BroadcastStream", common.Envelope,
+                           opb.BroadcastResponse)
         self._timeout = timeout_s
 
     def process_message(self, env: common.Envelope
                         ) -> opb.BroadcastResponse:
         return self._call(env, timeout=self._timeout)
+
+    def process_messages(self, envs) -> list:
+        """Streamed window: the server batches the filter + enqueue
+        (services.register_broadcast handle_stream)."""
+        return list(self._stream(iter(envs), timeout=self._timeout))
 
 
 class DeliverClient:

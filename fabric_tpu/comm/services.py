@@ -75,10 +75,70 @@ def register_peer_deliver(server: GRPCServer, events_handler) -> None:
 
 
 def register_broadcast(server: GRPCServer, broadcast_handler) -> None:
+    def handle_stream(request_iterator, ctx):
+        """Streamed ingest (the reference's AtomicBroadcast.Broadcast
+        shape): responses are 1:1 in order, but the server drains the
+        inbound window greedily and validates it through the batched
+        entry — one signature-filter verify and one consenter enqueue
+        per window instead of per envelope."""
+        import logging as _logging
+        import queue as _q
+        import threading as _t
+        q: _q.Queue = _q.Queue(maxsize=2048)
+        done = object()
+        stop = _t.Event()     # set when the response generator dies
+
+        def _put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.25)
+                    return True
+                except _q.Full:
+                    continue
+            return False
+
+        def reader():
+            try:
+                for env in request_iterator:
+                    if not _put(env):
+                        return      # consumer gone: stop pumping
+            except Exception as e:
+                # a mid-stream client error truncates the window; the
+                # client sees fewer responses than requests and knows
+                _logging.getLogger("comm.broadcast").debug(
+                    "broadcast stream reader ended: %s", e)
+            finally:
+                _put(done)
+
+        _t.Thread(target=reader, daemon=True,
+                  name="broadcast-reader").start()
+        try:
+            finished = False
+            while not finished:
+                first = q.get()
+                if first is done:
+                    break
+                batch = [first]
+                while len(batch) < 500:
+                    try:
+                        nxt = q.get_nowait()
+                    except _q.Empty:
+                        break
+                    if nxt is done:
+                        finished = True
+                        break
+                    batch.append(nxt)
+                yield from broadcast_handler.process_messages(batch)
+        finally:
+            stop.set()      # unblock + retire the reader thread
+
     server.add_service(BROADCAST_SERVICE, {
         "Broadcast": (
             UNARY_UNARY,
             lambda env, ctx: broadcast_handler.process_message(env),
+            common.Envelope, opb.BroadcastResponse),
+        "BroadcastStream": (
+            STREAM_STREAM, handle_stream,
             common.Envelope, opb.BroadcastResponse),
     })
 

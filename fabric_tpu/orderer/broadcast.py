@@ -57,6 +57,126 @@ class BroadcastHandler:
             "status", common.Status.Name(status))
         inst.observe(dur) if dur is not None else inst.add(1)
 
+    def process_messages(self, envs) -> list:
+        """Batched ingest over a window of envelopes: responses are 1:1
+        and in order, but consecutive NORMAL envelopes on the same
+        channel share one msgprocessor pass (ONE batched
+        signature-filter verify) and one consenter enqueue
+        (`chain.order_batch`). Config-class envelopes break the run and
+        process individually, preserving intra-channel order. The gRPC
+        Broadcast stream drains its inbound window through this entry."""
+        out: list = [None] * len(envs)
+        run: list = []                # (orig index, env)
+        run_channel: str = ""
+        run_support = None
+
+        def flush():
+            nonlocal run, run_support
+            if not run:
+                return
+            idxs = [i for i, _ in run]
+            batch = [e for _, e in run]
+            for i, resp in zip(idxs, self._process_normal_run(
+                    run_channel, run_support, batch)):
+                out[i] = resp
+            run = []
+            run_support = None
+
+        for i, env in enumerate(envs):
+            try:
+                ch = pu.get_channel_header(pu.get_payload(env))
+            except Exception:
+                ch = None
+            if (ch is None or not ch.channel_id or
+                    msgprocessor.classify(ch) != msgprocessor.NORMAL):
+                flush()
+                out[i] = self.process_message(env)
+                continue
+            support = self._registrar.get_chain(ch.channel_id)
+            if run and (ch.channel_id != run_channel or
+                        support is not run_support):
+                flush()
+            run_channel = ch.channel_id
+            run_support = support
+            run.append((i, env))
+        flush()
+        return out
+
+    def _process_normal_run(self, cid: str, support, batch
+                            ) -> list:
+        """One NORMAL-message run on one channel: batched filters, then
+        one enqueue."""
+        if support is None:
+            return [ordpb.BroadcastResponse(
+                status=common.Status.NOT_FOUND,
+                info=f"channel {cid} not found")] * len(batch)
+        if support.chain.errored():
+            resp = ordpb.BroadcastResponse(
+                status=common.Status.SERVICE_UNAVAILABLE,
+                info="consenter is in an errored state")
+            for _ in batch:
+                self._observe(self.metrics.processed_count, cid,
+                              "normal", resp.status)
+            return [resp] * len(batch)
+
+        t0 = time.perf_counter()
+        results = support.processor.process_normal_msgs(batch)
+        vdur = (time.perf_counter() - t0) / max(len(batch), 1)
+        responses: list = [None] * len(batch)
+        accepted: list = []
+        for j, (env, (seq, err)) in enumerate(zip(batch, results)):
+            if err is None:
+                self._observe(self.metrics.validate_duration, cid,
+                              "normal", common.Status.SUCCESS, vdur)
+                accepted.append((j, env, seq))
+                continue
+            status = (common.Status.FORBIDDEN
+                      if isinstance(err, msgprocessor.PermissionDenied)
+                      else common.Status.BAD_REQUEST)
+            self._observe(self.metrics.validate_duration, cid,
+                          "normal", status, vdur)
+            self._observe(self.metrics.processed_count, cid, "normal",
+                          status)
+            responses[j] = ordpb.BroadcastResponse(status=status,
+                                                   info=str(err))
+        if accepted:
+            t1 = time.perf_counter()
+            n_ok = 0
+            status, info = common.Status.SUCCESS, ""
+            try:
+                order_batch = getattr(support.chain, "order_batch",
+                                      None)
+                if order_batch is not None:
+                    n_ok = order_batch([(env, seq)
+                                        for _, env, seq in accepted])
+                else:
+                    for _, env, seq in accepted:
+                        support.chain.order(env, seq)
+                        n_ok += 1
+            except msgprocessor.MsgProcessorError as e:
+                status, info = common.Status.SERVICE_UNAVAILABLE, str(e)
+            except Exception as e:
+                logger.exception("[%s] broadcast failure", cid)
+                status, info = common.Status.INTERNAL_SERVER_ERROR, \
+                    str(e)
+            edur = (time.perf_counter() - t1) / len(accepted)
+            if n_ok < len(accepted) and \
+                    status == common.Status.SUCCESS:
+                status = common.Status.SERVICE_UNAVAILABLE
+                info = "leader changed mid-window"
+            # a follower forwarding mid-window can deliver a prefix:
+            # report those truthfully as SUCCESS, only the rest failed
+            for pos, (j, _, _) in enumerate(accepted):
+                st = common.Status.SUCCESS if pos < n_ok else status
+                inf = "" if pos < n_ok else info
+                self._observe(self.metrics.enqueue_duration, cid,
+                              "normal", st, edur)
+                self._observe(self.metrics.processed_count, cid,
+                              "normal", st)
+                responses[j] = ordpb.BroadcastResponse(status=st,
+                                                       info=inf)
+        return responses
+
     def process_message(self, env: common.Envelope
                         ) -> ordpb.BroadcastResponse:
         """One envelope in, one status out (the gRPC stream layer maps

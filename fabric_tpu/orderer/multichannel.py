@@ -131,6 +131,12 @@ class ChainSupport:
         logger.info("[%s] config now at sequence %d",
                     self.channel_id, self._validator.sequence())
 
+    @property
+    def csp(self):
+        """The orderer's crypto provider — the batched sig-filter
+        (msgprocessor.process_normal_msgs) dispatches through it."""
+        return self._csp
+
     def bundle(self) -> Bundle:
         with self._lock:
             return self._bundle

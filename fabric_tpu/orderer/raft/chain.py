@@ -241,6 +241,34 @@ class RaftChain:
     def order(self, env: common.Envelope, config_seq: int) -> None:
         self._submit(env, config_seq, is_config=False)
 
+    def order_batch(self, envs_seqs) -> int:
+        """A whole ingest window as ONE event: the broadcast layer's
+        batched filter hands the accepted run here, so the consenter
+        loop wakes once per window instead of once per envelope (on a
+        busy single-core host the per-envelope queue handoff was the
+        ordering floor — reference chain.go Order enqueues per
+        message). Returns how many LEADING envelopes were accepted —
+        a follower forwarding to the leader can fail mid-window, and
+        the already-forwarded prefix must not be reported as failed
+        (the client would re-order it on retry)."""
+        self.metrics.normal_proposals.add(len(envs_seqs))
+        if self._halted.is_set():
+            raise MsgProcessorError("chain is halted")
+        leader = self.node.leader_id
+        if leader == self.node_id:
+            self._events.put(("order_batch", envs_seqs))
+            return len(envs_seqs)
+        accepted = 0
+        for env, seq in envs_seqs:
+            try:
+                self._submit_forward(env, seq)
+            except MsgProcessorError:
+                if accepted == 0:
+                    raise
+                return accepted
+            accepted += 1
+        return accepted
+
     def configure(self, env: common.Envelope, config_seq: int) -> None:
         self._submit(env, config_seq, is_config=True)
 
@@ -254,6 +282,12 @@ class RaftChain:
         if leader == self.node_id:
             self._events.put(("order", env, config_seq, is_config))
             return
+        self._submit_forward(env, config_seq)
+
+    def _submit_forward(self, env: common.Envelope,
+                        config_seq: int) -> None:
+        """Forward to the current raft leader (reference Submit RPC)."""
+        leader = self.node.leader_id
         if leader == 0:
             raise MsgProcessorError(
                 f"[{self._support.channel_id}] no raft leader")
@@ -338,12 +372,30 @@ class RaftChain:
                 ev = ()
             if ev is None:
                 break
+            # drain everything already queued: one wakeup handles the
+            # whole backlog, then ONE ready() pass flushes the
+            # accumulated side effects (avoids per-event thread
+            # handoffs when a producer is streaming submissions)
+            evs = [ev] if ev else []
+            while len(evs) < 4096:
+                try:
+                    nxt = self._events.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._halted.set()
+                    break
+                evs.append(nxt)
             try:
                 now = time.monotonic()
-                if ev and ev[0] == "step":
-                    self.node.step(ev[1])
-                elif ev and ev[0] == "order":
-                    self._process_order(ev[1], ev[2], ev[3])
+                for ev in evs:
+                    if ev[0] == "step":
+                        self.node.step(ev[1])
+                    elif ev[0] == "order":
+                        self._process_order(ev[1], ev[2], ev[3])
+                    elif ev[0] == "order_batch":
+                        for env, seq in ev[1]:
+                            self._process_order(env, seq, False)
                 if now >= next_tick:
                     self.node.tick()
                     next_tick = now + self._tick_s

@@ -59,6 +59,16 @@ class SoloChain:
         """Normal message (reference solo `Order`)."""
         self._enqueue(_Msg(env, config_seq, is_config=False))
 
+    def order_batch(self, envs_seqs) -> int:
+        """A broadcast ingest window as one queue item (see
+        RaftChain.order_batch). Returns the accepted count (all of
+        them — the local queue cannot partially fail)."""
+        if self._halted.is_set():
+            raise MsgProcessorError("chain is halted")
+        self._queue.put([_Msg(env, seq, is_config=False)
+                         for env, seq in envs_seqs])
+        return len(envs_seqs)
+
     def configure(self, env: common.Envelope, config_seq: int) -> None:
         """Config message — already wrapped by the msgprocessor."""
         self._enqueue(_Msg(env, config_seq, is_config=True))
@@ -93,12 +103,13 @@ class SoloChain:
             if msg is None:
                 break
             try:
-                if msg.is_config:
-                    timer_deadline = self._process_config(
-                        msg, timer_deadline)
-                else:
-                    timer_deadline = self._process_normal(
-                        msg, timer_deadline)
+                for m in (msg if isinstance(msg, list) else [msg]):
+                    if m.is_config:
+                        timer_deadline = self._process_config(
+                            m, timer_deadline)
+                    else:
+                        timer_deadline = self._process_normal(
+                            m, timer_deadline)
             except MsgProcessorError as e:
                 logger.warning("[%s] message dropped during ordering: "
                                "%s", support.channel_id, e)

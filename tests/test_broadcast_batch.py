@@ -1,0 +1,174 @@
+"""Batched broadcast ingest: process_messages / order_batch / the
+batched sig-filter.
+
+The windowed path (BroadcastHandler.process_messages →
+StandardChannel.process_normal_msgs → chain.order_batch) must accept
+and order exactly what the per-envelope path does — including mixed
+windows where some envelopes are tampered, belong to unknown channels,
+or are config-class (which break the run and process individually).
+Reference analog: `orderer/common/broadcast/broadcast.go` Handle with
+`sigfilter.go` — re-architected batch-first.
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.core.chaincode import Chaincode, ChaincodeDefinition, shim
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.common.deliver import DeliverHandler
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.broadcast import BroadcastHandler
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.peer import Peer
+from fabric_tpu.peer.gateway import Gateway
+from fabric_tpu.protos import common as cpb
+from fabric_tpu.protoutil import protoutil as pu
+
+CHANNEL = "batchchannel"
+
+
+class KV(Chaincode):
+    def init(self, stub):
+        return shim.success()
+
+    def invoke(self, stub):
+        fn, params = stub.get_function_and_parameters()
+        stub.put_state(params[0], params[1].encode())
+        return shim.success()
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    root = tmp_path_factory.mktemp("bbatch")
+    cdir = str(root / "crypto")
+    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
+                                  n_users=1)
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    csp = SWProvider()
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Application": {
+            "Organizations": [{"Name": "Org1", "ID": "Org1MSP",
+                               "MSPDir": os.path.join(org1, "msp")}],
+            "Capabilities": {"V2_0": True},
+        },
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0:7050"],
+            "BatchTimeout": "200ms",
+            "BatchSize": {"MaxMessageCount": 16},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+
+    def local_msp(msp_dir, mspid):
+        m = X509MSP(csp)
+        m.setup(msp_config_from_dir(msp_dir, mspid, csp=csp))
+        return m
+
+    orderer_msp = local_msp(
+        os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
+        "OrdererMSP")
+    registrar = Registrar(str(root / "orderer"),
+                          orderer_msp.get_default_signing_identity(),
+                          csp, {"solo": solo.consenter})
+    registrar.join(genesis)
+    broadcast = BroadcastHandler(registrar)
+
+    msp = local_msp(
+        os.path.join(org1, "peers", "peer0.org1.example.com", "msp"),
+        "Org1MSP")
+    peer = Peer(str(root / "peer"), msp, csp)
+    peer.join_channel(genesis)
+    peer.chaincode_support.register("bcc", KV())
+    peer.channel(CHANNEL).define_chaincode(ChaincodeDefinition(name="bcc"))
+    user = local_msp(
+        os.path.join(org1, "users", "User1@org1.example.com", "msp"),
+        "Org1MSP")
+    gw = Gateway(peer, broadcast, user.get_default_signing_identity())
+
+    def endorse(n, tag):
+        return [gw.endorse(CHANNEL, "bcc",
+                           [b"put", f"{tag}{i}".encode(), b"v"],
+                           endorsing_peers=[peer])[0]
+                for i in range(n)]
+
+    yield registrar, broadcast, endorse, peer
+    registrar.halt()
+    peer.close()
+
+
+def _wait_ordered(registrar, ntx, timeout=10.0):
+    import time
+    chain = registrar.get_chain(CHANNEL)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        blocks = [chain.ledger.get_block(i)
+                  for i in range(1, chain.ledger.height)]
+        got = sum(len(b.data.data) for b in blocks if b is not None)
+        if got >= ntx:
+            return got
+        time.sleep(0.05)
+    return -1
+
+
+def test_window_orders_everything(net):
+    registrar, broadcast, endorse, _ = net
+    envs = endorse(24, "w")
+    resps = broadcast.process_messages(envs)
+    assert all(r.status == cpb.Status.SUCCESS for r in resps), \
+        [(r.status, r.info) for r in resps if
+         r.status != cpb.Status.SUCCESS][:3]
+    assert _wait_ordered(registrar, 24) == 24
+
+
+def test_mixed_window_statuses(net):
+    registrar, broadcast, endorse, _ = net
+    envs = endorse(6, "m")
+    # tamper env 2's signature: sig filter must reject JUST that one
+    bad = cpb.Envelope()
+    bad.CopyFrom(envs[2])
+    bad.signature = b"\x30\x06\x02\x01\x01\x02\x01\x01"
+    envs[2] = bad
+    # env 4 goes to an unknown channel
+    ch = pu.make_channel_header(cpb.HeaderType.ENDORSER_TRANSACTION,
+                                "nosuch", tx_id="x")
+    sh = cpb.SignatureHeader(creator=b"c", nonce=b"n")
+    pay = pu.make_payload(ch, sh, b"data")
+    envs[4] = cpb.Envelope(payload=pu.marshal(pay), signature=b"s")
+    # garbage envelope
+    envs.append(cpb.Envelope(payload=b"", signature=b""))
+
+    resps = broadcast.process_messages(envs)
+    assert resps[0].status == cpb.Status.SUCCESS
+    assert resps[1].status == cpb.Status.SUCCESS
+    assert resps[2].status == cpb.Status.FORBIDDEN
+    assert resps[3].status == cpb.Status.SUCCESS
+    assert resps[4].status == cpb.Status.NOT_FOUND
+    assert resps[5].status == cpb.Status.SUCCESS
+    assert resps[6].status == cpb.Status.BAD_REQUEST
+
+
+def test_batched_filter_equals_single(net):
+    """Every envelope accepted by the batched entry is accepted by the
+    per-envelope entry and vice versa (same filter semantics)."""
+    registrar, broadcast, endorse, _ = net
+    envs = endorse(4, "s")
+    bad = cpb.Envelope()
+    bad.CopyFrom(envs[1])
+    bad.signature = bad.signature[:-2]      # truncated DER
+    envs[1] = bad
+    batched = [r.status for r in broadcast.process_messages(envs)]
+    single = [broadcast.process_message(e).status for e in envs]
+    assert batched == single
