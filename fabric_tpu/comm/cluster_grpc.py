@@ -29,6 +29,25 @@ from fabric_tpu.protos import common, orderer as opb
 
 logger = logging.getLogger("comm.cluster")
 
+from fabric_tpu.common import metrics as _mdefs  # noqa: E402
+
+MSG_SEND_TIME = _mdefs.HistogramOpts(
+    namespace="cluster", subsystem="comm", name="msg_send_time",
+    help="The time it takes to send a consensus message to a fellow "
+         "consenter in seconds.", label_names=("host", "channel"))
+MSG_DROPPED = _mdefs.CounterOpts(
+    namespace="cluster", subsystem="comm", name="msg_dropped_count",
+    help="The number of consensus messages dropped because the "
+         "destination consenter was unreachable.",
+    label_names=("host", "channel"))
+EGRESS_STREAMS = _mdefs.GaugeOpts(
+    namespace="cluster", subsystem="comm", name="egress_stream_count",
+    help="The number of outbound connections to fellow consenters.")
+INGRESS_STREAMS = _mdefs.GaugeOpts(
+    namespace="cluster", subsystem="comm", name="ingress_stream_count",
+    help="The number of distinct consenters recently heard from on "
+         "the inbound cluster service.")
+
 
 _pem_der_memo: dict[bytes, Optional[bytes]] = {}
 
@@ -60,7 +79,9 @@ class GRPCClusterTransport(ClusterTransport):
                  tls_root_ca: Optional[bytes] = None,
                  client_cert: Optional[bytes] = None,
                  client_key: Optional[bytes] = None,
-                 require_client_auth: bool = False):
+                 require_client_auth: bool = False,
+                 metrics_provider=None):
+        from fabric_tpu.common import metrics as _m
         self.endpoint = endpoint
         self._tls_root_ca = tls_root_ca
         self._client_cert = client_cert
@@ -75,6 +96,13 @@ class GRPCClusterTransport(ClusterTransport):
         self._inbox: queue.Queue = queue.Queue(maxsize=4096)
         self._closed = threading.Event()
         self._warned_insecure = False
+        provider = metrics_provider or _m.DisabledProvider()
+        self._m_send_time = provider.new_histogram(MSG_SEND_TIME)
+        self._m_dropped = provider.new_counter(MSG_DROPPED)
+        self._m_egress = provider.new_gauge(EGRESS_STREAMS)
+        self._m_ingress = provider.new_gauge(INGRESS_STREAMS)
+        self._ingress_peers: dict[str, float] = {}
+        self._ingress_window_s = 60.0
         self._thread = threading.Thread(
             target=self._drain, name=f"cluster-grpc-{endpoint}",
             daemon=True)
@@ -89,15 +117,23 @@ class GRPCClusterTransport(ClusterTransport):
                 self._channels[target] = ch
                 c = ClusterClient(ch, self.endpoint)
                 self._clients[target] = c
+                self._m_egress.set(len(self._clients))
             return c
 
     # -- ClusterTransport outbound --
 
     def send_consensus(self, target: str, channel: str,
                        payload: bytes) -> None:
+        import time as _t
+        t0 = _t.perf_counter()
         try:
             self._client(target).send_consensus(channel, payload)
+            self._m_send_time.with_labels(
+                "host", target, "channel", channel).observe(
+                _t.perf_counter() - t0)
         except Exception:
+            self._m_dropped.with_labels(
+                "host", target, "channel", channel).add(1)
             logger.debug("consensus send to %s failed", target)
 
     def submit(self, target: str, channel: str, env_bytes: bytes,
@@ -195,8 +231,19 @@ class GRPCClusterTransport(ClusterTransport):
 
     # -- inbound (comm.services.register_cluster calls these) --
 
+    def _note_ingress(self, sender: str) -> None:
+        import time as _t
+        now = _t.monotonic()
+        self._ingress_peers[sender] = now
+        horizon = now - self._ingress_window_s
+        live = {ep: ts for ep, ts in self._ingress_peers.items()
+                if ts >= horizon}
+        self._ingress_peers = live
+        self._m_ingress.set(len(live))
+
     def enqueue_consensus(self, sender: str, channel: str,
                           payload: bytes) -> None:
+        self._note_ingress(sender)
         try:
             self._inbox.put_nowait((sender, channel, payload))
         except queue.Full:

@@ -17,6 +17,49 @@ import grpc
 
 logger = logging.getLogger("comm.grpc")
 
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+UNARY_REQUESTS_RECEIVED = _m.CounterOpts(
+    namespace="grpc", subsystem="server",
+    name="unary_requests_received",
+    help="The number of unary gRPC requests received.",
+    label_names=("service", "method"))
+UNARY_REQUESTS_COMPLETED = _m.CounterOpts(
+    namespace="grpc", subsystem="server",
+    name="unary_requests_completed",
+    help="The number of unary gRPC requests completed, by status "
+         "code.", label_names=("service", "method", "code"))
+UNARY_REQUEST_DURATION = _m.HistogramOpts(
+    namespace="grpc", subsystem="server",
+    name="unary_request_duration",
+    help="The time a unary gRPC request took to complete.",
+    label_names=("service", "method"))
+STREAM_REQUESTS_RECEIVED = _m.CounterOpts(
+    namespace="grpc", subsystem="server",
+    name="stream_requests_received",
+    help="The number of streaming gRPC requests received.",
+    label_names=("service", "method"))
+STREAM_REQUESTS_COMPLETED = _m.CounterOpts(
+    namespace="grpc", subsystem="server",
+    name="stream_requests_completed",
+    help="The number of streaming gRPC requests completed, by "
+         "status code.", label_names=("service", "method", "code"))
+STREAM_REQUEST_DURATION = _m.HistogramOpts(
+    namespace="grpc", subsystem="server",
+    name="stream_request_duration",
+    help="The time a streaming gRPC request took to complete.",
+    label_names=("service", "method"))
+STREAM_MESSAGES_RECEIVED = _m.CounterOpts(
+    namespace="grpc", subsystem="server",
+    name="stream_messages_received",
+    help="The number of messages received on streaming gRPC "
+         "requests.", label_names=("service", "method"))
+STREAM_MESSAGES_SENT = _m.CounterOpts(
+    namespace="grpc", subsystem="server",
+    name="stream_messages_sent",
+    help="The number of messages sent on streaming gRPC requests.",
+    label_names=("service", "method"))
+
 
 def _split_method(full_method: str) -> tuple[str, str]:
     """'/ftpu.Endorser/ProcessProposal' → (service, method)."""
@@ -123,26 +166,33 @@ class ConcurrencyLimiter(grpc.ServerInterceptor):
 
 
 class ServerObservability(grpc.ServerInterceptor):
+    """Reference `common/grpcmetrics`: unary and streaming RPCs get
+    SEPARATE metric families (requests received/completed, duration),
+    and streaming RPCs additionally count messages in each direction."""
+
     def __init__(self, metrics_provider=None,
                  log: Optional[logging.Logger] = None):
         self._log = log or logger
-        self._m_completed = None
-        self._m_duration = None
+        self._m = None
         if metrics_provider is not None:
-            from fabric_tpu.common import metrics as m
-            self._m_completed = metrics_provider.new_counter(
-                m.CounterOpts(namespace="grpc", subsystem="server",
-                              name="requests_completed",
-                              help="The number of gRPC requests "
-                                   "completed, by status code.",
-                              label_names=("service", "method",
-                                           "code")))
-            self._m_duration = metrics_provider.new_histogram(
-                m.HistogramOpts(namespace="grpc", subsystem="server",
-                                name="request_duration",
-                                help="The time a gRPC request took "
-                                     "to complete.",
-                                label_names=("service", "method")))
+            self._m = {
+                "u_rx": metrics_provider.new_counter(
+                    UNARY_REQUESTS_RECEIVED),
+                "u_done": metrics_provider.new_counter(
+                    UNARY_REQUESTS_COMPLETED),
+                "u_dur": metrics_provider.new_histogram(
+                    UNARY_REQUEST_DURATION),
+                "s_rx": metrics_provider.new_counter(
+                    STREAM_REQUESTS_RECEIVED),
+                "s_done": metrics_provider.new_counter(
+                    STREAM_REQUESTS_COMPLETED),
+                "s_dur": metrics_provider.new_histogram(
+                    STREAM_REQUEST_DURATION),
+                "s_msg_rx": metrics_provider.new_counter(
+                    STREAM_MESSAGES_RECEIVED),
+                "s_msg_tx": metrics_provider.new_counter(
+                    STREAM_MESSAGES_SENT),
+            }
 
     def intercept_service(self, continuation, handler_call_details):
         handler = continuation(handler_call_details)
@@ -151,8 +201,22 @@ class ServerObservability(grpc.ServerInterceptor):
         service, method = _split_method(handler_call_details.method)
         outer = self
 
-        def wrap_unary(fn):
+        def count(key, *extra):
+            if outer._m is not None:
+                outer._m[key].with_labels(
+                    "service", service, "method", method,
+                    *extra).add(1)
+
+        def counted_iter(it):
+            for msg in it:
+                count("s_msg_rx")
+                yield msg
+
+        def wrap_unary(fn, streaming_req=False):
             def inner(request, context):
+                count("s_rx" if streaming_req else "u_rx")
+                if streaming_req:
+                    request = counted_iter(request)
                 t0 = time.perf_counter()
                 code = "OK"
                 try:
@@ -165,21 +229,28 @@ class ServerObservability(grpc.ServerInterceptor):
                     raise
                 finally:
                     outer._observe(service, method, code,
-                                   time.perf_counter() - t0)
+                                   time.perf_counter() - t0,
+                                   streaming=streaming_req)
             return inner
 
-        def wrap_stream(fn):
+        def wrap_stream(fn, streaming_req=False):
             def inner(request, context):
+                count("s_rx")
+                if streaming_req:
+                    request = counted_iter(request)
                 t0 = time.perf_counter()
                 code = "OK"
                 try:
-                    yield from fn(request, context)
+                    for resp in fn(request, context):
+                        count("s_msg_tx")
+                        yield resp
                 except Exception:
                     code = _abort_code(context)
                     raise
                 finally:
                     outer._observe(service, method, code,
-                                   time.perf_counter() - t0)
+                                   time.perf_counter() - t0,
+                                   streaming=True)
             return inner
 
         if handler.unary_unary:
@@ -194,23 +265,24 @@ class ServerObservability(grpc.ServerInterceptor):
                 response_serializer=handler.response_serializer)
         if handler.stream_unary:
             return grpc.stream_unary_rpc_method_handler(
-                wrap_unary(handler.stream_unary),
+                wrap_unary(handler.stream_unary, streaming_req=True),
                 request_deserializer=handler.request_deserializer,
                 response_serializer=handler.response_serializer)
         if handler.stream_stream:
             return grpc.stream_stream_rpc_method_handler(
-                wrap_stream(handler.stream_stream),
+                wrap_stream(handler.stream_stream, streaming_req=True),
                 request_deserializer=handler.request_deserializer,
                 response_serializer=handler.response_serializer)
         return handler
 
     def _observe(self, service: str, method: str, code: str,
-                 dur: float) -> None:
+                 dur: float, streaming: bool = False) -> None:
         self._log.debug("%s/%s completed code=%s in %.1fms", service,
                         method, code, dur * 1e3)
-        if self._m_completed is not None:
-            self._m_completed.with_labels(
+        if self._m is not None:
+            pre = "s" if streaming else "u"
+            self._m[pre + "_done"].with_labels(
                 "service", service, "method", method,
                 "code", code).add(1)
-            self._m_duration.with_labels(
+            self._m[pre + "_dur"].with_labels(
                 "service", service, "method", method).observe(dur)

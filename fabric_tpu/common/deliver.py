@@ -40,6 +40,10 @@ REQUESTS_COMPLETED = _m.CounterOpts(
     namespace="deliver", name="requests_completed",
     help="The number of deliver seek requests completed, by final "
          "status.", label_names=("channel", "status"))
+REQUESTS_RECEIVED = _m.CounterOpts(
+    namespace="deliver", name="requests_received",
+    help="The number of deliver seek requests received.",
+    label_names=("channel",))
 
 
 class DeliverMetrics:
@@ -52,6 +56,8 @@ class DeliverMetrics:
         self.blocks_sent = provider.new_counter(BLOCKS_SENT)
         self.requests_completed = provider.new_counter(
             REQUESTS_COMPLETED)
+        self.requests_received = provider.new_counter(
+            REQUESTS_RECEIVED)
 
 
 class DeliverHandler:
@@ -80,6 +86,8 @@ class DeliverHandler:
             parsed = (payload, ch)
         except Exception:
             channel, parsed = "", None
+        self.metrics.requests_received.with_labels(
+            "channel", channel).add(1)
         # curry once: deliver is the block-fanout hot path — no
         # per-block instrument allocation
         sent = self.metrics.blocks_sent.with_labels("channel", channel)

@@ -120,11 +120,78 @@ _registry_lock = threading.Lock()
 _handler: logging.Handler | None = None
 
 
+class _CountingFilter(logging.Filter):
+    """logging_entries_written: one count per record the flogging
+    handler emits, labeled by level. The companion entries_checked
+    counter hooks `Logger.isEnabledFor` (see wire_logging_metrics) so
+    it counts every log CALL evaluated against the active level —
+    including the suppressed ones — matching the reference's
+    check/write observer split."""
+
+    def __init__(self):
+        super().__init__()
+        self.written = 0
+        self._counters: dict | None = None   # levelname -> counter
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        self.written += 1
+        cs = self._counters
+        if cs is not None:
+            c = cs.get(record.levelname)
+            if c is None:
+                c = cs["_base"].with_labels("level", record.levelname)
+                cs[record.levelname] = c
+            c.add(1)
+        return True
+
+
+_log_counts = _CountingFilter()
+_checked_counters: dict | None = None
+_checked_patched = False
+
+
+def wire_logging_metrics(provider) -> None:
+    """Attach a metrics provider to the flogging observer (called by
+    node assembly once the operations metrics exist). entries_checked
+    counts every log call evaluated against the active level (a
+    process-wide `Logger.isEnabledFor` hook); entries_written counts
+    records actually emitted by the flogging handler."""
+    global _checked_counters, _checked_patched
+    from fabric_tpu.common import metrics as _m
+    checked = provider.new_counter(_m.CounterOpts(
+        namespace="logging", name="entries_checked",
+        help="The number of log calls checked against the active "
+             "logging level, by level.", label_names=("level",)))
+    written = provider.new_counter(_m.CounterOpts(
+        namespace="logging", name="entries_written",
+        help="The number of log records written out, by level.",
+        label_names=("level",)))
+    _log_counts._counters = {"_base": written}
+    _checked_counters = {"_base": checked}
+    if not _checked_patched:
+        _checked_patched = True
+        orig = logging.Logger.isEnabledFor
+
+        def counting_is_enabled_for(self, level):
+            cs = _checked_counters
+            if cs is not None:
+                name = logging.getLevelName(level)
+                c = cs.get(name)
+                if c is None:
+                    c = cs["_base"].with_labels("level", name)
+                    cs[name] = c
+                c.add(1)
+            return orig(self, level)
+
+        logging.Logger.isEnabledFor = counting_is_enabled_for
+
+
 def _ensure_handler() -> logging.Handler:
     global _handler
     if _handler is None:
         _handler = logging.StreamHandler(sys.stderr)
         _handler.setFormatter(_Formatter())
+        _handler.addFilter(_log_counts)
     return _handler
 
 

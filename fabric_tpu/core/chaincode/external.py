@@ -45,11 +45,28 @@ class ExternalChaincodeError(Exception):
     pass
 
 
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+LAUNCH_DURATION = _m.HistogramOpts(
+    namespace="chaincode", name="launch_duration",
+    help="The time to launch a chaincode: connect + REGISTER "
+         "handshake with the external process, in seconds.",
+    label_names=("chaincode", "success"))
+LAUNCH_FAILURES = _m.CounterOpts(
+    namespace="chaincode", name="launch_failures",
+    help="The number of chaincode launches (connect/handshake) that "
+         "failed.", label_names=("chaincode",))
+LAUNCH_TIMEOUTS = _m.CounterOpts(
+    namespace="chaincode", name="launch_timeouts",
+    help="The number of chaincode launches that timed out waiting "
+         "for the external process.", label_names=("chaincode",))
+
+
 class ExternalChaincodeClient:
     """Peer-side handle to one CCaaS process; duck-types Chaincode."""
 
     def __init__(self, name: str, address: str,
-                 timeout_s: float = 30.0):
+                 timeout_s: float = 30.0, metrics_provider=None):
         self.name = name
         self._address = address
         self._timeout = timeout_s
@@ -58,20 +75,37 @@ class ExternalChaincodeClient:
         self._to_cc: Optional[queue.Queue] = None
         self._from_cc: Optional[queue.Queue] = None
         self._stream_thread: Optional[threading.Thread] = None
+        provider = metrics_provider or _m.DisabledProvider()
+        self._m_launch = provider.new_histogram(LAUNCH_DURATION)
+        self._m_launch_fail = provider.new_counter(LAUNCH_FAILURES)
+        self._m_launch_timeout = provider.new_counter(LAUNCH_TIMEOUTS)
 
     # -- connection management --
 
     def _ensure_stream(self) -> None:
         if self._channel is not None:
             return
+        import time as _t
+        t0 = _t.perf_counter()
         try:
             self._connect()
-        except Exception:
+        except Exception as e:
             # a half-open stream must not look connected: the next
             # caller (e.g. the external-builder launch retry loop)
             # would skip the handshake and block on a dead dialog
             self._reset()
+            self._m_launch_fail.with_labels(
+                "chaincode", self.name).add(1)
+            if isinstance(e, queue.Empty) or "timed out" in str(e):
+                self._m_launch_timeout.with_labels(
+                    "chaincode", self.name).add(1)
+            self._m_launch.with_labels(
+                "chaincode", self.name, "success", "false").observe(
+                _t.perf_counter() - t0)
             raise
+        self._m_launch.with_labels(
+            "chaincode", self.name, "success", "true").observe(
+            _t.perf_counter() - t0)
 
     def _connect(self) -> None:
         self._channel = grpc.insecure_channel(self._address)

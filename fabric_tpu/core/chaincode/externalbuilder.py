@@ -237,7 +237,10 @@ class ExternalBuilderRegistry:
                 [exe, bld, run_meta], env=self._env(builder),
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
-        client = ExternalChaincodeClient(name, address)
+        client = ExternalChaincodeClient(
+            name, address,
+            metrics_provider=getattr(support, "metrics_provider",
+                                     None))
         deadline = time.monotonic() + connect_timeout_s
         last = None
         while True:

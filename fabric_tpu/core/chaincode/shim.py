@@ -97,6 +97,21 @@ class ChaincodeStub:
                 f"{self._fence['reason']}")
         return self._sim
 
+    def _rx(self, rtype: str) -> None:
+        """Request-entry count (chaincode_shim_requests_received)."""
+        sup = self._support
+        if sup is not None and hasattr(sup, "count_shim_received"):
+            sup.count_shim_received(rtype, self._channel_id, self._ns)
+
+    def _count(self, rtype: str, ok: bool) -> None:
+        """Completion count (the reference counts the handler's
+        transaction-stream messages; here every stub state access is
+        one shim request — the external-chaincode dialog funnels
+        through these same methods)."""
+        sup = self._support
+        if sup is not None and hasattr(sup, "count_shim"):
+            sup.count_shim(rtype, self._channel_id, self._ns, ok)
+
     # -- invocation context --
 
     def get_channel_id(self) -> str:
@@ -130,13 +145,32 @@ class ChaincodeStub:
     # -- state --
 
     def get_state(self, key: str) -> Optional[bytes]:
-        return self._live().get_state(self._ns, key)
+        self._rx("GET_STATE")
+        try:
+            out = self._live().get_state(self._ns, key)
+        except Exception:
+            self._count("GET_STATE", False)
+            raise
+        self._count("GET_STATE", True)
+        return out
 
     def put_state(self, key: str, value: bytes) -> None:
-        self._live().put_state(self._ns, key, value)
+        self._rx("PUT_STATE")
+        try:
+            self._live().put_state(self._ns, key, value)
+        except Exception:
+            self._count("PUT_STATE", False)
+            raise
+        self._count("PUT_STATE", True)
 
     def del_state(self, key: str) -> None:
-        self._live().del_state(self._ns, key)
+        self._rx("DEL_STATE")
+        try:
+            self._live().del_state(self._ns, key)
+        except Exception:
+            self._count("DEL_STATE", False)
+            raise
+        self._count("DEL_STATE", True)
 
     def set_state_validation_parameter(self, key: str,
                                        policy: bytes) -> None:
@@ -158,7 +192,10 @@ class ChaincodeStub:
     def get_state_by_range(self, start: str, end: str):
         """Iterate (key, value) in [start, end); '' means unbounded,
         matching the reference's GetStateByRange semantics."""
-        return self._live().get_state_range(self._ns, start, end)
+        self._rx("GET_STATE_BY_RANGE")
+        out = self._live().get_state_range(self._ns, start, end)
+        self._count("GET_STATE_BY_RANGE", True)
+        return out
 
     def get_history_for_key(self, key: str):
         """Newest-first history of committed values for `key` —
@@ -170,12 +207,17 @@ class ChaincodeStub:
             raise NotImplementedError(
                 "history queries need a ledger-wired stub (endorser "
                 "invocations have one; this context does not)")
-        return self._ledger.get_history_for_key(self._ns, key)
+        self._rx("GET_HISTORY_FOR_KEY")
+        out = self._ledger.get_history_for_key(self._ns, key)
+        self._count("GET_HISTORY_FOR_KEY", True)
+        return out
 
     def get_query_result(self, query: str):
         """Rich JSON-selector query (reference GetQueryResult; the
         statecouchdb surface). Yields (key, value)."""
+        self._rx("GET_QUERY_RESULT")
         results, _bm = self._live().get_query_result(self._ns, query)
+        self._count("GET_QUERY_RESULT", True)
         return iter(results)
 
     def get_query_result_with_pagination(self, query: str,
@@ -197,7 +239,10 @@ class ChaincodeStub:
         return sim
 
     def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
-        return self._pvt_sim().get_private_data(self._ns, collection, key)
+        self._rx("GET_PRIVATE_DATA")
+        out = self._pvt_sim().get_private_data(self._ns, collection, key)
+        self._count("GET_PRIVATE_DATA", True)
+        return out
 
     def get_private_data_hash(self, collection: str, key: str
                               ) -> Optional[bytes]:
@@ -207,7 +252,9 @@ class ChaincodeStub:
 
     def put_private_data(self, collection: str, key: str,
                          value: bytes) -> None:
+        self._rx("PUT_PRIVATE_DATA")
         self._pvt_sim().put_private_data(self._ns, collection, key, value)
+        self._count("PUT_PRIVATE_DATA", True)
 
     def del_private_data(self, collection: str, key: str) -> None:
         self._pvt_sim().del_private_data(self._ns, collection, key)
@@ -257,5 +304,8 @@ class ChaincodeStub:
             return error("chaincode-to-chaincode unavailable")
         self._live()   # a fenced (timed-out) stub must not spawn an
         #                unfenced child stub over the shared simulator
-        return self._support.invoke_chaincode(
+        self._rx("INVOKE_CHAINCODE")
+        resp = self._support.invoke_chaincode(
             self, name, list(args), channel or self._channel_id)
+        self._count("INVOKE_CHAINCODE", resp.status < 400)
+        return resp

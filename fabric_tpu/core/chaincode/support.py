@@ -38,10 +38,25 @@ EXECUTE_DURATION = _m.HistogramOpts(
     help="The time a chaincode invocation took end to end (init or "
          "invoke), including cc2cc sub-calls.",
     label_names=("chaincode", "success"))
+SHIM_REQUESTS_RECEIVED = _m.CounterOpts(
+    namespace="chaincode", name="shim_requests_received",
+    help="The number of chaincode shim requests received (state "
+         "access, range/query iteration, events, cc2cc), by request "
+         "type.", label_names=("type", "channel", "chaincode"))
+SHIM_REQUESTS_COMPLETED = _m.CounterOpts(
+    namespace="chaincode", name="shim_requests_completed",
+    help="The number of chaincode shim requests completed, by "
+         "request type and success.",
+    label_names=("type", "channel", "chaincode", "success"))
 
 
 class ExecuteError(Exception):
     pass
+
+
+class ChaincodeNotFoundError(ExecuteError):
+    """The named chaincode is not registered on this peer (the
+    endorser maps this to chaincode_instantiation_failures)."""
 
 
 @dataclass
@@ -85,8 +100,30 @@ class ChaincodeSupport:
         self._timeout = execute_timeout_s
         self._channel_source = channel_source
         provider = metrics_provider or _m.DisabledProvider()
+        self.metrics_provider = metrics_provider
         self._m_timeouts = provider.new_counter(EXECUTE_TIMEOUTS)
         self._m_duration = provider.new_histogram(EXECUTE_DURATION)
+        self._m_shim_rx = provider.new_counter(SHIM_REQUESTS_RECEIVED)
+        self._m_shim_done = provider.new_counter(
+            SHIM_REQUESTS_COMPLETED)
+
+    def count_shim_received(self, rtype: str, channel: str,
+                            chaincode: str) -> None:
+        """One shim request ENTERING (called by ChaincodeStub at
+        method entry — in-flight/hung requests show as a
+        received-minus-completed gap)."""
+        self._m_shim_rx.with_labels(
+            "type", rtype, "channel", channel,
+            "chaincode", chaincode).add(1)
+
+    def count_shim(self, rtype: str, channel: str, chaincode: str,
+                   ok: bool) -> None:
+        """One COMPLETED shim request (both in-process chaincode and
+        the external-builder/CCaaS dialog funnel through the same
+        stub)."""
+        self._m_shim_done.with_labels(
+            "type", rtype, "channel", channel, "chaincode", chaincode,
+            "success", "true" if ok else "false").add(1)
 
     def register(self, name: str, chaincode) -> None:
         """`chaincode`: anything with init(stub)/invoke(stub) — an
@@ -124,7 +161,8 @@ class ChaincodeSupport:
         cc_id = spec.chaincode_spec.chaincode_id
         cc = self._chaincodes.get(cc_id.name)
         if cc is None:
-            raise ExecuteError(f"chaincode {cc_id.name} not found")
+            raise ChaincodeNotFoundError(
+                f"chaincode {cc_id.name} not found")
         stub = shim.ChaincodeStub(
             channel_id=channel_id, tx_id=tx_id, namespace=cc_id.name,
             simulator=simulator,

@@ -67,6 +67,11 @@ DUPLICATE_TXS_FAILURES = _m.CounterOpts(
     namespace="endorser", name="duplicate_transaction_failures",
     help="The number of proposals rejected as duplicate "
          "transaction IDs.", label_names=("channel",))
+CHAINCODE_INSTANTIATION_FAILURES = _m.CounterOpts(
+    namespace="endorser", name="chaincode_instantiation_failures",
+    help="The number of proposals naming a chaincode that is not "
+         "registered/committed on the channel.",
+    label_names=("channel", "chaincode"))
 PROPOSAL_DURATION = _m.HistogramOpts(
     namespace="endorser", name="proposal_duration",
     help="The time to complete a proposal end to end.",
@@ -92,6 +97,8 @@ class EndorserMetrics:
             ENDORSEMENT_FAILURES)
         self.duplicate_failures = provider.new_counter(
             DUPLICATE_TXS_FAILURES)
+        self.instantiation_failures = provider.new_counter(
+            CHAINCODE_INSTANTIATION_FAILURES)
         self.proposal_duration = provider.new_histogram(
             PROPOSAL_DURATION)
 
@@ -186,6 +193,15 @@ class Endorser:
         except Exception as e:
             logger.warning("chaincode execution failed for [%s]: %s",
                            up.tx_id, e)
+            from fabric_tpu.core.chaincode.support import (
+                ChaincodeNotFoundError,
+            )
+            if isinstance(e, ChaincodeNotFoundError):
+                # the named chaincode is not registered on this peer
+                # (reference: chaincode_instantiation_failures)
+                self.metrics.instantiation_failures.with_labels(
+                    "channel", up.channel_id,
+                    "chaincode", up.chaincode_name).add(1)
             self.metrics.simulation_failures.with_labels(
                 "channel", up.channel_id,
                 "chaincode", up.chaincode_name).add(1)

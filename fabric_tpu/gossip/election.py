@@ -101,7 +101,17 @@ class LeaderElectionService:
                  on_gain: Callable[[], None],
                  on_lose: Callable[[], None],
                  propose_interval_s: float = 0.3,
-                 leader_alive_s: float = 1.5):
+                 leader_alive_s: float = 1.5,
+                 metrics_provider=None):
+        from fabric_tpu.common import metrics as _m
+        provider = metrics_provider or _m.DisabledProvider()
+        self._m_leader = provider.new_gauge(_m.GaugeOpts(
+            namespace="gossip", subsystem="leader_election",
+            name="leader",
+            help="The leadership status of this peer in its org's "
+                 "gossip leader election: 1 if leader, 0 otherwise.",
+            label_names=("channel",))).with_labels(
+            "channel", channel_id)
         self._node = node
         self._channel = node.join_channel(channel_id)
         self._channel.on_leadership = self._handle
@@ -154,6 +164,7 @@ class LeaderElectionService:
             self._channel, gmsg.sign_message(msg, self._node.signer))
 
     def _run_actions(self, actions: list) -> None:
+        self._m_leader.set(1 if self.is_leader else 0)
         for act in actions:
             if act == PROPOSE:
                 self._send(is_declaration=False)

@@ -13,12 +13,64 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Callable, Optional
 
 from fabric_tpu.gossip import message as gmsg
 from fabric_tpu.protos import gossip as gpb, rwset as rwpb
 
 logger = logging.getLogger("gossip.privdata")
+
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+SEND_DURATION = _m.HistogramOpts(
+    namespace="gossip", subsystem="privdata", name="send_duration",
+    help="The time to distribute endorsement-time private data to "
+         "eligible peers in seconds.", label_names=("channel",))
+VALIDATION_DURATION = _m.HistogramOpts(
+    namespace="gossip", subsystem="privdata",
+    name="validation_duration",
+    help="The time to validate a received private-data push against "
+         "its on-chain hashes in seconds.", label_names=("channel",))
+RECONCILIATION_DURATION = _m.HistogramOpts(
+    namespace="gossip", subsystem="privdata",
+    name="reconciliation_duration",
+    help="The time one reconciliation round took in seconds.",
+    label_names=("channel",))
+LIST_MISSING_DURATION = _m.HistogramOpts(
+    namespace="gossip", subsystem="privdata",
+    name="list_missing_duration",
+    help="The time to list missing private-data entries from the "
+         "store in seconds.", label_names=("channel",))
+FETCH_DURATION = _m.HistogramOpts(
+    namespace="gossip", subsystem="privdata", name="fetch_duration",
+    help="The time from requesting missing private data to the "
+         "response being committed in seconds.",
+    label_names=("channel",))
+RETRIEVE_DURATION = _m.HistogramOpts(
+    namespace="gossip", subsystem="privdata",
+    name="retrieve_duration",
+    help="The time to retrieve requested private data from local "
+         "stores when serving a fellow peer in seconds.",
+    label_names=("channel",))
+
+
+class _PrivMetrics:
+    def __init__(self, provider, channel: str):
+        provider = provider or _m.DisabledProvider()
+        lbl = ("channel", channel)
+        self.send = provider.new_histogram(
+            SEND_DURATION).with_labels(*lbl)
+        self.validation = provider.new_histogram(
+            VALIDATION_DURATION).with_labels(*lbl)
+        self.reconciliation = provider.new_histogram(
+            RECONCILIATION_DURATION).with_labels(*lbl)
+        self.list_missing = provider.new_histogram(
+            LIST_MISSING_DURATION).with_labels(*lbl)
+        self.fetch = provider.new_histogram(
+            FETCH_DURATION).with_labels(*lbl)
+        self.retrieve = provider.new_histogram(
+            RETRIEVE_DURATION).with_labels(*lbl)
 
 
 class PrivDataProvider:
@@ -43,6 +95,9 @@ class PrivDataProvider:
                       "req_sig_failed": 0, "req_served": 0,
                       "req_no_data": 0, "res_committed": 0,
                       "res_rejected": 0, "reconcile_requests": 0}
+        self.metrics = _PrivMetrics(
+            getattr(peer, "metrics_provider", None), channel_id)
+        self._fetch_started: dict = {}   # (ns, coll, txid) -> t0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -104,6 +159,7 @@ class PrivDataProvider:
 
     def distribute(self, tx_id: str, height: int,
                    pvt_results: rwpb.TxPvtReadWriteSet) -> None:
+        t0 = time.perf_counter()
         for nspvt in pvt_results.ns_pvt_rwset:
             for cpvt in nspvt.collection_pvt_rwset:
                 endpoints = self._member_endpoints(
@@ -122,8 +178,10 @@ class PrivDataProvider:
                 smsg = gmsg.sign_message(msg, self._node.signer)
                 for ep in endpoints:
                     self._node.send_endpoint(ep, smsg)
+        self.metrics.send.observe(time.perf_counter() - t0)
 
     def _on_push(self, sender: str, msg: gpb.GossipMessage) -> None:
+        t0 = time.perf_counter()
         pd = msg.private_data
         if not self._i_am_member(pd.namespace, pd.collection_name):
             return  # not authorized to hold this cleartext
@@ -139,6 +197,7 @@ class PrivDataProvider:
             single = existing
         self._peer.transient_store.persist(
             pd.tx_id, pd.private_sim_height, single)
+        self.metrics.validation.observe(time.perf_counter() - t0)
 
     # -- pull (missing at commit / reconciliation,
     #    reference pull.go fetchPrivateData) --
@@ -153,8 +212,11 @@ class PrivDataProvider:
     def reconcile_once(self) -> int:
         """Request every missing (block, tx, ns, coll) this peer is a
         member of from authorized peers; returns #requests sent."""
+        t_round = time.perf_counter()
         ledger = self._peer_channel.ledger
         missing = ledger.missing_pvt_data(max_entries=64)
+        self.metrics.list_missing.observe(
+            time.perf_counter() - t_round)
         sent = 0
         for m in missing:
             # eligibility under the config that governed the gap's own
@@ -176,9 +238,16 @@ class PrivDataProvider:
             d.seq_in_block = m.tx_num
             smsg = gmsg.sign_message(msg, self._node.signer)
             self.stats["reconcile_requests"] += 1
+            if len(self._fetch_started) > 1024:
+                self._fetch_started.clear()   # unanswered backlog
+            self._fetch_started[(m.namespace, m.collection,
+                                 m.block_num, m.tx_num)] = \
+                time.perf_counter()
             self._node.send_endpoint(endpoints[sent % len(endpoints)],
                                      smsg)
             sent += 1
+        self.metrics.reconciliation.observe(
+            time.perf_counter() - t_round)
         return sent
 
     def _on_request(self, sender: str, msg: gpb.GossipMessage,
@@ -212,6 +281,7 @@ class PrivDataProvider:
                     "verification; dropping", self.channel_id, sender)
                 return
         req_org = self._org_of(requester.identity)
+        t_serve = time.perf_counter()
         out = gpb.GossipMessage(tag=gpb.GossipMessage.CHAN_ONLY)
         self._gchannel._tag_channel(out)
         ledger = self._peer_channel.ledger
@@ -240,6 +310,8 @@ class PrivDataProvider:
                     el.payload.append(cpvt.rwset)
         if out.private_res.elements:
             self.stats["req_served"] += 1
+            self.metrics.retrieve.observe(
+                time.perf_counter() - t_serve)
             self._node.send_endpoint(sender, gmsg.unsigned(out))
         else:
             self.stats["req_no_data"] += 1
@@ -254,6 +326,14 @@ class PrivDataProvider:
                     bytes(payload))
                 self.stats["res_committed" if ok
                            else "res_rejected"] += 1
+                if ok:
+                    t0f = self._fetch_started.pop(
+                        (el.digest.namespace, el.digest.collection,
+                         el.digest.block_seq, el.digest.seq_in_block),
+                        None)
+                    if t0f is not None:
+                        self.metrics.fetch.observe(
+                            time.perf_counter() - t0f)
                 if ok:
                     logger.info("[%s] reconciled pvt data for block %d "
                                 "tx %d [%s/%s]", self.channel_id,

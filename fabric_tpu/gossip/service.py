@@ -135,7 +135,9 @@ class GossipService:
         res.election = LeaderElectionService(
             self.node, channel_id, on_gain, on_lose,
             propose_interval_s=self.node.cfg.alive_interval_s,
-            leader_alive_s=self.node.cfg.alive_expiration_s)
+            leader_alive_s=self.node.cfg.alive_expiration_s,
+            metrics_provider=getattr(self._peer, "metrics_provider",
+                                     None))
         state.start()
         privdata.start()
         res.election.start()

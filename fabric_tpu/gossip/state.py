@@ -28,6 +28,11 @@ STATE_HEIGHT = _mdefs.GaugeOpts(
     namespace="gossip", subsystem="state", name="height",
     help="The ledger height this peer has committed through the "
          "gossip state pipeline.", label_names=("channel",))
+COMMIT_DURATION = _mdefs.HistogramOpts(
+    namespace="gossip", subsystem="state", name="commit_duration",
+    help="The time to commit one gossip-delivered block through the "
+         "peer's validation + commit pipeline in seconds.",
+    label_names=("channel",))
 PAYLOAD_BUFFER_SIZE = _mdefs.GaugeOpts(
     namespace="gossip", subsystem="payload_buffer", name="size",
     help="The number of out-of-order blocks parked in the payload "
@@ -105,6 +110,8 @@ class GossipStateProvider:
             "channel", channel_id)
         self._m_buffer = provider.new_gauge(
             PAYLOAD_BUFFER_SIZE).with_labels("channel", channel_id)
+        self._m_commit = provider.new_histogram(
+            COMMIT_DURATION).with_labels("channel", channel_id)
 
         self._gchannel.on_block = self._on_block
         self._gchannel.on_state_request = self._on_state_request
@@ -170,7 +177,10 @@ class GossipStateProvider:
                 self.buffer.set_next(seq)  # retry from another peer
                 continue
             try:
+                import time as _t
+                _t0 = _t.perf_counter()
                 self._peer.process_block(block)
+                self._m_commit.observe(_t.perf_counter() - _t0)
             except Exception:
                 logger.exception("[%s] commit of block [%d] failed",
                                  self.channel_id, seq)

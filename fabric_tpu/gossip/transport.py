@@ -27,6 +27,15 @@ logger = logging.getLogger("gossip.comm")
 
 Handler = Callable[[str, gpb.SignedGossipMessage], None]
 
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+OVERFLOW_COUNT = _m.CounterOpts(
+    namespace="gossip", subsystem="comm", name="overflow_count",
+    help="The number of inbound gossip messages dropped because the "
+         "receive buffer was full (drop-oldest policy).")
+
+
+
 
 class Transport:
     """The seam. Implementations: LocalTransport (in-proc),
@@ -46,8 +55,11 @@ class Transport:
 
 class LocalTransport(Transport):
     def __init__(self, network: "LocalNetwork", endpoint: str,
-                 inbox_size: int = 1024):
+                 inbox_size: int = 1024, metrics_provider=None):
         self.endpoint = endpoint
+        self._m_overflow = (metrics_provider or
+                            _m.DisabledProvider()).new_counter(
+            OVERFLOW_COUNT)
         self._net = network
         self._handler: Optional[Handler] = None
         self._inbox: queue.Queue = queue.Queue(maxsize=inbox_size)
@@ -70,6 +82,7 @@ class LocalTransport(Transport):
             self._inbox.put_nowait((sender, msg))
         except queue.Full:
             # drop-oldest: stale gossip is worthless, fresh is not
+            self._m_overflow.add(1)
             try:
                 self._inbox.get_nowait()
             except queue.Empty:

@@ -45,6 +45,14 @@ BLOCKCHAIN_HEIGHT = metrics_mod.GaugeOpts(
     namespace="ledger", name="blockchain_height",
     help="The height of the chain (number of committed blocks).",
     label_names=("channel",))
+BLOCKSTORAGE_ONLY_COMMIT_TIME = metrics_mod.HistogramOpts(
+    namespace="ledger", name="blockstorage_commit_time",
+    help="The time to append the block (without private data) to the "
+         "block store.", label_names=("channel",))
+TRANSACTION_COUNT = metrics_mod.CounterOpts(
+    namespace="ledger", name="transaction_count",
+    help="The number of transactions committed, by validation code.",
+    label_names=("channel", "validation_code"))
 
 
 class LedgerError(Exception):
@@ -94,6 +102,10 @@ class KVLedger:
             STATEDB_COMMIT_TIME).with_labels("channel", ledger_id)
         self._m_height = provider.new_gauge(
             BLOCKCHAIN_HEIGHT).with_labels("channel", ledger_id)
+        self._m_blkstore_time = provider.new_histogram(
+            BLOCKSTORAGE_ONLY_COMMIT_TIME).with_labels(
+            "channel", ledger_id)
+        self._m_tx_count = provider.new_counter(TRANSACTION_COUNT)
 
         from fabric_tpu.ledger.snapshot import SnapshotRequests
         self.snapshot_requests = SnapshotRequests(
@@ -340,8 +352,18 @@ class KVLedger:
         self._maybe_generate_snapshots()
         self._m_block_time.observe(t3 - t0)
         self._m_store_time.observe(t2 - t1)
+        self._m_blkstore_time.observe(t2 - t1)
         self._m_state_time.observe(t3 - t2)
         self._m_height.set(self.height)
+        from collections import Counter as _Counter
+        for code, cnt in _Counter(codes).items():
+            try:
+                cname = txpb.TxValidationCode.Name(code)
+            except ValueError:
+                cname = str(code)
+            self._m_tx_count.with_labels(
+                "channel", self.ledger_id,
+                "validation_code", cname).add(cnt)
         logger.info(
             "[%s] committed block [%d] with %d tx(s) in %.1fms "
             "(state_validation=%.1fms block_commit=%.1fms "

@@ -30,6 +30,17 @@ class OperationsServer:
         host, port = address.rsplit(":", 1)
         self._metrics = metrics_provider
         self._version = version
+        if metrics_provider is not None:
+            from fabric_tpu.common import metrics as _m
+            try:
+                metrics_provider.new_gauge(_m.GaugeOpts(
+                    namespace="fabric", name="version",
+                    help="The active version of the node software "
+                         "(constant 1, labeled by version).",
+                    label_names=("version",))).with_labels(
+                    "version", version).set(1)
+            except Exception:
+                logger.debug("fabric_version gauge unavailable")
         self._profile_enabled = profile_enabled
         self._checkers: dict[str, Callable[[], None]] = {}
         self._extra: dict[str, Callable] = {}

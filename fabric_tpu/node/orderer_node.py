@@ -57,6 +57,8 @@ class OrdererNode:
             statsd_interval_s=cfg.get_duration(
                 "Metrics.Statsd.WriteInterval", 10.0))
         self.metrics = provider
+        from fabric_tpu.common import flogging as _flog
+        _flog.wire_logging_metrics(provider)
 
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(
@@ -103,7 +105,8 @@ class OrdererNode:
             cluster_ep,
             tls_root_ca=root_cas if cluster_tls else None,
             client_cert=client_cert, client_key=client_key,
-            require_client_auth=cluster_tls)
+            require_client_auth=cluster_tls,
+            metrics_provider=provider)
 
         ledger_dir = cfg.get_path("FileLedger.Location")
         os.makedirs(ledger_dir, exist_ok=True)
@@ -123,7 +126,8 @@ class OrdererNode:
              "etcdraft": raft_mod.consenter(self.cluster,
                                             tick_interval_s=tick,
                                             metrics_provider=provider),
-             "kafka": _kafka_deprecated})
+             "kafka": _kafka_deprecated},
+            metrics_provider=provider)
         from fabric_tpu.orderer.broadcast import BroadcastMetrics
         broadcast = BroadcastHandler(
             self.registrar, metrics=BroadcastMetrics(provider))

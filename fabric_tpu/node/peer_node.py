@@ -98,6 +98,8 @@ class PeerNode:
             statsd_interval_s=cfg.get_duration(
                 "metrics.statsd.writeInterval", 10.0))
         self.metrics = provider
+        from fabric_tpu.common import flogging as _flog
+        _flog.wire_logging_metrics(provider)
 
         fs_path = cfg.get_path("peer.fileSystemPath")
         os.makedirs(fs_path, exist_ok=True)
@@ -238,7 +240,8 @@ class PeerNode:
         for spec in cfg.get("chaincode.external") or []:
             name, _, address = spec.partition("=")
             self.peer.chaincode_support.register(
-                name, ExternalChaincodeClient(name, address))
+                name, ExternalChaincodeClient(
+                    name, address, metrics_provider=provider))
             logger.info("registered external chaincode %s at %s",
                         name, address)
 

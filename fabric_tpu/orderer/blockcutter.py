@@ -9,12 +9,19 @@ consenter (solo/raft), exactly like the reference.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 
+from fabric_tpu.common import metrics as _m
 from fabric_tpu.protos import common
 from fabric_tpu.protoutil import protoutil as pu
 
 logger = logging.getLogger("blockcutter")
+
+BLOCK_FILL_DURATION = _m.HistogramOpts(
+    namespace="blockcutter", name="block_fill_duration",
+    help="The time from first transaction enqueueing to the block "
+         "being cut in seconds.", label_names=("channel",))
 
 
 @dataclass
@@ -27,13 +34,18 @@ class BatchConfig:
 
 
 class Receiver:
-    def __init__(self, config_source):
+    def __init__(self, config_source, metrics_provider=None,
+                 channel: str = ""):
         """`config_source()` returns the current BatchConfig — config
         can change between blocks (reference fetches
         sharedConfigFetcher.OrdererConfig() per call)."""
         self._config_source = config_source
         self._pending: list[common.Envelope] = []
         self._pending_bytes = 0
+        self._first_enqueued: float | None = None
+        provider = metrics_provider or _m.DisabledProvider()
+        self._fill_duration = provider.new_histogram(
+            BLOCK_FILL_DURATION).with_labels("channel", channel)
 
     def ordered(self, env: common.Envelope
                 ) -> tuple[list[list[common.Envelope]], bool]:
@@ -56,6 +68,8 @@ class Receiver:
             batches.append(self._cut())
 
         self._pending.append(env)
+        if self._first_enqueued is None:
+            self._first_enqueued = time.perf_counter()
         self._pending_bytes += msg_bytes
         if len(self._pending) >= cfg.max_message_count:
             batches.append(self._cut())
@@ -67,6 +81,10 @@ class Receiver:
 
     def _cut(self) -> list[common.Envelope]:
         batch = self._pending
+        if self._first_enqueued is not None:
+            self._fill_duration.observe(
+                time.perf_counter() - self._first_enqueued)
+            self._first_enqueued = None
         self._pending = []
         self._pending_bytes = 0
         return batch

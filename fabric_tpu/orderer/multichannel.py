@@ -28,6 +28,19 @@ from fabric_tpu.orderer.msgprocessor import StandardChannel
 
 logger = logging.getLogger("orderer.multichannel")
 
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+PARTICIPATION_STATUS = _m.GaugeOpts(
+    namespace="participation", name="status",
+    help="The channel participation status of the node on the "
+         "channel: 1 for the current status (active, onboarding, "
+         "failed), 0 otherwise.", label_names=("channel", "status"))
+PARTICIPATION_RELATION = _m.GaugeOpts(
+    namespace="participation", name="consensus_relation",
+    help="The consensus relation of the node on the channel: 1 for "
+         "the current relation (consenter, follower, other), 0 "
+         "otherwise.", label_names=("channel", "relation"))
+
 
 class OrdererLedger:
     """The ordering side keeps only the block chain (no state DB) —
@@ -79,11 +92,13 @@ class ChainSupport:
     here."""
 
     def __init__(self, channel_id: str, ledger: OrdererLedger,
-                 signer, csp, consenter_factory):
+                 signer, csp, consenter_factory,
+                 metrics_provider=None):
         self.channel_id = channel_id
         self.ledger = ledger
         self.signer = signer
         self._csp = csp
+        self._metrics_provider = metrics_provider
         self._lock = threading.Lock()
         self._bundle: Optional[Bundle] = None
         self._validator: Optional[ConfigTxValidator] = None
@@ -98,7 +113,9 @@ class ChainSupport:
         self._apply_config_block(cfg_block)
         self._last_config_number = cfg_block.header.number
 
-        self.cutter = blockcutter.Receiver(self._batch_config)
+        self.cutter = blockcutter.Receiver(
+            self._batch_config, metrics_provider=metrics_provider,
+            channel=channel_id)
         self.writer = BlockWriter(ledger, signer, last_block=last)
         self.processor = StandardChannel(channel_id, self)
         self.chain = consenter_factory(self)
@@ -214,13 +231,20 @@ class Registrar:
     restored from disk on restart."""
 
     def __init__(self, root_dir: str, signer, csp,
-                 consenters: dict[str, Callable]):
+                 consenters: dict[str, Callable],
+                 metrics_provider=None):
         self._root = root_dir
         self._signer = signer
         self._csp = csp
         self._consenters = dict(consenters)
         self._chains: dict[str, ChainSupport] = {}
         self._lock = threading.Lock()
+        self._metrics_provider = metrics_provider or \
+            _m.DisabledProvider()
+        self._part_status = self._metrics_provider.new_gauge(
+            PARTICIPATION_STATUS)
+        self._part_relation = self._metrics_provider.new_gauge(
+            PARTICIPATION_RELATION)
         os.makedirs(root_dir, exist_ok=True)
         for channel_id in sorted(os.listdir(root_dir)):
             if os.path.isdir(os.path.join(root_dir, channel_id)):
@@ -239,6 +263,22 @@ class Registrar:
             return maker(support)
         return factory
 
+    def _set_participation(self, channel_id: str, support) -> None:
+        """Channel-participation gauges (reference:
+        `orderer/common/channelparticipation` info endpoint exposes the
+        same status/relation pair)."""
+        follower = type(support.chain).__name__ == "FollowerChain"
+        status = "onboarding" if follower else "active"
+        relation = "follower" if follower else "consenter"
+        for s in ("active", "onboarding", "failed"):
+            self._part_status.with_labels(
+                "channel", channel_id, "status", s).set(
+                1 if s == status else 0)
+        for r in ("consenter", "follower", "other"):
+            self._part_relation.with_labels(
+                "channel", channel_id, "relation", r).set(
+                1 if r == relation else 0)
+
     def _restore(self, channel_id: str) -> None:
         ledger = OrdererLedger(os.path.join(self._root, channel_id))
         if ledger.height == 0:
@@ -247,12 +287,14 @@ class Registrar:
         try:
             support = ChainSupport(channel_id, ledger, self._signer,
                                    self._csp,
-                                   self._consenter_factory())
+                                   self._consenter_factory(),
+                                   metrics_provider=self._metrics_provider)
         except Exception:
             ledger.close()
             raise
         self._chains[channel_id] = support
         support.chain.start()
+        self._set_participation(channel_id, support)
 
     def join(self, join_block: common.Block) -> ChainSupport:
         """Channel participation join (reference:
@@ -287,7 +329,8 @@ class Registrar:
                     ledger.add_block(join_block)
                 support = ChainSupport(channel_id, ledger, self._signer,
                                        self._csp,
-                                       self._consenter_factory())
+                                       self._consenter_factory(),
+                                       metrics_provider=self._metrics_provider)
             except Exception:
                 ledger.close()
                 if created:
@@ -295,6 +338,7 @@ class Registrar:
                 raise
             self._chains[channel_id] = support
         support.chain.start()
+        self._set_participation(channel_id, support)
         return support
 
     def remove(self, channel_id: str) -> None:

@@ -74,6 +74,17 @@ NORMAL_PROPOSALS_RECEIVED = _m.CounterOpts(
     name="normal_proposals_received",
     help="The number of normal (non-config) proposals received by "
          "this node.", label_names=("channel",))
+ACTIVE_NODES = _m.GaugeOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="active_nodes",
+    help="The number of consenters this node has heard from within "
+         "the last few election timeouts (itself included).",
+    label_names=("channel",))
+DATA_PERSIST_DURATION = _m.HistogramOpts(
+    namespace="consensus", subsystem="etcdraft",
+    name="data_persist_duration",
+    help="The time to persist raft log entries and hard state to "
+         "the WAL in seconds.", label_names=("channel",))
 CONFIG_PROPOSALS_RECEIVED = _m.CounterOpts(
     namespace="consensus", subsystem="etcdraft",
     name="config_proposals_received",
@@ -103,6 +114,10 @@ class RaftMetrics:
             NORMAL_PROPOSALS_RECEIVED).with_labels(*lbl)
         self.config_proposals = provider.new_counter(
             CONFIG_PROPOSALS_RECEIVED).with_labels(*lbl)
+        self.active_nodes = provider.new_gauge(
+            ACTIVE_NODES).with_labels(*lbl)
+        self.data_persist_duration = provider.new_histogram(
+            DATA_PERSIST_DURATION).with_labels(*lbl)
 
 
 def endpoint_id(endpoint: str) -> int:
@@ -129,6 +144,28 @@ def parse_consenter_certs(metadata: bytes) -> dict[str, bytes]:
     meta.ParseFromString(metadata)
     return {f"{c.host}:{c.port}": bytes(c.client_tls_cert)
             for c in meta.consenters}
+
+
+class _TimedStorage:
+    """RaftStorage proxy timing the WAL writes (append + hard state)
+    into consensus_etcdraft_data_persist_duration."""
+
+    def __init__(self, inner, observe):
+        self._inner = inner
+        self._observe = observe
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def append(self, entries) -> None:
+        t0 = time.perf_counter()
+        self._inner.append(entries)
+        self._observe(time.perf_counter() - t0)
+
+    def set_hard_state(self, term, voted_for, commit) -> None:
+        t0 = time.perf_counter()
+        self._inner.set_hard_state(term, voted_for, commit)
+        self._observe(time.perf_counter() - t0)
 
 
 class _BlockCreator:
@@ -173,13 +210,21 @@ class RaftChain:
             raise ValueError(f"{self.endpoint} is not a consenter on "
                              f"{support.channel_id}")
 
-        storage = RaftStorage(support.ledger.db_handle("raft"))
+        storage = _TimedStorage(
+            RaftStorage(support.ledger.db_handle("raft")),
+            self.metrics.data_persist_duration.observe)
         self.node = RaftNode(self.node_id,
                              list(self._consenters),
                              storage,
                              election_tick=election_tick,
                              heartbeat_tick=heartbeat_tick)
         self._storage = storage
+        # liveness view for the active_nodes gauge: ids we heard from
+        # recently (updated on inbound raft traffic, decayed on tick)
+        self._peer_seen: dict[int, float] = {}
+        self._active_window_s = (3 * election_tick *
+                                 max(tick_interval_s, 1e-3))
+        self.metrics.active_nodes.set(1)
         self._events: queue.Queue = queue.Queue(maxsize=4096)
         self._halted = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -390,6 +435,7 @@ class RaftChain:
                 now = time.monotonic()
                 for ev in evs:
                     if ev[0] == "step":
+                        self._peer_seen[ev[1].from_] = now
                         self.node.step(ev[1])
                     elif ev[0] == "order":
                         self._process_order(ev[1], ev[2], ev[3])
@@ -399,6 +445,12 @@ class RaftChain:
                 if now >= next_tick:
                     self.node.tick()
                     next_tick = now + self._tick_s
+                    horizon = now - self._active_window_s
+                    self.metrics.active_nodes.set(
+                        1 + sum(1 for nid, ts in
+                                self._peer_seen.items()
+                                if ts >= horizon and
+                                nid in self._consenters))
                 if self._timer_deadline is not None and \
                         now >= self._timer_deadline:
                     self._timer_deadline = None

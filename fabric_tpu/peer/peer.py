@@ -31,6 +31,25 @@ from fabric_tpu.peer.mcs import MSPMessageCryptoService
 logger = logging.getLogger("peer")
 
 
+from fabric_tpu.common import metrics as _pm  # noqa: E402
+
+PVT_COMMIT_BLOCK_DURATION = _pm.HistogramOpts(
+    namespace="gossip", subsystem="privdata",
+    name="commit_block_duration",
+    help="The time the coordinator took to store a block together "
+         "with its private data in seconds.", label_names=("channel",))
+PVT_PULL_DURATION = _pm.HistogramOpts(
+    namespace="gossip", subsystem="privdata", name="pull_duration",
+    help="The time to gather a block's private data from the "
+         "transient store at commit in seconds.",
+    label_names=("channel",))
+PVT_PURGE_DURATION = _pm.HistogramOpts(
+    namespace="gossip", subsystem="privdata", name="purge_duration",
+    help="The time to purge committed transactions' private data "
+         "from the transient store in seconds.",
+    label_names=("channel",))
+
+
 class Channel:
     """Per-channel resources (reference: `core/peer/peer.go` Channel)."""
 
@@ -59,6 +78,14 @@ class Channel:
                                        channel=channel_id))
         self.committer = LedgerCommitter(
             ledger, on_config_block=self._on_config_block)
+        _prov = peer.metrics_provider or _pm.DisabledProvider()
+        self._m_pvt_commit = _prov.new_histogram(
+            PVT_COMMIT_BLOCK_DURATION).with_labels(
+            "channel", channel_id)
+        self._m_pvt_pull = _prov.new_histogram(
+            PVT_PULL_DURATION).with_labels("channel", channel_id)
+        self._m_pvt_purge = _prov.new_histogram(
+            PVT_PURGE_DURATION).with_labels("channel", channel_id)
 
     # -- config --
 
@@ -163,11 +190,18 @@ class Channel:
         final tx codes. Reference: gossip/state deliverPayloads →
         coordinator.StoreBlock (`gossip/privdata/coordinator.go:152`,
         SURVEY §3.4)."""
+        import time as _t
         flags = self.validator.validate(block)
+        t0 = _t.perf_counter()
         pvt_data, committed_txids = self._gather_pvt_data(block, flags)
+        t1 = _t.perf_counter()
         codes = self.committer.commit(block, flags, pvt_data=pvt_data)
+        t2 = _t.perf_counter()
         if committed_txids:
             self._peer.transient_store.purge_by_txids(committed_txids)
+            self._m_pvt_purge.observe(_t.perf_counter() - t2)
+        self._m_pvt_pull.observe(t1 - t0)
+        self._m_pvt_commit.observe(t2 - t0)
         self._notify_commit(block, codes)
         return codes
 

@@ -252,9 +252,20 @@ class TestQ16TableCache:
         tpu.verify_batch(self._items(keys, [0, 0]))      # set {0}
         tpu.verify_batch(self._items(keys, [1, 1]))      # set {1}
         tpu.verify_batch(self._items(keys, [0, 0]))      # hit {0} -> MRU
+        # round-4 adaptive policy: a newcomer may not evict a victim
+        # still inside the hot window — it rides the 8-bit path instead
+        tpu.verify_batch(self._items(keys, [2, 2]))
+        assert tpu.stats["q16_evictions"] == 0
+        assert tpu.stats["q16_adaptive_skips"] == 1
+        assert len(tpu._qflat_cache) == 2
+        # once the LRU victim has gone cold, the eviction happens and
+        # the newcomer builds its table
+        tpu._q16_batch_no += tpu._HOT_WINDOW
+        tpu._q16_denied.clear()
         tpu.verify_batch(self._items(keys, [2, 2]))      # evicts LRU {1}
         assert tpu.stats["q16_evictions"] == 1
         assert len(tpu._qflat_cache) == 2
+        tpu._q16_batch_no += tpu._HOT_WINDOW
         tpu.verify_batch(self._items(keys, [1, 1]))      # {1} rebuilt
         assert tpu.stats["q16_builds"] == 4
 

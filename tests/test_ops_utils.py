@@ -175,7 +175,7 @@ class TestGrpcObservability:
             for _ in range(3):
                 call(gpb.Empty(), timeout=5)
             body = provider.render()
-            assert "grpc_server_requests_completed" in body
+            assert "grpc_server_unary_requests_completed" in body
             assert 'method="Ping"' in body
         finally:
             server.stop()
