@@ -70,21 +70,20 @@ def bench_idemix(prov) -> dict:
     )
 
     n = int(os.environ.get("BENCH_IDEMIX_N", "256"))
-    issuer = IdemixIssuer(prov, scheme="bls")
+    scheme = os.environ.get("BENCH_IDEMIX_SCHEME", "ps")
+    issuer = IdemixIssuer(prov, scheme=scheme)
     msp = IdemixMSP(prov)
-    msp.setup(idemix_msp_config("AnonBLS", issuer))
+    msp.setup(idemix_msp_config("AnonZK", issuer))
     creds = issuer.issue("research", mapi.MSPRole.MEMBER, count=n)
     msp.add_credentials(creds)
-    # all issued credentials as deserialized identities
-    from fabric_tpu.protos import msp as msppb
+    # every issued credential as a freshly-deserialized identity (the
+    # "ps" default carries a zero-knowledge presentation per identity:
+    # host Schnorr + ONE device pairing-product lane each)
     idents = []
-    for _priv, cred in creds:
-        wrapped = msppb.SerializedIdemixIdentity()
-        wrapped.credential.CopyFrom(cred)
-        sid = msppb.SerializedIdentity(
-            mspid="AnonBLS", id_bytes=wrapped.SerializeToString())
-        idents.append(msp.deserialize_identity(
-            sid.SerializeToString()))
+    with msp._lock:
+        signers = list(msp._signers)
+    for s in signers:
+        idents.append(msp.deserialize_identity(s.serialize()))
 
     t0 = t.perf_counter()
     ok = msp.validate_credentials_batch(idents)
@@ -101,7 +100,7 @@ def bench_idemix(prov) -> dict:
     # host baseline: exact integer pairing on a small sample
     from fabric_tpu.bccsp.sw import SWProvider
     sw_msp = IdemixMSP(SWProvider())
-    sw_msp.setup(idemix_msp_config("AnonBLS", issuer))
+    sw_msp.setup(idemix_msp_config("AnonZK", issuer))
     sample = idents[:4]
     t0 = t.perf_counter()
     sample_ok = sw_msp.validate_credentials_batch(sample)
@@ -112,6 +111,7 @@ def bench_idemix(prov) -> dict:
     host_ideal = ncpu / host_per_cred
     return {
         "creds": n,
+        "scheme": scheme,
         "creds_per_s": round(n / steady, 1),
         "warm_s": round(warm_s, 2),
         "steady_s": round(steady, 4),
@@ -120,6 +120,9 @@ def bench_idemix(prov) -> dict:
         "host_ideal_creds_per_s": round(host_ideal, 1),
         "vs_host_ideal": round((n / steady) / host_ideal, 2),
         "surface": "IdemixMSP.validate_credentials_batch -> "
+                   "zero-knowledge PS presentations (host Schnorr + "
+                   "BN254 pairing product on device)" if scheme == "ps"
+                   else "IdemixMSP.validate_credentials_batch -> "
                    "bls_verify_batch (BN254 pairing product on "
                    "device)",
     }

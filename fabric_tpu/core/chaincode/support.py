@@ -73,6 +73,10 @@ class ChaincodeDefinition:
     collections: tuple = ()           # CollectionConfig, ordered
     endorsement_plugin: str = "escc"  # core/handlers registry name
     validation_plugin: str = "vscc"
+    # rich-query indexes shipped with the chaincode, (name, index_json)
+    # pairs (reference: META-INF/statedb/couchdb/indexes JSON files) —
+    # installed into the channel's state DB when the definition commits
+    indexes: tuple = ()
 
     def collection(self, name: str):
         for c in self.collections:

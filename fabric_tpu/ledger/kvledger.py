@@ -209,6 +209,13 @@ class KVLedger:
         """Batched duplicate-txid probe (validator fast path)."""
         return self.block_store.existing_tx_ids(tx_ids)
 
+    def define_index(self, ns: str, name: str,
+                     index_json: str) -> None:
+        """Register + build a rich-query index for a chaincode
+        namespace (reference: CouchDB indexes installed from a
+        chaincode package's META-INF/statedb/couchdb/indexes)."""
+        self.state_db.define_index(ns, name, index_json)
+
     def set_collection_info_source(self, fn) -> None:
         self._collection_info = fn
 

@@ -109,8 +109,16 @@ def execute_query(statedb, ns: str, query: str,
                   ) -> tuple[list[tuple[str, bytes, object]], str]:
     """Run a rich query against `ns`; returns ([(key, raw value,
     version)], next_bookmark). `query` is the CouchDB-style JSON:
-    {"selector": {...}, "sort": [...], "limit": N, "fields": [...]}.
-    Bookmark = last returned key (resume with key > bookmark)."""
+    {"selector": {...}, "sort": [...], "limit": N, "fields": [...],
+    "use_index": ...}.
+
+    Planning: when the namespace has a materialized index whose
+    leading field is constrained by the selector (use_index preferred,
+    reference: statecouchdb use-index planning), candidates come from
+    a BOUNDED index scan in index order and every candidate document
+    is re-verified against the full selector; otherwise the namespace
+    is walked. Bookmarks are opaque: "ix:<hex index key>" on the index
+    plan, the last returned state key on the scan plan."""
     try:
         q = json.loads(query)
     except Exception as e:
@@ -124,7 +132,61 @@ def execute_query(statedb, ns: str, query: str,
     sort_spec = q.get("sort") or []
     fields = q.get("fields") or None
 
+    registry = getattr(statedb, "indexes", None)
+    stats = getattr(statedb, "query_stats", None)
+    plan = None
+    if not (bookmark and not bookmark.startswith("ix:")):
+        plan = plan_query(registry, ns, selector, q.get("use_index"))
+
+    def project(key, vv, doc):
+        if fields:
+            doc = {f: doc[f] for f in fields if f in doc}
+            return key, json.dumps(doc, sort_keys=True).encode(), \
+                vv.version
+        return key, vv.value, vv.version
+
     out = []
+    last_ix_key = None
+    if plan is not None:
+        if stats is not None:
+            stats["index_scans"] += 1
+        name, _field_path, spans = plan
+        resume = bytes.fromhex(bookmark[3:]) if bookmark else None
+        seen: set[str] = set()
+        for enc_lo, enc_hi in spans:
+            for key, ix_key in statedb.index_scan(ns, name, enc_lo,
+                                                  enc_hi):
+                if resume is not None and ix_key <= resume:
+                    continue
+                if key in seen:
+                    continue
+                vv = statedb.get_state(ns, key)
+                if vv is None:
+                    continue
+                try:
+                    doc = json.loads(vv.value)
+                except Exception:
+                    continue
+                if not isinstance(doc, dict) or \
+                        not matches(doc, selector):
+                    continue
+                seen.add(key)
+                out.append(project(key, vv, doc))
+                last_ix_key = ix_key
+                if limit and len(out) >= limit and not sort_spec:
+                    break
+            if limit and len(out) >= limit and not sort_spec:
+                break
+        if sort_spec:
+            _apply_sort(out, sort_spec, limit)
+        next_bookmark = ""
+        if page_size and len(out) == page_size and \
+                last_ix_key is not None and not sort_spec:
+            next_bookmark = "ix:" + last_ix_key.hex()
+        return out, next_bookmark
+
+    if stats is not None:
+        stats["full_scans"] += 1
     start = bookmark + "\x00" if bookmark else ""
     for key, vv in statedb.get_state_range(ns, start, ""):
         try:
@@ -133,40 +195,43 @@ def execute_query(statedb, ns: str, query: str,
             continue  # non-JSON values are invisible to rich queries
         if not isinstance(doc, dict) or not matches(doc, selector):
             continue
-        if fields:
-            doc = {f: doc[f] for f in fields if f in doc}
-            raw = json.dumps(doc, sort_keys=True).encode()
-        else:
-            raw = vv.value
-        out.append((key, raw, vv.version))
+        out.append(project(key, vv, doc))
         if limit and len(out) >= limit and not sort_spec:
             break
 
     if sort_spec:
-        def sort_key(item):
-            doc = json.loads(item[1])
-            keys = []
-            for s in sort_spec:
-                name, direction = (next(iter(s.items()))
-                                   if isinstance(s, dict) else (s, "asc"))
-                _f, v = _field(doc, name)
-                keys.append(v)
-            return keys
-        reverse = bool(sort_spec and isinstance(sort_spec[0], dict)
-                       and next(iter(sort_spec[0].values())) == "desc")
-        out.sort(key=sort_key, reverse=reverse)
-        if limit:
-            out = out[:limit]
+        out = _apply_sort(out, sort_spec, limit)
 
     next_bookmark = out[-1][0] if out and page_size and \
         len(out) == page_size else ""
     return out, next_bookmark
 
 
+def _apply_sort(out: list, sort_spec, limit: int) -> list:
+    def sort_key(item):
+        doc = json.loads(item[1])
+        keys = []
+        for s in sort_spec:
+            name, direction = (next(iter(s.items()))
+                               if isinstance(s, dict) else (s, "asc"))
+            _f, v = _field(doc, name)
+            keys.append(v)
+        return keys
+    reverse = bool(sort_spec and isinstance(sort_spec[0], dict)
+                   and next(iter(sort_spec[0].values())) == "desc")
+    out.sort(key=sort_key, reverse=reverse)
+    if limit:
+        del out[limit:]
+    return out
+
+
 class IndexRegistry:
-    """Index definitions (META-INF/statedb-style). The embedded engine
-    scans — indexes are accepted for API parity and used as query-plan
-    hints only (reference: CouchDB index JSON files per chaincode)."""
+    """Index definitions (META-INF/statedb-style, the reference's
+    CouchDB index JSON files per chaincode). Round 4: indexes are
+    MATERIALIZED into an ordered keyspace maintained at state-commit
+    time (fabric_tpu/ledger/statedb.py), and the query planner below
+    turns a selector constraint on an index's leading field into a
+    bounded index scan instead of a namespace walk."""
 
     def __init__(self):
         self._indexes: dict[tuple[str, str], dict] = {}
@@ -175,7 +240,137 @@ class IndexRegistry:
         idx = json.loads(index_json)
         if "index" not in idx or "fields" not in idx["index"]:
             raise QueryError("index definition lacks index.fields")
+        fields = idx["index"]["fields"]
+        if not isinstance(fields, list) or not fields:
+            raise QueryError("index.fields must be a non-empty list")
         self._indexes[(ns, name)] = idx
 
     def list(self, ns: str) -> list[str]:
         return sorted(n for (s, n) in self._indexes if s == ns)
+
+    def fields(self, ns: str, name: str) -> list[str]:
+        """Field paths of one index, in order (CouchDB field entries
+        may be bare strings or {"field": "asc"} objects)."""
+        idx = self._indexes[(ns, name)]
+        out = []
+        for f in idx["index"]["fields"]:
+            out.append(next(iter(f)) if isinstance(f, dict) else f)
+        return out
+
+    def for_ns(self, ns: str) -> dict[str, list[str]]:
+        """name -> field list for every index on `ns`."""
+        return {n: self.fields(s, n)
+                for (s, n) in self._indexes if s == ns}
+
+
+# ---- orderable value encoding for materialized index entries ----
+#
+# Entries must sort byte-wise in the same order Mango sorts values:
+# null < booleans < numbers < strings. Numbers use the standard
+# order-preserving IEEE-754 transform (flip all bits for negatives,
+# flip the sign bit for positives). 0x00 bytes are escaped so the
+# \x00\x00 segment separator stays unambiguous.
+
+import struct as _struct  # noqa: E402
+
+
+def _escape(b: bytes) -> bytes:
+    return b.replace(b"\x00", b"\x00\xff")
+
+
+def _unescape(b: bytes) -> bytes:
+    return b.replace(b"\x00\xff", b"\x00")
+
+
+def encode_index_value(v) -> bytes:
+    if v is None:
+        return b"\x01"
+    if isinstance(v, bool):
+        return b"\x03" if v else b"\x02"
+    if isinstance(v, (int, float)):
+        bits = _struct.pack(">d", float(v))
+        if bits[0] & 0x80:
+            bits = bytes(x ^ 0xFF for x in bits)
+        else:
+            bits = bytes([bits[0] ^ 0x80]) + bits[1:]
+        return b"\x04" + _escape(bits)
+    if isinstance(v, str):
+        return b"\x05" + _escape(v.encode())
+    # arrays/objects: deterministic but only equality-meaningful
+    return b"\x06" + _escape(
+        json.dumps(v, sort_keys=True).encode())
+
+
+def _leading_field_bounds(selector: dict, field: str):
+    """(low, high) encoded bounds for an index whose leading field is
+    constrained at the TOP level of the selector (inside $and works
+    too); None when the index cannot serve this query.
+
+    Bound composition is SEPARATOR-aware: an index entry for value v
+    continues with the b"\\x00\\x00" segment separator, while an entry
+    for a string EXTENDING v continues with its escaped tail (first
+    bytes b"\\x00\\xff" or >= b"\\x01", both sorting ABOVE the
+    separator). So `enc + \\x00\\x00` is the first key of exactly-v and
+    `enc + \\x00\\x01` is one past it — extensions of v (which are
+    strictly greater values) fall at or above `enc + \\x00\\x01`."""
+    _SEP = b"\x00\x00"
+    _AFTER_EQ = b"\x00\x01"
+    conds = dict(selector)
+    for sub in selector.get("$and", []) or []:
+        if isinstance(sub, dict):
+            conds.update(sub)
+    cond = conds.get(field)
+    if cond is None:
+        return None
+    if not (isinstance(cond, dict) and
+            any(k.startswith("$") for k in cond)):
+        enc = encode_index_value(cond)
+        return [(enc + _SEP, enc + _AFTER_EQ)]
+    if "$eq" in cond:
+        enc = encode_index_value(cond["$eq"])
+        return [(enc + _SEP, enc + _AFTER_EQ)]
+    if "$in" in cond:
+        spans = []
+        for v in sorted(cond["$in"], key=encode_index_value):
+            enc = encode_index_value(v)
+            spans.append((enc + _SEP, enc + _AFTER_EQ))
+        return spans
+    lo, hi = b"", b"\xff"
+    bounded = False
+    if "$gt" in cond:
+        lo = encode_index_value(cond["$gt"]) + _AFTER_EQ
+        bounded = True
+    if "$gte" in cond:
+        lo = encode_index_value(cond["$gte"]) + _SEP
+        bounded = True
+    if "$lt" in cond:
+        hi = encode_index_value(cond["$lt"]) + _SEP
+        bounded = True
+    if "$lte" in cond:
+        hi = encode_index_value(cond["$lte"]) + _AFTER_EQ
+        bounded = True
+    return [(lo, hi)] if bounded else None
+
+
+def plan_query(registry: Optional[IndexRegistry], ns: str,
+               selector: dict, use_index) -> Optional[tuple]:
+    """Pick an index: `use_index` (CouchDB "name" or ["ddoc","name"])
+    wins when usable; otherwise the first index (sorted by name) whose
+    leading field is constrained. Returns (index name, leading field,
+    [(lo, hi) encoded spans]) or None for a namespace scan."""
+    if registry is None:
+        return None
+    candidates = registry.for_ns(ns)
+    if not candidates:
+        return None
+    ordered = sorted(candidates)
+    if use_index:
+        name = use_index[-1] if isinstance(use_index, list) \
+            else use_index
+        if name in candidates:
+            ordered = [name] + [n for n in ordered if n != name]
+    for name in ordered:
+        spans = _leading_field_bounds(selector, candidates[name][0])
+        if spans:
+            return name, candidates[name][0], spans
+    return None

@@ -76,9 +76,148 @@ def _decode(raw: bytes) -> VersionedValue:
     return VersionedValue(raw[20 + mdlen:], version, raw[20:20 + mdlen])
 
 
+_IDX_PREFIX = b"\x00idx\x00"     # system keyspace (leading NUL: no
+#                                  namespace key can start with it)
+_IDX_DEF_PREFIX = b"\x00idxdef\x00"   # persisted index definitions
+_IDX_SEP = b"\x00\x00"
+
+
 class StateDB:
     def __init__(self, db: DBHandle):
         self._db = db
+        # materialized rich-query indexes (reference: statecouchdb's
+        # CouchDB Mango indexes from chaincode META-INF). Entries live
+        # in the SAME keyspace/batch as state writes, and the
+        # DEFINITIONS are persisted alongside, so a restarted node
+        # keeps maintaining (and serving) its indexes.
+        from fabric_tpu.ledger import richquery
+        self.indexes = richquery.IndexRegistry()
+        self.query_stats = {"index_scans": 0, "full_scans": 0}
+        for k, v in self._db.iterate(
+                _IDX_DEF_PREFIX,
+                _IDX_DEF_PREFIX[:-1] + b"\x01"):
+            try:
+                ns_b, name_b = k[len(_IDX_DEF_PREFIX):].split(
+                    _IDX_SEP, 1)
+                self.indexes.define(ns_b.decode(), name_b.decode(),
+                                    v.decode())
+            except Exception:
+                import logging
+                logging.getLogger("statedb").exception(
+                    "unreadable persisted index definition %r", k)
+
+    # -- materialized index plumbing --
+
+    @staticmethod
+    def _idx_key(ns: str, name: str, enc_values: list[bytes],
+                 state_key: str) -> bytes:
+        from fabric_tpu.ledger.richquery import _escape
+        parts = [_escape(ns.encode()), _escape(name.encode())]
+        parts.extend(enc_values)
+        parts.append(_escape(state_key.encode()))
+        return _IDX_PREFIX + _IDX_SEP.join(parts)
+
+    def _idx_entries(self, ns: str, key: str, value: bytes
+                     ) -> list[bytes]:
+        """Index keys a (ns, key, value) document contributes (empty
+        for non-JSON values or docs missing an indexed field)."""
+        import json as _json
+
+        from fabric_tpu.ledger import richquery
+        idxs = self.indexes.for_ns(ns)
+        if not idxs:
+            return []
+        try:
+            doc = _json.loads(value)
+        except Exception:
+            return []
+        if not isinstance(doc, dict):
+            return []
+        out = []
+        for name, fields in idxs.items():
+            enc = []
+            for f in fields:
+                found, v = richquery._field(doc, f)
+                if not found:
+                    break
+                enc.append(richquery.encode_index_value(v))
+            else:
+                out.append(self._idx_key(ns, name, enc, key))
+        return out
+
+    def _maintain_indexes(self, wb, ns: str, key: str,
+                          new_vv: Optional[VersionedValue]) -> None:
+        if not self.indexes.for_ns(ns):
+            return
+        old = self.get_state(ns, key)
+        if old is not None:
+            for ik in self._idx_entries(ns, key, old.value):
+                wb.delete(ik)
+        if new_vv is not None:
+            for ik in self._idx_entries(ns, key, new_vv.value):
+                wb.put(ik, b"")
+
+    def _entries_for_index(self, ns: str, name: str,
+                           fields: list, key: str,
+                           value: bytes) -> list[bytes]:
+        """Index keys one (key, value) contributes to ONE index."""
+        import json as _json
+
+        from fabric_tpu.ledger import richquery
+        try:
+            doc = _json.loads(value)
+        except Exception:
+            return []
+        if not isinstance(doc, dict):
+            return []
+        enc = []
+        for f in fields:
+            found, v = richquery._field(doc, f)
+            if not found:
+                return []
+            enc.append(richquery.encode_index_value(v))
+        return [self._idx_key(ns, name, enc, key)]
+
+    def define_index(self, ns: str, name: str,
+                     index_json: str) -> None:
+        """Register an index, persist its definition, and (re)build it
+        over existing state (reference: installing a chaincode's
+        META-INF index into CouchDB triggers an index build). A
+        re-install first drops the old entries, so stale values never
+        linger."""
+        from fabric_tpu.ledger.richquery import _escape
+        def_key = (_IDX_DEF_PREFIX + _escape(ns.encode()) + _IDX_SEP +
+                   _escape(name.encode()))
+        if self._db.get(def_key) == index_json.encode():
+            self.indexes.define(ns, name, index_json)
+            return                       # already built, same shape
+        self.indexes.define(ns, name, index_json)
+        fields = self.indexes.fields(ns, name)
+        # drop any previous incarnation of this index's entries
+        base = (_IDX_PREFIX + _escape(ns.encode()) + _IDX_SEP +
+                _escape(name.encode()) + _IDX_SEP)
+        wb = self._db.new_batch()
+        for k, _v in self._db.iterate(base, base[:-1] + b"\x01"):
+            wb.delete(k)
+        for key, vv in self.get_state_range(ns, "", ""):
+            for ik in self._entries_for_index(ns, name, fields, key,
+                                              vv.value):
+                wb.put(ik, b"")
+            if len(wb.ops) >= 10000:
+                self._db.write_batch(wb)
+                wb = self._db.new_batch()
+        wb.put(def_key, index_json.encode())
+        self._db.write_batch(wb)
+
+    def index_scan(self, ns: str, name: str, enc_lo: bytes,
+                   enc_hi: bytes):
+        """State keys whose leading indexed value falls in
+        [enc_lo, enc_hi), in index order."""
+        from fabric_tpu.ledger.richquery import _escape, _unescape
+        base = _IDX_PREFIX + _escape(ns.encode()) + _IDX_SEP + \
+            _escape(name.encode()) + _IDX_SEP
+        for k, _v in self._db.iterate(base + enc_lo, base + enc_hi):
+            yield (_unescape(k.split(_IDX_SEP)[-1]).decode(), k)
 
     @staticmethod
     def _k(ns: str, key: str) -> bytes:
@@ -132,9 +271,11 @@ class StateDB:
 
     def apply_updates(self, batch: UpdateBatch, height: Height) -> None:
         """Atomically apply a block's updates + the savepoint
-        (reference: stateleveldb ApplyUpdates)."""
+        (reference: stateleveldb ApplyUpdates). Materialized index
+        entries ride the same batch."""
         wb = self._db.new_batch()
         for (ns, key), vv in batch.updates.items():
+            self._maintain_indexes(wb, ns, key, vv)
             if vv is None:
                 wb.delete(self._k(ns, key))
             else:
@@ -144,9 +285,11 @@ class StateDB:
 
     def iterate_all(self) -> Iterator[tuple[str, str, VersionedValue]]:
         """Every (ns, key, versioned value), ordered — the snapshot
-        export walk (reference: statedb GetFullScanIterator)."""
+        export walk (reference: statedb GetFullScanIterator). Keys
+        with a leading NUL are system keyspaces (savepoint,
+        materialized indexes — derived data, rebuilt not exported)."""
         for k, raw in self._db.iterate(start=b"", end=None):
-            if k == _SAVEPOINT:
+            if k.startswith(b"\x00"):
                 continue
             ns, _, key = k.partition(_SEP)
             yield (ns.decode(), key.decode(), _decode(raw))
@@ -157,6 +300,7 @@ class StateDB:
         not disturb crash-recovery bookkeeping."""
         wb = self._db.new_batch()
         for (ns, key), vv in batch.updates.items():
+            self._maintain_indexes(wb, ns, key, vv)
             if vv is None:
                 wb.delete(self._k(ns, key))
             else:

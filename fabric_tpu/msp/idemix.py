@@ -6,21 +6,22 @@ pseudonyms; a verifier learns ONLY the org (MSP id) and the disclosed
 attributes (OU, role) — two transactions by the same member cannot be
 linked by any channel participant.
 
-Construction (documented divergence): the reference uses BBS+
-credentials over BN254 pairings, where the member re-randomizes one
-long-lived credential per transaction and proves possession in zero
-knowledge. Pairing verification is CPU-heavy and incompatible with this
-framework's batched P-256 verify path. Here the SAME privacy contract
-is met with *pseudonym credentials*: the org's idemix issuer signs
-batches of fresh one-time pseudonym keys (plus the disclosed OU/role —
-never the holder's enrollment identity), and the member signs each
-transaction with a different pseudonym. Verifier-side unlinkability is
-information-theoretic (independent keys); org membership is bound by
-the issuer signature. Trade-offs vs BBS+: the ISSUER can link (the
-reference grants its auditor the same power via the encrypted
-enrollment id), and members must refresh credential batches. In
-exchange every idemix verification is ordinary ECDSA-P256 — it rides
-the TPU batch verify path with zero extra kernels.
+Construction (scheme="ps", the default): a zero-knowledge credential
+layer over BN254 pairings (Pointcheval-Sanders randomizable
+signatures, fabric_tpu/msp/idemix_ps.py) with the SAME architecture as
+the reference's BBS+ — blind issuance (the issuer never learns the
+member secret), per-use re-randomized presentation (a signature of
+knowledge over the pseudonym being authorized), selective disclosure
+of OU/role. Unlinkability holds against EVERY party including the
+issuer. The transaction signature itself stays ECDSA-P256 under a
+fresh pseudonym key certified by the presentation, so tx verification
+rides the TPU batch verify path unchanged, and the credential
+presentations verify with host Schnorr math plus ONE device-batched
+pairing product per credential (`csp.pairing_check_batch`).
+
+Legacy schemes kept for benchmarks/back-compat: "ecdsa" (issuer binds
+pseudonym batches by P-256 — issuer CAN link) and "bls" (pairing-bound
+pseudonym batches — the round-3 construction).
 """
 
 from __future__ import annotations
@@ -39,6 +40,15 @@ from fabric_tpu.msp.mspimpl import MSPError
 from fabric_tpu.protos import msp as msppb, policies as polpb
 
 _CRED_CONTEXT = b"ftpu-idemix-credential-v1|"
+
+
+def _presentation_msg(nym_pub: bytes, ou: str, role: int) -> bytes:
+    """What a PS presentation signs: the pseudonym being authorized
+    plus the disclosed attributes (binding them to the proof). Signed
+    encoding: the proto role is int32, and a hostile negative value
+    must fail verification, not raise."""
+    return (b"ftpu-idemix-nym-v1|" + nym_pub + b"|" + ou.encode() +
+            b"|" + role.to_bytes(4, "big", signed=True))
 
 
 def _credential_digest(nym_pub: bytes, ou: str, role: int) -> bytes:
@@ -61,7 +71,7 @@ class IdemixIssuer:
         config 4).
     """
 
-    def __init__(self, csp, signing_key=None, scheme: str = "ecdsa"):
+    def __init__(self, csp, signing_key=None, scheme: str = "ps"):
         self._csp = csp
         self.scheme = scheme
         self._key = signing_key or ec.generate_private_key(
@@ -70,6 +80,9 @@ class IdemixIssuer:
             from fabric_tpu.ops import bn254_ref as bref
             import os as _os
             self._bls_sk, self._bls_pk = bref.bls_keygen(_os.urandom(32))
+        elif scheme == "ps":
+            from fabric_tpu.msp import idemix_ps as ps
+            self._ps_sk, self._ps_pk = ps.keygen()
 
     def public_key_pem(self) -> bytes:
         return self._key.public_key().public_bytes(
@@ -80,13 +93,48 @@ class IdemixIssuer:
         from fabric_tpu.ops import bn254_ref as bref
         return bref.g2_to_bytes(self._bls_pk)
 
+    def ps_public_key_bytes(self) -> bytes:
+        return self._ps_pk.to_bytes()
+
+    def issue_blind(self, request, ou: str,
+                    role: int = api.MSPRole.MEMBER):
+        """The blind half of PS issuance (member built `request` with
+        `idemix_ps.request_credential` — the issuer sees only a
+        perfectly-hiding commitment to the member secret). Returns
+        (sigma1, blinded sigma2) for the member to unblind."""
+        from fabric_tpu.msp import idemix_ps as ps
+        return ps.blind_sign(self._ps_sk, self._ps_pk, request, ou,
+                             role)
+
     def issue(self, ou: str, role: int = api.MSPRole.MEMBER,
               count: int = 1) -> list[tuple[object,
                                             msppb.IdemixCredential]]:
         """A batch of one-time pseudonym credentials: [(private key,
-        credential)]. The issuer NEVER sees how/when each is used on
-        channel — only that it issued `count` of them."""
+        credential)]. Under "ps" (the default) each credential carries
+        a zero-knowledge presentation authorizing the pseudonym — the
+        convenience form of the blind protocol (issue_blind /
+        idemix_ps.request_credential are the real separated halves;
+        this helper runs both sides in-process for tooling/tests)."""
         out = []
+        if self.scheme == "ps":
+            from fabric_tpu.msp import idemix_ps as ps
+            for _ in range(count):
+                m_sk = ps._rand_scalar()
+                req, blinder = ps.request_credential(self._ps_pk, m_sk)
+                s1, s2b = ps.blind_sign(self._ps_sk, self._ps_pk, req,
+                                        ou, role)
+                sigma = ps.unblind(s1, s2b, blinder)
+                nym_priv = ec.generate_private_key(ec.SECP256R1())
+                nym_pub = nym_priv.public_key().public_bytes(
+                    serialization.Encoding.DER,
+                    serialization.PublicFormat.SubjectPublicKeyInfo)
+                pres = ps.present(
+                    self._ps_pk, sigma, m_sk, ou, role,
+                    _presentation_msg(nym_pub, ou, role))
+                cred = msppb.IdemixCredential(nym_pub=nym_pub, ou=ou,
+                                              role=role)
+                out.append((nym_priv, (cred, pres.to_proto())))
+            return out
         for _ in range(count):
             nym_priv = ec.generate_private_key(ec.SECP256R1())
             nym_pub = nym_priv.public_key().public_bytes(
@@ -115,10 +163,14 @@ class IdemixIssuer:
 
 class IdemixIdentity(api.Identity):
     def __init__(self, msp: "IdemixMSP",
-                 credential: msppb.IdemixCredential, nym_key):
+                 credential: msppb.IdemixCredential, nym_key,
+                 presentation=None):
         self._msp = msp
         self.credential = credential
         self._nym_key = nym_key   # bccsp key (public)
+        # PS scheme: the zero-knowledge presentation authorizing this
+        # pseudonym (msppb.IdemixPresentation)
+        self.presentation = presentation
 
     def id_bytes(self) -> bytes:
         return bytes(self.credential.nym_pub)
@@ -131,6 +183,8 @@ class IdemixIdentity(api.Identity):
         sid.mspid = self.mspid()
         wrapped = msppb.SerializedIdemixIdentity()
         wrapped.credential.CopyFrom(self.credential)
+        if self.presentation is not None:
+            wrapped.presentation.CopyFrom(self.presentation)
         sid.id_bytes = wrapped.SerializeToString()
         return sid.SerializeToString()
 
@@ -160,8 +214,9 @@ class IdemixIdentity(api.Identity):
 class IdemixSigningIdentity(IdemixIdentity, api.SigningIdentity):
     def __init__(self, msp: "IdemixMSP",
                  credential: msppb.IdemixCredential, nym_key,
-                 nym_priv):
-        super().__init__(msp, credential, nym_key)
+                 nym_priv, presentation=None):
+        super().__init__(msp, credential, nym_key,
+                         presentation=presentation)
         self._priv = nym_priv
 
     def sign(self, msg: bytes) -> bytes:
@@ -201,16 +256,26 @@ class IdemixMSP(api.MSP):
             from fabric_tpu.ops import bn254_ref as bref
             self._issuer_bls_pk = bref.g2_from_bytes(
                 bytes(idc.issuer_bls_public_key))
+        self._issuer_ps_pk = None
+        if idc.issuer_ps_public_key:
+            from fabric_tpu.msp import idemix_ps as ps
+            self._issuer_ps_pk = ps.PSPublicKey.from_bytes(
+                bytes(idc.issuer_ps_public_key))
 
     # -- credential intake (member side) --
 
     def add_credentials(self, creds) -> None:
-        """Load issued (nym_priv, credential) pairs for signing."""
+        """Load issued (nym_priv, credential) pairs for signing. PS
+        credentials arrive as (nym_priv, (credential, presentation))."""
         with self._lock:
             for nym_priv, cred in creds:
+                pres = None
+                if isinstance(cred, tuple):
+                    cred, pres = cred
                 nym_key = self._import_nym(bytes(cred.nym_pub))
                 self._signers.append(IdemixSigningIdentity(
-                    self, cred, nym_key, nym_priv))
+                    self, cred, nym_key, nym_priv,
+                    presentation=pres))
 
     def get_default_signing_identity(self) -> IdemixSigningIdentity:
         """Pops a FRESH pseudonym per call — consecutive transactions
@@ -238,10 +303,14 @@ class IdemixMSP(api.MSP):
         wrapped = msppb.SerializedIdemixIdentity()
         wrapped.ParseFromString(sid.id_bytes)
         cred = wrapped.credential
-        if not cred.nym_pub or not (cred.issuer_sig or cred.bls_sig):
+        has_pres = wrapped.HasField("presentation")
+        if not cred.nym_pub or not (cred.issuer_sig or cred.bls_sig
+                                    or has_pres):
             raise MSPError("idemix identity lacks a credential")
         nym_key = self._import_nym(bytes(cred.nym_pub))
-        return IdemixIdentity(self, cred, nym_key)
+        return IdemixIdentity(
+            self, cred, nym_key,
+            presentation=wrapped.presentation if has_pres else None)
 
     def is_well_formed(self, serialized: bytes) -> None:
         self.deserialize_identity(serialized)
@@ -264,8 +333,32 @@ class IdemixMSP(api.MSP):
         out = [False] * len(identities)
         bls_idx, bls_digests, bls_sigs = [], [], []
         ec_idx, ec_items = [], []
+        ps_idx, ps_products = [], []
         for i, ident in enumerate(identities):
             cred = ident.credential
+            if getattr(ident, "presentation", None) is not None:
+                if self._issuer_ps_pk is None:
+                    continue                  # no PS trust anchor
+                from fabric_tpu.msp import idemix_ps as ps
+                try:
+                    pres = ps.Presentation.from_proto(
+                        ident.presentation)
+                    msg = _presentation_msg(bytes(cred.nym_pub),
+                                            cred.ou, cred.role)
+                    # host half: the Schnorr signature of knowledge
+                    if not ps.verify_schnorr(self._issuer_ps_pk, pres,
+                                             cred.ou, cred.role, msg):
+                        continue
+                    lanes = ps.pairing_product(
+                        self._issuer_ps_pk, pres, cred.ou, cred.role)
+                except Exception:
+                    # a hostile presentation must fail ITS lane, never
+                    # poison the whole batch
+                    continue
+                # device half: one pairing-product lane, batched below
+                ps_idx.append(i)
+                ps_products.append(lanes)
+                continue
             digest = _credential_digest(bytes(cred.nym_pub), cred.ou,
                                         cred.role)
             if cred.bls_sig:
@@ -295,6 +388,14 @@ class IdemixMSP(api.MSP):
             res = csp.bls_verify_batch(
                 self._issuer_bls_pk, bls_digests, bls_sigs)
             for i, ok in zip(bls_idx, res):
+                out[i] = ok
+        if ps_idx:
+            csp = self.csp
+            if not hasattr(csp, "pairing_check_batch"):
+                from fabric_tpu.bccsp.sw import SWProvider
+                csp = SWProvider()       # exact host pairing fallback
+            res = csp.pairing_check_batch(ps_products)
+            for i, ok in zip(ps_idx, res):
                 out[i] = ok
         return out
 
@@ -342,5 +443,7 @@ def idemix_msp_config(name: str,
         name=name, issuer_public_key=issuer.public_key_pem())
     if issuer.scheme == "bls":
         idc.issuer_bls_public_key = issuer.bls_public_key_bytes()
+    elif issuer.scheme == "ps":
+        idc.issuer_ps_public_key = issuer.ps_public_key_bytes()
     return msppb.MSPConfig(type=1,
                            config=idc.SerializeToString())

@@ -1,0 +1,315 @@
+"""Pointcheval-Sanders anonymous credentials over BN254: the
+zero-knowledge layer of the idemix MSP.
+
+Round-3 verdict #6: the pseudonym scheme let the ISSUER link a
+member's transactions. This module removes that caveat with the same
+cryptographic architecture the reference uses (`msp/idemix.go`
+wrapping IBM/idemix BBS+ over BN254 — SURVEY §2.2): a randomizable
+pairing-based credential plus a Schnorr signature of knowledge.
+Pointcheval-Sanders (CT-RSA'16) is the modern, simpler construction
+with the same properties BBS+ provides here: blind issuance (the
+issuer never learns the member secret), perfect re-randomization (two
+presentations of one credential share no common values), and selective
+disclosure (OU/role shown, member secret hidden).
+
+Protocol (additive notation; G1/G2 are BN254 groups of prime order R,
+G~ the G2 generator on the twist):
+
+  Issuer keys   sk = (x, y_sk, y_ou, y_role);
+                pk = (X~ = x*G~, Y~_i = y_i*G~) in G2
+                   + Y_sk = y_sk*G in G1 (the blind-issuance base).
+
+  Blind issue   member: secret m_sk, blinder s;
+                  C = m_sk*Y_sk + s*G  (Pedersen, perfectly hiding)
+                  + Schnorr PoK of (m_sk, s) on C.
+                issuer: random u; sigma1 = u*G,
+                  sigma2 = u*(x*G + C + (m_ou*y_ou + m_role*y_role)*G).
+                member unblinds: sigma2 -= s*sigma1 — a PS signature on
+                (m_sk, m_ou, m_role). The issuer saw only C.
+
+  Present       random t, r: sigma1' = t*sigma1,
+                sigma2' = t*(sigma2 + r*sigma1);
+                T~ = m_sk*Y~_sk + r*G~   (perfectly hiding in r)
+                SoK over the presented message (Fiat-Shamir):
+                  K~ = k1*Y~_sk + k2*G~
+                  c  = H(pk | sigma' | T~ | K~ | disclosed | msg)
+                  s1 = k1 + c*m_sk,  s2 = k2 + c*r   (mod R)
+
+  Verify        K~' = s1*Y~_sk + s2*G~ - c*T~ ; recompute c; and the
+                pairing equation
+                  e(sigma1', D~ + T~) == e(sigma2', G~)
+                with D~ = X~ + m_ou*Y~_ou + m_role*Y~_role computed by
+                the VERIFIER from the disclosed attributes. The
+                pairing rides `csp.pairing_check_batch` — one 2-term
+                product lane per credential, device-batched on the TPU
+                provider (BASELINE config 4's surface).
+
+Host math is integer scalar work (this module + ops/bn254_ref); the
+pairing products are the only heavy step and stay on device.
+Differential tests: tests/test_idemix_ps.py (hand-computed vectors,
+tamper corpus, unlinkability property).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass
+
+from fabric_tpu.ops import bn254_ref as b
+
+G1 = b.G1
+G2T = (b.G2_X, b.G2_Y)
+R = b.R
+
+_CTX = b"ftpu-idemix-ps-v1|"
+
+
+def _h_scalar(*parts: bytes) -> int:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(len(p).to_bytes(4, "big"))
+        h.update(p)
+    return int.from_bytes(h.digest(), "big") % R
+
+
+def _rand_scalar() -> int:
+    return (int.from_bytes(os.urandom(48), "big") % (R - 1)) + 1
+
+
+def attr_scalar(value: str | int) -> int:
+    """Disclosed attributes enter the credential as scalars."""
+    if isinstance(value, int):
+        return value % R
+    return _h_scalar(b"attr", value.encode())
+
+
+def _g1b(p) -> bytes:
+    return b.g1_to_bytes(p) if p is not None else b"\x00" * 64
+
+
+def _g2b(q) -> bytes:
+    return b.g2_to_bytes(q) if q is not None else b"\x00" * 128
+
+
+@dataclass
+class PSPublicKey:
+    X_t: tuple          # X~  (G2 twist)
+    Y_sk_t: tuple       # Y~_sk
+    Y_ou_t: tuple       # Y~_ou
+    Y_role_t: tuple     # Y~_role
+    Y_sk_1: tuple       # Y_sk (G1 blind-issuance base)
+
+    def to_bytes(self) -> bytes:
+        return (_g2b(self.X_t) + _g2b(self.Y_sk_t) + _g2b(self.Y_ou_t)
+                + _g2b(self.Y_role_t) + _g1b(self.Y_sk_1))
+
+    @classmethod
+    def from_bytes(cls, raw: bytes) -> "PSPublicKey":
+        if len(raw) != 4 * 128 + 64:
+            raise ValueError("PS public key must be 576 bytes")
+        qs = [b.g2_from_bytes(raw[i * 128:(i + 1) * 128])
+              for i in range(4)]
+        return cls(*qs, b.g1_from_bytes(raw[512:]))
+
+
+@dataclass
+class PSSecretKey:
+    x: int
+    y_sk: int
+    y_ou: int
+    y_role: int
+
+
+def keygen(seed: bytes | None = None) -> tuple[PSSecretKey, PSPublicKey]:
+    if seed is not None:
+        def rnd(tag):
+            return _h_scalar(b"ps-keygen", seed, tag) or 1
+        x, y_sk, y_ou, y_role = (rnd(b"x"), rnd(b"ysk"), rnd(b"you"),
+                                 rnd(b"yrole"))
+    else:
+        x, y_sk, y_ou, y_role = (_rand_scalar() for _ in range(4))
+    sk = PSSecretKey(x, y_sk, y_ou, y_role)
+    pk = PSPublicKey(
+        X_t=b.g2_mul_fast(x, G2T), Y_sk_t=b.g2_mul_fast(y_sk, G2T),
+        Y_ou_t=b.g2_mul_fast(y_ou, G2T), Y_role_t=b.g2_mul_fast(y_role, G2T),
+        Y_sk_1=b.g1_mul_fast(y_sk, G1))
+    return sk, pk
+
+
+# ---- blind issuance ----
+
+@dataclass
+class CredentialRequest:
+    commitment: tuple       # C in G1
+    c: int                  # PoK challenge
+    s_sk: int               # PoK responses
+    s_blind: int
+
+
+def request_credential(pk: PSPublicKey, m_sk: int
+                       ) -> tuple[CredentialRequest, int]:
+    """Member side: Pedersen commitment to the member secret + PoK.
+    Returns (request, blinder) — keep the blinder for unblinding."""
+    s = _rand_scalar()
+    C = b.g1_add_fast(b.g1_mul_fast(m_sk, pk.Y_sk_1), b.g1_mul_fast(s, G1))
+    k1, k2 = _rand_scalar(), _rand_scalar()
+    K = b.g1_add_fast(b.g1_mul_fast(k1, pk.Y_sk_1), b.g1_mul_fast(k2, G1))
+    c = _h_scalar(_CTX + b"req", pk.to_bytes(), _g1b(C), _g1b(K))
+    return CredentialRequest(
+        commitment=C, c=c, s_sk=(k1 + c * m_sk) % R,
+        s_blind=(k2 + c * s) % R), s
+
+
+def verify_request(pk: PSPublicKey, req: CredentialRequest) -> bool:
+    """Issuer side: the requester must KNOW the committed secret (a
+    commitment lifted from another member would not verify)."""
+    lhs = b.g1_add_fast(b.g1_mul_fast(req.s_sk, pk.Y_sk_1),
+                   b.g1_mul_fast(req.s_blind, G1))
+    K = b.g1_add_fast(lhs, b.g1_neg(b.g1_mul_fast(req.c, req.commitment)))
+    c = _h_scalar(_CTX + b"req", pk.to_bytes(), _g1b(req.commitment),
+                  _g1b(K))
+    return c == req.c
+
+
+def blind_sign(sk: PSSecretKey, pk: PSPublicKey,
+               req: CredentialRequest, ou: str, role: int
+               ) -> tuple[tuple, tuple]:
+    """Issuer side: sign the hidden commitment + disclosed attributes.
+    Returns (sigma1, blinded sigma2)."""
+    if not verify_request(pk, req):
+        raise ValueError("credential request proof of knowledge failed")
+    u = _rand_scalar()
+    sigma1 = b.g1_mul_fast(u, G1)
+    m_ou, m_role = attr_scalar(ou), attr_scalar(role)
+    acc = b.g1_mul_fast((sk.x + m_ou * sk.y_ou + m_role * sk.y_role) % R,
+                   G1)
+    acc = b.g1_add_fast(acc, req.commitment)
+    return sigma1, b.g1_mul_fast(u, acc)
+
+
+def unblind(sigma1: tuple, sigma2_blinded: tuple,
+            blinder: int) -> tuple[tuple, tuple]:
+    """Member side: sigma2 = sigma2' - s*sigma1."""
+    return sigma1, b.g1_add_fast(sigma2_blinded,
+                            b.g1_neg(b.g1_mul_fast(blinder, sigma1)))
+
+
+def credential_valid(pk: PSPublicKey, sigma: tuple[tuple, tuple],
+                     m_sk: int, ou: str, role: int) -> bool:
+    """Member-side intake check (host pairing): e(sigma1, X~ +
+    m_sk*Y~_sk + m_ou*Y~_ou + m_role*Y~_role) == e(sigma2, G~)."""
+    sigma1, sigma2 = sigma
+    if sigma1 is None or sigma2 is None:
+        return False
+    q = pk.X_t
+    q = b.g2_add_fast(q, b.g2_mul_fast(m_sk, pk.Y_sk_t))
+    q = b.g2_add_fast(q, b.g2_mul_fast(attr_scalar(ou), pk.Y_ou_t))
+    q = b.g2_add_fast(q, b.g2_mul_fast(attr_scalar(role), pk.Y_role_t))
+    f1 = b.miller_loop(q, sigma1)
+    f2 = b.miller_loop(b.g2_neg_tw(G2T), sigma2)
+    return b.final_exponentiation(b.f12_mul(f1, f2)) == b.F12_ONE
+
+
+# ---- presentation (signature of knowledge) ----
+
+@dataclass
+class Presentation:
+    sigma1: tuple
+    sigma2: tuple
+    T_t: tuple
+    c: int
+    s_sk: int
+    s_r: int
+
+    def to_proto(self):
+        from fabric_tpu.protos import msp as msppb
+        return msppb.IdemixPresentation(
+            sigma1=_g1b(self.sigma1), sigma2=_g1b(self.sigma2),
+            t_commit=_g2b(self.T_t),
+            c=self.c.to_bytes(32, "big"),
+            s_sk=self.s_sk.to_bytes(32, "big"),
+            s_r=self.s_r.to_bytes(32, "big"))
+
+    @classmethod
+    def from_proto(cls, p) -> "Presentation":
+        return cls(
+            sigma1=b.g1_from_bytes(bytes(p.sigma1)),
+            sigma2=b.g1_from_bytes(bytes(p.sigma2)),
+            T_t=b.g2_from_bytes(bytes(p.t_commit)),
+            c=int.from_bytes(bytes(p.c), "big"),
+            s_sk=int.from_bytes(bytes(p.s_sk), "big"),
+            s_r=int.from_bytes(bytes(p.s_r), "big"))
+
+
+def _challenge(pk: PSPublicKey, sigma1, sigma2, T_t, K_t, ou: str,
+               role: int, msg: bytes) -> int:
+    return _h_scalar(
+        _CTX + b"present", pk.to_bytes(), _g1b(sigma1), _g1b(sigma2),
+        _g2b(T_t), _g2b(K_t), ou.encode(),
+        role.to_bytes(4, "big", signed=True), msg)
+
+
+def present(pk: PSPublicKey, sigma: tuple[tuple, tuple], m_sk: int,
+            ou: str, role: int, msg: bytes) -> Presentation:
+    """Prove possession of a credential over the hidden member secret,
+    binding `msg` (the authorized pseudonym key, a tx digest, ...)."""
+    sigma1, sigma2 = sigma
+    t, r = _rand_scalar(), _rand_scalar()
+    s1p = b.g1_mul_fast(t, sigma1)
+    s2p = b.g1_mul_fast(t, b.g1_add_fast(sigma2, b.g1_mul_fast(r, sigma1)))
+    T_t = b.g2_add_fast(b.g2_mul_fast(m_sk, pk.Y_sk_t), b.g2_mul_fast(r, G2T))
+    k1, k2 = _rand_scalar(), _rand_scalar()
+    K_t = b.g2_add_fast(b.g2_mul_fast(k1, pk.Y_sk_t), b.g2_mul_fast(k2, G2T))
+    c = _challenge(pk, s1p, s2p, T_t, K_t, ou, role, msg)
+    return Presentation(sigma1=s1p, sigma2=s2p, T_t=T_t, c=c,
+                        s_sk=(k1 + c * m_sk) % R,
+                        s_r=(k2 + c * r) % R)
+
+
+def verify_schnorr(pk: PSPublicKey, pres: Presentation, ou: str,
+                   role: int, msg: bytes) -> bool:
+    """The host half of verification: the Schnorr signature of
+    knowledge. The pairing half is `pairing_product` below."""
+    if pres.sigma1 is None or pres.sigma1 == (0, 0):
+        return False
+    if not (b.on_curve_g1(pres.sigma1) and b.on_curve_g1(pres.sigma2)
+            and b.on_curve_g2(pres.T_t)):
+        return False
+    if not (0 < pres.c < R and 0 <= pres.s_sk < R
+            and 0 <= pres.s_r < R):
+        return False
+    lhs = b.g2_add_fast(b.g2_mul_fast(pres.s_sk, pk.Y_sk_t),
+                   b.g2_mul_fast(pres.s_r, G2T))
+    K_t = b.g2_add_fast(lhs, b.g2_mul_fast((R - pres.c) % R, pres.T_t))
+    c = _challenge(pk, pres.sigma1, pres.sigma2, pres.T_t, K_t, ou,
+                   role, msg)
+    return c == pres.c
+
+
+def pairing_product(pk: PSPublicKey, pres: Presentation, ou: str,
+                    role: int) -> list[tuple]:
+    """The device half: one 2-term pairing-product lane —
+    e(sigma1', D~ + T~) * e(-sigma2', G~) == 1 — in the
+    `csp.pairing_check_batch` input format."""
+    D_t = b.g2_add_fast(pk.X_t,
+                   b.g2_mul_fast(attr_scalar(ou), pk.Y_ou_t))
+    D_t = b.g2_add_fast(D_t, b.g2_mul_fast(attr_scalar(role), pk.Y_role_t))
+    q = b.g2_add_fast(D_t, pres.T_t)
+    return [(pres.sigma1, q),
+            (b.g1_neg(pres.sigma2), G2T)]
+
+
+def verify_presentation_host(pk: PSPublicKey, pres: Presentation,
+                             ou: str, role: int, msg: bytes) -> bool:
+    """Full host verification (the exact oracle for tests; production
+    batches the pairing half on device)."""
+    if not verify_schnorr(pk, pres, ou, role, msg):
+        return False
+    terms = pairing_product(pk, pres, ou, role)
+    f = b.f12_scalar(1)
+    for p1, q2 in terms:
+        if p1 is None:
+            return False
+        f = b.f12_mul(f, b.miller_loop(q2, p1))
+    return b.final_exponentiation(f) == b.F12_ONE

@@ -418,6 +418,36 @@ def g1_mul(k: int, p):
     return (out[0][0][0][0], out[1][0][0][0])
 
 
+def g1_add(p1, p2):
+    """Affine int G1 addition (through the Fp12 embedding)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    out = ec_add(g1_embed(p1), g1_embed(p2))
+    if out is None:
+        return None
+    return (out[0][0][0][0], out[1][0][0][0])
+
+
+def g1_neg(p):
+    if p is None:
+        return None
+    return (p[0], (-p[1]) % P)
+
+
+def g2_add(q1, q2):
+    """Point addition on the twist (through the untwist)."""
+    if q1 is None:
+        return q2
+    if q2 is None:
+        return q1
+    out = ec_add(untwist(q1), untwist(q2))
+    if out is None:
+        return None
+    return _retwist(out)
+
+
 def bls_keygen(seed: bytes):
     """(sk, pk_twist): pk = sk*G2 on E'(Fp2)."""
     import hashlib as _h
@@ -438,6 +468,138 @@ def bls_verify(pk_tw, msg: bytes, sig) -> bool:
     f1 = miller_loop((G2_X, G2_Y), sig)
     f2 = miller_loop(g2_neg_tw(pk_tw), hash_to_g1(msg))
     return final_exponentiation(f12_mul(f1, f2)) == F12_ONE
+
+
+# ---------------------------------------------------------------------------
+# Fast host group arithmetic (Jacobian, no Fp12 embedding): the PS
+# credential layer (msp/idemix_ps.py) does dozens of scalar muls per
+# presentation — through the embedding each costs ~f12 work; these are
+# plain Fp / Fp2 Jacobian ladders. Differential-tested against the
+# embedded ops (tests/test_idemix_ps.py).
+# ---------------------------------------------------------------------------
+
+def _jac_dbl(X, Y, Z, fadd, fsub, fmul, fzero):
+    if Z == fzero or Y == fzero:
+        return None
+    A = fmul(X, X)
+    B = fmul(Y, Y)
+    C = fmul(B, B)
+    D = fsub(fmul(fadd(X, B), fadd(X, B)), fadd(A, C))
+    D = fadd(D, D)
+    E = fadd(fadd(A, A), A)
+    F = fmul(E, E)
+    X3 = fsub(F, fadd(D, D))
+    C8 = C
+    for _ in range(3):
+        C8 = fadd(C8, C8)
+    Y3 = fsub(fmul(E, fsub(D, X3)), C8)
+    Z3 = fmul(fadd(Y, Y), Z)
+    return X3, Y3, Z3
+
+
+def _fp_ops():
+    fadd = lambda a, c: (a + c) % P
+    fsub = lambda a, c: (a - c) % P
+    fmul = lambda a, c: (a * c) % P
+    return fadd, fsub, fmul, 0
+
+
+def _fp2_ops():
+    return f2_add, f2_sub, f2_mul, (0, 0)
+
+
+def _jac_scalar(k, aff, fadd, fsub, fmul, fzero, fone):
+    """k * affine point, generic Jacobian double-and-add; returns
+    Jacobian or None (infinity)."""
+    k %= R
+    if k == 0 or aff is None:
+        return None
+    acc = None
+    base = (aff[0], aff[1], fone)
+    for bit in bin(k)[2:]:
+        if acc is not None:
+            acc = _jac_dbl(*acc, fadd, fsub, fmul, fzero)
+        if bit == "1":
+            acc = _jac_add_full(acc, base, fadd, fsub, fmul, fzero)
+    return acc
+
+
+def _jac_add_full(P1, P2, fadd, fsub, fmul, fzero):
+    if P1 is None:
+        return P2
+    if P2 is None:
+        return P1
+    X1, Y1, Z1 = P1
+    X2, Y2, Z2 = P2
+    Z1Z1 = fmul(Z1, Z1)
+    Z2Z2 = fmul(Z2, Z2)
+    U1 = fmul(X1, Z2Z2)
+    U2 = fmul(X2, Z1Z1)
+    S1 = fmul(fmul(Y1, Z2), Z2Z2)
+    S2 = fmul(fmul(Y2, Z1), Z1Z1)
+    if U1 == U2:
+        if S1 != S2:
+            return None
+        return _jac_dbl(X1, Y1, Z1, fadd, fsub, fmul, fzero)
+    H = fsub(U2, U1)
+    HH = fmul(H, H)
+    HHH = fmul(H, HH)
+    rr = fsub(S2, S1)
+    V = fmul(U1, HH)
+    X3 = fsub(fsub(fmul(rr, rr), HHH), fadd(V, V))
+    Y3 = fsub(fmul(rr, fsub(V, X3)), fmul(S1, HHH))
+    Z3 = fmul(fmul(Z1, Z2), H)
+    return X3, Y3, Z3
+
+
+def _fp_jac_to_affine(J):
+    if J is None:
+        return None
+    X, Y, Z = J
+    zi = pow(Z, P - 2, P)
+    zi2 = zi * zi % P
+    return (X * zi2 % P, Y * zi2 % P * zi % P)
+
+
+def _fp2_jac_to_affine(J):
+    if J is None:
+        return None
+    X, Y, Z = J
+    zi = f2_inv(Z)
+    zi2 = f2_mul(zi, zi)
+    return (f2_mul(X, zi2), f2_mul(f2_mul(Y, zi2), zi))
+
+
+def g1_mul_fast(k: int, p):
+    fadd, fsub, fmul, z = _fp_ops()
+    return _fp_jac_to_affine(_jac_scalar(k, p, fadd, fsub, fmul, z, 1))
+
+
+def g1_add_fast(p1, p2):
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    fadd, fsub, fmul, z = _fp_ops()
+    return _fp_jac_to_affine(_jac_add_full(
+        (p1[0], p1[1], 1), (p2[0], p2[1], 1), fadd, fsub, fmul, z))
+
+
+def g2_mul_fast(k: int, q):
+    fadd, fsub, fmul, z = _fp2_ops()
+    return _fp2_jac_to_affine(
+        _jac_scalar(k, q, fadd, fsub, fmul, z, (1, 0)))
+
+
+def g2_add_fast(q1, q2):
+    if q1 is None:
+        return q2
+    if q2 is None:
+        return q1
+    fadd, fsub, fmul, z = _fp2_ops()
+    return _fp2_jac_to_affine(_jac_add_full(
+        (q1[0], q1[1], (1, 0)), (q2[0], q2[1], (1, 0)),
+        fadd, fsub, fmul, z))
 
 
 # -- wire encodings (64-byte G1, 128-byte G2 twist, big-endian) --

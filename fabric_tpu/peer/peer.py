@@ -154,6 +154,16 @@ class Channel:
     def define_chaincode(self, definition: ChaincodeDefinition) -> None:
         with self._lock:
             self._definitions[definition.name] = definition
+        # install the chaincode's rich-query indexes (reference:
+        # CouchDB index build on chaincode installation)
+        for name, index_json in getattr(definition, "indexes", ()):
+            try:
+                self.ledger.define_index(definition.name, name,
+                                         index_json)
+            except Exception:
+                logger.exception("[%s] index %s for chaincode %s "
+                                 "failed to build", self.channel_id,
+                                 name, definition.name)
 
     def chaincode_definition(self, name: str
                              ) -> Optional[ChaincodeDefinition]:
