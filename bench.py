@@ -385,10 +385,21 @@ def main():
     batch = BLOCK_TXS * SIGS_PER_TX
 
     # --- the PRODUCT construction path: core.yaml BCCSP mapping ---
+    # WarmKeysDir mirrors peer_node's default-under-fileSystemPath: a
+    # SECOND bench run (or the driver's) prewarms the persisted Q-table
+    # key sets before the first batch — the measured
+    # restart-to-first-validated-block story
+    warm_dir = os.environ.get(
+        "BENCH_WARM_DIR",
+        os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
     prov = factory.new_bccsp(factory.FactoryOpts.from_config({
         "Default": "TPU",
-        "TPU": {"MinBatch": 16, "Chunk": CHUNK},
+        "TPU": {"MinBatch": 16, "Chunk": CHUNK,
+                "WarmKeysDir": warm_dir},
     }))
+    t0 = time.perf_counter()
+    prov.prewarm(buckets=(4096, CHUNK))
+    prewarm_s = time.perf_counter() - t0
 
     # --- workload: NKEYS org keys, `batch` signed messages ---
     privs = [ec.generate_private_key(ec.SECP256R1()) for _ in range(NKEYS)]
@@ -419,6 +430,7 @@ def main():
 
     # --- warm pass THROUGH THE SEAM: compiles the pipeline, builds and
     #     caches the per-key-set Q tables, returns correctness ---
+    prewarmed_sets = len(prov._qflat_cache)
     t0 = time.perf_counter()
     out = prov.verify_batch(items)
     warm_s = time.perf_counter() - t0
@@ -448,24 +460,11 @@ def main():
     from fabric_tpu.ops import comb, limb, sha256
 
     bucket = prov._bucket(batch)       # the shape verify_batch compiled
-    if prov._hash_on_host:
-        # the shipped default: host SHA-256 → 32-byte digest lanes,
-        # device runs pure ECDSA; the block tensor is inert shape
-        # (mirrors _verify_batch_device's fast path)
-        import hashlib
-        blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
-        nblocks = np.zeros(bucket, dtype=np.int32)
-        digests0 = np.zeros((bucket, 8), dtype=np.uint32)
-        for i, m in enumerate(msgs):
-            digests0[i] = np.frombuffer(
-                hashlib.sha256(m).digest(), dtype=">u4")
-        has_digest = np.ones(bucket, dtype=bool)
-    else:
-        nb = prov._nb_bucket(MSG_LEN)
-        blocks, nblocks = sha256.pack_messages(
-            msgs + [b""] * (bucket - batch), nb)
-        digests0 = np.zeros((bucket, 8), dtype=np.uint32)
-        has_digest = np.zeros(bucket, dtype=bool)
+    import hashlib
+    digests0 = np.zeros((bucket, 8), dtype=np.uint32)
+    for i, m in enumerate(msgs):
+        digests0[i] = np.frombuffer(
+            hashlib.sha256(m).digest(), dtype=">u4")
     ok_n, r_b, rpn_b, w_b = native.batch_prep(
         [it.signature for it in items])
     assert ok_n.all()
@@ -473,9 +472,9 @@ def main():
     def padb(a):
         return np.pad(a, [(0, bucket - batch)] + [(0, 0)] * (a.ndim - 1))
 
-    r_l = padb(limb.be_bytes_to_limbs(r_b))
-    rpn_l = padb(limb.be_bytes_to_limbs(rpn_b))
-    w_l = padb(limb.be_bytes_to_limbs(w_b))
+    r8 = padb(r_b)
+    rpn8 = padb(rpn_b)
+    w8 = padb(w_b)
     key_map: dict[bytes, int] = {}
     key_idx = np.zeros(bucket, dtype=np.int32)
     for i, it in enumerate(items):
@@ -490,7 +489,7 @@ def main():
     if q16_path:
         q_flat = prov._qflat_cache[cache_key]    # built by the warm pass
         g16 = comb.g16_tables()
-        fn = prov._comb_fns[(K, True)]
+        fn = prov._comb_fns[("digest", K, True)]
     else:                                        # CPU dry-run path
         qk = np.zeros((K, 64), dtype=np.uint8)
         for i, kb in enumerate(order):
@@ -499,7 +498,7 @@ def main():
             jnp.asarray(limb.be_bytes_to_limbs(qk[:, :32])),
             jnp.asarray(limb.be_bytes_to_limbs(qk[:, 32:])))
         g16 = jnp.zeros((0, 3, limb.L), dtype=jnp.int32)
-        fn = prov._comb_fns[(K, False)]
+        fn = prov._comb_fns[("digest", K, False)]
     premask = np.zeros(bucket, dtype=bool)
     premask[:batch] = True
 
@@ -508,13 +507,12 @@ def main():
     for lo in range(0, bucket, chunk):
         hi = lo + chunk
         staged.append(tuple(jnp.asarray(a) for a in (
-            blocks[lo:hi], nblocks[lo:hi], key_idx[lo:hi],
-            r_l[lo:hi], rpn_l[lo:hi], w_l[lo:hi], premask[lo:hi],
-            digests0[lo:hi], has_digest[lo:hi])))
+            key_idx[lo:hi], r8[lo:hi], rpn8[lo:hi], w8[lo:hi],
+            premask[lo:hi], digests0[lo:hi])))
     jax.block_until_ready(staged)
 
     def run_chunks():
-        outs = [fn(*ch[:3], q_flat, g16, *ch[3:]) for ch in staged]
+        outs = [fn(ch[0], q_flat, g16, *ch[1:]) for ch in staged]
         return np.concatenate([np.asarray(o) for o in outs])
 
     out = run_chunks()                 # cache-hit: same shapes as warm
@@ -609,6 +607,8 @@ def main():
             "cpu_ideal_cores": ncpu,
             "cpu_ideal_sigs_per_s": round(cpu_sigs_per_s, 1),
             "warm_pass_s": round(warm_s, 1),
+            "prewarm_s": round(prewarm_s, 1),
+            "prewarmed_key_sets": prewarmed_sets,
             "sign_s": round(sign_s, 2),
             "provider_stats": dict(prov.stats),
             "pipeline": pipeline,

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import time
 
@@ -147,7 +148,8 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
 
     endorsing = list(peers.values())[:endorsements]
 
-    print("pipeline: network up; endorsing", flush=True)
+    print("pipeline: network up; endorsing", flush=True,
+          file=sys.stderr)
     # ---- endorse everything first (CPU signing work, untimed) ----
     t0 = time.perf_counter()
     envs = [gw.endorse(channel, "bench",
@@ -157,7 +159,7 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     endorse_s = time.perf_counter() - t0
 
     print(f"pipeline: endorsed {ntxs} in {endorse_s:.1f}s; ordering",
-          flush=True)
+          flush=True, file=sys.stderr)
     # ---- order through raft into one block ----
     # submission goes through the batched windowed ingest — the same
     # path the BroadcastStream gRPC handler drives (one sig-filter
@@ -205,7 +207,8 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     data_blocks = [b for b in blocks if b.data.data]
     nsigs = ntxs * (endorsements + 1)
 
-    print(f"pipeline: ordered in {order_s:.1f}s; validating", flush=True)
+    print(f"pipeline: ordered in {order_s:.1f}s; validating", flush=True,
+          file=sys.stderr)
     # ---- peer-side pipeline: validate (repeatable) + commit (once) ----
     out: dict = {
         "ntxs": ntxs, "endorsements_per_tx": endorsements,

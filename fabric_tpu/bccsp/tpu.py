@@ -79,6 +79,11 @@ class TPUProvider(api.BCCSP):
         self._warm_keys_dir = warm_keys_dir
         self._qflat_cache: dict = {}     # key-set tuple -> q16 table (LRU)
         self._qflat_cache_bytes = 0
+        # 8-bit Q tables are small (~0.5 MB/key) but cost a device
+        # round trip to rebuild; a peer/orderer sees the same key set
+        # every batch, so cache a handful (LRU)
+        self._q8_cache: dict = {}
+        self._Q8_CACHE_MAX = 16
         # adaptive anti-thrash state: when the working set of key sets
         # exceeds the byte budget, pin the resident tables and serve
         # the overflow sets on the 8-bit path instead of rebuilding
@@ -261,8 +266,11 @@ class TPUProvider(api.BCCSP):
                 max_len = max(max_len, len(it.message))
 
         msgs += [b""] * (bucket - n)
-        if self._hash_on_host and max_len > 0:
-            # default path: host SHA-256 → 32-byte digest lanes
+        if self._hash_on_host:
+            # default path: host SHA-256 → 32-byte digest lanes (runs
+            # for EVERY pending lane, including empty messages — an
+            # empty message still hashes to SHA-256(b""), never to a
+            # zero digest)
             hashed = 0
             for i in range(n):
                 if premask[i] and not has_digest[i]:
@@ -273,9 +281,24 @@ class TPUProvider(api.BCCSP):
                     hashed += 1
             self.stats["host_hashed_lanes"] += hashed
             max_len = 0
-            # every lane is a digest (or dead) lane: the SHA stage is
-            # select-injected away, so the block tensor is just shape —
-            # build the zeros directly instead of packing 32k empties
+        if max_len == 0 and bool(np.all(has_digest[:n] |
+                                        ~premask[:n])):
+            # every lane is a digest (or dead) lane: dispatch the
+            # transfer-minimal digest pipeline — compact u8 scalars,
+            # on-device limb conversion, no SHA stage at all
+            if 0 < len(key_map) <= self._max_keys:
+                self.stats["comb_batches"] += 1
+                out = self._dispatch_comb_digest(
+                    bucket, key_map, key_idx, r_b, rpn_b, w_b,
+                    premask, digests)
+                result = out[:n].tolist()
+                if sw_lanes:
+                    self.stats["nonp256_sw_lanes"] += len(sw_lanes)
+                    sub = self._sw.verify_batch(
+                        [items[i] for i in sw_lanes])
+                    for i, v in zip(sw_lanes, sub):
+                        result[i] = v
+                return result
             blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
             nblocks = np.zeros(bucket, dtype=np.int32)
             r_l = limb.be_bytes_to_limbs(r_b)
@@ -487,19 +510,28 @@ class TPUProvider(api.BCCSP):
 
         dg = np.zeros((bucket, 8), dtype=np.uint32)
         dg[:n] = np.ascontiguousarray(digests).view(">u4").reshape(n, 8)
-        blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
-        nblocks = np.zeros(bucket, dtype=np.int32)
-        has_digest = np.ones(bucket, dtype=bool)
 
-        def pad32(a):
+        def pad8(a):
             out = np.zeros((bucket, 32), dtype=np.uint8)
             out[:n] = a
-            return limb.be_bytes_to_limbs(out)
+            return out
 
-        thunk = self._dispatch_arrays(
-            bucket, key_map, lane_slot, blocks, nblocks, pad32(r),
-            pad32(rpn), pad32(w), premask, dg, has_digest, qx_b, qy_b,
-            async_out=True)
+        if 0 < len(key_map) <= self._max_keys:
+            # transfer-minimal digest pipeline (the common case)
+            self.stats["comb_batches"] += 1
+            thunk = self._dispatch_comb_digest(
+                bucket, key_map, lane_slot, pad8(r), pad8(rpn),
+                pad8(w), premask, dg, async_out=True)
+        else:
+            blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
+            nblocks = np.zeros(bucket, dtype=np.int32)
+            has_digest = np.ones(bucket, dtype=bool)
+            thunk = self._dispatch_arrays(
+                bucket, key_map, lane_slot, blocks, nblocks,
+                limb.be_bytes_to_limbs(pad8(r)),
+                limb.be_bytes_to_limbs(pad8(rpn)),
+                limb.be_bytes_to_limbs(pad8(w)), premask, dg,
+                has_digest, qx_b, qy_b, async_out=True)
 
         def resolve() -> list[bool]:
             result = thunk()[:n].tolist()
@@ -675,12 +707,12 @@ class TPUProvider(api.BCCSP):
                         "set(s)", warmed)
         return warmed
 
-    def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
-                       r_l, rpn_l, w_l, premask, digests, has_digest,
-                       async_out=False):
-        """Comb-method path: per-key tables built once, then the batch is
-        dispatched in chunks so host staging of chunk k+1 overlaps device
-        execution of chunk k (jax dispatch is async)."""
+    def _resolve_tables(self, key_map, key_idx):
+        """Canonical slot order + per-key-set tables (q16 when cached/
+        buildable under budget, else the 8-bit LRU cache). Returns
+        (key_idx remapped, K, q_flat, g16, q16?). Under a mesh the
+        table arrays come back replicated (stored back, so repeat
+        dispatches short-circuit the device_put)."""
         import jax.numpy as jnp
 
         from fabric_tpu.ops import limb
@@ -694,6 +726,17 @@ class TPUProvider(api.BCCSP):
             qk[i] = np.frombuffer(kb, dtype=np.uint8)
         qx_k = limb.be_bytes_to_limbs(qk[:, :32])
         qy_k = limb.be_bytes_to_limbs(qk[:, 32:])
+
+        def q8_cached():
+            q8 = self._q8_cache.pop(tuple(order), None)
+            if q8 is None:
+                q8 = self._qtab_fn(K)(jnp.asarray(qx_k),
+                                      jnp.asarray(qy_k))
+            self._q8_cache[tuple(order)] = q8    # (re-)insert as MRU
+            while len(self._q8_cache) > self._Q8_CACHE_MAX:
+                self._q8_cache.pop(next(iter(self._q8_cache)))
+            return q8
+
         q16 = False
         if self._g16_enabled():
             from fabric_tpu.ops import comb
@@ -702,20 +745,12 @@ class TPUProvider(api.BCCSP):
             if q_flat is not None:
                 q16 = True
             else:
-                q_flat = self._qtab_fn(K)(jnp.asarray(qx_k),
-                                          jnp.asarray(qy_k))
+                q_flat = q8_cached()
         else:
-            q_flat = self._qtab_fn(K)(jnp.asarray(qx_k),
-                                      jnp.asarray(qy_k))
-            g16 = jnp.zeros((0, 3, r_l.shape[-1]), dtype=jnp.int32)
+            q_flat = q8_cached()
+            g16 = jnp.zeros((0, 3, limb.L), dtype=jnp.int32)
 
         if self._mesh is not None:
-            # replicate the tables onto the mesh ONCE: the replicated
-            # arrays are stored back (q16 cache / provider attribute)
-            # so later dispatches pass already-placed arrays and the
-            # device_put short-circuits instead of re-broadcasting
-            # gigabytes per block. Chunk slices stay divisible by the
-            # mesh size for shard_map.
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self._mesh, P())
@@ -730,10 +765,52 @@ class TPUProvider(api.BCCSP):
                 g16 = cached
             else:
                 g16 = jax.device_put(g16, rep)
+        return key_idx, K, q_flat, g16, q16
+
+    def _mesh_chunk(self, bucket: int) -> int:
+        """Chunk size; under a mesh, slices stay divisible by the mesh
+        size for shard_map."""
         chunk = min(bucket, self._chunk)
         if self._mesh is not None:
             m = self._mesh.size
             chunk = max(m, (chunk // m) * m)
+        return chunk
+
+    def _dispatch_comb_digest(self, bucket, key_map, key_idx, r8, rpn8,
+                              w8, premask, digests, async_out=False):
+        """Digest-lane comb dispatch: compact u8 scalar operands, limb
+        conversion ON DEVICE, no SHA stage (_comb_pipeline_digest) —
+        the transfer-minimal shape for the host-hash default and the
+        prepared-block fast path."""
+        import jax.numpy as jnp
+
+        key_idx, K, q_flat, g16, q16 = self._resolve_tables(key_map,
+                                                            key_idx)
+        chunk = self._mesh_chunk(bucket)
+        fn = self._comb_pipeline_digest(K, q16)
+        outs = []
+        for lo in range(0, bucket, chunk):
+            hi = lo + chunk
+            outs.append(fn(
+                jnp.asarray(key_idx[lo:hi]), q_flat, g16,
+                jnp.asarray(r8[lo:hi]), jnp.asarray(rpn8[lo:hi]),
+                jnp.asarray(w8[lo:hi]), jnp.asarray(premask[lo:hi]),
+                jnp.asarray(digests[lo:hi])))
+        thunk = lambda: np.concatenate(  # noqa: E731
+            [np.asarray(o) for o in outs])
+        return thunk if async_out else thunk()
+
+    def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
+                       r_l, rpn_l, w_l, premask, digests, has_digest,
+                       async_out=False):
+        """Comb-method path: per-key tables built once, then the batch is
+        dispatched in chunks so host staging of chunk k+1 overlaps device
+        execution of chunk k (jax dispatch is async)."""
+        import jax.numpy as jnp
+
+        key_idx, K, q_flat, g16, q16 = self._resolve_tables(key_map,
+                                                            key_idx)
+        chunk = self._mesh_chunk(bucket)
         fn = self._comb_pipeline(K, q16)
         outs = []
         for lo in range(0, bucket, chunk):
@@ -781,7 +858,10 @@ class TPUProvider(api.BCCSP):
             from fabric_tpu.ops import comb, sha256
 
             use_g16 = self._g16_enabled()
-            tree = self._tree_impl()
+            # the Pallas VMEM tree is tuned for the 32-point (16-bit
+            # window) tree; the 64-point 8-bit tree hits unimplemented
+            # Mosaic lowerings — q8 dispatches keep the XLA tree
+            tree = self._tree_impl() if q16 else "xla"
 
             def fused(blocks, nblocks, key_idx, q_flat, g16, r, rpn, w,
                       premask, digests, has_digest):
@@ -809,6 +889,46 @@ class TPUProvider(api.BCCSP):
             else:
                 self._comb_fns[key] = jax.jit(fused)
         return self._comb_fns[key]
+
+    def _comb_pipeline_digest(self, K: int, q16: bool):
+        """Digest-lane-only comb pipeline: no SHA stage, no block
+        tensors, and the scalar operands arrive as 32-byte big-endian
+        u8 rows converted to limbs ON DEVICE — the transfer-minimal
+        shape the host-hash default and the prepared-block fast path
+        dispatch (32+96 B/lane instead of ~346 B/lane; the difference
+        is the wall clock on tunnel/NIC-attached accelerators)."""
+        key = ("digest", K, q16)
+        with self._jit_lock:
+            if key not in self._comb_fns:
+                import jax
+
+                from fabric_tpu.ops import comb, limb
+
+                use_g16 = self._g16_enabled()
+                tree = self._tree_impl() if q16 else "xla"
+
+                def fused(key_idx, q_flat, g16, r8, rpn8, w8, premask,
+                          digests):
+                    r = limb.be_bytes_to_limbs_jnp(r8)
+                    rpn = limb.be_bytes_to_limbs_jnp(rpn8)
+                    w = limb.be_bytes_to_limbs_jnp(w8)
+                    return comb.comb_verify_with_tables(
+                        digests, key_idx, q_flat, r, rpn, w, premask,
+                        g16=g16 if use_g16 else None, q16=q16,
+                        tree=tree)
+
+                if self._mesh is not None:
+                    from jax import shard_map
+                    from jax.sharding import PartitionSpec as P
+                    s = P("batch")
+                    rep = P()
+                    self._comb_fns[key] = jax.jit(shard_map(
+                        fused, mesh=self._mesh,
+                        in_specs=(s, rep, rep, s, s, s, s, s),
+                        out_specs=s, check_vma=False))
+                else:
+                    self._comb_fns[key] = jax.jit(fused)
+            return self._comb_fns[key]
 
     def _pipeline(self):
         if self._fn is None:
@@ -860,25 +980,40 @@ class TPUProvider(api.BCCSP):
             for K in key_counts:
                 ent = (comb.NWIN_G16 * comb.NENT_G16 if q16
                        else comb.NWIN * comb.NENT)
-                # nb values must match production shapes exactly:
-                # _nb_bucket only produces powers of two (1 = digest
-                # lanes / tiny msgs; 8 covers the typical proposal
-                # payload sizes) — a mismatched nb would precompile a
-                # module no real batch ever uses
+                sd = jax.ShapeDtypeStruct
+                import numpy as _np
+                g16_sd = (sd((comb.NWIN_G16 * comb.NENT_G16, 3, 20),
+                          _np.int32) if q16 else
+                          sd((0, 3, 20), _np.int32))
                 for bucket in buckets:
                     chunk = min(bucket, self._chunk)
+                    # the digest pipeline is the production hot path
+                    # (host-hash default AND the prepared-block fast
+                    # path): compact u8 scalars, no SHA stage
+                    dfn = self._comb_pipeline_digest(K, q16)
+                    dargs = (
+                        sd((chunk,), _np.int32),          # key_idx
+                        sd((ent * K, 3, 20), _np.int32),  # q_flat
+                        g16_sd,                           # g16
+                        sd((chunk, 32), _np.uint8),       # r
+                        sd((chunk, 32), _np.uint8),       # rpn
+                        sd((chunk, 32), _np.uint8),       # w
+                        sd((chunk,), bool),               # premask
+                        sd((chunk, 8), _np.uint32),       # digests
+                    )
+                    dfn.lower(*dargs).compile()
+                    logger.info("prewarmed digest comb pipeline K=%d "
+                                "chunk=%d q16=%s", K, chunk, q16)
+                    if self._hash_on_host:
+                        continue      # fused-SHA pipeline not used
                     fn = self._comb_pipeline(K, q16)
-                    sd = jax.ShapeDtypeStruct
-                    import numpy as _np
                     for nb in msg_nbs:
                         args = (
                             sd((chunk, nb, 16), _np.uint32),  # blocks
                             sd((chunk,), _np.int32),          # nblocks
                             sd((chunk,), _np.int32),          # key_idx
                             sd((ent * K, 3, 20), _np.int32),  # q_flat
-                            (sd((comb.NWIN_G16 * comb.NENT_G16, 3, 20),
-                                _np.int32) if q16 else
-                             sd((0, 3, 20), _np.int32)),      # g16
+                            g16_sd,                           # g16
                             sd((chunk, 20), _np.int32),       # r
                             sd((chunk, 20), _np.int32),       # rpn
                             sd((chunk, 20), _np.int32),       # w

@@ -151,13 +151,16 @@ def execute_query(statedb, ns: str, query: str,
         if stats is not None:
             stats["index_scans"] += 1
         name, _field_path, spans = plan
-        resume = bytes.fromhex(bookmark[3:]) if bookmark else None
+        resume = None
+        if bookmark:
+            try:
+                resume = bytes.fromhex(bookmark[3:])
+            except ValueError:
+                raise QueryError(f"invalid bookmark {bookmark!r}")
         seen: set[str] = set()
         for enc_lo, enc_hi in spans:
-            for key, ix_key in statedb.index_scan(ns, name, enc_lo,
-                                                  enc_hi):
-                if resume is not None and ix_key <= resume:
-                    continue
+            for key, ix_key in statedb.index_scan(
+                    ns, name, enc_lo, enc_hi, start_after=resume):
                 if key in seen:
                     continue
                 vv = statedb.get_state(ns, key)
@@ -288,6 +291,8 @@ def encode_index_value(v) -> bytes:
     if isinstance(v, bool):
         return b"\x03" if v else b"\x02"
     if isinstance(v, (int, float)):
+        if v == 0:
+            v = 0.0          # +0.0 / -0.0 / 0 must encode identically
         bits = _struct.pack(">d", float(v))
         if bits[0] & 0x80:
             bits = bytes(x ^ 0xFF for x in bits)
@@ -337,14 +342,19 @@ def _leading_field_bounds(selector: dict, field: str):
         return spans
     lo, hi = b"", b"\xff"
     bounded = False
+    # range bounds are INCLUSIVE at the encoding level even for the
+    # strict operators: number encodings round through float64, so a
+    # value just past the bound can share the bound's encoding — the
+    # exact semantics come from re-verifying every candidate with
+    # matches(); the inclusive span only costs a few extra candidates
     if "$gt" in cond:
-        lo = encode_index_value(cond["$gt"]) + _AFTER_EQ
+        lo = encode_index_value(cond["$gt"]) + _SEP
         bounded = True
     if "$gte" in cond:
         lo = encode_index_value(cond["$gte"]) + _SEP
         bounded = True
     if "$lt" in cond:
-        hi = encode_index_value(cond["$lt"]) + _SEP
+        hi = encode_index_value(cond["$lt"]) + _AFTER_EQ
         bounded = True
     if "$lte" in cond:
         hi = encode_index_value(cond["$lte"]) + _AFTER_EQ

@@ -117,44 +117,29 @@ class StateDB:
         parts.append(_escape(state_key.encode()))
         return _IDX_PREFIX + _IDX_SEP.join(parts)
 
-    def _idx_entries(self, ns: str, key: str, value: bytes
-                     ) -> list[bytes]:
+    def _idx_entries(self, ns: str, key: str, value: bytes,
+                     idxs: dict = None) -> list[bytes]:
         """Index keys a (ns, key, value) document contributes (empty
         for non-JSON values or docs missing an indexed field)."""
-        import json as _json
-
-        from fabric_tpu.ledger import richquery
-        idxs = self.indexes.for_ns(ns)
-        if not idxs:
-            return []
-        try:
-            doc = _json.loads(value)
-        except Exception:
-            return []
-        if not isinstance(doc, dict):
-            return []
+        if idxs is None:
+            idxs = self.indexes.for_ns(ns)
         out = []
         for name, fields in idxs.items():
-            enc = []
-            for f in fields:
-                found, v = richquery._field(doc, f)
-                if not found:
-                    break
-                enc.append(richquery.encode_index_value(v))
-            else:
-                out.append(self._idx_key(ns, name, enc, key))
+            out.extend(self._entries_for_index(ns, name, fields, key,
+                                               value))
         return out
 
     def _maintain_indexes(self, wb, ns: str, key: str,
                           new_vv: Optional[VersionedValue]) -> None:
-        if not self.indexes.for_ns(ns):
+        idxs = self.indexes.for_ns(ns)
+        if not idxs:
             return
         old = self.get_state(ns, key)
         if old is not None:
-            for ik in self._idx_entries(ns, key, old.value):
+            for ik in self._idx_entries(ns, key, old.value, idxs):
                 wb.delete(ik)
         if new_vv is not None:
-            for ik in self._idx_entries(ns, key, new_vv.value):
+            for ik in self._idx_entries(ns, key, new_vv.value, idxs):
                 wb.put(ik, b"")
 
     def _entries_for_index(self, ns: str, name: str,
@@ -210,13 +195,21 @@ class StateDB:
         self._db.write_batch(wb)
 
     def index_scan(self, ns: str, name: str, enc_lo: bytes,
-                   enc_hi: bytes):
+                   enc_hi: bytes, start_after: bytes = None):
         """State keys whose leading indexed value falls in
-        [enc_lo, enc_hi), in index order."""
+        [enc_lo, enc_hi), in index order. `start_after` (an index key
+        from a previous page's bookmark) SEEKS the scan — pagination
+        is O(page), not O(scanned-so-far)."""
         from fabric_tpu.ledger.richquery import _escape, _unescape
         base = _IDX_PREFIX + _escape(ns.encode()) + _IDX_SEP + \
             _escape(name.encode()) + _IDX_SEP
-        for k, _v in self._db.iterate(base + enc_lo, base + enc_hi):
+        lo = base + enc_lo
+        hi = base + enc_hi
+        if start_after is not None:
+            if start_after >= hi:
+                return
+            lo = max(lo, start_after + b"\x00")
+        for k, _v in self._db.iterate(lo, hi):
             yield (_unescape(k.split(_IDX_SEP)[-1]).decode(), k)
 
     @staticmethod

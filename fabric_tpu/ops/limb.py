@@ -82,6 +82,28 @@ def be_bytes_to_limbs(raw: np.ndarray) -> np.ndarray:
     return (bits.reshape(B, L, W) * weights).sum(axis=2, dtype=np.int32)
 
 
+def be_bytes_to_limbs_jnp(raw):
+    """Device-side (B, 32) uint8 big-endian -> (B, L) limbs.
+
+    Same output as `be_bytes_to_limbs`, expressed in jnp so the
+    conversion runs ON DEVICE: the host then ships 32 B/scalar instead
+    of 80 B of int32 limbs — the difference matters on tunnel/NIC
+    attached accelerators where the verify path is transfer-bound.
+    """
+    raw = raw.astype(jnp.int32)             # (B, 32), big-endian bytes
+    B = raw.shape[0]
+    # value bit k (little-endian) = byte (31 - k//8), bit (k % 8)
+    k = jnp.arange(L * W)                   # 260 bits; top 4 are zero
+    byte_idx = 31 - (k // 8)
+    bit_idx = k % 8
+    valid = k < 256
+    bytes_k = jnp.where(valid, raw[:, jnp.clip(byte_idx, 0, 31)], 0)
+    bits = (bytes_k >> bit_idx) & 1         # (B, L*W)
+    weights = (1 << jnp.arange(W, dtype=jnp.int32))
+    return (bits.reshape(B, L, W) * weights).sum(
+        axis=2, dtype=jnp.int32)
+
+
 # ---------------------------------------------------------------------------
 # Carry propagation
 # ---------------------------------------------------------------------------

@@ -191,6 +191,14 @@ class TestQ16TableCache:
             def __init__(self, n):
                 self.size = n
 
+        def fake_pipeline_digest(K, q16=False):
+            def run(key_idx, q_flat, g16, r8, rpn8, w8, premask,
+                    digests):
+                calls["pipeline_key_idx"].append(
+                    np.asarray(key_idx).copy())
+                return np.asarray(premask)
+            return run
+
         def fake_pipeline(K, q16=False):
             def run(blocks, nblocks, key_idx, q_flat, g16, r, rpn, w,
                     premask, digests, has_digest):
@@ -201,6 +209,8 @@ class TestQ16TableCache:
         monkeypatch.setattr(tpu, "_qtab_fn", fake_qtab_fn)
         monkeypatch.setattr(tpu, "_q16_fn", fake_q16_fn)
         monkeypatch.setattr(tpu, "_comb_pipeline", fake_pipeline)
+        monkeypatch.setattr(tpu, "_comb_pipeline_digest",
+                            fake_pipeline_digest)
         return tpu, calls
 
     @staticmethod
