@@ -457,7 +457,7 @@ def main():
     #     Staging mirrors _verify_batch_device; objects are the
     #     provider's, looked up from its caches. ---
     from fabric_tpu import native
-    from fabric_tpu.ops import comb, limb, sha256
+    from fabric_tpu.ops import comb, limb
 
     bucket = prov._bucket(batch)       # the shape verify_batch compiled
     import hashlib

@@ -100,16 +100,17 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     orderer_msp = local_msp(
         os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
         "OrdererMSP")
-    # the orderer's own BCCSP is also TPU-backed so the batched
-    # broadcast sig-filter (msgprocessor.process_normal_msgs) rides the
-    # device; UseG16 off — the filter sees few distinct keys and the
-    # 8-bit comb path wins without the multi-minute 16-bit table build
-    from fabric_tpu.bccsp import factory as _bf
-    orderer_csp = _bf.new_bccsp(_bf.FactoryOpts.from_config(
-        {"Default": "TPU", "TPU": {"MinBatch": 64, "UseG16": False}}))
+    # The orderer keeps the sw provider: the ordering win is the
+    # WINDOWED ingest (one sig-filter verify_batch + one consenter
+    # enqueue per 512-envelope window — process_normal_msgs), which
+    # orders >3k tx/s on one core either way. A TPU-backed filter
+    # (BCCSP Default: TPU, UseG16: False) also works but pays a
+    # per-process pipeline warm (~1 min) that would sit inside this
+    # section's timer for a ~2x steady filter gain the tunnel latency
+    # mostly swallows; measured in tools/ profiling, documented here.
     registrar = Registrar(
         os.path.join(root, "orderer"),
-        orderer_msp.get_default_signing_identity(), orderer_csp,
+        orderer_msp.get_default_signing_identity(), sw_csp,
         {"etcdraft": raft_mod.consenter(transport,
                                         tick_interval_s=0.03,
                                         election_tick=8)})

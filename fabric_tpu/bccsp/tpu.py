@@ -79,9 +79,11 @@ class TPUProvider(api.BCCSP):
         self._warm_keys_dir = warm_keys_dir
         self._qflat_cache: dict = {}     # key-set tuple -> q16 table (LRU)
         self._qflat_cache_bytes = 0
-        # 8-bit Q tables are small (~0.5 MB/key) but cost a device
-        # round trip to rebuild; a peer/orderer sees the same key set
-        # every batch, so cache a handful (LRU)
+        # 8-bit Q tables (~1.9 MB per key slot) cost a device round
+        # trip to rebuild; a peer/orderer sees the same key set every
+        # batch, so cache a handful (entry-count LRU — worst case
+        # 16 sets x MaxKeys is ~500 MB, well under the q16 budget the
+        # TableCacheMB knob governs)
         self._q8_cache: dict = {}
         self._Q8_CACHE_MAX = 16
         # adaptive anti-thrash state: when the working set of key sets
@@ -757,6 +759,10 @@ class TPUProvider(api.BCCSP):
             q_flat = jax.device_put(q_flat, rep)
             if q16 and tuple(order) in self._qflat_cache:
                 self._qflat_cache[tuple(order)] = q_flat
+            elif not q16 and tuple(order) in self._q8_cache:
+                # keep the REPLICATED copy so repeat dispatches
+                # short-circuit the broadcast
+                self._q8_cache[tuple(order)] = q_flat
             if getattr(g16, "size", 0):
                 cached = getattr(self, "_g16_rep", None)
                 if cached is None:

@@ -76,6 +76,17 @@ def _decode(raw: bytes) -> VersionedValue:
     return VersionedValue(raw[20 + mdlen:], version, raw[20:20 + mdlen])
 
 
+def _parse_doc(value: bytes):
+    """JSON document or None (non-JSON / non-object values carry no
+    index entries)."""
+    import json as _json
+    try:
+        doc = _json.loads(value)
+    except Exception:
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
 _IDX_PREFIX = b"\x00idx\x00"     # system keyspace (leading NUL: no
 #                                  namespace key can start with it)
 _IDX_DEF_PREFIX = b"\x00idxdef\x00"   # persisted index definitions
@@ -120,13 +131,17 @@ class StateDB:
     def _idx_entries(self, ns: str, key: str, value: bytes,
                      idxs: dict = None) -> list[bytes]:
         """Index keys a (ns, key, value) document contributes (empty
-        for non-JSON values or docs missing an indexed field)."""
+        for non-JSON values or docs missing an indexed field). The
+        document parses ONCE regardless of index count."""
         if idxs is None:
             idxs = self.indexes.for_ns(ns)
+        doc = _parse_doc(value)
+        if doc is None:
+            return []
         out = []
         for name, fields in idxs.items():
             out.extend(self._entries_for_index(ns, name, fields, key,
-                                               value))
+                                               value, doc=doc))
         return out
 
     def _maintain_indexes(self, wb, ns: str, key: str,
@@ -144,16 +159,12 @@ class StateDB:
 
     def _entries_for_index(self, ns: str, name: str,
                            fields: list, key: str,
-                           value: bytes) -> list[bytes]:
+                           value: bytes, doc=None) -> list[bytes]:
         """Index keys one (key, value) contributes to ONE index."""
-        import json as _json
-
         from fabric_tpu.ledger import richquery
-        try:
-            doc = _json.loads(value)
-        except Exception:
-            return []
-        if not isinstance(doc, dict):
+        if doc is None:
+            doc = _parse_doc(value)
+        if doc is None:
             return []
         enc = []
         for f in fields:
