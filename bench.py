@@ -367,7 +367,206 @@ def bench_crossover(prov) -> dict:
     return out
 
 
+BENCH_KEYS_PEM = "bench_keys.pem"
+
+
+def _apply_platform():
+    """Honor an explicit JAX_PLATFORMS: the axon TPU plugin registers
+    through sitecustomize and overrides the env var at interpreter
+    start; jax.config wins as long as it runs before backend init.
+    No-op when unset (the driver's real-TPU runs)."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+
+def _load_bench_privs(warm_dir):
+    """Bench-only org signing keys persisted beside the warm tables so
+    a later process (the restart child, the next driver run) measures
+    against the SAME key set the tables were built for."""
+    from cryptography.hazmat.primitives import serialization
+    path = os.path.join(warm_dir, BENCH_KEYS_PEM)
+    try:
+        blob = open(path, "rb").read()
+    except FileNotFoundError:
+        return None
+    privs = []
+    for chunk in blob.split(b"-----END PRIVATE KEY-----")[:-1]:
+        privs.append(serialization.load_pem_private_key(
+            chunk + b"-----END PRIVATE KEY-----", None))
+    return privs or None
+
+
+def _save_bench_privs(warm_dir, privs):
+    from cryptography.hazmat.primitives import serialization
+    os.makedirs(warm_dir, exist_ok=True)
+    path = os.path.join(warm_dir, BENCH_KEYS_PEM)
+    blob = b"".join(
+        p.private_bytes(serialization.Encoding.PEM,
+                        serialization.PrivateFormat.PKCS8,
+                        serialization.NoEncryption())
+        for p in privs)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def _signed_batch(prov, privs, n, rng):
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    from fabric_tpu.bccsp import VerifyItem, utils as butils
+    from fabric_tpu.bccsp.bccsp import ECDSAPublicKeyImportOpts
+    keys = [prov.key_import(p.public_key(), ECDSAPublicKeyImportOpts())
+            for p in privs]
+    items = []
+    for i in range(n):
+        m = rng.bytes(MSG_LEN)
+        der = privs[i % len(privs)].sign(m, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        items.append(VerifyItem(
+            key=keys[i % len(keys)],
+            signature=butils.marshal_signature(r, butils.to_low_s(s)),
+            message=m))
+    return items
+
+
+def _restart_child(mode, warm_dir):
+    """Child-process half of the restart benchmark (one process = one
+    TPU owner; the parent spawns these BEFORE initializing jax).
+
+    populate: build + persist the Q tables for a fresh bench key set.
+    restart:  the measured story — construct the provider from config,
+              prewarm from persisted bytes, validate one CHUNK-sig
+              batch; report seconds from construction to validated."""
+    out = {"mode": mode}
+    _apply_platform()
+    from cryptography.hazmat.primitives.asymmetric import ec
+
+    from fabric_tpu.bccsp import factory
+    from fabric_tpu.common import jaxenv
+
+    jaxenv.enable_compilation_cache()
+    rng = np.random.default_rng(4321)
+
+    if mode == "populate":
+        privs = [ec.generate_private_key(ec.SECP256R1())
+                 for _ in range(NKEYS)]
+        _save_bench_privs(warm_dir, privs)
+        prov = factory.new_bccsp(factory.FactoryOpts.from_config({
+            "Default": "TPU",
+            "TPU": {"MinBatch": 16, "Chunk": CHUNK,
+                    "WarmKeysDir": warm_dir}}))
+        prov.prewarm(buckets=(CHUNK,))
+        items = _signed_batch(prov, privs, 4096, rng)
+        t0 = time.perf_counter()
+        ok = prov.verify_batch(items)
+        out["cold_first_batch_s"] = round(time.perf_counter() - t0, 2)
+        out["ok"] = bool(all(ok))
+        prov.flush_warm_tables()
+        out["q16_builds"] = prov.stats["q16_builds"]
+    else:
+        privs = _load_bench_privs(warm_dir)
+        if privs is None:
+            out["error"] = "no persisted bench keys"
+            print(json.dumps(out))
+            return
+        # workload generation (signing) is not restart cost: presign
+        # before the clock starts
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            decode_dss_signature,
+        )
+
+        from fabric_tpu.bccsp import VerifyItem, utils as butils
+        from fabric_tpu.bccsp.bccsp import ECDSAPublicKeyImportOpts
+        pre = []
+        for i in range(CHUNK):
+            m = rng.bytes(MSG_LEN)
+            der = privs[i % len(privs)].sign(
+                m, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
+            pre.append((m, butils.marshal_signature(
+                r, butils.to_low_s(s))))
+        t0 = time.perf_counter()
+        prov = factory.new_bccsp(factory.FactoryOpts.from_config({
+            "Default": "TPU",
+            "TPU": {"MinBatch": 16, "Chunk": CHUNK,
+                    "WarmKeysDir": warm_dir}}))
+        t_ctor = time.perf_counter()
+        prov.prewarm(buckets=(CHUNK,))
+        t_pw = time.perf_counter()
+        keys = [prov.key_import(p.public_key(),
+                                ECDSAPublicKeyImportOpts())
+                for p in privs]
+        items = [VerifyItem(key=keys[i % len(keys)], signature=sig,
+                            message=m)
+                 for i, (m, sig) in enumerate(pre)]
+        ok = prov.verify_batch(items)
+        t1 = time.perf_counter()
+        out.update({
+            "ok": bool(all(ok)),
+            "restart_to_first_validated_s": round(t1 - t0, 2),
+            "ctor_s": round(t_ctor - t0, 2),
+            "prewarm_s": round(t_pw - t_ctor, 2),
+            "first_batch_s": round(t1 - t_pw, 2),
+            "batch": CHUNK,
+            "q16_disk_loads": prov.stats["q16_disk_loads"],
+            "q16_builds": prov.stats["q16_builds"],
+        })
+    print(json.dumps(out))
+
+
+def bench_restart(warm_dir) -> dict:
+    """Parent half: spawn populate (only when the warm dir has no
+    bench key set yet) then the measured restart child. Runs BEFORE
+    the parent touches jax — on TPU rigs the chip is single-owner."""
+    import subprocess
+    import sys
+    res = {}
+    have = (os.path.exists(os.path.join(warm_dir, BENCH_KEYS_PEM))
+            and os.path.exists(os.path.join(warm_dir,
+                                            "warm_keysets.json")))
+    try:
+        if not have:
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--restart-child", "populate", warm_dir],
+                capture_output=True, text=True, timeout=1800)
+            if p.returncode != 0:
+                return {"error": "populate child failed",
+                        "stderr": p.stderr[-800:]}
+            res["populate"] = json.loads(p.stdout.strip().
+                                         splitlines()[-1])
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--restart-child", "restart", warm_dir],
+            capture_output=True, text=True, timeout=1800)
+        if p.returncode != 0:
+            return {"error": "restart child failed",
+                    "stderr": p.stderr[-800:]}
+        res.update(json.loads(p.stdout.strip().splitlines()[-1]))
+    except Exception as e:          # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"}
+    return res
+
+
 def main():
+    # --- restart-to-first-validated-block: measured in CHILD
+    #     processes before this one claims the device ---
+    warm_dir = os.environ.get(
+        "BENCH_WARM_DIR",
+        os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
+    restart = None
+    if os.environ.get("BENCH_RESTART", "1") == "1":
+        restart = bench_restart(warm_dir)
+
+    _apply_platform()
     import jax
     import jax.numpy as jnp
     from cryptography.hazmat.primitives import hashes
@@ -385,13 +584,9 @@ def main():
     batch = BLOCK_TXS * SIGS_PER_TX
 
     # --- the PRODUCT construction path: core.yaml BCCSP mapping ---
-    # WarmKeysDir mirrors peer_node's default-under-fileSystemPath: a
-    # SECOND bench run (or the driver's) prewarms the persisted Q-table
-    # key sets before the first batch — the measured
-    # restart-to-first-validated-block story
-    warm_dir = os.environ.get(
-        "BENCH_WARM_DIR",
-        os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
+    # WarmKeysDir mirrors peer_node's default-under-fileSystemPath:
+    # the restart children (and previous driver runs) persisted this
+    # key set's Q-table bytes, so prewarm restores instead of rebuilds
     prov = factory.new_bccsp(factory.FactoryOpts.from_config({
         "Default": "TPU",
         "TPU": {"MinBatch": 16, "Chunk": CHUNK,
@@ -401,8 +596,19 @@ def main():
     prov.prewarm(buckets=(4096, CHUNK))
     prewarm_s = time.perf_counter() - t0
 
-    # --- workload: NKEYS org keys, `batch` signed messages ---
-    privs = [ec.generate_private_key(ec.SECP256R1()) for _ in range(NKEYS)]
+    # --- workload: NKEYS org keys, `batch` signed messages. Reuse
+    # the persisted bench key set when present: the restart children
+    # (or a previous run) already built and persisted its Q tables,
+    # so this run's warm pass restores them instead of paying the
+    # multi-minute build ---
+    privs = _load_bench_privs(warm_dir)
+    if privs is None or len(privs) != NKEYS:
+        privs = [ec.generate_private_key(ec.SECP256R1())
+                 for _ in range(NKEYS)]
+        try:
+            _save_bench_privs(warm_dir, privs)
+        except Exception:           # noqa: BLE001
+            pass                     # read-only cache dir: still runs
     keys = [prov.key_import(p.public_key(), ECDSAPublicKeyImportOpts())
             for p in privs]
     msgs = [rng.bytes(MSG_LEN) for _ in range(batch)]
@@ -430,7 +636,7 @@ def main():
 
     # --- warm pass THROUGH THE SEAM: compiles the pipeline, builds and
     #     caches the per-key-set Q tables, returns correctness ---
-    prewarmed_sets = len(prov._qflat_cache)
+    prewarmed_sets = prov.stats["q16_resident_sets"]
     t0 = time.perf_counter()
     out = prov.verify_batch(items)
     warm_s = time.perf_counter() - t0
@@ -439,7 +645,6 @@ def main():
     if prov.stats["comb_batches"] < 1:
         raise SystemExit("bench did not exercise the comb path: %s"
                          % prov.stats)
-    q16_path = prov.stats["q16_builds"] >= 1
 
     # --- provider wall-clock steady (host prep + transfer + device) ---
     times = []
@@ -457,7 +662,6 @@ def main():
     #     Staging mirrors _verify_batch_device; objects are the
     #     provider's, looked up from its caches. ---
     from fabric_tpu import native
-    from fabric_tpu.ops import comb, limb
 
     bucket = prov._bucket(batch)       # the shape verify_batch compiled
     import hashlib
@@ -481,24 +685,13 @@ def main():
         pub = it.key.public_key()
         kb = pub.x_bytes().tobytes() + pub.y_bytes().tobytes()
         key_idx[i] = key_map.setdefault(kb, len(key_map))
-    order, key_idx = type(prov)._canonical_key_order(key_map, key_idx)
-    K = 1
-    while K < len(order):
-        K *= 2
-    cache_key = tuple(order)
-    if q16_path:
-        q_flat = prov._qflat_cache[cache_key]    # built by the warm pass
-        g16 = comb.g16_tables()
-        fn = prov._comb_fns[("digest", K, True)]
-    else:                                        # CPU dry-run path
-        qk = np.zeros((K, 64), dtype=np.uint8)
-        for i, kb in enumerate(order):
-            qk[i] = np.frombuffer(kb, dtype=np.uint8)
-        q_flat = prov._qtab_fn(K)(
-            jnp.asarray(limb.be_bytes_to_limbs(qk[:, :32])),
-            jnp.asarray(limb.be_bytes_to_limbs(qk[:, 32:])))
-        g16 = jnp.zeros((0, 3, limb.L), dtype=jnp.int32)
-        fn = prov._comb_fns[("digest", K, False)]
+    # the provider's SUPPORTED measurement surface: its own compiled
+    # digest pipeline + resident tables. Degrades to the 8-bit path
+    # exactly as verify_batch would (BENCH_r04 died here peeking at
+    # _qflat_cache when the cache policy denied the live key set)
+    fn, key_idx, tabs = prov.prepared_digest_pipeline(key_map, key_idx)
+    q_flat, g16, q16_path, K = (tabs["q_flat"], tabs["g16"],
+                                tabs["q16"], tabs["K"])
     premask = np.zeros(bucket, dtype=bool)
     premask[:batch] = True
 
@@ -611,6 +804,7 @@ def main():
             "prewarmed_key_sets": prewarmed_sets,
             "sign_s": round(sign_s, 2),
             "provider_stats": dict(prov.stats),
+            "restart": restart,
             "pipeline": pipeline,
             "idemix": idemix,
             "blocksig": blocksig,
@@ -623,4 +817,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys
+    if len(sys.argv) > 3 and sys.argv[1] == "--restart-child":
+        _restart_child(sys.argv[2], sys.argv[3])
+    else:
+        main()

@@ -53,9 +53,26 @@ NENT = 1 << WBITS           # table entries per window
 def g_tables() -> np.ndarray:
     """(NWIN * NENT, 3, L) int32 — projective T_G[i*NENT + j] = j*2^(8i)*G.
 
-    Entry j=0 is the point at infinity (0 : 1 : 0). Built once per
-    process over Python ints (exact), cached.
-    """
+    Entry j=0 is the point at infinity (0 : 1 : 0). Built once over
+    Python ints (exact), lru-cached in process and persisted to
+    $FABRIC_TPU_GTAB_CACHE (default ~/.cache/fabric_tpu/gtab8.npy,
+    empty string disables) — the 8k host bigint point ops are a
+    measurable slice of restart-to-first-validated-block, and G is a
+    universal constant."""
+    import os
+    cache = os.environ.get(
+        "FABRIC_TPU_GTAB_CACHE",
+        os.path.expanduser("~/.cache/fabric_tpu/gtab8.npy"))
+    if cache:
+        try:
+            arr = np.load(cache)
+            if (arr.dtype == np.int32
+                    and arr.shape == (NWIN * NENT, 3, L)):
+                return arr
+        except FileNotFoundError:
+            pass
+        except Exception:
+            pass                          # unreadable: rebuild below
     out = np.zeros((NWIN * NENT, 3, L), dtype=np.int32)
     base = (p256.GX, p256.GY, 1)
     for i in range(NWIN):
@@ -66,6 +83,15 @@ def g_tables() -> np.ndarray:
             acc = p256.cadd_int(acc, base)
         for _ in range(WBITS):
             base = p256.cdbl_int(base)
+    if cache:
+        try:
+            os.makedirs(os.path.dirname(cache), exist_ok=True)
+            tmp = cache + f".tmp{os.getpid()}"
+            with open(tmp, "wb") as f:
+                np.save(f, out)
+            os.replace(tmp, cache)
+        except Exception:
+            pass                          # best-effort persistence
     return out
 
 

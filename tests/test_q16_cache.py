@@ -80,6 +80,222 @@ def test_cold_tables_still_evict(monkeypatch):
     assert prov.stats["q16_cache_bytes"] == EST
 
 
+def test_prewarm_poisoning_fresh_set_reaches_q16(monkeypatch, tmp_path):
+    """BENCH_r04 repro: a restarted provider prewarms PERSISTED key
+    sets that the live workload never asks for again (org key
+    rotation; the bench's fresh random keys). Round-4 policy marked
+    them hot, pinning the whole byte budget and denying the live
+    working set the flagship path for 256 batches — the KeyError that
+    killed the round's numbers. Prewarmed tables must stay cold until
+    a live batch claims them."""
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    # process 1: three live key sets fill the budget and persist
+    p1 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    for i in range(3):
+        assert p1._q16_cached(_key(i), 1, _QX, _QX) is not None
+    p1.flush_warm_tables()
+    # process 2 (restart after key rotation): prewarm restores all
+    # three persisted sets, then a FRESH working set arrives
+    p2 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    assert p2._prewarm_tables() == 3
+    out = p2._q16_cached(_key(7), 1, _QX, _QX)
+    assert out is not None               # fresh set gets the q16 path
+    assert p2.stats["q16_evictions"] == 1
+    assert p2.stats["q16_adaptive_skips"] == 0
+    # the evicted stale set left the warm file; the live set was
+    # recorded — the NEXT restart warms the actual working set
+    persisted = p2._load_warm_keys()
+    assert [k.hex() for k in _key(7)] in persisted
+    assert len(persisted) == 3           # one stale dropped, one added
+
+
+def test_prewarmed_set_claimed_by_live_use_is_protected(monkeypatch,
+                                                        tmp_path):
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    p1 = TPUProvider(use_g16=True, table_cache_bytes=EST,
+                     warm_keys_dir=warm)
+    assert p1._q16_cached(_key(0), 1, _QX, _QX) is not None
+    p1.flush_warm_tables()
+    p2 = TPUProvider(use_g16=True, table_cache_bytes=EST,
+                     warm_keys_dir=warm)
+    assert p2._prewarm_tables() == 1
+    # a live batch claims the prewarmed table: zero rebuild cost...
+    assert p2._q16_cached(_key(0), 1, _QX, _QX) is not None
+    assert p2.stats["q16_builds"] == 0          # restored from bytes
+    # ...and the claimed table is now hot: a newcomer is denied
+    assert p2._q16_cached(_key(5), 1, _QX, _QX) is None
+    assert p2.stats["q16_adaptive_skips"] == 1
+    assert p2.stats["q16_evictions"] == 0
+
+
+def test_denied_set_reearns_q16_when_residents_cool(monkeypatch):
+    """A denial must not be a fixed 256-lookup sentence: once the
+    residents cool off, a still-requesting set re-earns the path."""
+    builds = []
+    _stub(monkeypatch, builds)
+    prov = TPUProvider(use_g16=True, table_cache_bytes=EST)
+    assert prov._q16_cached(_key(0), 1, _QX, _QX) is not None
+    assert prov._q16_cached(_key(1), 1, _QX, _QX) is None   # denied
+    # set 1 keeps asking while set 0 goes idle; it must get the table
+    # well before the 256-lookup deny TTL expires
+    got_at = None
+    for n in range(2, 64):
+        if prov._q16_cached(_key(1), 1, _QX, _QX) is not None:
+            got_at = n
+            break
+    assert got_at is not None and got_at < 40
+    assert prov.stats["q16_evictions"] == 1
+
+
+def test_table_bytes_persist_and_preload(monkeypatch, tmp_path):
+    """Restart fast path: the built table's BYTES are persisted
+    (tmp+rename, background thread) and the next process's prewarm
+    restores them with ZERO device builds — restart-to-first-block
+    is a disk read + H2D copy, not a multi-minute rebuild."""
+    import os
+
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    p1 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    t = p1._q16_cached(_key(1), 1, _QX, _QX)
+    assert t is not None
+    p1.flush_warm_tables()
+    path = p1._table_path(_key(1))
+    assert os.path.exists(path)
+
+    p2 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    assert p2._prewarm_tables() == 1
+    assert p2.stats["q16_disk_loads"] == 1
+    assert p2.stats["q16_builds"] == 0           # no device rebuild
+    # live request is a cache hit
+    assert p2._q16_cached(_key(1), 1, _QX, _QX) is not None
+    assert p2.stats["q16_builds"] == 0
+
+    # corrupt/truncated bytes fall back to the device rebuild
+    with open(path, "wb") as f:
+        f.write(b"\x93NUMPY junk")
+    p3 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    assert p3._prewarm_tables() == 1
+    assert p3.stats["q16_disk_loads"] == 0
+    assert p3.stats["q16_builds"] == 1
+
+
+def test_stale_table_bytes_removed_with_warm_set(monkeypatch, tmp_path):
+    import os
+
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    p1 = TPUProvider(use_g16=True, table_cache_bytes=EST,
+                     warm_keys_dir=warm)
+    assert p1._q16_cached(_key(1), 1, _QX, _QX) is not None
+    p1.flush_warm_tables()
+    path = p1._table_path(_key(1))
+    assert os.path.exists(path)
+    # restart + rotation: prewarmed set displaced by the live set →
+    # its persisted bytes are reclaimed along with the warm entry
+    p2 = TPUProvider(use_g16=True, table_cache_bytes=EST,
+                     warm_keys_dir=warm)
+    assert p2._prewarm_tables() == 1
+    assert p2._q16_cached(_key(2), 1, _QX, _QX) is not None
+    assert not os.path.exists(path)
+    assert [k.hex() for k in _key(1)] not in p2._load_warm_keys()
+
+
+def test_prewarm_stops_at_budget_without_deleting_disk(monkeypatch,
+                                                       tmp_path):
+    """More persisted sets than the budget fits: prewarm restores the
+    MRU sets that fit and leaves the rest ON DISK — it must not churn
+    its own restores or misclassify over-budget sets as stale and
+    delete their bytes (code-review finding)."""
+    import os
+
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    p1 = TPUProvider(use_g16=True, table_cache_bytes=5 * EST,
+                     warm_keys_dir=warm)
+    for i in range(5):
+        assert p1._q16_cached(_key(i), 1, _QX, _QX) is not None
+    p1.flush_warm_tables()
+    assert len(p1._load_warm_keys()) == 5
+
+    p2 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    assert p2._prewarm_tables() == 3        # MRU sets 4, 3, 2
+    assert p2.stats["q16_evictions"] == 0   # no churn
+    # nothing was deleted: all five sets remain restorable
+    assert len(p2._load_warm_keys()) == 5
+    for i in range(5):
+        assert os.path.exists(p2._table_path(_key(i)))
+    # the MRU sets are the resident ones
+    assert _key(4) in p2._qflat_cache and _key(2) in p2._qflat_cache
+    assert _key(0) not in p2._qflat_cache
+
+
+def test_live_miss_streams_from_disk_not_rebuild(monkeypatch,
+                                                 tmp_path):
+    """A set evicted from RAM but persisted on disk re-enters via the
+    disk bytes, not a device rebuild (code-review finding)."""
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    prov = TPUProvider(use_g16=True, table_cache_bytes=EST,
+                       warm_keys_dir=warm)
+    assert prov._q16_cached(_key(1), 1, _QX, _QX) is not None
+    prov.flush_warm_tables()
+    assert prov.stats["q16_builds"] == 1
+    # age set 1 out, then let set 2 evict it (set 2 has no disk bytes
+    # yet -> device build)
+    for n in range(20):
+        prov._q16_batch_no += 1
+    assert prov._q16_cached(_key(2), 1, _QX, _QX) is not None
+    prov.flush_warm_tables()
+    assert prov.stats["q16_builds"] == 2
+    assert prov.stats["q16_evictions"] == 1
+    # set 1 returns: disk load, NOT a third build
+    for n in range(20):
+        prov._q16_batch_no += 1
+    assert prov._q16_cached(_key(1), 1, _QX, _QX) is not None
+    assert prov.stats["q16_builds"] == 2
+    assert prov.stats["q16_disk_loads"] == 1
+
+
+def test_mru_trim_reclaims_displaced_table_bytes(monkeypatch,
+                                                 tmp_path):
+    """Key sets pushed off the warm file's MRU cap must take their
+    persisted table bytes with them (code-review finding: unbounded
+    disk growth on long-lived nodes)."""
+    import os
+
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    monkeypatch.setattr(TPUProvider, "_WARM_MAX_SETS", 3)
+    prov = TPUProvider(use_g16=True, table_cache_bytes=100 * EST,
+                       warm_keys_dir=warm)
+    for i in range(5):
+        assert prov._q16_cached(_key(i), 1, _QX, _QX) is not None
+    prov.flush_warm_tables()
+    sets = prov._load_warm_keys()
+    assert len(sets) == 3                   # MRU cap
+    assert [k.hex() for k in _key(4)] in sets
+    # displaced sets' bytes are gone; retained sets' bytes remain
+    assert not os.path.exists(prov._table_path(_key(0)))
+    assert not os.path.exists(prov._table_path(_key(1)))
+    assert os.path.exists(prov._table_path(_key(4)))
+
+
 def test_oversize_set_never_builds(monkeypatch):
     builds = []
     _stub(monkeypatch, builds)
