@@ -151,6 +151,29 @@ class BlockStore:
         self._index_block(block, self._cur_suffix, offset,
                           self._f.tell())
 
+    def _block_tx_ids(self, block: common.Block) -> list:
+        """Per-envelope tx_id, "" where absent/unparseable. One native
+        wire-format pass (native/blockprep.cpp ftpu_txid_scan) with a
+        per-envelope Python fallback — the full protobuf unmarshal of
+        10k envelopes was the measured commit floor at production
+        block sizes (round-4 profiling)."""
+        from fabric_tpu import native
+        envs = list(block.data.data)
+        scanned = native.txid_scan(envs)
+        if scanned is None:
+            scanned = [None] * len(envs)
+        out = []
+        for env_bytes, tid in zip(envs, scanned):
+            if tid is None:
+                try:
+                    env = pu.unmarshal_envelope(env_bytes)
+                    tid = pu.get_channel_header(
+                        pu.get_payload(env)).tx_id
+                except Exception:
+                    tid = ""
+            out.append(tid)
+        return out
+
     def _index_block(self, block: common.Block, suffix: int,
                      offset: int, end_offset: int) -> None:
         batch = self._index.new_batch()
@@ -160,24 +183,29 @@ class BlockStore:
                   struct.pack(">Q", block.header.number))
         filt = block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER]
+        tx_ids = self._block_tx_ids(block)
+        # first occurrence wins (reference blkstorage keeps the
+        # original tx's entry; a later DUPLICATE_TXID replay must not
+        # clobber the VALID tx's recorded validation code). The
+        # already-committed probe is ONE batched index read, not a
+        # point get per tx.
         seen_txids: set[bytes] = set()
-        for i, env_bytes in enumerate(block.data.data):
-            try:
-                env = pu.unmarshal_envelope(env_bytes)
-                ch = pu.get_channel_header(pu.get_payload(env))
-            except Exception:
+        cand: list[tuple[int, bytes]] = []
+        for i, tid in enumerate(tx_ids):
+            if not tid:
                 continue
-            if not ch.tx_id:
+            tkey = b"t" + tid.encode()
+            if tkey in seen_txids:
+                continue
+            seen_txids.add(tkey)
+            cand.append((i, tkey))
+        committed = self._index.get_many([k for _, k in cand]) \
+            if cand else {}
+        for i, tkey in cand:
+            if tkey in committed:
                 continue
             code = filt[i] if i < len(filt) else \
                 txpb.TxValidationCode.NOT_VALIDATED
-            # first occurrence wins (reference blkstorage keeps the
-            # original tx's entry; a later DUPLICATE_TXID replay must
-            # not clobber the VALID tx's recorded validation code)
-            tkey = b"t" + ch.tx_id.encode()
-            if tkey in seen_txids or self._index.get(tkey) is not None:
-                continue
-            seen_txids.add(tkey)
             batch.put(tkey,
                       struct.pack(">QIB", block.header.number, i, code))
         batch.put(_CHECKPOINT,

@@ -73,17 +73,30 @@ class KVStore:
             self._conn.commit()
 
     def write_batch(self, batch: WriteBatch, sync: bool = True) -> None:
-        """Atomic multi-op commit (leveldb WriteBatch semantics)."""
+        """Atomic multi-op commit (leveldb WriteBatch semantics).
+
+        Ops run as executemany over maximal same-kind runs — one
+        Python→SQLite call per run, not per op (a 10k-tx block's index
+        batch is ~10k puts; per-op execute was a measured slice of the
+        commit floor). Runs preserve put/delete ordering per key."""
         with self._lock:
             cur = self._conn.cursor()
-            for key, value in batch.ops:
-                if value is None:
-                    cur.execute("DELETE FROM kv WHERE k = ?", (key,))
+            ops = batch.ops
+            i, n = 0, len(ops)
+            while i < n:
+                j = i
+                is_del = ops[i][1] is None
+                while j < n and (ops[j][1] is None) == is_del:
+                    j += 1
+                if is_del:
+                    cur.executemany("DELETE FROM kv WHERE k = ?",
+                                    [(k,) for k, _ in ops[i:j]])
                 else:
-                    cur.execute(
+                    cur.executemany(
                         "INSERT INTO kv(k, v) VALUES(?, ?) "
                         "ON CONFLICT(k) DO UPDATE SET v = excluded.v",
-                        (key, value))
+                        ops[i:j])
+                i = j
             self._conn.commit()
 
     def iterate(self, start: bytes = b"", end: Optional[bytes] = None

@@ -77,8 +77,9 @@ def _load() -> Optional[ctypes.CDLL]:
         # a stale .so from an older source tree may predate the block
         # prep symbols even when mtimes look fresh (build caches, tars
         # with preserved mtimes): rebuild once, else stay unavailable
-        if not hasattr(lib, "ftpu_block_prep"):
-            logger.info("native library lacks block-prep symbols; "
+        if not hasattr(lib, "ftpu_block_prep") or \
+                not hasattr(lib, "ftpu_txid_scan"):
+            logger.info("native library lacks current symbols; "
                         "rebuilding")
             if not _build():
                 return None
@@ -127,6 +128,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ftpu_sha256.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                     _u8]
         lib.ftpu_sha256.restype = None
+        lib.ftpu_txid_scan.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),        # envs
+            np.ctypeslib.ndpointer(np.int64, flags="C"),  # lens
+            ctypes.c_int64,                          # n
+            _i64, _i32,                              # txid off/len
+        ]
+        lib.ftpu_txid_scan.restype = None
         lib.ftpu_utf8_valid.argtypes = [ctypes.c_char_p,
                                         ctypes.c_int64]
         lib.ftpu_utf8_valid.restype = ctypes.c_int32
@@ -246,6 +254,39 @@ def block_prep(envs: list[bytes], channel_id: str,
     if bp.n_unique < 0:
         return None
     return bp
+
+
+def txid_scan(envs: list[bytes]) -> Optional[list]:
+    """Tolerant per-envelope ChannelHeader.tx_id extraction in one
+    native pass (block-store indexing hot path — reference analog:
+    blockindex.go indexBlock txid extraction).
+
+    Returns a list aligned with envs: `str` (possibly "") where the
+    native walker decided, `None` where the envelope needs the Python
+    fallback parse. Returns None (whole call) when the native library
+    is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(envs)
+    if n == 0:
+        return []
+    arr = (ctypes.c_char_p * n)(*envs)
+    lens = np.array([len(e) for e in envs], dtype=np.int64)
+    off = np.zeros(n, dtype=np.int64)
+    ln = np.zeros(n, dtype=np.int32)
+    lib.ftpu_txid_scan(arr, lens, n, off, ln)
+    out: list = []
+    for i in range(n):
+        li = int(ln[i])
+        if li < 0:
+            out.append(None)
+        elif li == 0:
+            out.append("")
+        else:
+            o = int(off[i])
+            out.append(envs[i][o:o + li].decode())
+    return out
 
 
 def sha256(data: bytes) -> Optional[bytes]:

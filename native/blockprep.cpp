@@ -1246,6 +1246,87 @@ void ftpu_sha256(const uint8_t *p, int64_t n, uint8_t *out32) {
     sha256_one(p, (size_t)n, out32);
 }
 
+// ---- tolerant txid scan (block-store indexing) ----
+//
+// The block store needs ONLY ChannelHeader.tx_id per envelope
+// (Envelope.payload -> Payload.header -> Header.channel_header ->
+// field 5). Unlike the strict clean-scan above (which routes unusual
+// encodings to Python for VALIDATION), indexing must accept anything
+// the Python protobuf parser accepts: unknown fields are skipped,
+// repeated occurrences take the last value (proto3 merge semantics).
+// Returns per-envelope txid offset/len; len = -1 means this envelope
+// needs the Python fallback parse, len = 0 means cleanly parsed with
+// no txid (skip, matching `if not ch.tx_id` in _index_block).
+// Reference analog: blockindex.go indexBlock extracting txids via
+// protoutil.GetOrComputeTxIDFromEnvelope.
+
+// 1 found, 0 absent (clean), -1 malformed / needs-Python.
+// bail_on_repeat: for embedded MESSAGE fields protobuf merge is
+// concatenation, not last-wins — a repeated occurrence must route to
+// the Python parser rather than silently dropping the first
+// occurrence's contents. String fields (tx_id itself) keep proto3
+// last-wins, which IS the Python semantics.
+static int32_t walk_one(const Slice &in, uint64_t field, Slice &out,
+                        bool bail_on_repeat) {
+    int64_t pos = 0;
+    int32_t found = 0;
+    while (pos < in.n) {
+        uint64_t tag;
+        if (!read_varint(in, pos, tag)) return -1;
+        uint64_t f = tag >> 3;
+        uint32_t wt = (uint32_t)(tag & 7);
+        if (wt == 2) {
+            Slice s;
+            if (!read_len_delim(in, pos, s)) return -1;
+            if (f == field) {
+                if (found && bail_on_repeat) return -1;
+                out = s;          // last occurrence wins (string)
+                found = 1;
+            }
+        } else if (wt == 0) {
+            uint64_t v;
+            if (!read_varint(in, pos, v)) return -1;
+        } else if (wt == 5) {
+            if (pos + 4 > in.n) return -1;
+            pos += 4;
+        } else if (wt == 1) {
+            if (pos + 8 > in.n) return -1;
+            pos += 8;
+        } else {
+            return -1;            // groups/reserved: Python decides
+        }
+    }
+    return found;
+}
+
+void ftpu_txid_scan(const uint8_t *const *envs, const int64_t *lens,
+                    int64_t n, int64_t *txid_off, int32_t *txid_len) {
+    parallel_for(n, env_threads(), [&](int64_t lo, int64_t hi) {
+        for (int64_t i = lo; i < hi; ++i) {
+            txid_off[i] = 0;
+            txid_len[i] = -1;
+            Slice env = {envs[i], lens[i]};
+            Slice payload = NIL, header = NIL, chdr = NIL, txid = NIL;
+            if (walk_one(env, 1, payload, true) != 1) {
+                // no payload: Python would fail the same way, but let
+                // it decide (it may still skip gracefully)
+                continue;
+            }
+            if (walk_one(payload, 1, header, true) != 1) continue;
+            if (walk_one(header, 1, chdr, true) != 1) continue;
+            int32_t got = walk_one(chdr, 5, txid, false);
+            if (got < 0) continue;
+            if (got == 0 || !valid_utf8(txid)) {
+                if (got == 1) continue;       // bad utf8: Python path
+                txid_len[i] = 0;              // cleanly absent
+                continue;
+            }
+            txid_off[i] = (int64_t)(txid.p - envs[i]);
+            txid_len[i] = (int32_t)txid.n;
+        }
+    });
+}
+
 // standalone UTF-8 validator (differential tests vs upb)
 int32_t ftpu_utf8_valid(const uint8_t *p, int64_t n) {
     Slice s = {p, n};

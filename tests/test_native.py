@@ -145,3 +145,91 @@ class TestNativeParity:
         got = tpu.verify_batch(items)
         assert got == want
         assert sum(want) > 0 and not all(want)
+
+
+class TestTxidScan:
+    """Tolerant native txid walker vs the Python protobuf parser
+    (block-store indexing; native/blockprep.cpp ftpu_txid_scan)."""
+
+    @staticmethod
+    def _env(tx_id: str = "ab12", channel: str = "ch") -> bytes:
+        from fabric_tpu.protos import common as cpb
+        ch = cpb.ChannelHeader(type=cpb.HeaderType.ENDORSER_TRANSACTION,
+                               channel_id=channel, tx_id=tx_id)
+        pay = cpb.Payload(
+            header=cpb.Header(channel_header=ch.SerializeToString()),
+            data=b"body")
+        return cpb.Envelope(payload=pay.SerializeToString(),
+                            signature=b"sig").SerializeToString()
+
+    def test_clean_and_edge_envelopes(self):
+        from fabric_tpu import native
+        envs = [
+            self._env("feedbeef"),
+            self._env(""),                      # cleanly absent txid
+            b"",                                # empty envelope
+            b"\xff\xff\xff",                    # garbage
+            self._env("cafe") + b"\x38\x01",    # unknown field appended
+        ]
+        out = native.txid_scan(envs)
+        assert out is not None
+        assert out[0] == "feedbeef"
+        assert out[1] == ""
+        # empty envelope: no payload -> Python decides (skips it)
+        assert out[2] is None
+        assert out[3] is None                   # malformed -> Python
+        assert out[4] == "cafe"                 # unknown fields legal
+
+    def test_repeated_message_fields_route_to_python(self):
+        """Protobuf merges repeated embedded-message fields by
+        concatenation — last-wins would drop the first occurrence's
+        tx_id. The native walker must hand such envelopes to Python
+        (code-review finding: a crafted duplicate header could
+        otherwise hide a tx_id from the block index and defeat
+        DUPLICATE_TXID protection)."""
+        from fabric_tpu import native
+        from fabric_tpu.protos import common as cpb
+        from fabric_tpu.protoutil import protoutil as pu
+
+        # Payload with TWO header fields: first carries the txid,
+        # second is an empty Header
+        ch = cpb.ChannelHeader(channel_id="ch", tx_id="hidden01")
+        hdr1 = cpb.Header(
+            channel_header=ch.SerializeToString()).SerializeToString()
+        hdr2 = cpb.Header().SerializeToString()
+        payload = (b"\x0a" + bytes([len(hdr1)]) + hdr1 +
+                   b"\x0a" + bytes([len(hdr2)]) + hdr2)
+        env = cpb.Envelope(payload=payload).SerializeToString()
+        out = native.txid_scan([env])
+        assert out == [None], "duplicate header must route to Python"
+        # and the Python parser DOES see the txid (merge semantics)
+        e = pu.unmarshal_envelope(env)
+        merged = pu.get_channel_header(pu.get_payload(e))
+        assert merged.tx_id == "hidden01"
+
+    def test_blockstore_indexes_duplicate_header_envelope(self, tmp_path):
+        """End to end through _block_tx_ids: the fallback path indexes
+        what the native walker refused."""
+        from fabric_tpu.ledger.blkstorage import BlockStore
+        from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+        from fabric_tpu.protos import common as cpb
+        from fabric_tpu.protoutil import protoutil as pu
+
+        ch = cpb.ChannelHeader(channel_id="ch", tx_id="duphdr01")
+        hdr1 = cpb.Header(
+            channel_header=ch.SerializeToString()).SerializeToString()
+        hdr2 = cpb.Header().SerializeToString()
+        payload = (b"\x0a" + bytes([len(hdr1)]) + hdr1 +
+                   b"\x0a" + bytes([len(hdr2)]) + hdr2)
+        env = cpb.Envelope(payload=payload).SerializeToString()
+
+        store = BlockStore(str(tmp_path),
+                           DBHandle(KVStore(":memory:"), "blk"))
+        block = pu.new_block(0, b"")
+        block.data.data.append(env)
+        block.metadata.metadata.extend(
+            [b""] * (cpb.BlockMetadataIndex.TRANSACTIONS_FILTER + 1))
+        block.metadata.metadata[
+            cpb.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes([0])
+        store.add_block(block)
+        assert store.get_tx_loc("duphdr01") == (0, 0, 0)
