@@ -1,0 +1,95 @@
+"""Crash-tolerant file repository for channel-participation artifacts.
+
+Rebuild of `orderer/common/filerepo/filerepo.go`: a directory of
+`<name>.<suffix>` files where Save is write-to-`<file>~tmp`, fsync,
+atomic rename — so a reader never observes a torn file — and
+construction sweeps leftover `~tmp` files from a crash mid-save.
+The orderer uses one repo for join blocks: a join is durable in the
+repo BEFORE the channel's ledger exists, and the registrar resumes
+interrupted joins at startup (multichannel.Registrar.__init__).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+_TMP = "~tmp"
+_NAME_RE = re.compile(r"^[a-zA-Z0-9.-]+$")
+
+
+class FileRepoError(Exception):
+    pass
+
+
+class FileRepo:
+    """One artifact kind (suffix) in one directory."""
+
+    def __init__(self, base_dir: str, suffix: str = "join"):
+        if not suffix or "." in suffix or "/" in suffix:
+            raise FileRepoError(f"invalid suffix {suffix!r}")
+        self._dir = os.path.join(base_dir, suffix)
+        self._suffix = "." + suffix
+        os.makedirs(self._dir, exist_ok=True)
+        # a crash mid-save leaves only a ~tmp file; sweep it so a
+        # half-written artifact can never be read back
+        for name in os.listdir(self._dir):
+            if name.endswith(_TMP):
+                try:
+                    os.remove(os.path.join(self._dir, name))
+                except OSError:
+                    pass
+        self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
+        """Make the rename/unlink itself durable (POSIX requires the
+        directory fsync, not just the file's)."""
+        try:
+            fd = os.open(self._dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+
+    def _path(self, name: str) -> str:
+        if not _NAME_RE.match(name):
+            raise FileRepoError(f"invalid artifact name {name!r}")
+        return os.path.join(self._dir, name + self._suffix)
+
+    def save(self, name: str, content: bytes) -> None:
+        """Atomic create-or-replace: tmp + fsync + rename + dir fsync
+        (reference filerepo.Save semantics)."""
+        path = self._path(name)
+        tmp = path + _TMP
+        with open(tmp, "wb") as f:
+            f.write(content)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._fsync_dir()
+
+    def read(self, name: str) -> Optional[bytes]:
+        try:
+            with open(self._path(name), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def remove(self, name: str) -> None:
+        """Idempotent (reference Remove tolerates missing files)."""
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            return
+        self._fsync_dir()
+
+    def list(self) -> list[str]:
+        """Artifact names (without suffix), sorted."""
+        out = []
+        for fname in os.listdir(self._dir):
+            if fname.endswith(self._suffix) and not fname.endswith(_TMP):
+                out.append(fname[: -len(self._suffix)])
+        return sorted(out)

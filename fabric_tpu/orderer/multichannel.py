@@ -246,13 +246,39 @@ class Registrar:
         self._part_relation = self._metrics_provider.new_gauge(
             PARTICIPATION_RELATION)
         os.makedirs(root_dir, exist_ok=True)
+        # crash-tolerant join-block repo (reference
+        # orderer/common/filerepo/filerepo.go): a join is durable here
+        # BEFORE the channel ledger exists, so a crash mid-join resumes
+        # below instead of losing the operator's request
+        from fabric_tpu.orderer.filerepo import FileRepo
+        self._joinrepo = FileRepo(os.path.join(root_dir, "pendingops"),
+                                  "join")
         for channel_id in sorted(os.listdir(root_dir)):
+            if channel_id == "pendingops":
+                continue
             if os.path.isdir(os.path.join(root_dir, channel_id)):
                 try:
                     self._restore(channel_id)
                 except Exception:
                     logger.exception("failed to restore channel %s",
                                      channel_id)
+        for channel_id in self._joinrepo.list():
+            if channel_id in self._chains:
+                # crashed after the ledger append but before the
+                # artifact removal: the channel restored above
+                self._joinrepo.remove(channel_id)
+                continue
+            raw = self._joinrepo.read(channel_id)
+            try:
+                block = common.Block()
+                block.ParseFromString(raw)
+                logger.info("resuming interrupted join of channel %s "
+                            "from the pending-join repo", channel_id)
+                self.join(block)
+            except Exception:
+                logger.exception("could not resume join of channel %s"
+                                 " (artifact kept for retry)",
+                                 channel_id)
 
     def _consenter_factory(self):
         def factory(support: ChainSupport):
@@ -318,6 +344,16 @@ class Registrar:
             if bundle.orderer is None:
                 raise ValueError("join block config lacks an Orderer "
                                  "section")
+            # the join becomes DURABLE here, before any ledger state
+            # exists: a crash at any later point is resumed from this
+            # artifact at startup (write-tmp-fsync-rename discipline —
+            # reference orderer/common/filerepo + registrar JoinChannel)
+            self._joinrepo.save(channel_id, pu.marshal(join_block))
+            if os.environ.get("FTPU_CRASH_AFTER_JOIN_SAVE") == "1":
+                # crash-fault injection for the nwo kill-during-join
+                # test: die with the join saved but no ledger created
+                logger.critical("FTPU_CRASH_AFTER_JOIN_SAVE: aborting")
+                os._exit(41)
             channel_dir = os.path.join(self._root, channel_id)
             # only a join that CREATES the ledger may clean it up on
             # failure; a pre-existing dir (e.g. startup _restore failed
@@ -335,8 +371,12 @@ class Registrar:
                 ledger.close()
                 if created:
                     shutil.rmtree(channel_dir, ignore_errors=True)
+                    self._joinrepo.remove(channel_id)
                 raise
             self._chains[channel_id] = support
+            # the ledger now holds the join block durably; the pending
+            # artifact has served its purpose
+            self._joinrepo.remove(channel_id)
         support.chain.start()
         self._set_participation(channel_id, support)
         return support
@@ -346,6 +386,7 @@ class Registrar:
         channel's ledger (reference registrar.RemoveChannel)."""
         with self._lock:
             support = self._chains.pop(channel_id, None)
+            self._joinrepo.remove(channel_id)
         if support is not None:
             support.halt()
             support.ledger.close()

@@ -46,10 +46,11 @@ def wait_http(url: str, timeout: float = 30.0) -> None:
 
 
 class Node:
-    def __init__(self, name: str, argv: list[str], log_path: str):
+    def __init__(self, name: str, argv: list[str], log_path: str,
+                 extra_env: dict | None = None):
         self.name = name
         self.log_path = log_path
-        self.log = open(log_path, "wb")
+        self.log = open(log_path, "ab")
         env = dict(os.environ)
         env.update({
             "PYTHONPATH": REPO,
@@ -58,6 +59,8 @@ class Node:
             "FABRIC_LOGGING_SPEC": env.get("FABRIC_LOGGING_SPEC",
                                            "info"),
         })
+        if extra_env:
+            env.update(extra_env)
         self.proc = subprocess.Popen(argv, stdout=self.log,
                                      stderr=subprocess.STDOUT, env=env)
 
@@ -80,11 +83,17 @@ class Network:
     """2-org (1 peer each by default) × N-orderer raft network."""
 
     def __init__(self, root: str, n_orderers: int = 3,
-                 peers_per_org: int = 1, channel: str = "testchannel"):
+                 peers_per_org: int = 1, channel: str = "testchannel",
+                 state_backend: dict | None = None):
         self.root = root
         self.channel = channel
         self.n_orderers = n_orderers
         self.peers_per_org = peers_per_org
+        # org -> "http" runs that org's peers against an external
+        # state-server process (the statecouchdb deployment shape)
+        self.state_backend = state_backend or {}
+        self.state_server_port = free_port() if self.state_backend \
+            else None
         self.nodes: dict[str, Node] = {}
         # (general grpc, ops, mTLS cluster listener) per orderer
         self.orderer_ports = [(free_port(), free_port(), free_port())
@@ -189,7 +198,8 @@ class Network:
 
     # -- node lifecycle --
 
-    def start_orderer(self, i: int) -> Node:
+    def start_orderer(self, i: int,
+                      extra_env: dict | None = None) -> Node:
         grpc_port, ops_port, cluster_port = self.orderer_ports[i]
         crypto = os.path.join(self.root, "crypto")
         tls_dir = os.path.join(
@@ -228,7 +238,8 @@ class Network:
         node = Node(f"orderer{i}",
                     [sys.executable, "-m", "fabric_tpu.cmd.orderer",
                      "start", "--config", path],
-                    os.path.join(self.root, f"orderer{i}.log"))
+                    os.path.join(self.root, f"orderer{i}.log"),
+                    extra_env=extra_env)
         self.nodes[f"orderer{i}"] = node
         return node
 
@@ -257,6 +268,11 @@ class Network:
             "operations": {
                 "listenAddress": f"127.0.0.1:{ops_port}"},
         }
+        if self.state_backend.get(org) == "http":
+            cfg["ledger"] = {"state": {
+                "stateDatabase": "http",
+                "stateDatabaseAddress":
+                    f"127.0.0.1:{self.state_server_port}"}}
         path = os.path.join(self.root, f"core_{org}_{i}.yaml")
         with open(path, "w") as f:
             yaml.safe_dump(cfg, f)
@@ -267,7 +283,23 @@ class Network:
         self.nodes[f"peer_{org}_{i}"] = node
         return node
 
+    def start_state_server(self) -> Node:
+        node = Node("stateserver",
+                    [sys.executable, "-m",
+                     "fabric_tpu.ledger.stateserver",
+                     "--data-dir", os.path.join(self.root,
+                                                "stateserver"),
+                     "--listen",
+                     f"127.0.0.1:{self.state_server_port}"],
+                    os.path.join(self.root, "stateserver.log"))
+        self.nodes["stateserver"] = node
+        return node
+
     def start_all(self) -> None:
+        if self.state_server_port is not None:
+            self.start_state_server()
+            wait_http(f"http://127.0.0.1:{self.state_server_port}"
+                      "/healthz")
         for i in range(self.n_orderers):
             self.start_orderer(i)
         for i in range(self.n_orderers):

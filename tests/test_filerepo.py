@@ -1,0 +1,166 @@
+"""Crash-tolerant join-block file repo.
+
+Round-4 verdict #9: participation join-blocks lacked the reference's
+write-tmp-fsync-rename crash discipline
+(`orderer/common/filerepo/filerepo.go`). These tests pin the repo
+semantics (atomic save, tmp sweep, idempotent remove) and the
+registrar's crash-resume contract: a join that died after the artifact
+save but before the ledger append is completed at the next startup.
+The process-kill variant lives in test_integration_nwo.py
+(FTPU_CRASH_AFTER_JOIN_SAVE injection).
+"""
+
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.sw import SWProvider
+from fabric_tpu.internal import cryptogen
+from fabric_tpu.internal.configtxgen import genesis_block, new_channel_group
+from fabric_tpu.msp import msp_config_from_dir
+from fabric_tpu.msp.mspimpl import X509MSP
+from fabric_tpu.orderer import solo
+from fabric_tpu.orderer.filerepo import FileRepo, FileRepoError
+from fabric_tpu.orderer.multichannel import Registrar
+from fabric_tpu.protoutil import protoutil as pu
+
+CHANNEL = "joinkill"
+
+
+class TestFileRepo:
+    def test_save_read_list_remove(self, tmp_path):
+        repo = FileRepo(str(tmp_path), "join")
+        repo.save("ch1", b"alpha")
+        repo.save("ch2", b"beta")
+        assert repo.read("ch1") == b"alpha"
+        assert repo.list() == ["ch1", "ch2"]
+        repo.save("ch1", b"alpha2")          # atomic replace
+        assert repo.read("ch1") == b"alpha2"
+        repo.remove("ch1")
+        repo.remove("ch1")                   # idempotent
+        assert repo.read("ch1") is None
+        assert repo.list() == ["ch2"]
+
+    def test_tmp_leftovers_swept_at_startup(self, tmp_path):
+        repo = FileRepo(str(tmp_path), "join")
+        repo.save("ok", b"good")
+        # simulate a crash mid-save: a torn tmp file on disk
+        torn = os.path.join(str(tmp_path), "join", "dead.join~tmp")
+        with open(torn, "wb") as f:
+            f.write(b"half-writ")
+        repo2 = FileRepo(str(tmp_path), "join")
+        assert not os.path.exists(torn)
+        assert repo2.list() == ["ok"]
+        assert repo2.read("ok") == b"good"
+
+    def test_bad_names_rejected(self, tmp_path):
+        repo = FileRepo(str(tmp_path), "join")
+        for bad in ("", "../x", "a/b", "a~tmp\x00"):
+            with pytest.raises(FileRepoError):
+                repo.save(bad, b"x")
+        with pytest.raises(FileRepoError):
+            FileRepo(str(tmp_path), "a.b")
+
+
+@pytest.fixture(scope="module")
+def genesis_env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("joinrepo")
+    cdir = str(root / "crypto")
+    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    csp = SWProvider()
+    profile = {
+        "Consortium": "SampleConsortium",
+        "Capabilities": {"V2_0": True},
+        "Orderer": {
+            "OrdererType": "solo",
+            "Addresses": ["orderer0:7050"],
+            "BatchTimeout": "200ms",
+            "BatchSize": {"MaxMessageCount": 16},
+            "Organizations": [
+                {"Name": "OrdererOrg", "ID": "OrdererMSP",
+                 "MSPDir": os.path.join(ordo, "msp"),
+                 "OrdererEndpoints": ["orderer0:7050"]}],
+            "Capabilities": {"V2_0": True},
+        },
+    }
+    genesis = genesis_block(CHANNEL, new_channel_group(profile))
+    msp = X509MSP(csp)
+    msp.setup(msp_config_from_dir(
+        os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
+        "OrdererMSP", csp=csp))
+    return root, msp.get_default_signing_identity(), csp, genesis
+
+
+def _registrar(root, signer, csp, sub):
+    return Registrar(os.path.join(str(root), sub), signer, csp,
+                     {"solo": solo.consenter})
+
+
+class TestJoinCrashResume:
+    def test_join_resumed_from_pending_artifact(self, genesis_env):
+        """Crash between the artifact save and the ledger append: the
+        next startup completes the join."""
+        root, signer, csp, genesis = genesis_env
+        reg = _registrar(root, signer, csp, "o1")
+        reg.halt()
+        # simulate the crash window: artifact durable, no channel dir
+        repo = FileRepo(os.path.join(str(root), "o1", "pendingops"),
+                        "join")
+        repo.save(CHANNEL, pu.marshal(genesis))
+        reg2 = _registrar(root, signer, csp, "o1")
+        try:
+            support = reg2.get_chain(CHANNEL)
+            assert support is not None, "interrupted join not resumed"
+            assert support.ledger.height == 1
+            # the artifact is consumed once the ledger holds the block
+            assert repo.list() == []
+        finally:
+            reg2.halt()
+        # a THIRD start restores the channel from its ledger dir and
+        # does not double-join
+        reg3 = _registrar(root, signer, csp, "o1")
+        try:
+            assert reg3.get_chain(CHANNEL).ledger.height == 1
+        finally:
+            reg3.halt()
+
+    def test_completed_join_leaves_no_artifact(self, genesis_env):
+        root, signer, csp, genesis = genesis_env
+        reg = _registrar(root, signer, csp, "o2")
+        try:
+            reg.join(genesis)
+            repo = FileRepo(
+                os.path.join(str(root), "o2", "pendingops"), "join")
+            assert repo.list() == []
+        finally:
+            reg.halt()
+
+    def test_crash_injection_hook_fires_after_save(self, genesis_env,
+                                                   monkeypatch):
+        """The nwo kill-during-join test's injection point must die
+        AFTER the artifact save (that ordering is the contract the
+        resume path depends on)."""
+        root, signer, csp, genesis = genesis_env
+        reg = _registrar(root, signer, csp, "o3")
+        monkeypatch.setenv("FTPU_CRASH_AFTER_JOIN_SAVE", "1")
+        died = []
+        monkeypatch.setattr(os, "_exit",
+                            lambda code: died.append(code) or
+                            (_ for _ in ()).throw(SystemExit(code)))
+        with pytest.raises(SystemExit):
+            reg.join(genesis)
+        reg.halt()
+        assert died == [41]
+        repo = FileRepo(os.path.join(str(root), "o3", "pendingops"),
+                        "join")
+        assert repo.list() == [CHANNEL]
+        assert not os.path.isdir(os.path.join(str(root), "o3",
+                                              CHANNEL))
+        # restart (without the injection) completes the join
+        monkeypatch.delenv("FTPU_CRASH_AFTER_JOIN_SAVE")
+        reg2 = _registrar(root, signer, csp, "o3")
+        try:
+            assert reg2.get_chain(CHANNEL) is not None
+            assert reg2.get_chain(CHANNEL).ledger.height == 1
+        finally:
+            reg2.halt()

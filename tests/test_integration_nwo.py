@@ -146,6 +146,42 @@ class TestNwoEndToEnd:
         from fabric_tpu.protoutil import protoutil as pu
         assert pu.is_config_block(block)
 
+    def test_kill_during_join_resumes_at_restart(self, network):
+        """Crash-safe join-block repo end to end (reference
+        orderer/common/filerepo): an orderer killed between the
+        join-artifact save and the ledger append completes the join at
+        its next startup. The crash window is hit deterministically via
+        FTPU_CRASH_AFTER_JOIN_SAVE (multichannel.Registrar.join)."""
+        import os
+
+        # a second channel's genesis, same org material
+        block_path = os.path.join(network.root, "joinkill.block")
+        network._run_cli(
+            "fabric_tpu.cmd.configtxgen", "-profile", "Genesis",
+            "-channelID", "joinkill",
+            "-configPath", os.path.join(network.root, "configtx.yaml"),
+            "-outputBlock", block_path)
+        # restart orderer2 with the crash injection armed
+        network.nodes["orderer2"].kill()
+        network.start_orderer(
+            2, extra_env={"FTPU_CRASH_AFTER_JOIN_SAVE": "1"})
+        ops = network.orderer_ports[2][1]
+        from tests.nwo import wait_http
+        wait_http(f"http://127.0.0.1:{ops}/healthz")
+        node = network.nodes["orderer2"]
+        with pytest.raises(Exception):
+            network.osnadmin(2, "join", "--channelID", "joinkill",
+                             "--config-block", block_path)
+        assert _wait(lambda: node.proc.poll() == 41, timeout=20), \
+            f"orderer2 did not die at the injection point: " \
+            f"{node.proc.poll()}"
+        # restart clean: the pending join must complete from the repo
+        network.start_orderer(2)
+        wait_http(f"http://127.0.0.1:{ops}/healthz")
+        listed = json.loads(network.osnadmin(2, "list"))
+        names = [c["name"] for c in listed.get("channels", [])]
+        assert "joinkill" in names, listed
+
     def test_orderer_crash_failover(self, network):
         """Kill one orderer (possibly the raft leader): the network
         keeps ordering."""
