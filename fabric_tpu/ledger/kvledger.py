@@ -75,14 +75,21 @@ class KVLedger:
     """Reference: kvLedger (`kv_ledger.go`)."""
 
     def __init__(self, ledger_id: str, ledger_dir: str,
-                 metrics_provider=None):
+                 metrics_provider=None, state_db_factory=None):
         self.ledger_id = ledger_id
         self._dir = ledger_dir
         os.makedirs(ledger_dir, exist_ok=True)
         self._kv = KVStore(os.path.join(ledger_dir, "index.db"))
         self.block_store = BlockStore(
             ledger_dir, DBHandle(self._kv, "blkindex"))
-        self.state_db = StateDB(DBHandle(self._kv, "statedb"))
+        # pluggable state DB (reference statedb.go VersionedDB): the
+        # factory builds an alternate backend (e.g. the HTTP external
+        # engine, statecouchdb's role); default = embedded sqlite
+        if state_db_factory is not None:
+            self.state_db = state_db_factory(
+                ledger_id, DBHandle(self._kv, "statedb"))
+        else:
+            self.state_db = StateDB(DBHandle(self._kv, "statedb"))
         self.history_db = HistoryDB(DBHandle(self._kv, "historydb"))
         self.txmgr = TxMgr(self.state_db)
         self.pvt_store = pvt.PvtDataStore(DBHandle(self._kv, "pvtstore"))

@@ -25,9 +25,14 @@ _UNDER_CONSTRUCTION = "_under_construction"
 
 
 class LedgerManager:
-    def __init__(self, root_dir: str, metrics_provider=None):
+    def __init__(self, root_dir: str, metrics_provider=None,
+                 state_db_factory=None):
         self._root = root_dir
         self._metrics = metrics_provider
+        # pluggable VersionedDB seam (reference: statedb.go); None =
+        # the embedded engine. Signature: (ledger_id, db_handle) ->
+        # statedb.VersionedDB (see kvledger.KVLedger)
+        self._state_db_factory = state_db_factory
         self._ledgers: dict[str, KVLedger] = {}
         os.makedirs(root_dir, exist_ok=True)
 
@@ -66,7 +71,8 @@ class LedgerManager:
             pass
         os.replace(tmp, path)
         marker = os.path.join(path, _UNDER_CONSTRUCTION)
-        ledger = KVLedger(ledger_id, path, self._metrics)
+        ledger = KVLedger(ledger_id, path, self._metrics,
+                          state_db_factory=self._state_db_factory)
         try:
             ledger.initialize_from_genesis(genesis_block)
         except Exception:
@@ -97,7 +103,8 @@ class LedgerManager:
         with open(os.path.join(tmp, _UNDER_CONSTRUCTION), "w"):
             pass
         os.replace(tmp, path)
-        ledger = KVLedger(ledger_id, path, self._metrics)
+        ledger = KVLedger(ledger_id, path, self._metrics,
+                          state_db_factory=self._state_db_factory)
         try:
             snap.import_into(ledger, snapshot_dir)
         except Exception:
@@ -119,7 +126,8 @@ class LedgerManager:
             raise LedgerError(
                 f"ledger {ledger_id!r} is incomplete (create() did not "
                 f"finish); re-create it from its genesis block")
-        ledger = KVLedger(ledger_id, path, self._metrics)
+        ledger = KVLedger(ledger_id, path, self._metrics,
+                          state_db_factory=self._state_db_factory)
         self._ledgers[ledger_id] = ledger
         return ledger
 

@@ -93,7 +93,63 @@ _IDX_DEF_PREFIX = b"\x00idxdef\x00"   # persisted index definitions
 _IDX_SEP = b"\x00\x00"
 
 
-class StateDB:
+class VersionedDB:
+    """The pluggable state-database seam (reference:
+    `core/ledger/kvledger/txmgmt/statedb/statedb.go` VersionedDB).
+
+    Everything above this line — TxMgr/TxSimulator MVCC, the
+    committer, snapshots, fastvalidate's metadata probes — talks ONLY
+    to this surface, so a deployment can swap the embedded engine for
+    an external service (statehttp.HTTPVersionedDB is the in-tree
+    example, playing CouchDB's role: rich queries execute inside the
+    database with its own indexes and pagination).
+
+    Contract notes: `get_state_range` yields (key, VersionedValue) in
+    key order over [start, end) (end="" = unbounded within ns);
+    `execute_query` returns ([(key, raw_value, Height)], bookmark);
+    `apply_updates` must persist the batch and savepoint atomically;
+    `savepoint()` is None only before the first apply_updates."""
+
+    def get_state(self, ns: str, key: str):
+        raise NotImplementedError
+
+    def get_state_metadata(self, ns: str, key: str):
+        raise NotImplementedError
+
+    def get_state_metadata_many(self, wanted):
+        return {nk: self.get_state_metadata(*nk) for nk in wanted}
+
+    def get_version(self, ns: str, key: str):
+        vv = self.get_state(ns, key)
+        return vv.version if vv is not None else None
+
+    def get_state_range(self, ns: str, start_key: str, end_key: str):
+        raise NotImplementedError
+
+    def execute_query(self, ns: str, query: str, page_size: int = 0,
+                      bookmark: str = ""):
+        raise NotImplementedError
+
+    def define_index(self, ns: str, name: str, index_json: str) -> None:
+        raise NotImplementedError
+
+    def apply_updates(self, batch: "UpdateBatch", height: Height) -> None:
+        raise NotImplementedError
+
+    def apply_writes_only(self, batch: "UpdateBatch") -> None:
+        raise NotImplementedError
+
+    def savepoint(self) -> Optional[Height]:
+        raise NotImplementedError
+
+    def iterate_all(self):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class StateDB(VersionedDB):
     def __init__(self, db: DBHandle):
         self._db = db
         # materialized rich-query indexes (reference: statecouchdb's
@@ -314,3 +370,11 @@ class StateDB:
     def savepoint(self) -> Optional[Height]:
         raw = self._db.get(_SAVEPOINT)
         return Height.unpack(raw) if raw else None
+
+    def execute_query(self, ns: str, query: str, page_size: int = 0,
+                      bookmark: str = ""):
+        """Rich (Mango-selector) query — the engine's own planner and
+        materialized indexes (reference: statecouchdb ExecuteQuery)."""
+        from fabric_tpu.ledger import richquery
+        return richquery.execute_query(self, ns, query, page_size,
+                                       bookmark)

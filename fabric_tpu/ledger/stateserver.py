@@ -1,0 +1,277 @@
+"""External state database over HTTP — the second VersionedDB backend.
+
+Plays the role CouchDB plays for the reference
+(`core/ledger/kvledger/txmgmt/statedb/statecouchdb/statecouchdb.go`):
+the peer's ledger talks to a separate database PROCESS through a
+client implementing the `statedb.VersionedDB` seam, and rich queries
+execute inside the database with its own materialized indexes and
+pagination. The server side hosts the embedded engine
+(`statedb.StateDB` over sqlite) per database name — one per channel —
+behind a small JSON/HTTP protocol (base64 for byte values).
+
+Run the server:  python -m fabric_tpu.ledger.stateserver \
+                     --data-dir /var/state --listen 127.0.0.1:5984
+Point a peer at it: core.yaml `ledger.state.stateDatabase: http`,
+`ledger.state.stateDatabaseAddress: 127.0.0.1:5984` (peer_node.py).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import threading
+import urllib.request
+from typing import Iterator, Optional
+
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.ledger.statedb import (
+    Height, StateDB, UpdateBatch, VersionedDB, VersionedValue,
+)
+
+logger = logging.getLogger("stateserver")
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def _vv_out(vv: Optional[VersionedValue]):
+    if vv is None:
+        return None
+    return {"v": _b64(vv.value),
+            "ver": [vv.version.block, vv.version.tx],
+            "md": _b64(vv.metadata or b"")}
+
+
+def _vv_in(obj) -> Optional[VersionedValue]:
+    if obj is None:
+        return None
+    return VersionedValue(_unb64(obj["v"]),
+                          Height(obj["ver"][0], obj["ver"][1]),
+                          _unb64(obj["md"]))
+
+
+class StateServer:
+    """One process hosting N named state databases (reference analog:
+    one CouchDB instance, one database per channel+namespace scope)."""
+
+    def __init__(self, data_dir: str, listen: str = "127.0.0.1:0"):
+        self._dir = data_dir
+        os.makedirs(data_dir, exist_ok=True)
+        self._dbs: dict[str, StateDB] = {}
+        self._stores: dict[str, KVStore] = {}
+        self._lock = threading.Lock()
+        host, port = listen.rsplit(":", 1)
+        from http.server import (
+            BaseHTTPRequestHandler, ThreadingHTTPServer,
+        )
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                logger.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {"status": "OK"})
+                else:
+                    self._reply(404, {"error": "bad path"})
+
+            def do_POST(self):
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    parts = [p for p in self.path.split("/") if p]
+                    # /v1/<dbname>/<method>
+                    if len(parts) != 3 or parts[0] != "v1":
+                        self._reply(404, {"error": "bad path"})
+                        return
+                    out = outer._dispatch(parts[1], parts[2], req)
+                    self._reply(200, out)
+                except Exception as e:   # noqa: BLE001
+                    logger.exception("state request failed")
+                    self._reply(500, {"error": f"{type(e).__name__}: "
+                                               f"{e}"})
+
+            def _reply(self, code: int, obj) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.address = (f"{self._httpd.server_address[0]}:"
+                        f"{self._httpd.server_address[1]}")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="stateserver")
+
+    def start(self) -> None:
+        self._thread.start()
+        logger.info("state server listening on %s (data: %s)",
+                    self.address, self._dir)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        with self._lock:
+            for store in self._stores.values():
+                store.close()
+            self._stores.clear()
+            self._dbs.clear()
+
+    def _db(self, name: str) -> StateDB:
+        if not name.replace("-", "").replace("_", "").isalnum():
+            raise ValueError(f"invalid database name {name!r}")
+        with self._lock:
+            db = self._dbs.get(name)
+            if db is None:
+                store = KVStore(os.path.join(self._dir,
+                                             f"{name}.state.db"))
+                self._stores[name] = store
+                db = StateDB(DBHandle(store, "statedb"))
+                self._dbs[name] = db
+            return db
+
+    def _dispatch(self, dbname: str, method: str, req: dict):
+        db = self._db(dbname)
+        if method == "get_state":
+            return {"vv": _vv_out(db.get_state(req["ns"], req["key"]))}
+        if method == "get_state_metadata_many":
+            found = []
+            for ns, key in req["keys"]:
+                md = db.get_state_metadata(ns, key)
+                if md is not None:
+                    found.append([ns, key, _b64(md)])
+            return {"found": found}
+        if method == "get_state_range":
+            items = [[k, _vv_out(vv)] for k, vv in db.get_state_range(
+                req["ns"], req["start"], req["end"])]
+            return {"items": items}
+        if method == "execute_query":
+            results, bm = db.execute_query(
+                req["ns"], req["query"], req.get("page_size", 0),
+                req.get("bookmark", ""))
+            return {"results": [[k, _b64(raw),
+                                 [v.block, v.tx]]
+                                for k, raw, v in results],
+                    "bookmark": bm}
+        if method == "define_index":
+            db.define_index(req["ns"], req["name"], req["json"])
+            return {}
+        if method in ("apply_updates", "apply_writes_only"):
+            batch = UpdateBatch()
+            for ns, key, vv in req["updates"]:
+                batch.updates[(ns, key)] = _vv_in(vv)
+            if method == "apply_updates":
+                h = req["height"]
+                db.apply_updates(batch, Height(h[0], h[1]))
+            else:
+                db.apply_writes_only(batch)
+            return {}
+        if method == "savepoint":
+            sp = db.savepoint()
+            return {"height":
+                    [sp.block, sp.tx] if sp else None}
+        if method == "iterate_all":
+            return {"items": [[ns, k, _vv_out(vv)]
+                              for ns, k, vv in db.iterate_all()]}
+        raise ValueError(f"unknown method {method!r}")
+
+
+class HTTPVersionedDB(VersionedDB):
+    """Client half of the seam: the peer-side VersionedDB whose engine
+    lives in another process (statecouchdb's role)."""
+
+    def __init__(self, address: str, dbname: str, timeout: float = 30.0):
+        self._base = f"http://{address}/v1/{dbname}/"
+        self._timeout = timeout
+
+    def _call(self, method: str, **kwargs):
+        req = urllib.request.Request(
+            self._base + method, data=json.dumps(kwargs).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req,
+                                    timeout=self._timeout) as resp:
+            out = json.loads(resp.read())
+        return out
+
+    def get_state(self, ns: str, key: str) -> Optional[VersionedValue]:
+        return _vv_in(self._call("get_state", ns=ns, key=key)["vv"])
+
+    def get_state_metadata(self, ns: str, key: str) -> Optional[bytes]:
+        vv = self.get_state(ns, key)
+        return vv.metadata if vv is not None and vv.metadata else None
+
+    def get_state_metadata_many(self, wanted) -> dict:
+        out = self._call("get_state_metadata_many",
+                         keys=[[ns, key] for ns, key in wanted])
+        return {(ns, key): _unb64(md)
+                for ns, key, md in out["found"]}
+
+    def get_state_range(self, ns: str, start_key: str, end_key: str
+                        ) -> Iterator[tuple[str, VersionedValue]]:
+        out = self._call("get_state_range", ns=ns, start=start_key,
+                         end=end_key)
+        for k, vv in out["items"]:
+            yield k, _vv_in(vv)
+
+    def execute_query(self, ns: str, query: str, page_size: int = 0,
+                      bookmark: str = ""):
+        out = self._call("execute_query", ns=ns, query=query,
+                         page_size=page_size, bookmark=bookmark)
+        return ([(k, _unb64(raw), Height(v[0], v[1]))
+                 for k, raw, v in out["results"]], out["bookmark"])
+
+    def define_index(self, ns: str, name: str, index_json: str) -> None:
+        self._call("define_index", ns=ns, name=name, json=index_json)
+
+    def _ship(self, method: str, batch: UpdateBatch, **extra) -> None:
+        updates = [[ns, key, _vv_out(vv)]
+                   for (ns, key), vv in batch.updates.items()]
+        self._call(method, updates=updates, **extra)
+
+    def apply_updates(self, batch: UpdateBatch, height: Height) -> None:
+        self._ship("apply_updates", batch,
+                   height=[height.block, height.tx])
+
+    def apply_writes_only(self, batch: UpdateBatch) -> None:
+        self._ship("apply_writes_only", batch)
+
+    def savepoint(self) -> Optional[Height]:
+        h = self._call("savepoint")["height"]
+        return Height(h[0], h[1]) if h else None
+
+    def iterate_all(self) -> Iterator[tuple[str, str, VersionedValue]]:
+        for ns, k, vv in self._call("iterate_all")["items"]:
+            yield ns, k, _vv_in(vv)
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(prog="stateserver")
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--listen", default="127.0.0.1:5984")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    srv = StateServer(args.data_dir, args.listen)
+    srv.start()
+    print(f"state server on {srv.address}", flush=True)
+    try:
+        srv._thread.join()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

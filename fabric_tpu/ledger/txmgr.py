@@ -147,9 +147,8 @@ class TxSimulator:
         (reference: statecouchdb ExecuteQuery). Returned keys are
         recorded as reads; result sets are NOT re-validated for
         phantoms (the documented CouchDB caveat)."""
-        from fabric_tpu.ledger import richquery
-        results, next_bm = richquery.execute_query(
-            self._db, ns, query, page_size, bookmark)
+        results, next_bm = self._db.execute_query(
+            ns, query, page_size, bookmark)
         for key, _raw, version in results:
             if (ns, key) not in self._reads and \
                     (ns, key) not in self._writes:

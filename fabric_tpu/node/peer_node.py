@@ -132,8 +132,27 @@ class PeerNode:
         local_msp = X509MSP(csp)
         local_msp.setup(msp_config_from_dir(msp_dir, msp_id, csp=csp))
 
+        # pluggable state database (reference core.yaml
+        # ledger.state.stateDatabase goleveldb|CouchDB): "http" points
+        # the VersionedDB seam at an external state-server process
+        # (fabric_tpu/ledger/stateserver.py, statecouchdb's role)
+        state_db_factory = None
+        state_kind = cfg.get("ledger.state.stateDatabase", "embedded")
+        if str(state_kind).lower() in ("http", "couchdb"):
+            state_addr = cfg.get("ledger.state.stateDatabaseAddress",
+                                 "127.0.0.1:5984")
+            from fabric_tpu.ledger.stateserver import HTTPVersionedDB
+
+            def state_db_factory(ledger_id, _handle,
+                                 _addr=state_addr):
+                return HTTPVersionedDB(_addr, ledger_id)
+
+            logger.info("state database: external http engine at %s",
+                        state_addr)
+
         self.peer = Peer(fs_path, local_msp, csp,
-                         metrics_provider=provider)
+                         metrics_provider=provider,
+                         state_db_factory=state_db_factory)
         self.msp_id = msp_id
 
         # gossip over gRPC; external endpoint = peer.address

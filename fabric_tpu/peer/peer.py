@@ -301,13 +301,14 @@ class Peer:
     `internal/peer/node/start.go` serve()."""
 
     def __init__(self, ledger_root: str, local_msp, csp,
-                 metrics_provider=None):
+                 metrics_provider=None, state_db_factory=None):
         self.csp = csp
         self.local_msp = local_msp
         self.metrics_provider = metrics_provider
         self.signer = local_msp.get_default_signing_identity()
-        self.ledger_mgr = LedgerManager(ledger_root,
-                                        metrics_provider=metrics_provider)
+        self.ledger_mgr = LedgerManager(
+            ledger_root, metrics_provider=metrics_provider,
+            state_db_factory=state_db_factory)
         self.transient_store = TransientStore(
             os.path.join(ledger_root, "transient.db"))
         self.chaincode_support = ChaincodeSupport(

@@ -1,0 +1,74 @@
+"""Multi-process proof of the pluggable state-database seam: a real
+peer PROCESS runs its world state against an external state-server
+process (fabric_tpu/ledger/stateserver.py — statecouchdb's deployment
+shape) while the other org stays on the embedded engine, and both
+commit identical state through endorse→order→validate→commit.
+Round-4 verdict #7 done-criterion: "nwo test runs a peer on the
+alternate backend".
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from tests.nwo import Network
+
+
+def _wait(cond, timeout=60.0, step=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:
+            pass
+        time.sleep(step)
+    return False
+
+
+@pytest.fixture(scope="module")
+def network(tmp_path_factory):
+    net = Network(str(tmp_path_factory.mktemp("nwo_http")),
+                  n_orderers=1,
+                  state_backend={"org2": "http"})
+    try:
+        net.start_all()
+        net.join_all()
+        yield net
+    finally:
+        net.teardown()
+        for name, node in net.nodes.items():
+            print(f"--- {name} log tail ---")
+            try:
+                with open(node.log_path, "rb") as f:
+                    print(f.read()[-2000:].decode(errors="replace"))
+            except OSError:
+                pass
+
+
+@pytest.mark.integration
+class TestPeerOnHTTPStateBackend:
+    def test_commit_visible_on_both_backends(self, network):
+        assert _wait(lambda: json.loads(network.invoke(
+            "org1", 0, "put", "ext1", "42"))["status"] == "VALID",
+            timeout=60)
+        # org1 (embedded) and org2 (external http engine) agree
+        assert _wait(lambda: network.query(
+            "org1", 0, "get", "ext1").strip() == "42")
+        assert _wait(lambda: network.query(
+            "org2", 0, "get", "ext1").strip() == "42")
+        # the state actually lives in the server process's data dir
+        sdir = os.path.join(network.root, "stateserver")
+        assert any(n.endswith(".state.db") for n in os.listdir(sdir)), \
+            os.listdir(sdir)
+
+    def test_endorse_on_http_backend_peer(self, network):
+        """The http-backed peer can ENDORSE (simulate against the
+        external engine), not just commit."""
+        assert _wait(lambda: json.loads(network.invoke(
+            "org2", 0, "put", "ext2", "7"))["status"] == "VALID",
+            timeout=60)
+        assert _wait(lambda: network.query(
+            "org1", 0, "get", "ext2").strip() == "7")
