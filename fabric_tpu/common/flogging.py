@@ -132,16 +132,22 @@ class _CountingFilter(logging.Filter):
         super().__init__()
         self.written = 0
         self._counters: dict | None = None   # levelname -> counter
+        self._checked: dict | None = None    # hook-off approximation
+
+    @staticmethod
+    def _bump(cs: dict, levelname: str) -> None:
+        c = cs.get(levelname)
+        if c is None:
+            c = cs["_base"].with_labels("level", levelname)
+            cs[levelname] = c
+        c.add(1)
 
     def filter(self, record: logging.LogRecord) -> bool:
         self.written += 1
-        cs = self._counters
-        if cs is not None:
-            c = cs.get(record.levelname)
-            if c is None:
-                c = cs["_base"].with_labels("level", record.levelname)
-                cs[record.levelname] = c
-            c.add(1)
+        if self._counters is not None:
+            self._bump(self._counters, record.levelname)
+        if self._checked is not None:
+            self._bump(self._checked, record.levelname)
         return True
 
 
@@ -150,12 +156,19 @@ _checked_counters: dict | None = None
 _checked_patched = False
 
 
-def wire_logging_metrics(provider) -> None:
+def wire_logging_metrics(provider, count_checked=None) -> None:
     """Attach a metrics provider to the flogging observer (called by
-    node assembly once the operations metrics exist). entries_checked
-    counts every log call evaluated against the active level (a
-    process-wide `Logger.isEnabledFor` hook); entries_written counts
-    records actually emitted by the flogging handler."""
+    node assembly once the operations metrics exist). entries_written
+    counts records actually emitted by the flogging handler.
+
+    entries_checked (every log call evaluated against the active
+    level, including suppressed ones) needs a process-wide
+    `Logger.isEnabledFor` hook that taxes every suppressed debug call
+    in hot loops and leaks into third-party loggers — so it is OFF by
+    default and opt-in via count_checked=True or
+    FABRIC_TPU_LOG_CHECKED_METRIC=1 (round-4 advisor). When off, the
+    checked counter still registers (doc parity) and counts emitted
+    records only."""
     global _checked_counters, _checked_patched
     from fabric_tpu.common import metrics as _m
     checked = provider.new_counter(_m.CounterOpts(
@@ -168,22 +181,44 @@ def wire_logging_metrics(provider) -> None:
         label_names=("level",)))
     _log_counts._counters = {"_base": written}
     _checked_counters = {"_base": checked}
+    if count_checked is None:
+        count_checked = os.environ.get(
+            "FABRIC_TPU_LOG_CHECKED_METRIC", "0") == "1"
+    if not count_checked:
+        # cheap approximation without the global hook: a record that
+        # reaches the flogging handler was necessarily checked
+        _log_counts._checked = _checked_counters
+        return
     if not _checked_patched:
         _checked_patched = True
-        orig = logging.Logger.isEnabledFor
+        _orig_is_enabled_for[0] = logging.Logger.isEnabledFor
+        _names = {}                      # level int -> cached name
 
         def counting_is_enabled_for(self, level):
             cs = _checked_counters
             if cs is not None:
-                name = logging.getLevelName(level)
+                name = _names.get(level)
+                if name is None:
+                    name = _names[level] = logging.getLevelName(level)
                 c = cs.get(name)
                 if c is None:
                     c = cs["_base"].with_labels("level", name)
                     cs[name] = c
                 c.add(1)
-            return orig(self, level)
+            return _orig_is_enabled_for[0](self, level)
 
         logging.Logger.isEnabledFor = counting_is_enabled_for
+
+
+_orig_is_enabled_for: list = [None]
+
+
+def unwire_checked_hook() -> None:
+    """Restore the stock Logger.isEnabledFor (tests/shutdown)."""
+    global _checked_patched
+    if _checked_patched and _orig_is_enabled_for[0] is not None:
+        logging.Logger.isEnabledFor = _orig_is_enabled_for[0]
+        _checked_patched = False
 
 
 def _ensure_handler() -> logging.Handler:

@@ -205,8 +205,12 @@ def execute_query(statedb, ns: str, query: str,
     if sort_spec:
         out = _apply_sort(out, sort_spec, limit)
 
+    # bookmarks resume in KEY order, so they compose only with
+    # unsorted queries — under sort the scan plan suppresses them,
+    # matching the index plan (round-4 advisor: the two plans
+    # disagreed, and a sorted bookmark would skip/repeat documents)
     next_bookmark = out[-1][0] if out and page_size and \
-        len(out) == page_size else ""
+        len(out) == page_size and not sort_spec else ""
     return out, next_bookmark
 
 

@@ -622,6 +622,24 @@ def g2_to_bytes(q) -> bytes:
                     for c in (q[0][0], q[0][1], q[1][0], q[1][1]))
 
 
+def g2_in_subgroup(q) -> bool:
+    """Prime-order subgroup membership on the twist.
+
+    E'(Fp2) has a large cofactor, so on-curve alone admits
+    small-subgroup/invalid points — the classic verifier-facing
+    footgun on attacker-supplied G2 inputs (idemix PS presentations
+    deserialize commitment points). The reference's idemix pairing
+    stacks (amcl / gurvy) reject non-subgroup points at
+    deserialization; so does this one. Fast test (Galbraith–Scott):
+    psi(Q) == [6x^2]Q — the G2 eigenvalue of the twisted Frobenius is
+    t - 1 = 6x^2 for BN curves — a half-length scalar mul instead of
+    the full [r]Q == inf check (equivalence asserted in
+    tests/test_bn254.py)."""
+    if q is None:
+        return True
+    return g2_frobenius(q) == g2_mul_fast(6 * T_BN * T_BN, q)
+
+
 def g2_from_bytes(raw: bytes):
     if len(raw) != 128:
         raise ValueError("G2 point must be 128 bytes")
@@ -629,4 +647,6 @@ def g2_from_bytes(raw: bytes):
     q = ((vals[0], vals[1]), (vals[2], vals[3]))
     if not on_curve_g2(q):
         raise ValueError("G2 point not on twist curve")
+    if not g2_in_subgroup(q):
+        raise ValueError("G2 point not in the prime-order subgroup")
     return q

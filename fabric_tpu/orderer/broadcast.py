@@ -107,6 +107,11 @@ class BroadcastHandler:
         """One NORMAL-message run on one channel: batched filters, then
         one enqueue."""
         if support is None:
+            # metric coverage must match the unary process_message path
+            # (round-4 advisor: the two ingest paths disagreed here)
+            for _ in batch:
+                self._observe(self.metrics.processed_count, cid,
+                              "normal", common.Status.NOT_FOUND)
             return [ordpb.BroadcastResponse(
                 status=common.Status.NOT_FOUND,
                 info=f"channel {cid} not found")] * len(batch)

@@ -84,3 +84,50 @@ class TestPairing:
         q = (bn.G2_X, bn.G2_Y)
         assert bn.miller_loop(None, bn.G1) == bn.F12_ONE
         assert bn.miller_loop(q, None) == bn.F12_ONE
+
+
+class TestG2SubgroupCheck:
+    """Verifier-facing G2 deserialization must reject on-twist points
+    outside the prime-order subgroup (round-4 advisor, medium: the
+    invalid-point/small-subgroup footgun on idemix presentation
+    inputs; the reference's amcl/gurvy stacks reject these at
+    deserialization)."""
+
+    # on E'(Fp2) (checked below) but NOT in the order-R subgroup:
+    # x = 2 + u, y = sqrt(x^3 + 3/(9+u)), found by try-and-increment
+    NON_SUBGROUP = (
+        (2, 1),
+        (7292567877523311580221095596750716176434782432868683424513645834767876293070,
+         19659275751359636165940301690575149581329631496732780143538578556285923319774),
+    )
+
+    def test_point_is_on_twist_but_rejected(self):
+        q = self.NON_SUBGROUP
+        assert bn.on_curve_g2(q)
+        assert not bn.g2_in_subgroup(q)
+        with pytest.raises(ValueError, match="subgroup"):
+            bn.g2_from_bytes(bn.g2_to_bytes(q))
+
+    def test_subgroup_points_accepted(self):
+        g2 = (bn.G2_X, bn.G2_Y)
+        assert bn.g2_in_subgroup(g2)
+        assert bn.g2_in_subgroup(None)
+        q = bn.g2_mul_fast(987654321123456789, g2)
+        assert bn.g2_in_subgroup(q)
+        assert bn.g2_from_bytes(bn.g2_to_bytes(q)) == q
+
+    def test_frobenius_test_matches_full_order_test(self):
+        """psi(Q) == [6x^2]Q must agree with the unreduced [R]Q == inf
+        oracle (g2_mul_fast reduces k mod R, so the oracle is built
+        from adds)."""
+        def mul_nored(k, q):
+            acc = None
+            for bit in bin(k)[2:]:
+                acc = bn.g2_add_fast(acc, acc) if acc else None
+                if bit == "1":
+                    acc = bn.g2_add_fast(acc, q)
+            return acc
+
+        g2 = (bn.G2_X, bn.G2_Y)
+        for q in (g2, bn.g2_mul_fast(31337, g2), self.NON_SUBGROUP):
+            assert bn.g2_in_subgroup(q) == (mul_nored(bn.R, q) is None)
