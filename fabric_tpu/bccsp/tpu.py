@@ -1347,6 +1347,44 @@ class TPUProvider(api.BCCSP):
         # embedded sw provider; one implementation, not three
         return self._sw.pairing_check_batch(products)
 
+    def g2_msm_batch(self, lanes) -> list:
+        """Batched G2 multi-scalar multiplication on device: per lane,
+        sum_t k_t * Q_t over the BN254 twist (affine int points / None;
+        returns affine int points / None). One lax.scan of complete
+        RCB double/add steps over the scalar bit columns
+        (ops/bn254.py g2_msm_scan). Consumer: IdemixMSP PS
+        presentation verification — every credential's Schnorr K~
+        recombination and T~ subgroup check in one dispatch, where the
+        reference verifies each credential's proof serially on CPU
+        (vendored IBM/idemix). Small batches and device failures fall
+        back to the host Strauss MSM (bn254_ref.g2_msm)."""
+        from fabric_tpu.ops import bn254_ref as bref
+        if len(lanes) < max(2, self._min_batch // 8):
+            return [bref.g2_msm(lane) for lane in lanes]
+        try:
+            import jax
+
+            from fabric_tpu.ops import bn254 as bdev
+            nterms = len(lanes[0])
+            n = len(lanes)
+            bucket = 1
+            while bucket < n:
+                bucket *= 2
+            pad = [[(0, None)] * nterms] * (bucket - n)
+            bits, q_flat = bdev.stage_g2_msm(list(lanes) + pad)
+            key = ("g2msm", nterms, bucket)
+            if key not in self._qtab_fns:
+                self._qtab_fns[key] = jax.jit(bdev.g2_msm_scan)
+            import jax.numpy as jnp
+            out = self._qtab_fns[key](
+                jnp.asarray(bits), *[jnp.asarray(a) for a in q_flat])
+            return bdev.read_g2_msm(out)[:n]
+        except Exception:    # noqa: BLE001
+            self.stats["sw_fallbacks"] += 1
+            logger.exception("device g2 msm failed; host fallback for "
+                             "%d lanes", len(lanes))
+            return [bref.g2_msm(lane) for lane in lanes]
+
     def bls_verify_batch(self, pk_tw, msgs, sig_points) -> list[bool]:
         """Issuer-credential BLS verify: e(sig, G2)·e(H(m), -pk) == 1
         per lane. `sig_points` entries may be None (malformed) — those

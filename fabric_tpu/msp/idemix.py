@@ -334,6 +334,7 @@ class IdemixMSP(api.MSP):
         bls_idx, bls_digests, bls_sigs = [], [], []
         ec_idx, ec_items = [], []
         ps_idx, ps_products = [], []
+        ps_pending = []            # (i, pres, ou, role, msg)
         for i, ident in enumerate(identities):
             cred = ident.credential
             if getattr(ident, "presentation", None) is not None:
@@ -341,23 +342,19 @@ class IdemixMSP(api.MSP):
                     continue                  # no PS trust anchor
                 from fabric_tpu.msp import idemix_ps as ps
                 try:
+                    # subgroup test deferred: it batches on device
+                    # below with the Schnorr recombination
                     pres = ps.Presentation.from_proto(
-                        ident.presentation)
+                        ident.presentation, defer_subgroup=True)
                     msg = _presentation_msg(bytes(cred.nym_pub),
                                             cred.ou, cred.role)
-                    # host half: the Schnorr signature of knowledge
-                    if not ps.verify_schnorr(self._issuer_ps_pk, pres,
-                                             cred.ou, cred.role, msg):
+                    if not ps.schnorr_checks(pres):
                         continue
-                    lanes = ps.pairing_product(
-                        self._issuer_ps_pk, pres, cred.ou, cred.role)
                 except Exception:
                     # a hostile presentation must fail ITS lane, never
                     # poison the whole batch
                     continue
-                # device half: one pairing-product lane, batched below
-                ps_idx.append(i)
-                ps_products.append(lanes)
+                ps_pending.append((i, pres, cred.ou, cred.role, msg))
                 continue
             digest = _credential_digest(bytes(cred.nym_pub), cred.ou,
                                         cred.role)
@@ -377,6 +374,33 @@ class IdemixMSP(api.MSP):
                 ec_items.append(bapi.VerifyItem(
                     key=self._issuer_pub,
                     signature=bytes(cred.issuer_sig), digest=digest))
+        if ps_pending:
+            # ONE device dispatch recombines every presentation's
+            # Schnorr K~ AND runs every T~'s prime-order membership
+            # test ([6x^2]T~ vs host-cheap psi(T~)); the reference
+            # verifies each credential proof serially on CPU
+            from fabric_tpu.msp import idemix_ps as ps
+            from fabric_tpu.ops import bn254_ref as bref
+            lanes = []
+            for _i, pres, _ou, _role, _msg in ps_pending:
+                lanes.append(ps.schnorr_msm_lane(
+                    self._issuer_ps_pk, pres))
+                lanes.append(ps.subgroup_msm_lane(pres))
+            csp = self.csp
+            if hasattr(csp, "g2_msm_batch"):
+                msm = csp.g2_msm_batch(lanes)
+            else:
+                msm = [bref.g2_msm(lane) for lane in lanes]
+            for j, (i, pres, ou, role, msg) in enumerate(ps_pending):
+                K_t, sub = msm[2 * j], msm[2 * j + 1]
+                if sub != bref.g2_frobenius_fast(pres.T_t):
+                    continue          # T~ outside the r-subgroup
+                if not ps.verify_schnorr_prepared(
+                        self._issuer_ps_pk, pres, ou, role, msg, K_t):
+                    continue
+                ps_idx.append(i)
+                ps_products.append(ps.pairing_product(
+                    self._issuer_ps_pk, pres, ou, role))
         if ec_items:
             for i, ok in zip(ec_idx, self.csp.verify_batch(ec_items)):
                 out[i] = ok

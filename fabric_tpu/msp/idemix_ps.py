@@ -232,11 +232,18 @@ class Presentation:
             s_r=self.s_r.to_bytes(32, "big"))
 
     @classmethod
-    def from_proto(cls, p) -> "Presentation":
+    def from_proto(cls, p, defer_subgroup: bool = False
+                   ) -> "Presentation":
+        """defer_subgroup=True skips only T~'s prime-order membership
+        test (on-curve still enforced) — the MSP batch verifier runs
+        it on device alongside the Schnorr recombination
+        (subgroup_msm_lane); NEVER defer without that companion
+        check."""
         return cls(
             sigma1=b.g1_from_bytes(bytes(p.sigma1)),
             sigma2=b.g1_from_bytes(bytes(p.sigma2)),
-            T_t=b.g2_from_bytes(bytes(p.t_commit)),
+            T_t=b.g2_from_bytes(bytes(p.t_commit),
+                                subgroup_check=not defer_subgroup),
             c=int.from_bytes(bytes(p.c), "big"),
             s_sk=int.from_bytes(bytes(p.s_sk), "big"),
             s_r=int.from_bytes(bytes(p.s_r), "big"))
@@ -267,24 +274,55 @@ def present(pk: PSPublicKey, sigma: tuple[tuple, tuple], m_sk: int,
                         s_r=(k2 + c * r) % R)
 
 
-def verify_schnorr(pk: PSPublicKey, pres: Presentation, ou: str,
-                   role: int, msg: bytes) -> bool:
-    """The host half of verification: the Schnorr signature of
-    knowledge. The pairing half is `pairing_product` below."""
+def schnorr_checks(pres: Presentation) -> bool:
+    """Structural gates before any expensive math."""
     if pres.sigma1 is None or pres.sigma1 == (0, 0):
         return False
     if not (b.on_curve_g1(pres.sigma1) and b.on_curve_g1(pres.sigma2)
             and b.on_curve_g2(pres.T_t)):
         return False
-    if not (0 < pres.c < R and 0 <= pres.s_sk < R
-            and 0 <= pres.s_r < R):
-        return False
-    lhs = b.g2_add_fast(b.g2_mul_fast(pres.s_sk, pk.Y_sk_t),
-                   b.g2_mul_fast(pres.s_r, G2T))
-    K_t = b.g2_add_fast(lhs, b.g2_mul_fast((R - pres.c) % R, pres.T_t))
+    return (0 < pres.c < R and 0 <= pres.s_sk < R
+            and 0 <= pres.s_r < R)
+
+
+def schnorr_msm_lane(pk: PSPublicKey, pres: Presentation) -> list:
+    """The 3-term G2 MSM whose result is the recombined commitment
+    K~ = s_sk*Y~ + s_r*G~ - c*T~ — batchable across presentations on
+    device (TPUProvider.g2_msm_batch)."""
+    return [(pres.s_sk, pk.Y_sk_t), (pres.s_r, G2T),
+            ((R - pres.c) % R, pres.T_t)]
+
+
+def subgroup_msm_lane(pres: Presentation) -> list:
+    """[6x^2]T~ as a 3-term lane (zero-padded): with the host-cheap
+    psi(T~) compare this is the prime-order membership test
+    (bn254_ref.g2_in_subgroup), batched on device for deferred
+    deserializations."""
+    return [(6 * b.T_BN * b.T_BN, pres.T_t), (0, None), (0, None)]
+
+
+def verify_schnorr_prepared(pk: PSPublicKey, pres: Presentation,
+                            ou: str, role: int, msg: bytes,
+                            K_t) -> bool:
+    """Finish half: the challenge-hash compare, given the recombined
+    K~ (from the batched device MSM or the host Strauss MSM)."""
     c = _challenge(pk, pres.sigma1, pres.sigma2, pres.T_t, K_t, ou,
                    role, msg)
     return c == pres.c
+
+
+def verify_schnorr(pk: PSPublicKey, pres: Presentation, ou: str,
+                   role: int, msg: bytes) -> bool:
+    """The host half of verification: the Schnorr signature of
+    knowledge. The pairing half is `pairing_product` below. (Single
+    presentation; the MSP batches the MSM across presentations via
+    schnorr_msm_lane + verify_schnorr_prepared.)"""
+    if not schnorr_checks(pres):
+        return False
+    # one interleaved 3-term MSM (shared doublings) instead of three
+    # independent ladders — the host half's measured hot spot
+    K_t = b.g2_msm(schnorr_msm_lane(pk, pres))
+    return verify_schnorr_prepared(pk, pres, ou, role, msg, K_t)
 
 
 def pairing_product(pk: PSPublicKey, pres: Presentation, ou: str,

@@ -622,6 +622,76 @@ def g2_to_bytes(q) -> bytes:
                     for c in (q[0][0], q[0][1], q[1][0], q[1][1]))
 
 
+def g2_msm(pairs, window: int = 4):
+    """Host Strauss/interleaved multi-scalar multiplication on the
+    twist: sum_i k_i * Q_i with SHARED doublings and per-base
+    2^window-entry tables — ~2.5x fewer field ops than independent
+    ladders for the 3-term Schnorr verification combination
+    (idemix_ps.verify_schnorr). pairs: [(k, Q_affine|None), ...]."""
+    fadd, fsub, fmul, z = _fp2_ops()
+    one = (1, 0)
+    tabs = []
+    for k, q in pairs:
+        k %= R
+        if k == 0 or q is None:
+            tabs.append(None)
+            continue
+        # table[j] = j*Q in Jacobian, j in 1..2^w-1
+        tab = [None] * (1 << window)
+        tab[1] = (q[0], q[1], one)
+        for j in range(2, 1 << window):
+            tab[j] = _jac_add_full(tab[j - 1], tab[1], fadd, fsub,
+                                   fmul, z)
+        tabs.append((k, tab))
+    acc = None
+    nwin = (256 + window - 1) // window
+    for w in reversed(range(nwin)):
+        if acc is not None:
+            for _ in range(window):
+                acc = _jac_dbl(*acc, fadd, fsub, fmul, z)
+        for entry in tabs:
+            if entry is None:
+                continue
+            k, tab = entry
+            d = (k >> (w * window)) & ((1 << window) - 1)
+            if d:
+                acc = _jac_add_full(acc, tab[d], fadd, fsub, fmul, z) \
+                    if acc is not None else tab[d]
+    return _fp2_jac_to_affine(acc)
+
+
+def f2_conj(a):
+    return (a[0], (-a[1]) % P)
+
+
+_PSI_COEF: list = []
+
+
+def g2_frobenius_fast(q):
+    """psi on the twist via the standard coordinate form
+    psi(x, y) = (c_x * conj(x), c_y * conj(y)) — two Fp2 muls instead
+    of the untwist round trip through Fp12. The coefficients are
+    SELF-CALIBRATED against the exact `g2_frobenius` on two
+    independent points at first use (falls back to the exact map if
+    the curve convention ever changes)."""
+    if q is None:
+        return None
+    if not _PSI_COEF:
+        g2 = (G2_X, G2_Y)
+        exact = g2_frobenius(g2)
+        cx = f2_mul(exact[0], f2_inv(f2_conj(G2_X)))
+        cy = f2_mul(exact[1], f2_inv(f2_conj(G2_Y)))
+        probe = g2_mul_fast(123457, g2)
+        ok = (f2_mul(cx, f2_conj(probe[0])),
+              f2_mul(cy, f2_conj(probe[1]))) == g2_frobenius(probe)
+        _PSI_COEF.append((cx, cy) if ok else None)
+    coef = _PSI_COEF[0]
+    if coef is None:
+        return g2_frobenius(q)
+    return (f2_mul(coef[0], f2_conj(q[0])),
+            f2_mul(coef[1], f2_conj(q[1])))
+
+
 def g2_in_subgroup(q) -> bool:
     """Prime-order subgroup membership on the twist.
 
@@ -637,16 +707,19 @@ def g2_in_subgroup(q) -> bool:
     tests/test_bn254.py)."""
     if q is None:
         return True
-    return g2_frobenius(q) == g2_mul_fast(6 * T_BN * T_BN, q)
+    return g2_frobenius_fast(q) == g2_msm([(6 * T_BN * T_BN, q)])
 
 
-def g2_from_bytes(raw: bytes):
+def g2_from_bytes(raw: bytes, subgroup_check: bool = True):
+    """subgroup_check=False defers the prime-order membership test to
+    the caller (the idemix MSP batches it on device with the Schnorr
+    recombinations) — the on-curve check always runs."""
     if len(raw) != 128:
         raise ValueError("G2 point must be 128 bytes")
     vals = [int.from_bytes(raw[i:i + 32], "big") for i in range(0, 128, 32)]
     q = ((vals[0], vals[1]), (vals[2], vals[3]))
     if not on_curve_g2(q):
         raise ValueError("G2 point not on twist curve")
-    if not g2_in_subgroup(q):
+    if subgroup_check and not g2_in_subgroup(q):
         raise ValueError("G2 point not in the prime-order subgroup")
     return q
