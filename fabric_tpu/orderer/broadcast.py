@@ -206,6 +206,19 @@ class BroadcastHandler:
                           "empty channel id")
         support = self._registrar.get_chain(ch.channel_id)
         if support is None:
+            if msgprocessor.classify(ch) == msgprocessor.CONFIG_UPDATE:
+                # the reference's system channel would treat this as
+                # channel CREATION (msgprocessor/systemchannel.go);
+                # this orderer is system-channel-free (the Fabric 3.x
+                # direction) — surface the supported path explicitly
+                # instead of a bare not-found
+                return reject(
+                    ch.channel_id, common.Status.NOT_FOUND,
+                    f"channel {ch.channel_id} does not exist, and "
+                    "channel creation via broadcast config update "
+                    "requires a system channel, which this orderer "
+                    "does not serve; create the channel through the "
+                    "participation API (osnadmin channel join)")
             return reject(ch.channel_id, common.Status.NOT_FOUND,
                           f"channel {ch.channel_id} not found")
         if support.chain.errored():

@@ -172,3 +172,24 @@ def test_batched_filter_equals_single(net):
     batched = [r.status for r in broadcast.process_messages(envs)]
     single = [broadcast.process_message(e).status for e in envs]
     assert batched == single
+
+
+def test_channel_creation_config_update_gets_explicit_guidance(net):
+    """A CONFIG_UPDATE for a nonexistent channel is the reference's
+    system-channel channel-creation flow
+    (orderer/common/msgprocessor/systemchannel.go). This orderer is
+    system-channel-free: the rejection must say so and point at the
+    participation API, not a bare not-found (round-4 verdict #4)."""
+    registrar, broadcast, _endorse, _peer = net
+    ch = pu.make_channel_header(cpb.HeaderType.CONFIG_UPDATE,
+                                "newchannel", tx_id="create1")
+    sh = cpb.SignatureHeader(creator=b"c", nonce=b"n")
+    pay = pu.make_payload(ch, sh, b"config-update-bytes")
+    env = cpb.Envelope(payload=pu.marshal(pay), signature=b"s")
+    resp = broadcast.process_message(env)
+    assert resp.status == cpb.Status.NOT_FOUND
+    assert "system channel" in resp.info
+    assert "osnadmin channel join" in resp.info
+    # the batched ingest path agrees
+    resp2 = broadcast.process_messages([env])[0]
+    assert resp2.status == cpb.Status.NOT_FOUND
