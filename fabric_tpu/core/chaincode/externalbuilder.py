@@ -132,6 +132,16 @@ class ExternalBuilderRegistry:
         for k in b.propagate_environment:
             if k in os.environ:
                 env[k] = os.environ[k]
+        if b.name == "ftpu-python":
+            # the built-in platform's run script hosts the chaincode
+            # with the framework's own shim/server modules: make THIS
+            # process's fabric_tpu importable in the child regardless
+            # of how the peer itself was launched
+            import fabric_tpu
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(fabric_tpu.__file__)))
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in (env.get("PYTHONPATH", ""), pkg_root) if p)
         return env
 
     def _exec(self, b: BuilderConfig, phase: str, args: list,
@@ -266,9 +276,30 @@ class ExternalBuilderRegistry:
                                  process=process, build_dir=bld)
 
 
+def builtin_python_builder() -> BuilderConfig:
+    """The framework's built-in python platform (the role the docker
+    controller + core/chaincode/platforms play in the reference:
+    arbitrary source tree → running chaincode process with ZERO
+    operator-provided builders — here daemon-free, as a subprocess
+    hosting ChaincodeServer). Last in detection order, so operator
+    builders always win."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return BuilderConfig(
+        name="ftpu-python",
+        path=os.path.join(here, "builtin_builder"),
+        # the run script imports fabric_tpu + jax-free shim modules
+        propagate_environment=["PYTHONPATH", "HOME", "LANG",
+                               "JAX_PLATFORMS",
+                               "PALLAS_AXON_POOL_IPS"])
+
+
 def registry_from_config(cfg: dict, build_root: str
                          ) -> ExternalBuilderRegistry:
-    """core.yaml `chaincode.externalBuilders` → registry."""
+    """core.yaml `chaincode.externalBuilders` → registry, plus the
+    built-in python platform (disable with
+    `chaincode.disableBuiltinPlatform: true`)."""
     builders = [BuilderConfig.from_config(b)
                 for b in (cfg or {}).get("externalBuilders", [])]
+    if not (cfg or {}).get("disableBuiltinPlatform"):
+        builders.append(builtin_python_builder())
     return ExternalBuilderRegistry(builders, build_root)

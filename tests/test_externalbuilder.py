@@ -195,5 +195,84 @@ class TestConfig:
                  "PropagateEnvironment": ["HOME"]},
                 {"name": "b2", "path": "/opt/b2"},
             ]}, str(tmp_path / "w"))
-        assert [b.name for b in reg._builders] == ["b1", "b2"]
+        assert [b.name for b in reg._builders] == [
+            "b1", "b2", "ftpu-python"]   # built-in platform appended
         assert reg._builders[0].propagate_environment == ("HOME",)
+
+
+class TestBuiltinPythonPlatform:
+    """The built-in python platform: an arbitrary chaincode SOURCE
+    TREE runs as a process with zero operator-provided builders — the
+    role core/chaincode/platforms + the docker controller play in the
+    reference, daemon-free (round-4 missing #3)."""
+
+    SRC = textwrap.dedent("""
+        from fabric_tpu.core.chaincode import shim
+
+        class Counter(shim.Chaincode):
+            def init(self, stub):
+                return shim.success()
+
+            def invoke(self, stub):
+                fn, params = stub.get_function_and_parameters()
+                if fn == "put" and len(params) >= 2:
+                    stub.put_state(params[0], params[1].encode())
+                    return shim.success(b"stored")
+                return shim.success(b"pong")
+    """)
+
+    def test_source_tree_to_running_process(self, tmp_path):
+        pkg = write_package(
+            str(tmp_path / "pycc.tgz"),
+            {"type": "python", "label": "pycc_1.0"},
+            {"main.py": self.SRC.encode()})
+        reg = registry_from_config({}, str(tmp_path / "bld"))
+        support = ChaincodeSupport(channel_source=lambda cid: None)
+        launched = reg.launch("pycc", pkg, support,
+                              connect_timeout_s=30.0)
+        try:
+            assert launched.process is not None
+            assert launched.process.poll() is None
+            resp = _invoke(support, "pycc", b"get")
+            assert resp.status == 200
+        finally:
+            launched.stop()
+
+    def test_operator_builders_win_detection(self, tmp_path):
+        """An operator builder claiming type "python" outranks the
+        built-in platform (reference ordering: externalBuilders before
+        built-in platforms)."""
+        b = _mk_builder(tmp_path, "opbuilder", claim_type="python")
+        reg = ExternalBuilderRegistry(
+            [b], str(tmp_path / "bld"))
+        # append the builtin AFTER, as registry_from_config does
+        from fabric_tpu.core.chaincode.externalbuilder import (
+            builtin_python_builder,
+        )
+        reg2 = ExternalBuilderRegistry(
+            [b, builtin_python_builder()], str(tmp_path / "bld2"))
+        src = tmp_path / "src"
+        meta = tmp_path / "meta"
+        src.mkdir(); meta.mkdir()
+        (meta / "metadata.json").write_text(
+            json.dumps({"type": "python", "label": "x"}))
+        assert reg2.detect(str(src), str(meta)).name == "opbuilder"
+
+    def test_bad_source_fails_at_build(self, tmp_path):
+        pkg = write_package(
+            str(tmp_path / "bad.tgz"),
+            {"type": "python", "label": "bad_1.0"},
+            {"main.py": b"def broken(:\n"})
+        reg = registry_from_config({}, str(tmp_path / "bld"))
+        support = ChaincodeSupport(channel_source=lambda cid: None)
+        with pytest.raises(BuildError, match="build failed|parse"):
+            reg.launch("badcc", pkg, support)
+
+    def test_builtin_can_be_disabled(self, tmp_path):
+        reg = registry_from_config(
+            {"disableBuiltinPlatform": True}, str(tmp_path / "bld"))
+        src = tmp_path / "s"; meta = tmp_path / "m"
+        src.mkdir(); meta.mkdir()
+        (meta / "metadata.json").write_text(
+            json.dumps({"type": "python", "label": "x"}))
+        assert reg.detect(str(src), str(meta)) is None
