@@ -189,6 +189,65 @@ class TestShardedComb:
                  jax.device_put(np.zeros(B, bool), s_))
         assert np.asarray(out).tolist() == [False] * B
 
+    def test_shardmap_q16_real_tables_match_oracle(self, mesh8):
+        """Round-4 verdict #4: REAL 16-bit table contents sharded over
+        8 devices must reproduce the oracle bits for a mixed
+        valid/invalid batch — the zero-table gate above only proves
+        compile+execute. Private scalar 1 makes Q == G, so the real
+        8-bit Q table is the host G-table CONSTANT and the real
+        16-bit table builds in ONE vectorized device pass (feasible
+        on the CPU mesh; same builder, same layout as the provider's
+        multi-minute production build)."""
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from fabric_tpu.ops import comb
+        from fabric_tpu.parallel import BATCH_AXIS, shardmap_comb_verify
+
+        priv = ec.derive_private_key(1, ec.SECP256R1())
+        B = 16
+        words = np.zeros((B, 8), np.uint32)
+        rs, rpns, ws, premask, want = [], [], [], [], []
+        for i in range(B):
+            msg = f"q16 real lane {i}".encode()
+            der = priv.sign(msg, ec.ECDSA(hashes.SHA256()))
+            r, s = decode_dss_signature(der)
+            words[i] = np.frombuffer(
+                hashlib.sha256(msg).digest(), dtype=">u4")
+            ok = True
+            if i % 4 == 1:                      # tampered r
+                r = (r * 7) % p256.N or 1
+                ok = False
+            elif i % 4 == 2:                    # tampered digest
+                words[i] = np.frombuffer(
+                    hashlib.sha256(b"swapped").digest(), dtype=">u4")
+                ok = False
+            pm = i % 4 != 3                     # parse-failed lane
+            premask.append(pm)
+            want.append(ok and pm)
+            rs.append(r)
+            ws.append(pow(s, -1, p256.N))
+            rpns.append(r + p256.N if r + p256.N < p256.P else r)
+
+        q8 = jnp.asarray(comb.g_tables())       # REAL table for Q == G
+        q_flat = jax.jit(comb.build_q16_tables,
+                         static_argnums=1)(q8, 1)
+        g16 = comb.g16_tables()
+        rep = NamedSharding(mesh8, P())
+        s_ = NamedSharding(mesh8, P(BATCH_AXIS))
+        fn = shardmap_comb_verify(mesh8, q16=True, tree="xla")
+        out = fn(jax.device_put(words, s_),
+                 jax.device_put(np.zeros(B, np.int32), s_),
+                 jax.device_put(q_flat, rep),
+                 jax.device_put(jnp.asarray(g16), rep),
+                 jax.device_put(limb.ints_to_limbs(rs), s_),
+                 jax.device_put(limb.ints_to_limbs(rpns), s_),
+                 jax.device_put(limb.ints_to_limbs(ws), s_),
+                 jax.device_put(np.asarray(premask), s_))
+        out = np.asarray(out)
+        assert out.tolist() == want
+        assert any(want) and not all(want)
+
     def test_mesh_provider_verify_prepared(self, mesh8):
         """TPUProvider with a mesh: the prepared-array entry compiles
         the shard_map comb pipeline and matches the sw oracle."""
