@@ -115,6 +115,7 @@ def bench_idemix(prov) -> dict:
         "creds_per_s": round(n / steady, 1),
         "warm_s": round(warm_s, 2),
         "steady_s": round(steady, 4),
+        "steady_phase_s": getattr(msp, "last_batch_timings", None),
         "host_single_thread_ms_per_cred":
             round(host_per_cred * 1e3, 1),
         "host_ideal_creds_per_s": round(host_ideal, 1),
@@ -462,7 +463,7 @@ def _restart_child(mode, warm_dir):
             "Default": "TPU",
             "TPU": {"MinBatch": 16, "Chunk": CHUNK,
                     "WarmKeysDir": warm_dir}}))
-        prov.prewarm(buckets=(CHUNK,))
+        prov.prewarm(buckets=(CHUNK,), wait_restore=True)
         items = _signed_batch(prov, privs, 4096, rng)
         t0 = time.perf_counter()
         ok = prov.verify_batch(items)
@@ -499,6 +500,13 @@ def _restart_child(mode, warm_dir):
             "TPU": {"MinBatch": 16, "Chunk": CHUNK,
                     "WarmKeysDir": warm_dir}}))
         t_ctor = time.perf_counter()
+        # prewarm phases timed so the restart cost is attributable:
+        # g16 device build, then table restore (disk + tunnel H2D)
+        # OVERLAPPED with the AOT pipeline compiles inside prewarm()
+        from fabric_tpu.ops import comb as _comb
+        _comb.g16_tables()
+        t_g16 = time.perf_counter()
+        t_tabs = t_g16
         prov.prewarm(buckets=(CHUNK,))
         t_pw = time.perf_counter()
         keys = [prov.key_import(p.public_key(),
@@ -509,14 +517,32 @@ def _restart_child(mode, warm_dir):
                  for i, (m, sig) in enumerate(pre)]
         ok = prov.verify_batch(items)
         t1 = time.perf_counter()
+        served_8bit = prov.stats["q16_loading_skips"] > 0
+        # time-to-flagship: when the background q16 restore lands and
+        # a batch runs on the 16-bit path again
+        prov.flush_warm_tables(timeout=1200)
+        ok2 = prov.verify_batch(items)
+        t2 = time.perf_counter()
         out.update({
-            "ok": bool(all(ok)),
+            "ok": bool(all(ok)) and bool(all(ok2)),
             "restart_to_first_validated_s": round(t1 - t0, 2),
+            "first_batch_path": ("8-bit (availability window: q16 "
+                                 "restore still streaming)"
+                                 if served_8bit else "16-bit"),
+            "flagship_restored_s": round(t2 - t0, 2),
             "ctor_s": round(t_ctor - t0, 2),
+            "g16_build_s": round(t_g16 - t_ctor, 2),
+            "aot_s": round(t_pw - t_tabs, 2),
             "prewarm_s": round(t_pw - t_ctor, 2),
+            "note": ("first-validated rides the 8-bit path while the "
+                     "~GB q16 table streams back over the device "
+                     "tunnel (single-digit MB/s here; sub-second per "
+                     "GB on a host-attached TPU)"),
             "first_batch_s": round(t1 - t_pw, 2),
             "batch": CHUNK,
             "q16_disk_loads": prov.stats["q16_disk_loads"],
+            "q8_disk_loads": prov.stats["q8_disk_loads"],
+            "q16_loading_skips": prov.stats["q16_loading_skips"],
             "q16_builds": prov.stats["q16_builds"],
         })
     print(json.dumps(out))
@@ -593,7 +619,10 @@ def main():
                 "WarmKeysDir": warm_dir},
     }))
     t0 = time.perf_counter()
-    prov.prewarm(buckets=(4096, CHUNK))
+    # wait_restore: the HEADLINE sections must measure the fully-warm
+    # flagship path; the availability-first restore window is the
+    # restart child's measurement, not this one's
+    prov.prewarm(buckets=(4096, CHUNK), wait_restore=True)
     prewarm_s = time.perf_counter() - t0
 
     # --- workload: NKEYS org keys, `batch` signed messages. Reuse

@@ -101,6 +101,13 @@ class TPUProvider(api.BCCSP):
         # sets (org key rotation, channel churn) pin the whole byte
         # budget and deny the live working set the flagship path.
         self._q16_prewarmed: set = set()
+        # sets the BACKGROUND restore thread is still streaming to the
+        # device: live misses must NOT block on the (tunnel-bound,
+        # ~minutes for a GB-scale table) load — they ride the 8-bit
+        # path until the restore lands, restoring availability-first
+        # semantics (reference peers validate immediately on start)
+        self._q16_loading: set = set()
+        self._restore_thread = None
         self._fn = None             # lazily-built generic jitted pipeline
         self._comb_fns = {}         # (K, q16) -> jitted comb pipeline
         self._qtab_fns = {}         # K -> jitted table builder
@@ -113,7 +120,8 @@ class TPUProvider(api.BCCSP):
                       "q16_builds": 0, "q16_evictions": 0,
                       "q16_oversize_skips": 0, "q16_cache_bytes": 0,
                       "q16_adaptive_skips": 0, "q16_resident_sets": 0,
-                      "q16_disk_loads": 0,
+                      "q16_disk_loads": 0, "q8_disk_loads": 0,
+                      "q16_loading_skips": 0,
                       "nonp256_sw_lanes": 0}
         self._persist_threads: list = []
         # serializes warm-file mutations (record/trim/drop) with the
@@ -708,16 +716,30 @@ class TPUProvider(api.BCCSP):
                 # the warm file so the next restart skips the rebuild
                 self._q16_prewarmed.discard(victim)
                 self._drop_warm_keys(victim)
+        if not prewarm and cache_key in self._q16_loading:
+            # the background restore is still streaming this set's
+            # table to the device: serve the batch on the 8-bit path
+            # NOW rather than stalling validation on a minutes-scale
+            # transfer (availability first — the q16 path takes over
+            # the moment the restore lands)
+            self.stats["q16_loading_skips"] += 1
+            return None
         if preloaded is None and self._warm_keys_dir:
             # persisted bytes serve BOTH prewarm and live misses: a
-            # set evicted from RAM but still on disk re-enters in
-            # seconds (disk read + H2D) instead of the multi-minute
-            # device rebuild. Loaded only now — after the budget and
-            # denial gates — so over-budget sets never touch the disk.
+            # set evicted from RAM but still on disk re-enters via a
+            # disk read + H2D instead of the multi-minute device
+            # rebuild. Loaded only now — after the budget and denial
+            # gates — so over-budget sets never touch the disk.
             preloaded = self._load_q16_table(cache_key, K)
         if preloaded is not None:
             import jax.numpy as jnp
             q_flat = jnp.asarray(preloaded)
+            if prewarm:
+                # the restore thread owns this H2D: block HERE (in the
+                # background) so the table is genuinely device-resident
+                # before the loading marker clears
+                import jax
+                jax.block_until_ready(q_flat)
             self.stats["q16_disk_loads"] += 1
         else:
             q_flat = self._build_q16_table(cache_key, K, qx_k, qy_k)
@@ -738,6 +760,10 @@ class TPUProvider(api.BCCSP):
     def _build_q16_table(self, cache_key, K, qx_k, qy_k):
         import jax.numpy as jnp
         q8 = self._qtab_fn(K)(jnp.asarray(qx_k), jnp.asarray(qy_k))
+        # persist the small 8-bit table too: it is the availability
+        # path a restarted node serves on while this set's 16-bit
+        # bytes stream back to the device
+        self._persist_q8_table(cache_key, q8)
         q_flat = self._q16_fn(K)(q8, K)
         self.stats["q16_builds"] += 1
         return q_flat
@@ -774,10 +800,11 @@ class TPUProvider(api.BCCSP):
                     # (~252*K MB); without this a long-lived node
                     # orphans one file per rotated-out key set
                     try:
-                        tab = self._table_path(
-                            tuple(bytes.fromhex(k) for k in old))
-                        if os.path.exists(tab):
-                            os.remove(tab)
+                        okey = tuple(bytes.fromhex(k) for k in old)
+                        for prefix in ("qtab16", "qtab8"):
+                            tab = self._table_path(okey, prefix)
+                            if os.path.exists(tab):
+                                os.remove(tab)
                     except Exception:
                         logger.exception("could not reclaim trimmed "
                                          "warm table")
@@ -802,9 +829,10 @@ class TPUProvider(api.BCCSP):
                     with open(tmp, "w") as f:
                         json.dump(sets, f)
                     os.replace(tmp, path)
-                tab = self._table_path(cache_key)
-                if os.path.exists(tab):
-                    os.remove(tab)       # reclaim ~252*K MB of disk
+                for prefix in ("qtab16", "qtab8"):
+                    tab = self._table_path(cache_key, prefix)
+                    if os.path.exists(tab):
+                        os.remove(tab)   # reclaim ~252*K MB of disk
         except Exception:
             logger.exception("could not drop stale warm key set")
 
@@ -817,16 +845,19 @@ class TPUProvider(api.BCCSP):
     #    the reference's on-disk MSP/ledger warm state; there is no
     #    reference analog because CPU verify has no precompute.
 
-    def _table_path(self, cache_key) -> str:
+    def _table_path(self, cache_key, prefix: str = "qtab16") -> str:
         import hashlib
-        from fabric_tpu.ops import comb
         h = hashlib.sha256(b"".join(cache_key)).hexdigest()[:32]
         return os.path.join(self._warm_keys_dir,
-                            f"qtab{comb.NWIN_G16}_{h}.npy")
+                            f"{prefix}_{h}.npy")
 
-    def _persist_q16_table(self, cache_key, q_flat) -> None:
-        """Write the built table bytes in a background thread (the
-        serving path must not block on a ~GB transfer + write)."""
+    def _q8_est_bytes(self, K: int) -> int:
+        from fabric_tpu.ops import comb, limb
+        return comb.NWIN * K * comb.NENT * 3 * limb.L * 4
+
+    def _persist_table(self, cache_key, q_flat, prefix: str) -> None:
+        """Write built table bytes in a background thread (the serving
+        path must not block on a transfer + write)."""
         if not self._warm_keys_dir:
             return
 
@@ -834,7 +865,7 @@ class TPUProvider(api.BCCSP):
             try:
                 arr = np.asarray(q_flat)
                 os.makedirs(self._warm_keys_dir, exist_ok=True)
-                path = self._table_path(cache_key)
+                path = self._table_path(cache_key, prefix)
                 tmp = path + ".tmp"
                 with open(tmp, "wb") as f:
                     np.save(f, arr)
@@ -850,38 +881,60 @@ class TPUProvider(api.BCCSP):
                     if entry not in self._load_warm_keys():
                         os.remove(path)
             except Exception:
-                logger.exception("could not persist q16 table bytes")
+                logger.exception("could not persist %s table bytes",
+                                 prefix)
 
         t = threading.Thread(target=work, daemon=True,
-                             name="q16-table-persist")
+                             name=f"{prefix}-table-persist")
         self._persist_threads.append(t)
         t.start()
 
+    def _persist_q16_table(self, cache_key, q_flat) -> None:
+        self._persist_table(cache_key, q_flat, "qtab16")
+
+    def _persist_q8_table(self, cache_key, q8) -> None:
+        # ~2 MB per key slot: makes the 8-bit availability path (the
+        # one serving blocks while the big q16 table streams in)
+        # restorable in roughly a second
+        self._persist_table(cache_key, q8, "qtab8")
+
     def flush_warm_tables(self, timeout: float = 120.0) -> None:
-        """Join outstanding table-persist writers (shutdown/bench)."""
+        """Join outstanding table-persist writers and the background
+        restore (shutdown/bench)."""
+        if self._restore_thread is not None:
+            self._restore_thread.join(timeout)
         for t in self._persist_threads:
             t.join(timeout)
         self._persist_threads = [
             t for t in self._persist_threads if t.is_alive()]
 
-    def _load_q16_table(self, cache_key, K):
-        """np.load persisted table bytes; None on any mismatch."""
-        path = self._table_path(cache_key)
+    def _load_table(self, cache_key, want_bytes: int, prefix: str):
+        if not self._warm_keys_dir:
+            return None
+        path = self._table_path(cache_key, prefix)
         try:
             arr = np.load(path)
         except FileNotFoundError:
             return None
         except Exception:
-            logger.exception("unreadable persisted q16 table; "
-                             "rebuilding")
+            logger.exception("unreadable persisted %s table; "
+                             "rebuilding", prefix)
             return None
-        if arr.dtype != np.int32 or arr.nbytes != self._q16_est_bytes(K):
+        if arr.dtype != np.int32 or arr.nbytes != want_bytes:
             logger.warning(
-                "persisted q16 table %s is %d bytes (%s), want %d; "
-                "rebuilding", path, arr.nbytes, arr.dtype,
-                self._q16_est_bytes(K))
+                "persisted %s table %s is %d bytes (%s), want %d; "
+                "rebuilding", prefix, path, arr.nbytes, arr.dtype,
+                want_bytes)
             return None
         return arr
+
+    def _load_q16_table(self, cache_key, K):
+        return self._load_table(cache_key, self._q16_est_bytes(K),
+                                "qtab16")
+
+    def _load_q8_table(self, cache_key, K):
+        return self._load_table(cache_key, self._q8_est_bytes(K),
+                                "qtab8")
 
     def _load_warm_keys(self) -> list:
         if not self._warm_keys_dir:
@@ -903,47 +956,56 @@ class TPUProvider(api.BCCSP):
 
     def _prewarm_tables(self) -> int:
         """Restore the Q tables for persisted key sets, MRU-first,
-        until the byte budget is full: from persisted table BYTES when
-        present (disk read + H2D, seconds — _q16_cached loads them
-        after its budget gate), else a device rebuild (minutes).
-        Returns sets warmed."""
+        until the byte budget is full, from persisted table BYTES only
+        (no device rebuilds at startup: a live miss builds on demand).
+        Runs in prewarm()'s background restore thread on a node; each
+        set carries a `_q16_loading` marker so concurrent live batches
+        ride the 8-bit path instead of blocking on the (tunnel-bound)
+        H2D. Returns sets warmed."""
         from fabric_tpu.ops import limb
         sets = self._load_warm_keys()      # MRU first
-        warmed = 0
+        candidates = []
         for entry in sets:
-            try:
-                order = [bytes.fromhex(k) for k in entry]
-                cache_key = tuple(order)
-                if not os.path.exists(self._table_path(cache_key)):
-                    # no persisted bytes: do NOT burn a multi-minute
-                    # device build at startup for a possibly-stale
-                    # set — a live miss will build (and persist) it
-                    # on demand
-                    continue
-                K = 1
-                while K < len(order):
-                    K *= 2
-                qk = np.zeros((K, 64), dtype=np.uint8)
-                for i, kb in enumerate(order):
-                    qk[i] = np.frombuffer(kb, dtype=np.uint8)
-                if self._q16_cached(
+            order = [bytes.fromhex(k) for k in entry]
+            cache_key = tuple(order)
+            if os.path.exists(self._table_path(cache_key)):
+                candidates.append((cache_key, order))
+                self._q16_loading.add(cache_key)
+        warmed = 0
+        try:
+            for cache_key, order in candidates:
+                try:
+                    K = 1
+                    while K < len(order):
+                        K *= 2
+                    qk = np.zeros((K, 64), dtype=np.uint8)
+                    for i, kb in enumerate(order):
+                        qk[i] = np.frombuffer(kb, dtype=np.uint8)
+                    got = self._q16_cached(
                         cache_key, K,
                         limb.be_bytes_to_limbs(qk[:, :32]),
                         limb.be_bytes_to_limbs(qk[:, 32:]),
-                        prewarm=True) is not None:
-                    warmed += 1
-                elif self._qflat_cache_bytes and \
-                        self._q16_est_bytes(K) + self._qflat_cache_bytes \
-                        > self._table_cache_bytes:
-                    # budget full: the remaining (older) sets stay on
-                    # disk, untouched, for live misses to stream in
-                    break
-            except Exception:
-                logger.exception("warm table build failed for one set")
+                        prewarm=True)
+                    if got is not None:
+                        warmed += 1
+                    elif self._qflat_cache_bytes and \
+                            self._q16_est_bytes(K) + \
+                            self._qflat_cache_bytes > \
+                            self._table_cache_bytes:
+                        # budget full: older sets stay on disk for
+                        # live misses to stream in
+                        break
+                except Exception:
+                    logger.exception("warm table restore failed for "
+                                     "one set")
+                finally:
+                    self._q16_loading.discard(cache_key)
+        finally:
+            for cache_key, _ in candidates:
+                self._q16_loading.discard(cache_key)
         if warmed:
             logger.info("prewarmed Q tables for %d persisted key "
-                        "set(s), %d from persisted bytes", warmed,
-                        self.stats["q16_disk_loads"])
+                        "set(s) from persisted bytes", warmed)
         return warmed
 
     def _resolve_tables(self, key_map, key_idx):
@@ -969,8 +1031,14 @@ class TPUProvider(api.BCCSP):
         def q8_cached():
             q8 = self._q8_cache.pop(tuple(order), None)
             if q8 is None:
-                q8 = self._qtab_fn(K)(jnp.asarray(qx_k),
-                                      jnp.asarray(qy_k))
+                pre = self._load_q8_table(tuple(order), K)
+                if pre is not None:
+                    q8 = jnp.asarray(pre)
+                    self.stats["q8_disk_loads"] += 1
+                else:
+                    q8 = self._qtab_fn(K)(jnp.asarray(qx_k),
+                                          jnp.asarray(qy_k))
+                    self._persist_q8_table(tuple(order), q8)
             self._q8_cache[tuple(order)] = q8    # (re-)insert as MRU
             while len(self._q8_cache) > self._Q8_CACHE_MAX:
                 self._q8_cache.pop(next(iter(self._q8_cache)))
@@ -979,12 +1047,16 @@ class TPUProvider(api.BCCSP):
         q16 = False
         if self._g16_enabled():
             from fabric_tpu.ops import comb
-            g16 = comb.g16_tables()
             q_flat = self._q16_cached(tuple(order), K, qx_k, qy_k)
             if q_flat is not None:
                 q16 = True
+                g16 = comb.g16_tables()
             else:
+                # 8-bit fallback (adaptive overflow / restore pending):
+                # pure 8/8 pipeline — independent of the g16 build, so
+                # a restarting node validates immediately
                 q_flat = q8_cached()
+                g16 = jnp.zeros((0, 3, limb.L), dtype=jnp.int32)
         else:
             q_flat = q8_cached()
             g16 = jnp.zeros((0, 3, limb.L), dtype=jnp.int32)
@@ -1121,7 +1193,10 @@ class TPUProvider(api.BCCSP):
 
             from fabric_tpu.ops import comb, sha256
 
-            use_g16 = self._g16_enabled()
+            # q16=False pipelines run pure 8-bit on BOTH bases: they
+            # serve the adaptive-overflow and restore-pending windows,
+            # and must not block on (or embed) the ~252 MB g16 build
+            use_g16 = self._g16_enabled() and q16
             # the Pallas VMEM tree is tuned for the 32-point (16-bit
             # window) tree; the 64-point 8-bit tree hits unimplemented
             # Mosaic lowerings — q8 dispatches keep the XLA tree
@@ -1168,7 +1243,11 @@ class TPUProvider(api.BCCSP):
 
                 from fabric_tpu.ops import comb, limb
 
-                use_g16 = self._g16_enabled()
+                # q16=False pipelines run pure 8-bit on BOTH bases:
+                # they serve the adaptive-overflow and restore-pending
+                # windows, and must not block on (or embed) the
+                # ~252 MB g16 build
+                use_g16 = self._g16_enabled() and q16
                 tree = self._tree_impl() if q16 else "xla"
 
                 def fused(key_idx, q_flat, g16, r8, rpn8, w8, premask,
@@ -1217,13 +1296,17 @@ class TPUProvider(api.BCCSP):
         return self._fn
 
     def prewarm(self, buckets=(4096, 32768), key_counts=(4,),
-                msg_nbs=None) -> None:
+                msg_nbs=None, wait_restore: bool = False) -> None:
         """AOT-compile the standard validation shapes (and build the
         16-bit G table) BEFORE the node joins channels, so a cold peer
         does not stall its first blocks on device compilation
         (round-2 verdict: cold compile was minutes; with the
         persistent cache this makes restart-to-first-validated-block
-        fast). Safe to call on any backend; failures only log."""
+        fast). Persisted Q tables restore in a BACKGROUND thread that
+        outlives this call (wait_restore=True joins it — tests): live
+        batches ride the 8-bit path until each restore lands, so the
+        node validates immediately like a reference peer. Safe to call
+        on any backend; failures only log."""
         import jax  # noqa: F401  (jax.ShapeDtypeStruct below)
 
         from fabric_tpu.ops import comb
@@ -1234,13 +1317,18 @@ class TPUProvider(api.BCCSP):
         try:
             q16 = self._g16_enabled()
             if q16:
-                comb.g16_tables()
-                # rebuild the Q tables for the key sets persisted by the
-                # previous process FIRST — they are the multi-minute
-                # cost a restarted peer would otherwise pay on its first
-                # block (the XLA cache below covers only code, not the
-                # table data)
-                self._prewarm_tables()
+                # the g16 G-table build AND the persisted Q-table
+                # restores run in ONE background thread (g16 first —
+                # any q16 dispatch needs it): minutes of tunnel-bound
+                # transfer that must not hold up the node's first
+                # blocks, which the 8-bit path serves meanwhile
+                def restore():
+                    comb.g16_tables()
+                    self._prewarm_tables()
+
+                self._restore_thread = threading.Thread(
+                    target=restore, daemon=True, name="qtab-restore")
+                self._restore_thread.start()
             for K in key_counts:
                 ent = (comb.NWIN_G16 * comb.NENT_G16 if q16
                        else comb.NWIN * comb.NENT)
@@ -1268,6 +1356,28 @@ class TPUProvider(api.BCCSP):
                     dfn.lower(*dargs).compile()
                     logger.info("prewarmed digest comb pipeline K=%d "
                                 "chunk=%d q16=%s", K, chunk, q16)
+                    if q16:
+                        # the pure-8-bit variant serves blocks while
+                        # the big q16 tables stream back (restore
+                        # window) and the adaptive-overflow sets —
+                        # compile it too or the first restarted block
+                        # pays it
+                        dfn8 = self._comb_pipeline_digest(K, False)
+                        dargs8 = (
+                            sd((chunk,), _np.int32),
+                            sd((comb.NWIN * comb.NENT * K, 3, 20),
+                               _np.int32),
+                            sd((0, 3, 20), _np.int32),
+                            sd((chunk, 32), _np.uint8),
+                            sd((chunk, 32), _np.uint8),
+                            sd((chunk, 32), _np.uint8),
+                            sd((chunk,), bool),
+                            sd((chunk, 8), _np.uint32),
+                        )
+                        dfn8.lower(*dargs8).compile()
+                        logger.info("prewarmed digest comb pipeline "
+                                    "K=%d chunk=%d q16=False "
+                                    "(restore-window path)", K, chunk)
                     if self._hash_on_host:
                         continue      # fused-SHA pipeline not used
                     fn = self._comb_pipeline(K, q16)
@@ -1289,6 +1399,8 @@ class TPUProvider(api.BCCSP):
                         logger.info("prewarmed comb pipeline K=%d "
                                     "chunk=%d nb=%d q16=%s", K, chunk,
                                     nb, q16)
+            if wait_restore and self._restore_thread is not None:
+                self._restore_thread.join()
         except Exception:
             logger.exception("prewarm failed (continuing; first block "
                              "will pay the compile)")

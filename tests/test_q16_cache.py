@@ -296,6 +296,35 @@ def test_mru_trim_reclaims_displaced_table_bytes(monkeypatch,
     assert os.path.exists(prov._table_path(_key(4)))
 
 
+def test_live_batch_rides_q8_while_restore_streams(monkeypatch,
+                                                   tmp_path):
+    """Availability-first restart: while the background restore is
+    still streaming a set's table to the device (the _q16_loading
+    marker), a live batch must NOT block on the load — it is denied
+    the 16-bit path (rides 8-bit) and the q16 path resumes the moment
+    the restore lands."""
+    builds = []
+    _stub(monkeypatch, builds)
+    warm = str(tmp_path / "warm")
+    p1 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    assert p1._q16_cached(_key(1), 1, _QX, _QX) is not None
+    p1.flush_warm_tables()
+
+    p2 = TPUProvider(use_g16=True, table_cache_bytes=3 * EST,
+                     warm_keys_dir=warm)
+    # simulate the in-flight restore
+    p2._q16_loading.add(_key(1))
+    assert p2._q16_cached(_key(1), 1, _QX, _QX) is None
+    assert p2.stats["q16_loading_skips"] == 1
+    assert p2.stats["q16_disk_loads"] == 0      # did NOT block on it
+    # restore lands (what _prewarm_tables does): marker cleared
+    p2._q16_loading.discard(_key(1))
+    assert p2._q16_cached(_key(1), 1, _QX, _QX) is not None
+    assert p2.stats["q16_disk_loads"] == 1
+    assert p2.stats["q16_builds"] == 0
+
+
 def test_oversize_set_never_builds(monkeypatch):
     builds = []
     _stub(monkeypatch, builds)

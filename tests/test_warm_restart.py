@@ -93,7 +93,7 @@ def test_prewarm_invokes_table_rebuild(monkeypatch):
                         lambda self: called.append(True) or 0)
     monkeypatch.setattr(comb, "g16_tables", lambda: None)
     prov = TPUProvider(use_g16=True)
-    prov.prewarm(buckets=(), key_counts=())
+    prov.prewarm(buckets=(), key_counts=(), wait_restore=True)
     assert called
 
 
