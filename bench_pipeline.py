@@ -241,11 +241,20 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     # TPU-filter number was only a commit-message claim.
     order_tpu_s = None
     try:
+        # the orderer's own provider pads every 512-envelope window to
+        # the 4096-lane bucket the parent AOT-compiled: no fresh
+        # device compiles inside the ordering timer (the padded lanes
+        # are premasked; device time is ~flat in lane count here)
+        from fabric_tpu.bccsp import factory as _bf
+        orderer_csp = _bf.new_bccsp(_bf.FactoryOpts.from_config({
+            "Default": "TPU",
+            "TPU": {"MinBatch": 16, "BucketFloor": 4096,
+                    "Chunk": 32768, "WarmKeysDir": warm_dir}}))
         net2 = LocalClusterNetwork()
         transport2 = net2.register(orderer_ep)
         registrar2 = Registrar(
             os.path.join(root, "orderer_tpu"),
-            orderer_msp.get_default_signing_identity(), tpu_csp,
+            orderer_msp.get_default_signing_identity(), orderer_csp,
             {"etcdraft": raft_mod.consenter(transport2,
                                             tick_interval_s=0.03,
                                             election_tick=8)})

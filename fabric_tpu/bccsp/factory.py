@@ -49,6 +49,10 @@ class TpuOpts:
     # block after a restart (node assembly defaults this under
     # peer.fileSystemPath); None disables persistence
     warm_keys_dir: Optional[str] = None
+    # pad device batches up to this bucket (0 = off): pins modest
+    # windows (e.g. orderer sig-filter ingest) to an AOT-compiled
+    # shape; padded lanes are premasked
+    bucket_floor: int = 0
 
 
 @dataclass
@@ -85,6 +89,7 @@ class FactoryOpts:
                     int(tpu_cfg.get("TableCacheMB", 6144)) << 20),
                 hash_on_host=bool(tpu_cfg.get("HashOnHost", True)),
                 warm_keys_dir=tpu_cfg.get("WarmKeysDir") or None,
+                bucket_floor=int(tpu_cfg.get("BucketFloor", 0)),
             ),
         )
 
@@ -109,7 +114,8 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            use_g16=opts.tpu.use_g16,
                            table_cache_bytes=opts.tpu.table_cache_bytes,
                            hash_on_host=opts.tpu.hash_on_host,
-                           warm_keys_dir=opts.tpu.warm_keys_dir)
+                           warm_keys_dir=opts.tpu.warm_keys_dir,
+                           bucket_floor=opts.tpu.bucket_floor)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

@@ -45,9 +45,16 @@ class TPUProvider(api.BCCSP):
                  chunk: int = 32768, use_g16: Optional[bool] = None,
                  table_cache_bytes: int = 6 << 30,
                  hash_on_host: bool = True,
-                 warm_keys_dir: Optional[str] = None):
+                 warm_keys_dir: Optional[str] = None,
+                 bucket_floor: int = 0):
         self._sw = swmod.SWProvider(keystore)
         self._min_batch = min_batch
+        # pad device batches up to this bucket (0 = off): a workload of
+        # modest windows (e.g. the orderer's 512-envelope sig-filter
+        # ingest) can pin itself to an already-AOT-compiled shape
+        # instead of compiling its own — padded lanes are premasked
+        # and near-free on device (BCCSP.TPU.BucketFloor)
+        self._bucket_floor = bucket_floor
         self._max_blocks = max_blocks
         # hash message lanes on host (OpenSSL-class C SHA-256) and ship
         # 32-byte digests instead of padded SHA blocks: transfer drops
@@ -742,6 +749,12 @@ class TPUProvider(api.BCCSP):
                 jax.block_until_ready(q_flat)
             self.stats["q16_disk_loads"] += 1
         else:
+            if not prewarm:
+                # record the key set BEFORE the persist threads start:
+                # their publish step deletes any table file whose set
+                # is absent from the warm file (the reclaim-race
+                # guard), so the record must win that race
+                self._record_warm_keys(cache_key)
             q_flat = self._build_q16_table(cache_key, K, qx_k, qy_k)
             self._persist_q16_table(cache_key, q_flat)
         self._qflat_cache[cache_key] = q_flat
@@ -752,7 +765,10 @@ class TPUProvider(api.BCCSP):
         else:
             self._q16_last_use[cache_key] = now
             self._q16_denied.pop(cache_key, None)
-            self._record_warm_keys(cache_key)
+            if preloaded is not None:
+                # a disk-restored set is live again: refresh its MRU
+                # position in the warm file
+                self._record_warm_keys(cache_key)
         self.stats["q16_cache_bytes"] = self._qflat_cache_bytes
         self.stats["q16_resident_sets"] = len(self._qflat_cache)
         return q_flat
@@ -1514,7 +1530,7 @@ class TPUProvider(api.BCCSP):
         return out
 
     def _bucket(self, n: int) -> int:
-        b = self._min_batch
+        b = max(self._min_batch, self._bucket_floor or 0)
         while b < n:
             b *= 2
         if self._mesh is not None:
