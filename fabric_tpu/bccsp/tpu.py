@@ -1311,7 +1311,7 @@ class TPUProvider(api.BCCSP):
                 self._fn = jax.jit(fused)
         return self._fn
 
-    def prewarm(self, buckets=(4096, 32768), key_counts=(4,),
+    def prewarm(self, buckets=(4096, 32768), key_counts=(1, 4),
                 msg_nbs=None, wait_restore: bool = False) -> None:
         """AOT-compile the standard validation shapes (and build the
         16-bit G table) BEFORE the node joins channels, so a cold peer
