@@ -1,0 +1,302 @@
+"""Gate the optional `cryptography` (OpenSSL) dependency.
+
+The sw provider is the oracle every other layer leans on, so its
+import must never fail: stripped images without the `cryptography`
+wheel get a pure-Python P-256 backend (`p256_host.py`) behind the SAME
+API surface sw.py/keystore.py consume. Capabilities the fallback
+cannot honestly provide — x509 parsing, AES — raise
+`MissingCryptographyError` at USE time with install guidance, instead
+of killing the whole bccsp/node import chain at import time (the
+graceful-degradation contract: absent dependency degrades, never
+halts).
+
+Import from here, not from `cryptography`:
+
+    from fabric_tpu.bccsp._crypto_compat import (
+        HAVE_CRYPTOGRAPHY, ec, hashes, serialization, x509, ...)
+
+When OpenSSL is present these are exact re-exports; nothing changes.
+"""
+
+from __future__ import annotations
+
+
+class MissingCryptographyError(ImportError):
+    """A capability only OpenSSL provides was requested on a host
+    running the pure-python fallback backend."""
+
+    def __init__(self, what: str):
+        self.what = what
+        super().__init__(
+            f"{what} requires the 'cryptography' package, which is "
+            "not installed; the pure-python fallback backend covers "
+            "P-256 ECDSA + SHA-2 only")
+
+
+# capabilities the fallback HONESTLY lacks. Errors from these prefixes
+# are environment gaps (tests may skip on them); anything else — e.g.
+# a typo'd `ec.`/`serialization.` attribute, which the namespace
+# metaclass also reports as MissingCryptographyError — is a product
+# bug and must surface as a failure, never a skip.
+_CAPABILITY_GAPS = ("x509", "Cipher", "algorithms", "modes",
+                    "padding", "NameOID", "AES", "ECDSA with",
+                    "curve ")
+
+
+def is_capability_gap(exc: BaseException) -> bool:
+    return (isinstance(exc, MissingCryptographyError)
+            and str(getattr(exc, "what", "")).startswith(
+                _CAPABILITY_GAPS))
+
+
+try:
+    from cryptography import x509
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec, padding
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.ciphers import (
+        Cipher,
+        algorithms,
+        modes,
+    )
+    from cryptography.x509.oid import NameOID
+
+    HAVE_CRYPTOGRAPHY = True
+
+except ImportError:
+    HAVE_CRYPTOGRAPHY = False
+
+    import base64 as _base64
+    import hashlib as _hashlib
+
+    from fabric_tpu.bccsp import p256_host as _p256
+    from fabric_tpu.bccsp import utils as _utils
+
+    class _MissingAttr(type):
+        """Namespace metaclass: any attribute the fallback doesn't shim
+        raises the informative error at USE time, keeping the import
+        graph alive no matter which corner of the `cryptography` API a
+        module references."""
+
+        def __getattr__(cls, name):
+            raise MissingCryptographyError(f"{cls.__name__}.{name}")
+
+    class InvalidSignature(Exception):  # noqa: N818  (upstream name)
+        pass
+
+    def decode_dss_signature(der: bytes):
+        try:
+            return _utils.unmarshal_signature(der)
+        except _utils.SignatureFormatError as e:
+            raise ValueError(str(e)) from None
+
+    def encode_dss_signature(r: int, s: int) -> bytes:
+        return _utils.marshal_signature(r, s)
+
+    class _HashAlg:
+        name = ""
+        digest_size = 0
+
+    class _SHA256(_HashAlg):
+        name, digest_size = "sha256", 32
+
+    class _SHA384(_HashAlg):
+        name, digest_size = "sha384", 48
+
+    class _SHA512(_HashAlg):
+        name, digest_size = "sha512", 64
+
+    class hashes(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        HashAlgorithm = _HashAlg
+        SHA256 = _SHA256
+        SHA384 = _SHA384
+        SHA512 = _SHA512
+
+    class Prehashed:
+        def __init__(self, algorithm):
+            self._algorithm = algorithm
+            self.digest_size = algorithm.digest_size
+
+    def _digest_for(algorithm, data: bytes) -> bytes:
+        """Resolve sign/verify input: prehashed passes through, a
+        named hash algorithm hashes the message first."""
+        if isinstance(algorithm, Prehashed):
+            return data
+        if isinstance(algorithm, _HashAlg):
+            return getattr(_hashlib, algorithm.name)(data).digest()
+        raise MissingCryptographyError(
+            f"ECDSA with {type(algorithm).__name__}")
+
+    # -- the EC namespace --
+
+    class _SECP256R1:
+        name = "secp256r1"
+        key_size = 256
+
+    class _ECDSA:
+        def __init__(self, algorithm):
+            self.algorithm = algorithm
+
+    class _PubNumbers:
+        def __init__(self, x: int, y: int):
+            self.x, self.y = x, y
+
+    class _PublicKey:
+        """Mirror of EllipticCurvePublicKey (P-256 only)."""
+
+        def __init__(self, x: int, y: int):
+            if not _p256.on_curve(x, y):
+                raise ValueError("point not on P-256")
+            self._x, self._y = x, y
+            self.curve = _SECP256R1()
+
+        def public_numbers(self):
+            return _PubNumbers(self._x, self._y)
+
+        def public_bytes(self, encoding, fmt) -> bytes:
+            point = (b"\x04" + self._x.to_bytes(32, "big")
+                     + self._y.to_bytes(32, "big"))
+            if fmt is _PublicFormat.UncompressedPoint:
+                return point
+            der = _p256.encode_spki(self._x, self._y)
+            if encoding is _Encoding.PEM:
+                return _pem_wrap("PUBLIC KEY", der)
+            return der
+
+        def verify(self, signature: bytes, data: bytes,
+                   signature_algorithm) -> None:
+            digest = _digest_for(signature_algorithm.algorithm, data)
+            r, s = decode_dss_signature(signature)
+            if not _p256.verify(self._x, self._y, digest, r, s):
+                raise InvalidSignature("signature mismatch")
+
+    class _PrivNumbers:
+        def __init__(self, d: int):
+            self.private_value = d
+
+    class _PrivateKey:
+        """Mirror of EllipticCurvePrivateKey (P-256 only)."""
+
+        def __init__(self, d: int):
+            self._d = d
+            self.curve = _SECP256R1()
+            x, y = _p256.derive_public(d)
+            self._pub = _PublicKey(x, y)
+
+        def public_key(self) -> _PublicKey:
+            return self._pub
+
+        def private_numbers(self):
+            return _PrivNumbers(self._d)
+
+        def sign(self, data: bytes, signature_algorithm) -> bytes:
+            digest = _digest_for(signature_algorithm.algorithm, data)
+            r, s = _p256.sign(self._d, digest)
+            return encode_dss_signature(r, s)
+
+        def private_bytes(self, encoding, fmt, encryption) -> bytes:
+            der = _p256.encode_pkcs8(self._d)
+            if encoding is _Encoding.PEM:
+                return _pem_wrap("PRIVATE KEY", der)
+            return der
+
+    def _generate_private_key(curve):
+        if getattr(curve, "name", "") != "secp256r1":
+            raise MissingCryptographyError(
+                f"curve {getattr(curve, 'name', curve)!r}")
+        return _PrivateKey(_p256.generate_scalar())
+
+    class ec(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        SECP256R1 = _SECP256R1
+        ECDSA = _ECDSA
+        EllipticCurvePublicKey = _PublicKey
+        EllipticCurvePrivateKey = _PrivateKey
+        generate_private_key = staticmethod(_generate_private_key)
+
+    # -- serialization --
+
+    def _pem_wrap(label: str, der: bytes) -> bytes:
+        body = _base64.encodebytes(der)
+        return (f"-----BEGIN {label}-----\n".encode() + body
+                + f"-----END {label}-----\n".encode())
+
+    def _pem_unwrap(pem: bytes) -> bytes:
+        lines = [ln for ln in pem.splitlines()
+                 if ln and not ln.startswith(b"-----")]
+        return _base64.b64decode(b"".join(lines))
+
+    class _Encoding:
+        PEM = "PEM"
+        DER = "DER"
+        X962 = "X962"
+
+    class _PublicFormat:
+        SubjectPublicKeyInfo = "SubjectPublicKeyInfo"
+        UncompressedPoint = "UncompressedPoint"
+
+    class _PrivateFormat:
+        PKCS8 = "PKCS8"
+
+    class _NoEncryption:
+        pass
+
+    def _load_der_public_key(der: bytes):
+        return _PublicKey(*_p256.decode_spki(der))
+
+    def _load_der_private_key(der: bytes, password=None):
+        return _PrivateKey(_p256.decode_pkcs8(der))
+
+    def _load_pem_public_key(pem: bytes):
+        return _load_der_public_key(_pem_unwrap(pem))
+
+    def _load_pem_private_key(pem: bytes, password=None):
+        return _load_der_private_key(_pem_unwrap(pem))
+
+    class serialization(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        Encoding = _Encoding
+        PublicFormat = _PublicFormat
+        PrivateFormat = _PrivateFormat
+        NoEncryption = _NoEncryption
+        load_der_public_key = staticmethod(_load_der_public_key)
+        load_der_private_key = staticmethod(_load_der_private_key)
+        load_pem_public_key = staticmethod(_load_pem_public_key)
+        load_pem_private_key = staticmethod(_load_pem_private_key)
+
+    # -- x509 / AES: honestly unsupported in the fallback --
+
+    class _Certificate:
+        """Placeholder so isinstance checks stay valid; never
+        instantiated by the fallback."""
+
+    def _load_der_x509_certificate(der: bytes):
+        raise MissingCryptographyError("x509 certificate parsing")
+
+    class x509(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        Certificate = _Certificate
+        load_der_x509_certificate = staticmethod(
+            _load_der_x509_certificate)
+
+    class Cipher:
+        def __init__(self, *a, **kw):
+            raise MissingCryptographyError("AES")
+
+    class _AES:
+        def __init__(self, *a, **kw):
+            raise MissingCryptographyError("AES")
+
+    class algorithms(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        AES = _AES
+
+    class modes(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        CBC = _AES
+
+    class padding(metaclass=_MissingAttr):  # noqa: N801  (namespace)
+        """RSA padding namespace (msp verify of RSA-signed certs)."""
+
+    class NameOID(metaclass=_MissingAttr):
+        """x509 name OIDs (cryptogen cert building)."""

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from fabric_tpu.bccsp.bccsp import BCCSP
+from fabric_tpu.common.breaker import BreakerConfig
 
 _lock = threading.Lock()
 _default: Optional[BCCSP] = None
@@ -53,6 +54,10 @@ class TpuOpts:
     # windows (e.g. orderer sig-filter ingest) to an AOT-compiled
     # shape; padded lanes are premasked
     bucket_floor: int = 0
+    # graceful degradation (BCCSP.TPU.Fallback): circuit breaker
+    # around every device dispatch — on trip the provider serves the
+    # bit-identical sw path and re-probes after CooldownS
+    fallback: BreakerConfig = field(default_factory=BreakerConfig)
 
 
 @dataclass
@@ -69,6 +74,8 @@ class FactoryOpts:
         sw_cfg = cfg.get("SW") or {}
         tpu_cfg = cfg.get("TPU") or {}
         fks = sw_cfg.get("FileKeyStore") or {}
+        fb_cfg = tpu_cfg.get("Fallback") or {}
+        fb_defaults = BreakerConfig()
         return cls(
             default=(cfg.get("Default") or "SW").upper(),
             sw=SwOpts(
@@ -90,14 +97,25 @@ class FactoryOpts:
                 hash_on_host=bool(tpu_cfg.get("HashOnHost", True)),
                 warm_keys_dir=tpu_cfg.get("WarmKeysDir") or None,
                 bucket_floor=int(tpu_cfg.get("BucketFloor", 0)),
+                fallback=BreakerConfig(
+                    deadline_ms=float(fb_cfg.get(
+                        "DeadlineMs", fb_defaults.deadline_ms)),
+                    trip_threshold=int(fb_cfg.get(
+                        "TripThreshold", fb_defaults.trip_threshold)),
+                    cooldown_s=float(fb_cfg.get(
+                        "CooldownS", fb_defaults.cooldown_s)),
+                    probe_batch=int(fb_cfg.get(
+                        "ProbeBatch", fb_defaults.probe_batch)),
+                ),
             ),
         )
 
 
 def new_bccsp(opts: FactoryOpts) -> BCCSP:
-    from fabric_tpu.bccsp.keystore import FileKeyStore
-
-    ks = FileKeyStore(opts.sw.keystore_path) if opts.sw.keystore_path else None
+    ks = None
+    if opts.sw.keystore_path:
+        from fabric_tpu.bccsp.keystore import FileKeyStore
+        ks = FileKeyStore(opts.sw.keystore_path)
     if opts.default == "SW":
         from fabric_tpu.bccsp.sw import SWProvider
         return SWProvider(ks)
@@ -115,7 +133,8 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            table_cache_bytes=opts.tpu.table_cache_bytes,
                            hash_on_host=opts.tpu.hash_on_host,
                            warm_keys_dir=opts.tpu.warm_keys_dir,
-                           bucket_floor=opts.tpu.bucket_floor)
+                           bucket_floor=opts.tpu.bucket_floor,
+                           fallback=opts.tpu.fallback)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 import base64
 import os
 
-from cryptography.hazmat.primitives import serialization
+from fabric_tpu.bccsp._crypto_compat import serialization
 
 from fabric_tpu.bccsp import sw
 

@@ -1,0 +1,259 @@
+"""Pure-Python P-256 ECDSA — the no-OpenSSL host fallback.
+
+The sw provider is the correctness ORACLE for the whole TPU path, so it
+must exist on every host — including stripped container images that
+lack the `cryptography` wheel (no pip at runtime; the graceful-
+degradation contract says an absent dependency degrades, never halts).
+This module is that floor: keygen, RFC 6979 deterministic signing, and
+verification in pure Python big-int arithmetic.
+
+Semantics are aligned with Go `crypto/ecdsa` (and hence the OpenSSL
+backend): digests longer than the group order are truncated leftmost
+(`hashToNat` bits2int), r/s range-checked before any curve math, and
+the curve equation decided exactly. Jacobian coordinates keep a verify
+near a millisecond — slow next to OpenSSL, but bit-identical, which is
+the property the differential tests pin.
+
+Used via `fabric_tpu/bccsp/_crypto_compat.py`; nothing above that
+layer knows which backend is live.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from typing import Optional
+
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+A = P - 3
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+
+_INF = (0, 1, 0)    # Jacobian point at infinity (Z == 0)
+
+
+def on_curve(x: int, y: int) -> bool:
+    if not (0 <= x < P and 0 <= y < P):
+        return False
+    return (y * y - (x * x * x + A * x + B)) % P == 0
+
+
+# -- Jacobian arithmetic (dbl-2001-b / add-2007-bl, a = -3) --
+
+def _jdouble(pt):
+    X1, Y1, Z1 = pt
+    if Z1 == 0 or Y1 == 0:
+        return _INF
+    delta = Z1 * Z1 % P
+    gamma = Y1 * Y1 % P
+    beta = X1 * gamma % P
+    alpha = 3 * (X1 - delta) * (X1 + delta) % P
+    X3 = (alpha * alpha - 8 * beta) % P
+    Z3 = ((Y1 + Z1) * (Y1 + Z1) - gamma - delta) % P
+    Y3 = (alpha * (4 * beta - X3) - 8 * gamma * gamma) % P
+    return (X3, Y3, Z3)
+
+
+def _jadd(p, q):
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    if Z1 == 0:
+        return q
+    if Z2 == 0:
+        return p
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    U1 = X1 * Z2Z2 % P
+    U2 = X2 * Z1Z1 % P
+    S1 = Y1 * Z2 * Z2Z2 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    if U1 == U2:
+        if S1 != S2:
+            return _INF
+        return _jdouble(p)
+    H = (U2 - U1) % P
+    I = 4 * H * H % P
+    J = H * I % P
+    r = 2 * (S2 - S1) % P
+    V = U1 * I % P
+    X3 = (r * r - J - 2 * V) % P
+    Y3 = (r * (V - X3) - 2 * S1 * J) % P
+    Z3 = ((Z1 + Z2) * (Z1 + Z2) - Z1Z1 - Z2Z2) % P * H % P
+    return (X3, Y3, Z3)
+
+
+def _to_jacobian(x: int, y: int):
+    return (x, y, 1)
+
+
+def _to_affine(pt) -> Optional[tuple[int, int]]:
+    X, Y, Z = pt
+    if Z == 0:
+        return None
+    zinv = pow(Z, P - 2, P)
+    zinv2 = zinv * zinv % P
+    return (X * zinv2 % P, Y * zinv2 * zinv % P)
+
+
+def scalar_mult(k: int, point: tuple[int, int]) -> Optional[tuple[int, int]]:
+    """k * point (affine in/out; None = infinity)."""
+    k %= N
+    if k == 0:
+        return None
+    acc = _INF
+    base = _to_jacobian(*point)
+    for bit in bin(k)[2:]:
+        acc = _jdouble(acc)
+        if bit == "1":
+            acc = _jadd(acc, base)
+    return _to_affine(acc)
+
+
+def _double_mult(u1: int, u2: int, q: tuple[int, int]):
+    """u1*G + u2*Q via Shamir interleaving (the verify hot path)."""
+    g = _to_jacobian(GX, GY)
+    qj = _to_jacobian(*q)
+    gq = _jadd(g, qj)
+    acc = _INF
+    for i in range(max(u1.bit_length(), u2.bit_length()) - 1, -1, -1):
+        acc = _jdouble(acc)
+        b1 = (u1 >> i) & 1
+        b2 = (u2 >> i) & 1
+        if b1 and b2:
+            acc = _jadd(acc, gq)
+        elif b1:
+            acc = _jadd(acc, g)
+        elif b2:
+            acc = _jadd(acc, qj)
+    return _to_affine(acc)
+
+
+# -- digest handling (Go crypto/ecdsa hashToNat) --
+
+def _bits2int(data: bytes) -> int:
+    v = int.from_bytes(data, "big")
+    excess = len(data) * 8 - N.bit_length()
+    if excess > 0:
+        v >>= excess
+    return v
+
+
+def verify(x: int, y: int, digest: bytes, r: int, s: int) -> bool:
+    """Exact ECDSA verify over precomputed digest bytes."""
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    if not on_curve(x, y) or (x == 0 and y == 0):
+        return False
+    e = _bits2int(digest) % N
+    w = pow(s, N - 2, N)
+    u1 = e * w % N
+    u2 = r * w % N
+    pt = _double_mult(u1, u2, (x, y))
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+# -- RFC 6979 deterministic nonces (SHA-256) --
+
+def _int2octets(v: int) -> bytes:
+    return v.to_bytes(32, "big")
+
+
+def _bits2octets(data: bytes) -> bytes:
+    return _int2octets(_bits2int(data) % N)
+
+
+def sign(d: int, digest: bytes) -> tuple[int, int]:
+    """Deterministic ECDSA over precomputed digest bytes; returns raw
+    (r, s) — the caller applies the low-S policy."""
+    if not (1 <= d < N):
+        raise ValueError("private scalar out of range")
+    e = _bits2int(digest) % N
+    hmod = hashlib.sha256
+    V = b"\x01" * 32
+    K = b"\x00" * 32
+    seed = _int2octets(d) + _bits2octets(digest)
+    K = hmac.new(K, V + b"\x00" + seed, hmod).digest()
+    V = hmac.new(K, V, hmod).digest()
+    K = hmac.new(K, V + b"\x01" + seed, hmod).digest()
+    V = hmac.new(K, V, hmod).digest()
+    while True:
+        V = hmac.new(K, V, hmod).digest()
+        k = _bits2int(V)
+        if 1 <= k < N:
+            pt = scalar_mult(k, (GX, GY))
+            if pt is not None:
+                r = pt[0] % N
+                if r != 0:
+                    s = pow(k, N - 2, N) * (e + r * d) % N
+                    if s != 0:
+                        return r, s
+        K = hmac.new(K, V + b"\x00", hmod).digest()
+        V = hmac.new(K, V, hmod).digest()
+
+
+def generate_scalar() -> int:
+    """Uniform private scalar in [1, N)."""
+    return secrets.randbelow(N - 1) + 1
+
+
+def derive_public(d: int) -> tuple[int, int]:
+    pt = scalar_mult(d, (GX, GY))
+    assert pt is not None
+    return pt
+
+
+# -- minimal DER templates (fallback-mode serialization only) --
+
+# SubjectPublicKeyInfo for id-ecPublicKey / prime256v1, uncompressed
+# point: the fixed 27-byte prefix every P-256 SPKI shares.
+SPKI_PREFIX = bytes.fromhex(
+    "3059301306072a8648ce3d020106082a8648ce3d03010703420004")
+# PKCS#8 wrapping of an ECPrivateKey (no embedded public key).
+PKCS8_PREFIX = bytes.fromhex(
+    "3041020100301306072a8648ce3d020106082a8648ce3d"
+    "030107042730250201010420")
+
+
+def encode_spki(x: int, y: int) -> bytes:
+    return SPKI_PREFIX + _int2octets(x) + _int2octets(y)
+
+
+def decode_spki(der: bytes) -> tuple[int, int]:
+    if len(der) != len(SPKI_PREFIX) + 64 or \
+            not der.startswith(SPKI_PREFIX):
+        raise ValueError("unsupported public key encoding "
+                         "(pure-python backend reads P-256 "
+                         "uncompressed SPKI only)")
+    x = int.from_bytes(der[-64:-32], "big")
+    y = int.from_bytes(der[-32:], "big")
+    if not on_curve(x, y):
+        raise ValueError("public point not on P-256")
+    return x, y
+
+
+def encode_pkcs8(d: int) -> bytes:
+    return PKCS8_PREFIX + _int2octets(d)
+
+
+def decode_pkcs8(der: bytes) -> int:
+    if len(der) == len(PKCS8_PREFIX) + 32 and \
+            der.startswith(PKCS8_PREFIX):
+        d = int.from_bytes(der[-32:], "big")
+    else:
+        # tolerate PKCS#8 blobs with the optional embedded public key
+        # (what OpenSSL writes): locate the ECPrivateKey scalar, a
+        # 32-byte OCTET STRING right after `INTEGER 1`
+        marker = b"\x02\x01\x01\x04\x20"
+        i = der.find(marker)
+        if i < 0 or i + len(marker) + 32 > len(der):
+            raise ValueError("unsupported private key encoding")
+        d = int.from_bytes(der[i + len(marker):i + len(marker) + 32],
+                           "big")
+    if not (1 <= d < N):
+        raise ValueError("private scalar out of range")
+    return d

@@ -15,16 +15,23 @@ import hashlib
 import os
 from typing import Optional, Sequence
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
+# the OpenSSL binding is optional: hosts without the `cryptography`
+# wheel run the pure-python P-256 backend behind the same names
+# (fabric_tpu/bccsp/_crypto_compat.py) — x509/AES degrade to explicit
+# MissingCryptographyError at use time instead of an import-time crash
+from fabric_tpu.bccsp._crypto_compat import (
+    Cipher,
+    InvalidSignature,
     Prehashed,
+    algorithms,
     decode_dss_signature,
+    ec,
     encode_dss_signature,
+    hashes,
+    modes,
+    serialization,
+    x509,
 )
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
-from cryptography import x509
-from cryptography.exceptions import InvalidSignature
 
 from fabric_tpu.bccsp import bccsp as api
 from fabric_tpu.bccsp import utils

@@ -32,6 +32,8 @@ import numpy as np
 from fabric_tpu.bccsp import bccsp as api
 from fabric_tpu.bccsp import sw as swmod
 from fabric_tpu.bccsp import utils
+from fabric_tpu.common import breaker as breaker_mod
+from fabric_tpu.common import faults
 
 logger = logging.getLogger("bccsp.tpu")
 
@@ -46,8 +48,15 @@ class TPUProvider(api.BCCSP):
                  table_cache_bytes: int = 6 << 30,
                  hash_on_host: bool = True,
                  warm_keys_dir: Optional[str] = None,
-                 bucket_floor: int = 0):
+                 bucket_floor: int = 0,
+                 fallback: Optional[breaker_mod.BreakerConfig] = None):
         self._sw = swmod.SWProvider(keystore)
+        # graceful degradation (BCCSP.TPU.Fallback): every device
+        # dispatch runs behind this breaker; on trip the provider
+        # serves the bit-identical sw path and re-probes the device
+        # after a cooldown (see common/breaker.py)
+        self._breaker = breaker_mod.CircuitBreaker(
+            fallback or breaker_mod.BreakerConfig(), name="bccsp.tpu")
         self._min_batch = min_batch
         # pad device batches up to this bucket (0 = off): a workload of
         # modest windows (e.g. the orderer's 512-envelope sig-filter
@@ -129,7 +138,14 @@ class TPUProvider(api.BCCSP):
                       "q16_adaptive_skips": 0, "q16_resident_sets": 0,
                       "q16_disk_loads": 0, "q8_disk_loads": 0,
                       "q16_loading_skips": 0,
-                      "nonp256_sw_lanes": 0}
+                      "nonp256_sw_lanes": 0,
+                      "breaker_state": 0, "breaker_trips": 0,
+                      "breaker_probes": 0,
+                      "breaker_deadline_timeouts": 0,
+                      "breaker_rejected_dispatches": 0,
+                      "degraded_batches": 0,
+                      "warm_table_persist_failures": 0,
+                      "warm_restore_failures": 0}
         self._persist_threads: list = []
         # serializes warm-file mutations (record/trim/drop) with the
         # background table-byte writers' publish step, so a concurrent
@@ -196,21 +212,66 @@ class TPUProvider(api.BCCSP):
     def decrypt(self, key, ciphertext, opts=None):
         return self._sw.decrypt(key, ciphertext, opts)
 
+    # -- degradation surface --
+
+    def health(self) -> str:
+        """Breaker state for /healthz: 'device' | 'degraded' |
+        'probing'. Verdicts are identical in every state; only the
+        serving path (and therefore throughput) differs."""
+        return self._breaker.state
+
+    def _sync_breaker_stats(self) -> None:
+        b = self._breaker
+        self.stats["breaker_state"] = b.state_code
+        self.stats["breaker_trips"] = b.stats["trips"]
+        self.stats["breaker_probes"] = b.stats["probes"]
+        self.stats["breaker_deadline_timeouts"] = \
+            b.stats["deadline_timeouts"]
+        self.stats["breaker_rejected_dispatches"] = b.stats["rejected"]
+
     # -- the batch path --
 
     def verify_batch(self, items: Sequence[api.VerifyItem]) -> list[bool]:
         if len(items) < self._min_batch:
             return self._sw.verify_batch(items)
+        # admission FIRST: admit() resolves the breaker state and the
+        # probe decision atomically, so a cooldown expiring between a
+        # state peek and the dispatch can never send an un-split batch
+        # to the suspect device as the probe
         try:
-            return self._verify_batch_device(items)
+            is_probe = self._breaker.admit()
+        except breaker_mod.CircuitOpen:
+            self.stats["degraded_batches"] += 1
+            self._sync_breaker_stats()
+            return self._sw.verify_batch(items)
+        # probing: risk at most ProbeBatch lanes on the suspect device;
+        # the rest of the batch verifies on the host path (results are
+        # bit-identical either way, so the split is invisible)
+        dev_items, probe_rest = items, None
+        if is_probe:
+            pb = self._breaker.config.probe_batch
+            if pb and len(items) > max(pb, self._min_batch):
+                cut = max(pb, self._min_batch)
+                dev_items, probe_rest = items[:cut], items[cut:]
+        try:
+            out = self._breaker.guard(
+                lambda: self._verify_batch_device(dev_items))
         except Exception:
             self.stats["sw_fallbacks"] += 1
+            self._sync_breaker_stats()
             logger.exception(
                 "TPU batch verify failed; falling back to sw for %d items",
                 len(items))
             return self._sw.verify_batch(items)
+        self._sync_breaker_stats()
+        if probe_rest is not None:
+            out = out + self._sw.verify_batch(probe_rest)
+        return out
 
     def _verify_batch_device(self, items) -> list[bool]:
+        # the tpu.dispatch fault point lives in the INNER dispatch
+        # helpers (_dispatch_arrays/_dispatch_comb_digest) — exactly
+        # one fire per logical batch, whichever path staging takes
         import jax.numpy as jnp
 
         from fabric_tpu.ops import limb, sha256
@@ -376,6 +437,7 @@ class TPUProvider(api.BCCSP):
         With async_out the DISPATCH happens now and a thunk returning
         the materialized np result is returned (jax compute proceeds
         in the background while the caller works)."""
+        faults.check("tpu.dispatch")
         import jax.numpy as jnp
 
         from fabric_tpu.ops import limb
@@ -461,24 +523,55 @@ class TPUProvider(api.BCCSP):
 
         def fallback():
             self.stats["sw_fallbacks"] += 1
+            self._sync_breaker_stats()
             logger.exception("TPU prepared-batch verify failed; "
                              "falling back to sw for %d lanes", n)
             return self._verify_prepared_sw(
                 range(n), digests, key_idx, keys, pubs, get_sig)
 
+        # breaker admission: while degraded every prepared batch rides
+        # the host path (bit-identical verdicts); in probing state this
+        # batch IS the probe — capped at ProbeBatch lanes, the rest on
+        # the host path — and its resolve outcome decides re-entry
         try:
-            resolve = self._verify_prepared_device(
-                digests, r, rpn, w, der_ok, key_idx, keys, pubs,
-                get_sig)
-        except Exception:
+            is_probe = self._breaker.admit()
+        except breaker_mod.CircuitOpen:
+            self.stats["degraded_batches"] += 1
+            self._sync_breaker_stats()
+            out = self._verify_prepared_sw(
+                range(n), digests, key_idx, keys, pubs, get_sig)
+            return lambda: out
+
+        cut = n
+        if is_probe:
+            pb = self._breaker.config.probe_batch
+            if pb and n > max(pb, self._min_batch):
+                cut = max(pb, self._min_batch)
+        try:
+            # staging may pay a first-dispatch compile: mark it live so
+            # a probing breaker's stale-reclaim can't preempt it
+            with self._breaker.execution():
+                resolve = self._verify_prepared_device(
+                    digests[:cut], r[:cut], rpn[:cut], w[:cut],
+                    der_ok[:cut], key_idx[:cut], keys, pubs, get_sig)
+        except Exception as e:
+            self._breaker.failure(e)
             out = fallback()
             return lambda: out
 
         def finish():
             try:
-                return resolve()
+                # the guard runs the deadline watchdog and records the
+                # device outcome (success closes a probing breaker)
+                out = self._breaker.guard(resolve)
             except Exception:
                 return fallback()
+            self._sync_breaker_stats()
+            if cut < n:
+                out = out + self._verify_prepared_sw(
+                    range(cut, n), digests, key_idx, keys, pubs,
+                    get_sig)
+            return out
         return finish
 
     def _verify_prepared_sw(self, lanes, digests, key_idx, keys, pubs,
@@ -879,6 +972,7 @@ class TPUProvider(api.BCCSP):
 
         def work():
             try:
+                faults.check("tpu.table_persist")
                 arr = np.asarray(q_flat)
                 os.makedirs(self._warm_keys_dir, exist_ok=True)
                 path = self._table_path(cache_key, prefix)
@@ -897,6 +991,10 @@ class TPUProvider(api.BCCSP):
                     if entry not in self._load_warm_keys():
                         os.remove(path)
             except Exception:
+                # surfaced as bccsp_warm_table_persist_failures: a node
+                # silently losing its warm bytes pays the multi-minute
+                # rebuild on every restart, which operators must SEE
+                self.stats["warm_table_persist_failures"] += 1
                 logger.exception("could not persist %s table bytes",
                                  prefix)
 
@@ -916,13 +1014,23 @@ class TPUProvider(api.BCCSP):
 
     def flush_warm_tables(self, timeout: float = 120.0) -> None:
         """Join outstanding table-persist writers and the background
-        restore (shutdown/bench)."""
+        restore (shutdown/bench). `timeout` bounds the TOTAL wait, not
+        each join — N stuck writers must not turn shutdown into
+        N x timeout."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
         if self._restore_thread is not None:
-            self._restore_thread.join(timeout)
+            self._restore_thread.join(
+                max(0.0, deadline - _time.monotonic()))
         for t in self._persist_threads:
-            t.join(timeout)
-        self._persist_threads = [
-            t for t in self._persist_threads if t.is_alive()]
+            t.join(max(0.0, deadline - _time.monotonic()))
+        stuck = [t for t in self._persist_threads if t.is_alive()]
+        if stuck:
+            logger.warning(
+                "%d warm-table persist writer(s) still running after "
+                "the %.0fs flush deadline; leaving them detached",
+                len(stuck), timeout)
+        self._persist_threads = stuck
 
     def _load_table(self, cache_key, want_bytes: int, prefix: str):
         if not self._warm_keys_dir:
@@ -1012,6 +1120,7 @@ class TPUProvider(api.BCCSP):
                         # live misses to stream in
                         break
                 except Exception:
+                    self.stats["warm_restore_failures"] += 1
                     logger.exception("warm table restore failed for "
                                      "one set")
                 finally:
@@ -1134,6 +1243,7 @@ class TPUProvider(api.BCCSP):
         conversion ON DEVICE, no SHA stage (_comb_pipeline_digest) —
         the transfer-minimal shape for the host-hash default and the
         prepared-block fast path."""
+        faults.check("tpu.dispatch")
         import jax.numpy as jnp
 
         key_idx, K, q_flat, g16, q16 = self._resolve_tables(key_map,
@@ -1181,6 +1291,7 @@ class TPUProvider(api.BCCSP):
     def _qtab_fn(self, K: int):
         with self._jit_lock:
             if K not in self._qtab_fns:
+                faults.check("tpu.compile")
                 import jax
 
                 from fabric_tpu.ops import comb
@@ -1191,6 +1302,7 @@ class TPUProvider(api.BCCSP):
         key = ("q16", K)
         with self._jit_lock:
             if key not in self._qtab_fns:
+                faults.check("tpu.compile")
                 import jax
 
                 from fabric_tpu.ops import comb
@@ -1205,6 +1317,7 @@ class TPUProvider(api.BCCSP):
 
     def _comb_pipeline_locked(self, key, K: int, q16: bool):
         if key not in self._comb_fns:
+            faults.check("tpu.compile")
             import jax
 
             from fabric_tpu.ops import comb, sha256
@@ -1255,6 +1368,7 @@ class TPUProvider(api.BCCSP):
         key = ("digest", K, q16)
         with self._jit_lock:
             if key not in self._comb_fns:
+                faults.check("tpu.compile")
                 import jax
 
                 from fabric_tpu.ops import comb, limb
@@ -1291,6 +1405,7 @@ class TPUProvider(api.BCCSP):
 
     def _pipeline(self):
         if self._fn is None:
+            faults.check("tpu.compile")
             import jax
 
             from fabric_tpu.ops import p256, sha256

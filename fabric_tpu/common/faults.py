@@ -1,0 +1,167 @@
+"""Process-wide fault-injection registry for chaos testing.
+
+Liveness-critical paths carry NAMED fault points — hooks that are
+no-ops in production (an unarmed `check()` is one dict lookup) but can
+be armed by tests and chaos runs to raise, stall, or fail-N-times.
+The well-known points:
+
+    tpu.dispatch       every device batch dispatch (bccsp/tpu.py)
+    tpu.compile        jit pipeline builds / AOT compiles
+    tpu.table_persist  warm-table byte writers
+    raft.step          inbound raft messages (orderer raft chain loop)
+    deliver.stream     the peer's block-deliver stream
+
+Arbitrary names are allowed — a new subsystem adds a `check()` call
+and tests arm it by string, no registration step.
+
+Arming:
+  - code:  `faults.arm("tpu.dispatch", mode="error", count=3)`
+  - env:   FTPU_FAULTS="tpu.dispatch=error:3;deliver.stream=delay::0.2"
+           parsed at import and re-applied by `reset()`, so a chaos CI
+           pass (tools/chaos_check.sh) arms a whole pytest run while
+           each test still starts from the same armed baseline.
+
+Spec grammar: `point=mode[:count][:delay_s]`, `mode` in {error, delay};
+empty count = unlimited. A `delay` fault sleeps then proceeds (a stall,
+for deadline/breaker testing); an `error` fault raises FaultInjected.
+
+Counts are consumed per fire; `fires(point)` reports how often a point
+actually fired (armed or not, a check on an unarmed point counts
+nothing — firing means the fault acted).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger("common.faults")
+
+ENV_VAR = "FTPU_FAULTS"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed `error` fault point."""
+
+
+@dataclass
+class _Arming:
+    mode: str                      # "error" | "delay"
+    count: Optional[int] = None    # remaining fires; None = unlimited
+    delay_s: float = 0.0
+    message: str = ""
+
+
+class FaultRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._armed: dict[str, _Arming] = {}
+        self._fires: dict[str, int] = {}
+
+    # -- arming --
+
+    def arm(self, point: str, mode: str = "error",
+            count: Optional[int] = None, delay_s: float = 0.0,
+            message: str = "") -> None:
+        if mode not in ("error", "delay"):
+            raise ValueError(f"unknown fault mode {mode!r}")
+        with self._lock:
+            self._armed[point] = _Arming(mode=mode, count=count,
+                                         delay_s=delay_s,
+                                         message=message)
+        logger.info("fault point %s armed: mode=%s count=%s delay=%.3fs",
+                    point, mode, count, delay_s)
+
+    def disarm(self, point: str) -> None:
+        with self._lock:
+            self._armed.pop(point, None)
+
+    def clear(self) -> None:
+        """Disarm everything, including env-armed faults."""
+        with self._lock:
+            self._armed.clear()
+            self._fires.clear()
+
+    def reset(self) -> None:
+        """Back to the process baseline: clear, then re-apply the
+        FTPU_FAULTS env arming (per-test isolation for chaos runs)."""
+        self.clear()
+        self.arm_from_env()
+
+    def arm_from_env(self, spec: Optional[str] = None) -> None:
+        spec = os.environ.get(ENV_VAR, "") if spec is None else spec
+        if not spec:
+            return
+        for part in spec.replace(",", ";").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                point, _, rhs = part.partition("=")
+                fields = rhs.split(":")
+                mode = fields[0] or "error"
+                count = (int(fields[1])
+                         if len(fields) > 1 and fields[1] else None)
+                delay = (float(fields[2])
+                         if len(fields) > 2 and fields[2] else 0.0)
+                self.arm(point.strip(), mode=mode, count=count,
+                         delay_s=delay, message=f"env:{ENV_VAR}")
+            except (ValueError, IndexError):
+                logger.warning("ignoring malformed %s entry %r",
+                               ENV_VAR, part)
+
+    # -- observation --
+
+    def fires(self, point: str) -> int:
+        with self._lock:
+            return self._fires.get(point, 0)
+
+    def armed(self, point: str) -> bool:
+        with self._lock:
+            return point in self._armed
+
+    # -- the hot-path hook --
+
+    def check(self, point: str) -> None:
+        """Fire the fault armed at `point`, if any. Near-free when
+        nothing is armed (the production state)."""
+        if not self._armed:
+            return
+        with self._lock:
+            a = self._armed.get(point)
+            if a is None:
+                return
+            if a.count is not None:
+                a.count -= 1
+                if a.count <= 0:
+                    del self._armed[point]
+            self._fires[point] = self._fires.get(point, 0) + 1
+            mode, delay_s, msg = a.mode, a.delay_s, a.message
+        # act OUTSIDE the lock: a delay fault must not serialize every
+        # other fault point behind its sleep
+        if mode == "delay":
+            time.sleep(delay_s)
+            return
+        raise FaultInjected(
+            f"injected fault at {point}" + (f" ({msg})" if msg else ""))
+
+
+_registry = FaultRegistry()
+
+# module-level convenience API (the registry is process-wide state,
+# like the bccsp factory singleton)
+arm = _registry.arm
+disarm = _registry.disarm
+clear = _registry.clear
+reset = _registry.reset
+arm_from_env = _registry.arm_from_env
+fires = _registry.fires
+armed = _registry.armed
+check = _registry.check
+
+# chaos runs arm the whole process via env before interpreter start
+_registry.arm_from_env()

@@ -61,6 +61,31 @@ def _label_key(
     return tuple((n, given.get(n, "")) for n in names)
 
 
+# -- shared degradation instruments --
+#
+# One spelling for the graceful-degradation surfaces, whichever node
+# assembly (peer or orderer) wires them: the TPU verify path's breaker
+# state, and the robustness counters the chaos subsystem exposes.
+# Components create them via `provider.new_*(OPTS)`; the registry
+# dedupes by fully-qualified name.
+
+BCCSP_FALLBACK_STATE_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="fallback", name="state",
+    help="TPU verify path breaker state: 0 device, 1 probing, "
+         "2 degraded (sw fallback serving).")
+
+BCCSP_FALLBACK_TRIPS_OPTS = CounterOpts(
+    namespace="bccsp", subsystem="fallback", name="trips_total",
+    help="Circuit-breaker trips: the device was benched after "
+         "consecutive dispatch failures or deadline stalls.")
+
+DELIVER_RECONNECTS_OPTS = CounterOpts(
+    namespace="deliver", subsystem="client", name="reconnects",
+    help="Deliver-stream reconnect attempts after a stream failure "
+         "(full-jitter backoff between attempts).",
+    label_names=("channel",))
+
+
 class Counter:
     def __init__(self, opts: CounterOpts):
         self.opts = opts

@@ -91,12 +91,36 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
                  "(TPUProvider.stats)")).with_labels()
         for name in stats
     }
+    # the canonical degradation instruments (the names operators
+    # alert on): breaker state gauge + trip counter, fed from the
+    # provider's breaker rather than the stats dict so they track
+    # state changes even between dispatches
+    breaker = getattr(csp, "_breaker", None)
+    fallback_state = fallback_trips = None
+    if breaker is not None:
+        try:
+            fallback_state = metrics_provider.new_gauge(
+                metrics_mod.BCCSP_FALLBACK_STATE_OPTS).with_labels()
+            fallback_trips = metrics_provider.new_counter(
+                metrics_mod.BCCSP_FALLBACK_TRIPS_OPTS).with_labels()
+        except Exception:
+            fallback_state = fallback_trips = None
 
     def poll():
+        last_trips = 0
         while True:
             for name, g in gauges.items():
                 try:
                     g.set(float(stats.get(name, 0)))
+                except Exception:
+                    pass
+            if fallback_state is not None:
+                try:
+                    fallback_state.set(float(breaker.state_code))
+                    trips = breaker.stats["trips"]
+                    if trips > last_trips:
+                        fallback_trips.add(trips - last_trips)
+                        last_trips = trips
                 except Exception:
                     pass
             time.sleep(poll_s)

@@ -20,10 +20,16 @@ from __future__ import annotations
 import datetime
 import os
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+# gated import: without the `cryptography` wheel this module still
+# imports; cert GENERATION then raises MissingCryptographyError at
+# call time (there is no honest pure-python x509 builder)
+from fabric_tpu.bccsp._crypto_compat import (
+    NameOID,
+    ec,
+    hashes,
+    serialization,
+    x509,
+)
 
 _NOT_BEFORE = datetime.datetime(2020, 1, 1)
 _NOT_AFTER = datetime.datetime(2099, 1, 1)

@@ -30,8 +30,7 @@ import hashlib
 import threading
 from typing import Optional, Sequence
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric import ec
+from fabric_tpu.bccsp._crypto_compat import ec, serialization
 
 from fabric_tpu.bccsp import bccsp as bapi
 from fabric_tpu.bccsp import utils as butils
@@ -148,10 +147,7 @@ class IdemixIssuer:
                     nym_pub=nym_pub, ou=ou, role=role,
                     bls_sig=bref.g1_to_bytes(sig_pt))))
                 continue
-            from cryptography.hazmat.primitives.asymmetric.utils import (
-                Prehashed,
-            )
-            from cryptography.hazmat.primitives import hashes
+            from fabric_tpu.bccsp._crypto_compat import Prehashed, hashes
             sig = self._key.sign(digest,
                                  ec.ECDSA(Prehashed(hashes.SHA256())))
             r, s = butils.unmarshal_signature(sig)
@@ -220,7 +216,7 @@ class IdemixSigningIdentity(IdemixIdentity, api.SigningIdentity):
         self._priv = nym_priv
 
     def sign(self, msg: bytes) -> bytes:
-        from cryptography.hazmat.primitives import hashes
+        from fabric_tpu.bccsp._crypto_compat import hashes
         sig = self._priv.sign(msg, ec.ECDSA(hashes.SHA256()))
         r, s = butils.unmarshal_signature(sig)
         return butils.marshal_signature(r, butils.to_low_s(s))

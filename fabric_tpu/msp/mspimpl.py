@@ -19,12 +19,18 @@ from __future__ import annotations
 import datetime
 from typing import Optional, Sequence
 
-from cryptography import x509
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric import ec, padding
-from cryptography.hazmat.primitives.serialization import Encoding
+# gated import: hosts without the `cryptography` wheel can still
+# import the node assemblies; actual x509 use raises
+# MissingCryptographyError (see bccsp/_crypto_compat.py)
+from fabric_tpu.bccsp._crypto_compat import (
+    InvalidSignature,
+    ec,
+    padding,
+    serialization,
+    x509,
+)
 
-_DER = Encoding.DER
+_DER = serialization.Encoding.DER
 
 from fabric_tpu.bccsp import bccsp as bccsp_api
 from fabric_tpu.bccsp.bccsp import VerifyItem

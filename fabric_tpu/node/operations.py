@@ -80,7 +80,10 @@ class OperationsServer:
     def register_checker(self, component: str,
                          check: Callable[[], None]) -> None:
         """`check()` raises when unhealthy (reference: healthz
-        HealthChecker)."""
+        HealthChecker). A checker may also RETURN a status string
+        (e.g. the bccsp breaker's device|degraded|probing) — surfaced
+        in the healthz body's `components` map without failing the
+        check, for states that are degraded-but-serving."""
         self._checkers[component] = check
 
     def register_handler(self, prefix: str,
@@ -127,17 +130,25 @@ class OperationsServer:
 
     def _healthz(self, h) -> None:
         failed = []
+        components = {}
         for name, check in self._checkers.items():
             try:
-                check()
+                status = check()
             except Exception as e:
                 failed.append({"component": name, "reason": str(e)})
+                components[name] = "failed"
+                continue
+            if isinstance(status, str) and status:
+                components[name] = status
+        body: dict = {"status": "OK"}
+        if components:
+            body["components"] = components
         if failed:
-            h._reply(503, json.dumps(
-                {"status": "Service Unavailable",
-                 "failed_checks": failed}).encode())
+            body["status"] = "Service Unavailable"
+            body["failed_checks"] = failed
+            h._reply(503, json.dumps(body).encode())
         else:
-            h._reply(200, json.dumps({"status": "OK"}).encode())
+            h._reply(200, json.dumps(body).encode())
 
     def _debug(self, h, path: str) -> None:
         """pprof-analog surfaces (reference: net/http/pprof on the ops

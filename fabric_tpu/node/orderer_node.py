@@ -63,6 +63,10 @@ class OrdererNode:
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(
             bccsp_factory.FactoryOpts.from_config(bccsp_cfg))
+        # breaker/degradation counters (bccsp_*) scrapeable on the
+        # orderer's /metrics too, not just the peer's
+        from fabric_tpu.common import profiling
+        profiling.publish_provider_stats(provider, csp)
         msp_dir = cfg.get_path("General.LocalMSPDir")
         msp_id = cfg.get("General.LocalMSPID", "OrdererMSP")
         local_msp = X509MSP(csp)
@@ -183,6 +187,11 @@ class OrdererNode:
             profile_enabled=bool(cfg.get("Operations.Profile.Enabled",
                                          False)))
         self.ops.register_checker("orderer", lambda: None)
+        # breaker state of the sig-filter's TPU provider on /healthz
+        # (device | degraded | probing); degraded still serves
+        health = getattr(csp, "health", None)
+        if callable(health):
+            self.ops.register_checker("bccsp", health)
         self.ops.register_handler("/participation",
                                   self._participation_http(
                                       participation))

@@ -239,6 +239,12 @@ class PeerNode:
             profile_enabled=bool(cfg.get("operations.profile.enabled",
                                          False)))
         self.ops.register_checker("peer", lambda: None)
+        # the TPU provider's breaker state on /healthz: degraded means
+        # verdicts are served (bit-identically) by the sw path while
+        # the device cools down — report, don't fail the node
+        health = getattr(csp, "health", None)
+        if callable(health):
+            self.ops.register_checker("bccsp", health)
         self.ops.register_handler("/admin", self._admin_http)
         self.ops.start()
 
@@ -305,8 +311,9 @@ class PeerNode:
         source = self._deliver_client_factory()
         self.gossip.initialize_channel(
             channel,
-            lambda adapter: Deliverer(adapter, self.peer.signer,
-                                      source, self.peer.mcs))
+            lambda adapter: Deliverer(
+                adapter, self.peer.signer, source, self.peer.mcs,
+                metrics_provider=getattr(self, "metrics", None)))
         logger.info("joined channel %s", channel.channel_id)
 
     def _gossip_endorsers(self, channel_id: str) -> dict:
@@ -371,7 +378,8 @@ class PeerNode:
             source = self._deliver_client_factory()
             self.gossip.initialize_channel(
                 ch, lambda adapter: Deliverer(
-                    adapter, self.peer.signer, source, self.peer.mcs))
+                    adapter, self.peer.signer, source, self.peer.mcs,
+                    metrics_provider=getattr(self, "metrics", None)))
             return 201, json.dumps(
                 {"status": "joined", "height": ch.ledger.height}
             ).encode()

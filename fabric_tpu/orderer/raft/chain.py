@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Optional
 
+from fabric_tpu.common import faults
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
 from fabric_tpu.orderer.raft.core import LEADER, RaftNode
 from fabric_tpu.orderer.raft.storage import RaftStorage
@@ -404,6 +405,25 @@ class RaftChain:
     # main loop (reference chain.go run:599)
     # ------------------------------------------------------------------
 
+    def _handle_event(self, ev, now: float) -> None:
+        """One drained event. A failing raft step is a DROPPED message
+        (raft's retransmission recovers it), never a reason to abort
+        the rest of the drain's events; `raft.step` is the chaos point
+        that models message loss/corruption."""
+        if ev[0] == "step":
+            try:
+                faults.check("raft.step")
+                self._peer_seen[ev[1].from_] = now
+                self.node.step(ev[1])
+            except Exception:
+                logger.exception("[%s] raft step failed; message "
+                                 "dropped", self._support.channel_id)
+        elif ev[0] == "order":
+            self._process_order(ev[1], ev[2], ev[3])
+        elif ev[0] == "order_batch":
+            for env, seq in ev[1]:
+                self._process_order(env, seq, False)
+
     def _run(self) -> None:
         next_tick = time.monotonic() + self._tick_s
         while not self._halted.is_set():
@@ -434,14 +454,7 @@ class RaftChain:
             try:
                 now = time.monotonic()
                 for ev in evs:
-                    if ev[0] == "step":
-                        self._peer_seen[ev[1].from_] = now
-                        self.node.step(ev[1])
-                    elif ev[0] == "order":
-                        self._process_order(ev[1], ev[2], ev[3])
-                    elif ev[0] == "order_batch":
-                        for env, seq in ev[1]:
-                            self._process_order(env, seq, False)
+                    self._handle_event(ev, now)
                 if now >= next_tick:
                     self.node.tick()
                     next_tick = now + self._tick_s

@@ -15,10 +15,12 @@ logic either way, mirroring `internal/pkg/peer/orderers`).
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Callable, Optional
 
+from fabric_tpu.common import faults, metrics as metrics_mod
 from fabric_tpu.protos import common, orderer as ordpb
 from fabric_tpu.protoutil import protoutil as pu
 
@@ -53,7 +55,7 @@ class Deliverer:
 
     def __init__(self, channel, signer, orderer_source: Callable,
                  mcs, retry_base_s: float = 0.1,
-                 retry_max_s: float = 10.0):
+                 retry_max_s: float = 10.0, metrics_provider=None):
         """`orderer_source()` → an object whose `handle(env)` yields
         DeliverResponse (in-process DeliverHandler or a gRPC
         adapter)."""
@@ -65,6 +67,19 @@ class Deliverer:
         self._retry_max_s = retry_max_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # consecutive stream failures; RESET after every successfully
+        # processed block, so one long outage doesn't pin the stream
+        # at retry_max_s forever afterwards
+        self._failures = 0
+        self.reconnects = 0
+        self._reconnects_metric = None
+        if metrics_provider is not None:
+            try:
+                self._reconnects_metric = metrics_provider.new_counter(
+                    metrics_mod.DELIVER_RECONNECTS_OPTS).with_labels(
+                    "channel", channel.channel_id)
+            except Exception:
+                logger.debug("deliver_reconnects counter unavailable")
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -78,21 +93,28 @@ class Deliverer:
             self._thread.join(timeout=5)
 
     def _run(self) -> None:
-        failures = 0
         while not self._stop.is_set():
             try:
                 endpoint = self._orderer_source()
                 if endpoint is None:
                     raise ConnectionError("no orderer endpoint")
                 self._pull(endpoint)
-                failures = 0
+                self._failures = 0
             except Exception as e:
-                failures += 1
-                delay = min(self._retry_base_s * (2 ** failures),
-                            self._retry_max_s)
+                self._failures += 1
+                self.reconnects += 1
+                if self._reconnects_metric is not None:
+                    self._reconnects_metric.add(1)
+                # FULL jitter (exponential cap, uniform draw): a fleet
+                # of peers reconnecting to a recovered orderer must not
+                # arrive in synchronized waves
+                cap = min(self._retry_base_s * (2 ** self._failures),
+                          self._retry_max_s)
+                delay = random.uniform(0, cap)
                 logger.warning(
-                    "[%s] deliver stream failed (%s); retry in %.1fs",
-                    self._channel.channel_id, e, delay)
+                    "[%s] deliver stream failed (%s); retry in %.2fs "
+                    "(attempt %d)", self._channel.channel_id, e, delay,
+                    self._failures)
                 self._stop.wait(delay)
 
     def _pull(self, endpoint) -> None:
@@ -102,6 +124,7 @@ class Deliverer:
         for resp in endpoint.handle(env):
             if self._stop.is_set():
                 return
+            faults.check("deliver.stream")
             which = resp.WhichOneof("type")
             if which == "status":
                 raise ConnectionError(
@@ -112,3 +135,7 @@ class Deliverer:
             self._mcs.verify_block(channel.channel_id,
                                    channel.ledger.height, block)
             channel.process_block(block)
+            # a processed block proves the stream is healthy again:
+            # reset the backoff so the NEXT outage starts from the
+            # base delay instead of the previous outage's ceiling
+            self._failures = 0

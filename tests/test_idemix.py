@@ -203,7 +203,8 @@ def _serialize(msp, cred):
 
 
 class TestIdemixOnChannel:
-    def test_idemix_client_submits_transactions(self, tmp_path):
+    def test_idemix_client_submits_transactions(self, tmp_path,
+                                                require_cryptography):
         root = tmp_path
         cdir = str(root / "crypto")
         org1 = cryptogen.generate_org(cdir, "org1.example.com",

@@ -82,12 +82,16 @@ class TestDebugEndpoints:
                 time.sleep(0.05)
 
         # one retry: on a loaded single-core box the 0.4 s window can
-        # close before the worker thread's first op lands in it
+        # close before the worker thread's first op lands in it. The
+        # profiler capture itself can also take minutes under full-
+        # suite load — use a generous read timeout, not _get's 30 s.
         found = []
         for attempt in range(2):
             threading.Thread(target=work, daemon=True).start()
-            status, body = _get(
-                ops.address, "/debug/jax/trace?seconds=0.4")
+            with urllib.request.urlopen(
+                    f"http://{ops.address}/debug/jax/trace?seconds=0.4",
+                    timeout=300) as r:
+                status, body = r.status, r.read()
             assert status == 200
             out = json.loads(body)["trace_dir"]
             assert "jax_trace_" in out    # server-chosen dir, never

@@ -411,6 +411,10 @@ class RaftNet:
 
 @pytest.fixture(scope="class")
 def raftnet(tmp_path_factory):
+    from fabric_tpu.bccsp._crypto_compat import HAVE_CRYPTOGRAPHY
+    if not HAVE_CRYPTOGRAPHY:
+        pytest.skip("x509 cert generation needs the 'cryptography' "
+                    "wheel (pure-python backend covers ECDSA only)")
     net = RaftNet(str(tmp_path_factory.mktemp("raft")))
     yield net
     net.halt()

@@ -56,7 +56,7 @@ def test_factory_config_selects_tpu():
     assert csp._min_batch == 1
 
 
-def test_device_validator_matches_sw(tmp_path):
+def test_device_validator_matches_sw(tmp_path, require_cryptography):
     # -- stand up a small sw-wired network and commit a block --
     csp = SWProvider()
     cdir = str(tmp_path / "crypto")

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Chaos gate: re-run the bccsp / raft / deliver test subsets with fault
+# points ARMED via env (fabric_tpu/common/faults.py parses FTPU_FAULTS
+# at interpreter start; the conftest fixture re-applies it per test).
+#
+# The claim under test: armed faults change WHICH path serves — never
+# verdicts, never liveness. Tests that pin device-path internals clear
+# the ambient arming themselves; everything else must stay green with
+# errors and stalls injected at every named fault point.
+#
+# Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow'
+        -p no:cacheprovider -p no:randomly)
+
+run() {
+    local faults="$1"; shift
+    echo "== chaos pass: FTPU_FAULTS='${faults}' $*"
+    FTPU_FAULTS="$faults" "${PYTEST[@]}" "$@"
+}
+
+# 1) bccsp: transient device errors at every dispatch/compile/persist
+#    point — breaker + sw fallback keep every verdict bit-identical
+run "tpu.dispatch=error:2;tpu.compile=error:1;tpu.table_persist=error:1" \
+    tests/test_chaos.py tests/test_bucket_floor.py
+
+# 2) bccsp under stalls: delayed dispatches instead of errors
+run "tpu.dispatch=delay:2:0.05" \
+    tests/test_chaos.py -k "Degradation or FaultRegistry"
+
+# 3) raft: dropped step messages per test — elections/replication must
+#    still converge (core tests drive the protocol; chain tests cover
+#    the armed fault point)
+run "raft.step=error:3" tests/test_raft.py tests/test_chaos.py -k Raft
+
+# 4) deliver: torn streams force the reconnect/backoff path
+run "deliver.stream=error:2" tests/test_chaos.py -k Deliver
+
+echo "chaos_check: all passes green"
