@@ -39,6 +39,12 @@ class TpuOpts:
     # gets the measured kernel, not a degraded one.
     use_g16: Optional[bool] = None
     chunk: int = 32768
+    # dispatch-pipeline chunk (BCCSP.TPU.PipelineChunk): a device batch
+    # is split into spans of this many lanes so stage N's device
+    # execution overlaps stage N+1's host prep (native DER parse, limb
+    # packing) and host->device transfer. 0 disables the overlapped
+    # pipeline (whole-batch staging, the pre-round-6 behavior).
+    pipeline_chunk: int = 8192
     max_keys: int = 16
     table_cache_bytes: int = 6 << 30
     # True (default): hash message lanes on host, ship 32-byte digests
@@ -91,6 +97,7 @@ class FactoryOpts:
                 use_g16=(bool(tpu_cfg["UseG16"])
                          if tpu_cfg.get("UseG16") is not None else None),
                 chunk=int(tpu_cfg.get("Chunk", 32768)),
+                pipeline_chunk=int(tpu_cfg.get("PipelineChunk", 8192)),
                 max_keys=int(tpu_cfg.get("MaxKeys", 16)),
                 table_cache_bytes=(
                     int(tpu_cfg.get("TableCacheMB", 6144)) << 20),
@@ -121,6 +128,12 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
         return SWProvider(ks)
     if opts.default == "TPU":
         from fabric_tpu.bccsp.tpu import TPUProvider
+        from fabric_tpu.common import jaxenv
+        # compiled verify kernels are part of the node's warm state:
+        # key the persistent XLA cache under the warm-table dir so a
+        # restart (or the next bench process) skips the ~minutes
+        # compiles along with the table rebuilds
+        jaxenv.enable_cache_under(opts.tpu.warm_keys_dir)
         mesh = None
         if opts.tpu.n_devices:
             from fabric_tpu.parallel import batch_mesh
@@ -129,6 +142,7 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            max_blocks=opts.tpu.max_blocks, mesh=mesh,
                            max_keys=opts.tpu.max_keys,
                            chunk=opts.tpu.chunk,
+                           pipeline_chunk=opts.tpu.pipeline_chunk,
                            use_g16=opts.tpu.use_g16,
                            table_cache_bytes=opts.tpu.table_cache_bytes,
                            hash_on_host=opts.tpu.hash_on_host,

@@ -47,3 +47,17 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     except Exception:
         logger.exception("could not enable the XLA compilation cache")
         return None
+
+
+def enable_cache_under(warm_dir: str | None) -> str | None:
+    """Key the persistent compilation cache under a provider's warm
+    state directory (``<warm_dir>/xla_cache``) so the ~minutes kernel
+    compiles are paid once per MACHINE, not once per process — compiled
+    programs live beside the warm Q-table bytes they serve.
+
+    An explicit $FABRIC_TPU_XLA_CACHE (including the empty string,
+    which disables caching) still wins; with no warm dir this falls
+    back to the ~/.cache default."""
+    if os.environ.get(_ENV) is not None or not warm_dir:
+        return enable_compilation_cache()
+    return enable_compilation_cache(os.path.join(warm_dir, "xla_cache"))

@@ -79,6 +79,28 @@ BCCSP_FALLBACK_TRIPS_OPTS = CounterOpts(
     help="Circuit-breaker trips: the device was benched after "
          "consecutive dispatch failures or deadline stalls.")
 
+BCCSP_PIPELINE_HOST_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pipeline", name="host_s",
+    help="Host-prep seconds (DER parse, limb packing, digest hashing) "
+         "spent staging the most recent overlapped verify batch.")
+
+BCCSP_PIPELINE_TRANSFER_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pipeline", name="transfer_s",
+    help="Host-to-device transfer-enqueue seconds for the most recent "
+         "overlapped verify batch (async device_put ahead of "
+         "dispatch).")
+
+BCCSP_PIPELINE_DEVICE_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pipeline", name="device_s",
+    help="Device dispatch + result-materialization seconds for the "
+         "most recent overlapped verify batch.")
+
+BCCSP_PIPELINE_OVERLAP_RATIO_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pipeline", name="overlap_ratio",
+    help="Fraction of host-prep time hidden behind device execution "
+         "in the most recent overlapped verify batch: 0 = fully "
+         "serial, (chunks-1)/chunks = fully pipelined.")
+
 DELIVER_RECONNECTS_OPTS = CounterOpts(
     namespace="deliver", subsystem="client", name="reconnects",
     help="Deliver-stream reconnect attempts after a stream failure "

@@ -84,11 +84,24 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
     stats = getattr(csp, "stats", None)
     if not isinstance(stats, dict):
         return None
+    # the pipeline stage timers have canonical declarations (help text,
+    # gendoc rows) in common/metrics.py; every other stats key gets a
+    # generic gauge named after it
+    canonical = {
+        "pipeline_host_s": metrics_mod.BCCSP_PIPELINE_HOST_SECONDS_OPTS,
+        "pipeline_transfer_s":
+            metrics_mod.BCCSP_PIPELINE_TRANSFER_SECONDS_OPTS,
+        "pipeline_device_s":
+            metrics_mod.BCCSP_PIPELINE_DEVICE_SECONDS_OPTS,
+        "pipeline_overlap_ratio":
+            metrics_mod.BCCSP_PIPELINE_OVERLAP_RATIO_OPTS,
+    }
     gauges = {
-        name: metrics_provider.new_gauge(metrics_mod.GaugeOpts(
-            namespace="bccsp", name=name,
-            help="BCCSP provider runtime counter "
-                 "(TPUProvider.stats)")).with_labels()
+        name: metrics_provider.new_gauge(canonical.get(
+            name, metrics_mod.GaugeOpts(
+                namespace="bccsp", name=name,
+                help="BCCSP provider runtime counter "
+                     "(TPUProvider.stats)"))).with_labels()
         for name in stats
     }
     # the canonical degradation instruments (the names operators

@@ -103,6 +103,19 @@ def _load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE"),
         ]
         lib.ftpu_batch_prep.restype = None
+        _u8w = np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE")
+        if hasattr(lib, "ftpu_batch_prep_ptrs"):
+            # pointer-table entry point: no blob join, so the
+            # overlapped verify pipeline's per-span worker preps
+            # straight from the signature bytes (the C call releases
+            # the GIL — host prep genuinely overlaps dispatch)
+            lib.ftpu_batch_prep_ptrs.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                np.ctypeslib.ndpointer(np.int32, flags="C"),
+                ctypes.c_int32,
+                _u8w, _u8w, _u8w, _u8w,
+            ]
+            lib.ftpu_batch_prep_ptrs.restype = None
         _i32 = np.ctypeslib.ndpointer(np.int32, flags="C,WRITEABLE")
         _i64 = np.ctypeslib.ndpointer(np.int64, flags="C,WRITEABLE")
         _u8 = np.ctypeslib.ndpointer(np.uint8, flags="C,WRITEABLE")
@@ -313,22 +326,31 @@ def batch_prep(signatures: list[bytes]
     Returns (ok bool[n], r u8[n,32], rpn u8[n,32], w u8[n,32]) — all
     big-endian scalars, zeros where ok is False — or None when the
     native library is unavailable.
+
+    Thread-safe and GIL-releasing (a plain ctypes call): the TPU
+    provider's overlapped pipeline runs this on a worker thread while
+    the main thread dispatches the previous span.
     """
     lib = _load()
     if lib is None:
         return None
     n = len(signatures)
-    blob = b"".join(signatures)
-    offs = np.zeros(n, dtype=np.int32)
-    lens = np.zeros(n, dtype=np.int32)
-    pos = 0
-    for i, sig in enumerate(signatures):
-        offs[i] = pos
-        lens[i] = len(sig)
-        pos += len(sig)
+    lens = np.array([len(sig) for sig in signatures], dtype=np.int32)
     r = np.zeros((n, 32), dtype=np.uint8)
     rpn = np.zeros((n, 32), dtype=np.uint8)
     w = np.zeros((n, 32), dtype=np.uint8)
     ok = np.zeros(n, dtype=np.uint8)
-    lib.ftpu_batch_prep(blob, offs, lens, n, r, rpn, w, ok)
+    if hasattr(lib, "ftpu_batch_prep_ptrs"):
+        # pointer table straight over the signature bytes: no O(batch
+        # bytes) blob copy per call (this runs once per pipeline span)
+        ptrs = (ctypes.c_char_p * max(n, 1))(*signatures)
+        lib.ftpu_batch_prep_ptrs(ptrs, lens, n, r, rpn, w, ok)
+    else:
+        blob = b"".join(signatures)
+        offs = np.zeros(n, dtype=np.int32)
+        pos = 0
+        for i, sig in enumerate(signatures):
+            offs[i] = pos
+            pos += len(sig)
+        lib.ftpu_batch_prep(blob, offs, lens, n, r, rpn, w, ok)
     return ok.astype(bool), r, rpn, w

@@ -46,6 +46,60 @@ NENT = 1 << WBITS           # table entries per window
 
 
 # ---------------------------------------------------------------------------
+# Persisted-table integrity: every *.npy this framework writes to a
+# warm/cache dir carries a sha256 sidecar (<path>.sha256). A table
+# corrupted on disk (bit rot, torn write survived by rename, operator
+# truncation) must fall back to a REBUILD, never feed the verify
+# kernel wrong points — a wrong Q-table entry flips verdicts silently.
+# ---------------------------------------------------------------------------
+
+def file_sha256(path: str, blk: int = 1 << 20) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(blk), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_digest_sidecar(path: str, digest: str | None = None) -> None:
+    """Record `path`'s sha256 beside it (tmp+rename; best-effort at
+    call sites — a missing sidecar degrades to trust-the-bytes)."""
+    import os
+    if digest is None:
+        digest = file_sha256(path)
+    side = path + ".sha256"
+    tmp = side + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(digest)
+    os.replace(tmp, side)
+
+
+def verify_digest_sidecar(path: str):
+    """True = digest matches; False = MISMATCH (corrupt — caller must
+    rebuild); None = no sidecar (legacy file, caller's choice)."""
+    try:
+        with open(path + ".sha256") as f:
+            want = f.read().strip()
+    except FileNotFoundError:
+        return None
+    except Exception:
+        return None
+    try:
+        return file_sha256(path) == want
+    except Exception:
+        return False
+
+
+def drop_digest_sidecar(path: str) -> None:
+    import os
+    try:
+        os.remove(path + ".sha256")
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
 # G-side tables (host-precomputed constants)
 # ---------------------------------------------------------------------------
 
@@ -65,10 +119,11 @@ def g_tables() -> np.ndarray:
         os.path.expanduser("~/.cache/fabric_tpu/gtab8.npy"))
     if cache:
         try:
-            arr = np.load(cache)
-            if (arr.dtype == np.int32
-                    and arr.shape == (NWIN * NENT, 3, L)):
-                return arr
+            if verify_digest_sidecar(cache) is not False:
+                arr = np.load(cache)
+                if (arr.dtype == np.int32
+                        and arr.shape == (NWIN * NENT, 3, L)):
+                    return arr
         except FileNotFoundError:
             pass
         except Exception:
@@ -89,7 +144,9 @@ def g_tables() -> np.ndarray:
             tmp = cache + f".tmp{os.getpid()}"
             with open(tmp, "wb") as f:
                 np.save(f, out)
+            digest = file_sha256(tmp)
             os.replace(tmp, cache)
+            write_digest_sidecar(cache, digest)
         except Exception:
             pass                          # best-effort persistence
     return out

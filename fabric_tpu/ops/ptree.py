@@ -41,6 +41,21 @@ from fabric_tpu.ops.limb import L, MASK, W
 
 BLOCK_B = 512               # batch lanes per kernel program
 
+# Lane-count granule for callers that slice a batch into dispatch
+# chunks (the provider's overlapped verify pipeline): chunks aligned
+# to this never force `tree_verify_points` to pad a partial Mosaic
+# tile per chunk, so every pipeline span reuses one compiled shape.
+LANE_ALIGN = 128
+
+
+def aligned_span(lanes: int, mesh_size: int = 1) -> int:
+    """Round a requested pipeline-chunk lane count to the kernel/mesh
+    granule: a multiple of LANE_ALIGN * mesh_size (floor, min one
+    granule) — the chunk shim between the provider's PipelineChunk
+    config and the Pallas tree's tile constraints."""
+    granule = LANE_ALIGN * max(1, mesh_size)
+    return max(granule, (lanes // granule) * granule)
+
 
 # ---------------------------------------------------------------------------
 # Limb-leading modular arithmetic (mirrors limb.Mod, axis 0 = limbs)

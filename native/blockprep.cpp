@@ -35,6 +35,7 @@
 #include <vector>
 
 #if defined(__x86_64__) || defined(__i386__)
+#include <cpuid.h>
 #include <immintrin.h>
 #define FTPU_X86 1
 #endif
@@ -272,8 +273,19 @@ void sha256_transform_ni(uint32_t state[8], const uint8_t *data,
 }
 
 bool sha_ni_supported() {
-    return __builtin_cpu_supports("sha") &&
-           __builtin_cpu_supports("sse4.1");
+    // __builtin_cpu_supports("sha") only exists on gcc >= 11; probe
+    // CPUID directly (leaf 7 EBX bit 29 = SHA-NI, leaf 1 ECX bit 19 =
+    // SSE4.1) so the library still builds on older toolchains —
+    // without this the WHOLE native prep layer silently fell back to
+    // Python on gcc 10 hosts
+    unsigned int eax, ebx, ecx, edx;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx))
+        return false;
+    if (!(ebx & (1u << 29)))
+        return false;
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx))
+        return false;
+    return (ecx & (1u << 19)) != 0;
 }
 #else
 bool sha_ni_supported() { return false; }

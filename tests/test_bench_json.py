@@ -1,0 +1,111 @@
+"""Bench output contract (ISSUE 2 satellite): ONE compact final line.
+
+Rounds 3-5 lost their numbers to an oversized JSON tail, a crash, and
+an rc=124 — the driver parses the LAST stdout line as JSON. The
+contract pinned here: `final_line` emits exactly one parseable line
+with no nested per-chunk arrays (full detail goes to the sidecar
+file), the pipeline overlap ratio is surfaced on both, and
+`emit_final` is idempotent (the self-deadline watchdog and the normal
+exit path race through it).
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _sample():
+    result = {
+        "metric": "block-validation sig-verify throughput",
+        "value": 50613.2,
+        "unit": "sigs/s",
+        "vs_baseline": 5.28,
+        "deadline_hit": False,
+    }
+    detail = {
+        "provider_stats": {
+            "pipeline_overlap_ratio": 0.42,
+            "pipeline_batches": 4,
+            "pipeline_host_s": 0.8,
+            "pipeline_device_s": 1.9,
+            "comb_batches": 5,
+        },
+        "per_chunk": [[i, i * 2] for i in range(200)],  # sidecar-only
+        "restart": {"ok": True},
+    }
+    return result, detail
+
+
+def test_final_line_is_one_compact_parseable_line(monkeypatch,
+                                                  tmp_path):
+    side = str(tmp_path / "detail.json")
+    monkeypatch.setattr(bench, "SIDECAR", side)
+    result, detail = _sample()
+    line = bench.final_line(result, detail)
+    assert "\n" not in line
+    assert len(line) < 2000          # compact: no embedded arrays
+    parsed = json.loads(line)
+    assert parsed["value"] == 50613.2
+    assert parsed["unit"] == "sigs/s"
+    assert parsed["pipeline_overlap_ratio"] == 0.42
+    assert "detail" not in parsed
+    assert "per_chunk" not in parsed
+    # flat: no nested containers on the driver-parsed line
+    for v in parsed.values():
+        assert not isinstance(v, (list, dict))
+    # the full detail landed in the sidecar
+    assert parsed["sidecar"] == side
+    with open(side) as f:
+        dumped = json.load(f)
+    assert dumped["provider_stats"]["pipeline_overlap_ratio"] == 0.42
+    assert len(dumped["per_chunk"]) == 200
+
+
+def test_final_line_without_detail(monkeypatch, tmp_path):
+    monkeypatch.setattr(bench, "SIDECAR", str(tmp_path / "d.json"))
+    result, _ = _sample()
+    parsed = json.loads(bench.final_line(result))
+    assert parsed["value"] == result["value"]
+    assert "sidecar" not in parsed
+
+
+def test_unwritable_sidecar_does_not_break_the_line(monkeypatch):
+    monkeypatch.setattr(bench, "SIDECAR",
+                        "/nonexistent-dir/nope/detail.json")
+    result, detail = _sample()
+    parsed = json.loads(bench.final_line(result, detail))
+    assert parsed["value"] == result["value"]
+    assert "sidecar" not in parsed
+
+
+def test_emit_final_is_idempotent(monkeypatch, tmp_path, capsys):
+    monkeypatch.setattr(bench, "SIDECAR", str(tmp_path / "d.json"))
+    monkeypatch.setattr(bench, "_FINAL_EMITTED",
+                        type(bench._FINAL_EMITTED)())
+    result, detail = _sample()
+    bench.emit_final(result, detail)
+    bench.emit_final({"value": -1}, None)     # watchdog double-fire
+    lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+    assert len(lines) == 1
+    assert json.loads(lines[0])["value"] == 50613.2
+
+
+def test_watchdog_shape_parses(monkeypatch, tmp_path):
+    """The deadline-hit salvage line must satisfy the same parse
+    contract (lists of section names are the one allowed nesting)."""
+    monkeypatch.setattr(bench, "SIDECAR", str(tmp_path / "d.json"))
+    parsed = json.loads(bench.final_line({
+        "metric": "smoke, self-deadline hit",
+        "value": None,
+        "unit": "sigs/s",
+        "deadline_s": 540.0,
+        "deadline_hit": True,
+        "completed_sections": ["prewarm_s", "sign_s"],
+    }))
+    assert parsed["deadline_hit"] is True
+    for v in parsed.values():
+        if isinstance(v, list):
+            assert all(isinstance(x, str) for x in v)
+        assert not isinstance(v, dict)

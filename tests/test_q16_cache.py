@@ -334,3 +334,87 @@ def test_oversize_set_never_builds(monkeypatch):
     assert prov._q16_cached(_key(0), 1, _QX, _QX) is None
     assert prov.stats["q16_oversize_skips"] == 1
     assert not builds
+
+
+def test_loading_set_never_evicts_residents(monkeypatch):
+    """ISSUE 2 satellite: the `_q16_loading` early-return sits ABOVE
+    the eviction loop — a live request for a set mid-restore rides
+    the 8-bit path WITHOUT displacing resident tables (the old order
+    evicted first, then returned None anyway)."""
+    builds = []
+    _stub(monkeypatch, builds)
+    prov = TPUProvider(use_g16=True, table_cache_bytes=EST)
+    assert prov._q16_cached(_key(0), 1, _QX, _QX) is not None
+    # age the resident far past the hot window so it WOULD be evicted
+    prov._q16_batch_no += 100
+    prov._q16_loading.add(_key(1))
+    assert prov._q16_cached(_key(1), 1, _QX, _QX) is None
+    assert prov.stats["q16_loading_skips"] == 1
+    assert prov.stats["q16_evictions"] == 0      # resident survived
+    assert _key(0) in prov._qflat_cache
+
+
+def test_q8_tables_persist_without_g16(monkeypatch, tmp_path):
+    """ISSUE 2 satellite: with UseG16: false the q8 file IS the warm
+    state. The old publish guard deleted the file it had just written
+    (the key set was never recorded on the pure-q8 path), so
+    q8_disk_loads could never rise across a restart."""
+    import jax.numpy as jnp
+
+    def fake_qtab_fn(self, K):
+        return lambda qx, qy: jnp.arange(2, dtype=jnp.int32)
+
+    monkeypatch.setattr(TPUProvider, "_qtab_fn", fake_qtab_fn)
+    monkeypatch.setattr(TPUProvider, "_q8_est_bytes",
+                        lambda self, K: 8)      # 2 x int32
+    warm = str(tmp_path / "warm")
+    key_map = {_key(1)[0]: 0}
+    kidx = np.zeros(4, dtype=np.int32)
+
+    p1 = TPUProvider(use_g16=False, warm_keys_dir=warm)
+    p1._resolve_tables(dict(key_map), kidx.copy())
+    p1.flush_warm_tables()
+    path = p1._table_path(_key(1), "qtab8")
+    assert os.path.exists(path)                  # publish guard kept it
+    assert [k.hex() for k in _key(1)] in p1._load_warm_keys()
+
+    # "restart": a fresh provider streams the q8 bytes from disk
+    p2 = TPUProvider(use_g16=False, warm_keys_dir=warm)
+    p2._resolve_tables(dict(key_map), kidx.copy())
+    assert p2.stats["q8_disk_loads"] > 0
+
+
+import os  # noqa: E402  (used by the persistence tests above)
+
+
+def test_concurrent_lookups_keep_accounting_consistent(monkeypatch):
+    """ISSUE 2 satellite: the dedicated q16 cache lock. Live batches
+    and a prewarm thread hammer `_q16_cached` concurrently; byte
+    accounting must end consistent with the resident set (the races
+    the round-5 advisor flagged corrupted `_qflat_cache_bytes`)."""
+    import threading
+
+    builds = []
+    _stub(monkeypatch, builds)
+    prov = TPUProvider(use_g16=True, table_cache_bytes=4 * EST)
+    errs = []
+
+    def hammer(tid):
+        try:
+            for n in range(60):
+                prov._q16_cached(_key(n % 6), 1, _QX, _QX,
+                                 prewarm=(tid == 3 and n % 2 == 0))
+        except Exception as e:          # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    with prov._q16_lock:
+        expect = sum(v.size * 4 for v in prov._qflat_cache.values())
+        assert prov._qflat_cache_bytes == expect
+        assert prov.stats["q16_cache_bytes"] == expect

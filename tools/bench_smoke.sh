@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Bench smoke gate: run bench.py in its bounded smoke mode on the CPU
+# backend and assert the driver-parse contract that rounds 3-5 kept
+# breaking — the process must finish inside its own self-deadline
+# (never rc=124 from outside) and its LAST stdout line must be ONE
+# compact JSON object, with the overlapped-pipeline stage timers
+# visible in the sidecar.
+#
+# First run on a fresh machine pays one ~3-4 min XLA compile; the
+# persistent compilation cache (keyed under BENCH_WARM_DIR) makes
+# every later run take seconds. CI budget = deadline + grace.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+DEADLINE="${BENCH_DEADLINE_S:-540}"
+WARM_DIR="${BENCH_WARM_DIR:-${HOME}/.cache/fabric_tpu_warmkeys}"
+OUT="$(mktemp)"
+SIDECAR="${BENCH_SIDECAR:-$(mktemp -u)/bench_detail.json}"
+mkdir -p "$(dirname "$SIDECAR")"
+trap 'rm -f "$OUT"' EXIT
+
+# grace on top of the self-deadline: the watchdog must win this race.
+# set +e around the pipeline — under set -e/pipefail a failing bench
+# would abort the script before the rc attribution below ever runs
+set +e
+timeout -k 30 "$((${DEADLINE%.*} + 120))" \
+    env JAX_PLATFORMS=cpu BENCH_SMOKE=1 \
+    BENCH_DEADLINE_S="$DEADLINE" \
+    BENCH_WARM_DIR="$WARM_DIR" \
+    BENCH_SIDECAR="$SIDECAR" \
+    python bench.py | tee "$OUT"
+rc=${PIPESTATUS[0]}
+set -e
+if [ "$rc" -ne 0 ]; then
+    echo "bench_smoke: bench.py exited rc=$rc" >&2
+    exit 1
+fi
+
+python - "$OUT" "$SIDECAR" <<'EOF'
+import json, sys
+
+out_path, sidecar = sys.argv[1], sys.argv[2]
+lines = [ln for ln in open(out_path).read().splitlines() if ln.strip()]
+assert lines, "bench printed nothing"
+final = lines[-1]
+obj = json.loads(final)          # the driver's parse, exactly
+assert obj.get("unit") == "sigs/s", obj
+assert len(final) < 4096, f"final line not compact: {len(final)}B"
+for v in obj.values():
+    assert not isinstance(v, dict), "nested object on the final line"
+n_json = sum(1 for ln in lines
+             if ln.startswith("{") and ln.endswith("}"))
+assert n_json == 1, f"expected exactly one JSON line, saw {n_json}"
+if obj.get("deadline_hit"):
+    print("bench_smoke: deadline hit — line still parseable", obj)
+    sys.exit(0)
+detail = json.load(open(obj["sidecar"]))
+stats = detail["provider_stats"]
+assert stats["pipeline_batches"] > 0, "pipeline path never ran"
+assert stats["pipeline_overlap_ratio"] > 0, stats
+print("bench_smoke: ok —",
+      {k: stats[k] for k in ("pipeline_batches", "pipeline_chunks",
+                             "pipeline_overlap_ratio")},
+      "value:", obj.get("value"))
+EOF
+echo "bench_smoke: green"
