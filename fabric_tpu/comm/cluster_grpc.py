@@ -149,11 +149,16 @@ class GRPCClusterTransport(ClusterTransport):
 
     def pull_blocks(self, target: str, channel: str, start: int,
                     end: int) -> list[common.Block]:
+        """RPC failures PROPAGATE (they used to collapse into an empty
+        list): the onboarding replicator needs to tell a dead source —
+        fail over, exclude, back off — from a live one that simply has
+        nothing past `start`."""
         try:
             return self._client(target).pull_blocks(channel, start,
                                                     end)
-        except Exception:
-            return []
+        except Exception as e:
+            raise ConnectionError(
+                f"pull from {target} failed: {e}") from e
 
     # -- handler registry (RaftChain registers itself) --
 

@@ -1,0 +1,52 @@
+"""Full-jitter exponential backoff, shared by every reconnect loop.
+
+Extracted from the peer deliver client (PR 1) so the cluster
+replication/onboarding puller retries with the SAME policy: exponential
+cap with a uniform draw ("full jitter", the AWS architecture-blog
+variant) so a fleet of clients reconnecting to a recovered server does
+not arrive in synchronized waves, a hard ceiling so one long outage
+cannot push waits past `max_s`, and reset-on-progress so the NEXT
+outage starts from the base delay instead of the previous outage's
+ceiling.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable, Optional
+
+
+class FullJitterBackoff:
+    """delay_n = uniform(0, min(base * 2^n, max)).
+
+    `next()` advances the failure count and returns the next delay;
+    `reset()` is called on any sign of progress. The draw function is
+    injectable so tests can pin the jitter.
+    """
+
+    def __init__(self, base_s: float = 0.1, max_s: float = 10.0,
+                 draw: Optional[Callable[[float, float], float]] = None):
+        if base_s <= 0:
+            raise ValueError("base_s must be positive")
+        if max_s < base_s:
+            raise ValueError("max_s must be >= base_s")
+        self.base_s = base_s
+        self.max_s = max_s
+        self.failures = 0
+        self._draw = draw or random.uniform
+
+    def next(self) -> float:
+        """Record a failure and return the delay to wait before the
+        next attempt."""
+        self.failures += 1
+        return self._draw(0.0, self.cap())
+
+    def cap(self) -> float:
+        """The current ceiling (exponential in failures so far,
+        clamped to max_s). Exposed for logging/tests."""
+        return min(self.base_s * (2 ** self.failures), self.max_s)
+
+    def reset(self) -> None:
+        """Progress observed: the next failure starts over from the
+        base delay."""
+        self.failures = 0

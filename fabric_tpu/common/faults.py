@@ -10,6 +10,9 @@ The well-known points:
     tpu.table_persist  warm-table byte writers
     raft.step          inbound raft messages (orderer raft chain loop)
     deliver.stream     the peer's block-deliver stream
+    cluster.pull       onboarding/catch-up block pulls from consenters
+    cluster.verify     pulled-span verification (orderer/onboarding.py)
+    onboarding.commit  committing a verified pulled block
 
 Arbitrary names are allowed — a new subsystem adds a `check()` call
 and tests arm it by string, no registration step.

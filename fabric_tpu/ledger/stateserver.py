@@ -18,6 +18,7 @@ Point a peer at it: core.yaml `ledger.state.stateDatabase: http`,
 from __future__ import annotations
 
 import base64
+import hmac
 import json
 import logging
 import os
@@ -44,30 +45,53 @@ def _unb64(s: str) -> bytes:
 def _vv_out(vv: Optional[VersionedValue]):
     if vv is None:
         return None
+    # metadata is null-vs-base64 on the wire: None (no metadata) and
+    # b"" (explicitly empty) are DIFFERENT ledger states and must
+    # round-trip as such (the reference's CouchDB JSON keeps the same
+    # distinction by omitting the field entirely)
     return {"v": _b64(vv.value),
             "ver": [vv.version.block, vv.version.tx],
-            "md": _b64(vv.metadata or b"")}
+            "md": None if vv.metadata is None else _b64(vv.metadata)}
 
 
 def _vv_in(obj) -> Optional[VersionedValue]:
     if obj is None:
         return None
+    md = obj.get("md")
     return VersionedValue(_unb64(obj["v"]),
                           Height(obj["ver"][0], obj["ver"][1]),
-                          _unb64(obj["md"]))
+                          None if md is None else _unb64(md))
 
 
 class StateServer:
     """One process hosting N named state databases (reference analog:
     one CouchDB instance, one database per channel+namespace scope)."""
 
-    def __init__(self, data_dir: str, listen: str = "127.0.0.1:0"):
+    # methods that change database state: these require the shared
+    # secret when one is configured (reads stay open — the reference
+    # analog is CouchDB's admin-vs-member split)
+    MUTATING = frozenset(
+        {"apply_updates", "apply_writes_only", "define_index"})
+    # NOTE: "" is absent on purpose — ("", port) binds ALL interfaces
+    LOOPBACK = frozenset({"127.0.0.1", "localhost", "::1"})
+
+    def __init__(self, data_dir: str, listen: str = "127.0.0.1:0",
+                 auth_token: Optional[str] = None):
         self._dir = data_dir
         os.makedirs(data_dir, exist_ok=True)
         self._dbs: dict[str, StateDB] = {}
         self._stores: dict[str, KVStore] = {}
         self._lock = threading.Lock()
+        self._auth_token = auth_token
         host, port = listen.rsplit(":", 1)
+        if host.strip("[]") not in self.LOOPBACK and not auth_token:
+            # an unauthenticated mutating API on a routable interface
+            # is an open door to ledger-state corruption; refuse to
+            # start rather than warn-and-serve
+            raise ValueError(
+                f"refusing to bind state server to non-loopback "
+                f"{host!r} without an auth token (set --auth-token / "
+                f"FTPU_STATE_TOKEN, or listen on 127.0.0.1)")
         from http.server import (
             BaseHTTPRequestHandler, ThreadingHTTPServer,
         )
@@ -92,7 +116,16 @@ class StateServer:
                     if len(parts) != 3 or parts[0] != "v1":
                         self._reply(404, {"error": "bad path"})
                         return
-                    out = outer._dispatch(parts[1], parts[2], req)
+                    authed = (not outer._auth_token) or \
+                        hmac.compare_digest(
+                            self.headers.get("X-Auth-Token", ""),
+                            outer._auth_token)
+                    if parts[2] in outer.MUTATING and not authed:
+                        self._reply(401, {"error":
+                                          "missing or bad auth token"})
+                        return
+                    out = outer._dispatch(parts[1], parts[2], req,
+                                          authed=authed)
                     self._reply(200, out)
                 except Exception as e:   # noqa: BLE001
                     logger.exception("state request failed")
@@ -127,21 +160,30 @@ class StateServer:
             self._stores.clear()
             self._dbs.clear()
 
-    def _db(self, name: str) -> StateDB:
+    def _db(self, name: str, may_create: bool = True) -> StateDB:
         if not name.replace("-", "").replace("_", "").isalnum():
             raise ValueError(f"invalid database name {name!r}")
         with self._lock:
             db = self._dbs.get(name)
             if db is None:
-                store = KVStore(os.path.join(self._dir,
-                                             f"{name}.state.db"))
+                path = os.path.join(self._dir, f"{name}.state.db")
+                if not may_create and not os.path.exists(path):
+                    # unauthenticated READS must not grow the data
+                    # dir: each db name materializes a store on disk,
+                    # so creation requires the same credential as
+                    # mutation (when one is configured)
+                    raise ValueError(
+                        f"database {name!r} does not exist "
+                        "(creating one requires authentication)")
+                store = KVStore(path)
                 self._stores[name] = store
                 db = StateDB(DBHandle(store, "statedb"))
                 self._dbs[name] = db
             return db
 
-    def _dispatch(self, dbname: str, method: str, req: dict):
-        db = self._db(dbname)
+    def _dispatch(self, dbname: str, method: str, req: dict,
+                  authed: bool = True):
+        db = self._db(dbname, may_create=authed)
         if method == "get_state":
             return {"vv": _vv_out(db.get_state(req["ns"], req["key"]))}
         if method == "get_state_metadata_many":
@@ -190,15 +232,19 @@ class HTTPVersionedDB(VersionedDB):
     """Client half of the seam: the peer-side VersionedDB whose engine
     lives in another process (statecouchdb's role)."""
 
-    def __init__(self, address: str, dbname: str, timeout: float = 30.0):
+    def __init__(self, address: str, dbname: str, timeout: float = 30.0,
+                 auth_token: Optional[str] = None):
         self._base = f"http://{address}/v1/{dbname}/"
         self._timeout = timeout
+        self._auth_token = auth_token
 
     def _call(self, method: str, **kwargs):
+        headers = {"Content-Type": "application/json"}
+        if self._auth_token:
+            headers["X-Auth-Token"] = self._auth_token
         req = urllib.request.Request(
             self._base + method, data=json.dumps(kwargs).encode(),
-            headers={"Content-Type": "application/json"},
-            method="POST")
+            headers=headers, method="POST")
         with urllib.request.urlopen(req,
                                     timeout=self._timeout) as resp:
             out = json.loads(resp.read())
@@ -208,8 +254,11 @@ class HTTPVersionedDB(VersionedDB):
         return _vv_in(self._call("get_state", ns=ns, key=key)["vv"])
 
     def get_state_metadata(self, ns: str, key: str) -> Optional[bytes]:
-        vv = self.get_state(ns, key)
-        return vv.metadata if vv is not None and vv.metadata else None
+        # ask the SERVER's get_state_metadata (one round trip via the
+        # batched endpoint) instead of deriving from get_state: the
+        # engine owns the None-vs-b"" decision, and the null-vs-base64
+        # wire encoding preserves whatever it says
+        return self.get_state_metadata_many([(ns, key)]).get((ns, key))
 
     def get_state_metadata_many(self, wanted) -> dict:
         out = self._call("get_state_metadata_many",
@@ -260,9 +309,15 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="stateserver")
     p.add_argument("--data-dir", required=True)
     p.add_argument("--listen", default="127.0.0.1:5984")
+    p.add_argument("--auth-token",
+                   default=os.environ.get("FTPU_STATE_TOKEN") or None,
+                   help="shared secret required on mutating API calls;"
+                        " mandatory for non-loopback --listen "
+                        "(env: FTPU_STATE_TOKEN)")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
-    srv = StateServer(args.data_dir, args.listen)
+    srv = StateServer(args.data_dir, args.listen,
+                      auth_token=args.auth_token)
     srv.start()
     print(f"state server on {srv.address}", flush=True)
     try:

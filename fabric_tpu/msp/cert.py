@@ -1,0 +1,138 @@
+"""X.509 certificate sanitization: low-S ECDSA signature normalization.
+
+Rebuild of reference `msp/cert.go:25-88` (certToPEM / sanitizeCert /
+isECDSASignedCert): ECDSA signatures are malleable — (r, s) and
+(r, n-s) both verify — so two byte-level encodings of the SAME
+certificate circulate unless normalized. The reference re-serializes
+every certificate it ingests with the signature forced to the low-S
+form, so subject key identifiers and identity-byte comparisons (the
+IDENTITY principal, admin matching, consenter identity checks during
+onboarding) agree regardless of which variant the issuing CA emitted.
+
+This port does the normalization with plain DER surgery — no OpenSSL
+needed, so it works on hosts running the pure-python crypto fallback.
+Only ECDSA-signed certificates are touched (P-256, the curve this
+stack implements); anything unparsable or non-ECDSA passes through
+unchanged — sanitization is normalization, not validation.
+"""
+
+from __future__ import annotations
+
+import base64
+import re
+
+# the (r,s) codec and low-S policy are the bccsp ones — ONE
+# implementation of the signature-space boundary for the whole stack.
+# An s outside [1, n) means the signature is for some other curve than
+# P-256 and the certificate is left alone.
+from fabric_tpu.bccsp.utils import (
+    P256_N,
+    SignatureFormatError,
+    is_low_s,
+    marshal_signature,
+    to_low_s,
+    unmarshal_signature,
+)
+
+# AlgorithmIdentifier OIDs (DER content bytes) for ecdsa-with-SHA{1,
+# 224,256,384,512} — 1.2.840.10045.4.1 / 4.3.{1,2,3,4}
+_ECDSA_OID_PREFIX = bytes((0x2A, 0x86, 0x48, 0xCE, 0x3D, 0x04))
+
+_PEM_RE = re.compile(
+    rb"-----BEGIN CERTIFICATE-----\s*(.*?)\s*-----END CERTIFICATE-----",
+    re.DOTALL)
+
+
+# -- minimal DER codec (TLV) --
+
+def _read_tlv(buf: bytes, off: int) -> tuple[int, bytes, int]:
+    """Returns (tag, content, end_offset). Raises on malformed input."""
+    if off + 2 > len(buf):
+        raise ValueError("DER: truncated TLV header")
+    tag = buf[off]
+    length = buf[off + 1]
+    off += 2
+    if length & 0x80:
+        n = length & 0x7F
+        if n == 0 or n > 4 or off + n > len(buf):
+            raise ValueError("DER: bad long-form length")
+        length = int.from_bytes(buf[off:off + n], "big")
+        off += n
+    if off + length > len(buf):
+        raise ValueError("DER: content overruns buffer")
+    return tag, buf[off:off + length], off + length
+
+
+def _enc_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes((n,))
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes((0x80 | len(body),)) + body
+
+
+def _tlv(tag: int, content: bytes) -> bytes:
+    return bytes((tag,)) + _enc_len(len(content)) + content
+
+
+def _is_ecdsa_alg(alg_der_content: bytes) -> bool:
+    """True when the AlgorithmIdentifier SEQUENCE content starts with
+    an ecdsa-with-SHA* OID."""
+    try:
+        tag, oid, _ = _read_tlv(alg_der_content, 0)
+    except ValueError:
+        return False
+    return tag == 0x06 and oid.startswith(_ECDSA_OID_PREFIX)
+
+
+def sanitize_der(der: bytes) -> bytes:
+    """Return `der` with a high-S ECDSA certificate signature replaced
+    by its low-S twin (s' = n - s); byte-identical input when the
+    signature is already low-S, not ECDSA, or the DER is not a
+    certificate shape we understand."""
+    try:
+        outer_tag, outer, end = _read_tlv(der, 0)
+        if outer_tag != 0x30 or end != len(der):
+            return der
+        # Certificate ::= SEQUENCE { tbs, sigAlg, sigValue }
+        t_tag, _t, o1 = _read_tlv(outer, 0)
+        a_tag, alg, o2 = _read_tlv(outer, o1)
+        b_tag, bits, o3 = _read_tlv(outer, o2)
+        if (t_tag, a_tag, b_tag) != (0x30, 0x30, 0x03) or \
+                o3 != len(outer):
+            return der
+        if not _is_ecdsa_alg(alg) or not bits or bits[0] != 0:
+            return der
+        # ECDSA-Sig-Value ::= SEQUENCE { r INTEGER, s INTEGER } —
+        # parsed/re-encoded by the bccsp signature codec
+        r, s = unmarshal_signature(bits[1:])
+        if s >= P256_N or is_low_s(s):
+            return der
+        new_bits = _tlv(0x03, b"\x00" + marshal_signature(
+            r, to_low_s(s)))
+        return _tlv(0x30, outer[:o2] + new_bits)
+    except (ValueError, SignatureFormatError):
+        return der
+
+
+def is_low_s_der(der: bytes) -> bool:
+    """True when the certificate's ECDSA signature is already in
+    canonical low-S form (or is not an ECDSA signature at all)."""
+    return sanitize_der(der) == der
+
+
+def sanitize_pem(pem: bytes) -> bytes:
+    """Normalize every CERTIFICATE block in `pem` (surrounding text —
+    key blocks, comments — is preserved verbatim)."""
+    def _one(m: re.Match) -> bytes:
+        try:
+            der = base64.b64decode(m.group(1))
+        except Exception:
+            return m.group(0)
+        fixed = sanitize_der(der)
+        if fixed == der:
+            return m.group(0)
+        b64 = base64.b64encode(fixed)
+        lines = [b64[i:i + 64] for i in range(0, len(b64), 64)]
+        return (b"-----BEGIN CERTIFICATE-----\n" + b"\n".join(lines)
+                + b"\n-----END CERTIFICATE-----")
+    return _PEM_RE.sub(_one, pem)

@@ -34,6 +34,7 @@ _DER = serialization.Encoding.DER
 
 from fabric_tpu.bccsp import bccsp as bccsp_api
 from fabric_tpu.bccsp.bccsp import VerifyItem
+from fabric_tpu.msp.cert import sanitize_pem
 from fabric_tpu.protos import msp as msppb, policies as polpb
 from fabric_tpu.msp import msp as api
 
@@ -153,12 +154,17 @@ class X509MSP(api.MSP):
         self._id = conf.name
         self._epoch += 1        # stale identity memos die here
         self._revoked = set()   # re-setup must drop stale CRLs
-        self._roots = [x509.load_pem_x509_certificate(p)
+        # every ingested certificate is sanitized to the canonical
+        # low-S signature encoding first (reference msp/cert.go:25-88)
+        # so SKI and identity-byte comparisons are representation-free
+        self._roots = [x509.load_pem_x509_certificate(sanitize_pem(p))
                        for p in conf.root_certs]
-        self._intermediates = [x509.load_pem_x509_certificate(p)
-                               for p in conf.intermediate_certs]
+        self._intermediates = [
+            x509.load_pem_x509_certificate(sanitize_pem(p))
+            for p in conf.intermediate_certs]
         self._admins = [
-            x509.load_pem_x509_certificate(p).public_bytes(_DER)
+            x509.load_pem_x509_certificate(
+                sanitize_pem(p)).public_bytes(_DER)
             for p in conf.admins
         ]
         for crl_pem in conf.revocation_list:
@@ -172,7 +178,7 @@ class X509MSP(api.MSP):
 
         if conf.HasField("signing_identity") and \
                 conf.signing_identity.public_signer:
-            pem = bytes(conf.signing_identity.public_signer)
+            pem = sanitize_pem(bytes(conf.signing_identity.public_signer))
             cert = x509.load_pem_x509_certificate(pem)
             pub = self.csp.key_import(
                 cert, bccsp_api.X509PublicKeyImportOpts(ephemeral=True))
@@ -205,6 +211,10 @@ class X509MSP(api.MSP):
         return self._identity_from_pem(bytes(sid.id_bytes))
 
     def _identity_from_pem(self, pem: bytes) -> X509Identity:
+        # normalize BEFORE parsing: the sanitized PEM becomes the
+        # identity's id_bytes, so serialize()d identities compare
+        # equal whichever (r,s)/(r,n-s) variant arrived on the wire
+        pem = sanitize_pem(pem)
         cert = x509.load_pem_x509_certificate(pem)
         # ephemeral: deserialization is the per-signature hot path and
         # must never touch the keystore (reference imports identity

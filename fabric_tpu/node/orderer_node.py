@@ -131,7 +131,8 @@ class OrdererNode:
                                             tick_interval_s=tick,
                                             metrics_provider=provider),
              "kafka": _kafka_deprecated},
-            metrics_provider=provider)
+            metrics_provider=provider,
+            cluster_transport=self.cluster)
         from fabric_tpu.orderer.broadcast import BroadcastMetrics
         broadcast = BroadcastHandler(
             self.registrar, metrics=BroadcastMetrics(provider))
@@ -192,6 +193,11 @@ class OrdererNode:
         health = getattr(csp, "health", None)
         if callable(health):
             self.ops.register_checker("bccsp", health)
+        # onboarding/replication state (discover|pull|verify|commit|
+        # failed per channel) — degraded-but-serving, like the bccsp
+        # breaker: catch-up in progress never fails the health check
+        self.ops.register_checker("onboarding",
+                                  self.registrar.onboarding_health)
         self.ops.register_handler("/participation",
                                   self._participation_http(
                                       participation))

@@ -141,11 +141,16 @@ class PeerNode:
         if str(state_kind).lower() in ("http", "couchdb"):
             state_addr = cfg.get("ledger.state.stateDatabaseAddress",
                                  "127.0.0.1:5984")
+            state_token = cfg.get("ledger.state.stateDatabaseAuthToken",
+                                  os.environ.get("FTPU_STATE_TOKEN")
+                                  or None)
             from fabric_tpu.ledger.stateserver import HTTPVersionedDB
 
             def state_db_factory(ledger_id, _handle,
-                                 _addr=state_addr):
-                return HTTPVersionedDB(_addr, ledger_id)
+                                 _addr=state_addr,
+                                 _tok=state_token):
+                return HTTPVersionedDB(_addr, ledger_id,
+                                       auth_token=_tok)
 
             logger.info("state database: external http engine at %s",
                         state_addr)

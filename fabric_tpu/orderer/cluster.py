@@ -210,5 +210,9 @@ class LocalClusterNetwork:
                    start: int, end: int) -> list[common.Block]:
         node = self._reachable(sender, target)
         if node is None:
-            return []
+            # a dead source must be DISTINGUISHABLE from one that has
+            # no blocks to serve: the onboarding replicator fails over
+            # on transport errors but treats an empty result at the
+            # tip as quiescence
+            raise ConnectionError(f"{target} unreachable from {sender}")
         return node.handle_pull(channel, start, end)

@@ -93,12 +93,16 @@ class ChainSupport:
 
     def __init__(self, channel_id: str, ledger: OrdererLedger,
                  signer, csp, consenter_factory,
-                 metrics_provider=None):
+                 metrics_provider=None, on_became_consenter=None):
         self.channel_id = channel_id
         self.ledger = ledger
         self.signer = signer
         self._csp = csp
         self._metrics_provider = metrics_provider
+        # promotion hook: a FollowerChain that finds this orderer in
+        # the consenter set calls this (the registrar wires it to
+        # switch_follower_to_chain); consulted by the consenter factory
+        self.on_became_consenter = on_became_consenter
         self._lock = threading.Lock()
         self._bundle: Optional[Bundle] = None
         self._validator: Optional[ConfigTxValidator] = None
@@ -198,22 +202,33 @@ class ChainSupport:
         self._last_config_number = block.header.number
         self._apply_config_block(block)
 
-    def append_onboarded_block(self, block: common.Block) -> None:
-        """Catch-up path (reference `orderer/common/cluster/util.go:202`
-        VerifyBlocks): a block pulled from another orderer keeps ITS
-        signatures — verify them against this channel's BlockValidation
-        policy, then append verbatim and resync the writer/config."""
+    def verify_onboarded_span(self, blocks) -> tuple:
+        """Verify a contiguous span of pulled blocks against this
+        channel's live config (reference
+        `orderer/common/cluster/util.go:202` VerifyBlocks): numbering
+        from the ledger tip, data-hash, previous-hash linkage, and one
+        BATCHED BCCSP dispatch for every block signature in the span,
+        re-deriving the policy across embedded config blocks. Returns
+        (valid_prefix_len, error) — see onboarding.verify_block_span.
+        """
+        from fabric_tpu.orderer.onboarding import verify_block_span
+        height = self.ledger.height
+        prev_hash = None
+        if height:
+            prev_hash = pu.block_header_hash(
+                self.ledger.get_block(height - 1).header)
+        n_valid, _bundle, err = verify_block_span(
+            self.channel_id, blocks, height, prev_hash, self.bundle())
+        return n_valid, err
+
+    def commit_onboarded_block(self, block: common.Block) -> None:
+        """Commit one VERIFIED pulled block: append verbatim (it keeps
+        the source's signatures), resync the writer, and adopt an
+        embedded config."""
         if block.header.number != self.ledger.height:
             raise ValueError(
                 f"onboarding block {block.header.number} out of order "
                 f"(height {self.ledger.height})")
-        expected = pu.block_data_hash(block.data)
-        if block.header.data_hash != expected:
-            raise ValueError("onboarding block data hash mismatch")
-        signed = pu.block_signature_set(block)
-        policy = self.bundle().policy_manager.get_policy(
-            "/Channel/Orderer/BlockValidation")
-        policy.evaluate_signed_data(signed)
         self.ledger.add_block(block)
         self.writer.resync(block)
         if pu.is_config_block(block):
@@ -232,13 +247,20 @@ class Registrar:
 
     def __init__(self, root_dir: str, signer, csp,
                  consenters: dict[str, Callable],
-                 metrics_provider=None):
+                 metrics_provider=None, cluster_transport=None):
         self._root = root_dir
         self._signer = signer
         self._csp = csp
         self._consenters = dict(consenters)
         self._chains: dict[str, ChainSupport] = {}
+        self._onboarding: set[str] = set()   # joins replicating now
         self._lock = threading.Lock()
+        self._stop = threading.Event()
+        # cluster fabric for ONBOARDING pulls (join from a non-genesis
+        # config block); without one, only genesis joins are possible
+        self._cluster_transport = cluster_transport
+        # channel -> replication state, surfaced on /healthz
+        self.onboarding_status: dict[str, str] = {}
         self._metrics_provider = metrics_provider or \
             _m.DisabledProvider()
         self._part_status = self._metrics_provider.new_gauge(
@@ -253,32 +275,65 @@ class Registrar:
         from fabric_tpu.orderer.filerepo import FileRepo
         self._joinrepo = FileRepo(os.path.join(root_dir, "pendingops"),
                                   "join")
+        # pending joins first: a channel with a NON-genesis artifact
+        # was crashed mid-ONBOARDING — it must resume through the
+        # onboarding path (which keeps hash-anchoring the pulled chain
+        # to the operator-supplied join block), never through a plain
+        # restore that would forget the anchor
+        pending: dict[str, common.Block] = {}
+        for channel_id in self._joinrepo.list():
+            try:
+                block = common.Block()
+                block.ParseFromString(self._joinrepo.read(channel_id))
+                pending[channel_id] = block
+            except Exception:
+                logger.exception("unreadable pending-join artifact "
+                                 "for %s (kept)", channel_id)
         for channel_id in sorted(os.listdir(root_dir)):
             if channel_id == "pendingops":
                 continue
-            if os.path.isdir(os.path.join(root_dir, channel_id)):
-                try:
-                    self._restore(channel_id)
-                except Exception:
-                    logger.exception("failed to restore channel %s",
-                                     channel_id)
-        for channel_id in self._joinrepo.list():
+            if not os.path.isdir(os.path.join(root_dir, channel_id)):
+                continue
+            blk = pending.get(channel_id)
+            if blk is not None and blk.header.number > 0:
+                continue        # resumed below via onboarding
+            try:
+                self._restore(channel_id)
+            except Exception:
+                logger.exception("failed to restore channel %s",
+                                 channel_id)
+        for channel_id, block in sorted(pending.items()):
             if channel_id in self._chains:
                 # crashed after the ledger append but before the
                 # artifact removal: the channel restored above
                 self._joinrepo.remove(channel_id)
                 continue
-            raw = self._joinrepo.read(channel_id)
-            try:
-                block = common.Block()
-                block.ParseFromString(raw)
-                logger.info("resuming interrupted join of channel %s "
-                            "from the pending-join repo", channel_id)
-                self.join(block)
-            except Exception:
-                logger.exception("could not resume join of channel %s"
-                                 " (artifact kept for retry)",
-                                 channel_id)
+            logger.info("resuming interrupted join of channel %s "
+                        "from the pending-join repo", channel_id)
+            if block.header.number == 0:
+                try:
+                    self.join(block)
+                except Exception:
+                    logger.exception("could not resume join of "
+                                     "channel %s (artifact kept for "
+                                     "retry)", channel_id)
+            else:
+                # onboarding resume replicates from the network; run
+                # it in the background so startup (and the channels
+                # restored above) aren't held hostage to dead sources
+                threading.Thread(
+                    target=self._resume_onboarding,
+                    args=(channel_id, block), daemon=True,
+                    name=f"onboard-{channel_id}").start()
+
+    def _resume_onboarding(self, channel_id: str,
+                           block: common.Block) -> None:
+        try:
+            self.join(block)
+        except Exception:
+            logger.exception("could not resume onboarding of channel "
+                             "%s (durable prefix + artifact kept for "
+                             "retry)", channel_id)
 
     def _consenter_factory(self):
         def factory(support: ChainSupport):
@@ -305,6 +360,52 @@ class Registrar:
                 "channel", channel_id, "relation", r).set(
                 1 if r == relation else 0)
 
+    def _promotion_hook(self, channel_id: str) -> Callable:
+        def hook() -> None:
+            self.switch_follower_to_chain(channel_id)
+        return hook
+
+    def switch_follower_to_chain(self, channel_id: str) -> None:
+        """Promotion (reference registrar.SwitchFollowerToChain): a
+        committed config block added this orderer to the channel's
+        consenter set; replace the follower chain with a consenter
+        chain over the SAME support. Runs on its own thread — the
+        trigger fires from inside the follower's loop."""
+        def _go() -> None:
+            if self._stop.is_set():
+                return
+            with self._lock:
+                support = self._chains.get(channel_id)
+            if support is None:
+                return
+            try:
+                support.chain.halt()
+            except Exception:
+                logger.exception("[%s] halting follower for promotion "
+                                 "failed", channel_id)
+            try:
+                # swap + start under the lock, re-checking halt: the
+                # registrar's halt() snapshots chains under the same
+                # lock, so a promotion either lands BEFORE the
+                # snapshot (and gets halted with everything else) or
+                # observes _stop and never starts the new chain
+                with self._lock:
+                    if self._stop.is_set() or \
+                            self._chains.get(channel_id) is not support:
+                        return
+                    support.chain = self._consenter_factory()(support)
+                    support.chain.start()
+            except Exception:
+                logger.exception("[%s] promotion to consenter failed",
+                                 channel_id)
+                return
+            self._set_participation(channel_id, support)
+            self.onboarding_status.pop(channel_id, None)
+            logger.info("[%s] follower promoted to consenter",
+                        channel_id)
+        threading.Thread(target=_go, daemon=True,
+                         name=f"promote-{channel_id}").start()
+
     def _restore(self, channel_id: str) -> None:
         ledger = OrdererLedger(os.path.join(self._root, channel_id))
         if ledger.height == 0:
@@ -314,7 +415,9 @@ class Registrar:
             support = ChainSupport(channel_id, ledger, self._signer,
                                    self._csp,
                                    self._consenter_factory(),
-                                   metrics_provider=self._metrics_provider)
+                                   metrics_provider=self._metrics_provider,
+                                   on_became_consenter=self._promotion_hook(
+                                       channel_id))
         except Exception:
             ledger.close()
             raise
@@ -329,12 +432,12 @@ class Registrar:
         env = pu.extract_envelope(join_block, 0)
         ch = pu.get_channel_header(pu.get_payload(env))
         channel_id = ch.channel_id
+        if join_block.header.number != 0:
+            return self._join_onboarding(channel_id, join_block)
         with self._lock:
-            if channel_id in self._chains:
+            if channel_id in self._chains or \
+                    channel_id in self._onboarding:
                 raise ValueError(f"channel {channel_id} already exists")
-            if join_block.header.number != 0:
-                raise ValueError("join from non-genesis block not yet "
-                                 "supported (onboarding/follower mode)")
             # validate the join block BEFORE anything touches disk:
             # a rejected join must leave no trace so it can be retried
             # (same contract as ledgermgmt.create's marker protocol)
@@ -366,7 +469,9 @@ class Registrar:
                 support = ChainSupport(channel_id, ledger, self._signer,
                                        self._csp,
                                        self._consenter_factory(),
-                                       metrics_provider=self._metrics_provider)
+                                       metrics_provider=self._metrics_provider,
+                                       on_became_consenter=self._promotion_hook(
+                                           channel_id))
             except Exception:
                 ledger.close()
                 if created:
@@ -377,6 +482,92 @@ class Registrar:
             # the ledger now holds the join block durably; the pending
             # artifact has served its purpose
             self._joinrepo.remove(channel_id)
+        support.chain.start()
+        self._set_participation(channel_id, support)
+        return support
+
+    def _join_onboarding(self, channel_id: str,
+                         join_block: common.Block) -> ChainSupport:
+        """Join from a LATER config block (reference
+        `orderer/common/onboarding/onboarding.go` + registrar
+        JoinChannel with a non-genesis block): replicate the chain up
+        through the join block from the channel's consenters —
+        verifying every block, failing over between sources — then
+        come up as a follower (or consenter, if the join config
+        already names this orderer). The join artifact plus the
+        crash-safe block store make a kill at ANY point resumable: the
+        restart re-enters here (or _restore, once a block is durable)
+        and replication continues from the last committed height."""
+        from fabric_tpu.orderer import onboarding as onb
+        with self._lock:
+            if channel_id in self._chains or \
+                    channel_id in self._onboarding:
+                raise ValueError(f"channel {channel_id} already exists")
+            if self._cluster_transport is None:
+                raise ValueError(
+                    f"cannot onboard channel {channel_id}: joining "
+                    "from a non-genesis config block requires a "
+                    "cluster transport to pull the chain from")
+            # validate BEFORE anything touches disk (same contract as
+            # the genesis path: a rejected join leaves no trace)
+            bundle = Bundle(channel_id,
+                            genesis_mod.config_from_block(join_block),
+                            self._csp)
+            if bundle.orderer is None:
+                raise ValueError("join block config lacks an Orderer "
+                                 "section")
+            self._joinrepo.save(channel_id, pu.marshal(join_block))
+            # reserve the name: replication happens OUTSIDE the lock
+            # (it can take minutes — the registrar must keep serving
+            # get_chain for every other channel meanwhile)
+            self._onboarding.add(channel_id)
+        channel_dir = os.path.join(self._root, channel_id)
+        created = not os.path.isdir(channel_dir)
+        ledger = None
+        try:
+            ledger = OrdererLedger(channel_dir)
+            sink = onb.BootstrapSink(channel_id, ledger, join_block,
+                                     self._csp)
+            replicator = onb.ChainReplicator(
+                channel_id, self._cluster_transport,
+                consenters_fn=lambda: onb.consenter_endpoints(
+                    sink.bundle),
+                sink=sink,
+                metrics_provider=self._metrics_provider,
+                on_state=lambda st: self.onboarding_status.
+                __setitem__(channel_id, st))
+            replicator.run(
+                target_height=join_block.header.number + 1,
+                stop=self._stop,
+                max_wall_s=float(os.environ.get(
+                    "FTPU_ONBOARD_JOIN_TIMEOUT_S", "120")))
+            with self._lock:
+                support = ChainSupport(
+                    channel_id, ledger, self._signer, self._csp,
+                    self._consenter_factory(),
+                    metrics_provider=self._metrics_provider,
+                    on_became_consenter=self._promotion_hook(
+                        channel_id))
+                self._chains[channel_id] = support
+                self._joinrepo.remove(channel_id)
+                self.onboarding_status.pop(channel_id, None)
+        except Exception:
+            progressed = ledger is not None and ledger.height > 0
+            if ledger is not None:
+                ledger.close()
+            if created and not progressed:
+                # nothing replicated: leave no trace, allow retry
+                shutil.rmtree(channel_dir, ignore_errors=True)
+                self._joinrepo.remove(channel_id)
+                self.onboarding_status.pop(channel_id, None)
+            else:
+                # keep the durable verified prefix AND the join
+                # artifact: a restart or retried join resumes here
+                self.onboarding_status[channel_id] = "failed"
+            raise
+        finally:
+            with self._lock:
+                self._onboarding.discard(channel_id)
         support.chain.start()
         self._set_participation(channel_id, support)
         return support
@@ -401,7 +592,17 @@ class Registrar:
         with self._lock:
             return sorted(self._chains)
 
+    def onboarding_health(self) -> Optional[str]:
+        """Aggregate replication state for /healthz `components`:
+        "chan1:pull chan2:verify", or None when nothing is
+        onboarding."""
+        snap = dict(self.onboarding_status)
+        if not snap:
+            return None
+        return " ".join(f"{ch}:{st}" for ch, st in sorted(snap.items()))
+
     def halt(self) -> None:
+        self._stop.set()
         with self._lock:
             chains = list(self._chains.values())
         for c in chains:

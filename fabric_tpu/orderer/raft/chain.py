@@ -232,6 +232,8 @@ class RaftChain:
         self._creator: Optional[_BlockCreator] = None
         self._timer_deadline: Optional[float] = None
         self._applied_since_compact = 0
+        self._metrics_provider = metrics_provider
+        self._replicator = None   # lazy: built on first catch-up
         self.metrics.cluster_size.set(len(self._consenters))
         self._replay_committed()
         transport.set_channel_auth(
@@ -644,28 +646,27 @@ class RaftChain:
     # -- snapshot catch-up (reference blockpuller.go) --
 
     def _catch_up(self, start: int, end: int) -> None:
-        for nid, ep in sorted(self._consenters.items()):
-            if nid == self.node_id:
-                continue
-            try:
-                blocks = self._transport.pull_blocks(
-                    ep, self._support.channel_id, start, end)
-            except Exception as e:
-                logger.warning("[%s] block pull from %s failed: %s",
-                               self._support.channel_id, ep, e)
-                continue
-            for block in blocks:
-                if block.header.number != self._support.ledger.height:
-                    continue
-                try:
-                    self._support.append_onboarded_block(block)
-                except Exception as e:
-                    logger.warning("[%s] pulled block %d rejected: %s",
-                                   self._support.channel_id,
-                                   block.header.number, e)
-                    break
-            if self._support.ledger.height >= end:
-                return
+        """A raft snapshot points past our ledger: pull the gap through
+        the onboarding replicator — verified blocks, source failover,
+        full-jitter backoff — instead of a single fixed source
+        (reference blockpuller.go over cluster/replication.go)."""
+        from fabric_tpu.orderer import onboarding as onb
+        if self._replicator is None:
+            self._replicator = onb.ChainReplicator(
+                self._support.channel_id, self._transport,
+                consenters_fn=lambda: [
+                    ep for _nid, ep in sorted(self._consenters.items())],
+                sink=onb.SupportSink(self._support),
+                metrics_provider=self._metrics_provider)
+        try:
+            # bounded: this runs on the raft event-loop thread, and an
+            # unfinished catch-up is retried when the next committed
+            # entry arrives
+            self._replicator.run(target_height=end, stop=self._halted,
+                                 max_wall_s=15.0)
+        except onb.OnboardingError as e:
+            logger.warning("[%s] snapshot catch-up incomplete: %s",
+                           self._support.channel_id, e)
 
 
 def consenter(transport, tick_interval_s: float = 0.1,
@@ -682,7 +683,11 @@ def consenter(transport, tick_interval_s: float = 0.1,
             logger.info("[%s] %s not in consenter set: starting as "
                         "follower", support.channel_id,
                         transport.endpoint)
-            return FollowerChain(support, transport)
+            return FollowerChain(
+                support, transport,
+                on_became_consenter=getattr(
+                    support, "on_became_consenter", None),
+                metrics_provider=metrics_provider)
         return RaftChain(support, transport,
                          tick_interval_s=tick_interval_s,
                          election_tick=election_tick,

@@ -3,10 +3,13 @@
 Rebuild of `orderer/common/follower/follower_chain.go` + the onboarding
 flow (`orderer/common/onboarding/onboarding.go`): an orderer that joins
 a channel whose consenter set does not include it pulls blocks from the
-consenters (verifying signatures — `cluster/util.go VerifyBlocks` via
-ChainSupport.append_onboarded_block), keeps its ledger current for
-Deliver clients, and — when a committed config block adds it to the
-consenter set — halts so the registrar can restart it as a consenter.
+consenters through the onboarding replicator — every block verified
+(`cluster/util.go VerifyBlocks` semantics via the batched BCCSP seam),
+sources failed over with full-jitter backoff when one dies mid-stream —
+keeps its ledger current for Deliver clients, and, when a committed
+config block adds it to the consenter set, triggers promotion: the
+registrar swaps this follower for a consenter chain over the same
+support (reference registrar.SwitchFollowerToChain).
 """
 
 from __future__ import annotations
@@ -16,6 +19,11 @@ import threading
 from typing import Callable, Optional
 
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+from fabric_tpu.orderer.onboarding import (
+    ChainReplicator,
+    SupportSink,
+    consenter_endpoints,
+)
 from fabric_tpu.orderer.raft.chain import parse_consenters
 
 logger = logging.getLogger("orderer.follower")
@@ -24,13 +32,19 @@ logger = logging.getLogger("orderer.follower")
 class FollowerChain:
     def __init__(self, support, transport,
                  poll_interval_s: float = 0.3,
-                 on_became_consenter: Optional[Callable] = None):
+                 on_became_consenter: Optional[Callable] = None,
+                 metrics_provider=None):
         self._support = support
         self._transport = transport
         self._interval = poll_interval_s
         self._on_promote = on_became_consenter
         self._halted = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._replicator = ChainReplicator(
+            support.channel_id, transport,
+            consenters_fn=lambda: consenter_endpoints(support.bundle()),
+            sink=SupportSink(support),
+            metrics_provider=metrics_provider)
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -59,15 +73,15 @@ class FollowerChain:
     def _run(self) -> None:
         while not self._halted.wait(self._interval):
             try:
-                self._pull_once()
+                self._replicator.poll_once()
                 if self._am_consenter():
                     logger.info("[%s] %s is now in the consenter set; "
                                 "halting follower for promotion",
                                 self._support.channel_id,
                                 self._transport.endpoint)
+                    self._halted.set()
                     if self._on_promote is not None:
                         self._on_promote()
-                    self._halted.set()
                     return
             except Exception:
                 logger.exception("[%s] follower pull failed",
@@ -80,23 +94,6 @@ class FollowerChain:
     def _am_consenter(self) -> bool:
         return self._transport.endpoint in \
             self._consenters().values()
-
-    def _pull_once(self) -> None:
-        height = self._support.ledger.height
-        for _nid, ep in sorted(self._consenters().items()):
-            if ep == self._transport.endpoint:
-                continue
-            try:
-                blocks = self._transport.pull_blocks(
-                    ep, self._support.channel_id, height, height + 10)
-            except Exception:
-                continue
-            for block in blocks:
-                if block.header.number != self._support.ledger.height:
-                    continue
-                self._support.append_onboarded_block(block)
-            if self._support.ledger.height > height:
-                return
 
 
 def follower_factory(transport, on_became_consenter=None):

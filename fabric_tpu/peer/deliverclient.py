@@ -15,12 +15,12 @@ logic either way, mirroring `internal/pkg/peer/orderers`).
 from __future__ import annotations
 
 import logging
-import random
 import threading
 import time
 from typing import Callable, Optional
 
 from fabric_tpu.common import faults, metrics as metrics_mod
+from fabric_tpu.common.backoff import FullJitterBackoff
 from fabric_tpu.protos import common, orderer as ordpb
 from fabric_tpu.protoutil import protoutil as pu
 
@@ -63,14 +63,12 @@ class Deliverer:
         self._signer = signer
         self._orderer_source = orderer_source
         self._mcs = mcs
-        self._retry_base_s = retry_base_s
-        self._retry_max_s = retry_max_s
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # consecutive stream failures; RESET after every successfully
-        # processed block, so one long outage doesn't pin the stream
-        # at retry_max_s forever afterwards
-        self._failures = 0
+        # full-jitter backoff (common/backoff.py), RESET after every
+        # successfully processed block, so one long outage doesn't pin
+        # the stream at retry_max_s forever afterwards
+        self._backoff = FullJitterBackoff(retry_base_s, retry_max_s)
         self.reconnects = 0
         self._reconnects_metric = None
         if metrics_provider is not None:
@@ -99,22 +97,16 @@ class Deliverer:
                 if endpoint is None:
                     raise ConnectionError("no orderer endpoint")
                 self._pull(endpoint)
-                self._failures = 0
+                self._backoff.reset()
             except Exception as e:
-                self._failures += 1
                 self.reconnects += 1
                 if self._reconnects_metric is not None:
                     self._reconnects_metric.add(1)
-                # FULL jitter (exponential cap, uniform draw): a fleet
-                # of peers reconnecting to a recovered orderer must not
-                # arrive in synchronized waves
-                cap = min(self._retry_base_s * (2 ** self._failures),
-                          self._retry_max_s)
-                delay = random.uniform(0, cap)
+                delay = self._backoff.next()
                 logger.warning(
                     "[%s] deliver stream failed (%s); retry in %.2fs "
                     "(attempt %d)", self._channel.channel_id, e, delay,
-                    self._failures)
+                    self._backoff.failures)
                 self._stop.wait(delay)
 
     def _pull(self, endpoint) -> None:
@@ -138,4 +130,4 @@ class Deliverer:
             # a processed block proves the stream is healthy again:
             # reset the backoff so the NEXT outage starts from the
             # base delay instead of the previous outage's ceiling
-            self._failures = 0
+            self._backoff.reset()

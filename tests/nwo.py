@@ -84,10 +84,15 @@ class Network:
 
     def __init__(self, root: str, n_orderers: int = 3,
                  peers_per_org: int = 1, channel: str = "testchannel",
-                 state_backend: dict | None = None):
+                 state_backend: dict | None = None,
+                 spare_orderers: int = 0):
         self.root = root
         self.channel = channel
         self.n_orderers = n_orderers
+        # spare orderers get crypto material and ports but are NOT in
+        # the genesis consenter set — they join later (onboarding /
+        # consenter-addition tests)
+        self.spare_orderers = spare_orderers
         self.peers_per_org = peers_per_org
         # org -> "http" runs that org's peers against an external
         # state-server process (the statecouchdb deployment shape)
@@ -97,7 +102,8 @@ class Network:
         self.nodes: dict[str, Node] = {}
         # (general grpc, ops, mTLS cluster listener) per orderer
         self.orderer_ports = [(free_port(), free_port(), free_port())
-                              for _ in range(n_orderers)]
+                              for _ in range(n_orderers +
+                                             spare_orderers)]
         self.peer_ports = {}   # (org, i) -> (grpc, ops)
         for org in ("org1", "org2"):
             for i in range(peers_per_org):
@@ -105,6 +111,16 @@ class Network:
         self._generate_material()
 
     # -- config generation --
+
+    def orderer_tls_cert_path(self, i: int) -> str:
+        return os.path.join(
+            self.root, "crypto", "ordererOrganizations", "example.com",
+            "orderers", f"orderer{i}.example.com", "tls", "server.crt")
+
+    def orderer_admin_msp_dir(self) -> str:
+        return os.path.join(
+            self.root, "crypto", "ordererOrganizations", "example.com",
+            "users", "Admin@example.com", "msp")
 
     def _generate_material(self) -> None:
         os.makedirs(self.root, exist_ok=True)
@@ -114,7 +130,8 @@ class Network:
             yaml.safe_dump({
                 "OrdererOrgs": [{
                     "Name": "Orderer", "Domain": "example.com",
-                    "Template": {"Count": self.n_orderers}}],
+                    "Template": {"Count": self.n_orderers +
+                                 self.spare_orderers}}],
                 "PeerOrgs": [
                     {"Name": "Org1", "Domain": "org1.example.com",
                      "Template": {"Count": self.peers_per_org},
@@ -130,13 +147,9 @@ class Network:
                       "--output", crypto)
 
         orderer_eps = [f"127.0.0.1:{g}" for g, _o, _c in
-                       self.orderer_ports]
+                       self.orderer_ports[:self.n_orderers]]
 
-        def _otls(i: int) -> str:
-            return os.path.join(
-                crypto, "ordererOrganizations", "example.com",
-                "orderers", f"orderer{i}.example.com", "tls",
-                "server.crt")
+        _otls = self.orderer_tls_cert_path
 
         profile = {
             "Consortium": "SampleConsortium",
@@ -164,7 +177,7 @@ class Network:
                      "ClientTLSCert": _otls(i),
                      "ServerTLSCert": _otls(i)}
                     for i, (_g, _o, c) in
-                    enumerate(self.orderer_ports)]},
+                    enumerate(self.orderer_ports[:self.n_orderers])]},
                 "Organizations": [{
                     "Name": "OrdererOrg", "ID": "OrdererMSP",
                     "MSPDir": os.path.join(

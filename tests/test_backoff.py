@@ -1,0 +1,51 @@
+"""common/backoff.py: the full-jitter policy shared by the peer
+deliver client and the onboarding replicator (ISSUE 3 satellite —
+extracted from PR 1's deliverclient so both reconnect loops retry
+identically)."""
+
+import pytest
+
+from fabric_tpu.common.backoff import FullJitterBackoff
+
+
+class TestFullJitterBackoff:
+    def test_cap_grows_exponentially_then_clamps(self):
+        caps = []
+        b = FullJitterBackoff(0.1, 1.0, draw=lambda lo, hi: hi)
+        for _ in range(6):
+            caps.append(b.next())
+        assert caps == [pytest.approx(0.2), pytest.approx(0.4),
+                        pytest.approx(0.8), 1.0, 1.0, 1.0]
+
+    def test_draw_is_full_jitter_over_zero_to_cap(self):
+        seen = []
+        b = FullJitterBackoff(0.1, 10.0,
+                              draw=lambda lo, hi: seen.append((lo, hi))
+                              or 0.0)
+        b.next()
+        b.next()
+        assert seen == [(0.0, pytest.approx(0.2)),
+                        (0.0, pytest.approx(0.4))]
+
+    def test_reset_on_progress_restarts_from_base(self):
+        b = FullJitterBackoff(0.1, 10.0, draw=lambda lo, hi: hi)
+        for _ in range(5):
+            b.next()
+        assert b.cap() > 1.0
+        b.reset()
+        assert b.failures == 0
+        # the outage after progress starts from the base delay, not
+        # pinned at the previous outage's ceiling
+        assert b.next() == pytest.approx(0.2)
+
+    def test_default_draw_within_bounds(self):
+        b = FullJitterBackoff(0.05, 0.4)
+        for _ in range(50):
+            d = b.next()
+            assert 0.0 <= d <= 0.4
+
+    def test_invalid_args_rejected(self):
+        with pytest.raises(ValueError):
+            FullJitterBackoff(0.0, 1.0)
+        with pytest.raises(ValueError):
+            FullJitterBackoff(1.0, 0.5)

@@ -676,7 +676,7 @@ class TestDeliverChaos:
             d.stop()
         assert ch.ledger.height >= 3
         assert d.reconnects == 3
-        assert d._failures == 0        # reset by processed blocks
+        assert d._backoff.failures == 0   # reset by processed blocks
 
     def test_backoff_resets_after_processed_block(self, monkeypatch):
         """One block per connection, then the stream dies: because the

@@ -192,3 +192,245 @@ class TestNwoEndToEnd:
         assert ok, "ordering did not recover after orderer crash"
         assert _wait(lambda: network.query(
             "org2", 0, "get", "after-crash").strip() == "1")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 3: verified orderer onboarding — a 4th orderer joins a live
+# 3-orderer channel, catches up with every block verified, survives a
+# dead source (failover) and a mid-catch-up process kill (resume from
+# the last durable block), then promotes and participates in consensus.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="class")
+def onb_net(tmp_path_factory):
+    from fabric_tpu.bccsp._crypto_compat import HAVE_CRYPTOGRAPHY
+    if not HAVE_CRYPTOGRAPHY:
+        pytest.skip("nwo needs the 'cryptography' wheel (cryptogen)")
+    from tests.nwo import Network
+    net = Network(str(tmp_path_factory.mktemp("nwo_onb")),
+                  n_orderers=3, spare_orderers=1)
+    try:
+        net.start_all()
+        net.join_all()
+        yield net
+    finally:
+        net.teardown()
+        for name, node in net.nodes.items():
+            print(f"--- {name} log tail ---")
+            try:
+                with open(node.log_path, "rb") as f:
+                    print(f.read()[-3000:].decode(errors="replace"))
+            except OSError:
+                pass
+
+
+def _orderer_admin(net):
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.msp import msp_config_from_dir
+    from fabric_tpu.msp.mspimpl import X509MSP
+    csp = SWProvider()
+    m = X509MSP(csp)
+    m.setup(msp_config_from_dir(net.orderer_admin_msp_dir(),
+                                "OrdererMSP", csp=csp))
+    return m.get_default_signing_identity()
+
+
+def _fetch_config_block(net, out_path, orderer_i=0):
+    from fabric_tpu.protos import common
+    gport = net.orderer_ports[orderer_i][0]
+    net._run_cli(
+        "fabric_tpu.cmd.peer", "channel", "fetch",
+        "--orderer", f"127.0.0.1:{gport}",
+        *net.peer_cli_identity("org1"),
+        "-C", net.channel, "config", out_path)
+    block = common.Block()
+    with open(out_path, "rb") as f:
+        block.ParseFromString(f.read())
+    return block
+
+
+def _submit_consenter_add(net, config_block, new_i):
+    """Build, sign (orderer-org admin), and broadcast a config update
+    that adds orderer `new_i` to the channel's consenter set."""
+    from fabric_tpu.comm.clients import BroadcastClient, channel_to
+    from fabric_tpu.common.configtx.validator import compute_update
+    from fabric_tpu.internal.configtxgen.genesis import (
+        config_from_block,
+    )
+    from fabric_tpu.protos import common, configtx as ctxpb
+    from fabric_tpu.protoutil import protoutil as pu
+
+    cfg = config_from_block(config_block)
+    new_cfg = ctxpb.Config()
+    new_cfg.CopyFrom(cfg)
+    val = new_cfg.channel_group.groups["Orderer"].values[
+        "ConsensusType"]
+    ct = ctxpb.ConsensusType()
+    ct.ParseFromString(val.value)
+    meta = ctxpb.ConsensusMetadata()
+    meta.ParseFromString(ct.metadata)
+    with open(net.orderer_tls_cert_path(new_i), "rb") as f:
+        tls_pem = f.read()
+    c = meta.consenters.add()
+    c.host = "127.0.0.1"
+    c.port = net.orderer_ports[new_i][2]
+    c.client_tls_cert = tls_pem
+    c.server_tls_cert = tls_pem
+    ct.metadata = meta.SerializeToString(deterministic=True)
+    val.value = ct.SerializeToString(deterministic=True)
+    update = compute_update(net.channel, cfg, new_cfg)
+
+    admin = _orderer_admin(net)
+    cue = ctxpb.ConfigUpdateEnvelope()
+    cue.config_update = pu.marshal(update)
+    cs = cue.signatures.add()
+    cs.signature_header = pu.marshal(
+        pu.create_signature_header(admin.serialize(),
+                                   pu.random_nonce()))
+    cs.signature = admin.sign(bytes(cs.signature_header) +
+                              bytes(cue.config_update))
+    ch = pu.make_channel_header(common.HeaderType.CONFIG_UPDATE,
+                                net.channel)
+    sh = pu.create_signature_header(admin.serialize(),
+                                    pu.random_nonce())
+    env = pu.sign_or_panic(admin,
+                           pu.make_payload(ch, sh, pu.marshal(cue)))
+    grpc_ch = channel_to(f"127.0.0.1:{net.orderer_ports[0][0]}")
+    try:
+        resp = BroadcastClient(grpc_ch).process_message(env)
+    finally:
+        grpc_ch.close()
+    assert resp.status == common.Status.SUCCESS, resp
+
+
+def _channel_info(net, orderer_i, channel):
+    out = json.loads(net.osnadmin(orderer_i, "list"))
+    for ch in out.get("channels", []):
+        if ch["name"] == channel:
+            return ch
+    return None
+
+
+def _height(info) -> int:
+    # MessageToDict renders uint64 as a JSON string and omits zeros
+    return int((info or {}).get("height", 0))
+
+
+@pytest.mark.integration
+class TestVerifiedOnboarding:
+    def test_follower_join_catch_up_and_promotion(self, onb_net):
+        net = onb_net
+        # a chain worth replicating
+        for k in range(3):
+            assert _wait(lambda: json.loads(net.invoke(
+                "org1", 0, "put", f"seed{k}", str(k)))["status"] ==
+                "VALID", timeout=60)
+        tip = _height(_channel_info(net, 0, net.channel))
+        assert tip >= 4
+
+        # 1. the spare orderer joins from GENESIS: not in the
+        # consenter set, so it comes up as a follower and replicates
+        # with verification + source failover
+        net.start_orderer(3)
+        from tests.nwo import wait_http
+        wait_http(f"http://127.0.0.1:{net.orderer_ports[3][1]}"
+                  "/healthz")
+        net.osnadmin(3, "join", "--channelID", net.channel,
+                     "--config-block", net.genesis_path)
+        assert _wait(lambda: _height(_channel_info(
+            net, 3, net.channel)) >= tip, timeout=30), \
+            _channel_info(net, 3, net.channel)
+        info = _channel_info(net, 3, net.channel)
+        assert info["consensusRelation"] == "follower", info
+
+        # 2. a config update adds orderer3 to the consenter set: the
+        # follower must notice the committed config block and promote
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".block") as tf:
+            cfg_block = _fetch_config_block(net, tf.name)
+        _submit_consenter_add(net, cfg_block, 3)
+        assert _wait(lambda: (_channel_info(net, 3, net.channel) or
+                              {}).get("consensusRelation") ==
+                     "consenter", timeout=40), \
+            _channel_info(net, 3, net.channel)
+
+        # 3. it PARTICIPATES: with orderer0 dead, ordering needs 3 of
+        # the 4 configured consenters — impossible unless orderer3
+        # votes
+        net.nodes["orderer0"].kill()
+        assert _wait(lambda: json.loads(net.invoke(
+            "org1", 0, "put", "post-promotion", "1"))["status"] ==
+            "VALID", timeout=60), "ordering stalled: promoted " \
+            "orderer is not participating in consensus"
+        # the promoted orderer's ledger advanced past the pre-join tip
+        # through raft replication, not just follower pulls
+        assert _wait(lambda: _height(_channel_info(
+            net, 3, net.channel)) > tip)
+
+    def test_onboarding_join_survives_crash_and_dead_source(
+            self, onb_net):
+        """Non-genesis join: orderer3 rejoins from the LATEST config
+        block with one consenter dead (source failover) and dies
+        mid-catch-up (FTPU_CRASH_ONBOARD_AT_HEIGHT); the restart
+        resumes from the last durable block and completes."""
+        import os
+        import shutil
+        net = onb_net
+        from tests.nwo import wait_http
+
+        # restore orderer0 (killed by the previous test)
+        if not net.nodes["orderer0"].alive:
+            net.start_orderer(0)
+            wait_http(f"http://127.0.0.1:{net.orderer_ports[0][1]}"
+                      "/healthz")
+
+        import tempfile
+        cfg_path = os.path.join(net.root, "latest_config.block")
+        cfg_block = _fetch_config_block(net, cfg_path)
+        assert cfg_block.header.number > 0
+
+        # wipe orderer3: it starts onboarding from nothing
+        net.nodes["orderer3"].kill()
+        shutil.rmtree(os.path.join(net.root, "orderer3"),
+                      ignore_errors=True)
+        # one consenter stays DOWN during catch-up: the replicator
+        # must fail over to a live source instead of wedging
+        net.nodes["orderer1"].kill()
+
+        # first attempt dies right before committing block 2
+        net.start_orderer(
+            3, extra_env={"FTPU_CRASH_ONBOARD_AT_HEIGHT": "2"})
+        wait_http(f"http://127.0.0.1:{net.orderer_ports[3][1]}"
+                  "/healthz")
+        node = net.nodes["orderer3"]
+        with pytest.raises(Exception):
+            net.osnadmin(3, "join", "--channelID", net.channel,
+                         "--config-block", cfg_path)
+        assert _wait(lambda: node.proc.poll() == 43, timeout=30), \
+            f"orderer3 did not die at the crash point: " \
+            f"{node.proc.poll()}"
+
+        # restart clean: the pending-join artifact + durable prefix
+        # resume replication WITHOUT re-issuing the join; the orderer
+        # finishes catch-up and (being in the consenter set now)
+        # promotes
+        net.start_orderer(3)
+        wait_http(f"http://127.0.0.1:{net.orderer_ports[3][1]}"
+                  "/healthz")
+        tip = _height(_channel_info(net, 0, net.channel))
+        assert _wait(lambda: _height(_channel_info(
+            net, 3, net.channel)) >= tip, timeout=40), \
+            _channel_info(net, 3, net.channel)
+        assert _wait(lambda: (_channel_info(net, 3, net.channel) or
+                              {}).get("consensusRelation") ==
+                     "consenter", timeout=40)
+
+        # full strength again: traffic commits and reaches orderer3
+        net.start_orderer(1)
+        wait_http(f"http://127.0.0.1:{net.orderer_ports[1][1]}"
+                  "/healthz")
+        assert _wait(lambda: json.loads(net.invoke(
+            "org2", 0, "put", "post-onboarding", "9"))["status"] ==
+            "VALID", timeout=60)
+        assert _wait(lambda: net.query(
+            "org1", 0, "get", "post-onboarding").strip() == "9")

@@ -138,3 +138,91 @@ class TestLedgerOnHTTPBackend:
         rows, _bm = sim2.get_query_result(
             "cc", json.dumps({"selector": {"color": "green"}}))
         assert [k for k, _ in rows] == ["alpha"]
+
+
+class TestHardening:
+    """ISSUE 3 satellite: non-loopback binds need a shared secret, the
+    mutating API enforces it, and metadata round-trips null-vs-base64
+    (None and b"" are different ledger states)."""
+
+    def test_non_loopback_bind_refused_without_token(self, tmp_path):
+        with pytest.raises(ValueError, match="auth token"):
+            StateServer(str(tmp_path / "s"), "0.0.0.0:0")
+
+    def test_non_loopback_bind_allowed_with_token(self, tmp_path):
+        srv = StateServer(str(tmp_path / "s"), "0.0.0.0:0",
+                          auth_token="sekrit")
+        srv.start()
+        srv.stop()
+
+    def test_loopback_bind_needs_no_token(self, tmp_path):
+        srv = StateServer(str(tmp_path / "s"), "127.0.0.1:0")
+        srv.start()
+        srv.stop()
+
+    def test_mutating_calls_rejected_without_token(self, tmp_path):
+        import urllib.error
+        srv = StateServer(str(tmp_path / "s"), "127.0.0.1:0",
+                          auth_token="sekrit")
+        srv.start()
+        try:
+            naked = HTTPVersionedDB(srv.address, "ch1")
+            b = UpdateBatch()
+            b.put("cc", "k", b"v", Height(0, 0))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                naked.apply_updates(b, Height(0, 0))
+            assert ei.value.code == 401
+            with pytest.raises(urllib.error.HTTPError):
+                naked.define_index("cc", "i1", "{}")
+            # an unauthenticated READ must not materialize a new
+            # database on disk either (unbounded-creation guard)
+            with pytest.raises(urllib.error.HTTPError):
+                naked.get_state("cc", "k")
+            assert not any(
+                f.endswith(".state.db")
+                for f in __import__("os").listdir(str(tmp_path / "s")))
+            # the authed client works end to end; once the database
+            # exists, reads stay open
+            authed = HTTPVersionedDB(srv.address, "ch1",
+                                     auth_token="sekrit")
+            authed.apply_updates(b, Height(0, 0))
+            assert naked.get_state("cc", "k").value == b"v"
+        finally:
+            srv.stop()
+
+    def test_wrong_token_rejected(self, tmp_path):
+        import urllib.error
+        srv = StateServer(str(tmp_path / "s"), "127.0.0.1:0",
+                          auth_token="sekrit")
+        srv.start()
+        try:
+            bad = HTTPVersionedDB(srv.address, "ch1",
+                                  auth_token="wrong")
+            b = UpdateBatch()
+            b.put("cc", "k", b"v", Height(0, 0))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                bad.apply_updates(b, Height(0, 0))
+            assert ei.value.code == 401
+        finally:
+            srv.stop()
+
+    def test_metadata_none_vs_empty_roundtrip(self, server):
+        db = HTTPVersionedDB(server.address, "mdch")
+        b = UpdateBatch()
+        b.put("cc", "no-md", b"v", Height(1, 0))               # b""
+        b.updates[("cc", "with-md")] = VersionedValue(
+            b"v", Height(1, 1), b"md!")
+        b.updates[("cc", "none-md")] = VersionedValue(
+            b"v", Height(1, 2), None)
+        db.apply_updates(b, Height(1, 2))
+        # get_state preserves exactly what the engine stores
+        assert db.get_state("cc", "with-md").metadata == b"md!"
+        # get_state_metadata matches the embedded engine's semantics:
+        # None for absent key OR no metadata, bytes otherwise
+        assert db.get_state_metadata("cc", "with-md") == b"md!"
+        assert db.get_state_metadata("cc", "no-md") is None
+        assert db.get_state_metadata("cc", "none-md") is None
+        assert db.get_state_metadata("cc", "missing") is None
+        assert db.get_state_metadata_many(
+            [("cc", "with-md"), ("cc", "no-md"), ("cc", "missing")]
+        ) == {("cc", "with-md"): b"md!"}

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# Chaos gate: re-run the bccsp / raft / deliver test subsets with fault
-# points ARMED via env (fabric_tpu/common/faults.py parses FTPU_FAULTS
-# at interpreter start; the conftest fixture re-applies it per test).
+# Chaos gate: re-run the bccsp / raft / deliver / onboarding test
+# subsets with fault points ARMED via env (fabric_tpu/common/faults.py
+# parses FTPU_FAULTS at interpreter start; the conftest fixture
+# re-applies it per test).
 #
 # The claim under test: armed faults change WHICH path serves — never
 # verdicts, never liveness. Tests that pin device-path internals clear
@@ -9,6 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -21,21 +23,49 @@ run() {
     FTPU_FAULTS="$faults" "${PYTEST[@]}" "$@"
 }
 
-# 1) bccsp: transient device errors at every dispatch/compile/persist
-#    point — breaker + sw fallback keep every verdict bit-identical
-run "tpu.dispatch=error:2;tpu.compile=error:1;tpu.table_persist=error:1" \
-    tests/test_chaos.py tests/test_bucket_floor.py
+bccsp() {
+    # transient device errors at every dispatch/compile/persist point —
+    # breaker + sw fallback keep every verdict bit-identical
+    run "tpu.dispatch=error:2;tpu.compile=error:1;tpu.table_persist=error:1" \
+        tests/test_chaos.py tests/test_bucket_floor.py
+    # stalls instead of errors
+    run "tpu.dispatch=delay:2:0.05" \
+        tests/test_chaos.py -k "Degradation or FaultRegistry"
+}
 
-# 2) bccsp under stalls: delayed dispatches instead of errors
-run "tpu.dispatch=delay:2:0.05" \
-    tests/test_chaos.py -k "Degradation or FaultRegistry"
+raft() {
+    # dropped step messages per test — elections/replication must
+    # still converge (core tests drive the protocol; chain tests cover
+    # the armed fault point)
+    run "raft.step=error:3" tests/test_raft.py tests/test_chaos.py \
+        -k Raft
+}
 
-# 3) raft: dropped step messages per test — elections/replication must
-#    still converge (core tests drive the protocol; chain tests cover
-#    the armed fault point)
-run "raft.step=error:3" tests/test_raft.py tests/test_chaos.py -k Raft
+deliver() {
+    # torn streams force the reconnect/backoff path
+    run "deliver.stream=error:2" tests/test_chaos.py -k Deliver
+}
 
-# 4) deliver: torn streams force the reconnect/backoff path
-run "deliver.stream=error:2" tests/test_chaos.py -k Deliver
+onboarding() {
+    # the chain-replication fault points — dead sources at every pull,
+    # corrupted spans at every verify, failing commits — catch-up must
+    # still converge with nothing forged committed
+    run "cluster.pull=error:2" tests/test_onboarding.py
+    run "cluster.verify=error:2" tests/test_onboarding.py \
+        -k "Replicator or Chaos"
+    run "onboarding.commit=error:1" tests/test_onboarding.py \
+        -k "Replicator or Chaos or Bootstrap"
+    run "cluster.pull=delay:3:0.05;onboarding.commit=error:1" \
+        tests/test_onboarding.py -k "Chaos"
+}
+
+case "${1:-all}" in
+    bccsp) bccsp ;;
+    raft) raft ;;
+    deliver) deliver ;;
+    onboarding) onboarding ;;
+    all) bccsp; raft; deliver; onboarding ;;
+    *) echo "unknown subset: $1" >&2; exit 2 ;;
+esac
 
 echo "chaos_check: all passes green"
