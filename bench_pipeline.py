@@ -318,6 +318,207 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     return out
 
 
+def _have_openssl_cp() -> bool:
+    try:
+        from fabric_tpu.bccsp._crypto_compat import HAVE_CRYPTOGRAPHY
+        return bool(HAVE_CRYPTOGRAPHY)
+    except Exception:                     # noqa: BLE001
+        return False
+
+
+def commit_pipeline_run(n_blocks: int = 6, ntxs: int = 24) -> dict:
+    """ISSUE 4 scenario: sequential vs depth-1 overlapped intake on a
+    synthetic multi-block stream — REAL per-tx signature verification
+    (stage A, batched through the BCCSP seam; pure-python P-256 when
+    the OpenSSL wheel is absent) against REAL KVLedger commits (stage
+    B), wheel-free so the bounded default bench can always run it.
+    Reports both wall clocks and the pipeline's measured overlap."""
+    import hashlib
+    import tempfile
+
+    from fabric_tpu import protoutil as pu
+    from fabric_tpu.bccsp import ECDSAKeyGenOpts, VerifyItem
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.core.commitpipeline import CommitPipeline
+    from fabric_tpu.core.committer import LedgerCommitter
+    from fabric_tpu.core.txvalidator import ValidationResult
+    from fabric_tpu.ledger import KVLedger
+    from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+    from fabric_tpu.ledger.kvledger import extract_tx_rwset
+    from fabric_tpu.ledger.statedb import StateDB
+    from fabric_tpu.ledger.txmgr import TxSimulator
+    from fabric_tpu.protos import common as cpb, proposal as proppb
+    from fabric_tpu.protos import transaction as txpb
+
+    channel = "cpbench"
+    root = tempfile.mkdtemp(prefix="bench_cp_")
+    seq = piped = pipeline = None
+    scratch_kv = None
+    try:
+        sw = SWProvider()
+        key = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+        pub = key.public_key()
+
+        class Signer:
+            def serialize(self):
+                return b"bench-client"
+
+            def sign(self, msg):
+                return sw.sign(key, hashlib.sha256(msg).digest())
+
+        # ---- build the stream once (signing is untimed setup) ----
+        scratch_kv = KVStore(os.path.join(root, "scratch.db"))
+        scratch = StateDB(DBHandle(scratch_kv, "s"))
+
+        def tx_env(i):
+            sim = TxSimulator(scratch, "sim")
+            sim.put_state("bench", f"k{i}", f"v{i}".encode())
+            results = pu.marshal(sim.get_tx_simulation_results())
+            prop, _tx_id = pu.create_proposal(channel, "bench",
+                                              [b"invoke"],
+                                              creator=b"bench-client")
+            presp = pu.create_proposal_response(
+                pu.marshal(prop), results, b"", proppb.Response(status=200),
+                proppb.ChaincodeID(name="bench"), Signer())
+            return pu.marshal(pu.create_signed_tx(prop, [presp], Signer()))
+
+        ch_hdr = pu.make_channel_header(cpb.HeaderType.CONFIG, channel)
+        sh = pu.create_signature_header(b"orderer", pu.random_nonce())
+        genesis = pu.new_block(0, b"")
+        genesis.data.data.append(pu.marshal(cpb.Envelope(
+            payload=pu.marshal(pu.make_payload(ch_hdr, sh, b"cfg")))))
+        genesis.header.data_hash = pu.block_data_hash(genesis.data)
+        blocks = [genesis]
+        n = 0
+        for _ in range(n_blocks):
+            blk = pu.new_block(blocks[-1].header.number + 1,
+                               pu.block_header_hash(blocks[-1].header))
+            for _t in range(ntxs):
+                blk.data.data.append(tx_env(n))
+                n += 1
+            blk.header.data_hash = pu.block_data_hash(blk.data)
+            blocks.append(blk)
+        stream = [b.SerializeToString() for b in blocks]
+
+        class Validator:
+            """One batched signature verify per block (the device-bound
+            stage); verdicts + deferred-publication contract match the
+            real TxValidator."""
+
+            def validate_ahead(self, block, known_txids=None):
+                t0 = time.perf_counter()
+                items = []
+                for env_bytes in block.data.data:
+                    env = pu.unmarshal_envelope(env_bytes)
+                    items.append(VerifyItem(key=pub,
+                                            signature=env.signature,
+                                            message=env.payload))
+                ok = sw.verify_batch(items) if block.header.number else \
+                    [True] * len(items)
+                codes = [txpb.TxValidationCode.VALID if o else
+                         txpb.TxValidationCode.BAD_CREATOR_SIGNATURE
+                         for o in ok]
+                return ValidationResult(
+                    codes=codes, n_items=len(items),
+                    duration_s=time.perf_counter() - t0)
+
+            def publish_validation(self, block, result):
+                while len(block.metadata.metadata) <= \
+                        cpb.BlockMetadataIndex.TRANSACTIONS_FILTER:
+                    block.metadata.metadata.append(b"")
+                block.metadata.metadata[
+                    cpb.BlockMetadataIndex.TRANSACTIONS_FILTER] = \
+                    bytes(result.codes)
+
+            def validate(self, block):
+                result = self.validate_ahead(block)
+                self.publish_validation(block, result)
+                return result.codes
+
+        class Chan:
+            def __init__(self, name):
+                self.ledger = KVLedger(channel, os.path.join(root, name))
+                self.channel_id = channel
+                self.validator = Validator()
+                self.committer = LedgerCommitter(self.ledger)
+
+            def commit_validated(self, block, codes, rwsets=None,
+                                 tx_ids=None):
+                return self.committer.commit(block, codes, rwsets=rwsets)
+
+            def process_block(self, block):
+                codes = self.validator.validate(block)
+                rwsets = [extract_tx_rwset(e) for e in block.data.data]
+                return self.commit_validated(block, codes, rwsets=rwsets)
+
+        def parse(raw):
+            blk = cpb.Block()
+            blk.ParseFromString(raw)
+            return blk
+
+        # ---- sequential twin ----
+        seq = Chan("seq")
+        seq.ledger.initialize_from_genesis(parse(stream[0]))
+        t0 = time.perf_counter()
+        for raw in stream[1:]:
+            seq.process_block(parse(raw))
+        sequential_s = time.perf_counter() - t0
+
+        # ---- depth-1 overlapped twin ----
+        piped = Chan("piped")
+        piped.ledger.initialize_from_genesis(parse(stream[0]))
+        pipeline = CommitPipeline(piped, depth=1)
+        t0 = time.perf_counter()
+        try:
+            for i, raw in enumerate(stream[1:], start=1):
+                pipeline.submit(i, raw=raw)
+            pipeline.drain(timeout=600)
+        finally:
+            stats = dict(pipeline.stats)
+            overlap = pipeline.overlap_ratio
+        pipelined_s = time.perf_counter() - t0
+
+        assert piped.ledger.commit_hash == seq.ledger.commit_hash, \
+            "pipelined commit hash diverged from sequential"
+        return {
+            "blocks": n_blocks, "txs_per_block": ntxs,
+            "sequential_s": round(sequential_s, 4),
+            "pipelined_s": round(pipelined_s, 4),
+            "speedup": round(sequential_s / pipelined_s, 3)
+            if pipelined_s else None,
+            "overlap_ratio": round(overlap, 4),
+            "validate_s": round(stats["validate_s"], 4),
+            "commit_s": round(stats["commit_s"], 4),
+            "barriers": stats["barriers"],
+            "fallbacks": stats["fallbacks"],
+            "commit_hash_match": True,
+            # on wheel-less 1-core hosts stage A is pure-python P-256
+            # and HOLDS the GIL, so measured overlap shows as
+            # contention, not speedup; device/native stage A (TPU comb
+            # kernel, native DER parse) releases it and the same
+            # overlap buys wall clock
+            "stage_a_backend": "sw-pure-python"
+            if not _have_openssl_cp() else "sw-openssl",
+        }
+    finally:
+        # this runs on EVERY default bench invocation now: close both
+        # twins and drop the temp trees even when an assert fires
+        import shutil
+        if pipeline is not None:
+            pipeline.stop()
+        for chan in (seq, piped):
+            if chan is not None:
+                try:
+                    chan.ledger.close()
+                except Exception:     # noqa: BLE001
+                    pass
+        try:
+            scratch_kv.close()
+        except Exception:             # noqa: BLE001
+            pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 if __name__ == "__main__":
     import sys
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

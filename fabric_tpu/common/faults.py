@@ -13,6 +13,11 @@ The well-known points:
     cluster.pull       onboarding/catch-up block pulls from consenters
     cluster.verify     pulled-span verification (orderer/onboarding.py)
     onboarding.commit  committing a verified pulled block
+    commit.validate_ahead  stage A of the commit pipeline — a fault
+                       demotes the block to the sequential path
+                       (core/commitpipeline.py)
+    commit.barrier     the pipeline's drain-before-validate barrier
+                       (config blocks, validation-parameter updates)
 
 Arbitrary names are allowed — a new subsystem adds a `check()` call
 and tests arm it by string, no registration step.

@@ -101,6 +101,39 @@ BCCSP_PIPELINE_OVERLAP_RATIO_OPTS = GaugeOpts(
          "in the most recent overlapped verify batch: 0 = fully "
          "serial, (chunks-1)/chunks = fully pipelined.")
 
+COMMIT_PIPELINE_DEPTH_OPTS = GaugeOpts(
+    namespace="commit", subsystem="pipeline", name="depth",
+    help="Configured commit-pipeline depth: how many blocks may be "
+         "validated ahead of the block being committed "
+         "(Peer.CommitPipeline.Depth; the gauge exists only when the "
+         "pipeline is on).", label_names=("channel",))
+
+COMMIT_PIPELINE_VALIDATE_SECONDS_OPTS = GaugeOpts(
+    namespace="commit", subsystem="pipeline", name="validate_s",
+    help="Stage-A seconds (block verify + batched validation + rwset "
+         "extraction) for the most recent pipelined block.",
+    label_names=("channel",))
+
+COMMIT_PIPELINE_COMMIT_SECONDS_OPTS = GaugeOpts(
+    namespace="commit", subsystem="pipeline", name="commit_s",
+    help="Stage-B seconds (private-data gather + ledger commit) for "
+         "the most recent pipelined block.", label_names=("channel",))
+
+COMMIT_PIPELINE_OVERLAP_RATIO_OPTS = GaugeOpts(
+    namespace="commit", subsystem="pipeline", name="overlap_ratio",
+    help="Cumulative fraction of stage-A validation time hidden "
+         "behind stage-B commits of earlier blocks: 0 = fully "
+         "sequential intake, approaching 1 = validation fully hidden.",
+    label_names=("channel",))
+
+COMMIT_PIPELINE_BARRIER_TOTAL_OPTS = CounterOpts(
+    namespace="commit", subsystem="pipeline", name="barrier_total",
+    help="Times stage A drained the pipeline before validating a "
+         "block, by reason: a config-block predecessor, a "
+         "validation-parameter or _lifecycle update, or a "
+         "sequential-fallback demotion.",
+    label_names=("channel", "reason"))
+
 DELIVER_RECONNECTS_OPTS = CounterOpts(
     namespace="deliver", subsystem="client", name="reconnects",
     help="Deliver-stream reconnect attempts after a stream failure "

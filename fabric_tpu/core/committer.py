@@ -25,7 +25,8 @@ class LedgerCommitter:
 
     def commit(self, block: common.Block,
                flags: Optional[Sequence[int]] = None,
-               pvt_data: Optional[dict] = None) -> list[int]:
+               pvt_data: Optional[dict] = None,
+               rwsets=None, tx_ids=None) -> list[int]:
         if self._on_config_block is not None and \
                 pu.is_config_block(block):
             # adopt the config only if the validator accepted it
@@ -39,7 +40,8 @@ class LedgerCommitter:
                                "validation (code %s); not adopting",
                                block.header.number, flags[0])
         return self._ledger.commit_block(block, flags,
-                                         pvt_data=pvt_data)
+                                         pvt_data=pvt_data,
+                                         rwsets=rwsets, tx_ids=tx_ids)
 
     def height(self) -> int:
         return self._ledger.height

@@ -250,7 +250,9 @@ def validate_fast(v, block, bundle):
     #   ("rich", i, cc_name, klp)          — KeyLevelPrepared finish
     #   ("config", i, check)               — config replay
     #   ("py", check)                      — reference-path tx
-    txids_in_block: set = set()
+    # seeded with the commit pipeline's validated-but-uncommitted
+    # predecessor tx-ids (empty on the sequential path)
+    txids_in_block: set = set(v._known_txids)
     pending: list = []
     py_checks: list[_TxCheck] = []
 

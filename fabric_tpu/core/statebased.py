@@ -72,6 +72,14 @@ class BlockOverlay:
         """None = no in-block update; b'' = parameter removed."""
         return self._vp.get((ns, coll, key))
 
+    @property
+    def dirty(self) -> bool:
+        """True when a VALID tx of this block changed (or removed) a
+        validation parameter — the commit-pipeline barrier signal:
+        later blocks must see this block's state commit before their
+        key-level policies resolve."""
+        return bool(self._vp)
+
     def apply(self, info: WriteSetInfo) -> None:
         for (coll, key), vp in info.vp_updates.items():
             self._vp[(info.namespace, coll, key)] = vp

@@ -85,6 +85,22 @@ class TxValidatorMetrics:
 
 
 @dataclass
+class ValidationResult:
+    """What `validate_ahead` computed for one block, with every side
+    effect (TRANSACTIONS_FILTER stamp, metrics) still pending — the
+    commit pipeline publishes them via `publish_validation` only once
+    the predecessor block is durably committed, so speculative
+    validation leaves no early trace."""
+    codes: list
+    n_items: int = 0
+    duration_s: float = 0.0
+    # True when a VALID tx of this block changed key-level
+    # validation parameters (the BlockOverlay is dirty): later blocks
+    # must not be validated until this one's state commit lands
+    vp_dirty: bool = False
+
+
+@dataclass
 class _TxCheck:
     """One tx that survived structural checks: its pending crypto."""
     index: int
@@ -115,6 +131,10 @@ class TxValidator:
         self._cc_definition = cc_definition
         self._configtx_validator_source = configtx_validator_source
         self._overlay = statebased.BlockOverlay()
+        # tx-ids of validated-but-uncommitted predecessor blocks (set
+        # by the commit pipeline): they are not in the ledger's txid
+        # index yet but must still trip the duplicate-txid check
+        self._known_txids: frozenset = frozenset()
         self.metrics = metrics or TxValidatorMetrics(
             channel=channel_id)
 
@@ -375,29 +395,61 @@ class TxValidator:
         """Validate every tx; returns and stamps per-tx validation codes
         (TRANSACTIONS_FILTER — reference validator.go:259). MVCC runs
         later, at commit (`kvledger.commit_block`)."""
+        result = self.validate_ahead(block)
+        self.publish_validation(block, result)
+        return result.codes
+
+    def validate_ahead(self, block: common.Block,
+                       known_txids=None) -> ValidationResult:
+        """The pure computation of `validate`: every verdict, ZERO
+        published side effects — no TRANSACTIONS_FILTER stamp, no
+        metrics. The commit pipeline runs this for block N+1 while
+        block N commits and publishes via `publish_validation` once N
+        is durable; `known_txids` carries the tx-ids of those
+        validated-but-uncommitted predecessors so the duplicate-txid
+        verdicts stay bit-identical to the sequential order."""
         t0 = time.perf_counter()
         bundle = self._bundle_source()
         # fresh per-block overlay for same-block validation-parameter
         # updates (statebased.BlockOverlay)
         self._overlay = statebased.BlockOverlay()
+        self._known_txids = frozenset(known_txids or ())
         n = len(block.data.data)
 
-        result = None
-        from fabric_tpu.core import fastvalidate
-        if fastvalidate.available(self._csp):
-            try:
-                result = fastvalidate.validate_fast(self, block, bundle)
-            except Exception:
-                logger.exception(
-                    "fast validation path failed; falling back to the "
-                    "reference path for block [%d]",
-                    block.header.number)
-                self._overlay = statebased.BlockOverlay()
-                result = None
-        if result is None:
-            result = self._validate_reference_path(block, bundle)
+        try:
+            result = None
+            from fabric_tpu.core import fastvalidate
+            if fastvalidate.available(self._csp):
+                try:
+                    result = fastvalidate.validate_fast(self, block,
+                                                        bundle)
+                except Exception:
+                    logger.exception(
+                        "fast validation path failed; falling back to "
+                        "the reference path for block [%d]",
+                        block.header.number)
+                    self._overlay = statebased.BlockOverlay()
+                    result = None
+            if result is None:
+                result = self._validate_reference_path(block, bundle)
+        finally:
+            self._known_txids = frozenset()
         codes, n_items = result
 
+        dur = time.perf_counter() - t0
+        logger.info("[%s] validated block [%d] in %.0fms (%d txs, "
+                    "%d signatures batched)",
+                    self._channel_id, block.header.number,
+                    dur * 1e3, n, n_items)
+        return ValidationResult(codes=codes, n_items=n_items,
+                                duration_s=dur,
+                                vp_dirty=self._overlay.dirty)
+
+    def publish_validation(self, block: common.Block,
+                           result: ValidationResult) -> None:
+        """The side effects of `validate`, deferred: stamp the
+        TRANSACTIONS_FILTER and publish the validation metrics."""
+        codes = result.codes
         # init-extend metadata first (reference protoutil.CopyBlockMetadata
         # semantics): a block from a rogue orderer may arrive with no
         # metadata slots at all, and that must invalidate txs, not crash
@@ -407,19 +459,13 @@ class TxValidator:
             block.metadata.metadata.append(b"")
         block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(codes)
-        dur = time.perf_counter() - t0
-        self.metrics.validation_duration.observe(dur)
-        self.metrics.signatures_batched.add(n_items)
+        self.metrics.validation_duration.observe(result.duration_s)
+        self.metrics.signatures_batched.add(result.n_items)
         # aggregate per distinct code: validation codes repeat heavily
         # within a block, so one labeled add per code, not per tx
         from collections import Counter
         for code, cnt in Counter(codes).items():
             self.metrics.count_tx(code, cnt)
-        logger.info("[%s] validated block [%d] in %.0fms (%d txs, "
-                    "%d signatures batched)",
-                    self._channel_id, block.header.number,
-                    dur * 1e3, n, n_items)
-        return codes
 
     def _validate_reference_path(self, block, bundle
                                  ) -> tuple[list[int], int]:
@@ -430,7 +476,7 @@ class TxValidator:
         n = len(block.data.data)
         codes: list[int] = [TVC.NOT_VALIDATED] * n
         checks: list[_TxCheck] = []
-        txids_in_block: set[str] = set()
+        txids_in_block: set[str] = set(self._known_txids)
 
         # ---- phase 1: CPU structural + collect ----
         for i, env_bytes in enumerate(block.data.data):

@@ -46,9 +46,34 @@ class _LeaderChannelAdapter:
     def process_block(self, block):
         self._state.add_local_block(block)
         # wait for the ordered commit so the deliverer's seek position
-        # (ledger.height) advances before the next iteration
-        self._peer_channel.wait_for_height(block.header.number + 1,
-                                           timeout=30)
+        # (ledger.height) advances before the next iteration — but
+        # when the channel runs a CommitPipeline, allow `depth` blocks
+        # of runahead so the LEADER overlaps too: fetch+validate of
+        # block N+1 proceeds while block N commits (otherwise this
+        # wait re-serializes the one intake that feeds the whole
+        # network); the bound keeps the payload buffer from growing
+        # without limit if commits fall behind
+        pipeline = getattr(self._peer_channel, "commit_pipeline", None)
+        depth = pipeline.depth if pipeline is not None else 0
+        from fabric_tpu.protoutil import protoutil as _pu
+        if depth and _pu.is_config_block(block):
+            # no runahead past a config block: the NEXT fetched
+            # block's verify_block must evaluate the BlockValidation
+            # policy of the bundle THIS block adopts — racing ahead
+            # here would tear the stream (or worse, verify under the
+            # outgoing policy) at every config boundary
+            depth = 0
+        if not self._peer_channel.wait_for_height(
+                block.header.number + 1 - depth, timeout=30):
+            # commits are wedged: tear the deliver stream (backoff +
+            # reconnect) instead of silently buffering the orderer's
+            # output without bound — the deliverer's `expected`
+            # counter no longer provides the old height-mismatch
+            # backstop, so this timeout is the bound now
+            raise TimeoutError(
+                f"commit of block "
+                f"[{block.header.number - depth}] not durable within "
+                f"30s; refusing to buffer further ahead")
 
 
 @dataclass

@@ -158,6 +158,13 @@ class GossipStateProvider:
     # -- ordered verify → commit --
 
     def _commit_loop(self) -> None:
+        # overlapped intake (Peer.CommitPipeline.Depth > 0): this loop
+        # becomes a feeder — stage A (verify + batched validate) for
+        # block N+1 overlaps stage B (pvt gather + ledger commit) for
+        # block N inside the channel's CommitPipeline
+        pipeline = getattr(self._peer, "commit_pipeline", None)
+        if pipeline is not None:
+            return self._commit_loop_pipelined(pipeline)
         while not self._stop.is_set():
             if not self.buffer.ready.wait(timeout=0.2):
                 continue
@@ -187,6 +194,60 @@ class GossipStateProvider:
                 self.buffer.set_next(seq)
                 continue
             self._publish_height()
+
+    def _commit_loop_pipelined(self, pipeline) -> None:
+        """Feeder for the channel's CommitPipeline. Retry semantics
+        match the sequential loop: any pipelined failure (forged
+        block, commit error) resets the pipeline and rewinds the
+        payload buffer to the committed height, so anti-entropy
+        re-fetches from there — at most `depth` extra blocks."""
+        def _on_committed(seq, block, codes):
+            # validate+commit wall clock, matching the sequential
+            # loop's process_block observation (stage-B-only time
+            # lives in commit_pipeline_commit_s)
+            self._m_commit.observe(
+                pipeline.stats.get("last_block_s", 0.0))
+            self._publish_height()
+        pipeline.on_committed = _on_committed
+
+        def recover(e) -> None:
+            logger.warning("[%s] pipelined intake failed (%s); "
+                           "resetting to committed height",
+                           self.channel_id, e)
+            pipeline.reset()
+            self.buffer.set_next(self._peer.ledger.height)
+
+        while not self._stop.is_set():
+            if not self.buffer.ready.wait(timeout=0.2):
+                # idle tick: probe for an async failure — without this
+                # a rejection at the tip wedges (the buffer's _next
+                # already advanced past the bad block, so re-gossiped
+                # copies are dropped and `ready` never fires again)
+                try:
+                    pipeline.check_error()
+                except Exception as e:   # noqa: BLE001
+                    recover(e)
+                continue
+            if self._stop.is_set():
+                return
+            item = self.buffer.pop()
+            try:
+                if item is None:
+                    # surface any pending pipeline error WITHOUT
+                    # waiting — a blocking drain here would serialize
+                    # steady one-block-at-a-time flow (commits never
+                    # wait for this; stage B lands each block as soon
+                    # as its validation finishes)
+                    pipeline.check_error()
+                    continue
+                seq, raw = item
+                # abort=self._stop: a stopping provider must not sit
+                # in the backpressure wait behind a slow commit
+                pipeline.submit(seq, raw=raw, abort=self._stop)
+            except Exception as e:    # noqa: BLE001 — reset + re-fetch
+                if self._stop.is_set():
+                    return
+                recover(e)
 
     def _publish_height(self) -> None:
         try:

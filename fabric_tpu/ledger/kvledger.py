@@ -299,12 +299,16 @@ class KVLedger:
 
     def commit_block(self, block: common.Block,
                      flags: Optional[Sequence[int]] = None,
-                     pvt_data: Optional[dict] = None) -> list[int]:
+                     pvt_data: Optional[dict] = None,
+                     rwsets=None, tx_ids=None) -> list[int]:
         """The commit pipeline. `flags` carries upstream validation
         results (sig/policy failures from the txvalidator); MVCC runs
         here. `pvt_data` maps tx_num → TxPvtReadWriteSet (cleartext the
-        peer holds — from its transient store or gossip pull). Returns
-        final per-tx validation codes."""
+        peer holds — from its transient store or gossip pull). `rwsets`
+        / `tx_ids` optionally carry the already-parsed TxReadWriteSet
+        list and tx-id scan from the intake path (one decode pass per
+        block instead of one per layer). Returns final per-tx
+        validation codes."""
         t0 = time.perf_counter()
         n = len(block.data.data)
         block_num = block.header.number
@@ -315,7 +319,8 @@ class KVLedger:
                 [txpb.TxValidationCode.VALID] * n
             batch = None
         else:
-            rwsets = [extract_tx_rwset(e) for e in block.data.data]
+            if rwsets is None:
+                rwsets = [extract_tx_rwset(e) for e in block.data.data]
             codes, batch = self.txmgr.validate_and_prepare(
                 block_num, rwsets,
                 list(flags) if flags else None)
@@ -336,7 +341,7 @@ class KVLedger:
             new_commit_hash
 
         t1 = time.perf_counter()
-        self.block_store.add_block(block)
+        self.block_store.add_block(block, tx_ids=tx_ids)
         self._commit_hash = new_commit_hash
         t2 = time.perf_counter()
 

@@ -155,9 +155,17 @@ class PeerNode:
             logger.info("state database: external http engine at %s",
                         state_addr)
 
+        # pipelined block intake (core.yaml `peer.CommitPipeline`):
+        # Depth N > 0 lets each channel validate up to N blocks ahead
+        # of the block being committed; 0 (the default) keeps the
+        # sequential verify→validate→commit loop
+        cp_cfg = dict(cfg.get("peer.CommitPipeline") or {})
+        commit_pipeline_depth = int(cp_cfg.get("Depth", 0) or 0)
+
         self.peer = Peer(fs_path, local_msp, csp,
                          metrics_provider=provider,
-                         state_db_factory=state_db_factory)
+                         state_db_factory=state_db_factory,
+                         commit_pipeline_depth=commit_pipeline_depth)
         self.msp_id = msp_id
 
         # gossip over gRPC; external endpoint = peer.address

@@ -69,6 +69,9 @@ class Deliverer:
         # successfully processed block, so one long outage doesn't pin
         # the stream at retry_max_s forever afterwards
         self._backoff = FullJitterBackoff(retry_base_s, retry_max_s)
+        # pipelined intake: backoff resets on committed progress, not
+        # on submit (commits land async on the pipeline's worker)
+        self._last_committed_height = channel.ledger.height
         self.reconnects = 0
         self._reconnects_metric = None
         if metrics_provider is not None:
@@ -111,23 +114,79 @@ class Deliverer:
 
     def _pull(self, endpoint) -> None:
         channel = self._channel
-        start = channel.ledger.height
+        # overlapped intake: when the channel carries a CommitPipeline
+        # (Peer.CommitPipeline.Depth > 0), this stream becomes its
+        # feeder — the pipeline verifies + validates block N+1 on its
+        # stage-A worker while block N's host commit runs, and commits
+        # land on the pipeline's own worker (no waiting on the next
+        # stream message). The leader-adapter path has no pipeline
+        # attribute and keeps the sequential flow (its blocks enter
+        # the gossip state provider, which pipelines on its own).
+        pipeline = getattr(channel, "commit_pipeline", None)
+        start = channel.ledger.height if pipeline is None else \
+            pipeline.next_seq
         env = seek_envelope(channel.channel_id, start, self._signer)
-        for resp in endpoint.handle(env):
-            if self._stop.is_set():
-                return
-            faults.check("deliver.stream")
-            which = resp.WhichOneof("type")
-            if which == "status":
-                raise ConnectionError(
-                    f"deliver ended with status {resp.status}")
-            block = resp.block
-            # verify BEFORE touching the pipeline
-            # (blocksprovider.go:229)
-            self._mcs.verify_block(channel.channel_id,
-                                   channel.ledger.height, block)
-            channel.process_block(block)
-            # a processed block proves the stream is healthy again:
-            # reset the backoff so the NEXT outage starts from the
-            # base delay instead of the previous outage's ceiling
-            self._backoff.reset()
+        # the next block the STREAM must produce: with a pipelined
+        # channel (or the leader adapter's bounded runahead) the
+        # ledger height lags in-flight commits, so it is no longer a
+        # valid expected-sequence source per iteration
+        expected = start
+        try:
+            for resp in endpoint.handle(env):
+                if self._stop.is_set():
+                    return
+                faults.check("deliver.stream")
+                which = resp.WhichOneof("type")
+                if which == "status":
+                    raise ConnectionError(
+                        f"deliver ended with status {resp.status}")
+                block = resp.block
+                if pipeline is not None:
+                    # verification happens inside stage A (same
+                    # next-expected-block contract as below); wait for
+                    # stage A to HANDLE this block before reading the
+                    # next response, so a forged block surfaces now —
+                    # reconnect + endpoint failover, like the
+                    # sequential path — instead of idling unseen at
+                    # the tip. Block N's commit still overlaps this
+                    # wait for validate(N+1).
+                    # abort=self._stop: a stopping deliverer must not
+                    # park in backpressure behind a slow commit.
+                    # `expected` (== pipeline.next_seq within one
+                    # stream: both start there and advance per block)
+                    # is the single sequence tracker for both branches
+                    pipeline.submit(expected, block=block,
+                                    abort=self._stop)
+                    pipeline.wait_validated(expected,
+                                            abort=self._stop)
+                    # backoff resets only on COMMITTED progress — a
+                    # validated-but-uncommitted block is not yet proof
+                    # the stream is healthy
+                    height = channel.ledger.height
+                    if height > self._last_committed_height:
+                        self._last_committed_height = height
+                        self._backoff.reset()
+                else:
+                    # verify BEFORE touching the pipeline
+                    # (blocksprovider.go:229)
+                    self._mcs.verify_block(channel.channel_id,
+                                           expected, block)
+                    channel.process_block(block)
+                    # a processed block proves the stream is healthy
+                    # again: reset the backoff so the NEXT outage
+                    # starts from the base delay instead of the
+                    # previous outage's ceiling
+                    self._backoff.reset()
+                expected += 1
+            if pipeline is not None:
+                # orderly stream end: land the in-flight tail before
+                # the re-seek (a reset here would drop the last
+                # blocks and re-fetch them forever on a stream that
+                # closes at the tip)
+                pipeline.drain(abort=self._stop)
+        except Exception:
+            if pipeline is not None:
+                # torn stream / rejected block: drop in-flight work
+                # and re-seek from the committed height
+                pipeline.reset()
+            raise

@@ -78,6 +78,15 @@ class Channel:
                                        channel=channel_id))
         self.committer = LedgerCommitter(
             ledger, on_config_block=self._on_config_block)
+        # overlapped intake (Peer.CommitPipeline.Depth > 0): validate
+        # block N+1 on the device while block N's host commit runs
+        self.commit_pipeline = None
+        depth = getattr(peer, "commit_pipeline_depth", 0) or 0
+        if depth > 0:
+            from fabric_tpu.core.commitpipeline import CommitPipeline
+            self.commit_pipeline = CommitPipeline(
+                self, mcs=peer.mcs, depth=depth,
+                metrics_provider=peer.metrics_provider)
         _prov = peer.metrics_provider or _pm.DisabledProvider()
         self._m_pvt_commit = _prov.new_histogram(
             PVT_COMMIT_BLOCK_DURATION).with_labels(
@@ -200,26 +209,45 @@ class Channel:
         final tx codes. Reference: gossip/state deliverPayloads →
         coordinator.StoreBlock (`gossip/privdata/coordinator.go:152`,
         SURVEY §3.4)."""
-        import time as _t
         flags = self.validator.validate(block)
+        rwsets = None
+        if not pu.is_config_block(block) and block.header.number != 0:
+            from fabric_tpu.ledger.kvledger import extract_tx_rwset
+            rwsets = [extract_tx_rwset(e) for e in block.data.data]
+        tx_ids = self.ledger.block_store.block_tx_ids(block)
+        return self.commit_validated(block, flags, rwsets=rwsets,
+                                     tx_ids=tx_ids)
+
+    def commit_validated(self, block: common.Block, flags: list[int],
+                         rwsets=None, tx_ids=None) -> list[int]:
+        """The host half of block intake: gather private data →
+        commit → purge → notify, with the validation verdicts (and
+        optionally the parsed rwsets + scanned tx-ids — each envelope
+        decoded exactly once per block) already in hand. The commit
+        pipeline calls this for block N while block N+1 validates."""
+        import time as _t
         t0 = _t.perf_counter()
-        pvt_data, committed_txids = self._gather_pvt_data(block, flags)
+        pvt_data, committed_txids = self._gather_pvt_data(
+            block, flags, rwsets=rwsets, tx_ids=tx_ids)
         t1 = _t.perf_counter()
-        codes = self.committer.commit(block, flags, pvt_data=pvt_data)
+        codes = self.committer.commit(block, flags, pvt_data=pvt_data,
+                                      rwsets=rwsets, tx_ids=tx_ids)
         t2 = _t.perf_counter()
         if committed_txids:
             self._peer.transient_store.purge_by_txids(committed_txids)
             self._m_pvt_purge.observe(_t.perf_counter() - t2)
         self._m_pvt_pull.observe(t1 - t0)
-        self._m_pvt_commit.observe(t2 - t0)
-        self._notify_commit(block, codes)
+        self._m_pvt_commit.observe(t2 - t1)
+        self._notify_commit(block, codes, tx_ids=tx_ids)
         return codes
 
-    def _gather_pvt_data(self, block: common.Block, flags: list[int]
+    def _gather_pvt_data(self, block: common.Block, flags: list[int],
+                         rwsets=None, tx_ids=None
                          ) -> tuple[dict, list[str]]:
         """Transient-store lookup per valid tx that advertises hashed
         collection writes (the gossip pull for still-missing data is
-        the reconciler's job)."""
+        the reconciler's job). `rwsets`/`tx_ids` reuse the intake
+        path's single parse pass when provided."""
         from fabric_tpu.ledger.kvledger import extract_tx_rwset
         pvt_data: dict[int, object] = {}
         txids: list[str] = []
@@ -227,18 +255,25 @@ class Channel:
         for i, env_bytes in enumerate(block.data.data):
             if flags[i] != txpb.TxValidationCode.VALID:
                 continue
-            txrw = extract_tx_rwset(env_bytes)
+            txrw = rwsets[i] if rwsets is not None else \
+                extract_tx_rwset(env_bytes)
             if txrw is None or not any(
                     nsrw.collection_hashed_rwset
                     for nsrw in txrw.ns_rwset):
                 continue
-            try:
-                env = pu.unmarshal_envelope(env_bytes)
-                ch = pu.get_channel_header(pu.get_payload(env))
-            except Exception:
+            if tx_ids is not None:
+                tx_id = tx_ids[i]
+            else:
+                try:
+                    env = pu.unmarshal_envelope(env_bytes)
+                    tx_id = pu.get_channel_header(
+                        pu.get_payload(env)).tx_id
+                except Exception:
+                    continue
+            if not tx_id:
                 continue
-            txids.append(ch.tx_id)
-            stored = store.get(ch.tx_id)
+            txids.append(tx_id)
+            stored = store.get(tx_id)
             if stored is not None:
                 pvt_data[i] = stored
         return pvt_data, txids
@@ -247,16 +282,20 @@ class Channel:
     #    internal/pkg/gateway/commit) --
 
     def _notify_commit(self, block: common.Block,
-                       codes: list[int]) -> None:
+                       codes: list[int], tx_ids=None) -> None:
         events = []
-        for i, env_bytes in enumerate(block.data.data):
-            try:
-                env = pu.unmarshal_envelope(env_bytes)
-                ch = pu.get_channel_header(pu.get_payload(env))
-                if ch.tx_id:
-                    events.append((ch.tx_id, codes[i]))
-            except Exception:
-                continue
+        if tx_ids is not None:
+            events = [(tid, codes[i]) for i, tid in enumerate(tx_ids)
+                      if tid]
+        else:
+            for i, env_bytes in enumerate(block.data.data):
+                try:
+                    env = pu.unmarshal_envelope(env_bytes)
+                    ch = pu.get_channel_header(pu.get_payload(env))
+                    if ch.tx_id:
+                        events.append((ch.tx_id, codes[i]))
+                except Exception:
+                    continue
         with self._commit_cond:
             self._last_committed = block.header.number
             self._commit_cond.notify_all()
@@ -301,10 +340,14 @@ class Peer:
     `internal/peer/node/start.go` serve()."""
 
     def __init__(self, ledger_root: str, local_msp, csp,
-                 metrics_provider=None, state_db_factory=None):
+                 metrics_provider=None, state_db_factory=None,
+                 commit_pipeline_depth: int = 0):
         self.csp = csp
         self.local_msp = local_msp
         self.metrics_provider = metrics_provider
+        # Peer.CommitPipeline.Depth (0 = off): blocks validated ahead
+        # of the one being committed, per channel
+        self.commit_pipeline_depth = int(commit_pipeline_depth or 0)
         self.signer = local_msp.get_default_signing_identity()
         self.ledger_mgr = LedgerManager(
             ledger_root, metrics_provider=metrics_provider,
@@ -387,5 +430,8 @@ class Peer:
         return self.channels.get(channel_id)
 
     def close(self) -> None:
+        for channel in list(self.channels.values()):
+            if channel.commit_pipeline is not None:
+                channel.commit_pipeline.stop()
         self.transient_store.close()
         self.ledger_mgr.close()

@@ -10,7 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -59,12 +59,27 @@ onboarding() {
         tests/test_onboarding.py -k "Chaos"
 }
 
+commit() {
+    # pipelined block intake under fire: stage-A faults demote blocks
+    # to the sequential path, barrier faults must never corrupt —
+    # codes, filters and commit hashes stay bit-identical throughout
+    # only the feeder-path tests (GossipState/Deliver) keep the env
+    # arming live — the parity/fault tests pin exact stats and clear
+    # it, so selecting them here would make the pass vacuous
+    run "commit.validate_ahead=error:2" tests/test_commit_pipeline.py
+    run "commit.barrier=error:1" tests/test_commit_pipeline.py \
+        -k "GossipState or Deliver"
+    run "commit.validate_ahead=delay:3:0.05;commit.barrier=delay:2:0.05" \
+        tests/test_commit_pipeline.py -k "Parity or GossipState or Deliver"
+}
+
 case "${1:-all}" in
     bccsp) bccsp ;;
     raft) raft ;;
     deliver) deliver ;;
     onboarding) onboarding ;;
-    all) bccsp; raft; deliver; onboarding ;;
+    commit) commit ;;
+    all) bccsp; raft; deliver; onboarding; commit ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 
