@@ -34,6 +34,8 @@ from fabric_tpu.bccsp import sw as swmod
 from fabric_tpu.bccsp import utils
 from fabric_tpu.common import breaker as breaker_mod
 from fabric_tpu.common import faults
+from fabric_tpu.common import lockcheck
+from fabric_tpu.common.hotpath import hot_path
 
 logger = logging.getLogger("bccsp.tpu")
 
@@ -473,6 +475,7 @@ class TPUProvider(api.BCCSP):
             premask, digests, has_digest, qx_b, qy_b, n, items,
             sw_lanes)
 
+    @hot_path
     def _dispatch_arrays(self, bucket, key_map, key_idx, blocks,
                          nblocks, r_l, rpn_l, w_l, premask, digests,
                          has_digest, qx_b, qy_b, async_out=False):
@@ -481,6 +484,7 @@ class TPUProvider(api.BCCSP):
         With async_out the DISPATCH happens now and a thunk returning
         the materialized np result is returned (jax compute proceeds
         in the background while the caller works)."""
+        lockcheck.note_blocking("tpu.dispatch")
         faults.check("tpu.dispatch")
         import jax.numpy as jnp
 
@@ -499,6 +503,8 @@ class TPUProvider(api.BCCSP):
                          (blocks, nblocks, qx_l, qy_l, r_l, rpn_l, w_l,
                           premask, digests, has_digest))
             out = self._pipeline()(*args)
+            # ftpu-lint: allow-host-sync(the thunk IS the deliberate
+            # materialization point, invoked after dispatch returns)
             thunk = lambda: np.asarray(out)  # noqa: E731
         return thunk if async_out else thunk()
 
@@ -545,6 +551,7 @@ class TPUProvider(api.BCCSP):
                     max_workers=1, thread_name_prefix="bccsp-prep")
             return self._prep_pool
 
+    @hot_path
     def _verify_batch_pipelined(self, items) -> Optional[list[bool]]:
         """Double-buffered verify: the batch is split into fixed
         PipelineChunk-lane spans; while span N executes on device,
@@ -617,6 +624,7 @@ class TPUProvider(api.BCCSP):
         if not (0 < len(key_map) <= self._max_keys):
             return None             # ladder/empty batches: legacy path
 
+        lockcheck.note_blocking("tpu.dispatch")
         faults.check("tpu.dispatch")
         import jax
 
@@ -698,6 +706,8 @@ class TPUProvider(api.BCCSP):
             outs.append(fn(dev[0], q_flat, g16, *dev[1:]))
             dispatch_s += _time.perf_counter() - t0
         t0 = _time.perf_counter()
+        # ftpu-lint: allow-host-sync(end-of-batch materialization: all
+        # spans are dispatched, this is the single deliberate sync)
         flat = np.concatenate([np.asarray(o) for o in outs])
         t_done = _time.perf_counter()
         device_s = dispatch_s + (t_done - t0)
@@ -1600,12 +1610,14 @@ class TPUProvider(api.BCCSP):
             chunk = max(m, (chunk // m) * m)
         return chunk
 
+    @hot_path
     def _dispatch_comb_digest(self, bucket, key_map, key_idx, r8, rpn8,
                               w8, premask, digests, async_out=False):
         """Digest-lane comb dispatch: compact u8 scalar operands, limb
         conversion ON DEVICE, no SHA stage (_comb_pipeline_digest) —
         the transfer-minimal shape for the host-hash default and the
         prepared-block fast path."""
+        lockcheck.note_blocking("tpu.dispatch")
         faults.check("tpu.dispatch")
         import time as _time
 
@@ -1648,12 +1660,15 @@ class TPUProvider(api.BCCSP):
 
         def thunk():
             t0 = _time.perf_counter()
+            # ftpu-lint: allow-host-sync(the thunk IS the deliberate
+            # materialization point, invoked after dispatch returns)
             out = np.concatenate([np.asarray(o) for o in outs])
             self.stats["prepared_device_s"] = round(
                 dispatch_s + _time.perf_counter() - t0, 6)
             return out
         return thunk if async_out else thunk()
 
+    @hot_path
     def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
                        r_l, rpn_l, w_l, premask, digests, has_digest,
                        async_out=False):
@@ -1677,6 +1692,7 @@ class TPUProvider(api.BCCSP):
                 jnp.asarray(digests[lo:hi]),
                 jnp.asarray(has_digest[lo:hi])))
         thunk = lambda: np.concatenate(  # noqa: E731
+            # ftpu-lint: allow-host-sync(deliberate materialization)
             [np.asarray(o) for o in outs])
         return thunk if async_out else thunk()
 

@@ -19,8 +19,13 @@ The well-known points:
     commit.barrier     the pipeline's drain-before-validate barrier
                        (config blocks, validation-parameter updates)
 
-Arbitrary names are allowed — a new subsystem adds a `check()` call
-and tests arm it by string, no registration step.
+A new subsystem adds a `check()` call AND declares the point in
+`KNOWN_POINTS` below — the canonical registry `tools/ftpu_lint.py`
+checks every call-site literal against, and `arm()` warns on unknown
+names so a typo'd FTPU_FAULTS entry is loud instead of inert (the
+chaos suite would otherwise pass vacuously). Arbitrary names still
+ARM (tests of the registry itself use made-up points); they just
+warn.
 
 Arming:
   - code:  `faults.arm("tpu.dispatch", mode="error", count=3)`
@@ -56,6 +61,24 @@ class FaultInjected(RuntimeError):
     """Raised by an armed `error` fault point."""
 
 
+# The canonical fault-point registry: every `faults.check("...")`
+# call-site literal in the tree must appear here (enforced by
+# tools/ftpu_lint.py's fault-point rule), and `arm()` warns when an
+# unknown name is armed. Keep the docstring table above in sync.
+KNOWN_POINTS = frozenset({
+    "tpu.dispatch",
+    "tpu.compile",
+    "tpu.table_persist",
+    "raft.step",
+    "deliver.stream",
+    "cluster.pull",
+    "cluster.verify",
+    "onboarding.commit",
+    "commit.validate_ahead",
+    "commit.barrier",
+})
+
+
 @dataclass
 class _Arming:
     mode: str                      # "error" | "delay"
@@ -77,6 +100,11 @@ class FaultRegistry:
             message: str = "") -> None:
         if mode not in ("error", "delay"):
             raise ValueError(f"unknown fault mode {mode!r}")
+        if point not in KNOWN_POINTS:
+            logger.warning(
+                "arming UNKNOWN fault point %r — no check() site "
+                "declares it in KNOWN_POINTS (common/faults.py); a "
+                "typo'd %s entry injects nothing", point, ENV_VAR)
         with self._lock:
             self._armed[point] = _Arming(mode=mode, count=count,
                                          delay_s=delay_s,
@@ -152,6 +180,10 @@ class FaultRegistry:
         # act OUTSIDE the lock: a delay fault must not serialize every
         # other fault point behind its sleep
         if mode == "delay":
+            # the sanitizer treats an injected stall like a device
+            # dispatch: holding any tracked lock across it is a finding
+            from fabric_tpu.common import lockcheck
+            lockcheck.note_blocking(f"fault-delay:{point}")
             time.sleep(delay_s)
             return
         raise FaultInjected(

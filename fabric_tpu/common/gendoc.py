@@ -4,10 +4,14 @@ Rebuild of `common/metrics/gendoc/` (which AST-walks the Go tree for
 `*Opts` literals and renders `docs/source/metrics_reference.rst`): this
 walks the `fabric_tpu` package with `ast`, collects every
 `CounterOpts/GaugeOpts/HistogramOpts(...)` call whose fields are
-literals, and renders `docs/metrics_reference.md`. Run
-`python -m fabric_tpu.common.gendoc` to regenerate, `--check` to fail
-when the committed doc is stale (enforced by
-`tests/test_observability.py`).
+literals, and renders `docs/metrics_reference.md`.
+
+Regeneration contract: after adding/changing ANY literal `*Opts(...)`
+declaration, run `python -m fabric_tpu.common.gendoc` and commit the
+doc. `--check` regenerates in memory and exits 1 with a unified diff
+on any drift — enforced by `tests/test_observability.py`, by
+`tools/ftpu_lint.py`'s metric-drift rule, and by the
+`tools/static_check.sh` CI gate.
 
 Dynamically-named instruments (e.g. the BCCSP provider-stats gauges,
 whose names mirror `TPUProvider.stats` keys at runtime) cannot be
@@ -114,6 +118,14 @@ def generate(root: str) -> str:
         "endpoint's `/metrics`",
         "(or pushed via statsd), per `operations.metrics.provider`.",
         "",
+        "Do not edit by hand: after changing any literal "
+        "`*Opts(...)` declaration,",
+        "regenerate and commit — `gendoc --check` (run by "
+        "`tools/static_check.sh`,",
+        "the ftpu_lint metric-drift rule, and "
+        "tests/test_observability.py) fails CI",
+        "with a unified diff on any drift.",
+        "",
     ]
     for kind, title in (("counter", "Counters"), ("gauge", "Gauges"),
                         ("histogram", "Histograms")):
@@ -149,8 +161,14 @@ def main(argv=None) -> int:
         except FileNotFoundError:
             current = ""
         if current != rendered:
+            import difflib
             print(f"{doc_path} is stale: regenerate with "
                   f"python -m fabric_tpu.common.gendoc")
+            for line in difflib.unified_diff(
+                    current.splitlines(), rendered.splitlines(),
+                    fromfile="committed", tofile="generated",
+                    lineterm=""):
+                print(line)
             return 1
         print(f"{doc_path} is current")
         return 0

@@ -1,0 +1,20 @@
+"""`@hot_path` — a zero-cost marker for device-latency-critical spans.
+
+Functions carrying this decorator are the overlapped verify/dispatch
+spans (bccsp/tpu.py) and commit-pipeline stage A: code where an
+accidental host synchronization (`.item()`, `float()`/`bool()` on a
+device array, `np.asarray` mid-span) silently stalls the pipeline the
+whole design exists to overlap. The marker does nothing at runtime;
+`tools/ftpu_lint.py`'s host-sync rule walks decorated functions (and
+their nested closures) and flags those calls unless the line carries
+an explicit `# ftpu-lint: allow-host-sync(<reason>)` waiver — the
+deliberate materialization points (end-of-span thunks) carry one.
+"""
+
+from __future__ import annotations
+
+
+def hot_path(fn):
+    """Mark `fn` as a device-hot span for the static host-sync lint."""
+    fn.__ftpu_hot_path__ = True
+    return fn

@@ -1,0 +1,476 @@
+"""Opt-in runtime lock-order sanitizer (FTPU_LOCKCHECK=1).
+
+The rebuild is deeply threaded — commit pipeline, verify pipeline,
+onboarding replicator, breaker watchdog, gossip — and Python has no
+`go vet`/`-race` equivalent to keep the locking honest. This module is
+the runtime half of the round-8 static-analysis suite (the AST half is
+`tools/ftpu_lint.py`): armed via env, it wraps `threading.Lock/RLock/
+Condition` creation, records the per-thread lock acquisition graph and
+reports
+
+  * order inversions — thread 1 acquires A then B while thread 2 (or a
+    later acquisition anywhere) acquires B then A: a potential
+    deadlock, reported with the acquisition stacks of BOTH edges;
+  * locks held across a blocking span — a device dispatch
+    (`bccsp/tpu.py` calls `note_blocking("tpu.dispatch")` next to its
+    fault points) or an injected-fault sleep (`faults.check` delay
+    mode): holding any tracked lock across one serializes every other
+    holder behind hardware latency, reported with the lock's
+    acquisition stack AND the blocking call's stack.
+
+Lock identity is the CREATION SITE (file:line), not the instance — the
+lockdep "lock class" idea: two instances created by the same
+constructor line are one class, so an A→B / B→A inversion is caught
+even when every run sees distinct instances. Nested acquisitions of
+the same class are skipped (a container class locking two of its own
+instances in address order would false-positive otherwise).
+
+Arming:
+  FTPU_LOCKCHECK=1      record violations; the pytest session fails at
+                        exit if any were recorded (tests/conftest.py)
+  FTPU_LOCKCHECK=raise  additionally raise LockOrderError at the
+                        detection point (pinpoints the acquiring test)
+
+Production overhead is zero: nothing is patched unless the env var is
+set, and `note_blocking()` is one module-global check when it is not.
+
+Known-benign findings are waived in code via `allow_blocking(tag,
+site_substring, reason)` / `allow_pair(site_a, site_b, reason)` —
+every waiver carries a reason string, mirroring the linter's
+`# ftpu-lint: allow-*` comment grammar.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+ENV_VAR = "FTPU_LOCKCHECK"
+
+# originals, captured before install() ever patches the module
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+_orig_condition = threading.Condition
+
+_STACK_LIMIT = 24
+_OWN_FILE = os.path.abspath(__file__)
+
+
+class LockOrderError(RuntimeError):
+    """Raised at the detection point under FTPU_LOCKCHECK=raise."""
+
+
+def _capture_stack(skip: int = 2) -> tuple:
+    """Cheap stack summary: (file, line, func) triples, innermost
+    first, lockcheck's own frames dropped. No source-line lookup —
+    this runs on every first acquisition of every tracked lock."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:
+        return ()
+    out = []
+    while f is not None and len(out) < _STACK_LIMIT:
+        code = f.f_code
+        if os.path.abspath(code.co_filename) != _OWN_FILE:
+            out.append((code.co_filename, f.f_lineno, code.co_name))
+        f = f.f_back
+    return tuple(out)
+
+
+def _render_stack(stack: tuple, indent: str = "    ") -> str:
+    if not stack:
+        return indent + "<no stack captured>"
+    return "\n".join(f'{indent}File "{fn}", line {ln}, in {func}'
+                     for fn, ln, func in stack)
+
+
+def _creation_site() -> str:
+    """file:line of the frame that called the lock factory — the
+    lock's CLASS for ordering purposes (lockdep-style)."""
+    stack = _capture_stack(skip=3)
+    for fn, ln, _func in stack:
+        base = os.path.abspath(fn)
+        if base != _OWN_FILE and os.sep + "threading.py" not in base:
+            return f"{fn}:{ln}"
+    return "<unknown>"
+
+
+@dataclass
+class Violation:
+    kind: str                 # "order-inversion" | "held-across-blocking"
+    description: str
+    stacks: list = field(default_factory=list)  # [(label, stack tuple)]
+
+    def render(self) -> str:
+        lines = [f"[{self.kind}] {self.description}"]
+        for label, stack in self.stacks:
+            lines.append(f"  {label}:")
+            lines.append(_render_stack(stack))
+        return "\n".join(lines)
+
+
+@dataclass
+class _Edge:
+    """First observation of `held_site` held while `acq_site` was
+    acquired: both stacks kept so an inversion found later can show
+    the OTHER order's evidence too."""
+    held_stack: tuple
+    acq_stack: tuple
+    thread: str
+
+
+class _Held:
+    __slots__ = ("lock", "count", "stack")
+
+    def __init__(self, lock, stack):
+        self.lock = lock
+        self.count = 1
+        self.stack = stack
+
+
+class LockSanitizer:
+    """One acquisition graph. The module singleton (installed via
+    env) is the production mode; tests instantiate their own so
+    violations never leak between cases."""
+
+    def __init__(self, raise_on_violation: bool = False):
+        self.raise_on_violation = raise_on_violation
+        self._state = _orig_lock()        # guards graph + violations
+        self._edges: dict[tuple, _Edge] = {}
+        self._violations: list[Violation] = []
+        self._seen: set = set()           # dedup keys
+        self._allowed_pairs: list[tuple] = []
+        self._allowed_blocking: list[tuple] = []
+        self._tls = threading.local()
+
+    # -- factories (what install() binds over threading.*) --
+
+    def lock(self):
+        return _TrackedLock(_orig_lock(), self, _creation_site())
+
+    def rlock(self):
+        return _TrackedLock(_orig_rlock(), self, _creation_site())
+
+    def condition(self, lock=None):
+        # a Condition's protocol calls land on the tracked lock it
+        # wraps, so the Condition itself needs no wrapper
+        return _orig_condition(lock if lock is not None else
+                               self.rlock())
+
+    # -- waivers --
+
+    def allow_pair(self, site_a: str, site_b: str, reason: str) -> None:
+        """Waive the inversion between two lock classes (substring
+        match on creation sites). Reason is mandatory — it is the
+        audit trail."""
+        if not reason:
+            raise ValueError("lockcheck waiver needs a reason")
+        self._allowed_pairs.append((site_a, site_b))
+
+    def allow_blocking(self, tag: str, site: str, reason: str) -> None:
+        """Waive holding the lock class created at `site` (substring)
+        across blocking spans tagged `tag`."""
+        if not reason:
+            raise ValueError("lockcheck waiver needs a reason")
+        self._allowed_blocking.append((tag, site))
+
+    # -- observation --
+
+    def violations(self) -> list:
+        with self._state:
+            return list(self._violations)
+
+    def clear(self) -> None:
+        with self._state:
+            self._violations.clear()
+            self._edges.clear()
+            self._seen.clear()
+
+    def report(self) -> str:
+        vs = self.violations()
+        if not vs:
+            return "lockcheck: clean"
+        head = (f"lockcheck: {len(vs)} violation(s) — potential "
+                f"deadlock / device-latency serialization:")
+        return "\n\n".join([head] + [v.render() for v in vs])
+
+    # -- internals --
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        elif any(h.count <= 0 for h in held):
+            # prune entries zeroed by a cross-thread release — only
+            # the OWNER thread ever mutates the list structure
+            held[:] = [h for h in held if h.count > 0]
+        return held
+
+    def _record(self, v: Violation) -> None:
+        self._violations.append(v)
+        if self.raise_on_violation:
+            raise LockOrderError(v.render())
+
+    def _pair_allowed(self, a: str, b: str) -> bool:
+        for sa, sb in self._allowed_pairs:
+            if ((sa in a and sb in b) or (sa in b and sb in a)):
+                return True
+        return False
+
+    def _find_path(self, src: str, dst: str) -> Optional[list]:
+        """DFS for a held→acquired path src→…→dst in the edge graph;
+        returns the edge list of the path or None. Called with
+        self._state held."""
+        adj: dict[str, list] = {}
+        for (a, b) in self._edges:
+            adj.setdefault(a, []).append(b)
+        stack = [(src, [])]
+        visited = set()
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            if node in visited:
+                continue
+            visited.add(node)
+            for nxt in adj.get(node, ()):
+                stack.append((nxt, path + [(node, nxt)]))
+        return None
+
+    def _on_acquired(self, lock: "_TrackedLock") -> None:
+        held = self._held()
+        for h in held:
+            if h.lock is lock and h.count > 0:
+                h.count += 1
+                return
+        stack = _capture_stack()
+        site = lock._site
+        with self._state:
+            for h in held:
+                hsite = h.lock._site
+                if h.count <= 0 or hsite == site:
+                    continue    # same class: see module docstring
+                edge = (hsite, site)
+                if edge in self._edges:
+                    continue
+                # inversion iff the REVERSE direction is already
+                # reachable: site → … → hsite
+                path = self._find_path(site, hsite)
+                if path is not None and \
+                        not self._pair_allowed(hsite, site):
+                    key = ("inv", frozenset((hsite, site)))
+                    if key not in self._seen:
+                        self._seen.add(key)
+                        stacks = [
+                            (f"this thread "
+                             f"({threading.current_thread().name}) "
+                             f"holds {hsite}, acquired at", h.stack),
+                            (f"while acquiring {site} at", stack),
+                        ]
+                        for (a, b) in path:
+                            e = self._edges[(a, b)]
+                            stacks.append(
+                                (f"but thread {e.thread} already "
+                                 f"acquired {b} while holding {a}, "
+                                 f"{a} acquired at", e.held_stack))
+                            stacks.append(
+                                (f"  … then {b} at", e.acq_stack))
+                        self._record(Violation(
+                            kind="order-inversion",
+                            description=(f"lock order inversion: "
+                                         f"{hsite} -> {site} vs "
+                                         f"existing {site} -> … -> "
+                                         f"{hsite}"),
+                            stacks=stacks))
+                self._edges[edge] = _Edge(
+                    held_stack=h.stack, acq_stack=stack,
+                    thread=threading.current_thread().name)
+        entry = _Held(lock, stack)
+        held.append(entry)
+        # remember where the holder entry lives: a plain Lock may
+        # legally be RELEASED by another thread (handoff idiom), and
+        # the releaser must be able to evict the owner's entry or the
+        # owner's next note_blocking reports a lock it no longer holds
+        lock._owner_rec = (held, entry)
+
+    def _on_released(self, lock: "_TrackedLock") -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is lock and held[i].count > 0:
+                held[i].count -= 1
+                if held[i].count <= 0:
+                    del held[i]
+                    lock._owner_rec = None
+                return
+        # cross-thread release (a plain Lock used as a handoff): mark
+        # the OWNER thread's entry dead so its next note_blocking does
+        # not report a lock it no longer holds. Only the count is
+        # written from this thread — the owner prunes the list
+        # structure itself (_held), so its lock-free iterations can
+        # never see a shrunken list mid-loop.
+        rec = getattr(lock, "_owner_rec", None)
+        if rec is not None:
+            _owner_held, entry = rec
+            lock._owner_rec = None
+            entry.count = 0
+        # else: acquired before tracking started — nothing to unwind
+
+    def note_blocking(self, tag: str) -> None:
+        """Call on entry to a span that blocks on hardware or an
+        injected stall. Any tracked lock held here is a finding."""
+        held = self._held()
+        if not held:
+            return
+        stack = _capture_stack()
+        with self._state:
+            for h in held:
+                if h.count <= 0:
+                    continue    # zeroed by a cross-thread release
+                site = h.lock._site
+                if any(t == tag and s in site
+                       for t, s in self._allowed_blocking):
+                    continue
+                key = ("blk", tag, site)
+                if key in self._seen:
+                    continue
+                self._seen.add(key)
+                self._record(Violation(
+                    kind="held-across-blocking",
+                    description=(f"lock {site} held across blocking "
+                                 f"span '{tag}' (serializes other "
+                                 f"holders behind device/fault "
+                                 f"latency)"),
+                    stacks=[(f"lock {site} acquired at", h.stack),
+                            (f"blocking span '{tag}' entered at",
+                             stack)]))
+
+
+class _TrackedLock:
+    """Duck-typed Lock/RLock wrapper: full lock protocol including the
+    `_release_save`/`_acquire_restore`/`_is_owned` trio Condition
+    uses, with held-set bookkeeping kept honest across `wait()`'s
+    release/reacquire."""
+
+    def __init__(self, inner, sanitizer: LockSanitizer, site: str):
+        self._inner = inner
+        self._san = sanitizer
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            try:
+                self._san._on_acquired(self)
+            except BaseException:
+                self._inner.release()   # raise mode: don't leak the
+                raise                   # real lock with the report
+        return ok
+
+    def release(self):
+        self._san._on_released(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+        return False
+
+    # Condition protocol. The inner C RLock provides all three; a
+    # plain inner Lock gets the same fallbacks Condition itself would
+    # have used had it seen an unwrapped Lock.
+    def _release_save(self):
+        self._san._on_released(self)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._san._on_acquired(self)
+
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _at_fork_reinit(self):
+        # os.register_at_fork handlers (concurrent.futures.thread)
+        # reinitialize locks in the child — delegate
+        return self._inner._at_fork_reinit()
+
+    def __getattr__(self, name):
+        # any residual lock-protocol surface resolves on the real lock
+        return getattr(self._inner, name)
+
+    def __repr__(self):
+        return f"<tracked {self._inner!r} from {self._site}>"
+
+
+# -- module-level singleton + install --
+
+_SAN: Optional[LockSanitizer] = None
+
+
+def enabled() -> bool:
+    return _SAN is not None
+
+
+def sanitizer() -> Optional[LockSanitizer]:
+    return _SAN
+
+
+def install(raise_on_violation: bool = False) -> LockSanitizer:
+    """Patch threading.Lock/RLock/Condition to produce tracked locks.
+    Idempotent. Call EARLY (before the modules under test create
+    their locks) — tests/conftest.py does this when FTPU_LOCKCHECK
+    is set."""
+    global _SAN
+    if _SAN is None:
+        _SAN = LockSanitizer(raise_on_violation=raise_on_violation)
+        threading.Lock = _SAN.lock
+        threading.RLock = _SAN.rlock
+        threading.Condition = _SAN.condition
+    return _SAN
+
+
+def uninstall() -> None:
+    """Restore the original factories (already-created tracked locks
+    keep working — they only wrap). Test helper."""
+    global _SAN
+    if _SAN is not None:
+        threading.Lock = _orig_lock
+        threading.RLock = _orig_rlock
+        threading.Condition = _orig_condition
+        _SAN = None
+
+
+def install_from_env() -> Optional[LockSanitizer]:
+    mode = os.environ.get(ENV_VAR, "").strip().lower()
+    if mode in ("", "0", "false", "off"):
+        return None
+    return install(raise_on_violation=(mode == "raise"))
+
+
+def note_blocking(tag: str) -> None:
+    """Product-code hook at blocking spans (device dispatch, injected
+    stalls). One global load + None check when the sanitizer is off."""
+    san = _SAN
+    if san is not None:
+        san.note_blocking(tag)

@@ -362,8 +362,11 @@ class StatsdProvider(PrometheusProvider):
         while not self._stop.wait(self._interval):
             try:
                 self.flush()
+            # ftpu-lint: allow-swallow(a statsd outage must never hurt
+            # the node, and warning once per interval would spam for
+            # the outage's whole duration; flush retries next tick)
             except Exception:
-                pass    # a statsd outage must never hurt the node
+                pass
 
     def _path(self, name: str, key) -> str:
         parts = [self._prefix] if self._prefix else []

@@ -17,10 +17,13 @@ adapted to this runtime:
 from __future__ import annotations
 
 import collections
+import logging
 import os
 import sys
 import threading
 import time
+
+logger = logging.getLogger("common.profiling")
 
 SAMPLE_HZ = 100
 
@@ -121,12 +124,17 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
 
     def poll():
         last_trips = 0
+        warned: set = set()     # once per gauge, not once per poll_s
         while True:
             for name, g in gauges.items():
                 try:
                     g.set(float(stats.get(name, 0)))
-                except Exception:
-                    pass
+                except Exception as e:
+                    if name not in warned:
+                        warned.add(name)
+                        logger.warning("bccsp stats gauge %r publish "
+                                       "failed (suppressing repeats): "
+                                       "%s", name, e)
             if fallback_state is not None:
                 try:
                     fallback_state.set(float(breaker.state_code))
@@ -134,8 +142,12 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
                     if trips > last_trips:
                         fallback_trips.add(trips - last_trips)
                         last_trips = trips
-                except Exception:
-                    pass
+                except Exception as e:
+                    if "breaker" not in warned:
+                        warned.add("breaker")
+                        logger.warning("bccsp breaker gauge publish "
+                                       "failed (suppressing repeats): "
+                                       "%s", e)
             time.sleep(poll_s)
 
     t = threading.Thread(target=poll, name="bccsp-stats", daemon=True)

@@ -169,6 +169,9 @@ class ExternalChaincodeClient:
                 self._to_cc.put(None)
             if self._channel is not None:
                 self._channel.close()
+        # ftpu-lint: allow-swallow(teardown of an already-broken
+        # stream: close() on a dead channel raises routinely and the
+        # caller surfaces the underlying stream failure)
         except Exception:
             pass
         self._channel = None
@@ -435,7 +438,9 @@ class ChaincodeServer:
                 for msg in request_iterator:
                     session.handle(msg)
             except Exception:
-                pass
+                logger.warning("chaincode server [%s]: request stream "
+                               "pump failed; ending session",
+                               self._name, exc_info=True)
             out.put(None)
 
         threading.Thread(target=pump_in, daemon=True).start()

@@ -82,6 +82,9 @@ class LaunchedChaincode:
     def stop(self) -> None:
         try:
             self.client.close()
+        # ftpu-lint: allow-swallow(teardown close of a possibly-dead
+        # chaincode client; the process terminate/kill below is the
+        # real stop)
         except Exception:
             pass
         if self.process is not None and self.process.poll() is None:

@@ -62,6 +62,7 @@ from typing import Callable, Optional
 
 from fabric_tpu.common import faults
 from fabric_tpu.common import metrics as metrics_mod
+from fabric_tpu.common.hotpath import hot_path
 
 logger = logging.getLogger("commitpipeline")
 
@@ -438,6 +439,7 @@ class CommitPipeline:
                 raise _Rejected("verify", e) from e
         item.verified = True
 
+    @hot_path
     def _validate_one(self, item: _Item) -> None:
         from fabric_tpu import protoutil as pu
         from fabric_tpu.ledger.kvledger import extract_tx_rwset

@@ -165,6 +165,9 @@ def resolve_vp_policy(vp_bytes: bytes, evaluator, deserializer, csp):
         app.ParseFromString(vp_bytes)
         if app.WhichOneof("type") is not None:
             return evaluator.resolve(vp_bytes)
+    # ftpu-lint: allow-swallow(format detection, not failure handling:
+    # bytes that do not parse as ApplicationPolicy fall through to the
+    # bare SignaturePolicyEnvelope interpretation below)
     except Exception:
         pass
     return cauthdsl.SignaturePolicy.from_bytes(vp_bytes, deserializer, csp)

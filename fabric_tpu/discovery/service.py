@@ -225,8 +225,10 @@ class DiscoveryService:
                 imp = polpb.ImplicitMetaPolicy()
                 imp.ParseFromString(pol.value)
                 rule = imp.rule
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("discovery: implicit-meta policy lookup "
+                           "for %r failed (%s); assuming MAJORITY",
+                           path, e)
         if rule == polpb.ImplicitMetaPolicy.ANY:
             return 1
         if rule == polpb.ImplicitMetaPolicy.ALL:

@@ -393,8 +393,9 @@ class GossipNode:
         try:
             self.metrics.total_peers_known.set(
                 len(self.discovery.alive_members()))
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("gossip: publishing total_peers_known "
+                           "failed: %s", e)
         for cb in list(self._on_membership_change):
             try:
                 cb()

@@ -125,8 +125,9 @@ class OperationsServer:
             logger.exception("ops handler error")
             try:
                 h._reply(500, json.dumps({"Error": str(e)}).encode())
-            except Exception:
-                pass
+            except Exception as reply_exc:
+                logger.warning("ops: could not deliver 500 reply for "
+                               "%s %s: %s", method, path, reply_exc)
 
     def _healthz(self, h) -> None:
         failed = []

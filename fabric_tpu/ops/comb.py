@@ -30,6 +30,7 @@ back to the generic ladder in the provider (fabric_tpu/bccsp/tpu.py).
 from __future__ import annotations
 
 import functools
+import logging
 
 import numpy as np
 
@@ -39,6 +40,8 @@ from jax import lax
 from fabric_tpu.ops import limb, p256
 from fabric_tpu.ops.limb import L, W
 from fabric_tpu.ops.p256 import FN, FP, cadd, cdbl
+
+logger = logging.getLogger("ops.comb")
 
 WBITS = 8                   # comb window width (bits)
 NWIN = 256 // WBITS         # windows per 256-bit scalar
@@ -126,8 +129,9 @@ def g_tables() -> np.ndarray:
                     return arr
         except FileNotFoundError:
             pass
-        except Exception:
-            pass                          # unreadable: rebuild below
+        except Exception as e:
+            logger.warning("G-table cache %s unreadable (%s); "
+                           "rebuilding", cache, e)
     out = np.zeros((NWIN * NENT, 3, L), dtype=np.int32)
     base = (p256.GX, p256.GY, 1)
     for i in range(NWIN):
@@ -147,8 +151,9 @@ def g_tables() -> np.ndarray:
             digest = file_sha256(tmp)
             os.replace(tmp, cache)
             write_digest_sidecar(cache, digest)
-        except Exception:
-            pass                          # best-effort persistence
+        except Exception as e:
+            logger.warning("G-table cache persist to %s failed (%s); "
+                           "next start rebuilds", cache, e)
     return out
 
 

@@ -280,8 +280,9 @@ class RaftChain:
             self._thread.join(timeout=5)
         try:
             self._transport.remove_handler(self._support.channel_id)
-        except Exception:
-            pass
+        except Exception as e:
+            logger.warning("[%s] halt: removing transport handler "
+                           "failed: %s", self._support.channel_id, e)
 
     def errored(self) -> bool:
         return self._halted.is_set()

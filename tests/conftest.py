@@ -27,6 +27,16 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Round-8 lock-order sanitizer: when FTPU_LOCKCHECK is set, patch the
+# threading lock factories BEFORE any fabric_tpu module creates its
+# locks (tools/static_check.sh arms this for a fast threaded subset;
+# FTPU_LOCKCHECK=raise fails at the detection point instead of at
+# session end). jax was imported above on purpose — its internal
+# locks stay untracked.
+from fabric_tpu.common import lockcheck  # noqa: E402
+
+lockcheck.install_from_env()
+
 # Persistent compilation cache: the heavy differential tests jit the
 # same pipelines on every run; caching makes re-runs minutes faster on
 # this 1-core box (keyed by HLO hash — safe across code edits).
@@ -87,6 +97,23 @@ def require_cryptography():
     if not HAVE_CRYPTOGRAPHY:
         pytest.skip("needs the 'cryptography' wheel (x509/AES); the "
                     "pure-python backend covers P-256 ECDSA only")
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Surface lock-order sanitizer findings (with both stacks) at the
+    end of a FTPU_LOCKCHECK run."""
+    san = lockcheck.sanitizer()
+    if san is not None and san.violations():
+        terminalreporter.write_sep("=", "lockcheck violations")
+        terminalreporter.write_line(san.report())
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """A sanitizer-armed run FAILS on recorded violations even when
+    every test passed — that is the CI gate's contract."""
+    san = lockcheck.sanitizer()
+    if san is not None and san.violations() and session.exitstatus == 0:
+        session.exitstatus = 3
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,267 @@
+"""Project-invariant linter tests (ISSUE 5 tentpole, static half).
+
+Two contracts: (1) the tree at HEAD is CLEAN — zero unwaived findings,
+which is what lets tools/static_check.sh gate CI; (2) deliberately
+seeded violations of every rule class (unknown fault point,
+undocumented metric, bare swallow, host-sync in a @hot_path span) are
+caught, and the `# ftpu-lint: allow-*` waiver grammar suppresses
+exactly what it names. Plus the runtime half of the fault-point seam:
+`Registry.arm()` warns on names outside KNOWN_POINTS.
+"""
+
+import importlib.util
+import logging
+import os
+import shutil
+import sys
+import textwrap
+
+import pytest
+
+from fabric_tpu.common import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "_ftpu_lint_under_test",
+        os.path.join(REPO, "tools", "ftpu_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def lint():
+    return _load_lint()
+
+
+def _seed_tree(root) -> str:
+    """A minimal lintable tree: the REAL faults.py/gendoc.py (so
+    KNOWN_POINTS and the doc renderer are authentic), docs generated
+    clean, no violations yet."""
+    common = os.path.join(root, "fabric_tpu", "common")
+    os.makedirs(common)
+    open(os.path.join(root, "fabric_tpu", "__init__.py"), "w").close()
+    open(os.path.join(common, "__init__.py"), "w").close()
+    for fn in ("faults.py", "gendoc.py"):
+        shutil.copy(os.path.join(REPO, "fabric_tpu", "common", fn),
+                    os.path.join(common, fn))
+    return root
+
+
+def _regen_docs(root):
+    spec = importlib.util.spec_from_file_location(
+        "_seed_gendoc", os.path.join(root, "fabric_tpu", "common",
+                                     "gendoc.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    doc = os.path.join(root, mod.DOC_RELPATH)
+    os.makedirs(os.path.dirname(doc), exist_ok=True)
+    with open(doc, "w", encoding="utf-8") as f:
+        f.write(mod.generate(root))
+
+
+class TestSeededViolations:
+    @pytest.fixture()
+    def seeded(self, tmp_path, lint):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)          # docs clean BEFORE the seed module
+        seed = textwrap.dedent('''\
+            from fabric_tpu.common import faults
+            from fabric_tpu.common.hotpath import hot_path
+            import numpy as np
+
+            def CounterOpts(**kw):
+                return kw
+
+            SEEDED = CounterOpts(namespace="seeded",
+                                 name="drift_total",
+                                 help="undocumented on purpose")
+
+            def poke():
+                faults.check("commit.validate_head")   # the typo
+
+            def swallow():
+                try:
+                    poke()
+                except Exception:
+                    pass
+
+            @hot_path
+            def hot(arr):
+                dev = np.asarray(arr)
+                return float(dev.item())
+        ''')
+        with open(os.path.join(root, "fabric_tpu", "seed.py"),
+                  "w") as f:
+            f.write(seed)
+        return root
+
+    def test_each_rule_class_caught(self, lint, seeded):
+        findings = lint.run_lint(seeded)
+        rules = {f.rule for f in findings}
+        assert rules == {"fault-point", "silent-swallow", "host-sync",
+                         "metric-drift"}
+        fp = [f for f in findings if f.rule == "fault-point"]
+        assert len(fp) == 1 and "commit.validate_head" in fp[0].message
+        assert fp[0].path.endswith("seed.py")
+        hs = [f for f in findings if f.rule == "host-sync"]
+        # np.asarray, float(), .item() — all three sync idioms
+        assert len(hs) == 3
+        assert any(".item()" in f.message for f in hs)
+        assert any("float()" in f.message for f in hs)
+        assert any("np.asarray()" in f.message for f in hs)
+        sw = [f for f in findings if f.rule == "silent-swallow"]
+        assert len(sw) == 1
+        md = [f for f in findings if f.rule == "metric-drift"]
+        assert len(md) == 1 and "stale" in md[0].message
+
+    def test_waivers_suppress_exactly_what_they_name(self, lint,
+                                                     seeded):
+        path = os.path.join(seeded, "fabric_tpu", "seed.py")
+        with open(path) as f:
+            src = f.read()
+        src = src.replace(
+            '    faults.check("commit.validate_head")   # the typo',
+            '    # ftpu-lint: allow-fault-point(seeded test waiver)\n'
+            '    faults.check("commit.validate_head")')
+        src = src.replace(
+            "    except Exception:\n        pass",
+            "    # ftpu-lint: allow-swallow(seeded test waiver)\n"
+            "    except Exception:\n        pass")
+        src = src.replace(
+            "    dev = np.asarray(arr)",
+            "    # ftpu-lint: allow-host-sync(seeded test waiver)\n"
+            "    dev = np.asarray(arr)")
+        src = src.replace(
+            "    return float(dev.item())",
+            "    # ftpu-lint: allow-host-sync(seeded test waiver)\n"
+            "    return float(dev.item())")
+        with open(path, "w") as f:
+            f.write(src)
+        _regen_docs(seeded)        # clears the drift too
+        assert lint.run_lint(seeded) == []
+
+    def test_waiver_reason_is_mandatory(self, lint, seeded):
+        path = os.path.join(seeded, "fabric_tpu", "seed.py")
+        with open(path) as f:
+            src = f.read()
+        src = src.replace(
+            "    except Exception:\n        pass",
+            "    # ftpu-lint: allow-swallow()\n"
+            "    except Exception:\n        pass")
+        with open(path, "w") as f:
+            f.write(src)
+        findings = lint.run_lint(seeded)
+        assert any(f.rule == "waiver" and "without a reason"
+                   in f.message for f in findings)
+        # and the reasonless waiver does NOT suppress the swallow
+        assert any(f.rule == "silent-swallow" for f in findings)
+
+    def test_waiver_reason_may_contain_parens(self, lint, seeded):
+        path = os.path.join(seeded, "fabric_tpu", "seed.py")
+        with open(path) as f:
+            src = f.read()
+        src = src.replace(
+            "    except Exception:\n        pass",
+            "    # ftpu-lint: allow-swallow(close() raises on a dead "
+            "channel)\n"
+            "    except Exception:\n        pass")
+        with open(path, "w") as f:
+            f.write(src)
+        findings = lint.run_lint(seeded)
+        assert not any(f.rule in ("silent-swallow", "waiver")
+                       for f in findings)
+
+    def test_unknown_waiver_rule_is_reported(self, lint, seeded):
+        path = os.path.join(seeded, "fabric_tpu", "seed.py")
+        with open(path) as f:
+            src = f.read()
+        src = src.replace(
+            "    except Exception:\n        pass",
+            "    # ftpu-lint: allow-swalow(typo'd rule name)\n"
+            "    except Exception:\n        pass")
+        with open(path, "w") as f:
+            f.write(src)
+        findings = lint.run_lint(seeded)
+        assert any(f.rule == "waiver" and "unknown waiver"
+                   in f.message for f in findings)
+        assert any(f.rule == "silent-swallow" for f in findings)
+
+    def test_missing_known_points_is_a_finding(self, lint, tmp_path):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        faults_py = os.path.join(root, "fabric_tpu", "common",
+                                 "faults.py")
+        with open(faults_py, "w") as f:
+            f.write("ENV_VAR = 'FTPU_FAULTS'\n")
+        findings = lint.run_lint(root)
+        assert any(f.rule == "fault-point" and "KNOWN_POINTS"
+                   in f.message for f in findings)
+
+    def test_gendoc_check_prints_diff(self, seeded, capsys):
+        spec = importlib.util.spec_from_file_location(
+            "_seed_gendoc_chk",
+            os.path.join(seeded, "fabric_tpu", "common", "gendoc.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        assert mod.main(["--check", "--root", seeded]) == 1
+        out = capsys.readouterr().out
+        assert "stale" in out
+        assert "+| `seeded_drift_total`" in out
+        # regenerated -> clean
+        assert mod.main(["--root", seeded]) == 0
+        assert mod.main(["--check", "--root", seeded]) == 0
+
+
+class TestTreeAtHead:
+    def test_tree_is_clean(self, lint):
+        findings = lint.run_lint(REPO)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_cli_exit_zero_on_head(self, lint, capsys):
+        assert lint.main(["--root", REPO]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_cli_rejects_unknown_rule(self, lint):
+        assert lint.main(["--rules", "no-such-rule"]) == 2
+
+    def test_known_points_match_docstring_table(self, lint):
+        """The declaration list and the module docstring's point table
+        must not drift from each other."""
+        points, err = lint.load_known_points(REPO)
+        assert err is None
+        assert points == faults.KNOWN_POINTS
+        for p in sorted(points):
+            assert p in (faults.__doc__ or ""), \
+                f"KNOWN_POINTS entry {p} missing from faults.py " \
+                f"docstring table"
+
+
+class TestArmWarnsOnUnknownPoint:
+    def test_unknown_point_warns_but_still_arms(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="common.faults"):
+            faults.arm("definitely.not.a.point", mode="error",
+                       count=1)
+        assert any("UNKNOWN fault point" in r.message
+                   for r in caplog.records)
+        assert faults.armed("definitely.not.a.point")
+        with pytest.raises(faults.FaultInjected):
+            faults.check("definitely.not.a.point")
+
+    def test_known_point_arms_silently(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="common.faults"):
+            faults.arm("tpu.dispatch", mode="error", count=1)
+        assert not any("UNKNOWN fault point" in r.message
+                       for r in caplog.records)
+
+    def test_env_typo_is_loud(self, caplog):
+        with caplog.at_level(logging.WARNING, logger="common.faults"):
+            faults.arm_from_env("commit.validate_head=error:1")
+        assert any("UNKNOWN fault point" in r.message
+                   for r in caplog.records)

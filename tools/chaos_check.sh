@@ -10,7 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -73,13 +73,20 @@ commit() {
         tests/test_commit_pipeline.py -k "Parity or GossipState or Deliver"
 }
 
+static() {
+    # the round-8 static gate: project-invariant lint + metrics-doc
+    # drift + the lock-order-sanitizer-armed threaded subset
+    ./tools/static_check.sh
+}
+
 case "${1:-all}" in
     bccsp) bccsp ;;
     raft) raft ;;
     deliver) deliver ;;
     onboarding) onboarding ;;
     commit) commit ;;
-    all) bccsp; raft; deliver; onboarding; commit ;;
+    static) static ;;
+    all) bccsp; raft; deliver; onboarding; commit; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

@@ -1,0 +1,392 @@
+#!/usr/bin/env python3
+"""ftpu_lint — project-invariant AST linter for the fabric_tpu tree.
+
+The rebuild's correctness rests on stringly-typed seams nothing used
+to cross-check: a typo'd `faults.check("commit.validate_head")` arms
+nothing and the chaos suite passes vacuously; an undocumented
+`CounterOpts` silently drifts out of `docs/metrics_reference.md`; an
+`except Exception: pass` in a daemon loop hides real failures; a
+stray `.item()` in an overlapped verify span stalls the device
+pipeline. `go vet` caught the Go tree's equivalents — this is the
+Python tree's equivalent, enforced by `tools/static_check.sh`.
+
+Rules (each waivable per line with `# ftpu-lint: allow-<rule>(<reason>)`
+on the flagged line or the line above; the reason is mandatory):
+
+  fault-point    every `faults.check/arm/armed/disarm/fires("...")`
+                 string literal must be declared in the canonical
+                 `KNOWN_POINTS` registry in fabric_tpu/common/faults.py
+                 (waiver: allow-fault-point)
+  metric-drift   every statically-declared CounterOpts/GaugeOpts/
+                 HistogramOpts must round-trip through
+                 fabric_tpu/common/gendoc.py into
+                 docs/metrics_reference.md (regenerate with
+                 `python -m fabric_tpu.common.gendoc`)
+  silent-swallow `except Exception/BaseException/bare: pass` is an
+                 error — log at warning with context, or waive with
+                 allow-swallow(<why swallowing is correct here>)
+  host-sync      `.item()`, `float()`, `bool()`, `np.asarray` inside a
+                 function decorated `@hot_path`
+                 (fabric_tpu/common/hotpath.py) — host syncs that
+                 stall the overlapped device spans; the deliberate
+                 end-of-span materialization points carry
+                 allow-host-sync waivers
+
+Usage:
+  python tools/ftpu_lint.py [--root DIR] [--rules r1,r2] [files...]
+
+Exit status: 0 clean, 1 findings, 2 usage/setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+
+ALL_RULES = ("fault-point", "metric-drift", "silent-swallow",
+             "host-sync")
+
+_WAIVER_RE = re.compile(
+    r"#\s*ftpu-lint:\s*allow-([a-z-]+)\(\s*(.*?)\s*\)?\s*$")
+_WAIVER_KINDS = ("swallow", "fault-point", "host-sync")
+
+_FAULT_METHODS = {"check", "arm", "armed", "disarm", "fires"}
+_HOST_SYNC_BUILTINS = {"float", "bool"}
+_NP_NAMES = {"np", "numpy"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str        # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class _Waivers:
+    """Per-file `# ftpu-lint: allow-<rule>(reason)` comments, keyed by
+    line. A waiver covers findings of its rule on its own line, or
+    anywhere in the contiguous comment block directly above the
+    flagged line (the reason may wrap onto following comment lines)."""
+
+    def __init__(self, source: str):
+        self._lines = source.splitlines()
+        self._by_line: dict[int, tuple[str, str]] = {}
+        self.malformed: list[tuple[int, str]] = []
+        for i, text in enumerate(self._lines, start=1):
+            m = _WAIVER_RE.search(text)
+            if not m:
+                continue
+            rule, reason = m.group(1), m.group(2).strip()
+            if rule not in _WAIVER_KINDS:
+                self.malformed.append(
+                    (i, f"unknown waiver `allow-{rule}` — known: "
+                        + ", ".join(f"allow-{k}"
+                                    for k in _WAIVER_KINDS)))
+                continue
+            if not reason:
+                self.malformed.append(
+                    (i, "ftpu-lint waiver without a reason — write "
+                        "`# ftpu-lint: allow-<rule>(<why>)`"))
+                continue
+            self._by_line[i] = (rule, reason)
+
+    def _is_comment_only(self, ln: int) -> bool:
+        if not (1 <= ln <= len(self._lines)):
+            return False
+        return self._lines[ln - 1].lstrip().startswith("#")
+
+    def covers(self, kind: str, *lines: int) -> bool:
+        """`kind` is the waiver suffix (`allow-<kind>`): "swallow",
+        "fault-point", "host-sync"."""
+        for ln in lines:
+            got = self._by_line.get(ln)
+            if got and got[0] == kind:
+                return True
+            cand = ln - 1
+            while self._is_comment_only(cand):
+                got = self._by_line.get(cand)
+                if got and got[0] == kind:
+                    return True
+                cand -= 1
+        return False
+
+
+def _repo_root_default() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_known_points(root: str):
+    """AST-parse the canonical KNOWN_POINTS declaration out of
+    fabric_tpu/common/faults.py (no import: the linter must stay
+    runnable against any tree state). Returns (points, error)."""
+    path = os.path.join(root, "fabric_tpu", "common", "faults.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError) as e:
+        return None, f"cannot parse {path}: {e}"
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "KNOWN_POINTS"
+                   for t in node.targets):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) and \
+                isinstance(value.func, ast.Name) and \
+                value.func.id in ("frozenset", "set") and value.args:
+            value = value.args[0]
+        try:
+            return frozenset(ast.literal_eval(value)), None
+        except (ValueError, SyntaxError) as e:
+            return None, f"KNOWN_POINTS is not a literal set: {e}"
+    return None, (f"{path} declares no KNOWN_POINTS registry "
+                  f"(the fault-point rule's source of truth)")
+
+
+# -- rule: fault-point --
+
+def _fault_point_findings(rel, tree, waivers, known_points):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _FAULT_METHODS):
+            continue
+        base = func.value
+        base_name = base.id if isinstance(base, ast.Name) else (
+            base.attr if isinstance(base, ast.Attribute) else "")
+        if base_name != "faults":
+            continue
+        point = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            point = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "point" and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str):
+                    point = kw.value.value
+        if point is None:
+            continue    # dynamic point name: the runtime warn covers it
+        if point in known_points:
+            continue
+        if waivers.covers("fault-point", node.lineno):
+            continue
+        out.append(Finding(
+            rel, node.lineno, "fault-point",
+            f"fault point {point!r} is not declared in "
+            f"fabric_tpu/common/faults.py KNOWN_POINTS — a typo here "
+            f"arms nothing and chaos passes go vacuous"))
+    return out
+
+
+# -- rule: silent-swallow --
+
+def _is_broad_exc(expr) -> bool:
+    if expr is None:
+        return True     # bare except
+    if isinstance(expr, ast.Name):
+        return expr.id in ("Exception", "BaseException")
+    if isinstance(expr, ast.Tuple):
+        return any(_is_broad_exc(e) for e in expr.elts)
+    return False
+
+
+def _swallow_findings(rel, tree, waivers):
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_exc(node.type):
+            continue
+        body = node.body
+        swallows = (len(body) == 1 and (
+            isinstance(body[0], ast.Pass)
+            or (isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and body[0].value.value is Ellipsis)))
+        if not swallows:
+            continue
+        if waivers.covers("swallow", node.lineno, body[0].lineno):
+            continue
+        what = ast.unparse(node.type) if node.type is not None \
+            else "<bare>"
+        out.append(Finding(
+            rel, node.lineno, "silent-swallow",
+            f"`except {what}: pass` swallows failures silently — log "
+            f"at warning with context or waive with "
+            f"`# ftpu-lint: allow-swallow(<reason>)`"))
+    return out
+
+
+# -- rule: host-sync --
+
+def _is_hot_path_decorator(dec) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Name):
+        return target.id == "hot_path"
+    if isinstance(target, ast.Attribute):
+        return target.attr == "hot_path"
+    return False
+
+
+def _host_sync_findings(rel, tree, waivers):
+    out = []
+    hot_funcs = [
+        node for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and any(_is_hot_path_decorator(d) for d in node.decorator_list)
+    ]
+    for fn in hot_funcs:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            label = None
+            if isinstance(func, ast.Attribute) and \
+                    func.attr == "item" and not node.args:
+                label = ".item()"
+            elif isinstance(func, ast.Name) and \
+                    func.id in _HOST_SYNC_BUILTINS:
+                label = f"{func.id}()"
+            elif isinstance(func, ast.Attribute) and \
+                    func.attr == "asarray" and \
+                    isinstance(func.value, ast.Name) and \
+                    func.value.id in _NP_NAMES:
+                label = f"{func.value.id}.asarray()"
+            if label is None:
+                continue
+            if waivers.covers("host-sync", node.lineno):
+                continue
+            out.append(Finding(
+                rel, node.lineno, "host-sync",
+                f"{label} inside @hot_path `{fn.name}` forces a host "
+                f"sync mid-span — hoist it out of the overlapped "
+                f"region or waive the deliberate materialization "
+                f"point with `# ftpu-lint: allow-host-sync(<reason>)`"))
+    return out
+
+
+# -- rule: metric-drift --
+
+def _metric_drift_findings(root):
+    import importlib.util
+    gendoc_path = os.path.join(root, "fabric_tpu", "common",
+                               "gendoc.py")
+    spec = importlib.util.spec_from_file_location("_ftpu_lint_gendoc",
+                                                  gendoc_path)
+    if spec is None or spec.loader is None:
+        return [Finding(os.path.join("fabric_tpu", "common",
+                                     "gendoc.py"), 1, "metric-drift",
+                        "cannot load gendoc for the drift check")]
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod    # dataclasses resolves __module__
+    spec.loader.exec_module(mod)
+    # delegate the comparison to gendoc's own --check so there is ONE
+    # source of truth for what "stale" means (its diff output is
+    # swallowed here — the finding points the user at the command)
+    import contextlib
+    import io
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc = mod.main(["--check", "--root", root])
+    if rc == 0:
+        return []
+    return [Finding(
+        mod.DOC_RELPATH, 1, "metric-drift",
+        "metrics reference is stale vs the declared *Opts literals — "
+        "run `python -m fabric_tpu.common.gendoc --check` for the "
+        "diff, regenerate with `python -m fabric_tpu.common.gendoc`")]
+
+
+# -- driver --
+
+def iter_source_files(root: str):
+    pkg = os.path.join(root, "fabric_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def run_lint(root: str, rules=ALL_RULES, files=None) -> list:
+    findings: list[Finding] = []
+    known_points = frozenset()
+    if "fault-point" in rules:
+        known_points, err = load_known_points(root)
+        if err is not None:
+            findings.append(Finding(
+                os.path.join("fabric_tpu", "common", "faults.py"), 1,
+                "fault-point", err))
+            known_points = frozenset()
+    paths = list(files) if files else list(iter_source_files(root))
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(rel, 1, "parse",
+                                    f"cannot lint: {e}"))
+            continue
+        waivers = _Waivers(source)
+        for ln, msg in waivers.malformed:
+            findings.append(Finding(rel, ln, "waiver", msg))
+        if "fault-point" in rules:
+            findings += _fault_point_findings(rel, tree, waivers,
+                                              known_points)
+        if "silent-swallow" in rules:
+            findings += _swallow_findings(rel, tree, waivers)
+        if "host-sync" in rules:
+            findings += _host_sync_findings(rel, tree, waivers)
+    if "metric-drift" in rules and not files:
+        findings += _metric_drift_findings(root)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fabric_tpu project-invariant linter")
+    parser.add_argument("--root", default=_repo_root_default(),
+                        help="repo root (holds fabric_tpu/ and docs/)")
+    parser.add_argument("--rules", default=",".join(ALL_RULES),
+                        help=f"comma list from {ALL_RULES}")
+    parser.add_argument("files", nargs="*",
+                        help="limit per-file rules to these files "
+                             "(metric-drift is tree-wide and skipped)")
+    args = parser.parse_args(argv)
+    rules = tuple(r.strip() for r in args.rules.split(",") if r.strip())
+    unknown = [r for r in rules if r not in ALL_RULES]
+    if unknown:
+        print(f"ftpu_lint: unknown rule(s) {unknown}; "
+              f"known: {ALL_RULES}", file=sys.stderr)
+        return 2
+    findings = run_lint(args.root, rules=rules,
+                        files=args.files or None)
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"ftpu_lint: {len(findings)} finding(s)")
+        return 1
+    nfiles = len(args.files) if args.files else \
+        sum(1 for _ in iter_source_files(args.root))
+    print(f"ftpu_lint: clean ({nfiles} files, "
+          f"rules: {', '.join(rules)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Round-8 static-analysis gate: the machine-checked project invariants.
+#
+#   1. tools/ftpu_lint.py        — AST rules over fabric_tpu/:
+#                                  fault-point registry, metric-drift,
+#                                  silent-swallow, host-sync-in-hot-path
+#                                  (waiver grammar: # ftpu-lint:
+#                                  allow-<rule>(<reason>))
+#   2. gendoc --check            — docs/metrics_reference.md must match
+#                                  the declared *Opts literals exactly
+#   3. FTPU_LOCKCHECK=1 subset   — the threaded fast subset runs under
+#                                  the lock-order sanitizer
+#                                  (fabric_tpu/common/lockcheck.py):
+#                                  any A→B/B→A inversion or lock held
+#                                  across a device dispatch /
+#                                  injected-fault stall FAILS the run
+#                                  (tests/conftest.py sessionfinish)
+#
+# Standalone: tools/static_check.sh
+# From the chaos gate: tools/chaos_check.sh static
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow'
+        -p no:cacheprovider -p no:randomly)
+
+echo "== static_check 1/3: ftpu_lint"
+python tools/ftpu_lint.py
+
+echo "== static_check 2/3: gendoc --check"
+python -m fabric_tpu.common.gendoc --check
+
+echo "== static_check 3/3: lock-order sanitizer (threaded subset)"
+FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
+    tests/test_lockcheck.py tests/test_ftpu_lint.py \
+    tests/test_chaos.py tests/test_commit_pipeline.py \
+    tests/test_pipeline_overlap.py tests/test_backoff.py
+
+echo "static_check: all gates green"
