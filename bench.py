@@ -30,8 +30,15 @@ Two TPU numbers are reported:
     identity, not similarity.
   * `provider_verify_batch_sigs_per_s` — honest wall clock of
     `TPUProvider.verify_batch(items)` end to end (host DER parse in
-    C++, limb packing, tunnel transfers, device, readback).
-Prints ONE JSON line.
+    C++, limb packing, per-device transfers, device, readback).
+
+Round-9 structure: the default invocation is a jax-free STAGED
+orchestrator — core (Devices=1), core (Devices=all), multichip
+scaling, full_pipeline, each a child process under a hard parent-side
+subprocess timeout, each printing its own JSON line as it finishes.
+The LAST stdout line is always ONE compact aggregate object (the
+driver's parse); full detail goes to the sidecar file, including the
+measured device-scaling curve.
 """
 
 from __future__ import annotations
@@ -64,9 +71,16 @@ CPU_SAMPLE = 60 if SMOKE else 300
 TPU_ITERS = 3 if SMOKE else 5
 CHUNK = int(os.environ.get("BENCH_CHUNK", "512" if SMOKE else "32768"))
 # seconds from process start to the watchdog's forced final line;
-# 0 disables (full runs own their budget — the driver's timeout rules)
+# 0 disables. Round-6 change: FULL runs are BOUNDED too (BENCH_r05 /
+# MULTICHIP_r05 went rc=124 with nothing printed) — an explicit
+# BENCH_DEADLINE_S=0 is now the only unbounded mode.
 DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S",
-                                  "540" if SMOKE else "0"))
+                                  "540" if SMOKE else "3600"))
+# per-stage hard deadline: the orchestrator kills a stage child that
+# exceeds it (works even when the child hangs inside a C extension or
+# an XLA compile, which no in-process watchdog can preempt)
+STAGE_DEADLINE_S = float(os.environ.get("BENCH_STAGE_DEADLINE_S",
+                                        "240" if SMOKE else "1500"))
 SIDECAR = os.environ.get("BENCH_SIDECAR", "bench_detail.json")
 
 _T0 = time.monotonic()
@@ -137,7 +151,11 @@ def _start_watchdog() -> None:
         time.sleep(max(0.0, DEADLINE_S - _elapsed()))
         if _FINAL_EMITTED.is_set():
             return
-        emit_final({
+        # reap live stage/restart children FIRST: os._exit alone would
+        # orphan a bench child that still owns the single-owner TPU
+        # chip, wedging the driver's next claim of the device
+        _kill_children()
+        res = {
             "metric": "block-validation sig-verify throughput "
                       "(smoke, self-deadline hit)",
             "value": _PARTIAL.get("value"),
@@ -145,11 +163,60 @@ def _start_watchdog() -> None:
             "deadline_s": DEADLINE_S,
             "deadline_hit": True,
             "completed_sections": sorted(_PARTIAL),
-        }, dict(_PARTIAL))
+        }
+        if _PARTIAL.get("stage"):
+            # a stage child's salvage line keeps its stage tag (and
+            # the device-count facts the orchestrator gates on) so the
+            # relay still emits a line and multichip still runs
+            res["stage"] = _PARTIAL["stage"]
+            res["devices"] = _PARTIAL.get("devices")
+            res["local_devices"] = _PARTIAL.get("local_devices")
+            res["mesh_devices"] = _PARTIAL.get("mesh_devices")
+        emit_final(res, dict(_PARTIAL))
         os._exit(0)
 
     threading.Thread(target=fire, name="bench-deadline",
                      daemon=True).start()
+
+
+# live children (stage/restart subprocesses) the deadline watchdog
+# must reap before exiting
+_CHILDREN_LOCK = threading.Lock()
+_CHILDREN: set = set()
+
+
+def _bounded_child(cmd, timeout, env=None):
+    """`subprocess.run(capture_output=True, text=True)` twin that
+    registers the child so the deadline watchdog can kill it. Returns
+    (rc, stdout, stderr); on timeout kills the child and raises
+    `subprocess.TimeoutExpired` carrying whatever stdout it printed."""
+    import subprocess
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True, env=env)
+    with _CHILDREN_LOCK:
+        _CHILDREN.add(p)
+    try:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            raise subprocess.TimeoutExpired(cmd, timeout, output=out,
+                                            stderr=err)
+        return p.returncode, out, err
+    finally:
+        with _CHILDREN_LOCK:
+            _CHILDREN.discard(p)
+
+
+def _kill_children() -> None:
+    with _CHILDREN_LOCK:
+        live = list(_CHILDREN)
+    for p in live:
+        try:
+            p.kill()
+        except OSError:
+            pass
 
 
 def _have_openssl() -> bool:
@@ -661,54 +728,107 @@ def _restart_child(mode, warm_dir):
     print(json.dumps(out))
 
 
-def bench_restart(warm_dir) -> dict:
+def bench_restart(warm_dir, timeout: float = 1800.0) -> dict:
     """Parent half: spawn populate (only when the warm dir has no
     bench key set yet) then the measured restart child. Runs BEFORE
-    the parent touches jax — on TPU rigs the chip is single-owner."""
-    import subprocess
+    the parent touches jax — on TPU rigs the chip is single-owner.
+    `timeout` bounds the WHOLE stage: the restart child gets whatever
+    the populate child left, so two sequential children can no longer
+    spend 2x the stage budget."""
     import sys
     res = {}
+    deadline = time.monotonic() + timeout
     have = (os.path.exists(os.path.join(warm_dir, BENCH_KEYS_PEM))
             and os.path.exists(os.path.join(warm_dir,
                                             "warm_keysets.json")))
     try:
         if not have:
-            p = subprocess.run(
+            rc, out, err = _bounded_child(
                 [sys.executable, os.path.abspath(__file__),
                  "--restart-child", "populate", warm_dir],
-                capture_output=True, text=True, timeout=1800)
-            if p.returncode != 0:
+                max(1.0, deadline - time.monotonic()))
+            if rc != 0:
                 return {"error": "populate child failed",
-                        "stderr": p.stderr[-800:]}
-            res["populate"] = json.loads(p.stdout.strip().
-                                         splitlines()[-1])
-        p = subprocess.run(
+                        "stderr": (err or "")[-800:]}
+            res["populate"] = json.loads(out.strip().splitlines()[-1])
+        rc, out, err = _bounded_child(
             [sys.executable, os.path.abspath(__file__),
              "--restart-child", "restart", warm_dir],
-            capture_output=True, text=True, timeout=1800)
-        if p.returncode != 0:
+            max(1.0, deadline - time.monotonic()))
+        if rc != 0:
             return {"error": "restart child failed",
-                    "stderr": p.stderr[-800:]}
-        res.update(json.loads(p.stdout.strip().splitlines()[-1]))
+                    "stderr": (err or "")[-800:]}
+        res.update(json.loads(out.strip().splitlines()[-1]))
     except Exception as e:          # noqa: BLE001
         return {"error": f"{type(e).__name__}: {e}"}
     return res
 
 
-def main():
+# ---------------------------------------------------------------------------
+# Staged bench (round 9): the default `python bench.py` is a jax-FREE
+# orchestrator; every heavyweight measurement runs in a child process
+# with its own hard deadline enforced by the PARENT's subprocess
+# timeout — the only kind of watchdog that can preempt a child hung
+# inside an XLA compile or a broken accelerator runtime (the BENCH_r05
+# / MULTICHIP_r05 rc=124 class). Stages:
+#   core@1dev      kernel-steady + provider-e2e, Devices: 1
+#   core@alldev    the same, sharded over every local device
+#   multichip      the scaling ratio between the two (curve in sidecar)
+#   full_pipeline  endorse->order->validate->commit + secondary regimes
+# Each stage prints its own JSON line the moment it ends; the LAST
+# stdout line is still the one compact aggregate the driver parses.
+# ---------------------------------------------------------------------------
+
+
+def emit_stage(obj: dict) -> None:
+    """Print one compact stage JSON line NOW: a stage that finished
+    reports even if every later stage dies. Stage lines carry a
+    "stage" key; the final aggregate line (emit_final) never does."""
+    print(json.dumps(obj, separators=(",", ":")), flush=True)
+
+
+def _flat(obj: dict) -> dict:
+    return {k: v for k, v in obj.items()
+            if not isinstance(v, (dict, list))}
+
+
+def _devices_env() -> int:
+    """BENCH_DEVICES: 0/absent = all local devices (the factory
+    default), 1 = pinned single-device path, N = first N devices."""
+    try:
+        return int(os.environ.get("BENCH_DEVICES", "0"))
+    except ValueError:
+        return 0
+
+
+def _tpu_config(warm_dir: str, devices: int,
+                pipeline_chunk: int) -> dict:
+    """The core.yaml-style BCCSP mapping every stage constructs its
+    provider from — the SAME seam `peer node start` uses. Devices=0
+    omits the knob so the factory's default (all local devices)
+    applies."""
+    tpu = {"MinBatch": 16, "Chunk": CHUNK,
+           "PipelineChunk": pipeline_chunk,
+           "WarmKeysDir": warm_dir}
+    if devices:
+        tpu["Devices"] = devices
+    return {"Default": "TPU", "TPU": tpu}
+
+
+def stage_core():
+    """kernel-steady + provider-e2e at one device count (BENCH_DEVICES).
+
+    Runs in its OWN process (one process = one device owner; the
+    orchestrator spawns one per device count so the 1-device and
+    all-device numbers come from identical fresh processes). Emits a
+    stage line per sub-measurement and ONE final line; full detail
+    goes to the BENCH_SIDECAR file."""
     _start_watchdog()
+    devices = _devices_env()
     have_ssl = _have_openssl()
-    # --- restart-to-first-validated-block: measured in CHILD
-    #     processes before this one claims the device ---
     warm_dir = os.environ.get(
         "BENCH_WARM_DIR",
         os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
-    restart = None
-    if os.environ.get("BENCH_RESTART",
-                      "0" if SMOKE else "1") == "1" and have_ssl:
-        restart = bench_restart(warm_dir)
-        _PARTIAL["restart"] = restart
-
     _apply_platform()
     import hashlib
 
@@ -723,26 +843,23 @@ def main():
     from fabric_tpu.common import jaxenv
 
     jaxenv.enable_cache_under(warm_dir)
+    local_devices = len(jax.devices())
+    _PARTIAL["stage"] = "core"
+    _PARTIAL["devices"] = devices or local_devices
+    _PARTIAL["local_devices"] = local_devices
     rng = np.random.default_rng(1234)
     batch = BLOCK_TXS * SIGS_PER_TX
 
-    # --- the PRODUCT construction path: core.yaml BCCSP mapping ---
-    # WarmKeysDir mirrors peer_node's default-under-fileSystemPath:
-    # the restart children (and previous driver runs) persisted this
-    # key set's Q-table bytes, so prewarm restores instead of rebuilds
     pipeline_chunk = int(os.environ.get("BENCH_PIPELINE_CHUNK",
                                         str(min(8192, CHUNK))))
-    prov = factory.new_bccsp(factory.FactoryOpts.from_config({
-        "Default": "TPU",
-        "TPU": {"MinBatch": 16, "Chunk": CHUNK,
-                "PipelineChunk": pipeline_chunk,
-                "WarmKeysDir": warm_dir},
-    }))
+    prov = factory.new_bccsp(factory.FactoryOpts.from_config(
+        _tpu_config(warm_dir, devices, pipeline_chunk)))
+    mesh_devices = prov.stats["shard_devices"]
+    _PARTIAL["mesh_devices"] = mesh_devices
     t0 = time.perf_counter()
-    # wait_restore: the HEADLINE sections must measure the fully-warm
+    # wait_restore: the headline sections must measure the fully-warm
     # flagship path; the availability-first restore window is the
-    # restart child's measurement, not this one's. Smoke runs pay ONE
-    # bounded compile (the pipeline-span shape for this key count).
+    # restart stage's measurement. Smoke runs pay ONE bounded compile.
     K_hdr = 1
     while K_hdr < NKEYS:
         K_hdr *= 2
@@ -756,10 +873,8 @@ def main():
     _PARTIAL["prewarm_s"] = round(prewarm_s, 1)
 
     # --- workload: NKEYS org keys, `batch` signed messages. With
-    # OpenSSL, reuse the persisted bench key set (the restart children
-    # or a previous run already built its Q tables); without it (this
-    # growth container), the pure-python sw backend generates and
-    # signs — slower per signature but dependency-free ---
+    # OpenSSL, reuse the persisted bench key set; without it (this
+    # growth container), the pure-python sw backend signs ---
     privs = _load_bench_privs(warm_dir) if have_ssl else None
     sw_oracle = SWProvider()
     if have_ssl:
@@ -805,7 +920,8 @@ def main():
         sign_s = time.perf_counter() - t0
     _PARTIAL["sign_s"] = round(sign_s, 1)
 
-    # --- CPU baseline: single-thread verify, ideal-scaled to all cores ---
+    # --- CPU baseline: single-thread verify, ideal-scaled to all
+    #     cores ---
     sample = min(CPU_SAMPLE, batch)
     t0 = time.perf_counter()
     if have_ssl:
@@ -827,19 +943,21 @@ def main():
     cpu_sigs_per_s = ncpu / cpu_per_sig          # ideal scaling credit
     _PARTIAL["cpu_ideal_sigs_per_s"] = round(cpu_sigs_per_s, 1)
 
-    # --- warm pass THROUGH THE SEAM: compiles the pipeline, builds and
-    #     caches the per-key-set Q tables, returns correctness ---
+    # --- provider-e2e sub-stage THROUGH THE SEAM: warm pass compiles
+    #     the pipeline and builds/caches the per-key-set Q tables,
+    #     then honest wall clock of verify_batch (host DER parse,
+    #     limb packing, per-device transfer streams, device,
+    #     readback) ---
     prewarmed_sets = prov.stats["q16_resident_sets"]
     t0 = time.perf_counter()
     out = prov.verify_batch(items)
     warm_s = time.perf_counter() - t0
     if not all(out):
-        raise SystemExit("correctness failure: valid signatures rejected")
+        raise SystemExit("correctness failure: valid signatures "
+                         "rejected")
     if prov.stats["comb_batches"] < 1:
         raise SystemExit("bench did not exercise the comb path: %s"
                          % prov.stats)
-
-    # --- provider wall-clock steady (host prep + transfer + device) ---
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -852,99 +970,191 @@ def main():
         round(batch / provider_s, 1)
     _PARTIAL["value"] = _PARTIAL["provider_verify_batch_sigs_per_s"]
     _PARTIAL["provider_stats"] = dict(prov.stats)
+    emit_stage({"stage": "provider_e2e",
+                "devices": devices or local_devices,
+                "mesh_devices": mesh_devices, "batch": batch,
+                "sigs_per_s": round(batch / provider_s, 1),
+                "seconds": round(provider_s, 4),
+                "overlap_ratio":
+                    prov.stats["pipeline_overlap_ratio"],
+                "shard_skew_s": prov.stats["shard_skew_s"]})
 
-    # --- device-resident steady: the provider's OWN jitted pipeline +
-    #     cached tables, operands staged once outside the timed loop
-    #     (tunnel-transfer jitter must not pollute the kernel number).
-    #     Staging mirrors _verify_batch_device; objects are the
-    #     provider's, looked up from its caches. ---
-    from fabric_tpu import native
-
-    bucket = prov._bucket(batch)       # the shape verify_batch compiled
-    import hashlib
-    digests0 = np.zeros((bucket, 8), dtype=np.uint32)
-    for i, m in enumerate(msgs):
-        digests0[i] = np.frombuffer(
-            hashlib.sha256(m).digest(), dtype=">u4")
-    prep = native.batch_prep([it.signature for it in items])
-    if prep is not None:
-        ok_n, r_b, rpn_b, w_b = prep
+    # --- kernel-steady sub-stage: the provider's OWN jitted pipeline
+    #     + cached tables, operands staged once outside the timed loop
+    #     (sharded across the mesh when one is configured — transfer
+    #     jitter must not pollute the kernel number) ---
+    tpu_s = None
+    if _remaining() <= 45:
+        emit_stage({"stage": "kernel_steady", "skipped": "budget",
+                    "devices": devices or local_devices})
     else:
-        # no native toolchain: stage with the pure-python prep (the
-        # same shared helper the provider's fallback paths call)
-        from fabric_tpu.bccsp.tpu import host_prep_scalars
-        ok_n = np.zeros(batch, dtype=bool)
-        r_b = np.zeros((batch, 32), dtype=np.uint8)
-        rpn_b = np.zeros((batch, 32), dtype=np.uint8)
-        w_b = np.zeros((batch, 32), dtype=np.uint8)
+        from fabric_tpu import native
+
+        bucket = prov._bucket(batch)   # the shape verify_batch compiled
+        digests0 = np.zeros((bucket, 8), dtype=np.uint32)
+        for i, m in enumerate(msgs):
+            digests0[i] = np.frombuffer(
+                hashlib.sha256(m).digest(), dtype=">u4")
+        prep = native.batch_prep([it.signature for it in items])
+        if prep is not None:
+            ok_n, r_b, rpn_b, w_b = prep
+        else:
+            # no native toolchain: stage with the pure-python prep
+            from fabric_tpu.bccsp.tpu import host_prep_scalars
+            ok_n = np.zeros(batch, dtype=bool)
+            r_b = np.zeros((batch, 32), dtype=np.uint8)
+            rpn_b = np.zeros((batch, 32), dtype=np.uint8)
+            w_b = np.zeros((batch, 32), dtype=np.uint8)
+            for i, it in enumerate(items):
+                p = host_prep_scalars(it.key.public_key(),
+                                      it.signature)
+                if p is None:
+                    continue
+                ok_n[i] = True
+                r_b[i] = np.frombuffer(p[0], np.uint8)
+                rpn_b[i] = np.frombuffer(p[1], np.uint8)
+                w_b[i] = np.frombuffer(p[2], np.uint8)
+        assert ok_n.all()
+
+        def padb(a):
+            return np.pad(a, [(0, bucket - batch)] +
+                          [(0, 0)] * (a.ndim - 1))
+
+        r8 = padb(r_b)
+        rpn8 = padb(rpn_b)
+        w8 = padb(w_b)
+        key_map: dict[bytes, int] = {}
+        key_idx = np.zeros(bucket, dtype=np.int32)
         for i, it in enumerate(items):
-            p = host_prep_scalars(it.key.public_key(), it.signature)
-            if p is None:
-                continue
-            ok_n[i] = True
-            r_b[i] = np.frombuffer(p[0], np.uint8)
-            rpn_b[i] = np.frombuffer(p[1], np.uint8)
-            w_b[i] = np.frombuffer(p[2], np.uint8)
-    assert ok_n.all()
+            pub = it.key.public_key()
+            kb = pub.x_bytes().tobytes() + pub.y_bytes().tobytes()
+            key_idx[i] = key_map.setdefault(kb, len(key_map))
+        # the provider's SUPPORTED measurement surface: its own
+        # compiled digest pipeline + resident tables, degrading to the
+        # 8-bit path exactly as verify_batch would (the BENCH_r04
+        # KeyError came from peeking at private caches instead)
+        fn, key_idx, tabs = prov.prepared_digest_pipeline(key_map,
+                                                          key_idx)
+        q_flat, g16, q16_path, K = (tabs["q_flat"], tabs["g16"],
+                                    tabs["q16"], tabs["K"])
+        premask = np.zeros(bucket, dtype=bool)
+        premask[:batch] = True
 
-    def padb(a):
-        return np.pad(a, [(0, bucket - batch)] + [(0, 0)] * (a.ndim - 1))
+        chunk = prov._mesh_chunk(bucket)
+        if prov._mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            _sh = NamedSharding(prov._mesh, P("batch"))
 
-    r8 = padb(r_b)
-    rpn8 = padb(rpn_b)
-    w8 = padb(w_b)
-    key_map: dict[bytes, int] = {}
-    key_idx = np.zeros(bucket, dtype=np.int32)
-    for i, it in enumerate(items):
-        pub = it.key.public_key()
-        kb = pub.x_bytes().tobytes() + pub.y_bytes().tobytes()
-        key_idx[i] = key_map.setdefault(kb, len(key_map))
-    # the provider's SUPPORTED measurement surface: its own compiled
-    # digest pipeline + resident tables. Degrades to the 8-bit path
-    # exactly as verify_batch would (BENCH_r04 died here peeking at
-    # _qflat_cache when the cache policy denied the live key set)
-    fn, key_idx, tabs = prov.prepared_digest_pipeline(key_map, key_idx)
-    q_flat, g16, q16_path, K = (tabs["q_flat"], tabs["g16"],
-                                tabs["q16"], tabs["K"])
-    premask = np.zeros(bucket, dtype=bool)
-    premask[:batch] = True
+            def put(a):
+                return jax.device_put(a, _sh)
+        else:
+            put = jnp.asarray
+        staged = []
+        for lo in range(0, bucket, chunk):
+            hi = lo + chunk
+            staged.append(tuple(put(a) for a in (
+                key_idx[lo:hi], r8[lo:hi], rpn8[lo:hi], w8[lo:hi],
+                premask[lo:hi], digests0[lo:hi])))
+        jax.block_until_ready(staged)
 
-    chunk = min(bucket, CHUNK)
-    staged = []
-    for lo in range(0, bucket, chunk):
-        hi = lo + chunk
-        staged.append(tuple(jnp.asarray(a) for a in (
-            key_idx[lo:hi], r8[lo:hi], rpn8[lo:hi], w8[lo:hi],
-            premask[lo:hi], digests0[lo:hi])))
-    jax.block_until_ready(staged)
+        def run_chunks():
+            outs = [fn(ch[0], q_flat, g16, *ch[1:]) for ch in staged]
+            return np.concatenate([np.asarray(o) for o in outs])
 
-    def run_chunks():
-        outs = [fn(ch[0], q_flat, g16, *ch[1:]) for ch in staged]
-        return np.concatenate([np.asarray(o) for o in outs])
+        out = run_chunks()             # cache-hit: same shapes as warm
+        if not out[:batch].all():
+            raise SystemExit("correctness failure on device-resident "
+                             "path")
+        times = []
+        for _ in range(TPU_ITERS):
+            t0 = time.perf_counter()
+            out = run_chunks()
+            times.append(time.perf_counter() - t0)
+        tpu_s = min(times)
+        _PARTIAL["value"] = round(batch / tpu_s, 1)
+        _PARTIAL["tpu_steady_s"] = round(tpu_s, 4)
+        _PARTIAL["provider_stats"] = dict(prov.stats)
+        emit_stage({"stage": "kernel_steady",
+                    "devices": devices or local_devices,
+                    "mesh_devices": mesh_devices, "batch": batch,
+                    "sigs_per_s": round(batch / tpu_s, 1),
+                    "seconds": round(tpu_s, 4),
+                    "chunk": chunk, "q16": bool(q16_path)})
 
-    out = run_chunks()                 # cache-hit: same shapes as warm
-    if not out[:batch].all():
-        raise SystemExit("correctness failure on device-resident path")
-    times = []
-    for _ in range(TPU_ITERS):
-        t0 = time.perf_counter()
-        out = run_chunks()
-        times.append(time.perf_counter() - t0)
-    tpu_s = min(times)
-    tpu_sigs_per_s = batch / tpu_s
-    _PARTIAL["value"] = round(tpu_sigs_per_s, 1)
-    _PARTIAL["tpu_steady_s"] = round(tpu_s, 4)
-    _PARTIAL["provider_stats"] = dict(prov.stats)
+    on_tpu = type(prov)._on_tpu()
+    detail = {
+        "batch": batch,
+        "distinct_keys": NKEYS,
+        "devices_requested": devices or "all",
+        "local_devices": local_devices,
+        "mesh_devices": mesh_devices,
+        "kernel": ("fixed-base comb 16/16-bit windows + Pallas VMEM "
+                   "tree (ops/comb.py + ops/ptree.py)" if on_tpu else
+                   "comb 8-bit (CPU dry run)"),
+        "seam": "factory.new_bccsp({'Default': 'TPU'}) -> "
+                "TPUProvider.verify_batch; steady number uses the "
+                "provider's own compiled pipeline + cached tables",
+        "sharding": ("shard_map over a %d-device batch-axis mesh "
+                     "(replicated tables, per-device transfer "
+                     "streams)" % mesh_devices if mesh_devices > 1
+                     else "single device (no mesh)"),
+        "pipeline_chunk": pipeline_chunk,
+        "tpu_steady_s": round(tpu_s, 4) if tpu_s else None,
+        "hash_mode": ("host SHA-256 -> 32B digest lanes (default)"
+                      if prov._hash_on_host else
+                      "fused device SHA-256"),
+        "tpu_block_tx_per_s": (round(BLOCK_TXS / tpu_s, 1)
+                               if tpu_s else None),
+        "provider_verify_batch_s": round(provider_s, 4),
+        "provider_verify_batch_sigs_per_s":
+            round(batch / provider_s, 1),
+        "cpu_single_thread_us_per_sig": round(cpu_per_sig * 1e6, 1),
+        "cpu_ideal_cores": ncpu,
+        "cpu_ideal_sigs_per_s": round(cpu_sigs_per_s, 1),
+        "cpu_baseline_impl": baseline_impl,
+        "warm_pass_s": round(warm_s, 1),
+        "prewarm_s": round(prewarm_s, 1),
+        "prewarmed_key_sets": prewarmed_sets,
+        "sign_s": round(sign_s, 2),
+        "provider_stats": dict(prov.stats),
+        "shard_stats": dict(prov.shard_stats),
+        "devices": [str(d) for d in jax.devices()],
+    }
+    value = (round(batch / tpu_s, 1) if tpu_s
+             else round(batch / provider_s, 1))
+    emit_final({
+        "stage": "core",
+        "metric": "block-validation sig-verify throughput "
+                  f"({BLOCK_TXS}-tx block, 2-of-3 P-256, via "
+                  "TPUProvider)",
+        "devices": devices or local_devices,
+        "local_devices": local_devices,
+        "mesh_devices": mesh_devices,
+        "value": value,
+        "unit": "sigs/s",
+        "vs_baseline": round(value / cpu_sigs_per_s, 3),
+        "batch": batch,
+        "provider_sigs_per_s": round(batch / provider_s, 1),
+        "tpu_steady_s": round(tpu_s, 4) if tpu_s else None,
+        "cpu_ideal_sigs_per_s": round(cpu_sigs_per_s, 1),
+        "deadline_s": DEADLINE_S or None,
+        "deadline_hit": False,
+        "on_tpu": on_tpu,
+    }, detail)
 
-    # --- BASELINE config 3: the REAL pipeline (endorse -> raft order
-    #     -> TxValidator -> commit), TPU peer vs sw peer ---
-    # default e2e block = the SAME signature bucket as the headline
-    # (10240 txs -> 30720 sigs -> bucket 32768), so the provider's
-    # already-compiled pipeline is reused and the e2e section adds
-    # ZERO fresh device compiles
-    # secondary sections: off by default in smoke mode, and skipped
-    # outright when the self-deadline is near or a section's hard
-    # dependency (OpenSSL for cert/keygen-heavy flows) is absent
+
+def stage_pipeline():
+    """full-pipeline stage: the commit-pipeline overlap benchmark
+    (wheel-free, runs in the bounded default) plus the secondary
+    regimes — real endorse->order->validate->commit, idemix pairing
+    verify, block-sig latency, many-key-set policy, sw/device
+    crossover — each env-gated exactly as before."""
+    _start_watchdog()
+    have_ssl = _have_openssl()
+    warm_dir = os.environ.get(
+        "BENCH_WARM_DIR",
+        os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
     aux_default = "0" if SMOKE else "1"
 
     def want(env: str, needs_ssl: bool = False,
@@ -954,6 +1164,25 @@ def main():
         if needs_ssl and not have_ssl:
             return False
         return _remaining() > margin_s
+
+    needs_prov = (want("BENCH_E2E", needs_ssl=True)
+                  or want("BENCH_IDEMIX")
+                  or want("BENCH_BLOCKSIG", needs_ssl=True)
+                  or want("BENCH_CROSSOVER", needs_ssl=True))
+    prov = None
+    if needs_prov:
+        _apply_platform()
+        from fabric_tpu.bccsp import factory
+        from fabric_tpu.common import jaxenv
+        jaxenv.enable_cache_under(warm_dir)
+        pipeline_chunk = int(os.environ.get(
+            "BENCH_PIPELINE_CHUNK", str(min(8192, CHUNK))))
+        prov = factory.new_bccsp(factory.FactoryOpts.from_config(
+            _tpu_config(warm_dir, _devices_env(), pipeline_chunk)))
+        prov.prewarm(buckets=(prov._bucket(BLOCK_TXS * SIGS_PER_TX),),
+                     wait_restore=True, bounded=SMOKE)
+
+    detail: dict = {}
 
     pipeline = None
     if want("BENCH_E2E", needs_ssl=True):
@@ -966,10 +1195,8 @@ def main():
         except Exception as e:          # noqa: BLE001
             pipeline = {"error": f"{type(e).__name__}: {e}"}
         _PARTIAL["pipeline"] = pipeline
+        detail["pipeline"] = pipeline
 
-    # ---- ISSUE 4: commit-pipeline overlap (sequential vs depth-1
-    #      on a synthetic multi-block stream) — wheel-free and cheap,
-    #      so it runs in the bounded default too ----
     commitpipe = None
     if os.environ.get("BENCH_COMMIT_PIPELINE", "1") == "1" and \
             _remaining() > 30:
@@ -983,8 +1210,8 @@ def main():
         except Exception as e:          # noqa: BLE001
             commitpipe = {"error": f"{type(e).__name__}: {e}"}
         _PARTIAL["commit_pipeline"] = commitpipe
+        detail["commit_pipeline"] = commitpipe
 
-    # ---- BASELINE config 4: idemix pairing verify ----
     idemix = None
     if want("BENCH_IDEMIX"):
         try:
@@ -992,8 +1219,8 @@ def main():
         except Exception as e:          # noqa: BLE001
             idemix = {"error": f"{type(e).__name__}: {e}"}
         _PARTIAL["idemix"] = idemix
+        detail["idemix"] = idemix
 
-    # ---- BASELINE config 5: block-sig + gossip auth under load ----
     blocksig = None
     if want("BENCH_BLOCKSIG", needs_ssl=True):
         try:
@@ -1001,8 +1228,8 @@ def main():
         except Exception as e:          # noqa: BLE001
             blocksig = {"error": f"{type(e).__name__}: {e}"}
         _PARTIAL["blocksig"] = blocksig
+        detail["blocksig"] = blocksig
 
-    # ---- many-key-set regime + adaptive table policy ----
     multikeyset = None
     if want("BENCH_MULTIKEY", needs_ssl=True):
         try:
@@ -1010,8 +1237,8 @@ def main():
         except Exception as e:          # noqa: BLE001
             multikeyset = {"error": f"{type(e).__name__}: {e}"}
         _PARTIAL["multikeyset"] = multikeyset
+        detail["multikeyset"] = multikeyset
 
-    # ---- small-batch sw/device crossover (MinBatch justification) ----
     crossover = None
     if want("BENCH_CROSSOVER", needs_ssl=True):
         try:
@@ -1019,79 +1246,269 @@ def main():
         except Exception as e:          # noqa: BLE001
             crossover = {"error": f"{type(e).__name__}: {e}"}
         _PARTIAL["crossover"] = crossover
+        detail["crossover"] = crossover
 
-    on_tpu = type(prov)._on_tpu()
-    detail = {
-        "batch": batch,
-        "distinct_keys": NKEYS,
-        "kernel": ("fixed-base comb 16/16-bit windows + Pallas VMEM "
-                   "tree (ops/comb.py + ops/ptree.py)" if on_tpu else
-                   "comb 8-bit (CPU dry run)"),
-        "seam": "factory.new_bccsp({'Default': 'TPU'}) -> "
-                "TPUProvider.verify_batch; steady number uses the "
-                "provider's own compiled pipeline + cached tables",
-        "chunk": chunk,
-        "pipeline_chunk": pipeline_chunk,
-        "tpu_steady_s": round(tpu_s, 4),
-        "hash_mode": ("host SHA-256 -> 32B digest lanes (default; "
-                      "reference-matching CPU hash, minimal "
-                      "transfer)" if prov._hash_on_host else
-                      "fused device SHA-256"),
-        "staging": "device-resident operands (tunnel transfer "
-                   "excluded; see provider_verify_batch_*)",
-        "tpu_block_tx_per_s": round(BLOCK_TXS / tpu_s, 1),
-        "provider_verify_batch_s": round(provider_s, 4),
-        "provider_verify_batch_sigs_per_s":
-            round(batch / provider_s, 1),
-        "cpu_single_thread_us_per_sig": round(cpu_per_sig * 1e6, 1),
-        "cpu_ideal_cores": ncpu,
-        "cpu_ideal_sigs_per_s": round(cpu_sigs_per_s, 1),
-        "cpu_baseline_impl": baseline_impl,
-        "warm_pass_s": round(warm_s, 1),
-        "prewarm_s": round(prewarm_s, 1),
-        "prewarmed_key_sets": prewarmed_sets,
-        "sign_s": round(sign_s, 2),
-        "provider_stats": dict(prov.stats),
-        "restart": restart,
-        "pipeline": pipeline,
-        "commit_pipeline": commitpipe,
-        "idemix": idemix,
-        "blocksig": blocksig,
-        "multikeyset": multikeyset,
-        "crossover": crossover,
-        "devices": [str(d) for d in jax.devices()],
-    }
-    # ONE compact, driver-parseable final line (detail -> sidecar)
-    cp_flat = {}
+    res = {"stage": "full_pipeline",
+           "ok": not any(isinstance(v, dict) and "error" in v
+                         for v in detail.values()),
+           "sections": ",".join(sorted(detail)) or None,
+           "deadline_hit": False}
     if commitpipe and "overlap_ratio" in commitpipe:
-        cp_flat = {
-            "commit_pipeline_overlap_ratio":
-                commitpipe["overlap_ratio"],
-            "commit_pipeline_speedup": commitpipe["speedup"],
+        res["commit_pipeline_overlap_ratio"] = \
+            commitpipe["overlap_ratio"]
+        res["commit_pipeline_speedup"] = commitpipe["speedup"]
+    if pipeline and "tpu_peer_block_s" in pipeline:
+        res["e2e_tpu_peer_block_s"] = pipeline["tpu_peer_block_s"]
+    emit_final(res, detail)
+
+
+def _last_json_obj(text: str):
+    for ln in reversed([line for line in (text or "").splitlines()
+                        if line.strip()]):
+        if ln.lstrip().startswith("{"):
+            try:
+                return json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def _stage_lines(text: str) -> list:
+    """Every JSON line with a "stage" key in a child's captured
+    stdout — relayed onto the parent's stdout so sub-stage reports
+    survive the capture."""
+    out = []
+    for ln in (text or "").splitlines():
+        if not ln.lstrip().startswith("{"):
+            continue
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "stage" in obj:
+            out.append(obj)
+    return out
+
+
+def _run_stage(name: str, argv: list, env_extra: dict, budget: float):
+    """Run one stage child under the parent's hard deadline. Returns
+    (final_obj_or_None, child_stdout, error_line_or_None)."""
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env.update(env_extra)
+    t0 = time.monotonic()
+    try:
+        rc, out, stderr = _bounded_child(
+            [sys.executable, os.path.abspath(__file__)] + argv,
+            budget, env=env)
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout or ""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return None, out, {
+            "stage": name, "ok": False, "timeout": True,
+            "budget_s": budget,
+            "elapsed_s": round(time.monotonic() - t0, 1)}
+    out = out or ""
+    obj = _last_json_obj(out)
+    if rc != 0 or obj is None:
+        return obj, out, {
+            "stage": name, "ok": False, "rc": rc,
+            "stderr_tail": (stderr or "")[-400:],
+            "elapsed_s": round(time.monotonic() - t0, 1)}
+    return obj, out, None
+
+
+def orchestrate():
+    """The default `python bench.py`: a jax-free stage driver that
+    ALWAYS prints one aggregate final line, whatever the stages do."""
+    _start_watchdog()
+    warm_dir = os.environ.get(
+        "BENCH_WARM_DIR",
+        os.path.expanduser("~/.cache/fabric_tpu_warmkeys"))
+    have_ssl = _have_openssl()
+    stages: dict = {}
+    stage_detail: dict = {}
+
+    def record(name, obj):
+        stages[name] = obj or {}
+        _PARTIAL.setdefault("stages", {})[name] = _flat(obj or {})
+
+    def budget(floor: float = 45.0):
+        return min(STAGE_DEADLINE_S or 1e9,
+                   max(0.0, _remaining() - floor))
+
+    # ---- restart stage (full mode + OpenSSL only, as before) ----
+    if os.environ.get("BENCH_RESTART",
+                      "0" if SMOKE else "1") == "1" and have_ssl:
+        b = budget()
+        if b > 60:
+            res = bench_restart(warm_dir, timeout=b)
+            res = {"stage": "restart",
+                   "ok": "error" not in res, **res}
+            emit_stage({"stage": "restart", **_flat(res)})
+            record("restart", res)
+            stage_detail["restart"] = res
+        else:
+            obj = {"stage": "restart", "skipped": "budget"}
+            emit_stage(obj)
+            record("restart", obj)
+
+    def staged(name: str, argv: list, env: dict, b: float, side: str):
+        """Run one child stage: relay its sub-stage lines, emit any
+        error line, record its final object, load its sidecar — the
+        one sequence every child stage (core_* and full_pipeline)
+        goes through."""
+        obj, out, err = _run_stage(name, argv, env, b)
+        for line_obj in _stage_lines(out):
+            emit_stage(line_obj)
+        if err is not None:
+            emit_stage(err)
+        record(name, obj or err)
+        try:
+            with open(side) as f:
+                stage_detail[name] = json.load(f)
+        except Exception:           # noqa: BLE001
+            stage_detail[name] = None
+        return obj
+
+    # ---- core stages: 1-device, then sharded over all devices ----
+    def core_stage(name: str, devices: int):
+        side = SIDECAR + f".{name}.json"
+        b = budget()
+        if b <= 60:
+            obj = {"stage": name, "skipped": "budget"}
+            emit_stage(obj)
+            record(name, obj)
+            return None
+        env = {"BENCH_DEVICES": str(devices),
+               "BENCH_SIDECAR": side,
+               "BENCH_DEADLINE_S": str(max(45.0, b - 30.0))}
+        return staged(name, ["--stage", "core"], env, b, side)
+
+    core1 = core_stage("core_1dev", 1)
+    local = (core1 or {}).get("local_devices") or 0
+    coreN = None
+    if os.environ.get("BENCH_MULTICHIP", "1") != "1":
+        obj = {"stage": "multichip", "skipped": "BENCH_MULTICHIP=0"}
+        emit_stage(obj)
+        record("multichip", obj)
+    elif local > 1:
+        if not SMOKE:
+            for tok in os.environ.get("BENCH_CURVE", "").split(","):
+                tok = tok.strip()
+                if tok.isdigit() and 1 < int(tok) < local:
+                    core_stage(f"core_{tok}dev", int(tok))
+        coreN = core_stage("core_alldev", 0)
+        curve_d, curve_v, curve_p = [], [], []
+        # numeric order, NOT name order: sorted names would interleave
+        # core_16dev between core_1dev and core_2dev and hand any
+        # scaling plot a non-monotonic device axis
+        core_objs = [o for n, o in stages.items()
+                     if n.startswith("core_") and (o or {}).get("value")]
+        for obj in sorted(core_objs,
+                          key=lambda o: o.get("mesh_devices") or 0):
+            curve_d.append(obj.get("mesh_devices"))
+            curve_v.append(obj.get("value"))
+            curve_p.append(obj.get("provider_sigs_per_s"))
+        mc = {"stage": "multichip",
+              "ok": bool(core1 and coreN and (core1 or {}).get("value")
+                         and (coreN or {}).get("value"))}
+        if mc["ok"]:
+            mc["devices"] = coreN.get("mesh_devices")
+            mc["tpu_steady_scaling_x"] = round(
+                coreN["value"] / core1["value"], 2)
+            if coreN.get("provider_sigs_per_s") and \
+                    core1.get("provider_sigs_per_s"):
+                mc["provider_scaling_x"] = round(
+                    coreN["provider_sigs_per_s"] /
+                    core1["provider_sigs_per_s"], 2)
+        emit_stage(mc)
+        record("multichip", mc)
+        # the measured scaling curve rides in the detail sidecar
+        stage_detail["multichip_curve"] = {
+            "devices": curve_d,
+            "tpu_steady_sigs_per_s": curve_v,
+            "provider_sigs_per_s": curve_p,
         }
+    else:
+        obj = {"stage": "multichip",
+               "skipped": f"{local or 1} local device(s)"}
+        emit_stage(obj)
+        record("multichip", obj)
+
+    # ---- full-pipeline stage ----
+    run_pipe = (os.environ.get("BENCH_COMMIT_PIPELINE", "1") == "1"
+                or not SMOKE)
+    b = budget(floor=30.0)
+    if run_pipe and b > 45:
+        side = SIDECAR + ".pipeline.json"
+        env = {"BENCH_SIDECAR": side,
+               "BENCH_DEADLINE_S": str(max(40.0, b - 20.0))}
+        staged("full_pipeline", ["--stage", "pipeline"], env, b, side)
+    else:
+        obj = {"stage": "full_pipeline",
+               "skipped": "budget" if run_pipe else "off"}
+        emit_stage(obj)
+        record("full_pipeline", obj)
+
+    # ---- aggregate final line (the one the driver parses) ----
+    best = {}
+    for cand in (stages.get("core_alldev"), stages.get("core_1dev")):
+        if cand and cand.get("value"):
+            best = cand
+            break
+    _PARTIAL["value"] = best.get("value")
+    fp = stages.get("full_pipeline") or {}
+    cp_flat = {k: fp[k] for k in ("commit_pipeline_overlap_ratio",
+                                  "commit_pipeline_speedup")
+               if k in fp}
+    mc = stages.get("multichip") or {}
+    ok_names = ",".join(sorted(
+        n for n, o in stages.items()
+        if o and (o.get("ok") or o.get("value") is not None)))
+    bad_names = ",".join(sorted(
+        n for n, o in stages.items()
+        if o and o.get("ok") is False and "skipped" not in o))
+    detail = {"stages": stages, "stage_detail": stage_detail}
     emit_final({
-        # the label reflects the MEASURED block size: bounded default
-        # runs use 512-tx blocks, not the full 10k config
         "metric": "block-validation sig-verify throughput "
-                  f"({BLOCK_TXS}-tx block, 2-of-3 P-256, "
-                  "via TPUProvider)",
+                  f"({BLOCK_TXS}-tx block, 2-of-3 P-256, via "
+                  "TPUProvider, staged)",
         **cp_flat,
-        "value": round(tpu_sigs_per_s, 1),
+        "value": best.get("value"),
         "unit": "sigs/s",
-        "vs_baseline": round(tpu_sigs_per_s / cpu_sigs_per_s, 3),
-        "batch": batch,
-        "provider_sigs_per_s": round(batch / provider_s, 1),
-        "tpu_steady_s": round(tpu_s, 4),
-        "cpu_ideal_sigs_per_s": round(cpu_sigs_per_s, 1),
+        "vs_baseline": best.get("vs_baseline"),
+        "batch": best.get("batch"),
+        "devices": best.get("mesh_devices"),
+        "provider_sigs_per_s": best.get("provider_sigs_per_s"),
+        "tpu_steady_s": best.get("tpu_steady_s"),
+        "cpu_ideal_sigs_per_s": best.get("cpu_ideal_sigs_per_s"),
+        "tpu_steady_scaling_x": mc.get("tpu_steady_scaling_x"),
+        "stages_ok": ok_names or None,
+        "stages_failed": bad_names or None,
         "deadline_s": DEADLINE_S or None,
         "deadline_hit": False,
-        "on_tpu": on_tpu,
+        "on_tpu": best.get("on_tpu"),
     }, detail)
+
+
+def main():
+    """Back-compat alias: the staged orchestrator."""
+    orchestrate()
 
 
 if __name__ == "__main__":
     import sys
     if len(sys.argv) > 3 and sys.argv[1] == "--restart-child":
         _restart_child(sys.argv[2], sys.argv[3])
+    elif len(sys.argv) > 2 and sys.argv[1] == "--stage":
+        if sys.argv[2] == "core":
+            stage_core()
+        elif sys.argv[2] == "pipeline":
+            stage_pipeline()
+        else:
+            raise SystemExit(f"unknown stage {sys.argv[2]!r}")
     else:
-        main()
+        orchestrate()

@@ -211,12 +211,21 @@ except ImportError:
                 f"curve {getattr(curve, 'name', curve)!r}")
         return _PrivateKey(_p256.generate_scalar())
 
+    def _derive_private_key(private_value, curve):
+        if getattr(curve, "name", "") != "secp256r1":
+            raise MissingCryptographyError(
+                f"curve {getattr(curve, 'name', curve)!r}")
+        if not 1 <= private_value < _p256.N:
+            raise ValueError("private_value out of range for P-256")
+        return _PrivateKey(private_value)
+
     class ec(metaclass=_MissingAttr):  # noqa: N801  (namespace)
         SECP256R1 = _SECP256R1
         ECDSA = _ECDSA
         EllipticCurvePublicKey = _PublicKey
         EllipticCurvePrivateKey = _PrivateKey
         generate_private_key = staticmethod(_generate_private_key)
+        derive_private_key = staticmethod(_derive_private_key)
 
     # -- serialization --
 

@@ -10,12 +10,15 @@ the only user-visible switch — no other layer imports the tpu module.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
 from fabric_tpu.bccsp.bccsp import BCCSP
 from fabric_tpu.common.breaker import BreakerConfig
+
+logger = logging.getLogger("bccsp.factory")
 
 _lock = threading.Lock()
 _default: Optional[BCCSP] = None
@@ -32,7 +35,12 @@ class SwOpts:
 class TpuOpts:
     min_batch: int = 16
     max_blocks: int = 64
-    n_devices: Optional[int] = None   # None = single-device (no mesh)
+    # BCCSP.TPU.Devices: batch-axis device-mesh size for the sharded
+    # verify pipeline. None/0 (the default) = ALL local devices — a
+    # box with 8 chips shards every big batch across all 8; 1 pins the
+    # single-device path (bit-for-bit the pre-mesh pipeline, no mesh
+    # object at all); N>1 uses the first N local devices.
+    n_devices: Optional[int] = None
     # comb-path knobs (fabric_tpu/bccsp/tpu.py): these select the
     # flagship 16-bit-window configuration; use_g16=None auto-resolves
     # to True on TPU backends so `BCCSP.Default: TPU` in core.yaml
@@ -118,6 +126,44 @@ class FactoryOpts:
         )
 
 
+def _resolve_mesh(n_devices: Optional[int]):
+    """BCCSP.TPU.Devices -> the provider's batch-axis mesh.
+
+    None/0 = all local devices (the sharded flagship: every chip on
+    the box combs its slice of the batch); 1 = no mesh, the
+    single-device pipeline bit-for-bit; N>1 = the first N devices.
+    Availability first: a backend that cannot even enumerate devices
+    (mid-flight libtpu upgrade, broken tunnel) degrades to the
+    single-device path with a warning instead of failing provider
+    construction — the breaker handles the rest at dispatch time."""
+    try:
+        nd = n_devices
+        if nd == 1:
+            return None
+        import jax
+        avail = len(jax.devices())
+        if nd is None or nd <= 0:
+            nd = avail
+        elif nd > avail:
+            # explicit over-ask (stale config on a smaller rig) serves
+            # on every device there IS, loudly — silently dropping to
+            # ONE device would cost ~avail x the configured throughput
+            logger.warning(
+                "BCCSP.TPU.Devices: %d exceeds the %d local "
+                "device(s); clamping to %d", nd, avail, avail)
+            nd = avail
+        if nd <= 1:
+            return None
+        from fabric_tpu.parallel import batch_mesh
+        return batch_mesh(nd)
+    except Exception:
+        logger.exception(
+            "could not build the %s-device verify mesh; serving on "
+            "the single-device path (set BCCSP.TPU.Devices: 1 to "
+            "silence)", n_devices if n_devices else "all")
+        return None
+
+
 def new_bccsp(opts: FactoryOpts) -> BCCSP:
     ks = None
     if opts.sw.keystore_path:
@@ -134,10 +180,7 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
         # restart (or the next bench process) skips the ~minutes
         # compiles along with the table rebuilds
         jaxenv.enable_cache_under(opts.tpu.warm_keys_dir)
-        mesh = None
-        if opts.tpu.n_devices:
-            from fabric_tpu.parallel import batch_mesh
-            mesh = batch_mesh(opts.tpu.n_devices)
+        mesh = _resolve_mesh(opts.tpu.n_devices)
         return TPUProvider(ks, min_batch=opts.tpu.min_batch,
                            max_blocks=opts.tpu.max_blocks, mesh=mesh,
                            max_keys=opts.tpu.max_keys,

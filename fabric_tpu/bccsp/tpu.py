@@ -180,6 +180,10 @@ class TPUProvider(api.BCCSP):
                       "pipeline_overlap_ratio": 0.0,
                       "prepared_transfer_s": 0.0,
                       "prepared_device_s": 0.0,
+                      "shard_devices": (getattr(mesh, "size", 1)
+                                        if mesh is not None else 1),
+                      "shard_dispatches": 0,
+                      "shard_skew_s": 0.0,
                       "breaker_state": 0, "breaker_trips": 0,
                       "breaker_probes": 0,
                       "breaker_deadline_timeouts": 0,
@@ -187,6 +191,12 @@ class TPUProvider(api.BCCSP):
                       "degraded_batches": 0,
                       "warm_table_persist_failures": 0,
                       "warm_restore_failures": 0}
+        # per-device stage observability for the sharded dispatch
+        # (bccsp_shard_* gauges, published with a `device` label by
+        # profiling.publish_provider_stats): one slot per mesh device,
+        # refreshed per sharded batch. Empty lists while single-chip.
+        self.shard_stats: dict = {"transfer_s": [], "ready_s": [],
+                                  "lanes": []}
         self._persist_threads: list = []
         # serializes warm-file mutations (record/trim/drop) with the
         # background table-byte writers' publish step, so a concurrent
@@ -499,9 +509,14 @@ class TPUProvider(api.BCCSP):
             self.stats["ladder_batches"] += 1
             qx_l = limb.be_bytes_to_limbs(qx_b)
             qy_l = limb.be_bytes_to_limbs(qy_b)
-            args = tuple(jnp.asarray(a) for a in
-                         (blocks, nblocks, qx_l, qy_l, r_l, rpn_l, w_l,
-                          premask, digests, has_digest))
+            args = (blocks, nblocks, qx_l, qy_l, r_l, rpn_l, w_l,
+                    premask, digests, has_digest)
+            if self._mesh is None:
+                args = tuple(jnp.asarray(a) for a in args)
+            # under a mesh the host arrays stay UNCOMMITTED so the
+            # jit's NamedSharding in_shardings place each lane slice
+            # on its device directly (a jnp.asarray here would commit
+            # to device 0 and force a gather-then-scatter reshard)
             out = self._pipeline()(*args)
             # ftpu-lint: allow-host-sync(the thunk IS the deliberate
             # materialization point, invoked after dispatch returns)
@@ -675,12 +690,14 @@ class TPUProvider(api.BCCSP):
             return ((kidx, r8, rpn8, w8, premask, dg),
                     (t0, _time.perf_counter()), hashed)
 
+        ndev = self._mesh.size if self._mesh is not None else 1
+        tdev = [0.0] * ndev
+
         def put(arrs):
             if self._mesh is not None:
-                from jax.sharding import NamedSharding
-                from jax.sharding import PartitionSpec as P
-                s = NamedSharding(self._mesh, P("batch"))
-                return tuple(jax.device_put(a, s) for a in arrs)
+                # sharded span feed: per-device transfer streams,
+                # lanes dealt across the mesh (bccsp_shard_* gauges)
+                return self._shard_put(arrs, tdev)
             return tuple(jax.device_put(a) for a in arrs)
 
         pool = self._prep_executor()
@@ -705,6 +722,12 @@ class TPUProvider(api.BCCSP):
                 t_disp0 = t0
             outs.append(fn(dev[0], q_flat, g16, *dev[1:]))
             dispatch_s += _time.perf_counter() - t0
+        if self._mesh is not None:
+            # per-device stage gauges BEFORE the gather: the final
+            # span's shard readiness is the per-chip signal; the
+            # np gather below would flatten it into one number
+            self.stats["shard_dispatches"] += nspans
+            self._record_shard_stats(outs[-1], tdev, pc, t_disp0)
         t0 = _time.perf_counter()
         # ftpu-lint: allow-host-sync(end-of-batch materialization: all
         # spans are dispatched, this is the single deliberate sync)
@@ -1601,6 +1624,81 @@ class TPUProvider(api.BCCSP):
         return fn, key_idx, {"q_flat": q_flat, "g16": g16,
                              "q16": q16, "K": K}
 
+    @hot_path
+    def _shard_put(self, arrs, timings=None):
+        """Round-robin span feeder for the sharded dispatch: deal each
+        span's lanes contiguously across the mesh — device d takes the
+        slice the batch NamedSharding assigns it — with one EXPLICIT
+        per-device transfer stream per chip, then assemble the shards
+        zero-copy into the global sharded array the shard_map program
+        consumes. Versus one batched device_put this costs a few
+        host-side slice views and buys per-device attribution: a chip
+        whose H2D stream is slow shows up in `timings` (len-mesh list
+        accumulating per-device transfer-enqueue seconds, surfaced as
+        `bccsp_shard_transfer_s{device=…}`) instead of smearing into
+        one opaque number."""
+        import time as _time
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        s = NamedSharding(self._mesh, P("batch"))
+        mesh_devs = list(self._mesh.devices.flat)
+        out = []
+        for a in arrs:
+            imap = s.addressable_devices_indices_map(a.shape)
+            shards = []
+            for d, dev in enumerate(mesh_devs):
+                t0 = _time.perf_counter()
+                shards.append(jax.device_put(a[imap[dev]], dev))
+                if timings is not None and d < len(timings):
+                    timings[d] += _time.perf_counter() - t0
+            out.append(jax.make_array_from_single_device_arrays(
+                a.shape, s, shards))
+        return tuple(out)
+
+    def _record_shard_stats(self, last_out, tdev, span,
+                            t_disp0) -> None:
+        """Refresh the per-device shard gauges after a sharded batch:
+        transfer-enqueue seconds per chip (from `_shard_put`), lanes
+        per chip, and the per-device ready lag of the FINAL span's
+        accept bitmap. Readiness is sampled by blocking shards in mesh
+        order, so device d's reading is max(its own, earlier devices')
+        — an upper bound that still localizes a straggler chip as a
+        step in the curve. Runs at the end-of-batch sync point, never
+        inside an overlapped span."""
+        import time as _time
+        ndev = len(tdev)
+        # lanes from the final span's REAL extent, not the nominal
+        # chunk: a non-dividing bucket leaves a short tail chunk and
+        # the gauge must report what each device actually processed
+        shape = getattr(last_out, "shape", None)
+        if shape:
+            span = int(shape[0])
+        ready: list = []
+        shards = getattr(last_out, "addressable_shards", None)
+        if shards is not None and t_disp0 is not None:
+            by_dev = {sh.device: sh for sh in shards}
+            for dev in self._mesh.devices.flat:
+                sh = by_dev.get(dev)
+                if sh is not None:
+                    try:
+                        sh.data.block_until_ready()
+                    except Exception:
+                        logger.warning(
+                            "shard ready probe failed on %s", dev,
+                            exc_info=True)
+                ready.append(
+                    round(_time.perf_counter() - t_disp0, 6))
+        self.shard_stats = {
+            "transfer_s": [round(t, 6) for t in tdev],
+            "ready_s": ready,
+            "lanes": [span // ndev] * ndev,
+        }
+        self.stats["shard_devices"] = ndev
+        self.stats["shard_skew_s"] = (
+            round(max(ready) - min(ready), 6) if ready else 0.0)
+
     def _mesh_chunk(self, bucket: int) -> int:
         """Chunk size; under a mesh, slices stay divisible by the mesh
         size for shard_map."""
@@ -1628,11 +1726,16 @@ class TPUProvider(api.BCCSP):
         chunk = self._mesh_chunk(bucket)
         fn = self._comb_pipeline_digest(K, q16)
 
+        ndev = self._mesh.size if self._mesh is not None else 1
+        tdev = [0.0] * ndev
+
         def stage(lo):
             hi = lo + chunk
-            return tuple(jax.device_put(a) for a in (
-                key_idx[lo:hi], r8[lo:hi], rpn8[lo:hi], w8[lo:hi],
-                premask[lo:hi], digests[lo:hi]))
+            arrs = (key_idx[lo:hi], r8[lo:hi], rpn8[lo:hi], w8[lo:hi],
+                    premask[lo:hi], digests[lo:hi])
+            if self._mesh is not None:
+                return self._shard_put(arrs, tdev)
+            return tuple(jax.device_put(a) for a in arrs)
 
         # transfer-ahead double buffer: chunk k+1's async device_put
         # is enqueued BEFORE chunk k's dispatch, so the H2D copy rides
@@ -1641,6 +1744,7 @@ class TPUProvider(api.BCCSP):
         # prep already happened in native/blockprep.cpp)
         outs = []
         transfer_s = dispatch_s = 0.0
+        t_disp0 = None
         t0 = _time.perf_counter()
         nxt = stage(0)
         transfer_s += _time.perf_counter() - t0
@@ -1651,15 +1755,22 @@ class TPUProvider(api.BCCSP):
                 nxt = stage(lo + chunk)
                 transfer_s += _time.perf_counter() - t0
             t0 = _time.perf_counter()
+            if t_disp0 is None:
+                t_disp0 = t0
             outs.append(fn(cur[0], q_flat, g16, *cur[1:]))
             dispatch_s += _time.perf_counter() - t0
         # prepared_* (NOT pipeline_*): these gauges must not clobber
         # the overlapped item path's coherent host/transfer/device/
         # overlap snapshot with a different batch's numbers
         self.stats["prepared_transfer_s"] = round(transfer_s, 6)
+        if self._mesh is not None:
+            self.stats["shard_dispatches"] += len(outs)
 
         def thunk():
             t0 = _time.perf_counter()
+            if self._mesh is not None:
+                self._record_shard_stats(outs[-1], tdev, chunk,
+                                         t_disp0)
             # ftpu-lint: allow-host-sync(the thunk IS the deliberate
             # materialization point, invoked after dispatch returns)
             out = np.concatenate([np.asarray(o) for o in outs])
@@ -1682,15 +1793,18 @@ class TPUProvider(api.BCCSP):
         chunk = self._mesh_chunk(bucket)
         fn = self._comb_pipeline(K, q16)
         outs = []
+        stage = ((lambda a: a) if self._mesh is not None
+                 else jnp.asarray)   # uncommitted under a mesh: the
+        #                              shard_map jit deals lanes out
         for lo in range(0, bucket, chunk):
             hi = lo + chunk
             outs.append(fn(
-                jnp.asarray(blocks[lo:hi]), jnp.asarray(nblocks[lo:hi]),
-                jnp.asarray(key_idx[lo:hi]), q_flat, g16,
-                jnp.asarray(r_l[lo:hi]), jnp.asarray(rpn_l[lo:hi]),
-                jnp.asarray(w_l[lo:hi]), jnp.asarray(premask[lo:hi]),
-                jnp.asarray(digests[lo:hi]),
-                jnp.asarray(has_digest[lo:hi])))
+                stage(blocks[lo:hi]), stage(nblocks[lo:hi]),
+                stage(key_idx[lo:hi]), q_flat, g16,
+                stage(r_l[lo:hi]), stage(rpn_l[lo:hi]),
+                stage(w_l[lo:hi]), stage(premask[lo:hi]),
+                stage(digests[lo:hi]),
+                stage(has_digest[lo:hi])))
         thunk = lambda: np.concatenate(  # noqa: E731
             # ftpu-lint: allow-host-sync(deliberate materialization)
             [np.asarray(o) for o in outs])
@@ -1754,14 +1868,15 @@ class TPUProvider(api.BCCSP):
                 # auto-partition, but as a per-shard program each chip
                 # simply combs its own batch slice against replicated
                 # tables — no collectives in the main path at all
-                from jax import shard_map
                 from jax.sharding import PartitionSpec as P
+
+                from fabric_tpu.common import jaxenv
                 s = P("batch")
                 rep = P()
-                self._comb_fns[key] = jax.jit(shard_map(
+                self._comb_fns[key] = jax.jit(jaxenv.shard_map(
                     fused, mesh=self._mesh,
                     in_specs=(s, s, s, rep, rep, s, s, s, s, s, s),
-                    out_specs=s, check_vma=False))
+                    out_specs=s))
             else:
                 self._comb_fns[key] = jax.jit(fused)
         return self._comb_fns[key]
@@ -1810,14 +1925,15 @@ class TPUProvider(api.BCCSP):
                     # every per-lane operand; NOT q_flat (1) / g16 (2)
                     jit_kw["donate_argnums"] = (0, 3, 4, 5, 6, 7)
                 if self._mesh is not None:
-                    from jax import shard_map
                     from jax.sharding import PartitionSpec as P
+
+                    from fabric_tpu.common import jaxenv
                     s = P("batch")
                     rep = P()
-                    self._comb_fns[key] = jax.jit(shard_map(
+                    self._comb_fns[key] = jax.jit(jaxenv.shard_map(
                         fused, mesh=self._mesh,
                         in_specs=(s, rep, rep, s, s, s, s, s),
-                        out_specs=s, check_vma=False), **jit_kw)
+                        out_specs=s), **jit_kw)
                 else:
                     self._comb_fns[key] = jax.jit(fused, **jit_kw)
             return self._comb_fns[key]

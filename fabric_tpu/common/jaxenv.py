@@ -49,6 +49,33 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         return None
 
 
+def shard_map(fn, mesh, in_specs, out_specs):
+    """Version-portable `shard_map` wrapper for the sharded verify
+    pipeline.
+
+    jax >= 0.6 exposes `jax.shard_map(..., check_vma=)`; the 0.4.x
+    line this container ships has only
+    `jax.experimental.shard_map.shard_map(..., check_rep=)`. Either
+    way replication checking is disabled: the flagship comb pipeline
+    contains a pallas_call custom call the checker cannot see
+    through, and the tables really are replicated by construction
+    (`TPUProvider._resolve_tables` places them with an empty
+    PartitionSpec)."""
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return sm(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as _esm
+    return _esm(fn, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, check_rep=False)
+
+
 def enable_cache_under(warm_dir: str | None) -> str | None:
     """Key the persistent compilation cache under a provider's warm
     state directory (``<warm_dir>/xla_cache``) so the ~minutes kernel

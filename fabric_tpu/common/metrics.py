@@ -101,6 +101,46 @@ BCCSP_PIPELINE_OVERLAP_RATIO_OPTS = GaugeOpts(
          "in the most recent overlapped verify batch: 0 = fully "
          "serial, (chunks-1)/chunks = fully pipelined.")
 
+BCCSP_SHARD_DEVICES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="shard", name="devices",
+    help="Device-mesh size the TPU verify provider shards the batch "
+         "axis over (BCCSP.TPU.Devices; 1 = single-device pipeline, "
+         "no mesh).")
+
+BCCSP_SHARD_DISPATCHES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="shard", name="dispatches",
+    help="Sharded span/chunk dispatches issued to the device mesh "
+         "since process start (each runs one per-shard comb program "
+         "on every chip).")
+
+BCCSP_SHARD_TRANSFER_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="shard", name="transfer_s",
+    help="Per-device host-to-device transfer-enqueue seconds for the "
+         "most recent sharded verify batch: the round-robin span "
+         "feeder runs one explicit stream per chip, so a chip with a "
+         "slow link stands out instead of smearing into one number.",
+    label_names=("device",))
+
+BCCSP_SHARD_READY_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="shard", name="ready_s",
+    help="Per-device seconds from the batch's first span dispatch "
+         "until that device's slice of the final span's accept bitmap "
+         "was ready. Sampled in mesh order (each reading is an upper "
+         "bound); a straggler chip shows as a step in the curve.",
+    label_names=("device",))
+
+BCCSP_SHARD_LANES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="shard", name="lanes",
+    help="Signature lanes the most recent sharded span placed on each "
+         "device (the batch axis is dealt contiguously across the "
+         "mesh).", label_names=("device",))
+
+BCCSP_SHARD_SKEW_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="shard", name="skew_s",
+    help="Ready-time spread (max - min) across mesh devices for the "
+         "most recent sharded batch: persistent skew means one chip "
+         "paces the whole mesh.")
+
 COMMIT_PIPELINE_DEPTH_OPTS = GaugeOpts(
     namespace="commit", subsystem="pipeline", name="depth",
     help="Configured commit-pipeline depth: how many blocks may be "

@@ -98,6 +98,12 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
             metrics_mod.BCCSP_PIPELINE_DEVICE_SECONDS_OPTS,
         "pipeline_overlap_ratio":
             metrics_mod.BCCSP_PIPELINE_OVERLAP_RATIO_OPTS,
+        # sharded-dispatch scalars share their fqnames with the
+        # canonical bccsp_shard_* declarations — the generic fallback
+        # opts would collide in the registry with different help text
+        "shard_devices": metrics_mod.BCCSP_SHARD_DEVICES_OPTS,
+        "shard_dispatches": metrics_mod.BCCSP_SHARD_DISPATCHES_OPTS,
+        "shard_skew_s": metrics_mod.BCCSP_SHARD_SKEW_SECONDS_OPTS,
     }
     gauges = {
         name: metrics_provider.new_gauge(canonical.get(
@@ -111,6 +117,22 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
     # alert on): breaker state gauge + trip counter, fed from the
     # provider's breaker rather than the stats dict so they track
     # state changes even between dispatches
+    # per-device sharded-dispatch gauges (device label = mesh slot):
+    # fed from the provider's shard_stats lists, refreshed per poll
+    shard_stats = getattr(csp, "shard_stats", None)
+    shard_gauges = None
+    if isinstance(shard_stats, dict):
+        try:
+            shard_gauges = {
+                "transfer_s": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_SHARD_TRANSFER_SECONDS_OPTS),
+                "ready_s": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_SHARD_READY_SECONDS_OPTS),
+                "lanes": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_SHARD_LANES_OPTS),
+            }
+        except Exception:
+            shard_gauges = None
     breaker = getattr(csp, "_breaker", None)
     fallback_state = fallback_trips = None
     if breaker is not None:
@@ -135,6 +157,23 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
                         logger.warning("bccsp stats gauge %r publish "
                                        "failed (suppressing repeats): "
                                        "%s", name, e)
+            if shard_gauges is not None:
+                # re-read per poll: the provider replaces the dict
+                # wholesale on each sharded batch
+                cur = getattr(csp, "shard_stats", None)
+                if isinstance(cur, dict):
+                    for name, g in shard_gauges.items():
+                        try:
+                            for d, v in enumerate(cur.get(name) or ()):
+                                g.with_labels("device",
+                                              str(d)).set(float(v))
+                        except Exception as e:
+                            if ("shard_" + name) not in warned:
+                                warned.add("shard_" + name)
+                                logger.warning(
+                                    "bccsp shard gauge %r publish "
+                                    "failed (suppressing repeats): %s",
+                                    name, e)
             if fallback_state is not None:
                 try:
                     fallback_state.set(float(breaker.state_code))

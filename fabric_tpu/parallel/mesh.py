@@ -66,8 +66,7 @@ def shardmap_comb_verify(mesh: Mesh, q16: bool, tree: str = "xla"):
     (the measured single-chip headline) is exercised; tree="xla" keeps
     the gate runnable on CPU meshes where pallas cannot lower.
     """
-    from jax import shard_map
-
+    from fabric_tpu.common import jaxenv
     from fabric_tpu.ops import comb
 
     def local(words, key_idx, q_flat, g16, r, rpn, w, premask):
@@ -77,10 +76,9 @@ def shardmap_comb_verify(mesh: Mesh, q16: bool, tree: str = "xla"):
 
     s = P(BATCH_AXIS)
     rep = P()
-    return jax.jit(shard_map(
+    return jax.jit(jaxenv.shard_map(
         local, mesh=mesh,
-        in_specs=(s, s, rep, rep, s, s, s, s), out_specs=s,
-        check_vma=False))
+        in_specs=(s, s, rep, rep, s, s, s, s), out_specs=s))
 
 
 def sharded_comb_fns(mesh: Mesh):

@@ -219,6 +219,66 @@ class TestSeededViolations:
         assert mod.main(["--check", "--root", seeded]) == 0
 
 
+class TestHotPathCoverage:
+    """Round-9 rule: the overlapped/sharded dispatch spans named in
+    REQUIRED_HOT_PATHS must exist and carry @hot_path — dropping the
+    decorator would silently disarm the host-sync rule on exactly the
+    code it was written for."""
+
+    def _seed_tpu(self, root, body: str):
+        bccsp = os.path.join(root, "fabric_tpu", "bccsp")
+        os.makedirs(bccsp, exist_ok=True)
+        open(os.path.join(bccsp, "__init__.py"), "w").close()
+        with open(os.path.join(bccsp, "tpu.py"), "w") as f:
+            f.write(body)
+
+    def _all_spans(self, lint, decorate=True):
+        dec = "@hot_path\n" if decorate else ""
+        fns = "".join(
+            f"{dec}def {name}(*a, **kw):\n    return None\n\n"
+            for name in
+            lint.REQUIRED_HOT_PATHS["fabric_tpu/bccsp/tpu.py"])
+        return ("from fabric_tpu.common.hotpath import hot_path\n\n"
+                + fns)
+
+    def test_undecorated_span_is_a_finding(self, lint, tmp_path):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        self._seed_tpu(root, self._all_spans(lint, decorate=False))
+        findings = [f for f in lint.run_lint(root)
+                    if f.rule == "hot-path-coverage"]
+        assert len(findings) == len(
+            lint.REQUIRED_HOT_PATHS["fabric_tpu/bccsp/tpu.py"])
+        assert any("_shard_put" in f.message for f in findings)
+        assert all("@hot_path" in f.message for f in findings)
+
+    def test_missing_span_reports_registry_drift(self, lint,
+                                                 tmp_path):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        body = self._all_spans(lint).replace(
+            "def _shard_put", "def _shard_put_renamed")
+        self._seed_tpu(root, body)
+        findings = [f for f in lint.run_lint(root)
+                    if f.rule == "hot-path-coverage"]
+        assert len(findings) == 1
+        assert "_shard_put" in findings[0].message
+        assert "REQUIRED_HOT_PATHS" in findings[0].message
+
+    def test_decorated_spans_are_clean(self, lint, tmp_path):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        self._seed_tpu(root, self._all_spans(lint))
+        assert [f for f in lint.run_lint(root)
+                if f.rule == "hot-path-coverage"] == []
+
+    def test_registry_names_the_sharded_feeder(self, lint):
+        """The round-9 sharded span is registered — the satellite's
+        point: new dispatch spans extend the coverage list."""
+        assert "_shard_put" in \
+            lint.REQUIRED_HOT_PATHS["fabric_tpu/bccsp/tpu.py"]
+
+
 class TestTreeAtHead:
     def test_tree_is_clean(self, lint):
         findings = lint.run_lint(REPO)

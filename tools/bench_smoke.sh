@@ -1,31 +1,51 @@
 #!/usr/bin/env bash
-# Bench smoke gate: run bench.py in its bounded smoke mode on the CPU
-# backend and assert the driver-parse contract that rounds 3-5 kept
-# breaking — the process must finish inside its own self-deadline
-# (never rc=124 from outside) and its LAST stdout line must be ONE
-# compact JSON object, with the overlapped-pipeline stage timers
-# visible in the sidecar.
+# Bench smoke gate: run the STAGED bench on the CPU backend (with a
+# forced 8-device host platform so the bounded multichip stage runs
+# even on a 1-chip box) and assert the driver-parse contract that
+# rounds 3-5 kept breaking — the process must finish inside its own
+# deadlines (never rc=124 from outside), every stage must print its
+# own JSON line, and the LAST stdout line must be ONE compact
+# aggregate object.
 #
-# First run on a fresh machine pays one ~3-4 min XLA compile; the
-# persistent compilation cache (keyed under BENCH_WARM_DIR) makes
-# every later run take seconds. CI budget = deadline + grace.
+# Per-stage deadlines are enforced by the orchestrator's subprocess
+# timeouts, so a stage hung inside an XLA compile is killed and
+# reported instead of eating the run. First run on a fresh machine
+# pays the ~3-4 min compiles (stages may report deadline_hit — still
+# green: the contract is "always parseable", not "always fast"); the
+# persistent compilation cache under BENCH_WARM_DIR makes later runs
+# take seconds. CI budget = total deadline + grace.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 DEADLINE="${BENCH_DEADLINE_S:-540}"
+STAGE_DEADLINE="${BENCH_STAGE_DEADLINE_S:-240}"
 WARM_DIR="${BENCH_WARM_DIR:-${HOME}/.cache/fabric_tpu_warmkeys}"
 OUT="$(mktemp)"
 SIDECAR="${BENCH_SIDECAR:-$(mktemp -u)/bench_detail.json}"
 mkdir -p "$(dirname "$SIDECAR")"
 trap 'rm -f "$OUT"' EXIT
 
-# grace on top of the self-deadline: the watchdog must win this race.
-# set +e around the pipeline — under set -e/pipefail a failing bench
-# would abort the script before the rc attribution below ever runs
+# the bounded multichip stage: force an 8-device CPU host platform so
+# core_alldev + the scaling line run everywhere (strip any caller
+# forcing first)
+FLAGS=""
+for f in ${XLA_FLAGS:-}; do
+    case "$f" in
+        --xla_force_host_platform_device_count*) ;;
+        *) FLAGS="$FLAGS $f" ;;
+    esac
+done
+FLAGS="$FLAGS --xla_force_host_platform_device_count=8"
+
+# grace on top of the self-deadline: the orchestrator must win this
+# race. set +e around the pipeline — under set -e/pipefail a failing
+# bench would abort the script before the rc attribution below runs
 set +e
 timeout -k 30 "$((${DEADLINE%.*} + 120))" \
-    env JAX_PLATFORMS=cpu BENCH_SMOKE=1 \
+    env JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    XLA_FLAGS="$FLAGS" BENCH_SMOKE=1 \
     BENCH_DEADLINE_S="$DEADLINE" \
+    BENCH_STAGE_DEADLINE_S="$STAGE_DEADLINE" \
     BENCH_WARM_DIR="$WARM_DIR" \
     BENCH_SIDECAR="$SIDECAR" \
     python bench.py | tee "$OUT"
@@ -42,25 +62,48 @@ import json, sys
 out_path, sidecar = sys.argv[1], sys.argv[2]
 lines = [ln for ln in open(out_path).read().splitlines() if ln.strip()]
 assert lines, "bench printed nothing"
-final = lines[-1]
-obj = json.loads(final)          # the driver's parse, exactly
-assert obj.get("unit") == "sigs/s", obj
-assert len(final) < 4096, f"final line not compact: {len(final)}B"
-for v in obj.values():
-    assert not isinstance(v, dict), "nested object on the final line"
-n_json = sum(1 for ln in lines
-             if ln.startswith("{") and ln.endswith("}"))
-assert n_json == 1, f"expected exactly one JSON line, saw {n_json}"
-if obj.get("deadline_hit"):
-    print("bench_smoke: deadline hit — line still parseable", obj)
+json_lines = [json.loads(ln) for ln in lines
+              if ln.startswith("{") and ln.endswith("}")]
+assert json_lines, "no JSON lines at all"
+
+final = json_lines[-1]           # the driver's parse, exactly
+assert final.get("unit") == "sigs/s", final
+assert "stage" not in final, "final line must be the aggregate"
+assert len(lines[-1]) < 4096, f"final line not compact: {len(lines[-1])}B"
+for v in final.values():
+    assert not isinstance(v, (dict, list)), \
+        f"nested container on the final line: {v!r}"
+
+# every stage reported its own line
+stages = {}
+for obj in json_lines[:-1]:
+    assert "stage" in obj, f"non-final JSON line without stage: {obj}"
+    stages[obj["stage"]] = obj
+for want in ("multichip", "full_pipeline"):
+    assert want in stages, f"stage {want!r} never reported: {sorted(stages)}"
+assert any(s.startswith("core") or s in ("provider_e2e", "kernel_steady")
+           for s in stages), f"no core stage line: {sorted(stages)}"
+
+if final.get("deadline_hit") or any(
+        o.get("deadline_hit") or o.get("timeout") for o in stages.values()):
+    print("bench_smoke: a deadline was hit (cold compile?) — "
+          "all lines still parseable:", sorted(stages))
     sys.exit(0)
-detail = json.load(open(obj["sidecar"]))
-stats = detail["provider_stats"]
-assert stats["pipeline_batches"] > 0, "pipeline path never ran"
-assert stats["pipeline_overlap_ratio"] > 0, stats
+
+assert final.get("value"), final
+detail = json.load(open(final["sidecar"]))
+core1 = (detail.get("stage_detail") or {}).get("core_1dev") or {}
+stats = core1.get("provider_stats") or {}
+assert stats.get("pipeline_batches", 0) > 0, "pipeline path never ran"
+assert stats.get("pipeline_overlap_ratio", 0) > 0, stats
+mc = stages.get("multichip") or {}
+if mc.get("ok"):
+    print("bench_smoke: multichip scaling",
+          mc.get("tpu_steady_scaling_x"), "x over",
+          mc.get("devices"), "devices")
 print("bench_smoke: ok —",
       {k: stats[k] for k in ("pipeline_batches", "pipeline_chunks",
                              "pipeline_overlap_ratio")},
-      "value:", obj.get("value"))
+      "value:", final.get("value"))
 EOF
 echo "bench_smoke: green"

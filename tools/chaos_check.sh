@@ -10,7 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -73,6 +73,19 @@ commit() {
         tests/test_commit_pipeline.py -k "Parity or GossipState or Deliver"
 }
 
+shard() {
+    # sharded dispatch under fire: tpu.dispatch fires once per sharded
+    # batch exactly like the single-chip path; breaker fallback must
+    # keep every accept/reject bitmap bit-identical. The parity tests
+    # pin stats and clear ambient arming; the multi-process case
+    # inherits FTPU_FAULTS into its child (faulted sharded dispatches
+    # serve sw — parity still binds), and TestShardedFaults arms the
+    # point explicitly either way.
+    run "tpu.dispatch=error:2" tests/test_shard_verify.py
+    run "tpu.dispatch=delay:2:0.05" tests/test_shard_verify.py \
+        -k "Faults or MultiProcess"
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -85,8 +98,9 @@ case "${1:-all}" in
     deliver) deliver ;;
     onboarding) onboarding ;;
     commit) commit ;;
+    shard) shard ;;
     static) static ;;
-    all) bccsp; raft; deliver; onboarding; commit; static ;;
+    all) bccsp; raft; deliver; onboarding; commit; shard; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

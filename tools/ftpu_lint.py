@@ -31,6 +31,14 @@ on the flagged line or the line above; the reason is mandatory):
                  stall the overlapped device spans; the deliberate
                  end-of-span materialization points carry
                  allow-host-sync waivers
+  hot-path-coverage
+                 the dispatch spans named in REQUIRED_HOT_PATHS (the
+                 overlapped/sharded verify spans in bccsp/tpu.py, the
+                 commit-pipeline validate worker) must exist and carry
+                 the `@hot_path` decorator — dropping it silently
+                 disarms the host-sync rule for exactly the code it
+                 was written for (no waiver: the registry IS the
+                 waiver; update it on a rename)
 
 Usage:
   python tools/ftpu_lint.py [--root DIR] [--rules r1,r2] [files...]
@@ -48,7 +56,19 @@ import sys
 from dataclasses import dataclass
 
 ALL_RULES = ("fault-point", "metric-drift", "silent-swallow",
-             "host-sync")
+             "host-sync", "hot-path-coverage")
+
+# The spans the host-sync rule exists FOR: every overlapped/sharded
+# device-dispatch span. A span here without @hot_path is a finding —
+# removing the decorator would silently disarm host-sync checking on
+# the exact code paths where a stray host sync stalls the pipeline.
+REQUIRED_HOT_PATHS = {
+    "fabric_tpu/bccsp/tpu.py": (
+        "_dispatch_arrays", "_verify_batch_pipelined",
+        "_dispatch_comb_digest", "_dispatch_comb", "_shard_put",
+    ),
+    "fabric_tpu/core/commitpipeline.py": ("_validate_one",),
+}
 
 _WAIVER_RE = re.compile(
     r"#\s*ftpu-lint:\s*allow-([a-z-]+)\(\s*(.*?)\s*\)?\s*$")
@@ -279,6 +299,37 @@ def _host_sync_findings(rel, tree, waivers):
     return out
 
 
+# -- rule: hot-path-coverage --
+
+def _hot_coverage_findings(rel, tree):
+    want = REQUIRED_HOT_PATHS.get(rel.replace(os.sep, "/"))
+    if not want:
+        return []
+    out = []
+    fns: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+    for name in want:
+        fn = fns.get(name)
+        if fn is None:
+            out.append(Finding(
+                rel, 1, "hot-path-coverage",
+                f"required @hot_path span `{name}` no longer exists — "
+                f"if it was renamed, update REQUIRED_HOT_PATHS in "
+                f"tools/ftpu_lint.py so the host-sync rule keeps "
+                f"covering it"))
+        elif not any(_is_hot_path_decorator(d)
+                     for d in fn.decorator_list):
+            out.append(Finding(
+                rel, fn.lineno, "hot-path-coverage",
+                f"dispatch span `{name}` must carry @hot_path "
+                f"(fabric_tpu/common/hotpath.py): without it the "
+                f"host-sync rule is silently disarmed on the code it "
+                f"was written for"))
+    return out
+
+
 # -- rule: metric-drift --
 
 def _metric_drift_findings(root):
@@ -352,6 +403,8 @@ def run_lint(root: str, rules=ALL_RULES, files=None) -> list:
             findings += _swallow_findings(rel, tree, waivers)
         if "host-sync" in rules:
             findings += _host_sync_findings(rel, tree, waivers)
+        if "hot-path-coverage" in rules:
+            findings += _hot_coverage_findings(rel, tree)
     if "metric-drift" in rules and not files:
         findings += _metric_drift_findings(root)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
