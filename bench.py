@@ -1212,6 +1212,29 @@ def stage_pipeline():
         _PARTIAL["commit_pipeline"] = commitpipe
         detail["commit_pipeline"] = commitpipe
 
+    # wheel-free (stub x509/MSP seam): runs by default, so every round
+    # reports the ordering bottleneck beside peer validation; a skip
+    # is recorded explicitly so the smoke gate can tell "didn't run"
+    # from "ran but lost its fields"
+    if os.environ.get("BENCH_ORDER_PIPELINE", "1") != "1":
+        orderpipe = {"skipped": "BENCH_ORDER_PIPELINE!=1"}
+    elif _remaining() <= 30:
+        orderpipe = {"skipped": "time budget exhausted"}
+    else:
+        try:
+            import bench_pipeline
+            orderpipe = bench_pipeline.order_pipeline_run(
+                prov,
+                ntxs=int(os.environ.get(
+                    "BENCH_ORDER_TXS", "192" if SMOKE else "1024")),
+                window=int(os.environ.get("BENCH_ORDER_WINDOW", "64")),
+                block_txs=int(os.environ.get(
+                    "BENCH_ORDER_BLOCK_TXS", "64" if SMOKE else "256")))
+        except Exception as e:          # noqa: BLE001
+            orderpipe = {"error": f"{type(e).__name__}: {e}"}
+    _PARTIAL["order_pipeline"] = orderpipe
+    detail["order_pipeline"] = orderpipe
+
     idemix = None
     if want("BENCH_IDEMIX"):
         try:
@@ -1257,6 +1280,12 @@ def stage_pipeline():
         res["commit_pipeline_overlap_ratio"] = \
             commitpipe["overlap_ratio"]
         res["commit_pipeline_speedup"] = commitpipe["speedup"]
+    if orderpipe and "order_raft_s" in orderpipe:
+        res["order_raft_s"] = orderpipe["order_raft_s"]
+        res["order_tx_per_s"] = orderpipe["order_tx_per_s"]
+        res["order_vs_validate"] = orderpipe["order_vs_validate"]
+    elif orderpipe and "skipped" in orderpipe:
+        res["order_skipped"] = orderpipe["skipped"]
     if pipeline and "tpu_peer_block_s" in pipeline:
         res["e2e_tpu_peer_block_s"] = pipeline["tpu_peer_block_s"]
     emit_final(res, detail)

@@ -318,6 +318,446 @@ def run(tpu_csp, ntxs: int = 1024, endorsements: int = 2) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Round-10 batched ordering: the wheel-free stub-seam harness.
+#
+# `run()` above exercises the REAL x509/MSP/channel-config stack and
+# therefore needs the 'cryptography' wheel (cert generation); on hosts
+# without it the ordering bottleneck would go unmeasured. The helpers
+# below rebuild the same single-node etcdraft ordering service with
+# ONLY those wheel-bound layers stubbed: real P-256 envelope
+# signatures (pure-python backend), the real batched StandardChannel
+# sig-filter over the provider's AdmissionWindow, the real
+# blockcutter, RaftChain/RaftNode/WAL, BlockWriteStage and BlockWriter
+# (signed blocks, batched self-verify). tests/test_order_pipeline.py
+# drives the same harness deterministically.
+# ---------------------------------------------------------------------------
+
+
+def make_order_client(channel: str = "orderbench"):
+    """Creator-side material for the stub ordering service: one REAL
+    P-256 keypair, a protoutil-compatible signer, and an envelope
+    factory. Pass the same client to twin services so an identical
+    envelope stream can be replayed through both (bit-identity
+    checks compare the resulting block streams)."""
+    import hashlib
+    import types
+
+    from fabric_tpu.bccsp import ECDSAKeyGenOpts, VerifyItem
+    from fabric_tpu.bccsp.sw import SWProvider
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protoutil import protoutil as pu
+
+    sw = SWProvider()
+    key = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+    pub = key.public_key()
+    creator = b"order-bench-client"
+
+    class _Signer:
+        def serialize(self):
+            return creator
+
+        def sign(self, msg: bytes) -> bytes:
+            return sw.sign(key, hashlib.sha256(msg).digest())
+
+        def verify_item(self, msg: bytes, sig: bytes) -> VerifyItem:
+            return VerifyItem(key=pub, signature=sig, message=msg)
+
+    signer = _Signer()
+
+    def envelope(i: int, payload: bytes = None) -> cpb.Envelope:
+        ch = pu.make_channel_header(
+            cpb.HeaderType.ENDORSER_TRANSACTION, channel,
+            tx_id=f"obench{i}")
+        sh = pu.create_signature_header(creator, pu.random_nonce())
+        return pu.sign_or_panic(signer, pu.make_payload(
+            ch, sh, payload if payload is not None
+            else f"tx{i}".encode()))
+
+    return types.SimpleNamespace(channel=channel, sw=sw, key=key,
+                                 pub=pub, creator=creator,
+                                 signer=signer, envelope=envelope)
+
+
+def make_order_support(root: str, client=None, csp=None,
+                       channel: str = "orderbench",
+                       block_txs: int = 64,
+                       batch_timeout_s: float = 30.0,
+                       endpoints=("orderer0.example.com:7050",),
+                       on_config=None):
+    """A wheel-free `ChainSupport` twin: real OrdererLedger (block
+    store + raft WAL keyspaces), real blockcutter, real BlockWriter
+    (signed blocks, batched self-verify through `csp`), real
+    StandardChannel whose batched sig-filter rides the provider's
+    AdmissionWindow, and a real SignaturePolicy — only the
+    x509/MSP/channel-config layers are replaced by a stub bundle whose
+    consenter set is `endpoints`. A committed config block bumps the
+    stub's config sequence (so later stale-seq envelopes exercise the
+    batched revalidation path) and calls `on_config(support, block)` —
+    the reconfiguration seam: mutate `support.orderer_config` there
+    (e.g. rotate consenter certs). The returned support's `.chain` is
+    None until a RaftChain is attached (see `make_order_service`)."""
+    import hashlib
+    import types
+
+    from fabric_tpu.bccsp import ECDSAKeyGenOpts, VerifyItem
+    from fabric_tpu.bccsp.admission import AdmissionWindow
+    from fabric_tpu.common.policies.cauthdsl import SignaturePolicy
+    from fabric_tpu.orderer import blockcutter
+    from fabric_tpu.orderer.blockwriter import BlockWriter
+    from fabric_tpu.orderer.msgprocessor import StandardChannel
+    from fabric_tpu.orderer.multichannel import OrdererLedger
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protos import configtx as ctxpb
+    from fabric_tpu.protos import policies as polpb
+    from fabric_tpu.protoutil import protoutil as pu
+
+    if client is None:
+        client = make_order_client(channel)
+    sw = client.sw
+    provider = csp if csp is not None else sw
+    ingress = AdmissionWindow.shared(provider)
+
+    okey = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+    opub = okey.public_key()
+
+    class _OrdererSigner:
+        def serialize(self):
+            return b"order-bench-orderer"
+
+        def sign(self, msg: bytes) -> bytes:
+            return sw.sign(okey, hashlib.sha256(msg).digest())
+
+        def verify_item(self, msg: bytes, sig: bytes) -> VerifyItem:
+            return VerifyItem(key=opub, signature=sig, message=msg)
+
+    class _Identity:
+        def mspid(self):
+            return "BenchMSP"
+
+        def satisfies_principal(self, principal):
+            return None
+
+        def verify_item(self, msg: bytes, sig: bytes) -> VerifyItem:
+            return VerifyItem(key=client.pub, signature=sig,
+                              message=msg)
+
+    class _Deserializer:
+        def deserialize_identity(self, raw: bytes):
+            if raw != client.creator:
+                raise ValueError("unknown creator")
+            return _Identity()
+
+    def consensus_metadata(cert_suffix: bytes = b"") -> bytes:
+        meta = ctxpb.ConsensusMetadata()
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            c = meta.consenters.add()
+            c.host, c.port = host, int(port)
+            c.client_tls_cert = (b"stub-cert-" + ep.encode() +
+                                 cert_suffix)
+        return pu.marshal(meta)
+
+    pol_env = polpb.SignaturePolicyEnvelope()
+    pol_env.rule.signed_by = 0
+    pol_env.identities.add()
+    policy = SignaturePolicy(pol_env, _Deserializer(), ingress)
+
+    class _PolicyManager:
+        def get_policy(self, name):
+            return policy
+
+    orderer_cfg = types.SimpleNamespace(
+        consensus_type="etcdraft",
+        consensus_state=0,
+        consensus_metadata=consensus_metadata(),
+        consensus_metadata_fn=consensus_metadata,
+        batch_size=types.SimpleNamespace(
+            max_message_count=block_txs,
+            absolute_max_bytes=1 << 30,
+            preferred_max_bytes=1 << 20),
+        batch_timeout_s=batch_timeout_s)
+    bundle = types.SimpleNamespace(orderer=orderer_cfg,
+                                   policy_manager=_PolicyManager())
+
+    signer = _OrdererSigner()
+    ledger = OrdererLedger(os.path.join(root, "ledger"))
+    if ledger.height == 0:
+        # deterministic stub genesis (twin services must agree on the
+        # prev-hash of block 1): zeroed timestamp, empty nonce, no
+        # signature — is_config_block only reads the channel header
+        ch = pu.make_channel_header(cpb.HeaderType.CONFIG, channel)
+        ch.timestamp = 0
+        sh = pu.create_signature_header(signer.serialize(), b"")
+        genesis = pu.new_block(0, b"")
+        genesis.data.data.append(pu.marshal(cpb.Envelope(
+            payload=pu.marshal(pu.make_payload(ch, sh,
+                                               b"stub-genesis")))))
+        genesis.header.data_hash = pu.block_data_hash(genesis.data)
+        ledger.add_block(genesis)
+
+    class _StubSupport:
+        """ChainSupport duck-type over the stub bundle."""
+
+        def __init__(self):
+            self.channel_id = channel
+            self.ledger = ledger
+            self.signer = signer
+            self.client = client
+            self.orderer_config = orderer_cfg
+            self.on_config = on_config
+            self.chain = None
+            self._sequence = 0
+            self._last_config = 0
+            self.cutter = blockcutter.Receiver(self._batch_config)
+            self.writer = BlockWriter(
+                ledger, signer,
+                last_block=ledger.get_block(ledger.height - 1),
+                csp=provider)
+            self.ingress_csp = ingress
+            self.processor = StandardChannel(channel, self)
+
+        def bundle(self):
+            return bundle
+
+        def configtx_validator(self):
+            return self   # duck-type: only .sequence() is consulted
+
+        def sequence(self) -> int:
+            return self._sequence
+
+        @property
+        def csp(self):
+            return provider
+
+        def _batch_config(self):
+            bs = self.orderer_config.batch_size
+            return blockcutter.BatchConfig(
+                max_message_count=bs.max_message_count,
+                absolute_max_bytes=bs.absolute_max_bytes,
+                preferred_max_bytes=bs.preferred_max_bytes)
+
+        @property
+        def batch_timeout_s(self) -> float:
+            return self.orderer_config.batch_timeout_s
+
+        def write_block(self, block, consenter_metadata=b"") -> None:
+            self.writer.write_block(
+                block, consenter_metadata,
+                last_config_number=self._last_config)
+
+        def write_blocks(self, blocks,
+                         consenter_metadata=b"") -> None:
+            self.writer.write_blocks(
+                blocks, consenter_metadata,
+                last_config_number=self._last_config)
+
+        def write_config_block(self, block,
+                               consenter_metadata=b"") -> None:
+            self.writer.write_block(
+                block, consenter_metadata,
+                last_config_number=block.header.number)
+            self._last_config = block.header.number
+            self._sequence += 1
+            if self.on_config is not None:
+                self.on_config(self, block)
+
+        def close(self):
+            self.ledger.close()
+
+    return _StubSupport()
+
+
+def make_order_service(root: str, client=None, csp=None,
+                       channel: str = "orderbench",
+                       block_txs: int = 64,
+                       batch_timeout_s: float = 30.0,
+                       endpoint: str = "orderer0.example.com:7050",
+                       endpoints=None, net=None,
+                       write_pipeline=None, start: bool = True,
+                       tick_interval_s: float = 0.02,
+                       election_tick: int = 8, on_config=None):
+    """A raft ordering service over `make_order_support`: single-node
+    by default, multi-consenter when `net` + `endpoints` are shared
+    across calls. `start=False` leaves the ready loop unstarted so
+    tests can drive the chain deterministically (tick/elect, feed
+    `_process_order_window`, `_drain_ready`). `close(flush=False)` is
+    crash-equivalent: the write stage is abandoned, committed-but-
+    unwritten entries stay in the raft WAL and replay on the next
+    service built over the same `root`."""
+    import types
+
+    from fabric_tpu.orderer.broadcast import BroadcastHandler
+    from fabric_tpu.orderer.cluster import LocalClusterNetwork
+    from fabric_tpu.orderer.raft.chain import RaftChain
+
+    if net is None:
+        net = LocalClusterNetwork()
+    eps = tuple(endpoints) if endpoints else (endpoint,)
+    support = make_order_support(
+        root, client=client, csp=csp, channel=channel,
+        block_txs=block_txs, batch_timeout_s=batch_timeout_s,
+        endpoints=eps, on_config=on_config)
+    transport = net.register(endpoint)
+    chain = RaftChain(support, transport,
+                      tick_interval_s=tick_interval_s,
+                      election_tick=election_tick,
+                      write_pipeline=write_pipeline)
+    support.chain = chain
+
+    class _Registrar:
+        def get_chain(self, cid):
+            return support if cid == channel else None
+
+    broadcast = BroadcastHandler(_Registrar())
+    if start:
+        chain.start()
+
+    def close(flush: bool = True) -> None:
+        try:
+            if flush:
+                chain.halt()
+            else:
+                # crash-sim: stop the loop without flushing the write
+                # stage; its worker may be wedged mid-span — unwritten
+                # blocks replay from the WAL at the next start
+                chain._halted.set()
+                try:
+                    chain._events.put_nowait(None)
+                except Exception:     # noqa: BLE001
+                    pass
+                if chain._thread is not None:
+                    chain._thread.join(timeout=5)
+        finally:
+            try:
+                transport.close()
+            except Exception:         # noqa: BLE001
+                pass
+            support.close()
+
+    return types.SimpleNamespace(support=support, chain=chain,
+                                 transport=transport, net=net,
+                                 broadcast=broadcast,
+                                 client=support.client, close=close)
+
+
+def order_pipeline_run(csp=None, ntxs: int = 1024,
+                       window: int = 256,
+                       block_txs: int = 256) -> dict:
+    """ISSUE 7 scenario: the batched raft ordering pipeline, wheel-free
+    (stub x509/MSP seam, pure-python P-256 when the OpenSSL wheel is
+    absent) so the bounded default bench can always report the
+    ordering bottleneck. Stands up a REAL single-node etcdraft
+    ordering service (WAL, ready loop, admission window, block-write
+    stage, signed blocks), broadcasts `ntxs` creator-signed envelopes
+    through the windowed ingest, and times `order_raft_s` from first
+    submission to every block durable. The `order_vs_validate` ratio
+    divides that by a peer-validation equivalent — ONE batched
+    `verify_batch` over the same `ntxs` signatures on the same
+    provider — so the driver sees how far ordering still trails
+    validation (ROADMAP item 2's ~2x target), independent of how fast
+    this host's crypto backend happens to be."""
+    import shutil
+
+    from fabric_tpu.bccsp import VerifyItem
+    from fabric_tpu.protos import common as cpb
+
+    root = tempfile.mkdtemp(prefix="bench_order_")
+    svc = None
+    try:
+        svc = make_order_service(root, csp=csp, block_txs=block_txs,
+                                 batch_timeout_s=30.0)
+        client = svc.client
+
+        # ---- creator-signed envelopes (CPU signing, untimed) ----
+        t0 = time.perf_counter()
+        envs = [client.envelope(i) for i in range(ntxs)]
+        sign_s = time.perf_counter() - t0
+
+        # wait out the single-node election so the timed run measures
+        # ordering, not retry sleeps
+        deadline0 = time.monotonic() + 60
+        while svc.chain.node.leader_id != svc.chain.node_id:
+            if time.monotonic() > deadline0:
+                raise RuntimeError("no raft leader after 60s")
+            time.sleep(0.01)
+
+        # ---- the timed ordering run ----
+        t0 = time.perf_counter()
+        pos = 0
+        while pos < len(envs):
+            resps = svc.broadcast.process_messages(
+                envs[pos:pos + window])
+            ok = 0
+            for resp in resps:
+                if resp.status == cpb.Status.SUCCESS:
+                    ok += 1
+                elif resp.status == cpb.Status.SERVICE_UNAVAILABLE:
+                    break    # transient leadership wobble: retry tail
+                else:
+                    raise RuntimeError(f"broadcast rejected: "
+                                       f"{resp.status} {resp.info}")
+            pos += ok
+            if ok == 0:
+                if time.monotonic() > deadline0:
+                    raise RuntimeError("broadcast unavailable for 60s")
+                time.sleep(0.02)
+        ledger = svc.support.ledger
+        deadline = time.monotonic() + 600
+        while True:
+            blocks = [ledger.get_block(n)
+                      for n in range(1, ledger.height)]
+            got = sum(len(b.data.data) for b in blocks
+                      if b is not None)
+            if got >= ntxs and all(b is not None for b in blocks):
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(f"ordering stalled: {got}/{ntxs} "
+                                   f"at height {ledger.height}")
+            time.sleep(0.02)
+        order_s = time.perf_counter() - t0
+
+        # ---- the peer-validation equivalent on the SAME provider ----
+        provider = svc.support.csp
+        items = [VerifyItem(key=client.pub, signature=e.signature,
+                            message=e.payload) for e in envs]
+        provider.verify_batch(items[:min(64, ntxs)])   # warm
+        t0 = time.perf_counter()
+        ok = provider.verify_batch(items)
+        validate_s = max(time.perf_counter() - t0, 1e-9)
+        if not all(ok):
+            raise RuntimeError("validate-equivalent rejected lanes")
+
+        stats = svc.chain.order_pipeline_stats()
+        win = getattr(svc.support.ingress_csp, "stats", {})
+        return {
+            "ntxs": ntxs, "window": window, "block_txs": block_txs,
+            "blocks": len(blocks), "sign_s": round(sign_s, 2),
+            "order_raft_s": round(order_s, 3),
+            "order_tx_per_s": round(ntxs / order_s, 1),
+            "validate_equiv_s": round(validate_s, 4),
+            "order_vs_validate": round(order_s / validate_s, 2),
+            "batch_fill": stats.get("fill"),
+            "windows": stats.get("windows"),
+            "blocks_proposed": stats.get("blocks_proposed"),
+            "blocks_written": stats.get("blocks_written"),
+            "write_overlap_ratio": round(
+                stats.get("overlap_ratio") or 0.0, 4),
+            "steps_coalesced": stats.get("steps_coalesced"),
+            "demotions": stats.get("demotions"),
+            "ingress_window_dispatches": win.get("window_dispatches"),
+            "ingress_window_callers": win.get("window_callers"),
+            "filter_backend": type(provider).__name__,
+        }
+    finally:
+        if svc is not None:
+            try:
+                svc.close(flush=True)
+            except Exception:         # noqa: BLE001
+                pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _have_openssl_cp() -> bool:
     try:
         from fabric_tpu.bccsp._crypto_compat import HAVE_CRYPTOGRAPHY

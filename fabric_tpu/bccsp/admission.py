@@ -1,0 +1,132 @@
+"""Micro-batched verify admission window over a BCCSP provider.
+
+The orderer's broadcast ingress verifies signatures in whatever shape
+the gRPC streams deliver them: a 512-envelope window from a batching
+client goes to the device as one `verify_batch`, but a fleet of
+single-envelope submitters (the "millions of users" shape) arrives as
+a storm of 1–2-item calls — each paying a full device dispatch, the
+exact per-message cost arXiv:2302.00418 measures dominating consensus
+at scale. `AdmissionWindow` coalesces them:
+
+  * a caller whose `verify_batch` finds the window idle dispatches
+    immediately — ZERO added latency on the quiet path;
+  * callers arriving while a dispatch is in flight queue up; when the
+    dispatch returns, the next caller becomes the leader and takes the
+    ENTIRE accumulated queue to the provider in one call — convoy
+    batching, with the device's own latency as the (self-tuning)
+    admission window.
+
+Every caller gets exactly its own verdicts back, in order. The window
+adds NO policy of its own: it delegates to the wrapped provider's
+`verify_batch`, so the TPU provider's circuit breaker, deadline
+watchdog and sw fallback (round 1) govern the coalesced dispatch
+exactly as they govern a direct one. All other BCCSP methods pass
+through untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from fabric_tpu.common.hotpath import hot_path
+
+
+class _Pending:
+    __slots__ = ("items", "result", "error", "done")
+
+    def __init__(self, items):
+        self.items = items
+        self.result: Optional[list] = None
+        self.error: Optional[BaseException] = None
+        self.done = False
+
+
+class AdmissionWindow:
+    """Batch-coalescing facade over one BCCSP provider instance."""
+
+    _ATTR = "__ftpu_admission_window__"
+
+    def __init__(self, csp):
+        self._csp = csp
+        self._cond = threading.Condition()
+        self._queue: list[_Pending] = []
+        self._dispatching = False
+        self.stats = {
+            "window_dispatches": 0,   # provider verify_batch calls
+            "window_items": 0,        # signature lanes dispatched
+            "window_callers": 0,      # verify_batch calls coalesced
+        }
+
+    @classmethod
+    def shared(cls, csp) -> "AdmissionWindow":
+        """The per-provider window (one admission queue per session
+        provider, however many channels share it). Stored on the
+        provider object so its lifetime — and the coalescing scope —
+        is exactly the provider's."""
+        if isinstance(csp, cls):
+            return csp
+        win = getattr(csp, cls._ATTR, None)
+        if win is None:
+            win = cls(csp)
+            try:
+                setattr(csp, cls._ATTR, win)
+            except (AttributeError, TypeError):
+                pass   # slotted/frozen provider: per-call window
+        return win
+
+    # -- the batched seam --
+
+    def verify_batch(self, items) -> list[bool]:
+        items = list(items)
+        if not items:
+            return []
+        mine = _Pending(items)
+        with self._cond:
+            self._queue.append(mine)
+            while not mine.done and self._dispatching:
+                self._cond.wait(timeout=0.1)
+            if mine.done:
+                batch = None
+            else:
+                # the window is idle and my request is still queued:
+                # I lead — take everything accumulated so far
+                self._dispatching = True
+                batch, self._queue = self._queue, []
+        if batch is not None:
+            try:
+                self._dispatch_window(batch)
+            finally:
+                with self._cond:
+                    self._dispatching = False
+                    self._cond.notify_all()
+        if mine.error is not None:
+            raise mine.error
+        return mine.result
+
+    @hot_path
+    def _dispatch_window(self, batch) -> None:
+        """ONE provider dispatch for every caller in `batch`, verdicts
+        scattered back per caller. The provider's breaker/fallback
+        wraps the whole coalesced call."""
+        flat = [it for p in batch for it in p.items]
+        self.stats["window_dispatches"] += 1
+        self.stats["window_items"] += len(flat)
+        self.stats["window_callers"] += len(batch)
+        try:
+            ok = self._csp.verify_batch(flat)
+        except BaseException as e:   # noqa: BLE001 — every waiter must learn
+            for p in batch:
+                p.error = e
+                p.done = True
+            return
+        lo = 0
+        for p in batch:
+            p.result = list(ok[lo:lo + len(p.items)])
+            lo += len(p.items)
+            p.done = True
+
+    # -- everything else is the provider's --
+
+    def __getattr__(self, name):
+        return getattr(self._csp, name)

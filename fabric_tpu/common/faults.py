@@ -9,6 +9,10 @@ The well-known points:
     tpu.compile        jit pipeline builds / AOT compiles
     tpu.table_persist  warm-table byte writers
     raft.step          inbound raft messages (orderer raft chain loop)
+    order.propose      the batched propose span of the ordering
+                       admission window — a fault demotes the window
+                       to per-block sequential proposes
+                       (orderer/raft/chain.py)
     deliver.stream     the peer's block-deliver stream
     cluster.pull       onboarding/catch-up block pulls from consenters
     cluster.verify     pulled-span verification (orderer/onboarding.py)
@@ -70,6 +74,7 @@ KNOWN_POINTS = frozenset({
     "tpu.compile",
     "tpu.table_persist",
     "raft.step",
+    "order.propose",
     "deliver.stream",
     "cluster.pull",
     "cluster.verify",

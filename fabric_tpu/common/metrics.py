@@ -174,6 +174,39 @@ COMMIT_PIPELINE_BARRIER_TOTAL_OPTS = CounterOpts(
          "sequential-fallback demotion.",
     label_names=("channel", "reason"))
 
+ORDERER_BATCH_FILL_OPTS = GaugeOpts(
+    namespace="orderer", subsystem="batch", name="fill",
+    help="Envelopes carried by the most recent raft proposal cut from "
+         "the ordering admission window (how full the batched propose "
+         "path runs; 1 = the per-envelope floor).",
+    label_names=("channel",))
+
+ORDERER_BATCH_PROPOSE_SECONDS_OPTS = GaugeOpts(
+    namespace="orderer", subsystem="batch", name="propose_s",
+    help="Seconds the raft loop spent cutting and proposing the most "
+         "recent admission window (msgprocessor revalidation, "
+         "blockcutter pass, block assembly, one batched raft append).",
+    label_names=("channel",))
+
+ORDERER_BATCH_CONSENSUS_SECONDS_OPTS = GaugeOpts(
+    namespace="orderer", subsystem="batch", name="consensus_s",
+    help="Propose-to-commit seconds for the most recent block this "
+         "leader proposed (raft replication + majority ack latency).",
+    label_names=("channel",))
+
+ORDERER_BATCH_WRITE_SECONDS_OPTS = GaugeOpts(
+    namespace="orderer", subsystem="batch", name="write_s",
+    help="Seconds the write stage spent signing and appending the "
+         "most recent committed-block span (runs off the raft loop "
+         "on the block-write worker).", label_names=("channel",))
+
+ORDERER_BATCH_OVERLAP_RATIO_OPTS = GaugeOpts(
+    namespace="orderer", subsystem="batch", name="overlap_ratio",
+    help="Cumulative fraction of block-write time hidden behind the "
+         "raft loop's cut/consensus work: 0 = fully sequential "
+         "ordering, approaching 1 = writes fully hidden.",
+    label_names=("channel",))
+
 DELIVER_RECONNECTS_OPTS = CounterOpts(
     namespace="deliver", subsystem="client", name="reconnects",
     help="Deliver-stream reconnect attempts after a stream failure "

@@ -192,3 +192,59 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
     t = threading.Thread(target=poll, name="bccsp-stats", daemon=True)
     t.start()
     return t
+
+
+def publish_order_stats(metrics_provider, registrar, poll_s: float = 5.0):
+    """Expose every raft chain's ordering-pipeline readings as the
+    canonical `orderer_batch_{fill,propose_s,consensus_s,write_s,
+    overlap_ratio}` gauges (channel-labeled), refreshed by a daemon
+    poller — the batched-ordering perf counters (admission-window
+    fill, propose/consensus/write stage seconds, write-overlap ratio)
+    become scrapeable beside the `bccsp_*` gauges. `registrar` must
+    expose `channel_list()` + `get_chain(id)` (whose `.chain` may
+    implement `order_pipeline_stats()`; chains that don't — solo,
+    followers — are skipped). Returns the poller thread."""
+    from fabric_tpu.common import metrics as metrics_mod
+
+    if not hasattr(registrar, "channel_list"):
+        return None
+    gauges = {
+        "fill": metrics_provider.new_gauge(
+            metrics_mod.ORDERER_BATCH_FILL_OPTS),
+        "propose_s": metrics_provider.new_gauge(
+            metrics_mod.ORDERER_BATCH_PROPOSE_SECONDS_OPTS),
+        "consensus_s": metrics_provider.new_gauge(
+            metrics_mod.ORDERER_BATCH_CONSENSUS_SECONDS_OPTS),
+        "write_s": metrics_provider.new_gauge(
+            metrics_mod.ORDERER_BATCH_WRITE_SECONDS_OPTS),
+        "overlap_ratio": metrics_provider.new_gauge(
+            metrics_mod.ORDERER_BATCH_OVERLAP_RATIO_OPTS),
+    }
+
+    def poll():
+        warned: set = set()     # once per channel, not once per poll_s
+        while True:
+            for cid in registrar.channel_list():
+                support = registrar.get_chain(cid)
+                stats_fn = getattr(
+                    getattr(support, "chain", None),
+                    "order_pipeline_stats", None)
+                if stats_fn is None:
+                    continue
+                try:
+                    stats = stats_fn()
+                    for name, g in gauges.items():
+                        g.with_labels("channel", cid).set(
+                            float(stats.get(name, 0)))
+                except Exception as e:
+                    if cid not in warned:
+                        warned.add(cid)
+                        logger.warning(
+                            "orderer batch gauge publish for %r "
+                            "failed (suppressing repeats): %s", cid, e)
+            time.sleep(poll_s)
+
+    t = threading.Thread(target=poll, name="orderer-batch-stats",
+                         daemon=True)
+    t.start()
+    return t

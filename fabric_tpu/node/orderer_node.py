@@ -133,6 +133,9 @@ class OrdererNode:
              "kafka": _kafka_deprecated},
             metrics_provider=provider,
             cluster_transport=self.cluster)
+        # batched-ordering pipeline gauges (orderer_batch_*) beside
+        # the provider's bccsp_* ones
+        profiling.publish_order_stats(provider, self.registrar)
         from fabric_tpu.orderer.broadcast import BroadcastMetrics
         broadcast = BroadcastHandler(
             self.registrar, metrics=BroadcastMetrics(provider))

@@ -6,6 +6,15 @@ Rebuild of `orderer/common/multichannel/blockwriter.go`:
 orderer signs (metadata.value ‖ sig_header ‖ block_header_bytes) and
 stores the signature in the SIGNATURES metadata slot — exactly what the
 peer's `VerifyBlock` / `block_signature_set` checks).
+
+Round 10 adds the batched span path (`write_blocks`): the write
+pipeline hands a run of committed blocks here, every block is signed,
+and the produced metadata signatures are re-verified in ONE batched
+dispatch through the BCCSP provider seam before anything touches the
+store — the orderer's own signatures ride the same device batch path
+(breaker + sw fallback included, round 1) that peer validation uses,
+and a corrupted signer or warm-table can never append a block the
+peers would reject.
 """
 
 from __future__ import annotations
@@ -20,12 +29,15 @@ logger = logging.getLogger("orderer.blockwriter")
 
 
 class BlockWriter:
-    def __init__(self, block_store, signer, last_block=None):
+    def __init__(self, block_store, signer, last_block=None, csp=None):
         """`block_store` is an append-only store exposing
         `add_block(block)` + `get_block_by_number`; `signer` the
-        orderer's signing identity."""
+        orderer's signing identity; `csp` (optional) the provider the
+        batched span path self-verifies produced block signatures
+        through."""
         self._store = block_store
         self._signer = signer
+        self._csp = csp
         self._last = last_block
         self._lock = threading.Lock()
 
@@ -74,11 +86,72 @@ class BlockWriter:
             self._store.add_block(block)
             self._last = block
 
+    def write_blocks(self, blocks,
+                     consenter_metadata: bytes = b"",
+                     last_config_number: int = 0) -> None:
+        """The batched span path (the write pipeline's entry): sign
+        every block of a contiguous committed run, self-verify ALL the
+        produced metadata signatures in one `csp.verify_batch`
+        dispatch (when a provider was wired — the TPU path's breaker/
+        fallback semantics apply unchanged), then append the span.
+        Nothing touches the store until the whole span's signatures
+        check out — a bad signature surfaces as an error the pipeline
+        demotes on, never as an appended block peers would reject."""
+        blocks = list(blocks)
+        if not blocks:
+            return
+        with self._lock:
+            expect = None if self._last is None \
+                else self._last.header.number + 1
+            signed: list = []
+            for block in blocks:
+                if expect is not None and \
+                        block.header.number != expect:
+                    raise ValueError(
+                        f"writing block {block.header.number} out of "
+                        f"order (expected {expect})")
+                signed.append(self._add_metadata(
+                    block, consenter_metadata, last_config_number))
+                expect = block.header.number + 1
+        # verify OUTSIDE the lock: the batched check may be a device
+        # dispatch, and a lock held across one is exactly what the
+        # round-8 sanitizer exists to catch
+        self._self_verify(blocks, signed)
+        with self._lock:
+            if self._last is not None and \
+                    blocks[0].header.number != \
+                    self._last.header.number + 1:
+                raise ValueError(
+                    f"writing block {blocks[0].header.number} out of "
+                    f"order (last {self._last.header.number})")
+            for block in blocks:
+                self._store.add_block(block)
+                self._last = block
+
+    def _self_verify(self, blocks, signed) -> None:
+        """One batched provider dispatch over the span's fresh block
+        signatures (skipped without a csp, or for a signer that cannot
+        express verification items)."""
+        verify_item = getattr(self._signer, "verify_item", None)
+        if self._csp is None or verify_item is None:
+            return
+        ok = self._csp.verify_batch(
+            [verify_item(msg, sig) for msg, sig in signed])
+        if not all(ok):
+            bad = [b.header.number
+                   for b, good in zip(blocks, ok) if not good]
+            raise ValueError(
+                f"self-verification of fresh block signature(s) "
+                f"{bad} failed — refusing to append a span peers "
+                f"would reject")
+
     def _add_metadata(self, block: common.Block,
                       consenter_metadata: bytes,
-                      last_config_number: int) -> None:
+                      last_config_number: int) -> tuple[bytes, bytes]:
         """Reference: `addBlockSignature:208` — the signed payload is
-        (metadata.value ‖ signature_header ‖ block_header_bytes)."""
+        (metadata.value ‖ signature_header ‖ block_header_bytes).
+        Returns (signed_bytes, signature) so the batched span path can
+        re-verify the whole run in one provider dispatch."""
         sig_header = pu.create_signature_header(
             self._signer.serialize(), pu.random_nonce())
         md = common.Metadata()
@@ -97,3 +170,4 @@ class BlockWriter:
         n = len(block.data.data)
         block.metadata.metadata[
             common.BlockMetadataIndex.TRANSACTIONS_FILTER] = bytes(n)
+        return signed_bytes, ms.signature

@@ -190,7 +190,12 @@ class StandardChannel:
         except papi.PolicyError as e:
             err = PermissionDenied(f"no policy /Channel/Writers: {e}")
             return [(None, err)] * len(envs)
-        csp = getattr(self._support, "csp", None)
+        # prefer the provider's micro-batched admission window
+        # (bccsp/admission.py): concurrent ingest windows — and the
+        # single-envelope path — coalesce into one device dispatch
+        csp = getattr(self._support, "ingress_csp", None)
+        if csp is None:
+            csp = getattr(self._support, "csp", None)
         out: list = [None] * len(envs)
         prepared: list = []           # (env index, prepared policy eval)
         items: list = []

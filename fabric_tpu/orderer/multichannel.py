@@ -120,7 +120,16 @@ class ChainSupport:
         self.cutter = blockcutter.Receiver(
             self._batch_config, metrics_provider=metrics_provider,
             channel=channel_id)
-        self.writer = BlockWriter(ledger, signer, last_block=last)
+        self.writer = BlockWriter(ledger, signer, last_block=last,
+                                  csp=csp)
+        # broadcast-ingress signature checks ride the session
+        # provider's micro-batched admission window: a storm of
+        # single-envelope submitters coalesces into full device
+        # batches (bccsp/admission.py) — every channel on this node
+        # shares the provider's one window
+        from fabric_tpu.bccsp.admission import AdmissionWindow
+        self.ingress_csp = AdmissionWindow.shared(csp) \
+            if csp is not None else None
         self.processor = StandardChannel(channel_id, self)
         self.chain = consenter_factory(self)
         logger.info("[%s] chain support up at height %d "
@@ -189,6 +198,16 @@ class ChainSupport:
                     consenter_metadata: bytes = b"") -> None:
         self.writer.write_block(
             block, consenter_metadata,
+            last_config_number=self._last_config_number)
+
+    def write_blocks(self, blocks,
+                     consenter_metadata: bytes = b"") -> None:
+        """A contiguous committed span in one batched sign+verify pass
+        (the raft write pipeline's fast path; see
+        BlockWriter.write_blocks). Callers guarantee no config block
+        rides in the span — those go through write_config_block."""
+        self.writer.write_blocks(
+            blocks, consenter_metadata,
             last_config_number=self._last_config_number)
 
     def write_config_block(self, block: common.Block,

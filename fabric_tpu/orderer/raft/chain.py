@@ -17,20 +17,38 @@ consenter and verifies their signatures before appending
 Raft node IDs are the first 8 bytes of SHA-256(endpoint) — stable
 across membership changes without coordination (the reference persists
 an id↔consenter table in the block metadata instead).
+
+Round 10 rebuilt the hot path batch-first (the discipline that fixed
+verify in rounds 6/9, applied to ordering): each drained ready-loop
+tick becomes ONE admission window — stale envelopes revalidated in one
+batched msgprocessor pass, the whole window fed through the cutter,
+and every cut batch proposed through `RaftNode.propose_batch` (one WAL
+append, one replication fan-out). Committed blocks are signed and
+written off-loop by `pipeline.BlockWriteStage`, so block-cutting of
+window N+1 overlaps consensus on block N and the write of block N−1;
+config blocks, membership changes, log compaction and catch-up drain
+the stage first, and any stage failure demotes to the sequential path
+and heals through `_replay_committed` (crash-equivalent, bit-identical
+block stream).
 """
 
 from __future__ import annotations
 
 import hashlib
 import logging
+import os
 import queue
 import threading
 import time
 from typing import Optional
 
 from fabric_tpu.common import faults
+from fabric_tpu.common.hotpath import hot_path
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
 from fabric_tpu.orderer.raft.core import LEADER, RaftNode
+from fabric_tpu.orderer.raft.pipeline import (
+    BlockWriteStage, OrderWriteError,
+)
 from fabric_tpu.orderer.raft.storage import RaftStorage
 from fabric_tpu.protos import common, orderer as opb
 from fabric_tpu.protos import configtx as ctxpb, raft as rpb
@@ -192,7 +210,8 @@ class RaftChain:
 
     def __init__(self, support, transport, tick_interval_s: float = 0.1,
                  election_tick: int = 10, heartbeat_tick: int = 1,
-                 metrics_provider=None):
+                 metrics_provider=None,
+                 write_pipeline: Optional[bool] = None):
         self._support = support
         self._transport = transport
         self.endpoint = transport.endpoint
@@ -235,7 +254,28 @@ class RaftChain:
         self._metrics_provider = metrics_provider
         self._replicator = None   # lazy: built on first catch-up
         self.metrics.cluster_size.set(len(self._consenters))
+        # round-10 ordering-pipeline accounting (read by
+        # profiling.publish_order_stats and the bench)
+        self.order_stats = {
+            "windows": 0, "envelopes": 0, "blocks_proposed": 0,
+            "propose_s": 0.0, "consensus_s": 0.0,
+            "last_fill": 0, "last_propose_s": 0.0,
+            "last_consensus_s": 0.0,
+            "steps_coalesced": 0, "demotions": 0,
+        }
+        self._proposed_at: dict[int, float] = {}
+        # raft-loop busy window, read by the write stage's overlap
+        # accounting: (busy-since or None, last closed busy interval)
+        self._loop_busy_since: Optional[float] = None
+        self._loop_window: tuple[float, float] = (0.0, 0.0)
+        self._write_stage: Optional[BlockWriteStage] = None
         self._replay_committed()
+        if write_pipeline is None:
+            write_pipeline = os.environ.get(
+                "FTPU_ORDER_PIPELINE", "1") != "0"
+        if write_pipeline:
+            self._write_stage = BlockWriteStage(
+                support, loop_activity=self._loop_activity)
         transport.set_channel_auth(
             support.channel_id,
             parse_consenter_certs(
@@ -278,6 +318,16 @@ class RaftChain:
             pass
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._write_stage is not None:
+            # flush, don't abandon: committed blocks the stage still
+            # holds would otherwise only reappear at the next restart's
+            # replay (a clean halt should leave the ledger at the tip)
+            try:
+                self._write_stage.stop(flush=True)
+            except Exception as e:
+                logger.warning("[%s] halt: flushing write stage "
+                               "failed: %s", self._support.channel_id,
+                               e)
         try:
             self._transport.remove_handler(self._support.channel_id)
         except Exception as e:
@@ -288,7 +338,11 @@ class RaftChain:
         return self._halted.is_set()
 
     def order(self, env: common.Envelope, config_seq: int) -> None:
-        self._submit(env, config_seq, is_config=False)
+        """Single-envelope Order folds through the SAME batch
+        admission window as the bulk path: under load, the ready loop
+        drains a run of these into one window — a burst of unary
+        submitters never pays per-envelope consensus-event latency."""
+        self.order_batch([(env, config_seq)])
 
     def order_batch(self, envs_seqs) -> int:
         """A whole ingest window as ONE event: the broadcast layer's
@@ -409,10 +463,12 @@ class RaftChain:
     # ------------------------------------------------------------------
 
     def _handle_event(self, ev, now: float) -> None:
-        """One drained event. A failing raft step is a DROPPED message
-        (raft's retransmission recovers it), never a reason to abort
-        the rest of the drain's events; `raft.step` is the chaos point
-        that models message loss/corruption."""
+        """One drained non-ordering event (`order`/`order_batch` never
+        reach here — `_run` folds them into the tick's admission
+        window). A failing raft step is a DROPPED message (raft's
+        retransmission recovers it), never a reason to abort the rest
+        of the drain's events; `raft.step` is the chaos point that
+        models message loss/corruption."""
         if ev[0] == "step":
             try:
                 faults.check("raft.step")
@@ -421,11 +477,48 @@ class RaftChain:
             except Exception:
                 logger.exception("[%s] raft step failed; message "
                                  "dropped", self._support.channel_id)
-        elif ev[0] == "order":
-            self._process_order(ev[1], ev[2], ev[3])
-        elif ev[0] == "order_batch":
-            for env, seq in ev[1]:
-                self._process_order(env, seq, False)
+
+    def _coalesce_steps(self, evs: list) -> list:
+        """Merge superseded CONSECUTIVE step messages from the same
+        sender before stepping the state machine: an entry-less
+        APPEND/HEARTBEAT only resets the election clock and advances
+        the commit index — both carried (monotonically) by the newer
+        message of the run; a non-reject APPEND_RESP is an ack the
+        leader folds in with a monotonic max, so only the run's
+        highest ack matters. Entry-bearing APPENDs, votes, rejections
+        and cross-sender interleavings are never dropped — raft's own
+        retransmission covers any ack a drop skipped."""
+        out: list = []
+        dropped = 0
+        for ev in evs:
+            if ev[0] == "step" and out and out[-1][0] == "step":
+                prev, cur = out[-1][1], ev[1]
+                if cur.from_ == prev.from_ and \
+                        cur.term == prev.term and \
+                        cur.type == prev.type:
+                    if cur.type in (rpb.RaftMessage.APPEND,
+                                    rpb.RaftMessage.HEARTBEAT) and \
+                            not prev.entries and not cur.entries and \
+                            cur.commit >= prev.commit and \
+                            cur.prev_log_index >= prev.prev_log_index:
+                        out[-1] = ev
+                        dropped += 1
+                        continue
+                    if cur.type == rpb.RaftMessage.APPEND_RESP and \
+                            not prev.reject and not cur.reject and \
+                            cur.last_log_index >= prev.last_log_index:
+                        out[-1] = ev
+                        dropped += 1
+                        continue
+            out.append(ev)
+        if dropped:
+            self.order_stats["steps_coalesced"] += dropped
+        return out
+
+    def _loop_activity(self):
+        """The write stage's overlap probe: is the raft loop busy now,
+        and what was its last busy interval (perf_counter clock)."""
+        return self._loop_busy_since, self._loop_window
 
     def _run(self) -> None:
         next_tick = time.monotonic() + self._tick_s
@@ -454,10 +547,24 @@ class RaftChain:
                     self._halted.set()
                     break
                 evs.append(nxt)
+            self._loop_busy_since = time.perf_counter()
             try:
                 now = time.monotonic()
-                for ev in evs:
-                    self._handle_event(ev, now)
+                # the drained backlog becomes ONE admission window:
+                # steps coalesce, submissions merge into a single
+                # cutter/propose pass (preserving arrival order across
+                # the config-message run breaks)
+                window: list = []
+                for ev in self._coalesce_steps(evs):
+                    if ev[0] == "order":
+                        window.append((ev[1], ev[2], ev[3]))
+                    elif ev[0] == "order_batch":
+                        window.extend((env, seq, False)
+                                      for env, seq in ev[1])
+                    else:
+                        self._handle_event(ev, now)
+                if window:
+                    self._process_order_window(window)
                 if now >= next_tick:
                     self.node.tick()
                     next_tick = now + self._tick_s
@@ -467,6 +574,11 @@ class RaftChain:
                                 self._peer_seen.items()
                                 if ts >= horizon and
                                 nid in self._consenters))
+                    if self._write_stage is not None:
+                        try:
+                            self._write_stage.check_error()
+                        except OrderWriteError:
+                            self._demote_write_stage()
                 if self._timer_deadline is not None and \
                         now >= self._timer_deadline:
                     self._timer_deadline = None
@@ -475,6 +587,10 @@ class RaftChain:
             except Exception:
                 logger.exception("[%s] raft chain loop error",
                                  self._support.channel_id)
+            finally:
+                end = time.perf_counter()
+                self._loop_window = (self._loop_busy_since or end, end)
+                self._loop_busy_since = None
 
     def _drain_ready(self) -> None:
         ready = self.node.ready()
@@ -498,48 +614,156 @@ class RaftChain:
             # deposed: in-flight blocks die with the old term
             self._creator = None
             self._timer_deadline = None
+            self._proposed_at.clear()
 
-    # -- leader-side ordering --
+    # -- leader-side ordering (the admission window) --
 
-    def _process_order(self, env: common.Envelope, config_seq: int,
-                       is_config: bool) -> None:
+    def _process_order_window(self, window) -> None:
+        """One drained ready-loop tick's submissions as ONE ordering
+        pass: stale envelopes revalidate in a single batched
+        msgprocessor run (one device-batched sig-filter dispatch), the
+        whole window streams through the blockcutter, and every cut
+        batch rides one `_propose_batch` (one WAL append). Config
+        messages break the run — they flush pending work and get their
+        own block, preserving intra-channel arrival order exactly like
+        the per-envelope path."""
         support = self._support
         if self.node.state != LEADER:
             # deposed between submit and processing: re-route
-            try:
-                self._submit(env, config_seq, is_config)
-            except MsgProcessorError as e:
-                logger.warning("[%s] dropped message during leader "
-                               "change: %s", support.channel_id, e)
+            for env, seq, is_config in window:
+                try:
+                    self._submit(env, seq, is_config)
+                except MsgProcessorError as e:
+                    logger.warning("[%s] dropped message during leader "
+                                   "change: %s", support.channel_id, e)
             return
-        try:
+        t0 = time.perf_counter()
+        run: list = []          # (env, config_seq) normal-message run
+        batches: list = []      # cut batches awaiting one proposal
+
+        def flush_run() -> None:
+            nonlocal run
+            if not run:
+                return
+            for env in self._revalidate_run(run):
+                cut, _pending = support.cutter.ordered(env)
+                batches.extend(cut)
+            run = []
+
+        for env, seq, is_config in window:
             if is_config:
-                if config_seq < support.sequence():
-                    env, _ = support.processor.process_config_msg(env)
-                self._cut_and_propose(support.cutter.cut())
-                self._timer_deadline = None
-                self._propose_block([env])
+                flush_run()
+                # propose everything cut so far FIRST: the config
+                # block must land after the normal traffic that
+                # preceded it in the window
+                self._propose_batch(batches)
+                batches = []
+                try:
+                    self._process_config(env, seq)
+                except MsgProcessorError as e:
+                    logger.warning("[%s] message dropped during "
+                                   "ordering: %s", support.channel_id,
+                                   e)
             else:
-                if config_seq < support.sequence():
-                    support.processor.process_normal_msg(env)
-                batches, pending = support.cutter.ordered(env)
-                for batch in batches:
-                    self._cut_and_propose(batch)
-                if pending:
-                    if self._timer_deadline is None:
-                        self._timer_deadline = (
-                            time.monotonic() + support.batch_timeout_s)
-                else:
-                    self._timer_deadline = None
-        except MsgProcessorError as e:
-            logger.warning("[%s] message dropped during ordering: %s",
-                           support.channel_id, e)
+                run.append((env, seq))
+        flush_run()
+        self._propose_batch(batches)
+        if support.cutter.pending_count:
+            if self._timer_deadline is None:
+                self._timer_deadline = (
+                    time.monotonic() + support.batch_timeout_s)
+        else:
+            self._timer_deadline = None
+        dt = time.perf_counter() - t0
+        self.order_stats["windows"] += 1
+        self.order_stats["envelopes"] += len(window)
+        self.order_stats["propose_s"] += dt
+        self.order_stats["last_propose_s"] = dt
+
+    def _revalidate_run(self, run) -> list:
+        """Envelopes validated by broadcast under a since-changed
+        channel config must re-run the msgprocessor (reference
+        chain.go Order last_validation_seq) — here in ONE batched pass
+        for the window's whole stale set instead of per message.
+        Returns the envelopes still accepted, in order; rejected ones
+        are dropped with a warning (the per-envelope path's
+        behavior)."""
+        support = self._support
+        seq_now = support.sequence()
+        stale = [i for i, (_env, seq) in enumerate(run)
+                 if seq < seq_now]
+        if not stale:
+            return [env for env, _seq in run]
+        results = support.processor.process_normal_msgs(
+            [run[i][0] for i in stale])
+        dropped = set()
+        for i, (_seq, err) in zip(stale, results):
+            if err is not None:
+                dropped.add(i)
+                logger.warning("[%s] message dropped during ordering: "
+                               "%s", support.channel_id, err)
+        return [env for i, (env, _seq) in enumerate(run)
+                if i not in dropped]
+
+    def _process_config(self, env: common.Envelope,
+                        config_seq: int) -> None:
+        support = self._support
+        if config_seq < support.sequence():
+            env, _ = support.processor.process_config_msg(env)
+        self._cut_and_propose(support.cutter.cut())
+        self._timer_deadline = None
+        self._propose_batch([[env]])
 
     def _cut_and_propose(self, batch) -> None:
         if batch:
-            self._propose_block(list(batch))
+            self._propose_batch([list(batch)])
+
+    @hot_path
+    def _propose_batch(self, batches) -> None:
+        """The batched-propose span: every batch the admission window
+        cut becomes one raft entry, ALL entries appended through one
+        `_TimedStorage` WAL write and replicated in one fan-out
+        (`RaftNode.propose_batch`). The `order.propose` chaos point
+        guards the span — a fault fires BEFORE any state mutates and
+        demotes the window to the per-block sequential path, so a
+        batching failure can never lose envelopes."""
+        batches = [list(b) for b in batches if b]
+        if not batches:
+            return
+        try:
+            faults.check("order.propose")
+            if self._creator is None:
+                self._creator = self._creator_from_tail()
+            blocks = [self._creator.create(b) for b in batches]
+            n = self.node.propose_batch(
+                [b.SerializeToString() for b in blocks])
+        except Exception:
+            logger.warning(
+                "[%s] batched propose failed; demoting this window to "
+                "sequential per-block proposes",
+                self._support.channel_id, exc_info=True)
+            self.order_stats["demotions"] += 1
+            # the batched creator may have advanced past blocks that
+            # were never proposed: rebuild from the raft-log tail
+            self._creator = None
+            for batch in batches:
+                self._propose_block(batch)
+            return
+        if n < len(blocks):
+            logger.warning("[%s] %d proposal(s) dropped (not leader)",
+                           self._support.channel_id, len(blocks) - n)
+            self.metrics.proposal_failures.add(len(blocks) - n)
+            self._creator = None
+        now = time.perf_counter()
+        for block in blocks[:n]:
+            self._proposed_at[block.header.number] = now
+        self.order_stats["blocks_proposed"] += n
+        if n:
+            self.order_stats["last_fill"] = len(batches[n - 1])
 
     def _propose_block(self, envelopes) -> None:
+        """Sequential per-block propose — the pre-round-10 path, kept
+        as the demotion target of `_propose_batch`."""
         if self._creator is None:
             self._creator = self._creator_from_tail()
         block = self._creator.create(envelopes)
@@ -549,6 +773,10 @@ class RaftChain:
                            self._support.channel_id)
             self.metrics.proposal_failures.add(1)
             self._creator = None
+            return
+        self._proposed_at[block.header.number] = time.perf_counter()
+        self.order_stats["blocks_proposed"] += 1
+        self.order_stats["last_fill"] = len(envelopes)
 
     def _creator_from_tail(self) -> _BlockCreator:
         """New leader: continue after the last block in the raft log
@@ -574,6 +802,9 @@ class RaftChain:
 
     def _apply(self, entry: rpb.Entry) -> None:
         if entry.type == rpb.Entry.CONF_CHANGE:
+            # reconfiguration barrier: membership changes must observe
+            # the durable ledger tip
+            self._drain_write_stage("membership change")
             self._after_conf_change()
             return
         if not entry.data:
@@ -585,11 +816,24 @@ class RaftChain:
             logger.warning("[%s] undecodable raft entry %d",
                            self._support.channel_id, entry.index)
             return
+        t0 = self._proposed_at.pop(block.header.number, None)
+        if t0 is not None:
+            dt = time.perf_counter() - t0
+            self.order_stats["consensus_s"] += dt
+            self.order_stats["last_consensus_s"] = dt
         height = self._support.ledger.height
+        if self._write_stage is not None:
+            # blocks the write stage holds count as written: a
+            # re-applied entry for one is a duplicate, not a gap
+            height = self._write_stage.effective_tip(height)
         if block.header.number < height:
             return  # duplicate (replay)
         if block.header.number > height:
-            self._catch_up(height, block.header.number)
+            # the replicator appends to the ledger directly: it must
+            # see the durable tip, not race the async writer
+            self._drain_write_stage("snapshot catch-up")
+            self._catch_up(self._support.ledger.height,
+                           block.header.number)
             if self._support.ledger.height != block.header.number:
                 logger.error("[%s] catch-up to %d failed (at %d)",
                              self._support.channel_id,
@@ -599,6 +843,16 @@ class RaftChain:
         self._write_committed_block(block)
         self._applied_since_compact += 1
         if self._applied_since_compact >= COMPACT_EVERY:
+            # compaction barrier: an entry compacted away while its
+            # block is still in flight would be unrecoverable after a
+            # crash — drain first; on a stall, just postpone (the next
+            # applied entry retries)
+            if self._write_stage is not None:
+                try:
+                    if not self._write_stage.drain(timeout=5.0):
+                        return
+                except OrderWriteError:
+                    self._demote_write_stage()
             self._applied_since_compact = 0
             self.node.compact(self.node.applied_index,
                               self._support.ledger.height)
@@ -609,10 +863,73 @@ class RaftChain:
         self.metrics.committed_block_number.set(block.header.number)
         support = self._support
         if pu.is_config_block(block):
+            # config barrier: the reconfiguration below (and the
+            # bundle the NEXT message validates under) must observe
+            # every earlier block durably written
+            self._drain_write_stage("config block")
+            if block.header.number < support.ledger.height:
+                # the barrier demoted: _replay_committed already wrote
+                # this very entry (and ran the reconfiguration)
+                # through the sequential path — writing it again
+                # would be an out-of-order append
+                return
             support.write_config_block(block)
             self._reconfigure()
+        elif self._write_stage is not None:
+            try:
+                self._write_stage.submit(block)
+            except OrderWriteError:
+                # demotion replays committed-but-unwritten entries —
+                # including this one — through the sequential path
+                self._demote_write_stage()
         else:
             support.write_block(block)
+
+    def _drain_write_stage(self, reason: str,
+                           timeout: float = 30.0) -> None:
+        """Barrier: wait for the write stage to reach the submitted
+        tip. A sticky error or a stall demotes to sequential writes
+        (which replays the gap from the raft log)."""
+        if self._write_stage is None:
+            return
+        try:
+            if self._write_stage.drain(timeout=timeout):
+                return
+            logger.warning("[%s] write stage stalled at a %s barrier; "
+                           "demoting to sequential writes",
+                           self._support.channel_id, reason)
+        except OrderWriteError:
+            pass
+        self._demote_write_stage()
+
+    def _demote_write_stage(self) -> None:
+        """Stage failure → the sequential path: stop the worker
+        (crash-equivalent for anything it still held) and heal the
+        ledger gap from the raft log, exactly like a restart would.
+        No envelope is lost — every affected block's entry is still in
+        the WAL."""
+        stage, self._write_stage = self._write_stage, None
+        if stage is None:
+            return
+        logger.warning("[%s] block-write pipeline demoted to the "
+                       "sequential path", self._support.channel_id)
+        self.order_stats["demotions"] += 1
+        try:
+            stage.stop(flush=False)
+        except Exception as e:   # noqa: BLE001 — demotion must complete
+            logger.warning("[%s] stopping failed write stage: %s",
+                           self._support.channel_id, e)
+        # the replay below appends through the same BlockWriter the
+        # worker uses — never run both concurrently. A worker wedged
+        # in a device dispatch is bounded by the provider's breaker
+        # deadline (round 1), and the sequential path would block the
+        # loop on that same write anyway, so this wait terminates.
+        while stage.alive():
+            logger.warning("[%s] write worker still mid-span; waiting "
+                           "before the sequential replay",
+                           self._support.channel_id)
+            stage.join(timeout=10.0)
+        self._replay_committed()
 
     def _reconfigure(self) -> None:
         """A config block committed: adopt the (possibly) new consenter
@@ -644,6 +961,36 @@ class RaftChain:
                            self._support.channel_id)
             threading.Thread(target=self.halt, daemon=True).start()
 
+    def order_pipeline_stats(self) -> dict:
+        """Merged ordering-pipeline readings. The `fill`/`propose_s`/
+        `consensus_s`/`write_s`/`overlap_ratio` keys feed the
+        canonical `orderer_batch_*` gauges through
+        `profiling.publish_order_stats`; the counters ride along for
+        the bench and tests."""
+        s = self.order_stats
+        out = {
+            "fill": s["last_fill"],
+            "propose_s": s["last_propose_s"],
+            "consensus_s": s["last_consensus_s"],
+            "write_s": 0.0,
+            "overlap_ratio": 0.0,
+            "windows": s["windows"],
+            "envelopes": s["envelopes"],
+            "blocks_proposed": s["blocks_proposed"],
+            "propose_total_s": s["propose_s"],
+            "consensus_total_s": s["consensus_s"],
+            "steps_coalesced": s["steps_coalesced"],
+            "demotions": s["demotions"],
+        }
+        stage = self._write_stage
+        if stage is not None:
+            out["write_s"] = stage.stats["last_write_s"]
+            out["write_total_s"] = stage.stats["write_s"]
+            out["blocks_written"] = stage.stats["written"]
+            out["write_spans"] = stage.stats["spans"]
+            out["overlap_ratio"] = stage.overlap_ratio
+        return out
+
     # -- snapshot catch-up (reference blockpuller.go) --
 
     def _catch_up(self, start: int, end: int) -> None:
@@ -671,7 +1018,8 @@ class RaftChain:
 
 
 def consenter(transport, tick_interval_s: float = 0.1,
-              election_tick: int = 10, metrics_provider=None):
+              election_tick: int = 10, metrics_provider=None,
+              write_pipeline: Optional[bool] = None):
     """Factory-of-factories for the registrar's consenter map:
     `{"etcdraft": raft.consenter(transport)}`. An orderer outside the
     channel's consenter set comes up as a FOLLOWER (onboarding mode)
@@ -692,5 +1040,6 @@ def consenter(transport, tick_interval_s: float = 0.1,
         return RaftChain(support, transport,
                          tick_interval_s=tick_interval_s,
                          election_tick=election_tick,
-                         metrics_provider=metrics_provider)
+                         metrics_provider=metrics_provider,
+                         write_pipeline=write_pipeline)
     return factory

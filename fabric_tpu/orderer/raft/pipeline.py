@@ -1,0 +1,241 @@
+"""Pipelined block writing for the raft ordering path.
+
+The consenter's apply path was strictly sequential per block: the raft
+event loop signed the block, appended it to the block store, notified
+deliver waiters, and only then touched the next event — so consensus
+on block N and block-cutting of batch N+1 idled behind the
+sign+store-append of block N−1. `BlockWriteStage` is the ordering-side
+analog of the peer's `CommitPipeline` (core/commitpipeline.py, round
+7): committed NORMAL blocks are handed to a dedicated write worker,
+and the raft loop goes straight back to draining its admission window.
+
+  stage CUT        (raft loop)   admission window → blockcutter →
+                                 batched raft proposal
+  stage CONSENSUS  (raft loop)   replication / commit, event-driven
+  stage WRITE      (this worker) sign + metadata + block-store append
+                                 + deliver notification, in block order
+
+Correctness barriers are explicit and live in the CHAIN (chain.py):
+
+  * config blocks and raft membership changes drain this stage before
+    they are applied — reconfiguration must observe the durable tip;
+  * log compaction drains first — a compacted entry whose block was
+    never written would be unrecoverable after a crash;
+  * snapshot catch-up drains first — the replicator appends directly.
+
+No early side effects: a block enters this stage only AFTER its entry
+committed in raft, and the entry stays in the raft log until it is
+durably written (the chain defers compaction past it) — a crash
+between propose(N+1) and write(N) replays bit-identically through
+`RaftChain._replay_committed`, exactly like a crash on the sequential
+path. Any write failure is sticky: the chain demotes to the
+sequential write path and heals the gap through the same replay.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+logger = logging.getLogger("orderer.raft.pipeline")
+
+
+class OrderWriteError(Exception):
+    """A pipelined block write failed; `number` is the first block of
+    the failing span. Recovery is the sequential path's: demote and
+    replay committed-but-unwritten entries from the raft log."""
+
+    def __init__(self, number: int, cause: BaseException):
+        super().__init__(f"pipelined write of block [{number}] failed: "
+                         f"{cause}")
+        self.number = number
+        self.cause = cause
+
+
+class BlockWriteStage:
+    """Ordered, asynchronous sign+write worker for one channel.
+
+    `support` duck-type: `write_block(block)` and (optionally)
+    `write_blocks(blocks)` — the batched span writer that signs the
+    whole span and self-checks the produced signatures through the
+    BCCSP seam in one device dispatch. `loop_activity()` (optional)
+    returns `(busy_since_or_None, (t0, t1) last busy window)` of the
+    raft event loop, for the overlap accounting. The `stats` readings
+    surface as the canonical `orderer_batch_{write_s,overlap_ratio}`
+    gauges through `profiling.publish_order_stats`."""
+
+    def __init__(self, support,
+                 loop_activity: Optional[Callable] = None):
+        self._support = support
+        self._cond = threading.Condition()
+        self._pending: list = []
+        self._submitted_tip: Optional[int] = None
+        self._written_tip: Optional[int] = None
+        self._error: Optional[OrderWriteError] = None
+        self._stop = threading.Event()
+        self._loop_activity = loop_activity
+        self.stats = {
+            "written": 0, "spans": 0,
+            "write_s": 0.0, "overlap_s": 0.0, "last_write_s": 0.0,
+        }
+        self._thread = threading.Thread(
+            target=self._write_loop,
+            name=f"order-write-{support.channel_id}", daemon=True)
+        self._thread.start()
+
+    # -- raft-loop API --
+
+    def submit(self, block) -> None:
+        """Enqueue the next committed block (in block order). Raises
+        the sticky error if an earlier span failed — the caller then
+        demotes to the sequential path."""
+        with self._cond:
+            if self._error is not None:
+                raise self._error
+            self._pending.append(block)
+            self._submitted_tip = block.header.number
+            self._cond.notify_all()
+
+    def effective_tip(self, ledger_height: int) -> int:
+        """The chain's working height: the ledger tip plus every block
+        already accepted by this stage (the raft loop must treat an
+        in-flight block as written — a re-applied entry for it is a
+        duplicate, not a gap)."""
+        with self._cond:
+            if self._submitted_tip is not None:
+                return max(ledger_height, self._submitted_tip + 1)
+            return ledger_height
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait until every submitted block is durably written; the
+        chain's barrier before config blocks, membership changes, log
+        compaction and catch-up. Returns False on timeout (the caller
+        skips the optional work or demotes); raises the sticky error
+        if a span failed."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cond:
+            while (self._pending or
+                   (self._submitted_tip is not None and
+                    self._written_tip != self._submitted_tip)) and \
+                    self._error is None and not self._stop.is_set():
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._cond.wait(timeout=0.2 if remaining is None
+                                else min(0.2, remaining))
+            if self._error is not None:
+                raise self._error
+            return True
+
+    def check_error(self) -> None:
+        """Non-blocking sticky-error probe (the raft loop polls this
+        once per tick so a failed span demotes promptly, not at the
+        next config barrier)."""
+        with self._cond:
+            if self._error is not None:
+                raise self._error
+
+    def stop(self, flush: bool = True, timeout: float = 5.0) -> None:
+        """Flush (best effort) and join the worker. `flush=False` is
+        crash-equivalent: unwritten blocks stay in the raft log and
+        replay at the next start."""
+        if flush:
+            try:
+                if not self.drain(timeout=timeout):
+                    logger.warning(
+                        "[%s] halt: write-stage drain timed out with "
+                        "blocks still unwritten — they stay in the "
+                        "raft log and replay at the next start",
+                        self._support.channel_id)
+            except OrderWriteError:
+                pass
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            logger.warning(
+                "[%s] halt: write worker still mid-span after %.1fs; "
+                "its unwritten blocks replay at the next start",
+                self._support.channel_id, timeout)
+
+    def alive(self) -> bool:
+        """Whether the worker thread is still running (after a
+        `stop(flush=False)` whose join timed out, the chain must not
+        replay sequentially until this goes False)."""
+        return self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    @property
+    def overlap_ratio(self) -> float:
+        return (self.stats["overlap_s"] / self.stats["write_s"]
+                if self.stats["write_s"] else 0.0)
+
+    # -- the worker --
+
+    def _write_loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                while (not self._pending or self._error is not None) \
+                        and not self._stop.is_set():
+                    self._cond.wait(timeout=0.2)
+                if self._stop.is_set():
+                    return
+                # take everything queued: the whole run becomes ONE
+                # batched sign+verify span through the BCCSP seam
+                span, self._pending = self._pending, []
+            t0 = time.perf_counter()
+            try:
+                write_blocks = getattr(self._support, "write_blocks",
+                                       None)
+                if write_blocks is not None and len(span) > 1:
+                    write_blocks(span)
+                else:
+                    for block in span:
+                        self._support.write_block(block)
+            except Exception as e:   # noqa: BLE001 — sticky, chain demotes
+                logger.exception(
+                    "[%s] pipelined write of blocks [%d..%d] failed; "
+                    "the chain will demote to sequential writes and "
+                    "replay from the raft log",
+                    self._support.channel_id, span[0].header.number,
+                    span[-1].header.number)
+                with self._cond:
+                    if self._error is None:
+                        self._error = OrderWriteError(
+                            span[0].header.number, e)
+                    self._cond.notify_all()
+                continue
+            t1 = time.perf_counter()
+            with self._cond:
+                self._written_tip = span[-1].header.number
+                self._cond.notify_all()
+            self.stats["written"] += len(span)
+            self.stats["spans"] += 1
+            self.stats["write_s"] += t1 - t0
+            self.stats["last_write_s"] = t1 - t0
+            self.stats["overlap_s"] += self._overlap(t0, t1)
+
+    def _overlap(self, t0: float, t1: float) -> float:
+        """How much of the write window [t0, t1] ran while the raft
+        loop was busy (cutting the next window / stepping consensus) —
+        the time this stage actually hid."""
+        if self._loop_activity is None:
+            return 0.0
+        try:
+            active_since, window = self._loop_activity()
+        except Exception:   # noqa: BLE001 — accounting must never kill writes
+            return 0.0
+        overlap = 0.0
+        if active_since is not None:
+            overlap += max(0.0, t1 - max(t0, active_since))
+        ws, we = window
+        if we > ws:
+            overlap += max(0.0, min(t1, we) - max(t0, ws))
+        return min(overlap, t1 - t0)

@@ -91,6 +91,19 @@ if final.get("deadline_hit") or any(
     sys.exit(0)
 
 assert final.get("value"), final
+
+# round-10 contract: the full_pipeline stage line reports the ordering
+# bottleneck (wheel-free stub harness, so it runs on every host) —
+# the driver reads the trend without a human opening sidecars
+fp = stages.get("full_pipeline") or {}
+if "skipped" not in fp and not fp.get("order_skipped"):
+    # an explicit order_skipped (env opt-out / budget exhausted) is
+    # fine; fields silently missing — or an errored section — is not
+    assert fp.get("order_raft_s", 0) > 0, \
+        f"full_pipeline lacks order_raft_s: {fp}"
+    assert fp.get("order_vs_validate", 0) > 0, \
+        f"full_pipeline lacks order_vs_validate: {fp}"
+
 detail = json.load(open(final["sidecar"]))
 core1 = (detail.get("stage_detail") or {}).get("core_1dev") or {}
 stats = core1.get("provider_stats") or {}

@@ -10,7 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -86,6 +86,20 @@ shard() {
         -k "Faults or MultiProcess"
 }
 
+order() {
+    # the round-10 ordering pipeline under fire: failing batched
+    # proposes demote the admission window to sequential per-block
+    # proposes, dropped raft steps are healed by retransmission —
+    # block streams stay bit-identical and no envelope is lost
+    # (raft + broadcast ingest subsets, the new fault points armed)
+    run "order.propose=error:2" tests/test_order_pipeline.py \
+        tests/test_broadcast_batch.py
+    run "order.propose=delay:2:0.02;raft.step=error:3" \
+        tests/test_order_pipeline.py
+    run "raft.step=error:2;order.propose=error:1" tests/test_raft.py \
+        tests/test_chaos.py -k "Raft"
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -99,8 +113,9 @@ case "${1:-all}" in
     onboarding) onboarding ;;
     commit) commit ;;
     shard) shard ;;
+    order) order ;;
     static) static ;;
-    all) bccsp; raft; deliver; onboarding; commit; shard; static ;;
+    all) bccsp; raft; deliver; onboarding; commit; shard; order; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

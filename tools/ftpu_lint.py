@@ -68,6 +68,10 @@ REQUIRED_HOT_PATHS = {
         "_dispatch_comb_digest", "_dispatch_comb", "_shard_put",
     ),
     "fabric_tpu/core/commitpipeline.py": ("_validate_one",),
+    # round-10 ordering spans: the batched raft propose and the
+    # ingress-verify admission window
+    "fabric_tpu/orderer/raft/chain.py": ("_propose_batch",),
+    "fabric_tpu/bccsp/admission.py": ("_dispatch_window",),
 }
 
 _WAIVER_RE = re.compile(
