@@ -1082,6 +1082,67 @@ def stage_core():
                     "seconds": round(tpu_s, 4),
                     "chunk": chunk, "q16": bool(q16_path)})
 
+    # --- ed25519 regime: the scheme router's second device kernel
+    #     (round 11). Own JSON fields on the stage/final lines; an
+    #     explicit skip marker when the section doesn't run — the
+    #     same contract as order_skipped, so bench_smoke can tell
+    #     "opted out / out of budget" from "silently broken" ---
+    ed_fields: dict = {}
+    ed_batch = int(os.environ.get("BENCH_ED25519_BATCH",
+                                  "128" if SMOKE else "1024"))
+    if os.environ.get("BENCH_ED25519", "1") != "1":
+        ed_fields["ed25519_skipped"] = "env"
+    elif _remaining() <= 90:
+        ed_fields["ed25519_skipped"] = "budget"
+    else:
+        from fabric_tpu.bccsp import ed25519_host as edh
+        from fabric_tpu.bccsp._crypto_compat import ed25519_sign
+        from fabric_tpu.bccsp.bccsp import Ed25519PublicKeyImportOpts
+        seeds = [edh.generate_seed() for _ in range(NKEYS)]
+        ed_keys = [prov.key_import(edh.public_from_seed(s),
+                                   Ed25519PublicKeyImportOpts())
+                   for s in seeds]
+        t0 = time.perf_counter()
+        ed_items = [VerifyItem(key=ed_keys[i % NKEYS],
+                               signature=ed25519_sign(
+                                   seeds[i % NKEYS], m),
+                               message=m)
+                    for i, m in enumerate(
+                        rng.bytes(MSG_LEN) for _ in range(ed_batch))]
+        ed_sign_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = prov.verify_batch(ed_items)       # compile + warm pass
+        ed_warm_s = time.perf_counter() - t0
+        if not all(out):
+            raise SystemExit("correctness failure: valid ed25519 "
+                             "signatures rejected")
+        if not prov.stats["ed25519_batches"]:
+            raise SystemExit("ed25519 regime never reached the "
+                             "device kernel: %s" % prov.scheme_stats)
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = prov.verify_batch(ed_items)
+            times.append(time.perf_counter() - t0)
+        ed_s = min(times)
+        if not all(out):
+            raise SystemExit("correctness failure in steady ed25519 "
+                             "pass")
+        ed_fields = {
+            "ed25519_batch": ed_batch,
+            "ed25519_sigs_per_s": round(ed_batch / ed_s, 1),
+            "ed25519_seconds": round(ed_s, 4),
+            "ed25519_warm_s": round(ed_warm_s, 1),
+        }
+        _PARTIAL.update(ed_fields)
+        emit_stage({"stage": "ed25519",
+                    "devices": devices or local_devices,
+                    "mesh_devices": mesh_devices, **ed_fields,
+                    "sign_s": round(ed_sign_s, 2)})
+    if "ed25519_skipped" in ed_fields:
+        emit_stage({"stage": "ed25519",
+                    "skipped": ed_fields["ed25519_skipped"]})
+
     on_tpu = type(prov)._on_tpu()
     detail = {
         "batch": batch,
@@ -1119,6 +1180,9 @@ def stage_core():
         "sign_s": round(sign_s, 2),
         "provider_stats": dict(prov.stats),
         "shard_stats": dict(prov.shard_stats),
+        "scheme_stats": {k: dict(v)
+                         for k, v in prov.scheme_stats.items()},
+        "ed25519": dict(ed_fields) or None,
         "devices": [str(d) for d in jax.devices()],
     }
     value = (round(batch / tpu_s, 1) if tpu_s
@@ -1141,6 +1205,7 @@ def stage_core():
         "deadline_s": DEADLINE_S or None,
         "deadline_hit": False,
         "on_tpu": on_tpu,
+        **ed_fields,
     }, detail)
 
 

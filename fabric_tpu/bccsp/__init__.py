@@ -14,9 +14,13 @@ from fabric_tpu.bccsp.bccsp import (  # noqa: F401
     Key,
     VerifyItem,
     AES256KeyGenOpts,
+    BLSKeyGenOpts,
+    BLSPublicKeyImportOpts,
     ECDSAKeyGenOpts,
     ECDSAPrivateKeyImportOpts,
     ECDSAPublicKeyImportOpts,
+    Ed25519KeyGenOpts,
+    Ed25519PublicKeyImportOpts,
     X509PublicKeyImportOpts,
     SHA256Opts,
     SHA384Opts,

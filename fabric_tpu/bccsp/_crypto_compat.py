@@ -309,3 +309,42 @@ except ImportError:
 
     class NameOID(metaclass=_MissingAttr):
         """x509 name OIDs (cryptogen cert building)."""
+
+
+# ---------------------------------------------------------------------------
+# Ed25519 helpers — shared by BOTH branches above. RFC 8032 signing is
+# deterministic, so the OpenSSL wheel and the pure-python host backend
+# produce byte-identical signatures over the same seed; prefer the
+# wheel when it is present (C speed), fall back to
+# `bccsp/ed25519_host.py` otherwise. VERIFICATION always runs the host
+# policy (strict encodings + small-order rejection) — the wheel's
+# laxer verifier would silently widen the accept set.
+# ---------------------------------------------------------------------------
+
+def _wheel_ed25519_private():
+    if not HAVE_CRYPTOGRAPHY:
+        return None
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey as _W,
+        )
+    except ImportError:
+        return None                 # wheel predates Ed25519 support
+    return _W
+
+
+def ed25519_sign(seed: bytes, msg: bytes) -> bytes:
+    w = _wheel_ed25519_private()
+    if w is not None:
+        return w.from_private_bytes(seed).sign(msg)
+    from fabric_tpu.bccsp import ed25519_host as _ed
+    return _ed.sign(seed, msg)
+
+
+def ed25519_public_from_seed(seed: bytes) -> bytes:
+    w = _wheel_ed25519_private()
+    if w is not None:
+        return w.from_private_bytes(seed).public_key(
+        ).public_bytes_raw()
+    from fabric_tpu.bccsp import ed25519_host as _ed
+    return _ed.public_from_seed(seed)

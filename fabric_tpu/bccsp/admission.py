@@ -20,8 +20,12 @@ Every caller gets exactly its own verdicts back, in order. The window
 adds NO policy of its own: it delegates to the wrapped provider's
 `verify_batch`, so the TPU provider's circuit breaker, deadline
 watchdog and sw fallback (round 1) govern the coalesced dispatch
-exactly as they govern a direct one. All other BCCSP methods pass
-through untouched.
+exactly as they govern a direct one — and since round 11 a coalesced
+window may be MIXED-SCHEME (P-256 endorsers convoying with Ed25519
+modern-MSP identities): the provider's scheme router partitions the
+one dispatch into per-scheme sub-batches, so coalescing never forces
+a lane onto the wrong kernel. All other BCCSP methods (including
+`verify_aggregate`) pass through untouched.
 """
 
 from __future__ import annotations

@@ -17,6 +17,13 @@ from typing import Optional, Sequence
 class Key(abc.ABC):
     """A cryptographic key handle (reference: `bccsp/bccsp.go:15-45`)."""
 
+    #: schemes whose sign/verify consume the raw MESSAGE rather than a
+    #: precomputed digest (Ed25519's internal SHA-512 challenge, BLS's
+    #: hash-to-curve) set this True; digest-based schemes (ECDSA) keep
+    #: the default. Callers that pre-hash (msp identities, the
+    #: blockwriter) consult it to decide what to pass as `digest`.
+    sign_message: bool = False
+
     @abc.abstractmethod
     def bytes(self) -> bytes:
         """Serialized form, if allowed (public keys: DER SPKI)."""
@@ -59,6 +66,29 @@ class VerifyItem:
 class ECDSAKeyGenOpts:
     ephemeral: bool = False
     curve: str = "P-256"
+
+
+@dataclass(frozen=True)
+class Ed25519KeyGenOpts:
+    ephemeral: bool = False
+
+
+@dataclass(frozen=True)
+class Ed25519PublicKeyImportOpts:
+    ephemeral: bool = False
+
+
+@dataclass(frozen=True)
+class BLSKeyGenOpts:
+    """BLS12-381 min-sig keys (pk on the G2 twist, signatures in G1 —
+    the aggregatable consensus-identity shape)."""
+
+    ephemeral: bool = False
+
+
+@dataclass(frozen=True)
+class BLSPublicKeyImportOpts:
+    ephemeral: bool = False
 
 
 @dataclass(frozen=True)
@@ -131,3 +161,14 @@ class BCCSP(abc.ABC):
 
     @abc.abstractmethod
     def decrypt(self, key: Key, ciphertext: bytes, opts=None) -> bytes: ...
+
+    def verify_aggregate(self, keys: Sequence[Key],
+                         messages: Sequence[bytes],
+                         signature: bytes) -> bool:
+        """Verify ONE aggregate signature over per-key messages
+        (BLS-style: keys[i] signed messages[i]; `signature` is the
+        aggregated group element). Providers without an aggregatable
+        scheme raise; a malformed signature or a non-aggregatable key
+        set verifies False / raises TypeError like `verify`."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no aggregate-verify scheme")

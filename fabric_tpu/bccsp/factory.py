@@ -68,6 +68,10 @@ class TpuOpts:
     # windows (e.g. orderer sig-filter ingest) to an AOT-compiled
     # shape; padded lanes are premasked
     bucket_floor: int = 0
+    # BCCSP.TPU.Ed25519: the scheme router's Ed25519 device kernel.
+    # False pins Ed25519 lanes to the host reference path (verdicts
+    # identical — this is a serving-path knob, not a policy one)
+    ed25519: bool = True
     # graceful degradation (BCCSP.TPU.Fallback): circuit breaker
     # around every device dispatch — on trip the provider serves the
     # bit-identical sw path and re-probes after CooldownS
@@ -112,6 +116,7 @@ class FactoryOpts:
                 hash_on_host=bool(tpu_cfg.get("HashOnHost", True)),
                 warm_keys_dir=tpu_cfg.get("WarmKeysDir") or None,
                 bucket_floor=int(tpu_cfg.get("BucketFloor", 0)),
+                ed25519=bool(tpu_cfg.get("Ed25519", True)),
                 fallback=BreakerConfig(
                     deadline_ms=float(fb_cfg.get(
                         "DeadlineMs", fb_defaults.deadline_ms)),
@@ -191,7 +196,8 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            hash_on_host=opts.tpu.hash_on_host,
                            warm_keys_dir=opts.tpu.warm_keys_dir,
                            bucket_floor=opts.tpu.bucket_floor,
-                           fallback=opts.tpu.fallback)
+                           fallback=opts.tpu.fallback,
+                           ed25519=opts.tpu.ed25519)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

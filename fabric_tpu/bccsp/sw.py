@@ -124,6 +124,142 @@ class ECDSAPrivateKey(api.Key):
         return self._priv
 
 
+class Ed25519PublicKey(api.Key):
+    """RFC 8032 public key (32-byte canonical encoding). Policy —
+    strict decoding, small-order rejection, cofactorless equation —
+    lives in `ed25519_host`; both providers consume it."""
+
+    scheme = "ed25519"
+    sign_message = True
+
+    def __init__(self, raw: bytes):
+        from fabric_tpu.bccsp import ed25519_host as edh
+        if edh.decode_point(raw) is None:
+            raise ValueError("not a canonical Ed25519 public key")
+        self._raw = bytes(raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def ski(self) -> bytes:
+        return hashlib.sha256(self._raw).digest()
+
+    def symmetric(self) -> bool:
+        return False
+
+    def private(self) -> bool:
+        return False
+
+    def public_key(self) -> "Ed25519PublicKey":
+        return self
+
+
+class Ed25519PrivateKey(api.Key):
+    scheme = "ed25519"
+    sign_message = True
+
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("Ed25519 seed must be 32 bytes")
+        self._seed = bytes(seed)
+        from fabric_tpu.bccsp._crypto_compat import (
+            ed25519_public_from_seed,
+        )
+        self._pub = Ed25519PublicKey(ed25519_public_from_seed(seed))
+
+    def bytes(self) -> bytes:
+        raise TypeError("private key export not allowed")
+
+    def ski(self) -> bytes:
+        return self._pub.ski()
+
+    def symmetric(self) -> bool:
+        return False
+
+    def private(self) -> bool:
+        return True
+
+    def public_key(self) -> Ed25519PublicKey:
+        return self._pub
+
+    @property
+    def seed(self) -> bytes:
+        return self._seed
+
+
+class BLSPublicKey(api.Key):
+    """BLS12-381 min-sig public key: a G2 twist point (192-byte
+    uncompressed encoding), subgroup-checked at construction —
+    aggregation is unsound over points outside the order-r group."""
+
+    scheme = "bls12381"
+    sign_message = True
+
+    def __init__(self, raw: bytes):
+        from fabric_tpu.ops import bls12_381_ref as bref
+        self.point = bref.g2_from_bytes(raw)
+        if self.point is None:
+            raise ValueError("BLS public key is the identity")
+        self._raw = bytes(raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def ski(self) -> bytes:
+        return hashlib.sha256(self._raw).digest()
+
+    def symmetric(self) -> bool:
+        return False
+
+    def private(self) -> bool:
+        return False
+
+    def public_key(self) -> "BLSPublicKey":
+        return self
+
+
+class BLSPrivateKey(api.Key):
+    scheme = "bls12381"
+    sign_message = True
+
+    def __init__(self, sk: int):
+        from fabric_tpu.ops import bls12_381_ref as bref
+        if not (1 <= sk < bref.R):
+            raise ValueError("BLS secret scalar out of range")
+        self._sk = sk
+        self._pub = BLSPublicKey(bref.g2_to_bytes(
+            bref.g2_mul(sk, (bref.G2_X, bref.G2_Y))))
+
+    def bytes(self) -> bytes:
+        raise TypeError("private key export not allowed")
+
+    def ski(self) -> bytes:
+        return self._pub.ski()
+
+    def symmetric(self) -> bool:
+        return False
+
+    def private(self) -> bool:
+        return True
+
+    def public_key(self) -> BLSPublicKey:
+        return self._pub
+
+    @property
+    def sk(self) -> int:
+        return self._sk
+
+
+def bls_aggregate_signatures(sigs) -> bytes:
+    """Aggregate serialized G1 signatures into one 96-byte signature
+    (sum of points). Raises ValueError on malformed input — callers
+    aggregate their OWN just-produced signatures (the blockwriter
+    span), so garbage here is a bug, not data."""
+    from fabric_tpu.ops import bls12_381_ref as bref
+    pts = [bref.g1_from_bytes(s, subgroup_check=False) for s in sigs]
+    return bref.g1_to_bytes(bref.bls_aggregate(pts))
+
+
 class AESKey(api.Key):
     def __init__(self, raw: bytes):
         self._raw = raw
@@ -151,6 +287,28 @@ _HASHERS = {
     "SHA3_256": hashlib.sha3_256,
     "SHA3_384": hashlib.sha3_384,
 }
+
+
+def _wheel_ed25519_raw(pub) -> Optional[bytes]:
+    """Raw 32-byte point from a `cryptography` Ed25519PublicKey (or
+    None when `pub` is not one / the wheel predates Ed25519). The
+    isinstance check matters: X25519/X448 keys also expose a raw-bytes
+    accessor, and an X25519 u-coordinate must not be mistaken for an
+    Edwards point. Callers only reach this with a wheel-produced key
+    object, so importing the wheel's type here is safe."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PublicKey as _WheelEd25519,
+        )
+    except Exception:
+        return None
+    if not isinstance(pub, _WheelEd25519):
+        return None
+    try:
+        raw = pub.public_bytes_raw()
+    except Exception:
+        return None
+    return raw if isinstance(raw, bytes) and len(raw) == 32 else None
 
 
 def check_signature(key, signature: bytes) -> Optional[tuple[int, int]]:
@@ -199,6 +357,13 @@ class SWProvider(api.BCCSP):
     def key_gen(self, opts) -> api.Key:
         if isinstance(opts, api.ECDSAKeyGenOpts):
             key = ECDSAPrivateKey(ec.generate_private_key(ec.SECP256R1()))
+        elif isinstance(opts, api.Ed25519KeyGenOpts):
+            from fabric_tpu.bccsp import ed25519_host as edh
+            key = Ed25519PrivateKey(edh.generate_seed())
+        elif isinstance(opts, api.BLSKeyGenOpts):
+            from fabric_tpu.ops import bls12_381_ref as bref
+            sk, _ = bref.bls_keygen(os.urandom(32))
+            key = BLSPrivateKey(sk)
         elif isinstance(opts, api.AES256KeyGenOpts):
             key = AESKey(os.urandom(32))
         else:
@@ -212,9 +377,28 @@ class SWProvider(api.BCCSP):
             cert = raw if isinstance(raw, x509.Certificate) \
                 else x509.load_der_x509_certificate(raw)
             pub = cert.public_key()
-            if not isinstance(pub, ec.EllipticCurvePublicKey):
-                raise TypeError("certificate does not carry an EC key")
-            key: api.Key = ECDSAPublicKey(pub)
+            if isinstance(pub, ec.EllipticCurvePublicKey):
+                key: api.Key = ECDSAPublicKey(pub)
+            else:
+                ed_raw = _wheel_ed25519_raw(pub)
+                if ed_raw is None:
+                    raise TypeError(
+                        "certificate carries neither an EC nor an "
+                        "Ed25519 key")
+                # modern-MSP identities (FAB-18401 shape): the cert
+                # key is Ed25519 — wrap the raw point so the scheme
+                # router and the msp layer see one key type
+                key = Ed25519PublicKey(ed_raw)
+        elif isinstance(opts, api.Ed25519PublicKeyImportOpts):
+            if isinstance(raw, (bytes, bytearray)):
+                key = Ed25519PublicKey(bytes(raw))
+            else:
+                ed_raw = _wheel_ed25519_raw(raw)
+                if ed_raw is None:
+                    raise TypeError("not an Ed25519 public key")
+                key = Ed25519PublicKey(ed_raw)
+        elif isinstance(opts, api.BLSPublicKeyImportOpts):
+            key = BLSPublicKey(bytes(raw))
         elif isinstance(opts, api.ECDSAPublicKeyImportOpts):
             if isinstance(raw, ec.EllipticCurvePublicKey):
                 key = ECDSAPublicKey(raw)
@@ -255,7 +439,15 @@ class SWProvider(api.BCCSP):
 
     def sign(self, key: api.Key, digest: bytes, opts=None) -> bytes:
         """Low-S DER signature over a precomputed digest (reference:
-        `bccsp/sw/ecdsa.go:27-39` signECDSA → ToLowS → marshal)."""
+        `bccsp/sw/ecdsa.go:27-39` signECDSA → ToLowS → marshal). For
+        message-based schemes (`key.sign_message`) `digest` IS the
+        message — Ed25519/BLS hash internally."""
+        if isinstance(key, Ed25519PrivateKey):
+            from fabric_tpu.bccsp._crypto_compat import ed25519_sign
+            return ed25519_sign(key.seed, digest)
+        if isinstance(key, BLSPrivateKey):
+            from fabric_tpu.ops import bls12_381_ref as bref
+            return bref.g1_to_bytes(bref.bls_sign(key.sk, digest))
         if not isinstance(key, ECDSAPrivateKey):
             raise TypeError("sign requires an ECDSA private key")
         alg = self._PREHASH_BY_LEN.get(len(digest))
@@ -277,6 +469,17 @@ class SWProvider(api.BCCSP):
     def verify(self, key: api.Key, signature: bytes, digest: bytes,
                opts=None) -> bool:
         pub = key.public_key()
+        if isinstance(pub, Ed25519PublicKey):
+            from fabric_tpu.bccsp import ed25519_host as edh
+            return edh.verify(pub.bytes(), signature, digest)
+        if isinstance(pub, BLSPublicKey):
+            from fabric_tpu.ops import bls12_381_ref as bref
+            try:
+                sig = bref.g1_from_bytes(signature,
+                                         subgroup_check=False)
+            except ValueError:
+                return False
+            return bref.bls_verify(pub.point, digest, sig)
         if not isinstance(pub, ECDSAPublicKey):
             raise TypeError("verify requires an ECDSA key")
         rs = check_signature(pub, signature)
@@ -298,10 +501,38 @@ class SWProvider(api.BCCSP):
     def verify_batch(self, items: Sequence[api.VerifyItem]) -> list[bool]:
         out = []
         for it in items:
+            if getattr(it.key, "sign_message", False):
+                # message-based schemes (Ed25519, BLS): the scheme
+                # hashes internally — never pre-hash, whichever field
+                # the caller populated carries the raw message
+                data = it.message if it.message is not None \
+                    else it.digest
+                out.append(self.verify(it.key, it.signature, data))
+                continue
             digest = it.digest if it.digest is not None \
                 else self.hash(it.message)
             out.append(self.verify(it.key, it.signature, digest))
         return out
+
+    def verify_aggregate(self, keys, messages, signature) -> bool:
+        """BLS aggregate verify — the HOST REFERENCE path (one full
+        pairing product via `bls12_381_ref`): keys[i] signed
+        messages[i], `signature` is the 96-byte aggregated G1 point.
+        The TPU provider's staged batched-Miller path must match this
+        bit for bit (chaos: armed tpu.bls_aggregate falls back
+        here)."""
+        from fabric_tpu.ops import bls12_381_ref as bref
+        pks = []
+        for k in keys:
+            pub = k.public_key()
+            if not isinstance(pub, BLSPublicKey):
+                raise TypeError("verify_aggregate requires BLS keys")
+            pks.append(pub.point)
+        try:
+            sig = bref.g1_from_bytes(signature, subgroup_check=False)
+        except ValueError:
+            return False
+        return bref.aggregate_verify(pks, list(messages), sig)
 
     # -- pairings (host oracle; the TPU provider batches these on
     #    device — reference consumer: idemix credential verification) --

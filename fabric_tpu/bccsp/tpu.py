@@ -74,7 +74,8 @@ class TPUProvider(api.BCCSP):
                  hash_on_host: bool = True,
                  warm_keys_dir: Optional[str] = None,
                  bucket_floor: int = 0,
-                 fallback: Optional[breaker_mod.BreakerConfig] = None):
+                 fallback: Optional[breaker_mod.BreakerConfig] = None,
+                 ed25519: bool = True):
         self._sw = swmod.SWProvider(keystore)
         # graceful degradation (BCCSP.TPU.Fallback): every device
         # dispatch runs behind this breaker; on trip the provider
@@ -173,6 +174,8 @@ class TPUProvider(api.BCCSP):
                       "q16_disk_loads": 0, "q8_disk_loads": 0,
                       "q16_loading_skips": 0,
                       "nonp256_sw_lanes": 0,
+                      "ed25519_batches": 0,
+                      "bls_aggregate_checks": 0,
                       "pipeline_batches": 0, "pipeline_chunks": 0,
                       "pipeline_host_s": 0.0,
                       "pipeline_transfer_s": 0.0,
@@ -197,6 +200,18 @@ class TPUProvider(api.BCCSP):
         # refreshed per sharded batch. Empty lists while single-chip.
         self.shard_stats: dict = {"transfer_s": [], "ready_s": [],
                                   "lanes": []}
+        # scheme-router observability (bccsp_scheme_* gauges, published
+        # with a `scheme` label): cumulative lanes routed per scheme,
+        # lanes that fell to the per-lane sw path, and device/aggregate
+        # dispatches — the multi-scheme twin of nonp256_sw_lanes, which
+        # stays as the scalar total for dashboard continuity
+        self.scheme_stats: dict = {"lanes": {}, "sw_lanes": {},
+                                   "dispatches": {}}
+        # BCCSP.TPU.Ed25519: gate the Ed25519 device kernel (False =
+        # Ed25519 lanes serve on the host reference path; verdicts are
+        # identical either way)
+        self._ed25519_enabled = ed25519
+        self._ed_tab = None         # replicated device B-comb table
         self._persist_threads: list = []
         # serializes warm-file mutations (record/trim/drop) with the
         # background table-byte writers' publish step, so a concurrent
@@ -291,8 +306,101 @@ class TPUProvider(api.BCCSP):
 
     # -- the batch path --
 
+    def _bump_scheme(self, scheme: str, lanes: int = 0,
+                     sw_lanes: int = 0, dispatches: int = 0) -> None:
+        """One accounting point for the scheme router (bccsp_scheme_*
+        gauges). Plain dict math — the GIL makes the += atomic enough
+        for gauges, exactly like the scalar stats."""
+        for key, n in (("lanes", lanes), ("sw_lanes", sw_lanes),
+                       ("dispatches", dispatches)):
+            if n:
+                d = self.scheme_stats[key]
+                d[scheme] = d.get(scheme, 0) + n
+
+    def _sw_scatter(self, lanes, result, verify_fn,
+                    scheme: str = "ecdsa-other") -> None:
+        """THE consolidated non-device-lane bookkeeping (was four
+        duplicated `nonp256_sw_lanes` sites): verify `lanes` through
+        `verify_fn` (a callable taking the lane list and returning
+        per-lane verdicts on the embedded sw provider) and scatter
+        into `result`, accounting the scalar total and the per-scheme
+        split in one place."""
+        lanes = list(lanes)
+        if not lanes:
+            return
+        self.stats["nonp256_sw_lanes"] += len(lanes)
+        # sw_lanes only: these lanes were already counted under the
+        # scheme that routed them here (router `lanes` partitions the
+        # batch; `sw_lanes` records the detours within it)
+        self._bump_scheme(scheme, sw_lanes=len(lanes))
+        for i, v in zip(lanes, verify_fn(lanes)):
+            result[i] = v
+
+    @staticmethod
+    def _lane_scheme(item) -> str:
+        """Router partition key for one lane: which per-scheme
+        sub-batch serves it. Everything the legacy P-256 staging
+        already handles inline (P-256, non-P-256 ECDSA sw lanes, dead
+        non-ECDSA keys) stays "p256" so that path remains bit-for-bit
+        the pre-router pipeline."""
+        key = item.key
+        if getattr(key, "scheme", None) == "ed25519":
+            return "ed25519"
+        if getattr(key, "scheme", None) == "bls12381":
+            return "bls"
+        return "p256"
+
     def verify_batch(self, items: Sequence[api.VerifyItem]) -> list[bool]:
+        """The scheme-dispatch router: partition lanes by (curve,
+        hash) into per-scheme sub-batches — P-256 rides the existing
+        comb/tree pipeline, Ed25519 the new batch kernel, BLS the
+        per-lane pairing path (aggregates arrive via
+        `verify_aggregate`), everything else the sw fallback — each
+        behind the shared breaker/fallback. A pure-P-256 batch (the
+        overwhelmingly common case) takes the legacy path with zero
+        extra staging; every lane of a mixed batch is routed (none
+        silently dropped), and the combined bitmap is bit-identical
+        to all-sw."""
         if len(items) < self._min_batch:
+            return self._sw.verify_batch(items)
+        schemes = [self._lane_scheme(it) for it in items]
+        if all(s == "p256" for s in schemes):
+            return self._verify_batch_p256(items)
+        by_scheme: dict[str, list[int]] = {}
+        for i, s in enumerate(schemes):
+            by_scheme.setdefault(s, []).append(i)
+        result: list = [False] * len(items)
+        for scheme, lanes in by_scheme.items():
+            sub = [items[i] for i in lanes]
+            if scheme == "p256":
+                out = self._verify_batch_p256(sub)
+            elif scheme == "ed25519":
+                out = self._verify_batch_ed25519(sub)
+            else:               # per-lane BLS verify on the host path
+                out = self._sw.verify_batch(sub)
+                self._bump_scheme(scheme, lanes=len(lanes),
+                                  sw_lanes=len(lanes))
+            for i, v in zip(lanes, out):
+                result[i] = v
+        return result
+
+    def _verify_batch_p256(self, items: Sequence[api.VerifyItem]
+                           ) -> list[bool]:
+        """The pre-router batch path: P-256 device verify with inline
+        sw lanes for non-P-256 ECDSA keys and dead lanes for
+        everything unknown. Sub-batches from the router land here
+        too, so the min-batch cutoff below still protects a mixed
+        batch's small P-256 remainder from device-dispatch latency.
+
+        Owns its own scheme accounting (like the Ed25519 path):
+        `dispatches` bumps only after a device dispatch actually
+        succeeded; sub-min-batch remainders, open-breaker degrades
+        and guard fallbacks count as `sw_lanes` — so the gauges show
+        the sw detours they document instead of a healthy device
+        path."""
+        self._bump_scheme("p256", lanes=len(items))
+        if len(items) < self._min_batch:
+            self._bump_scheme("p256", sw_lanes=len(items))
             return self._sw.verify_batch(items)
         # admission FIRST: admit() resolves the breaker state and the
         # probe decision atomically, so a cooldown expiring between a
@@ -303,6 +411,7 @@ class TPUProvider(api.BCCSP):
         except breaker_mod.CircuitOpen:
             self.stats["degraded_batches"] += 1
             self._sync_breaker_stats()
+            self._bump_scheme("p256", sw_lanes=len(items))
             return self._sw.verify_batch(items)
         # probing: risk at most ProbeBatch lanes on the suspect device;
         # the rest of the batch verifies on the host path (results are
@@ -319,12 +428,15 @@ class TPUProvider(api.BCCSP):
         except Exception:
             self.stats["sw_fallbacks"] += 1
             self._sync_breaker_stats()
+            self._bump_scheme("p256", sw_lanes=len(items))
             logger.exception(
                 "TPU batch verify failed; falling back to sw for %d items",
                 len(items))
             return self._sw.verify_batch(items)
         self._sync_breaker_stats()
+        self._bump_scheme("p256", dispatches=1)
         if probe_rest is not None:
+            self._bump_scheme("p256", sw_lanes=len(probe_rest))
             out = out + self._sw.verify_batch(probe_rest)
         return out
 
@@ -440,12 +552,10 @@ class TPUProvider(api.BCCSP):
                     bucket, key_map, key_idx, r_b, rpn_b, w_b,
                     premask, digests)
                 result = out[:n].tolist()
-                if sw_lanes:
-                    self.stats["nonp256_sw_lanes"] += len(sw_lanes)
-                    sub = self._sw.verify_batch(
-                        [items[i] for i in sw_lanes])
-                    for i, v in zip(sw_lanes, sub):
-                        result[i] = v
+                self._sw_scatter(
+                    sw_lanes, result,
+                    lambda ls: self._sw.verify_batch(
+                        [items[i] for i in ls]))
                 return result
             blocks = np.zeros((bucket, 1, 16), dtype=np.uint32)
             nblocks = np.zeros(bucket, dtype=np.int32)
@@ -530,12 +640,188 @@ class TPUProvider(api.BCCSP):
                                     nblocks, r_l, rpn_l, w_l, premask,
                                     digests, has_digest, qx_b, qy_b)
         result = out[:n].tolist()
-        if sw_lanes:
-            self.stats["nonp256_sw_lanes"] += len(sw_lanes)
-            sub = self._sw.verify_batch([items[i] for i in sw_lanes])
-            for i, v in zip(sw_lanes, sub):
-                result[i] = v
+        self._sw_scatter(
+            sw_lanes, result,
+            lambda ls: self._sw.verify_batch([items[i] for i in ls]))
         return result
+
+    # -- the Ed25519 batch path (scheme router "ed25519" lanes) --
+
+    def _verify_batch_ed25519(self, items) -> list[bool]:
+        """Ed25519 sub-batch: host gates + SHA-512 challenge per lane
+        (`ed25519_host.prep_verify` — the shared policy), then ONE
+        device dispatch of the vmapped [S]B + [k](-A) == R kernel,
+        behind the SAME breaker/fallback as the P-256 path. Small
+        sub-batches, a disabled kernel (BCCSP.TPU.Ed25519: false) and
+        device failures serve the host reference with bit-identical
+        verdicts."""
+        n = len(items)
+        if n < self._min_batch or not self._ed25519_enabled:
+            self._bump_scheme("ed25519", lanes=n, sw_lanes=n)
+            return self._sw.verify_batch(items)
+        try:
+            is_probe = self._breaker.admit()
+        except breaker_mod.CircuitOpen:
+            self.stats["degraded_batches"] += 1
+            self._sync_breaker_stats()
+            self._bump_scheme("ed25519", lanes=n, sw_lanes=n)
+            return self._sw.verify_batch(items)
+        dev_items, probe_rest = items, None
+        if is_probe:
+            pb = self._breaker.config.probe_batch
+            if pb and n > max(pb, self._min_batch):
+                cut = max(pb, self._min_batch)
+                dev_items, probe_rest = items[:cut], items[cut:]
+        try:
+            out = self._breaker.guard(
+                lambda: self._dispatch_ed25519(dev_items))
+        except Exception:
+            self.stats["sw_fallbacks"] += 1
+            self._sync_breaker_stats()
+            self._bump_scheme("ed25519", lanes=n, sw_lanes=n)
+            logger.exception(
+                "Ed25519 batch verify failed; falling back to sw for "
+                "%d items", n)
+            return self._sw.verify_batch(items)
+        self._sync_breaker_stats()
+        self._bump_scheme("ed25519", lanes=len(dev_items),
+                          dispatches=1)
+        if probe_rest is not None:
+            self._bump_scheme("ed25519", lanes=len(probe_rest),
+                              sw_lanes=len(probe_rest))
+            out = out + self._sw.verify_batch(probe_rest)
+        return out
+
+    @hot_path
+    def _dispatch_ed25519(self, items) -> list[bool]:
+        """The Ed25519 device span: host prep rows (gates + challenge
+        already computed), bucket/chunk staging, sharded feed under a
+        mesh, one compiled kernel per chunk shape."""
+        lockcheck.note_blocking("tpu.ed25519")
+        faults.check("tpu.ed25519")
+        import jax
+
+        from fabric_tpu.bccsp import ed25519_host as edh
+        from fabric_tpu.ops import ed25519 as edo
+
+        n = len(items)
+        prep = []
+        for it in items:
+            pub = it.key.public_key()
+            msg = it.message if it.message is not None else it.digest
+            prep.append(None if msg is None else
+                        edh.prep_verify(pub.bytes(), it.signature,
+                                        msg))
+        bucket = self._bucket(n)
+        rows = edo.stage_rows(prep, bucket)
+        tab = self._ed_table()
+        fn = self._ed25519_pipeline()
+        chunk = self._mesh_chunk(bucket)
+        outs = []
+        for lo in range(0, bucket, chunk):
+            arrs = tuple(a[lo:lo + chunk] for a in rows)
+            if self._mesh is not None:
+                arrs = self._shard_put(arrs)
+            else:
+                arrs = tuple(jax.device_put(a) for a in arrs)
+            outs.append(fn(tab, *arrs))
+        self.stats["ed25519_batches"] += 1
+        # ftpu-lint: allow-host-sync(end-of-batch materialization: the
+        # sub-batch's single deliberate sync point)
+        out = np.concatenate([np.asarray(o) for o in outs])
+        return out[:n].tolist()
+
+    def _ed25519_pipeline(self):
+        """Jitted (optionally shard_mapped) Ed25519 batch kernel: the
+        B-comb table rides replicated, per-lane operand rows sharded
+        on the batch axis — the digest-pipeline discipline."""
+        key = ("ed25519",)
+        with self._jit_lock:
+            if key not in self._comb_fns:
+                faults.check("tpu.compile")
+                import jax
+
+                from fabric_tpu.ops import ed25519 as edo
+                fn = edo.verify_core
+                if self._mesh is not None:
+                    from jax.sharding import PartitionSpec as P
+
+                    from fabric_tpu.common import jaxenv
+                    s = P("batch")
+                    rep = P()
+                    fn = jaxenv.shard_map(
+                        fn, mesh=self._mesh,
+                        in_specs=(rep, s, s, s, s, s, s, s),
+                        out_specs=s)
+                self._comb_fns[key] = jax.jit(fn)
+            return self._comb_fns[key]
+
+    def _ed_table(self):
+        """The persisted fixed-base B-comb table as a device array,
+        replicated across the mesh like q_flat/g16 (built through the
+        same sidecar-verified cache seam — ops/ed25519.b_tables)."""
+        with self._jit_lock:
+            if self._ed_tab is None:
+                import jax.numpy as jnp
+
+                from fabric_tpu.ops import ed25519 as edo
+                tab = jnp.asarray(edo.b_tables())
+                if self._mesh is not None:
+                    import jax
+                    from jax.sharding import (
+                        NamedSharding, PartitionSpec as P,
+                    )
+                    tab = jax.device_put(
+                        tab, NamedSharding(self._mesh, P()))
+                self._ed_tab = tab
+            return self._ed_tab
+
+    # -- BLS aggregate verify (orderer cluster/consenter identities) --
+
+    def verify_aggregate(self, keys, messages, signature) -> bool:
+        """BLS12-381 aggregate verify: the staged batched-Miller-loop
+        / shared-final-exponentiation path (`ops/bls12_381.py` —
+        host-serving today; ROADMAP item 4 lifts the loop on-device)
+        behind the `tpu.bls_aggregate` fault point. Any staged-path
+        failure serves the host reference on the embedded sw provider
+        — verdicts bit-identical (the degrade-don't-halt contract)."""
+        # materialize one-shot iterables up front: the staged loop
+        # below consumes both, and the fault fallback needs them again
+        keys = list(keys)
+        msgs = list(messages)
+        pks = []
+        for k in keys:
+            pub = k.public_key()
+            if getattr(pub, "scheme", None) != "bls12381":
+                raise TypeError("verify_aggregate requires BLS keys")
+            pks.append(pub.point)
+        # lanes counted ONCE per call, whichever path serves (the
+        # router partition invariant); dispatches only after the
+        # staged path actually produced the verdict
+        self._bump_scheme("bls", lanes=len(pks))
+        try:
+            lockcheck.note_blocking("tpu.bls_aggregate")
+            faults.check("tpu.bls_aggregate")
+            from fabric_tpu.ops import bls12_381 as blsagg
+            from fabric_tpu.ops import bls12_381_ref as bref
+            try:
+                sig = bref.g1_from_bytes(signature,
+                                         subgroup_check=False)
+            except ValueError:
+                return False
+            out = blsagg.aggregate_verify(pks, msgs, sig)
+            self.stats["bls_aggregate_checks"] += 1
+            self._bump_scheme("bls", dispatches=1)
+            return out
+        except Exception:
+            self.stats["sw_fallbacks"] += 1
+            self._bump_scheme("bls", sw_lanes=len(pks))
+            logger.exception(
+                "staged BLS aggregate verify failed; host reference "
+                "fallback for %d keys", len(pks))
+            # msgs, not messages: a one-shot iterable was already
+            # consumed by the staged path above
+            return self._sw.verify_aggregate(keys, msgs, signature)
 
     # -- the overlapped dispatch pipeline (BCCSP.TPU.PipelineChunk) --
 
@@ -756,11 +1042,9 @@ class TPUProvider(api.BCCSP):
         self.stats["host_hashed_lanes"] += hashed_total
 
         result = flat[:n].tolist()
-        if sw_lanes:
-            self.stats["nonp256_sw_lanes"] += len(sw_lanes)
-            sub = self._sw.verify_batch([items[i] for i in sw_lanes])
-            for i, v in zip(sw_lanes, sub):
-                result[i] = v
+        self._sw_scatter(
+            sw_lanes, result,
+            lambda ls: self._sw.verify_batch([items[i] for i in ls]))
         return result
 
     # -- the prepared-block path (native host pipeline) --
@@ -950,13 +1234,10 @@ class TPUProvider(api.BCCSP):
 
         def resolve() -> list[bool]:
             result = thunk()[:n].tolist()
-            if len(sw_lanes):
-                self.stats["nonp256_sw_lanes"] += len(sw_lanes)
-                sub = self._verify_prepared_sw(
-                    sw_lanes.tolist(), digests, key_idx, keys, pubs,
-                    get_sig)
-                for i, v in zip(sw_lanes.tolist(), sub):
-                    result[i] = v
+            self._sw_scatter(
+                sw_lanes.tolist(), result,
+                lambda ls: self._verify_prepared_sw(
+                    ls, digests, key_idx, keys, pubs, get_sig))
             return result
         return resolve
 

@@ -8,6 +8,12 @@ The well-known points:
     tpu.dispatch       every device batch dispatch (bccsp/tpu.py)
     tpu.compile        jit pipeline builds / AOT compiles
     tpu.table_persist  warm-table byte writers
+    tpu.ed25519        the scheme router's Ed25519 device dispatch —
+                       a fault serves the sub-batch on the host
+                       reference path, bit-identical (bccsp/tpu.py)
+    tpu.bls_aggregate  the staged BLS aggregate-verify path — a fault
+                       serves the host reference pairing product
+                       (bccsp/tpu.py verify_aggregate)
     raft.step          inbound raft messages (orderer raft chain loop)
     order.propose      the batched propose span of the ordering
                        admission window — a fault demotes the window
@@ -73,6 +79,8 @@ KNOWN_POINTS = frozenset({
     "tpu.dispatch",
     "tpu.compile",
     "tpu.table_persist",
+    "tpu.ed25519",
+    "tpu.bls_aggregate",
     "raft.step",
     "order.propose",
     "deliver.stream",

@@ -135,6 +135,27 @@ BCCSP_SHARD_LANES_OPTS = GaugeOpts(
          "device (the batch axis is dealt contiguously across the "
          "mesh).", label_names=("device",))
 
+BCCSP_SCHEME_LANES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="scheme", name="lanes",
+    help="Signature lanes the scheme-dispatch router has routed to "
+         "each per-scheme sub-batch path (p256 comb/tree pipeline, "
+         "ed25519 batch kernel, bls pairing path) since process "
+         "start.", label_names=("scheme",))
+
+BCCSP_SCHEME_SW_LANES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="scheme", name="sw_lanes",
+    help="Lanes per scheme that served on the per-lane sw/host path "
+         "instead of a device kernel (non-P-256 ECDSA curves, "
+         "sub-min-batch remainders, breaker fallbacks) — the "
+         "per-scheme split of the nonp256_sw_lanes scalar.",
+    label_names=("scheme",))
+
+BCCSP_SCHEME_DISPATCHES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="scheme", name="dispatches",
+    help="Device/aggregate dispatches the scheme router has issued "
+         "per scheme (one per routed sub-batch; for bls, one per "
+         "aggregate pairing check).", label_names=("scheme",))
+
 BCCSP_SHARD_SKEW_SECONDS_OPTS = GaugeOpts(
     namespace="bccsp", subsystem="shard", name="skew_s",
     help="Ready-time spread (max - min) across mesh devices for the "

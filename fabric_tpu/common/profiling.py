@@ -133,6 +133,22 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
             }
         except Exception:
             shard_gauges = None
+    # scheme-router gauges (scheme label = router partition key):
+    # fed from the provider's scheme_stats dicts, refreshed per poll
+    scheme_stats = getattr(csp, "scheme_stats", None)
+    scheme_gauges = None
+    if isinstance(scheme_stats, dict):
+        try:
+            scheme_gauges = {
+                "lanes": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_SCHEME_LANES_OPTS),
+                "sw_lanes": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_SCHEME_SW_LANES_OPTS),
+                "dispatches": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_SCHEME_DISPATCHES_OPTS),
+            }
+        except Exception:
+            scheme_gauges = None
     breaker = getattr(csp, "_breaker", None)
     fallback_state = fallback_trips = None
     if breaker is not None:
@@ -172,6 +188,23 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
                                 warned.add("shard_" + name)
                                 logger.warning(
                                     "bccsp shard gauge %r publish "
+                                    "failed (suppressing repeats): %s",
+                                    name, e)
+            if scheme_gauges is not None:
+                cur = getattr(csp, "scheme_stats", None)
+                if isinstance(cur, dict):
+                    for name, g in scheme_gauges.items():
+                        try:
+                            for scheme, v in dict(
+                                    cur.get(name) or {}).items():
+                                g.with_labels(
+                                    "scheme", str(scheme)).set(
+                                        float(v))
+                        except Exception as e:
+                            if ("scheme_" + name) not in warned:
+                                warned.add("scheme_" + name)
+                                logger.warning(
+                                    "bccsp scheme gauge %r publish "
                                     "failed (suppressing repeats): %s",
                                     name, e)
             if fallback_state is not None:

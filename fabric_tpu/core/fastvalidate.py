@@ -149,10 +149,14 @@ def validate_fast(v, block, bundle):
 
     # an identity without a bccsp `.key` (e.g. idemix pseudonyms, whose
     # verify key is internal to verify_item) cannot be staged as array
-    # lanes; txs touching one reroute per-tx through the reference path
+    # lanes, and neither can message-based schemes (Ed25519 modern-MSP
+    # identities: the staged lanes carry pre-hashed digests, but the
+    # scheme signs the full message); txs touching either reroute
+    # per-tx through the reference path
     keys = [getattr(ident, "key", None) for ident in idents]
     unstageable = np.array(
-        [ident is not None and key is None
+        [ident is not None and
+         (key is None or getattr(key, "sign_message", False))
          for ident, key in zip(idents, keys)] + [False])
     tx_unstageable = unstageable[np.clip(bp.creator_uid, 0,
                                          bp.n_unique)]

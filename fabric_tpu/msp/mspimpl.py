@@ -94,6 +94,12 @@ class X509Identity(api.Identity):
 
     def verify(self, msg: bytes, sig: bytes) -> bool:
         csp = self._msp.csp
+        if getattr(self.key, "sign_message", False):
+            # message-based schemes (Ed25519 modern-MSP identities):
+            # the scheme hashes internally — pre-hashing would verify
+            # the WRONG bytes (reference: FAB-18401 ed25519 bccsp
+            # passes the full message through)
+            return csp.verify(self.key, sig, msg)
         return csp.verify(self.key, sig, csp.hash(msg))
 
     def verify_item(self, msg: bytes, sig: bytes) -> VerifyItem:
@@ -116,6 +122,8 @@ class X509SigningIdentity(X509Identity, api.SigningIdentity):
 
     def sign(self, msg: bytes) -> bytes:
         csp = self._msp.csp
+        if getattr(self.key, "sign_message", False):
+            return csp.sign(self._priv, msg)
         return csp.sign(self._priv, csp.hash(msg))
 
 

@@ -1,0 +1,78 @@
+"""BLS12-381 aggregate verification — the batched, device-staged path.
+
+The provider's `verify_aggregate` serves from HERE, not from
+`bls12_381_ref` directly: this module owns the batch structure —
+per-pair Miller loops accumulated into one product, ONE shared final
+exponentiation per call — which is precisely the shape ROADMAP item 4
+lifts on-device (the 2G2T MSM-outsourcing / ACE-runtime amortization
+from PAPERS.md: the loop iterations batch across pairs; the expensive
+final exp is paid once per call whatever the batch size).
+
+Today every stage runs on the host reference (`bls12_381_ref`): the
+381-bit base field does not fit the 13x20-limb 256-bit machinery
+(`ops/limb.py` / `ops/mont.py`), so widening the limb layout — and
+transcribing `miller_products` below into a vmapped kernel — is item
+4's work. The SEAMS are cut now: `stage_pairs` produces the flat
+(G1, G2) pair list a device kernel would consume, `miller_products`
+is the only function that iterates pairs, and `check_products` is the
+single final-exp site.
+
+The host fallback twin (`bls12_381_ref.aggregate_verify`) computes
+the same predicate through its own code path — the chaos contract
+(armed `tpu.bls_aggregate` fault -> fallback) compares the two.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+from fabric_tpu.ops import bls12_381_ref as bref
+
+logger = logging.getLogger("ops.bls12_381")
+
+
+def stage_pairs(pks: Sequence, msgs: Sequence[bytes], agg_sig
+                ) -> Optional[list]:
+    """Flatten one aggregate-verify call into the pairing-product pair
+    list [(g1_point, g2_twist_point), ...] whose product must be ONE:
+    e(agg_sig, -G2) * prod_i e(H(m_i), pk_i). Returns None when an
+    input fails the structural/subgroup gates (the verdict is False
+    without touching any pairing)."""
+    if agg_sig is None or len(pks) != len(msgs) or not len(pks):
+        return None
+    if not bref.g1_in_subgroup(agg_sig):
+        return None
+    pairs = [(agg_sig, bref.g2_neg((bref.G2_X, bref.G2_Y)))]
+    for pk, msg in zip(pks, msgs):
+        if pk is None or not bref.g2_in_subgroup(pk):
+            return None
+        pairs.append((bref.hash_to_g1(msg), pk))
+    return pairs
+
+
+def miller_products(pairs) -> tuple:
+    """The batched Miller stage: one loop per pair, accumulated into a
+    single Fp12 product. THIS is the function item 4 replaces with a
+    vmapped device kernel over wide limbs (same signature: pairs in,
+    one Fp12 element out)."""
+    f = bref.F12_ONE
+    for p, q in pairs:
+        f = bref.f12_mul(f, bref.miller_loop(q, p))
+    return f
+
+
+def check_products(f) -> bool:
+    """ONE shared final exponentiation for the whole batch — the cost
+    that amortizes across however many pairs the call aggregated."""
+    return bref.final_exponentiation_fast(f) == bref.F12_ONE
+
+
+def aggregate_verify(pks, msgs, agg_sig) -> bool:
+    """The staged pipeline end to end: gate/stage -> batched Miller ->
+    one final exp. Verdict-identical to
+    `bls12_381_ref.aggregate_verify` (differential-tested)."""
+    pairs = stage_pairs(pks, msgs, agg_sig)
+    if pairs is None:
+        return False
+    return check_products(miller_products(pairs))

@@ -131,12 +131,44 @@ class BlockWriter:
     def _self_verify(self, blocks, signed) -> None:
         """One batched provider dispatch over the span's fresh block
         signatures (skipped without a csp, or for a signer that cannot
-        express verification items)."""
+        express verification items).
+
+        When the orderer's cluster identity is BLS (round-11 scheme
+        dispatch), the span's k signatures aggregate to ONE 96-byte G1
+        point and ONE `csp.verify_aggregate` pairing check replaces k
+        verify lanes — the consensus-aggregation shape from the
+        EdDSA/BLS committee measurement (PAPERS.md, 2302.00418). A
+        failed aggregate falls through to the per-signature batch for
+        block-level attribution, so the error below still names the
+        offending block numbers."""
         verify_item = getattr(self._signer, "verify_item", None)
         if self._csp is None or verify_item is None:
             return
-        ok = self._csp.verify_batch(
-            [verify_item(msg, sig) for msg, sig in signed])
+        items = [verify_item(msg, sig) for msg, sig in signed]
+        agg_verify = getattr(self._csp, "verify_aggregate", None)
+        if agg_verify is not None and items and all(
+                getattr(it.key, "scheme", None) == "bls12381"
+                for it in items):
+            from fabric_tpu.bccsp.sw import bls_aggregate_signatures
+            try:
+                agg_sig = bls_aggregate_signatures(
+                    [it.signature for it in items])
+                if agg_verify([it.key for it in items],
+                              [it.message for it in items], agg_sig):
+                    return
+            except NotImplementedError:
+                logger.warning("csp has no aggregate scheme; "
+                               "verifying the BLS span per-signature")
+            except ValueError:
+                # a signer emitting non-G1 bytes must land on the
+                # per-signature pass below (which rejects with block
+                # attribution), not crash the span write
+                logger.warning("BLS span signatures failed to "
+                               "aggregate; verifying per-signature",
+                               exc_info=True)
+            # aggregate rejected (or unsupported): the per-signature
+            # pass below attributes the failure to specific blocks
+        ok = self._csp.verify_batch(items)
         if not all(ok):
             bad = [b.header.number
                    for b, good in zip(blocks, ok) if not good]

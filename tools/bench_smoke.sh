@@ -104,6 +104,17 @@ if "skipped" not in fp and not fp.get("order_skipped"):
     assert fp.get("order_vs_validate", 0) > 0, \
         f"full_pipeline lacks order_vs_validate: {fp}"
 
+# round-11 contract: the core stage's ed25519 regime reports its own
+# throughput line or an explicit skip marker (env opt-out / budget) —
+# fields silently missing from a line that claims to have run is the
+# failure mode this guards
+ed = stages.get("ed25519") or {}
+if ed and "skipped" not in ed and "ed25519_skipped" not in ed:
+    assert ed.get("ed25519_sigs_per_s", 0) > 0, \
+        f"ed25519 stage line lacks throughput: {ed}"
+    print("bench_smoke: ed25519 regime", ed.get("ed25519_sigs_per_s"),
+          "sigs/s over", ed.get("ed25519_batch"))
+
 detail = json.load(open(final["sidecar"]))
 core1 = (detail.get("stage_detail") or {}).get("core_1dev") or {}
 stats = core1.get("provider_stats") or {}

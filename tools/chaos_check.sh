@@ -10,7 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -86,6 +86,18 @@ shard() {
         -k "Faults or MultiProcess"
 }
 
+schemes() {
+    # the round-11 scheme router under fire: armed tpu.ed25519 /
+    # tpu.bls_aggregate faults must serve every lane on the host
+    # reference path with BIT-IDENTICAL accept/reject bitmaps, then
+    # re-enter the device path through the breaker. Router tests that
+    # pin dispatch counts clear the ambient arming themselves.
+    run "tpu.ed25519=error:2;tpu.bls_aggregate=error:2" \
+        tests/test_scheme_router.py
+    run "tpu.ed25519=delay:2:0.05;tpu.dispatch=error:1" \
+        tests/test_scheme_router.py
+}
+
 order() {
     # the round-10 ordering pipeline under fire: failing batched
     # proposes demote the admission window to sequential per-block
@@ -114,8 +126,10 @@ case "${1:-all}" in
     commit) commit ;;
     shard) shard ;;
     order) order ;;
+    schemes) schemes ;;
     static) static ;;
-    all) bccsp; raft; deliver; onboarding; commit; shard; order; static ;;
+    all) bccsp; raft; deliver; onboarding; commit; shard; order;
+         schemes; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

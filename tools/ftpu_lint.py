@@ -66,6 +66,8 @@ REQUIRED_HOT_PATHS = {
     "fabric_tpu/bccsp/tpu.py": (
         "_dispatch_arrays", "_verify_batch_pipelined",
         "_dispatch_comb_digest", "_dispatch_comb", "_shard_put",
+        # round-11 scheme router: the Ed25519 device dispatch span
+        "_dispatch_ed25519",
     ),
     "fabric_tpu/core/commitpipeline.py": ("_validate_one",),
     # round-10 ordering spans: the batched raft propose and the
