@@ -758,6 +758,252 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def overload_run(producers: int = 4, ntxs_per_producer: int = 300,
+                 window: int = 24, block_txs: int = 32,
+                 budget_s: float = 0.35,
+                 events_cap: int = 48) -> dict:
+    """ISSUE 9 soak scenario: drive the REAL single-node raft ordering
+    service (threaded ready loop, admission window, write stage,
+    signed blocks) with MORE offered load than it can drain —
+    `producers` threads each broadcasting creator-signed envelopes
+    through `BroadcastHandler.process_messages` under a tight ambient
+    `Deadline` (`budget_s`) against a deliberately small raft event
+    queue (`events_cap` windows) — and assert the round-12 overload
+    contract:
+
+      * bounded: every registered overload queue's max_depth stayed
+        within its capacity (no unbounded growth anywhere);
+      * shed, not stalled: over-capacity load was refused as clean
+        per-envelope SERVICE_UNAVAILABLE, counted per stage, and no
+        producer ever blocked past its deadline budget;
+      * nothing half-applied: every ACCEPTED (SUCCESS) envelope
+        commits exactly once, every committed envelope was accepted,
+        and the committed stream replayed through a fresh SEQUENTIAL
+        (write_pipeline=False) oracle service is bit-identical;
+      * live throughout: the ledger kept advancing and the run
+        finished inside its wall budget (the soak script adds
+        FTPU_LOCKCHECK=1 on top for the no-deadlock claim).
+
+    Chaos faults ride in from FTPU_FAULTS exactly like every other
+    regime (tools/soak_check.sh arms order.propose delays + raft.step
+    errors), so shed accounting and demotion machinery are exercised
+    TOGETHER."""
+    import shutil
+    import threading
+
+    from fabric_tpu.common import overload
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protoutil.protoutil import marshal as pu_marshal
+
+    os.environ["FTPU_RAFT_EVENTS_CAP"] = str(events_cap)
+    root = tempfile.mkdtemp(prefix="bench_overload_")
+    svc = None
+    oracle = None
+    try:
+        svc = make_order_service(os.path.join(root, "hot"),
+                                 block_txs=block_txs,
+                                 batch_timeout_s=0.2)
+        client = svc.client
+
+        deadline0 = time.monotonic() + 60
+        while svc.chain.node.leader_id != svc.chain.node_id:
+            if time.monotonic() > deadline0:
+                raise RuntimeError("no raft leader after 60s")
+            time.sleep(0.01)
+
+        # pre-sign everything (CPU signing is untimed setup)
+        all_envs = [[client.envelope(p * 1_000_000 + i)
+                     for i in range(ntxs_per_producer)]
+                    for p in range(producers)]
+
+        accepted: list[list[bytes]] = [[] for _ in range(producers)]
+        shed_counts = [0] * producers
+        max_call_s = [0.0] * producers
+        errors: list = []
+
+        def producer(p: int) -> None:
+            envs = all_envs[p]
+            pos = 0
+            while pos < len(envs):
+                batch = envs[pos:pos + window]
+                pos += len(batch)
+                t0 = time.perf_counter()
+                try:
+                    with overload.Deadline.after(budget_s).applied():
+                        resps = svc.broadcast.process_messages(batch)
+                except Exception as e:      # noqa: BLE001
+                    errors.append(f"producer {p}: {e!r}")
+                    return
+                dt = time.perf_counter() - t0
+                if dt > max_call_s[p]:
+                    max_call_s[p] = dt
+                for env, resp in zip(batch, resps):
+                    if resp.status == cpb.Status.SUCCESS:
+                        accepted[p].append(pu_marshal(env))
+                    elif resp.status == \
+                            cpb.Status.SERVICE_UNAVAILABLE:
+                        shed_counts[p] += 1
+                    else:
+                        errors.append(
+                            f"producer {p}: unexpected status "
+                            f"{resp.status} {resp.info}")
+                        return
+
+        t_run0 = time.perf_counter()
+        threads = [threading.Thread(target=producer, args=(p,),
+                                    name=f"overload-producer-{p}")
+                   for p in range(producers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        offered_s = time.perf_counter() - t_run0
+        if errors:
+            raise RuntimeError("; ".join(errors[:3]))
+
+        n_accepted = sum(len(a) for a in accepted)
+        n_shed = sum(shed_counts)
+        n_offered = producers * ntxs_per_producer
+
+        # ---- drain: every accepted envelope must land ----
+        # incremental read (high-water block cursor): re-reading the
+        # whole ledger every poll tick is O(blocks^2) and starves the
+        # single-core pipeline being drained
+        ledger = svc.support.ledger
+        accepted_set = {e for a in accepted for e in a}
+        drain_deadline = time.monotonic() + 300
+        committed: list = []
+        next_block = 1
+        while True:
+            while next_block < ledger.height:
+                b = ledger.get_block(next_block)
+                if b is None:       # still in the write stage
+                    break
+                committed.extend(bytes(d) for d in b.data.data)
+                next_block += 1
+            if len(committed) >= n_accepted:
+                break
+            if time.monotonic() > drain_deadline:
+                raise RuntimeError(
+                    f"overload drain stalled: {len(committed)}/"
+                    f"{n_accepted} committed")
+            time.sleep(0.05)
+        n_blocks = next_block - 1
+        drain_s = time.perf_counter() - t_run0 - offered_s
+
+        # exactly-once: accepted == committed as multisets (and since
+        # accepted envelopes are globally unique, set+len suffice)
+        assert len(committed) == n_accepted, \
+            (len(committed), n_accepted)
+        assert set(committed) == accepted_set, \
+            "committed stream diverged from the accepted set"
+
+        # snapshot the overload stages NOW: the oracle service below
+        # re-registers same-named queues (raft.events.<channel>) and
+        # would shadow the hot run's readings
+        stages = overload.stage_stats()
+
+        # ---- sequential-oracle replay, bit-identical ----
+        # SAME client (keys + creator): the oracle must accept the
+        # exact committed bytes, and a fresh client's sig filter
+        # would rightly reject them
+        oracle = make_order_service(os.path.join(root, "oracle"),
+                                    client=client,
+                                    block_txs=block_txs,
+                                    batch_timeout_s=0.2,
+                                    write_pipeline=False,
+                                    endpoint="oracle0.example.com:7050",
+                                    endpoints=(
+                                        "oracle0.example.com:7050",))
+        odl = time.monotonic() + 60
+        while oracle.chain.node.leader_id != oracle.chain.node_id:
+            if time.monotonic() > odl:
+                raise RuntimeError("oracle: no raft leader")
+            time.sleep(0.01)
+        pos = 0
+        committed_envs = [cpb.Envelope.FromString(raw)
+                          for raw in committed]
+        while pos < len(committed_envs):
+            resps = oracle.broadcast.process_messages(
+                committed_envs[pos:pos + window])
+            ok = sum(1 for r in resps
+                     if r.status == cpb.Status.SUCCESS)
+            if ok == 0:
+                raise RuntimeError("oracle rejected the committed "
+                                   "stream")
+            pos += ok
+        olg = oracle.support.ledger
+        odeadline = time.monotonic() + 300
+        ocommitted: list = []
+        onext = 1
+        while True:
+            while onext < olg.height:
+                b = olg.get_block(onext)
+                if b is None:
+                    break
+                ocommitted.extend(bytes(d) for d in b.data.data)
+                onext += 1
+            if len(ocommitted) >= len(committed):
+                break
+            if time.monotonic() > odeadline:
+                raise RuntimeError("oracle drain stalled")
+            time.sleep(0.05)
+        assert ocommitted == committed, \
+            "sequential-oracle envelope stream diverged bit-wise"
+
+        # the oracle's creator signed the SAME key: its envelopes ARE
+        # the committed bytes, so equality above is bit-identity of
+        # everything the overloaded path committed
+
+        # ---- bounded-depth + per-stage shed accounting ----
+        depth_violations = {
+            name: s for name, s in stages.items()
+            if s.get("capacity", 0) > 0
+            and s.get("max_depth", 0) > s["capacity"]}
+        assert not depth_violations, \
+            f"queue depth exceeded its bound: {depth_violations}"
+        stage_sheds = {name: int(s.get("sheds", 0))
+                       for name, s in stages.items()
+                       if s.get("sheds")}
+
+        opstats = svc.chain.order_pipeline_stats()
+        committed_rate = (len(committed) /
+                          max(offered_s + drain_s, 1e-9))
+        offered_rate = n_offered / max(offered_s, 1e-9)
+        return {
+            "producers": producers,
+            "offered": n_offered,
+            "accepted": n_accepted,
+            "client_shed": n_shed,
+            "offered_per_s": round(offered_rate, 1),
+            "committed_per_s": round(committed_rate, 1),
+            "overcapacity_ratio": round(
+                offered_rate / max(committed_rate, 1e-9), 2),
+            "max_producer_call_s": round(max(max_call_s), 3),
+            "budget_s": budget_s,
+            "events_cap": events_cap,
+            "stage_sheds": stage_sheds,
+            "queue_max_depths": {
+                name: s.get("max_depth", 0)
+                for name, s in stages.items()
+                if s.get("capacity", 0) > 0},
+            "demotions": opstats.get("demotions"),
+            "blocks": n_blocks,
+            "accepted_commit_exact_once": True,
+            "oracle_bit_identical": True,
+            "run_s": round(offered_s + drain_s, 2),
+        }
+    finally:
+        os.environ.pop("FTPU_RAFT_EVENTS_CAP", None)
+        for s in (svc, oracle):
+            if s is not None:
+                try:
+                    s.close(flush=True)
+                except Exception:     # noqa: BLE001
+                    pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _have_openssl_cp() -> bool:
     try:
         from fabric_tpu.bccsp._crypto_compat import HAVE_CRYPTOGRAPHY
@@ -962,6 +1208,31 @@ def commit_pipeline_run(n_blocks: int = 6, ntxs: int = 24) -> dict:
 if __name__ == "__main__":
     import sys
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    if len(sys.argv) > 1 and sys.argv[1] == "overload":
+        # the round-12 soak regime (tools/soak_check.sh): arm the
+        # lock-order sanitizer FIRST when requested — locks are
+        # tracked from creation, so the patch must precede the
+        # fabric_tpu imports the run pulls in
+        from fabric_tpu.common import lockcheck
+        if os.environ.get(lockcheck.ENV_VAR):
+            lockcheck.install(
+                raise_on_violation=os.environ.get(
+                    lockcheck.ENV_VAR) == "raise")
+        out = overload_run(
+            producers=int(os.environ.get("SOAK_PRODUCERS", "4")),
+            ntxs_per_producer=int(os.environ.get("SOAK_TXS", "300")),
+            budget_s=float(os.environ.get("SOAK_BUDGET_S", "0.35")),
+            events_cap=int(os.environ.get("SOAK_EVENTS_CAP", "48")))
+        san = lockcheck.sanitizer()
+        out["lockcheck_violations"] = (
+            len(san.violations()) if san is not None else None)
+        print(json.dumps(out))
+        if san is not None and san.violations():
+            print(san.report(), file=sys.stderr)
+            sys.exit(3)
+        sys.exit(0)
+
     from fabric_tpu.bccsp import factory
     from fabric_tpu.common import jaxenv
 

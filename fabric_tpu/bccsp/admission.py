@@ -31,9 +31,11 @@ a lane onto the wrong kernel. All other BCCSP methods (including
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from fabric_tpu.common.hotpath import hot_path
+from fabric_tpu.common.overload import Deadline, OverloadError
 
 
 class _Pending:
@@ -47,7 +49,18 @@ class _Pending:
 
 
 class AdmissionWindow:
-    """Batch-coalescing facade over one BCCSP provider instance."""
+    """Batch-coalescing facade over one BCCSP provider instance.
+
+    Round 12: waiting is notification-driven (the round-10 version
+    polled `_cond.wait(timeout=0.1)` — a convoy of waiters each paid
+    up to 100ms of pure scheduling latency per dispatch; now the
+    leader notifies when verdicts scatter) and DEADLINE-AWARE: a
+    caller whose ambient `Deadline` expires while still QUEUED is
+    shed with `OverloadError` (its request never reached a device —
+    clean, retryable), while a caller whose batch is already in
+    flight waits the dispatch out (the provider's breaker deadline
+    bounds that wait; a dispatched verify cannot be recalled). The
+    convoy wait is observable as `bccsp_admission_wait_s`."""
 
     _ATTR = "__ftpu_admission_window__"
 
@@ -60,7 +73,28 @@ class AdmissionWindow:
             "window_dispatches": 0,   # provider verify_batch calls
             "window_items": 0,        # signature lanes dispatched
             "window_callers": 0,      # verify_batch calls coalesced
+            "window_sheds": 0,        # callers shed while queued
+            "window_wait_s": 0.0,     # cumulative convoy wait
+            "window_last_wait_s": 0.0,
         }
+        self._last_shed_t: Optional[float] = None
+        from fabric_tpu.common import overload
+        overload.register_stage("bccsp.admission", self)
+
+    def overload_stats(self) -> dict:
+        """The overload-registry protocol (common/overload.py): the
+        admission window is a stage like any queue — its depth is the
+        convoy length, its sheds are deadline-expired waiters."""
+        with self._cond:
+            return {
+                "depth": len(self._queue),
+                "capacity": 0,          # convoy length is self-tuning
+                "sheds": self.stats["window_sheds"],
+                "puts": self.stats["window_callers"],
+                "wait_s": self.stats["window_wait_s"],
+                "last_wait_s": self.stats["window_last_wait_s"],
+                "last_shed_t": self._last_shed_t,
+            }
 
     @classmethod
     def shared(cls, csp) -> "AdmissionWindow":
@@ -85,11 +119,34 @@ class AdmissionWindow:
         items = list(items)
         if not items:
             return []
+        deadline = Deadline.current()
         mine = _Pending(items)
+        t0 = time.perf_counter()
         with self._cond:
             self._queue.append(mine)
             while not mine.done and self._dispatching:
-                self._cond.wait(timeout=0.1)
+                timeout = None
+                if deadline is not None:
+                    timeout = deadline.remaining()
+                    if timeout <= 0:
+                        if mine in self._queue:
+                            # still only QUEUED: shed cleanly — this
+                            # request never reached a device, nothing
+                            # is half-applied, the caller retries
+                            self._queue.remove(mine)
+                            self.stats["window_sheds"] += 1
+                            self._last_shed_t = time.monotonic()
+                            raise OverloadError(
+                                "bccsp.admission",
+                                "convoy wait exceeded the deadline "
+                                "budget")
+                        # already taken by a leader: the dispatch is
+                        # in flight and bounded by the provider's
+                        # breaker deadline — wait it out (verdicts
+                        # cannot be recalled mid-dispatch)
+                        deadline = None
+                        timeout = None
+                self._cond.wait(timeout=timeout)
             if mine.done:
                 batch = None
             else:
@@ -97,6 +154,13 @@ class AdmissionWindow:
                 # I lead — take everything accumulated so far
                 self._dispatching = True
                 batch, self._queue = self._queue, []
+            # accumulate under the cond: every coalesced waiter exits
+            # concurrently after a scatter, and an unlocked += here
+            # loses addends under exactly the convoy load this stat
+            # exists to observe
+            wait = time.perf_counter() - t0
+            self.stats["window_wait_s"] += wait
+            self.stats["window_last_wait_s"] = wait
         if batch is not None:
             try:
                 self._dispatch_window(batch)
@@ -112,7 +176,9 @@ class AdmissionWindow:
     def _dispatch_window(self, batch) -> None:
         """ONE provider dispatch for every caller in `batch`, verdicts
         scattered back per caller. The provider's breaker/fallback
-        wraps the whole coalesced call."""
+        wraps the whole coalesced call. Verdict scatter happens under
+        the condition so waiters are NOTIFIED the moment their result
+        lands (no polling)."""
         flat = [it for p in batch for it in p.items]
         self.stats["window_dispatches"] += 1
         self.stats["window_items"] += len(flat)
@@ -120,15 +186,19 @@ class AdmissionWindow:
         try:
             ok = self._csp.verify_batch(flat)
         except BaseException as e:   # noqa: BLE001 — every waiter must learn
-            for p in batch:
-                p.error = e
-                p.done = True
+            with self._cond:
+                for p in batch:
+                    p.error = e
+                    p.done = True
+                self._cond.notify_all()
             return
         lo = 0
-        for p in batch:
-            p.result = list(ok[lo:lo + len(p.items)])
-            lo += len(p.items)
-            p.done = True
+        with self._cond:
+            for p in batch:
+                p.result = list(ok[lo:lo + len(p.items)])
+                lo += len(p.items)
+                p.done = True
+            self._cond.notify_all()
 
     # -- everything else is the provider's --
 

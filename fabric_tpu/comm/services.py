@@ -12,6 +12,9 @@ conventions.
 from __future__ import annotations
 
 import logging
+import queue as queue_mod
+import threading
+import time
 
 from fabric_tpu.comm.server import (
     GRPCServer, STREAM_STREAM, UNARY_STREAM, UNARY_UNARY,
@@ -74,63 +77,156 @@ def register_peer_deliver(server: GRPCServer, events_handler) -> None:
     })
 
 
+_BCAST_SHED = object()      # shed marker: holds the envelope's 1:1
+#                             response slot with SERVICE_UNAVAILABLE
+
+# one counter shared by every broadcast stream on the process
+_bcast_ingress_stats = {"sheds": 0, "last_shed_t": None}
+
+
+class _BroadcastIngressStats:
+    """Registry adapter: the per-stream queues are short-lived, so the
+    stage reading that matters — how often THIS process's broadcast
+    edge shed — aggregates across streams."""
+
+    def overload_stats(self) -> dict:
+        return {"depth": 0, "capacity": 2048,
+                "sheds": _bcast_ingress_stats["sheds"],
+                "last_shed_t": _bcast_ingress_stats["last_shed_t"]}
+
+
+_bcast_ingress_stage = _BroadcastIngressStats()
+
+
+def _register_ingress_stage() -> None:
+    # process-singleton stage entry; per-stream queues come and go
+    from fabric_tpu.common import overload
+    overload.register_stage("broadcast.ingress", _bcast_ingress_stage)
+
+
+def broadcast_stream(request_iterator, broadcast_handler,
+                     window: int = 500, inbox: int = 2048,
+                     budget_s=None):
+    """Streamed ingest (the reference's AtomicBroadcast.Broadcast
+    shape): responses are 1:1 in order, but the server drains the
+    inbound window greedily and validates it through the batched
+    entry — one signature-filter verify and one consenter enqueue
+    per window instead of per envelope.
+
+    Round 12: the overload edge. Each envelope is stamped with the
+    ingress deadline budget on arrival; if the handler cannot absorb
+    it within that budget the envelope is SHED here — a forced marker
+    holds its response slot so the client receives an IN-ORDER
+    `SERVICE_UNAVAILABLE` (reference Fabric's overloaded-orderer
+    contract) instead of a stalled stream — and the batch runs under
+    the ambient deadline so every downstream wait (admission window,
+    raft event enqueue) is bounded by the same budget."""
+    from fabric_tpu.common import overload
+
+    _register_ingress_stage()
+    q = overload.SheddingQueue("broadcast.ingress.stream",
+                               maxsize=inbox, register=False)
+    done = object()
+    stop = threading.Event()  # set when the response generator dies
+
+    def reader():
+        try:
+            for env in request_iterator:
+                if stop.is_set():
+                    return      # consumer gone: stop pumping
+                dl = overload.Deadline.after(
+                    budget_s if budget_s is not None
+                    else overload.ingress_budget_s())
+                # wait in short slices so a dying consumer (stop set)
+                # releases this thread promptly instead of holding it
+                # — and its envelope — for the full ingress budget
+                while not stop.is_set():
+                    try:
+                        q.put((env, dl), budget_s=min(
+                            0.25, max(0.0, dl.remaining())))
+                        break
+                    except overload.OverloadError:
+                        if not dl.expired():
+                            continue
+                        # shed AT THE EDGE: the marker is bound-
+                        # exempt (it replaces the envelope and must
+                        # hold its response slot), the envelope
+                        # itself is gone
+                        _bcast_ingress_stats["sheds"] += 1
+                        _bcast_ingress_stats["last_shed_t"] = \
+                            time.monotonic()
+                        q.put_forced((_BCAST_SHED, None))
+                        break
+        except Exception as e:
+            # a mid-stream client error truncates the window; the
+            # client sees fewer responses than requests and knows
+            logging.getLogger("comm.broadcast").debug(
+                "broadcast stream reader ended: %s", e)
+        finally:
+            q.put_forced(done)
+
+    threading.Thread(target=reader, daemon=True,
+                     name="broadcast-reader").start()
+
+    def unavailable():
+        return opb.BroadcastResponse(
+            status=common.Status.SERVICE_UNAVAILABLE,
+            info="orderer overloaded: broadcast ingress queue full "
+                 "past the deadline budget; retry with backoff")
+
+    try:
+        finished = False
+        while not finished:
+            first = q.get()
+            if first is done:
+                break
+            batch = [first]
+            while len(batch) < window:
+                try:
+                    nxt = q.get_nowait()
+                except queue_mod.Empty:
+                    break
+                if nxt is done:
+                    finished = True
+                    break
+                batch.append(nxt)
+            # split the drained window into contiguous runs of real
+            # envelopes (processed batched under the run's tightest
+            # remaining deadline) and shed markers (answered in place)
+            run: list = []
+            run_dl = None
+
+            def flush_run():
+                nonlocal run, run_dl
+                if not run:
+                    return
+                if run_dl is not None:
+                    with run_dl.applied():
+                        yield from \
+                            broadcast_handler.process_messages(run)
+                else:
+                    yield from broadcast_handler.process_messages(run)
+                run, run_dl = [], None
+
+            for env, dl in batch:
+                if env is _BCAST_SHED:
+                    yield from flush_run()
+                    yield unavailable()
+                    continue
+                run.append(env)
+                if dl is not None and (
+                        run_dl is None or
+                        dl.expires_at < run_dl.expires_at):
+                    run_dl = dl
+            yield from flush_run()
+    finally:
+        stop.set()      # unblock + retire the reader thread
+
+
 def register_broadcast(server: GRPCServer, broadcast_handler) -> None:
     def handle_stream(request_iterator, ctx):
-        """Streamed ingest (the reference's AtomicBroadcast.Broadcast
-        shape): responses are 1:1 in order, but the server drains the
-        inbound window greedily and validates it through the batched
-        entry — one signature-filter verify and one consenter enqueue
-        per window instead of per envelope."""
-        import logging as _logging
-        import queue as _q
-        import threading as _t
-        q: _q.Queue = _q.Queue(maxsize=2048)
-        done = object()
-        stop = _t.Event()     # set when the response generator dies
-
-        def _put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.25)
-                    return True
-                except _q.Full:
-                    continue
-            return False
-
-        def reader():
-            try:
-                for env in request_iterator:
-                    if not _put(env):
-                        return      # consumer gone: stop pumping
-            except Exception as e:
-                # a mid-stream client error truncates the window; the
-                # client sees fewer responses than requests and knows
-                _logging.getLogger("comm.broadcast").debug(
-                    "broadcast stream reader ended: %s", e)
-            finally:
-                _put(done)
-
-        _t.Thread(target=reader, daemon=True,
-                  name="broadcast-reader").start()
-        try:
-            finished = False
-            while not finished:
-                first = q.get()
-                if first is done:
-                    break
-                batch = [first]
-                while len(batch) < 500:
-                    try:
-                        nxt = q.get_nowait()
-                    except _q.Empty:
-                        break
-                    if nxt is done:
-                        finished = True
-                        break
-                    batch.append(nxt)
-                yield from broadcast_handler.process_messages(batch)
-        finally:
-            stop.set()      # unblock + retire the reader thread
+        yield from broadcast_stream(request_iterator,
+                                    broadcast_handler)
 
     server.add_service(BROADCAST_SERVICE, {
         "Broadcast": (

@@ -228,6 +228,51 @@ ORDERER_BATCH_OVERLAP_RATIO_OPTS = GaugeOpts(
          "ordering, approaching 1 = writes fully hidden.",
     label_names=("channel",))
 
+OVERLOAD_QUEUE_DEPTH_OPTS = GaugeOpts(
+    namespace="overload", subsystem="queue", name="depth",
+    help="Current depth of each registered inter-stage overload "
+         "queue (broadcast ingress, raft events, write stage, commit "
+         "pipeline, gossip inbox) — bounded by design; a depth "
+         "pinned at capacity means the stage downstream is the "
+         "bottleneck and sheds are imminent.",
+    label_names=("stage",))
+
+OVERLOAD_QUEUE_CAPACITY_OPTS = GaugeOpts(
+    namespace="overload", subsystem="queue", name="capacity",
+    help="Configured bound of each registered overload queue (0 = "
+         "self-tuning, e.g. the admission window's convoy).",
+    label_names=("stage",))
+
+OVERLOAD_QUEUE_MAX_DEPTH_OPTS = GaugeOpts(
+    namespace="overload", subsystem="queue", name="max_depth",
+    help="High-water depth each overload queue has reached since "
+         "process start — the soak rig's bounded-depth check reads "
+         "this against capacity.", label_names=("stage",))
+
+OVERLOAD_SHEDS_TOTAL_OPTS = CounterOpts(
+    namespace="overload", name="sheds_total",
+    help="Work items shed per stage: the stage could not accept the "
+         "item within the caller's deadline budget and refused it "
+         "retryably (broadcast clients see SERVICE_UNAVAILABLE). "
+         "Sustained growth means the system is running past "
+         "capacity and degrading GRACEFULLY — the alternative this "
+         "counter replaced was an unbounded stall.",
+    label_names=("stage",))
+
+OVERLOAD_PUT_WAIT_SECONDS_OPTS = GaugeOpts(
+    namespace="overload", subsystem="queue", name="wait_s",
+    help="Seconds the most recent admission into each overload queue "
+         "waited for space (backpressure before the shed horizon).",
+    label_names=("stage",))
+
+BCCSP_ADMISSION_WAIT_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="admission", name="wait_s",
+    help="Seconds the most recent verify_batch caller spent in the "
+         "admission window's convoy (queued behind an in-flight "
+         "coalesced dispatch) before its own verdicts were taken or "
+         "dispatched — the convoy latency the round-12 "
+         "condition-variable rewrite made observable.")
+
 DELIVER_RECONNECTS_OPTS = CounterOpts(
     namespace="deliver", subsystem="client", name="reconnects",
     help="Deliver-stream reconnect attempts after a stream failure "

@@ -1,0 +1,408 @@
+"""Overload protection: propagated deadlines + bounded shedding queues.
+
+Round 12. Every hot path in this tree is batched and overlapped
+(rounds 6-11), but the stages those rounds chain together — broadcast
+ingress → AdmissionWindow → raft event loop → BlockWriteStage →
+CommitPipeline — had no shared notion of a deadline, a queue bound, or
+a shed policy: sustained over-capacity load meant an indefinitely
+blocking `queue.put(...)` in the middle of the pipeline (the broadcast
+handler hung forever on a full raft event queue) or unbounded memory.
+The committee-consensus measurement in PAPERS.md (arXiv:2302.00418)
+shows throughput COLLAPSE at saturation is a consensus-layer failure
+mode; a serving system must shed cleanly at the admission edge, not
+stall in the middle. This module is that edge, in two pieces:
+
+`Deadline` — a remaining-budget context established once at ingress
+(the broadcast stream stamps each envelope with
+`Deadline.after(ingress_budget_s())`) and propagated AMBIENTLY down
+the calling thread (`with deadline.applied(): ...`): every downstream
+wait — the admission-window convoy wait, the raft event enqueue, the
+commit-pipeline backpressure wait — bounds itself by
+`Deadline.current()` without threading a parameter through every
+signature. Nesting takes the minimum (an inner stage can only shrink
+the budget, never extend the caller's).
+
+`SheddingQueue` — a bounded inter-stage queue whose blocking `put`
+is ALWAYS deadline-aware: it waits for space until the caller's
+deadline (or the process-wide `default_enqueue_budget_s()` when the
+caller carries none — there is no infinite wait), then SHEDS by
+raising `OverloadError`. A shed is a clean, retryable, client-visible
+refusal: nothing was enqueued, nothing half-applied; the broadcast
+layer maps it to `SERVICE_UNAVAILABLE` (reference Fabric's
+overloaded-orderer contract) so well-behaved clients back off and
+retry. Every queue self-registers in a process-wide registry so depth
+/ shed / wait-time surface as the `overload_*` gauges
+(`profiling.publish_overload_stats`) and as the `/healthz`
+`components.overload` state.
+
+The policy in one line: BLOCK while the budget lasts (backpressure),
+then SHED at the admission edge (graceful degradation) — and never,
+ever stall a middle stage forever.
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _queue
+import threading
+import time
+import weakref
+from typing import Optional
+
+_INGRESS_ENV = "FTPU_INGRESS_BUDGET_S"
+_ENQUEUE_ENV = "FTPU_ENQUEUE_BUDGET_S"
+
+_DEF_INGRESS_S = 30.0
+_DEF_ENQUEUE_S = 10.0
+
+# /healthz reports "shedding" while any queue shed within this window
+SHED_HEALTH_WINDOW_S = 30.0
+
+
+def _env_budget(name: str, default: float) -> float:
+    try:
+        v = float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def ingress_budget_s() -> float:
+    """The per-envelope deadline budget established at broadcast
+    ingress (FTPU_INGRESS_BUDGET_S, default 30s): the total wall an
+    envelope may spend queued across ALL stages before it is shed."""
+    return _env_budget(_INGRESS_ENV, _DEF_INGRESS_S)
+
+
+def default_enqueue_budget_s() -> float:
+    """The bound for a blocking inter-stage put whose caller carries
+    no deadline (FTPU_ENQUEUE_BUDGET_S, default 10s). This is the
+    backstop that closes the unbounded-blocking-put class: a put with
+    neither an explicit nor an ambient deadline still cannot wait
+    forever."""
+    return _env_budget(_ENQUEUE_ENV, _DEF_ENQUEUE_S)
+
+
+class OverloadError(Exception):
+    """A stage could not accept work within the deadline budget and
+    shed it. Retryable by contract: nothing was enqueued or applied —
+    the broadcast layer surfaces it as SERVICE_UNAVAILABLE, cluster
+    RPC as a SERVICE_UNAVAILABLE SubmitResponse, and internal feeders
+    simply retry the same item."""
+
+    def __init__(self, stage: str, info: str = ""):
+        super().__init__(
+            f"overloaded at {stage}: work shed"
+            + (f" ({info})" if info else "")
+            + " — retry with backoff")
+        self.stage = stage
+
+
+_tls = threading.local()
+
+
+class Deadline:
+    """An absolute expiry on the monotonic clock, carried down the
+    calling thread. Immutable; `applied()` installs it as the ambient
+    deadline (nesting takes the min) for the duration of a block."""
+
+    __slots__ = ("expires_at",)
+
+    def __init__(self, expires_at: float):
+        self.expires_at = float(expires_at)
+
+    @classmethod
+    def after(cls, budget_s: float) -> "Deadline":
+        return cls(time.monotonic() + float(budget_s))
+
+    def remaining(self) -> float:
+        return self.expires_at - time.monotonic()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def applied(self):
+        """Context manager: make this the calling thread's ambient
+        deadline. An already-tighter ambient deadline wins (a nested
+        stage can shrink the caller's budget, never extend it)."""
+        return _Applied(self)
+
+    @classmethod
+    def current(cls) -> Optional["Deadline"]:
+        return getattr(_tls, "deadline", None)
+
+    @classmethod
+    def remaining_or(cls, default: Optional[float]) -> Optional[float]:
+        """The ambient deadline's remaining budget, or `default` when
+        the thread carries none. A caller bounding a wait writes
+        `timeout = Deadline.remaining_or(fallback_budget)`."""
+        d = cls.current()
+        return default if d is None else d.remaining()
+
+    def __repr__(self) -> str:
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+class _Applied:
+    __slots__ = ("_deadline", "_prior")
+
+    def __init__(self, deadline: Deadline):
+        self._deadline = deadline
+        self._prior = None
+
+    def __enter__(self) -> Deadline:
+        self._prior = Deadline.current()
+        eff = self._deadline
+        if self._prior is not None and \
+                self._prior.expires_at < eff.expires_at:
+            eff = self._prior
+        _tls.deadline = eff
+        return eff
+
+    def __exit__(self, *exc) -> None:
+        _tls.deadline = self._prior
+
+
+# ---------------------------------------------------------------------------
+# the process-wide queue registry (gauges + /healthz read through it)
+# ---------------------------------------------------------------------------
+
+_reg_lock = threading.Lock()
+_stages: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+
+
+def register_stage(name: str, obj) -> None:
+    """Register any object exposing `overload_stats() -> dict` (depth,
+    capacity, sheds, puts, wait_s, last_wait_s, last_shed_t) under a
+    stage name. SheddingQueue self-registers; BlockWriteStage and
+    CommitPipeline register adapters. Weakly held: a halted channel's
+    queues drop out of the gauges on collection; a re-created stage of
+    the same name simply replaces the entry."""
+    with _reg_lock:
+        _stages[name] = obj
+
+
+def unregister_stage(name: str, obj=None) -> None:
+    with _reg_lock:
+        if obj is None or _stages.get(name) is obj:
+            _stages.pop(name, None)
+
+
+def stage_stats() -> dict:
+    """Snapshot of every live stage's overload readings, keyed by
+    stage name — the source for `overload_*` gauges, /healthz and the
+    soak rig's bounded-depth assertions."""
+    with _reg_lock:
+        items = list(_stages.items())
+    out = {}
+    for name, obj in items:
+        try:
+            out[name] = dict(obj.overload_stats())
+        except Exception:   # noqa: BLE001 — one dead stage must not hide the rest
+            continue
+    return out
+
+
+def total_sheds() -> int:
+    return sum(int(s.get("sheds", 0)) for s in stage_stats().values())
+
+
+def health() -> str:
+    """/healthz `components.overload` state: `ok`, or
+    `shedding:<stage,...>` while any stage shed work within the last
+    SHED_HEALTH_WINDOW_S — degraded-but-serving, like the bccsp
+    breaker (a shedding orderer is doing its job, not failing)."""
+    now = time.monotonic()
+    shedding = sorted(
+        name for name, s in stage_stats().items()
+        if s.get("last_shed_t") is not None
+        and now - s["last_shed_t"] <= SHED_HEALTH_WINDOW_S)
+    if shedding:
+        return "shedding:" + ",".join(shedding)
+    return "ok"
+
+
+# ---------------------------------------------------------------------------
+# the bounded inter-stage queue
+# ---------------------------------------------------------------------------
+
+class SheddingQueue:
+    """Bounded queue whose blocking `put` is deadline-aware and whose
+    overflow policy is SHED, not stall.
+
+    Consumer-side API is `queue.Queue`-compatible (`get(timeout=)`,
+    `get_nowait()` raising `queue.Empty`) so a drain loop swaps in
+    without changes. Producer-side:
+
+      put(item)            wait for space until the caller's deadline
+                           (ambient `Deadline.current()` unless an
+                           explicit one is passed), else the queue's
+                           `default_budget_s`; on expiry count a shed
+                           and raise OverloadError. There is NO
+                           unbounded mode.
+      put_forced(item)     bypass the bound (control items only:
+                           shutdown sentinels, shed markers that must
+                           hold a response slot). Never sheds, never
+                           blocks.
+      put_drop_oldest(item) gossip's loss-tolerant policy: on Full,
+                           drop the OLDEST entry (counted as a shed)
+                           to admit the new one.
+    """
+
+    def __init__(self, name: str, maxsize: int,
+                 default_budget_s: Optional[float] = None,
+                 register: bool = True):
+        if maxsize <= 0:
+            raise ValueError("SheddingQueue needs a positive bound "
+                             "(unbounded queues are the failure mode "
+                             "this class exists to remove)")
+        self.name = name
+        self.maxsize = maxsize
+        self._default_budget_s = default_budget_s
+        # ftpu-lint: allow-unbounded-queue(the bound is enforced by
+        # put()/offer()/put_drop_oldest above the inner queue, because
+        # put_forced — control sentinels and shed markers — must be
+        # able to exceed it; this class IS the bounded replacement the
+        # rule points everyone else at)
+        self._q: _queue.Queue = _queue.Queue()
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)
+        self.stats = {
+            "puts": 0, "sheds": 0, "drops": 0, "forced": 0,
+            "max_depth": 0, "wait_s": 0.0, "last_wait_s": 0.0,
+        }
+        self._last_shed_t: Optional[float] = None
+        if register:
+            register_stage(name, self)
+
+    # -- producer side --
+
+    def _budget_s(self, budget_s: Optional[float]) -> float:
+        if budget_s is not None:
+            return budget_s
+        d = Deadline.current()
+        if d is not None:
+            return d.remaining()
+        if self._default_budget_s is not None:
+            return self._default_budget_s
+        return default_enqueue_budget_s()
+
+    def put(self, item, deadline: Optional[Deadline] = None,
+            budget_s: Optional[float] = None) -> None:
+        """Deadline-aware admission. Priority: explicit `deadline`,
+        then explicit `budget_s`, then the ambient `Deadline.current()`,
+        then the queue's default budget, then the process-wide
+        `default_enqueue_budget_s()` — the wait is ALWAYS finite."""
+        if deadline is not None:
+            budget = deadline.remaining()
+        else:
+            budget = self._budget_s(budget_s)
+        t0 = time.monotonic()
+        expires = t0 + max(0.0, budget)
+        with self._not_full:
+            while self._q.qsize() >= self.maxsize:
+                remaining = expires - time.monotonic()
+                if remaining <= 0:
+                    self.stats["sheds"] += 1
+                    self._last_shed_t = time.monotonic()
+                    raise OverloadError(
+                        self.name,
+                        f"queue full at {self.maxsize} for "
+                        f"{max(0.0, budget):.3f}s")
+                self._not_full.wait(timeout=remaining)
+            self._q.put_nowait(item)
+            self._account_put(t0)
+
+    def offer(self, item, count_shed: bool = True) -> bool:
+        """Non-blocking, non-raising admission: True if enqueued,
+        False if full. A refusal counts as a shed unless the caller
+        says otherwise (`count_shed=False` for INTERNAL traffic like
+        raft step messages, whose loss is a protocol concern —
+        retransmission recovers it — not a client-visible refusal;
+        those land in the `drops` stat instead so sheds_total keeps
+        meaning what its help text says)."""
+        with self._not_full:
+            if self._q.qsize() >= self.maxsize:
+                if count_shed:
+                    self.stats["sheds"] += 1
+                    self._last_shed_t = time.monotonic()
+                else:
+                    self.stats["drops"] += 1
+                return False
+            self._q.put_nowait(item)
+            self._account_put(time.monotonic())
+            return True
+
+    def put_nowait(self, item) -> None:
+        """queue.Queue-compatible spelling: raises `queue.Full` when
+        at the bound (counted as a shed) — for call sites that already
+        carry a Full handler."""
+        if not self.offer(item):
+            raise _queue.Full
+
+    def put_forced(self, item) -> None:
+        """Bound-exempt enqueue for CONTROL items: shutdown sentinels
+        and shed markers (which replace a real item and must hold its
+        response slot). Using this for payload would defeat the queue;
+        the `forced` stat keeps that visible."""
+        with self._not_full:
+            self._q.put_nowait(item)
+            self.stats["forced"] += 1
+            depth = self._q.qsize()
+            if depth > self.stats["max_depth"]:
+                self.stats["max_depth"] = depth
+
+    def put_drop_oldest(self, item) -> int:
+        """Admit `item`, evicting the oldest entry if full (the evicted
+        entry counts as a shed). Returns how many entries were dropped
+        (0 normally, 1 on eviction). Gossip's policy: stale gossip is
+        worthless, fresh is not."""
+        dropped = 0
+        with self._not_full:
+            while self._q.qsize() >= self.maxsize:
+                try:
+                    self._q.get_nowait()
+                except _queue.Empty:
+                    break
+                dropped += 1
+                self.stats["sheds"] += 1
+                self._last_shed_t = time.monotonic()
+            self._q.put_nowait(item)
+            self._account_put(time.monotonic())
+        return dropped
+
+    def _account_put(self, t0: float) -> None:
+        wait = time.monotonic() - t0
+        self.stats["puts"] += 1
+        self.stats["wait_s"] += wait
+        self.stats["last_wait_s"] = wait
+        depth = self._q.qsize()
+        if depth > self.stats["max_depth"]:
+            self.stats["max_depth"] = depth
+
+    # -- consumer side (queue.Queue-compatible) --
+
+    def get(self, block: bool = True, timeout: Optional[float] = None):
+        item = self._q.get(block=block, timeout=timeout)
+        with self._not_full:
+            self._not_full.notify()
+        return item
+
+    def get_nowait(self):
+        return self.get(block=False)
+
+    def qsize(self) -> int:
+        return self._q.qsize()
+
+    def empty(self) -> bool:
+        return self._q.empty()
+
+    # -- observability --
+
+    def overload_stats(self) -> dict:
+        out = dict(self.stats)
+        out["depth"] = self._q.qsize()
+        out["capacity"] = self.maxsize
+        out["last_shed_t"] = self._last_shed_t
+        return out

@@ -159,11 +159,31 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
                 metrics_mod.BCCSP_FALLBACK_TRIPS_OPTS).with_labels()
         except Exception:
             fallback_state = fallback_trips = None
+    # the admission window (bccsp/admission.py) attaches itself to the
+    # provider; its convoy wait becomes bccsp_admission_wait_s (the
+    # window may appear AFTER this poller starts — re-probed per poll)
+    try:
+        admission_wait = metrics_provider.new_gauge(
+            metrics_mod.BCCSP_ADMISSION_WAIT_SECONDS_OPTS).with_labels()
+    except Exception:
+        admission_wait = None
 
     def poll():
         last_trips = 0
         warned: set = set()     # once per gauge, not once per poll_s
         while True:
+            if admission_wait is not None:
+                win = getattr(csp, "__ftpu_admission_window__", None)
+                if win is not None:
+                    try:
+                        admission_wait.set(float(
+                            win.stats.get("window_last_wait_s", 0.0)))
+                    except Exception as e:
+                        if "admission" not in warned:
+                            warned.add("admission")
+                            logger.warning(
+                                "bccsp admission gauge publish failed "
+                                "(suppressing repeats): %s", e)
             for name, g in gauges.items():
                 try:
                     g.set(float(stats.get(name, 0)))
@@ -223,6 +243,64 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
             time.sleep(poll_s)
 
     t = threading.Thread(target=poll, name="bccsp-stats", daemon=True)
+    t.start()
+    return t
+
+
+def publish_overload_stats(metrics_provider, poll_s: float = 5.0):
+    """Expose every registered overload stage (common/overload.py:
+    shedding queues, the admission window, the write stage, the commit
+    pipeline) as the canonical `overload_queue_{depth,capacity,
+    max_depth,wait_s}` gauges and the `overload_sheds_total` counter,
+    stage-labeled, refreshed by a daemon poller — the round-12
+    overload surfaces an operator alerts on (sheds_total growing =
+    load past capacity, shed cleanly). Returns the poller thread."""
+    from fabric_tpu.common import metrics as metrics_mod
+    from fabric_tpu.common import overload
+
+    depth_g = metrics_provider.new_gauge(
+        metrics_mod.OVERLOAD_QUEUE_DEPTH_OPTS)
+    cap_g = metrics_provider.new_gauge(
+        metrics_mod.OVERLOAD_QUEUE_CAPACITY_OPTS)
+    max_g = metrics_provider.new_gauge(
+        metrics_mod.OVERLOAD_QUEUE_MAX_DEPTH_OPTS)
+    wait_g = metrics_provider.new_gauge(
+        metrics_mod.OVERLOAD_PUT_WAIT_SECONDS_OPTS)
+    sheds_c = metrics_provider.new_counter(
+        metrics_mod.OVERLOAD_SHEDS_TOTAL_OPTS)
+
+    def poll():
+        last_sheds: dict = {}
+        warned: set = set()
+        while True:
+            for stage, s in overload.stage_stats().items():
+                try:
+                    lbl = ("stage", stage)
+                    depth_g.with_labels(*lbl).set(
+                        float(s.get("depth", 0)))
+                    cap_g.with_labels(*lbl).set(
+                        float(s.get("capacity", 0)))
+                    if "max_depth" in s:
+                        max_g.with_labels(*lbl).set(
+                            float(s["max_depth"]))
+                    if "last_wait_s" in s:
+                        wait_g.with_labels(*lbl).set(
+                            float(s["last_wait_s"]))
+                    sheds = int(s.get("sheds", 0))
+                    if sheds > last_sheds.get(stage, 0):
+                        sheds_c.with_labels(*lbl).add(
+                            sheds - last_sheds.get(stage, 0))
+                        last_sheds[stage] = sheds
+                except Exception as e:
+                    if stage not in warned:
+                        warned.add(stage)
+                        logger.warning(
+                            "overload gauge publish for %r failed "
+                            "(suppressing repeats): %s", stage, e)
+            time.sleep(poll_s)
+
+    t = threading.Thread(target=poll, name="overload-stats",
+                         daemon=True)
     t.start()
     return t
 

@@ -36,6 +36,14 @@ logger = logging.getLogger("chaincode.external")
 CHAINCODE_SERVICE = "ftpu.Chaincode"
 M = shimpb.ChaincodeMessage
 
+# bounds on the stream-pump queues (round 12): one in-flight tx per
+# stream means these stay near-empty in healthy operation — a full
+# queue is a wedged or runaway peer/chaincode, and the overflow
+# handling below (error the tx / end the pump) is the shed policy;
+# unbounded growth against a stuck consumer was the failure mode
+STREAM_QUEUE_BOUND = 256
+REPLY_QUEUE_BOUND = 64
+
 
 # ---------------------------------------------------------------------------
 # peer side
@@ -113,8 +121,8 @@ class ExternalChaincodeClient:
             f"/{CHAINCODE_SERVICE}/Connect",
             request_serializer=lambda m: m.SerializeToString(),
             response_deserializer=M.FromString)
-        self._to_cc = queue.Queue()
-        self._from_cc = queue.Queue()
+        self._to_cc = queue.Queue(maxsize=STREAM_QUEUE_BOUND)
+        self._from_cc = queue.Queue(maxsize=STREAM_QUEUE_BOUND)
 
         def outgoing():
             while True:
@@ -126,11 +134,25 @@ class ExternalChaincodeClient:
         responses = call(outgoing())
 
         def pump():
+            def _deliver(item) -> bool:
+                try:
+                    self._from_cc.put(item, timeout=self._timeout)
+                    return True
+                except queue.Full:
+                    # nobody is consuming replies: the tx reader is
+                    # gone or wedged — end the pump; its _recv timeout
+                    # resets the stream
+                    logger.warning(
+                        "ccaas %s: inbound queue full for %.0fs; "
+                        "dropping stream pump", self.name,
+                        self._timeout)
+                    return False
             try:
                 for msg in responses:
-                    self._from_cc.put(msg)
+                    if not _deliver(msg):
+                        return
             except Exception as e:
-                self._from_cc.put(e)
+                _deliver(e)
 
         self._stream_thread = threading.Thread(
             target=pump, name=f"ccaas-{self.name}", daemon=True)
@@ -153,7 +175,14 @@ class ExternalChaincodeClient:
                     self._address)
 
     def _send(self, msg) -> None:
-        self._to_cc.put(msg)
+        try:
+            self._to_cc.put(msg, timeout=self._timeout)
+        except queue.Full:
+            # the gRPC request pump stopped consuming: surface as a
+            # stream failure (callers reset + report the tx error)
+            raise ExternalChaincodeError(
+                f"chaincode {self.name} outbound queue full for "
+                f"{self._timeout:.0f}s (stream stalled)") from None
 
     def _recv(self):
         got = self._from_cc.get(timeout=self._timeout)
@@ -166,7 +195,14 @@ class ExternalChaincodeClient:
     def _reset(self) -> None:
         try:
             if self._to_cc is not None:
-                self._to_cc.put(None)
+                # drop whatever the dead stream never sent, then the
+                # bound cannot refuse the shutdown sentinel
+                try:
+                    while True:
+                        self._to_cc.get_nowait()
+                except queue.Empty:
+                    pass
+                self._to_cc.put_nowait(None)
             if self._channel is not None:
                 self._channel.close()
         # ftpu-lint: allow-swallow(teardown of an already-broken
@@ -378,17 +414,30 @@ class _Session:
         self._name = name
         self._cc = chaincode
         self._out = out_queue
-        self._replies: queue.Queue = queue.Queue()
+        self._replies: queue.Queue = queue.Queue(
+            maxsize=REPLY_QUEUE_BOUND)
 
     def request(self, msg) -> object:
-        self._out.put(msg)
+        try:
+            self._out.put(msg, timeout=30)
+        except queue.Full:
+            raise RuntimeError(
+                f"chaincode {self._name}: peer stream send queue "
+                f"full (stalled connection)") from None
         return self._replies.get(timeout=30)
 
     def handle(self, msg) -> None:
         if msg.type in (M.REGISTERED, M.READY, M.KEEPALIVE):
             return
         if msg.type == M.RESPONSE or msg.type == M.ERROR:
-            self._replies.put(msg)
+            try:
+                self._replies.put_nowait(msg)
+            except queue.Full:
+                # no tx is waiting on this many replies: a runaway or
+                # duplicate-responding peer — drop loudly, the waiting
+                # request()'s own timeout surfaces the failure
+                logger.warning("chaincode %s: reply queue full; "
+                               "dropping %s", self._name, msg.type)
             return
         if msg.type in (M.TRANSACTION, M.INIT):
             threading.Thread(target=self._run_tx, args=(msg,),
@@ -407,9 +456,17 @@ class _Session:
         except Exception as e:
             logger.exception("chaincode %s crashed", self._name)
             resp = shim.error(f"chaincode {self._name} crashed: {e}")
-        self._out.put(M(type=M.COMPLETED, txid=msg.txid,
-                        channel_id=msg.channel_id,
-                        payload=resp.SerializeToString()))
+        try:
+            self._out.put(M(type=M.COMPLETED, txid=msg.txid,
+                            channel_id=msg.channel_id,
+                            payload=resp.SerializeToString()),
+                          timeout=30)
+        except queue.Full:
+            # the peer stopped reading: the tx result cannot be
+            # delivered — the peer side times out and resets
+            logger.warning("chaincode %s: stream send queue full; "
+                           "COMPLETED for tx %s undeliverable",
+                           self._name, msg.txid)
 
 
 class ChaincodeServer:
@@ -427,7 +484,7 @@ class ChaincodeServer:
         })
 
     def _connect(self, request_iterator, context):
-        out: queue.Queue = queue.Queue()
+        out: queue.Queue = queue.Queue(maxsize=STREAM_QUEUE_BOUND)
         session = _Session(self._name, self._cc, out)
         cc_id = ppb.ChaincodeID(name=self._name)
         out.put(M(type=M.REGISTER,
@@ -441,7 +498,18 @@ class ChaincodeServer:
                 logger.warning("chaincode server [%s]: request stream "
                                "pump failed; ending session",
                                self._name, exc_info=True)
-            out.put(None)
+            # end-of-session sentinel must land even against the
+            # bound: drop undelivered output first (the peer is gone)
+            try:
+                while True:
+                    out.get_nowait()
+            except queue.Empty:
+                pass
+            try:
+                out.put_nowait(None)
+            except queue.Full:
+                logger.warning("chaincode server [%s]: could not "
+                               "signal session end", self._name)
 
         threading.Thread(target=pump_in, daemon=True).start()
         while True:

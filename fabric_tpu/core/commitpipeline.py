@@ -62,6 +62,7 @@ from typing import Callable, Optional
 
 from fabric_tpu.common import faults
 from fabric_tpu.common import metrics as metrics_mod
+from fabric_tpu.common import overload
 from fabric_tpu.common.hotpath import hot_path
 
 logger = logging.getLogger("commitpipeline")
@@ -157,9 +158,12 @@ class CommitPipeline:
 
         self.stats = {
             "submitted": 0, "validated_ahead": 0, "committed": 0,
-            "fallbacks": 0, "barriers": 0,
+            "fallbacks": 0, "barriers": 0, "sheds": 0,
             "validate_s": 0.0, "commit_s": 0.0, "overlap_s": 0.0,
         }
+        self._last_shed_t: Optional[float] = None
+        overload.register_stage(
+            f"commit.pipeline.{channel.channel_id}", self)
 
         provider = metrics_provider or metrics_mod.DisabledProvider()
         cid = channel.channel_id
@@ -196,6 +200,19 @@ class CommitPipeline:
         with self._cond:
             return self._next_seq
 
+    def overload_stats(self) -> dict:
+        """Overload-registry protocol (common/overload.py): in-flight
+        blocks are the stage's depth, deadline-expired backpressure
+        waits its sheds."""
+        with self._cond:
+            return {
+                "depth": self._inflight,
+                "capacity": self.depth + 1,
+                "sheds": self.stats["sheds"],
+                "puts": self.stats["submitted"],
+                "last_shed_t": self._last_shed_t,
+            }
+
     def submit(self, seq: int, raw: Optional[bytes] = None,
                block=None, abort=None) -> None:
         """Enqueue the next in-sequence block (bytes or parsed).
@@ -203,9 +220,19 @@ class CommitPipeline:
         (backpressure); raises the pipeline's sticky error if a
         previous block failed. `abort` (an optional threading.Event,
         e.g. the feeder's own stop flag) breaks the backpressure wait
-        so a stopping feeder is not held hostage by a slow commit."""
+        so a stopping feeder is not held hostage by a slow commit.
+
+        The backpressure wait is bounded (round 12) by the caller's
+        ambient deadline budget, else `default_enqueue_budget_s()`:
+        on expiry it raises `OverloadError` — NON-sticky and clean
+        (nothing was enqueued, `next_seq` unchanged); the feeder
+        simply retries the same block, keeping backpressure on the
+        network without an unbounded wait."""
         if raw is None and block is None:
             raise ValueError("submit needs raw bytes or a parsed block")
+        budget = overload.Deadline.remaining_or(
+            overload.default_enqueue_budget_s())
+        deadline = time.monotonic() + max(0.0, budget)
         with self._cond:
             self._raise_if_error()
             if seq != self._next_seq:
@@ -216,7 +243,15 @@ class CommitPipeline:
             while self._inflight > self.depth and \
                     self._error is None and not self._stop.is_set() \
                     and not (abort is not None and abort.is_set()):
-                self._cond.wait(timeout=0.2)
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.stats["sheds"] += 1
+                    self._last_shed_t = time.monotonic()
+                    raise overload.OverloadError(
+                        f"commit.pipeline.{self.channel.channel_id}",
+                        f"backpressure wait for block [{seq}] "
+                        f"exceeded the deadline budget")
+                self._cond.wait(timeout=min(0.2, remaining))
             self._raise_if_error()
             if self._stop.is_set() or \
                     (abort is not None and abort.is_set()):

@@ -23,6 +23,7 @@ logger = logging.getLogger("gossip.state")
 MAX_RANGE = 10  # blocks per state request (reference defAntiEntropyBatchSize)
 
 from fabric_tpu.common import metrics as _mdefs  # noqa: E402
+from fabric_tpu.common import overload as _overload  # noqa: E402
 
 STATE_HEIGHT = _mdefs.GaugeOpts(
     namespace="gossip", subsystem="state", name="height",
@@ -243,7 +244,18 @@ class GossipStateProvider:
                 seq, raw = item
                 # abort=self._stop: a stopping provider must not sit
                 # in the backpressure wait behind a slow commit
-                pipeline.submit(seq, raw=raw, abort=self._stop)
+                while True:
+                    try:
+                        pipeline.submit(seq, raw=raw,
+                                        abort=self._stop)
+                        break
+                    except _overload.OverloadError:
+                        # deadline-bounded backpressure: nothing was
+                        # enqueued — retry the SAME block in place
+                        # instead of a reset + re-fetch (the block is
+                        # still in hand; only the wait was bounded)
+                        if self._stop.is_set():
+                            return
             except Exception as e:    # noqa: BLE001 — reset + re-fetch
                 if self._stop.is_set():
                     return

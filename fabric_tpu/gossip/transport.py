@@ -32,9 +32,11 @@ from fabric_tpu.common import metrics as _m  # noqa: E402
 OVERFLOW_COUNT = _m.CounterOpts(
     namespace="gossip", subsystem="comm", name="overflow_count",
     help="The number of inbound gossip messages dropped because the "
-         "receive buffer was full (drop-oldest policy).")
-
-
+         "receive buffer was full (drop-oldest policy). Every drop is "
+         "counted — including the previously-silent case where the "
+         "re-insert after an eviction lost the race; the inbox also "
+         "surfaces depth/drops through the overload_* gauges "
+         "(common/overload.py registry).")
 
 
 class Transport:
@@ -62,7 +64,9 @@ class LocalTransport(Transport):
             OVERFLOW_COUNT)
         self._net = network
         self._handler: Optional[Handler] = None
-        self._inbox: queue.Queue = queue.Queue(maxsize=inbox_size)
+        from fabric_tpu.common import overload
+        self._inbox = overload.SheddingQueue(
+            f"gossip.inbox.{endpoint}", maxsize=inbox_size)
         self._closed = threading.Event()
         self._thread = threading.Thread(
             target=self._drain, name=f"gossip-inbox-{endpoint}",
@@ -78,19 +82,12 @@ class LocalTransport(Transport):
     # -- called by the network --
 
     def enqueue(self, sender: str, msg: gpb.SignedGossipMessage) -> None:
-        try:
-            self._inbox.put_nowait((sender, msg))
-        except queue.Full:
-            # drop-oldest: stale gossip is worthless, fresh is not
-            self._m_overflow.add(1)
-            try:
-                self._inbox.get_nowait()
-            except queue.Empty:
-                pass
-            try:
-                self._inbox.put_nowait((sender, msg))
-            except queue.Full:
-                pass
+        # drop-oldest: stale gossip is worthless, fresh is not; every
+        # evicted message is COUNTED (the old re-insert race silently
+        # lost the incoming message instead)
+        dropped = self._inbox.put_drop_oldest((sender, msg))
+        if dropped:
+            self._m_overflow.add(dropped)
 
     def _drain(self) -> None:
         while not self._closed.is_set():

@@ -67,6 +67,9 @@ class OrdererNode:
         # orderer's /metrics too, not just the peer's
         from fabric_tpu.common import profiling
         profiling.publish_provider_stats(provider, csp)
+        # round-12 overload stages (broadcast ingress, raft event
+        # queues, write stages, admission window) as overload_* gauges
+        profiling.publish_overload_stats(provider)
         msp_dir = cfg.get_path("General.LocalMSPDir")
         msp_id = cfg.get("General.LocalMSPID", "OrdererMSP")
         local_msp = X509MSP(csp)
@@ -201,6 +204,11 @@ class OrdererNode:
         # breaker: catch-up in progress never fails the health check
         self.ops.register_checker("onboarding",
                                   self.registrar.onboarding_health)
+        # overload state (ok | shedding:<stages>): shedding is
+        # degraded-but-serving — the orderer refusing load past
+        # capacity with SERVICE_UNAVAILABLE is working as designed
+        from fabric_tpu.common import overload as _overload
+        self.ops.register_checker("overload", _overload.health)
         self.ops.register_handler("/participation",
                                   self._participation_http(
                                       participation))

@@ -118,6 +118,9 @@ class PeerNode:
         # gauges (bccsp_*) on /metrics
         from fabric_tpu.common import profiling
         profiling.publish_provider_stats(provider, csp)
+        # round-12 overload stages (commit pipeline, gossip inboxes)
+        # as overload_* gauges
+        profiling.publish_overload_stats(provider)
         # pre-compile the standard validation shapes in the background
         # so the first blocks after (re)start don't stall on device
         # compilation (BCCSP.TPU.Prewarm: false to disable)
@@ -258,6 +261,11 @@ class PeerNode:
         health = getattr(csp, "health", None)
         if callable(health):
             self.ops.register_checker("bccsp", health)
+        # overload state (ok | shedding:<stages>): shedding is
+        # degraded-but-serving — load past capacity refused cleanly,
+        # never a failed health check
+        from fabric_tpu.common import overload as _overload
+        self.ops.register_checker("overload", _overload.health)
         self.ops.register_handler("/admin", self._admin_http)
         self.ops.start()
 

@@ -12,6 +12,7 @@ import logging
 import time
 
 from fabric_tpu.common import metrics as _m
+from fabric_tpu.common.overload import OverloadError
 from fabric_tpu.protos import common, orderer as ordpb
 from fabric_tpu.protoutil import protoutil as pu
 from fabric_tpu.orderer import msgprocessor
@@ -125,7 +126,18 @@ class BroadcastHandler:
             return [resp] * len(batch)
 
         t0 = time.perf_counter()
-        results = support.processor.process_normal_msgs(batch)
+        try:
+            results = support.processor.process_normal_msgs(batch)
+        except OverloadError as e:
+            # the batched sig-filter verify was shed (admission-window
+            # deadline): the whole run is refused retryably — nothing
+            # was enqueued, nothing half-applied
+            resp = ordpb.BroadcastResponse(
+                status=common.Status.SERVICE_UNAVAILABLE, info=str(e))
+            for _ in batch:
+                self._observe(self.metrics.processed_count, cid,
+                              "normal", resp.status)
+            return [resp] * len(batch)
         vdur = (time.perf_counter() - t0) / max(len(batch), 1)
         responses: list = [None] * len(batch)
         accepted: list = []
@@ -158,7 +170,10 @@ class BroadcastHandler:
                     for _, env, seq in accepted:
                         support.chain.order(env, seq)
                         n_ok += 1
-            except msgprocessor.MsgProcessorError as e:
+            except (msgprocessor.MsgProcessorError, OverloadError) as e:
+                # MsgProcessorError: transient leadership/halt;
+                # OverloadError: the consenter event queue shed past
+                # the deadline budget — both retryable, same contract
                 status, info = common.Status.SERVICE_UNAVAILABLE, str(e)
             except Exception as e:
                 logger.exception("[%s] broadcast failure", cid)
@@ -257,6 +272,12 @@ class BroadcastHandler:
                           common.Status.FORBIDDEN,
                           time.perf_counter() - t0)
             return done(common.Status.FORBIDDEN, str(e))
+        except OverloadError as e:
+            # shed in the sig-filter's admission window: retryable
+            self._observe(self.metrics.validate_duration, cid, kname,
+                          common.Status.SERVICE_UNAVAILABLE,
+                          time.perf_counter() - t0)
+            return done(common.Status.SERVICE_UNAVAILABLE, str(e))
         except msgprocessor.MsgProcessorError as e:
             self._observe(self.metrics.validate_duration, cid, kname,
                           common.Status.BAD_REQUEST,
@@ -277,10 +298,11 @@ class BroadcastHandler:
                 support.chain.configure(to_order, seq)
             else:
                 support.chain.order(to_order, seq)
-        except msgprocessor.MsgProcessorError as e:
-            # enqueue-side rejections are transient leadership/halt
-            # conditions (no leader yet, halted mid-reconfig, forward
-            # refused) — clients should back off and retry (reference:
+        except (msgprocessor.MsgProcessorError, OverloadError) as e:
+            # enqueue-side rejections are transient leadership/halt/
+            # overload conditions (no leader yet, halted mid-reconfig,
+            # forward refused, event queue shed past the deadline
+            # budget) — clients should back off and retry (reference:
             # Order on a halted/leaderless chain → SERVICE_UNAVAILABLE)
             return done(common.Status.SERVICE_UNAVAILABLE, str(e),
                         enqueue_t0=t1)

@@ -42,7 +42,7 @@ import threading
 import time
 from typing import Optional
 
-from fabric_tpu.common import faults
+from fabric_tpu.common import faults, overload
 from fabric_tpu.common.hotpath import hot_path
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
 from fabric_tpu.orderer.raft.core import LEADER, RaftNode
@@ -245,7 +245,20 @@ class RaftChain:
         self._active_window_s = (3 * election_tick *
                                  max(tick_interval_s, 1e-3))
         self.metrics.active_nodes.set(1)
-        self._events: queue.Queue = queue.Queue(maxsize=4096)
+        # round 12: the consenter event queue is a bounded SHEDDING
+        # queue — a full queue bounds the producer's wait by the
+        # caller's deadline budget and then sheds with a retryable
+        # OverloadError (surfaced as SERVICE_UNAVAILABLE), instead of
+        # hanging the broadcast handler forever. FTPU_RAFT_EVENTS_CAP
+        # shrinks the bound for the overload soak rig.
+        try:
+            events_cap = int(os.environ.get(
+                "FTPU_RAFT_EVENTS_CAP", "4096") or 4096)
+        except ValueError:
+            events_cap = 4096
+        self._events = overload.SheddingQueue(
+            f"raft.events.{support.channel_id}",
+            maxsize=max(1, events_cap))
         self._halted = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._creator: Optional[_BlockCreator] = None
@@ -312,10 +325,9 @@ class RaftChain:
 
     def halt(self) -> None:
         self._halted.set()
-        try:
-            self._events.put_nowait(None)
-        except queue.Full:
-            pass
+        # the halt sentinel is a control item: bound-exempt, so a
+        # full event queue can never make halt() hang or lose the wake
+        self._events.put_forced(None)
         if self._thread is not None:
             self._thread.join(timeout=5)
         if self._write_stage is not None:
@@ -416,10 +428,13 @@ class RaftChain:
             msg.ParseFromString(payload)
         except Exception:
             return
-        try:
-            self._events.put_nowait(("step", msg))
-        except queue.Full:
-            logger.warning("[%s] raft event queue full",
+        # a dropped step is INTERNAL protocol loss (raft
+        # retransmission recovers it), not a client-visible shed:
+        # count it in the queue's `drops` stat, keep sheds_total and
+        # /healthz `shedding` meaning real refused work
+        if not self._events.offer(("step", msg), count_shed=False):
+            logger.warning("[%s] raft event queue full; step "
+                           "message dropped",
                            self._support.channel_id)
 
     def on_submit(self, env_bytes: bytes,
@@ -442,6 +457,13 @@ class RaftChain:
             is_config = ch.type in (common.HeaderType.CONFIG,
                                     common.HeaderType.ORDERER_TRANSACTION)
             self._events.put(("order", env, config_seq, is_config))
+        except overload.OverloadError as e:
+            # full event queue past the deadline budget: backpressure
+            # to the FORWARDER, which surfaces it to its client as a
+            # retryable SERVICE_UNAVAILABLE (never a hung Submit RPC)
+            return opb.SubmitResponse(
+                channel=channel,
+                status=common.Status.SERVICE_UNAVAILABLE, info=str(e))
         except Exception as e:
             return opb.SubmitResponse(channel=channel,
                                       status=common.Status.BAD_REQUEST,

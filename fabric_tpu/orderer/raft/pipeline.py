@@ -39,7 +39,15 @@ import threading
 import time
 from typing import Callable, Optional
 
+from fabric_tpu.common import overload
+
 logger = logging.getLogger("orderer.raft.pipeline")
+
+# default bound on committed-but-unwritten blocks the stage may hold;
+# a writer that cannot keep even this much headroom is stalled, and
+# the chain's demotion machinery (sequential writes + WAL replay) is
+# the correct response — not unbounded memory growth
+MAX_PENDING = 128
 
 
 class OrderWriteError(Exception):
@@ -67,31 +75,77 @@ class BlockWriteStage:
     gauges through `profiling.publish_order_stats`."""
 
     def __init__(self, support,
-                 loop_activity: Optional[Callable] = None):
+                 loop_activity: Optional[Callable] = None,
+                 max_pending: int = MAX_PENDING):
         self._support = support
         self._cond = threading.Condition()
         self._pending: list = []
+        self._max_pending = max_pending
         self._submitted_tip: Optional[int] = None
         self._written_tip: Optional[int] = None
         self._error: Optional[OrderWriteError] = None
         self._stop = threading.Event()
         self._loop_activity = loop_activity
         self.stats = {
-            "written": 0, "spans": 0,
+            "written": 0, "spans": 0, "sheds": 0,
             "write_s": 0.0, "overlap_s": 0.0, "last_write_s": 0.0,
         }
+        self._last_shed_t: Optional[float] = None
+        overload.register_stage(
+            f"order.write.{support.channel_id}", self)
         self._thread = threading.Thread(
             target=self._write_loop,
             name=f"order-write-{support.channel_id}", daemon=True)
         self._thread.start()
+
+    def overload_stats(self) -> dict:
+        """Overload-registry protocol: pending committed blocks are
+        the stage's queue depth; a submit that timed out (and demoted
+        the chain) is its shed."""
+        with self._cond:
+            return {
+                "depth": len(self._pending),
+                "capacity": self._max_pending,
+                "sheds": self.stats["sheds"],
+                "puts": self.stats["written"] + len(self._pending),
+                "last_shed_t": self._last_shed_t,
+            }
 
     # -- raft-loop API --
 
     def submit(self, block) -> None:
         """Enqueue the next committed block (in block order). Raises
         the sticky error if an earlier span failed — the caller then
-        demotes to the sequential path."""
+        demotes to the sequential path.
+
+        Bounded (round 12): with `max_pending` blocks already held,
+        the raft loop waits for the writer — honest backpressure that
+        propagates to the admission edge (the event queue fills, the
+        broadcast clients see SERVICE_UNAVAILABLE) — but only up to
+        the deadline budget. A writer stalled past that is a failed
+        stage: OrderWriteError, and the chain demotes + replays from
+        the WAL. A committed block is NEVER dropped here — shedding
+        happens at admission, not after consensus."""
+        budget = overload.Deadline.remaining_or(
+            overload.default_enqueue_budget_s())
+        deadline = time.monotonic() + max(0.0, budget)
         with self._cond:
+            if self._error is not None:
+                raise self._error
+            while len(self._pending) >= self._max_pending and \
+                    self._error is None and not self._stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.stats["sheds"] += 1
+                    self._last_shed_t = time.monotonic()
+                    raise OrderWriteError(
+                        block.header.number,
+                        overload.OverloadError(
+                            f"order.write.{self._support.channel_id}",
+                            f"write stage full at "
+                            f"{self._max_pending} blocks past the "
+                            f"deadline budget"))
+                self._cond.wait(timeout=remaining)
             if self._error is not None:
                 raise self._error
             self._pending.append(block)
@@ -190,6 +244,7 @@ class BlockWriteStage:
                 # take everything queued: the whole run becomes ONE
                 # batched sign+verify span through the BCCSP seam
                 span, self._pending = self._pending, []
+                self._cond.notify_all()   # wake a backpressured submit
             t0 = time.perf_counter()
             try:
                 write_blocks = getattr(self._support, "write_blocks",

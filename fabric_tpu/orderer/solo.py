@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
+from fabric_tpu.common import overload
 from fabric_tpu.protos import common
 from fabric_tpu.orderer.msgprocessor import (
     CONFIG, CONFIG_UPDATE, MsgProcessorError, classify,
@@ -33,11 +34,18 @@ class _Msg:
 
 
 class SoloChain:
-    """consensus.Chain (reference: `orderer/consensus/consensus.go`)."""
+    """consensus.Chain (reference: `orderer/consensus/consensus.go`).
+
+    The message queue is a bounded SheddingQueue (round 12): a full
+    queue bounds the broadcast handler's wait by the caller's deadline
+    budget and then sheds with a retryable OverloadError (surfaced as
+    SERVICE_UNAVAILABLE) — even the dev/test consenter must not hang
+    ingress forever."""
 
     def __init__(self, support):
         self._support = support
-        self._queue: queue.Queue = queue.Queue(maxsize=1000)
+        self._queue = overload.SheddingQueue(
+            f"solo.events.{support.channel_id}", maxsize=1000)
         self._halted = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -51,7 +59,7 @@ class SoloChain:
 
     def halt(self) -> None:
         self._halted.set()
-        self._queue.put(None)  # wake the loop
+        self._queue.put_forced(None)  # wake the loop (bound-exempt)
         if self._thread is not None:
             self._thread.join(timeout=5)
 

@@ -21,6 +21,7 @@ from typing import Callable, Optional
 
 from fabric_tpu.common import faults, metrics as metrics_mod
 from fabric_tpu.common.backoff import FullJitterBackoff
+from fabric_tpu.common.overload import OverloadError
 from fabric_tpu.protos import common, orderer as ordpb
 from fabric_tpu.protoutil import protoutil as pu
 
@@ -155,8 +156,18 @@ class Deliverer:
                     # `expected` (== pipeline.next_seq within one
                     # stream: both start there and advance per block)
                     # is the single sequence tracker for both branches
-                    pipeline.submit(expected, block=block,
-                                    abort=self._stop)
+                    while True:
+                        try:
+                            pipeline.submit(expected, block=block,
+                                            abort=self._stop)
+                            break
+                        except OverloadError:
+                            # deadline-bounded backpressure: nothing
+                            # was enqueued — retry the SAME block
+                            # in place (a reset + re-seek would
+                            # re-fetch work the pipeline still holds)
+                            if self._stop.is_set():
+                                return
                     pipeline.wait_validated(expected,
                                             abort=self._stop)
                     # backoff resets only on COMMITTED progress — a

@@ -279,6 +279,88 @@ class TestHotPathCoverage:
             lint.REQUIRED_HOT_PATHS["fabric_tpu/bccsp/tpu.py"]
 
 
+class TestUnboundedQueueRule:
+    """Round-12 rule: creating an unbounded queue.Queue anywhere in
+    fabric_tpu/ is a finding — the overload-protection layer closed
+    the unbounded-inter-stage-queue class and the linter keeps it
+    closed."""
+
+    def _run(self, lint, tmp_path, source):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        with open(os.path.join(root, "fabric_tpu", "qseed.py"),
+                  "w") as f:
+            f.write(textwrap.dedent(source))
+        return [f for f in lint.run_lint(
+            root, rules=("unbounded-queue",))
+            if f.path.endswith("qseed.py")]
+
+    def test_bare_queue_is_a_finding(self, lint, tmp_path):
+        findings = self._run(lint, tmp_path, '''\
+            import queue
+            q = queue.Queue()
+        ''')
+        assert len(findings) == 1
+        assert findings[0].rule == "unbounded-queue"
+        assert "SheddingQueue" in findings[0].message
+
+    def test_maxsize_zero_is_a_finding(self, lint, tmp_path):
+        findings = self._run(lint, tmp_path, '''\
+            import queue
+            a = queue.Queue(maxsize=0)
+            b = queue.Queue(0)
+        ''')
+        assert len(findings) == 2
+
+    def test_from_import_and_alias_are_resolved(self, lint, tmp_path):
+        findings = self._run(lint, tmp_path, '''\
+            import queue as _q
+            from queue import Queue, LifoQueue
+            a = _q.Queue()
+            b = Queue()
+            c = LifoQueue()
+        ''')
+        assert len(findings) == 3
+
+    def test_bounded_and_unrelated_are_clean(self, lint, tmp_path):
+        findings = self._run(lint, tmp_path, '''\
+            import queue
+
+            class Queue:          # a local class, not queue.Queue
+                pass
+
+            def mk(n):
+                return queue.Queue(maxsize=n)   # runtime-checked bound
+
+            a = queue.Queue(maxsize=64)
+            b = queue.Queue(16)
+            c = Queue
+        ''')
+        assert findings == []
+
+    def test_waiver_suppresses_with_reason(self, lint, tmp_path):
+        findings = self._run(lint, tmp_path, '''\
+            import queue
+            # ftpu-lint: allow-unbounded-queue(bound enforced by the
+            # wrapper class above this inner queue)
+            a = queue.Queue()
+            b = queue.Queue()     # unwaived: still a finding
+        ''')
+        assert len(findings) == 1
+        assert findings[0].line == 5    # `b = ...`; `a` is waived
+
+    def test_overload_module_owns_the_waived_exception(self, lint):
+        """The tree's ONLY unbounded queue is SheddingQueue's inner
+        one, waived with its reason (put_forced must exceed the
+        bound)."""
+        findings = [f for f in lint.run_lint(
+            REPO, rules=("unbounded-queue",))]
+        assert findings == []
+        src = open(os.path.join(REPO, "fabric_tpu", "common",
+                                "overload.py")).read()
+        assert "allow-unbounded-queue(" in src
+
+
 class TestTreeAtHead:
     def test_tree_is_clean(self, lint):
         findings = lint.run_lint(REPO)

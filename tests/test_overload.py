@@ -1,0 +1,878 @@
+"""Round-12 overload protection (ISSUE 9).
+
+The claims under test, over `common/overload.py` and the five seams
+it wires (broadcast ingress, AdmissionWindow, raft event queue,
+BlockWriteStage, CommitPipeline):
+
+  * a full queue sheds at the deadline horizon with a RETRYABLE
+    error (`SERVICE_UNAVAILABLE` at the broadcast/stream edges),
+    never an indefinite stall;
+  * deadline expiry mid-pipeline never half-applies: a shed envelope
+    commits nowhere, an accepted envelope commits exactly once;
+  * the admission window is notification-driven and sheds only
+    callers still QUEUED (in-flight dispatches complete);
+  * demotion paths (write stage, commit pipeline fallback) still
+    drain under saturation;
+  * every stage's depth/shed/wait readings surface through the
+    overload registry, the overload_* gauges and /healthz.
+
+Chaos-armed runs (tools/chaos_check.sh overload) re-run this file
+with order.propose/tpu.dispatch/raft.step faults live: sheds must
+stay clean refusals whichever path serves. The lockcheck-armed run
+(tools/static_check.sh) covers the no-deadlock claim.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import pytest
+
+import bench_pipeline as bp
+from fabric_tpu.common import faults, overload
+from fabric_tpu.common.overload import (
+    Deadline, OverloadError, SheddingQueue,
+)
+
+
+class TestDeadline:
+    def test_after_remaining_expired(self):
+        d = Deadline.after(0.5)
+        assert 0.0 < d.remaining() <= 0.5
+        assert not d.expired()
+        assert Deadline.after(-1).expired()
+
+    def test_ambient_applied_and_restored(self):
+        assert Deadline.current() is None
+        with Deadline.after(5).applied() as d:
+            assert Deadline.current() is d
+        assert Deadline.current() is None
+
+    def test_nesting_takes_the_minimum(self):
+        with Deadline.after(10).applied() as outer:
+            with Deadline.after(100).applied() as inner:
+                # the looser inner deadline cannot EXTEND the budget
+                assert inner is outer or \
+                    inner.expires_at == outer.expires_at
+                assert Deadline.current().remaining() <= 10
+            with Deadline.after(0.1).applied() as tight:
+                assert Deadline.current().remaining() <= 0.1
+                assert tight.expires_at < outer.expires_at
+            assert Deadline.current() is outer
+
+    def test_thread_isolation(self):
+        seen = []
+
+        def probe():
+            seen.append(Deadline.current())
+
+        with Deadline.after(5).applied():
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+        assert seen == [None]
+
+    def test_remaining_or(self):
+        assert Deadline.remaining_or(7.5) == 7.5
+        with Deadline.after(2).applied():
+            assert Deadline.remaining_or(7.5) <= 2
+
+    def test_env_budgets(self, monkeypatch):
+        monkeypatch.setenv("FTPU_INGRESS_BUDGET_S", "12.5")
+        monkeypatch.setenv("FTPU_ENQUEUE_BUDGET_S", "3.5")
+        assert overload.ingress_budget_s() == 12.5
+        assert overload.default_enqueue_budget_s() == 3.5
+        monkeypatch.setenv("FTPU_INGRESS_BUDGET_S", "bogus")
+        assert overload.ingress_budget_s() == 30.0
+
+
+class TestSheddingQueueShed:
+    def test_put_get_roundtrip(self):
+        q = SheddingQueue("t.rt", maxsize=4, register=False)
+        q.put("a")
+        q.put("b")
+        assert q.get_nowait() == "a"
+        assert q.get(timeout=0.1) == "b"
+        with pytest.raises(queue.Empty):
+            q.get_nowait()
+
+    def test_full_queue_sheds_at_budget(self):
+        q = SheddingQueue("t.full", maxsize=1, default_budget_s=0.05,
+                          register=False)
+        q.put("x")
+        t0 = time.monotonic()
+        with pytest.raises(OverloadError):
+            q.put("y")
+        dt = time.monotonic() - t0
+        assert 0.04 <= dt < 2.0, "shed must land at the budget horizon"
+        assert q.overload_stats()["sheds"] == 1
+        assert q.overload_stats()["last_shed_t"] is not None
+        # the shed left nothing behind
+        assert q.get_nowait() == "x"
+        assert q.empty()
+
+    def test_ambient_deadline_bounds_the_put(self):
+        q = SheddingQueue("t.amb", maxsize=1, default_budget_s=30.0,
+                          register=False)
+        q.put("x")
+        with Deadline.after(0.05).applied():
+            t0 = time.monotonic()
+            with pytest.raises(OverloadError):
+                q.put("y")
+            assert time.monotonic() - t0 < 2.0
+
+    def test_unblocks_when_space_frees(self):
+        q = SheddingQueue("t.free", maxsize=1, default_budget_s=5.0,
+                          register=False)
+        q.put("x")
+        got = []
+
+        def consumer():
+            time.sleep(0.05)
+            got.append(q.get(timeout=1))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        q.put("y")          # must ride the freed slot, not shed
+        t.join()
+        assert got == ["x"]
+        assert q.get_nowait() == "y"
+        assert q.overload_stats()["sheds"] == 0
+
+    def test_put_forced_bypasses_bound(self):
+        q = SheddingQueue("t.forced", maxsize=1, register=False)
+        q.put("x")
+        q.put_forced(None)
+        assert q.qsize() == 2
+        assert q.overload_stats()["forced"] == 1
+
+    def test_put_nowait_raises_queue_full(self):
+        q = SheddingQueue("t.nowait", maxsize=1, register=False)
+        q.put_nowait("x")
+        with pytest.raises(queue.Full):
+            q.put_nowait("y")
+        assert q.overload_stats()["sheds"] == 1
+
+    def test_drop_oldest(self):
+        q = SheddingQueue("t.drop", maxsize=2, register=False)
+        assert q.put_drop_oldest(1) == 0
+        assert q.put_drop_oldest(2) == 0
+        assert q.put_drop_oldest(3) == 1
+        assert [q.get_nowait(), q.get_nowait()] == [2, 3]
+        assert q.overload_stats()["sheds"] == 1
+
+    def test_rejects_nonpositive_bound(self):
+        with pytest.raises(ValueError):
+            SheddingQueue("t.bad", maxsize=0, register=False)
+
+    def test_registry_and_health(self):
+        q = SheddingQueue("t.reg.health", maxsize=1,
+                          default_budget_s=0.01)
+        try:
+            q.put("x")
+            assert "t.reg.health" in overload.stage_stats()
+            with pytest.raises(OverloadError):
+                q.put("y")
+            assert "t.reg.health" in overload.health()
+            assert overload.health().startswith("shedding:")
+        finally:
+            overload.unregister_stage("t.reg.health", q)
+        assert "t.reg.health" not in overload.stage_stats()
+
+    def test_max_depth_high_water(self):
+        q = SheddingQueue("t.hw", maxsize=8, register=False)
+        for i in range(5):
+            q.put(i)
+        for _ in range(5):
+            q.get_nowait()
+        s = q.overload_stats()
+        assert s["max_depth"] == 5 and s["depth"] == 0
+
+
+class _BlockingCSP:
+    """Stub provider: verify_batch parks on an event, recording what
+    it was asked to verify."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.calls: list = []
+
+    def verify_batch(self, items):
+        self.calls.append(list(items))
+        assert self.release.wait(timeout=10), "test never released csp"
+        return [True] * len(items)
+
+
+class TestAdmissionWindowShed:
+    def _window(self):
+        from fabric_tpu.bccsp.admission import AdmissionWindow
+        csp = _BlockingCSP()
+        return AdmissionWindow(csp), csp
+
+    def test_queued_caller_sheds_at_deadline(self):
+        win, csp = self._window()
+        leader_done = []
+
+        def leader():
+            leader_done.append(win.verify_batch(["L1", "L2"]))
+
+        t = threading.Thread(target=leader)
+        t.start()
+        for _ in range(200):            # leader in flight
+            if csp.calls:
+                break
+            time.sleep(0.005)
+        assert csp.calls, "leader never dispatched"
+
+        with Deadline.after(0.05).applied():
+            with pytest.raises(OverloadError):
+                win.verify_batch(["W1"])
+        assert win.stats["window_sheds"] == 1
+        csp.release.set()
+        t.join(timeout=5)
+        assert leader_done == [[True, True]]
+        # the shed caller's lanes never reached the provider
+        assert all("W1" not in call for call in csp.calls)
+
+    def test_inflight_caller_waits_out_the_dispatch(self):
+        """A caller whose batch was already taken by a leader is NOT
+        shed at its deadline: dispatched verdicts cannot be recalled,
+        and the provider's breaker bounds the wait."""
+        win, csp = self._window()
+        results = {}
+
+        def call(tag, items, budget=None):
+            try:
+                if budget is None:
+                    results[tag] = win.verify_batch(items)
+                else:
+                    with Deadline.after(budget).applied():
+                        results[tag] = win.verify_batch(items)
+            except BaseException as e:   # noqa: BLE001
+                results[tag] = e
+
+        t1 = threading.Thread(target=call, args=("leader", ["A"]))
+        t1.start()
+        for _ in range(200):
+            if csp.calls:
+                break
+            time.sleep(0.005)
+        # the second caller queues, then a THIRD leader takes it after
+        # the first dispatch returns — here we release quickly so the
+        # deadline (0.15s) expires only while caller 2 is mid-flight
+        t2 = threading.Thread(target=call,
+                              args=("mid", ["B"], 0.15))
+        t2.start()
+        time.sleep(0.05)
+        csp.release.set()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        assert results["leader"] == [True]
+        assert results["mid"] == [True], (
+            "an in-flight (or promptly-led) caller must receive "
+            "verdicts, not a shed")
+
+    def test_notification_not_polling(self):
+        """Waiters wake promptly when the leader's verdicts scatter —
+        the round-10 implementation polled at 100ms, so a convoy of N
+        waiters paid up to N*100ms of pure scheduling latency."""
+        win, csp = self._window()
+        done_at = {}
+
+        def call(tag, items):
+            win.verify_batch(items)
+            done_at[tag] = time.perf_counter()
+
+        t1 = threading.Thread(target=call, args=("leader", ["A"]))
+        t1.start()
+        for _ in range(200):
+            if csp.calls:
+                break
+            time.sleep(0.005)
+        t2 = threading.Thread(target=call, args=("w", ["B"]))
+        t2.start()
+        time.sleep(0.05)    # w is queued behind the in-flight leader
+        t0 = time.perf_counter()
+        csp.release.set()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        # both the leader's return AND the follower's own dispatch
+        # completed; the follower led its own (instant) dispatch after
+        # one notification — far under a single 100ms poll tick
+        assert done_at["w"] - t0 < 0.09, (
+            f"waiter took {done_at['w'] - t0:.3f}s after release — "
+            "polling, not notification")
+        assert win.stats["window_wait_s"] > 0
+        assert win.stats["window_last_wait_s"] >= 0
+
+    def test_no_deadline_caller_never_sheds(self):
+        win, csp = self._window()
+        out = []
+        t1 = threading.Thread(
+            target=lambda: out.append(win.verify_batch(["A"])))
+        t1.start()
+        for _ in range(200):
+            if csp.calls:
+                break
+            time.sleep(0.005)
+        t2 = threading.Thread(
+            target=lambda: out.append(win.verify_batch(["B"])))
+        t2.start()
+        time.sleep(0.05)
+        csp.release.set()
+        t1.join(timeout=5)
+        t2.join(timeout=5)
+        assert out and all(r == [True] for r in out) and len(out) == 2
+        assert win.stats["window_sheds"] == 0
+
+    def test_registry_stage(self):
+        win, _csp = self._window()
+        assert "bccsp.admission" in overload.stage_stats()
+        s = win.overload_stats()
+        assert s["sheds"] == 0 and s["depth"] == 0
+
+
+def _elect(chain, max_ticks: int = 400):
+    from fabric_tpu.orderer.raft.core import LEADER
+    for _ in range(max_ticks):
+        chain.node.tick()
+        chain._drain_ready()
+        if chain.node.state == LEADER:
+            return
+    raise AssertionError("single-node chain never elected itself")
+
+
+class TestChainShed:
+    """The raft event queue's overload contract, against the REAL
+    chain (bench_pipeline stub seam, loop driven synchronously so the
+    queue genuinely fills)."""
+
+    @pytest.fixture()
+    def svc(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("FTPU_RAFT_EVENTS_CAP", "4")
+        svc = bp.make_order_service(str(tmp_path / "svc"),
+                                    start=False, block_txs=4)
+        _elect(svc.chain)
+        yield svc
+        svc.close(flush=True)
+
+    def _fill_events(self, svc) -> int:
+        n = 0
+        env = svc.client.envelope(990000 + n)
+        while True:
+            try:
+                with Deadline.after(0.01).applied():
+                    svc.chain.order_batch([(env, 0)])
+                n += 1
+                env = svc.client.envelope(990000 + n)
+            except OverloadError:
+                return n
+
+    def test_full_event_queue_sheds_retryably(self, svc):
+        filled = self._fill_events(svc)
+        assert filled == 4      # the FTPU_RAFT_EVENTS_CAP bound
+        stats = svc.chain._events.overload_stats()
+        assert stats["sheds"] >= 1
+        # retryable: drive the loop synchronously — one drain handles
+        # the backlog, the queue frees, and a retry of the SAME
+        # operation lands
+        evs = []
+        while True:
+            try:
+                evs.append(svc.chain._events.get_nowait())
+            except queue.Empty:
+                break
+        window = [(e[1][0][0], e[1][0][1], False) for e in evs
+                  if e[0] == "order_batch"]
+        svc.chain._process_order_window(window)
+        svc.chain._drain_ready()
+        with Deadline.after(1.0).applied():
+            assert svc.chain.order_batch(
+                [(svc.client.envelope(999999), 0)]) == 1
+
+    def test_shed_envelope_never_commits(self, svc):
+        accepted = []
+        shed = []
+        for i in range(10):
+            env = svc.client.envelope(880000 + i)
+            try:
+                with Deadline.after(0.01).applied():
+                    svc.chain.order_batch([(env, 0)])
+                accepted.append(env)
+            except OverloadError:
+                shed.append(env)
+        assert shed, "queue never filled — rig broken"
+        # drain + process everything accepted
+        evs = []
+        while True:
+            try:
+                evs.append(svc.chain._events.get_nowait())
+            except queue.Empty:
+                break
+        window = [(e[1][0][0], e[1][0][1], False) for e in evs
+                  if e[0] == "order_batch"]
+        svc.chain._process_order_window(window)
+        svc.chain._drain_ready()
+        stage = svc.chain._write_stage
+        if stage is not None:
+            assert stage.drain(timeout=30)
+        lg = svc.support.ledger
+        committed = {bytes(d)
+                     for n in range(1, lg.height)
+                     for d in lg.get_block(n).data.data}
+        from fabric_tpu.protoutil import protoutil as pu
+        for env in accepted:
+            assert pu.marshal(env) in committed, \
+                "accepted envelope lost"
+        for env in shed:
+            assert pu.marshal(env) not in committed, \
+                "SHED envelope committed — half-applied state"
+
+    def test_broadcast_maps_shed_to_service_unavailable(self, svc):
+        from fabric_tpu.protos import common as cpb
+        self._fill_events(svc)
+        with Deadline.after(0.01).applied():
+            resps = svc.broadcast.process_messages(
+                [svc.client.envelope(770000)])
+        assert len(resps) == 1
+        assert resps[0].status == cpb.Status.SERVICE_UNAVAILABLE
+
+    def test_on_submit_returns_service_unavailable(self, svc):
+        from fabric_tpu.protos import common as cpb
+        from fabric_tpu.protoutil import protoutil as pu
+        self._fill_events(svc)
+        with Deadline.after(0.01).applied():
+            resp = svc.chain.on_submit(
+                pu.marshal(svc.client.envelope(660000)))
+        assert resp.status == cpb.Status.SERVICE_UNAVAILABLE
+
+    def test_halt_with_full_queue(self, svc):
+        self._fill_events(svc)
+        # halt's sentinel is bound-exempt: this returns promptly even
+        # though the queue is at capacity (the loop was never started,
+        # so join is immediate)
+        t0 = time.monotonic()
+        svc.chain.halt()
+        assert time.monotonic() - t0 < 5
+        assert svc.chain.errored()
+
+
+class _Env:
+    """Minimal envelope stand-in for the stream-shed test."""
+
+    def __init__(self, i):
+        self.i = i
+
+
+class TestBroadcastStreamShed:
+    def _drive(self, n_envs, handler, **kw):
+        from fabric_tpu.comm.services import broadcast_stream
+        envs = [_Env(i) for i in range(n_envs)]
+        return envs, list(broadcast_stream(iter(envs), handler, **kw))
+
+    def test_responses_stay_one_to_one_under_shed(self):
+        from fabric_tpu.protos import common as cpb
+        from fabric_tpu.protos import orderer as opb
+        release = threading.Event()
+
+        class SlowHandler:
+            def __init__(self):
+                self.seen = []
+
+            def process_messages(self, batch):
+                # the FIRST window parks until released — the reader
+                # must shed everything its budget can't hold
+                if not self.seen:
+                    assert release.wait(timeout=10)
+                self.seen.append(list(batch))
+                return [opb.BroadcastResponse(
+                    status=cpb.Status.SUCCESS)] * len(batch)
+
+        handler = SlowHandler()
+        t = threading.Timer(0.3, release.set)
+        t.start()
+        try:
+            envs, resps = self._drive(40, handler, inbox=4,
+                                      budget_s=0.05)
+        finally:
+            t.cancel()
+            release.set()
+        assert len(resps) == 40, "responses must stay 1:1 in order"
+        sheds = [r for r in resps
+                 if r.status == cpb.Status.SERVICE_UNAVAILABLE]
+        oks = [r for r in resps if r.status == cpb.Status.SUCCESS]
+        assert sheds, "no shed despite a parked consumer"
+        assert len(sheds) + len(oks) == 40
+        # every non-shed envelope reached the handler exactly once
+        n_handled = sum(len(b) for b in handler.seen)
+        assert n_handled == len(oks)
+
+    def test_quiet_stream_sheds_nothing(self):
+        from fabric_tpu.protos import common as cpb
+        from fabric_tpu.protos import orderer as opb
+
+        class Echo:
+            def process_messages(self, batch):
+                return [opb.BroadcastResponse(
+                    status=cpb.Status.SUCCESS)] * len(batch)
+
+        _envs, resps = self._drive(25, Echo())
+        assert len(resps) == 25
+        assert all(r.status == cpb.Status.SUCCESS for r in resps)
+
+    def test_ambient_deadline_reaches_the_handler(self):
+        from fabric_tpu.protos import common as cpb
+        from fabric_tpu.protos import orderer as opb
+        seen = []
+
+        class Probe:
+            def process_messages(self, batch):
+                seen.append(Deadline.current())
+                return [opb.BroadcastResponse(
+                    status=cpb.Status.SUCCESS)] * len(batch)
+
+        self._drive(3, Probe(), budget_s=5.0)
+        assert seen and all(d is not None for d in seen), \
+            "handler must run under the ingress deadline"
+
+
+class _WedgeSupport:
+    channel_id = "wedge"
+
+    def __init__(self):
+        self.entered = threading.Event()
+        self.release = threading.Event()
+        self.written = []
+
+    def write_block(self, block):
+        self.entered.set()
+        assert self.release.wait(timeout=30)
+        self.written.append(block)
+
+
+class _FakeBlock:
+    def __init__(self, n):
+        import types
+        self.header = types.SimpleNamespace(number=n)
+
+
+class TestWriteStageBound:
+    def test_submit_bounds_then_demotes(self):
+        from fabric_tpu.orderer.raft.pipeline import (
+            BlockWriteStage, OrderWriteError,
+        )
+        sup = _WedgeSupport()
+        stage = BlockWriteStage(sup, max_pending=2)
+        try:
+            # block 0: wait until the worker has TAKEN it into a span
+            # (wedged inside write_block), so the pending fill below
+            # is deterministic
+            with Deadline.after(2.0).applied():
+                stage.submit(_FakeBlock(0))
+            assert sup.entered.wait(timeout=10)
+            for n in (1, 2):        # fill the pending bound exactly
+                with Deadline.after(2.0).applied():
+                    stage.submit(_FakeBlock(n))
+            t0 = time.monotonic()
+            with Deadline.after(0.05).applied():
+                with pytest.raises(OrderWriteError) as ei:
+                    stage.submit(_FakeBlock(3))
+            assert time.monotonic() - t0 < 2.0
+            assert isinstance(ei.value.cause, OverloadError)
+            assert stage.overload_stats()["sheds"] == 1
+        finally:
+            sup.release.set()
+            stage.stop(flush=True, timeout=10)
+        # everything SUBMITTED was written — a committed block is
+        # never dropped by the bound (3 was refused, not lost: the
+        # chain demotes and replays it from the WAL)
+        assert [b.header.number for b in sup.written] == [0, 1, 2]
+
+    def test_drains_under_saturation(self):
+        """The demotion-free path: a slow-but-moving writer with the
+        queue pinned at its bound still drains everything."""
+        from fabric_tpu.orderer.raft.pipeline import BlockWriteStage
+
+        class Slow:
+            channel_id = "slow"
+
+            def __init__(self):
+                self.written = []
+
+            def write_block(self, block):
+                time.sleep(0.005)
+                self.written.append(block.header.number)
+
+            def write_blocks(self, blocks):
+                for b in blocks:
+                    self.write_block(b)
+
+        sup = Slow()
+        stage = BlockWriteStage(sup, max_pending=2)
+        try:
+            for n in range(20):
+                stage.submit(_FakeBlock(n))
+            assert stage.drain(timeout=30)
+        finally:
+            stage.stop(flush=True, timeout=10)
+        assert sup.written == list(range(20))
+
+
+class TestCommitPipelineShed:
+    def _pipeline(self, tmp_path, commit_sleep=0.0):
+        from fabric_tpu.core.commitpipeline import CommitPipeline
+
+        class Result:
+            codes = []
+            vp_dirty = False
+            duration_s = 0.0
+
+        class Validator:
+            def validate_ahead(self, block, known_txids=None):
+                return Result()
+
+            def publish_validation(self, block, result):
+                pass
+
+        class Store:
+            def block_tx_ids(self, block):
+                return []
+
+        class Ledger:
+            height = 1
+            block_store = Store()
+
+        class Chan:
+            channel_id = "shedchan"
+            ledger = Ledger()
+            validator = Validator()
+            release = threading.Event()
+
+            def commit_validated(self, block, codes, rwsets=None,
+                                 tx_ids=None):
+                if commit_sleep:
+                    time.sleep(commit_sleep)
+                else:
+                    assert Chan.release.wait(timeout=30)
+                Ledger.height = block.header.number + 1
+                return codes
+
+            def process_block(self, block):
+                return self.commit_validated(block, [])
+
+        chan = Chan()
+        return CommitPipeline(chan, mcs=None, depth=1), chan
+
+    @staticmethod
+    def _block(n):
+        from fabric_tpu.protos import common as cpb
+        b = cpb.Block()
+        b.header.number = n
+        return b
+
+    def test_backpressure_wait_sheds_clean(self, tmp_path):
+        pipeline, chan = self._pipeline(tmp_path)
+        try:
+            # depth 1 → at most 2 blocks in flight without a commit:
+            # 1 and 2 admit immediately, 3 hits the backpressure wait
+            for n in (1, 2):
+                with Deadline.after(5).applied():
+                    pipeline.submit(n, block=self._block(n))
+            next_before = pipeline.next_seq
+            with Deadline.after(0.05).applied():
+                with pytest.raises(OverloadError):
+                    pipeline.submit(3, block=self._block(3))
+            # NON-sticky and nothing enqueued: next_seq unchanged,
+            # check_error clean, the SAME submit succeeds once the
+            # wedge clears
+            assert pipeline.next_seq == next_before
+            pipeline.check_error()
+            assert pipeline.stats["sheds"] == 1
+            chan.release.set()
+            with Deadline.after(30).applied():
+                pipeline.submit(3, block=self._block(3))
+            pipeline.drain(timeout=30)
+            assert pipeline.stats["committed"] == 3
+        finally:
+            chan.release.set()
+            pipeline.stop()
+
+    def test_demotion_path_drains_under_saturation(self, tmp_path):
+        """Stage-A faults demote blocks to the sequential fallback
+        while the feeder saturates the depth — everything still
+        commits, sheds stay clean refusals."""
+        pipeline, chan = self._pipeline(tmp_path, commit_sleep=0.003)
+        faults.arm("commit.validate_ahead", mode="error", count=3)
+        try:
+            n = 1
+            while n <= 12:
+                try:
+                    with Deadline.after(0.05).applied():
+                        pipeline.submit(n, block=self._block(n))
+                    n += 1
+                except OverloadError:
+                    continue        # retry the same block
+            pipeline.drain(timeout=60)
+            assert pipeline.stats["committed"] == 12
+            assert pipeline.stats["fallbacks"] >= 1, \
+                "armed faults should have demoted blocks"
+        finally:
+            faults.disarm("commit.validate_ahead")
+            pipeline.stop()
+
+    def test_registry_stage(self, tmp_path):
+        pipeline, chan = self._pipeline(tmp_path, commit_sleep=0.0)
+        try:
+            assert "commit.pipeline.shedchan" in overload.stage_stats()
+        finally:
+            chan.release.set()
+            pipeline.stop()
+
+
+class TestExternalQueueBounds:
+    def test_session_request_full_out_queue(self):
+        from fabric_tpu.core.chaincode import external
+
+        out: queue.Queue = queue.Queue(maxsize=1)
+        out.put_nowait("occupied")
+        session = external._Session("cc", None, out)
+        # monkeys: shrink the 30s wait by prefilling and patching put
+        t0 = time.monotonic()
+        orig_put = out.put
+
+        def fast_put(item, timeout=None):
+            return orig_put(item, timeout=0.05)
+
+        out.put = fast_put
+        with pytest.raises(RuntimeError, match="send queue full"):
+            session.request(object())
+        assert time.monotonic() - t0 < 5
+
+    def test_session_reply_overflow_drops_loudly(self, caplog):
+        from fabric_tpu.core.chaincode import external
+        M = external.M
+        session = external._Session("cc", None, queue.Queue(maxsize=4))
+        for _ in range(external.REPLY_QUEUE_BOUND):
+            session.handle(M(type=M.RESPONSE))
+        with caplog.at_level("WARNING"):
+            session.handle(M(type=M.RESPONSE))   # 65th: dropped
+        assert any("reply queue full" in r.message
+                   for r in caplog.records)
+
+    def test_client_send_full_queue_is_stream_error(self):
+        from fabric_tpu.core.chaincode import external
+        cli = external.ExternalChaincodeClient("cc", "127.0.0.1:1",
+                                               timeout_s=0.05)
+        cli._to_cc = queue.Queue(maxsize=1)
+        cli._to_cc.put_nowait("occupied")
+        with pytest.raises(external.ExternalChaincodeError,
+                           match="outbound queue full"):
+            cli._send(object())
+
+    def test_queues_are_bounded(self):
+        from fabric_tpu.core.chaincode import external
+        cli = external.ExternalChaincodeClient("cc", "127.0.0.1:1")
+        # _connect would dial; assert the declared bounds instead
+        assert external.STREAM_QUEUE_BOUND > 0
+        assert external.REPLY_QUEUE_BOUND > 0
+
+
+class TestGossipInboxDrops:
+    def test_dropped_messages_are_counted(self):
+        from fabric_tpu.common import metrics as metrics_mod
+        from fabric_tpu.gossip import transport as gt
+
+        provider = metrics_mod.PrometheusProvider()
+        net = gt.LocalNetwork()
+        t = gt.LocalTransport(net, "drops@test", inbox_size=2,
+                              metrics_provider=provider)
+        try:
+            # park the drain thread so the inbox genuinely fills
+            t._closed.set()
+            t._thread.join(timeout=5)
+            for i in range(5):
+                t.enqueue("sender", f"m{i}")
+            stats = t._inbox.overload_stats()
+            assert stats["sheds"] == 3          # 5 in, bound 2
+            rendered = provider.render()
+            assert "gossip_comm_overflow_count 3" in rendered
+            # drop-OLDEST: the freshest survive
+            assert t._inbox.get_nowait()[1] == "m3"
+            assert t._inbox.get_nowait()[1] == "m4"
+        finally:
+            net.unregister("drops@test")
+
+    def test_inbox_registered_as_overload_stage(self):
+        from fabric_tpu.gossip import transport as gt
+        net = gt.LocalNetwork()
+        t = net.register("stage@test")
+        try:
+            assert "gossip.inbox.stage@test" in overload.stage_stats()
+        finally:
+            t.close()
+
+
+class TestOverloadGauges:
+    def test_publish_overload_stats_renders(self):
+        from fabric_tpu.common import metrics as metrics_mod
+        from fabric_tpu.common import profiling
+
+        provider = metrics_mod.PrometheusProvider()
+        q = SheddingQueue("t.gauges", maxsize=2,
+                          default_budget_s=0.01)
+        try:
+            q.put("a")
+            with pytest.raises(OverloadError):
+                q.put("b")
+                q.put("c")
+            t = profiling.publish_overload_stats(provider,
+                                                 poll_s=0.05)
+            assert t is not None
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                r = provider.render()
+                if 'overload_queue_depth{stage="t.gauges"}' in r and \
+                        'overload_sheds_total{stage="t.gauges"}' in r:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail(f"overload gauges never rendered:\n{r}")
+            assert 'overload_queue_capacity{stage="t.gauges"} 2' in r
+        finally:
+            overload.unregister_stage("t.gauges", q)
+
+    def test_admission_wait_gauge_renders(self):
+        from fabric_tpu.bccsp.admission import AdmissionWindow
+        from fabric_tpu.common import metrics as metrics_mod
+        from fabric_tpu.common import profiling
+
+        class SW:
+            stats = {"x": 1}
+
+            def verify_batch(self, items):
+                return [True] * len(items)
+
+        provider = metrics_mod.PrometheusProvider()
+        csp = SW()
+        win = AdmissionWindow.shared(csp)
+        win.verify_batch(["a"])
+        profiling.publish_provider_stats(provider, csp, poll_s=0.05)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            r = provider.render()
+            if "bccsp_admission_wait_s" in r:
+                return
+            time.sleep(0.05)
+        pytest.fail(f"bccsp_admission_wait_s never rendered:\n{r}")
+
+    def test_health_ok_when_quiet(self):
+        # (other tests may have shed recently on shared stages; use a
+        # fresh queue and assert its absence from the report)
+        q = SheddingQueue("t.quiet", maxsize=2)
+        try:
+            q.put("a")
+            assert "t.quiet" not in overload.health()
+        finally:
+            overload.unregister_stage("t.quiet", q)

@@ -10,7 +10,7 @@
 # errors and stalls injected at every named fault point.
 #
 # Spec grammar: point=mode[:count][:delay_s], mode in {error, delay}.
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -112,6 +112,17 @@ order() {
         tests/test_chaos.py -k "Raft"
 }
 
+overload() {
+    # the round-12 overload layer under fire: armed propose stalls +
+    # device faults while the shed/deadline/backpressure semantics
+    # are pinned — a shed must stay a clean retryable refusal, never
+    # a half-applied state, whichever path serves
+    run "order.propose=delay::0.02;tpu.dispatch=error:2" \
+        tests/test_overload.py
+    run "raft.step=error:3;order.propose=error:1" \
+        tests/test_overload.py -k "Shed or Chain or Broadcast"
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -127,9 +138,10 @@ case "${1:-all}" in
     shard) shard ;;
     order) order ;;
     schemes) schemes ;;
+    overload) overload ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
-         schemes; static ;;
+         schemes; overload; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

@@ -39,6 +39,15 @@ on the flagged line or the line above; the reason is mandatory):
                  disarms the host-sync rule for exactly the code it
                  was written for (no waiver: the registry IS the
                  waiver; update it on a rename)
+  unbounded-queue
+                 creating an UNBOUNDED `queue.Queue()` (or an explicit
+                 `maxsize=0`) is an error — unbounded inter-stage
+                 queues are the overload failure mode round 12
+                 removed (indefinite blocking or unbounded memory at
+                 saturation). Use `common/overload.SheddingQueue`
+                 (deadline-aware, shed-counting) or pass an explicit
+                 positive bound with a Full policy; waive a deliberate
+                 site with allow-unbounded-queue(<reason>)
 
 Usage:
   python tools/ftpu_lint.py [--root DIR] [--rules r1,r2] [files...]
@@ -56,7 +65,7 @@ import sys
 from dataclasses import dataclass
 
 ALL_RULES = ("fault-point", "metric-drift", "silent-swallow",
-             "host-sync", "hot-path-coverage")
+             "host-sync", "hot-path-coverage", "unbounded-queue")
 
 # The spans the host-sync rule exists FOR: every overlapped/sharded
 # device-dispatch span. A span here without @hot_path is a finding —
@@ -78,7 +87,8 @@ REQUIRED_HOT_PATHS = {
 
 _WAIVER_RE = re.compile(
     r"#\s*ftpu-lint:\s*allow-([a-z-]+)\(\s*(.*?)\s*\)?\s*$")
-_WAIVER_KINDS = ("swallow", "fault-point", "host-sync")
+_WAIVER_KINDS = ("swallow", "fault-point", "host-sync",
+                 "unbounded-queue")
 
 _FAULT_METHODS = {"check", "arm", "armed", "disarm", "fires"}
 _HOST_SYNC_BUILTINS = {"float", "bool"}
@@ -336,6 +346,76 @@ def _hot_coverage_findings(rel, tree):
     return out
 
 
+# -- rule: unbounded-queue --
+
+_QUEUE_CLASSES = {"Queue", "LifoQueue", "PriorityQueue"}
+
+
+def _queue_aliases(tree):
+    """(module aliases of `queue`, direct names of its classes) as
+    imported by this file — resolution is import-based so a local
+    class named Queue is never flagged."""
+    mod_aliases: set = set()
+    cls_names: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "queue":
+                    mod_aliases.add(a.asname or "queue")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "queue":
+                for a in node.names:
+                    if a.name in _QUEUE_CLASSES:
+                        cls_names.add(a.asname or a.name)
+    return mod_aliases, cls_names
+
+
+def _unbounded_queue_findings(rel, tree, waivers):
+    mod_aliases, cls_names = _queue_aliases(tree)
+    if not mod_aliases and not cls_names:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        is_queue = (
+            (isinstance(func, ast.Attribute)
+             and func.attr in _QUEUE_CLASSES
+             and isinstance(func.value, ast.Name)
+             and func.value.id in mod_aliases)
+            or (isinstance(func, ast.Name) and func.id in cls_names))
+        if not is_queue:
+            continue
+        size = None
+        if node.args:
+            size = node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "maxsize":
+                size = kw.value
+        unbounded = size is None or (
+            isinstance(size, ast.Constant)
+            and isinstance(size.value, (int, float))
+            and size.value <= 0)
+        # a non-constant maxsize expression counts as bounded: the
+        # bound is the call site's contract (SheddingQueue rejects
+        # non-positive bounds at runtime)
+        if not unbounded:
+            continue
+        if waivers.covers("unbounded-queue", node.lineno):
+            continue
+        out.append(Finding(
+            rel, node.lineno, "unbounded-queue",
+            "unbounded queue.Queue() — at saturation this is "
+            "indefinite blocking or unbounded memory, the round-12 "
+            "overload failure mode; use common/overload.SheddingQueue "
+            "(deadline-aware put + shed accounting) or an explicit "
+            "positive maxsize with a Full policy, or waive a "
+            "deliberate site with "
+            "`# ftpu-lint: allow-unbounded-queue(<reason>)`"))
+    return out
+
+
 # -- rule: metric-drift --
 
 def _metric_drift_findings(root):
@@ -411,6 +491,8 @@ def run_lint(root: str, rules=ALL_RULES, files=None) -> list:
             findings += _host_sync_findings(rel, tree, waivers)
         if "hot-path-coverage" in rules:
             findings += _hot_coverage_findings(rel, tree)
+        if "unbounded-queue" in rules:
+            findings += _unbounded_queue_findings(rel, tree, waivers)
     if "metric-drift" in rules and not files:
         findings += _metric_drift_findings(root)
     return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

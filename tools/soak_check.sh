@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Round-12 overload soak gate (ISSUE 9 acceptance): drive the REAL
+# raft ordering service at sustained over-capacity with chaos faults
+# armed AND the lock-order sanitizer on, and hold the overload
+# contract:
+#
+#   * queue depths stay bounded (asserted inside overload_run against
+#     the registered capacities);
+#   * sheds are counted and attributed per stage (asserted here from
+#     the emitted JSON);
+#   * offered load genuinely exceeded drain capacity (the "~2x" soak
+#     shape — asserted as overcapacity_ratio);
+#   * zero deadlock under FTPU_LOCKCHECK=1 (the run exits 3 on any
+#     recorded lock-order violation; the wall timeout catches a hang);
+#   * every ACCEPTED envelope committed exactly once and the committed
+#     stream replays bit-identically through a sequential oracle
+#     (asserted inside overload_run).
+#
+# Usage: tools/soak_check.sh            (bounded default, ~1-3 min)
+#        SOAK_TXS=2000 tools/soak_check.sh      (longer soak)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+: "${SOAK_PRODUCERS:=4}"
+: "${SOAK_TXS:=400}"
+: "${SOAK_BUDGET_S:=0.15}"
+: "${SOAK_EVENTS_CAP:=8}"
+: "${SOAK_WALL_S:=600}"
+# chaos armed: propose-path stalls + dropped raft steps, the faults
+# that choke the middle of the pipeline and force admission-edge sheds
+: "${SOAK_FAULTS:=order.propose=delay::0.05;raft.step=error:5}"
+
+echo "== soak_check: sustained over-capacity, FTPU_FAULTS='${SOAK_FAULTS}', lockcheck armed"
+rc=0
+out=$(timeout -k 10 "${SOAK_WALL_S}" \
+    env JAX_PLATFORMS=cpu FTPU_LOCKCHECK=1 \
+    FTPU_FAULTS="${SOAK_FAULTS}" \
+    SOAK_PRODUCERS="${SOAK_PRODUCERS}" SOAK_TXS="${SOAK_TXS}" \
+    SOAK_BUDGET_S="${SOAK_BUDGET_S}" \
+    SOAK_EVENTS_CAP="${SOAK_EVENTS_CAP}" \
+    python bench_pipeline.py overload) || rc=$?
+echo "${out}"
+if [ "${rc}" -ne 0 ]; then
+    # rc=3 is a lock-order violation report, rc=124 a wall-timeout
+    # hang — both are exactly what this gate exists to catch
+    echo "soak_check: overload run failed (rc=${rc})" >&2
+    exit "${rc}"
+fi
+
+python - "${out}" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+
+def check(cond, msg):
+    if not cond:
+        print(f"soak_check FAILED: {msg}: {json.dumps(r)}",
+              file=sys.stderr)
+        sys.exit(1)
+
+check(r["accepted_commit_exact_once"] is True,
+      "accepted envelopes did not commit exactly once")
+check(r["oracle_bit_identical"] is True,
+      "committed stream diverged from the sequential oracle")
+check(r["lockcheck_violations"] == 0,
+      "lock-order violations recorded under load")
+check(r["client_shed"] > 0,
+      "no sheds at sustained over-capacity — the rig did not "
+      "saturate (raise SOAK_TXS / lower SOAK_BUDGET_S)")
+check(sum(r["stage_sheds"].values()) > 0,
+      "sheds were not attributed to any stage")
+check(r["overcapacity_ratio"] >= 1.3,
+      "offered load did not exceed drain capacity (not a soak)")
+for stage, depth in r["queue_max_depths"].items():
+    check(depth >= 0, f"bad depth reading for {stage}")
+print("soak_check: PASS — "
+      f"offered {r['offered']} @ {r['overcapacity_ratio']}x capacity, "
+      f"{r['client_shed']} shed cleanly "
+      f"({r['stage_sheds']}), "
+      f"{r['accepted']} accepted all committed bit-identically, "
+      f"0 lock violations")
+EOF
