@@ -1195,6 +1195,13 @@ def stage_core():
         "devices": devices or local_devices,
         "local_devices": local_devices,
         "mesh_devices": mesh_devices,
+        # round-13 elastic mesh: chips benched / re-admitted during
+        # the run and the mesh size the run FINISHED on — a degraded
+        # run is a salvage (served on the survivors), not a zero
+        "device_quarantines": prov.stats.get("device_quarantines", 0),
+        "device_readmits": prov.stats.get("device_readmits", 0),
+        "final_mesh_devices": prov.stats.get("shard_devices",
+                                             mesh_devices),
         "value": value,
         "unit": "sigs/s",
         "vs_baseline": round(value / cpu_sigs_per_s, 3),
@@ -1518,6 +1525,25 @@ def orchestrate():
                 mc["provider_scaling_x"] = round(
                     coreN["provider_sigs_per_s"] /
                     core1["provider_sigs_per_s"], 2)
+            # round-13 device-health facts for the driver: chips
+            # benched/re-admitted during the all-device run and the
+            # mesh size it finished on, plus an explicit salvage note
+            # when the run completed degraded (its scaling number is
+            # a survivors-mesh measurement, not a full-fleet one)
+            quar = coreN.get("device_quarantines", 0) or 0
+            readm = coreN.get("device_readmits", 0) or 0
+            final_mesh = coreN.get("final_mesh_devices",
+                                   coreN.get("mesh_devices"))
+            mc["device_quarantines"] = quar
+            mc["device_readmits"] = readm
+            mc["final_mesh_devices"] = final_mesh
+            if quar and final_mesh and \
+                    final_mesh < (coreN.get("mesh_devices") or 0):
+                mc["device_health_note"] = (
+                    "degraded-mesh salvage: finished on "
+                    f"{final_mesh}/{coreN.get('mesh_devices')} "
+                    f"devices ({quar} quarantine(s), "
+                    f"{readm} readmit(s))")
         emit_stage(mc)
         record("multichip", mc)
         # the measured scaling curve rides in the detail sidecar

@@ -17,6 +17,7 @@ from typing import Optional
 
 from fabric_tpu.bccsp.bccsp import BCCSP
 from fabric_tpu.common.breaker import BreakerConfig
+from fabric_tpu.common.devicehealth import DeviceHealthConfig
 
 logger = logging.getLogger("bccsp.factory")
 
@@ -76,6 +77,12 @@ class TpuOpts:
     # around every device dispatch — on trip the provider serves the
     # bit-identical sw path and re-probes after CooldownS
     fallback: BreakerConfig = field(default_factory=BreakerConfig)
+    # elastic fail-in-place (BCCSP.TPU.DeviceHealth): per-device
+    # quarantine for the sharded mesh — a lost/straggling chip is
+    # benched and the provider rebuilds a smaller mesh over the
+    # survivors instead of tripping the whole accelerator path
+    device_health: DeviceHealthConfig = field(
+        default_factory=DeviceHealthConfig)
 
 
 @dataclass
@@ -94,6 +101,8 @@ class FactoryOpts:
         fks = sw_cfg.get("FileKeyStore") or {}
         fb_cfg = tpu_cfg.get("Fallback") or {}
         fb_defaults = BreakerConfig()
+        dh_cfg = tpu_cfg.get("DeviceHealth") or {}
+        dh_defaults = DeviceHealthConfig()
         return cls(
             default=(cfg.get("Default") or "SW").upper(),
             sw=SwOpts(
@@ -127,12 +136,27 @@ class FactoryOpts:
                     probe_batch=int(fb_cfg.get(
                         "ProbeBatch", fb_defaults.probe_batch)),
                 ),
+                device_health=DeviceHealthConfig(
+                    trip_threshold=int(dh_cfg.get(
+                        "TripThreshold", dh_defaults.trip_threshold)),
+                    cooldown_s=float(dh_cfg.get(
+                        "CooldownS", dh_defaults.cooldown_s)),
+                    straggler_skew_s=float(dh_cfg.get(
+                        "StragglerSkewS",
+                        dh_defaults.straggler_skew_s)),
+                    straggler_strikes=int(dh_cfg.get(
+                        "StragglerStrikes",
+                        dh_defaults.straggler_strikes)),
+                    probe_timeout_s=float(dh_cfg.get(
+                        "ProbeTimeoutS",
+                        dh_defaults.probe_timeout_s)),
+                ),
             ),
         )
 
 
 def _resolve_mesh(n_devices: Optional[int]):
-    """BCCSP.TPU.Devices -> the provider's batch-axis mesh.
+    """BCCSP.TPU.Devices -> (mesh, requested) for the provider.
 
     None/0 = all local devices (the sharded flagship: every chip on
     the box combs its slice of the batch); 1 = no mesh, the
@@ -140,11 +164,16 @@ def _resolve_mesh(n_devices: Optional[int]):
     Availability first: a backend that cannot even enumerate devices
     (mid-flight libtpu upgrade, broken tunnel) degrades to the
     single-device path with a warning instead of failing provider
-    construction — the breaker handles the rest at dispatch time."""
+    construction — the breaker handles the rest at dispatch time.
+    `requested` is the multi-device ask that was NOT satisfied (the
+    explicit count, or "all" when enumeration itself failed): the
+    provider surfaces it as the `degraded_mesh:1/<requested>` health
+    sub-state so operators see the silent 1-chip degrade on /healthz,
+    not just in logs. None when the ask was met (or was 1)."""
     try:
         nd = n_devices
         if nd == 1:
-            return None
+            return None, None
         import jax
         avail = len(jax.devices())
         if nd is None or nd <= 0:
@@ -158,15 +187,16 @@ def _resolve_mesh(n_devices: Optional[int]):
                 "device(s); clamping to %d", nd, avail, avail)
             nd = avail
         if nd <= 1:
-            return None
+            return None, None
         from fabric_tpu.parallel import batch_mesh
-        return batch_mesh(nd)
+        return batch_mesh(nd), None
     except Exception:
         logger.exception(
             "could not build the %s-device verify mesh; serving on "
             "the single-device path (set BCCSP.TPU.Devices: 1 to "
             "silence)", n_devices if n_devices else "all")
-        return None
+        return None, (n_devices if n_devices and n_devices > 1
+                      else "all")
 
 
 def new_bccsp(opts: FactoryOpts) -> BCCSP:
@@ -185,7 +215,7 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
         # restart (or the next bench process) skips the ~minutes
         # compiles along with the table rebuilds
         jaxenv.enable_cache_under(opts.tpu.warm_keys_dir)
-        mesh = _resolve_mesh(opts.tpu.n_devices)
+        mesh, unmet = _resolve_mesh(opts.tpu.n_devices)
         return TPUProvider(ks, min_batch=opts.tpu.min_batch,
                            max_blocks=opts.tpu.max_blocks, mesh=mesh,
                            max_keys=opts.tpu.max_keys,
@@ -197,7 +227,9 @@ def new_bccsp(opts: FactoryOpts) -> BCCSP:
                            warm_keys_dir=opts.tpu.warm_keys_dir,
                            bucket_floor=opts.tpu.bucket_floor,
                            fallback=opts.tpu.fallback,
-                           ed25519=opts.tpu.ed25519)
+                           ed25519=opts.tpu.ed25519,
+                           device_health=opts.tpu.device_health,
+                           mesh_requested=unmet)
     raise ValueError(f"unknown BCCSP default {opts.default!r}")
 
 

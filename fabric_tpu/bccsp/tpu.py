@@ -22,6 +22,7 @@ outage must degrade, not halt (SURVEY §7 step 3).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -33,8 +34,10 @@ from fabric_tpu.bccsp import bccsp as api
 from fabric_tpu.bccsp import sw as swmod
 from fabric_tpu.bccsp import utils
 from fabric_tpu.common import breaker as breaker_mod
+from fabric_tpu.common import devicehealth as devhealth_mod
 from fabric_tpu.common import faults
 from fabric_tpu.common import lockcheck
+from fabric_tpu.common.devicehealth import DeviceLostError
 from fabric_tpu.common.hotpath import hot_path
 
 logger = logging.getLogger("bccsp.tpu")
@@ -75,14 +78,27 @@ class TPUProvider(api.BCCSP):
                  warm_keys_dir: Optional[str] = None,
                  bucket_floor: int = 0,
                  fallback: Optional[breaker_mod.BreakerConfig] = None,
-                 ed25519: bool = True):
+                 ed25519: bool = True,
+                 device_health: Optional[
+                     devhealth_mod.DeviceHealthConfig] = None,
+                 mesh_requested=None):
         self._sw = swmod.SWProvider(keystore)
         # graceful degradation (BCCSP.TPU.Fallback): every device
         # dispatch runs behind this breaker; on trip the provider
         # serves the bit-identical sw path and re-probes the device
-        # after a cooldown (see common/breaker.py)
-        self._breaker = breaker_mod.CircuitBreaker(
-            fallback or breaker_mod.BreakerConfig(), name="bccsp.tpu")
+        # after a cooldown (see common/breaker.py). Under a mesh,
+        # DeviceLostError is device-attributable: it quarantines ONE
+        # chip (elastic rebuild below) and must NEVER count against
+        # the whole accelerator path — an 8-chip box degrading to
+        # 0-chip throughput on a 1-chip fault is the failure mode the
+        # device-health layer exists to remove.
+        fb = fallback or breaker_mod.BreakerConfig()
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            import dataclasses
+            fb = dataclasses.replace(
+                fb, ignore=tuple(fb.ignore) + (DeviceLostError,))
+        self._breaker = breaker_mod.CircuitBreaker(fb,
+                                                   name="bccsp.tpu")
         self._min_batch = min_batch
         # pad device batches up to this bucket (0 = off): a workload of
         # modest windows (e.g. the orderer's 512-envelope sig-filter
@@ -101,7 +117,38 @@ class TPUProvider(api.BCCSP):
         # trade when the accelerator link is PCIe-fast and host cores
         # are the scarce resource.
         self._hash_on_host = hash_on_host
+        # elastic device mesh: `_mesh` is the SERVING mesh (swapped
+        # for a smaller one over the survivors when a chip is
+        # quarantined, grown back on probe re-admission); `_mesh_full`
+        # is the factory-built fleet and the stable device-index
+        # space chaos/gauges/quarantine accounting all use.
         self._mesh = mesh
+        self._mesh_full = mesh
+        self._dev_all = (list(mesh.devices.flat)
+                         if mesh is not None else [])
+        self._dev_pos = {d: i for i, d in enumerate(self._dev_all)}
+        # the factory's unmet multi-device ask (enumeration failure
+        # degraded to single-device): surfaced on /healthz as
+        # degraded_mesh:1/<requested> so operators SEE the silent
+        # 1-chip startup degrade
+        self._mesh_requested = mesh_requested
+        self._devhealth = (
+            devhealth_mod.DeviceHealth(len(self._dev_all),
+                                       device_health)
+            if len(self._dev_all) > 1 else None)
+        self._mesh_lock = threading.Lock()   # serializes rebuilds
+        # in-flight device dispatches, drained before a mesh swap so
+        # no batch straddles two meshes; while a rebuild is draining,
+        # NEW spans hold at the gate (otherwise sustained concurrent
+        # load starves the drain and the swap lands mid-batch anyway)
+        self._dispatch_cv = threading.Condition()
+        self._dispatch_inflight = 0
+        self._rebuild_pending = False
+        self._probe_threads: dict = {}       # device -> live probe
+        # per-batch rotation of the ready-probe sampling order: the
+        # first-sampled chip's reading inflates every later one, so a
+        # compute-slow chip PERMANENTLY first would never show a jump
+        self._ready_rot = 0
         self._max_keys = max_keys   # comb path cutoff (distinct pubkeys)
         self._chunk = chunk         # double-buffer chunk size (sigs)
         # overlapped dispatch pipeline (BCCSP.TPU.PipelineChunk): a
@@ -187,6 +234,16 @@ class TPUProvider(api.BCCSP):
                                         if mesh is not None else 1),
                       "shard_dispatches": 0,
                       "shard_skew_s": 0.0,
+                      # elastic-mesh counters (scalar aggregates; the
+                      # per-device split rides the device_stats
+                      # property as bccsp_device_* gauges)
+                      "mesh_devices_full": (getattr(mesh, "size", 1)
+                                            if mesh is not None
+                                            else 1),
+                      "mesh_rebuilds": 0,
+                      "device_quarantines": 0,
+                      "device_readmits": 0,
+                      "device_straggler_strikes": 0,
                       "breaker_state": 0, "breaker_trips": 0,
                       "breaker_probes": 0,
                       "breaker_deadline_timeouts": 0,
@@ -212,6 +269,7 @@ class TPUProvider(api.BCCSP):
         # identical either way)
         self._ed25519_enabled = ed25519
         self._ed_tab = None         # replicated device B-comb table
+        self._g16_rep = None        # mesh-replicated g16 cache
         self._persist_threads: list = []
         # serializes warm-file mutations (record/trim/drop) with the
         # background table-byte writers' publish step, so a concurrent
@@ -291,9 +349,41 @@ class TPUProvider(api.BCCSP):
 
     def health(self) -> str:
         """Breaker state for /healthz: 'device' | 'degraded' |
-        'probing'. Verdicts are identical in every state; only the
-        serving path (and therefore throughput) differs."""
-        return self._breaker.state
+        'probing', with the elastic-mesh sub-state appended when the
+        serving mesh is smaller than the fleet —
+        'device;degraded_mesh:<k>/<n>' (k healthy of n chips; also
+        '1/<requested>' when startup enumeration failed and the node
+        silently serves single-device). Verdicts are identical in
+        every state; only the serving path (and therefore throughput)
+        differs."""
+        st = self._breaker.state
+        sub = self._mesh_substate()
+        return f"{st};{sub}" if sub else st
+
+    def _mesh_substate(self) -> Optional[str]:
+        """`degraded_mesh:<k>/<n>` when serving on fewer chips than
+        the fleet (quarantine, or a failed startup enumeration), else
+        None."""
+        if self._mesh_full is None:
+            if self._mesh_requested is not None:
+                return f"degraded_mesh:1/{self._mesh_requested}"
+            return None
+        cur = self._mesh.size if self._mesh is not None else 1
+        full = self._mesh_full.size
+        if cur < full:
+            return f"degraded_mesh:{cur}/{full}"
+        return None
+
+    @property
+    def device_stats(self) -> dict:
+        """Per-device health rows (one slot per FULL-mesh device),
+        read fresh per poll by profiling.publish_provider_stats and
+        published as the device-labeled `bccsp_device_{state,trips,
+        quarantines,readmits}` gauges. Empty lists while single-chip."""
+        if self._devhealth is None:
+            return {"state": [], "trips": [], "quarantines": [],
+                    "readmits": []}
+        return self._devhealth.snapshot()
 
     def _sync_breaker_stats(self) -> None:
         b = self._breaker
@@ -303,6 +393,292 @@ class TPUProvider(api.BCCSP):
         self.stats["breaker_deadline_timeouts"] = \
             b.stats["deadline_timeouts"]
         self.stats["breaker_rejected_dispatches"] = b.stats["rejected"]
+
+    # -- elastic device mesh (fail-in-place; common/devicehealth.py) --
+
+    @contextlib.contextmanager
+    def _dispatch_span(self):
+        """Mark one device dispatch live so a concurrent mesh rebuild
+        drains it (waits for in-flight spans) before swapping the
+        serving mesh out from under it. New spans HOLD at the gate
+        while a rebuild is draining — without that, sustained
+        concurrent verify load keeps `_dispatch_inflight` above zero
+        forever and every rebuild burns its full drain deadline then
+        swaps mid-batch anyway. The hold is bounded: the rebuild's
+        drain wait is, and `_rebuild_pending` clears in its finally."""
+        import time as _time
+        with self._dispatch_cv:
+            deadline = None
+            while self._rebuild_pending:
+                if deadline is None:
+                    deadline = _time.monotonic() + 10.0
+                if _time.monotonic() >= deadline:
+                    break        # never wedge a dispatch on the gate
+                self._dispatch_cv.wait(0.1)
+            self._dispatch_inflight += 1
+        try:
+            yield
+        finally:
+            with self._dispatch_cv:
+                self._dispatch_inflight -= 1
+                self._dispatch_cv.notify_all()
+
+    def _device_index(self, dev) -> int:
+        """A device's FULL-mesh index — stable across rebuilds, the
+        space chaos targeting / quarantine accounting / bccsp_device_*
+        labels all share."""
+        return self._dev_pos.get(dev, -1)
+
+    def _attribute_device_failure(self, exc: BaseException
+                                  ) -> Optional[int]:
+        """Map a failed dispatch to ONE chip (DeviceLostError carries
+        it; other runtime errors are matched when the message names a
+        device) and quarantine it via its per-device breaker. Returns
+        the struck full-mesh index, else None. Called from the sw-
+        fallback handlers so the NEXT batch rebuilds and keeps
+        (N-1)/N device throughput instead of serving sw fleet-wide."""
+        if self._devhealth is None:
+            return None
+        d = self._devhealth.attribute(exc)
+        if d is None:
+            return None
+        self.stats.update(self._devhealth.totals())
+        # rebuild promptly (not lazily at the next admission): the
+        # very next batch must dispatch on the surviving mesh
+        self._maybe_probe_and_rebuild(probe=False)
+        return d
+
+    def _maybe_probe_and_rebuild(self,
+                                 probe: bool = True
+                                 ) -> Optional[list]:
+        """Admission-time health hook: kick any due re-admission
+        probes (ASYNCHRONOUSLY — a wedged chip's probe timeout must
+        never stall a consensus-critical batch), then swap the
+        serving mesh whenever healthy membership changed (shrink on
+        quarantine, grow back on readmission). Returns the healthy
+        full-mesh index list (None for a no-mesh provider): an EMPTY
+        list tells the caller to serve sw outright instead of paying
+        a doomed per-batch dispatch. Cheap when nothing changed (one
+        list compare)."""
+        dh = self._devhealth
+        if dh is None:
+            return None
+        if probe:
+            for d in dh.probe_candidates():
+                self._spawn_probe(d)
+        healthy = dh.healthy()
+        cur = [self._device_index(d)
+               for d in self._mesh.devices.flat] \
+            if self._mesh is not None else []
+        if healthy == cur or not healthy:
+            # unchanged — or NOTHING healthy: keep the current mesh
+            # object (an empty mesh cannot dispatch); callers see the
+            # empty healthy list and serve sw until a probe recovers
+            # a chip
+            return healthy
+        try:
+            self._rebuild_mesh(healthy)
+        except Exception:
+            # a failed rebuild keeps the old mesh: dispatches on it
+            # either work or fall to sw through the breaker — never
+            # fail the caller's verify from the admission hook
+            logger.exception("degraded-mesh rebuild failed; keeping "
+                             "the current serving mesh")
+        return healthy
+
+    def _spawn_probe(self, d: int) -> None:
+        """Run one chip's re-admission probe on a daemon thread; the
+        caller's batch proceeds on the current mesh and a LATER
+        admission grows the mesh once the outcome lands. The probe
+        slot was already taken in probe_candidates(), so concurrent
+        admissions cannot double-probe (the breaker's stale-probe
+        reclaim backstops a thread that dies without reporting)."""
+        dh = self._devhealth
+
+        def work():
+            ok = False
+            try:
+                # mark the probe LIVE on the chip's breaker: its wall
+                # time (probe_timeout_s) may exceed the breaker's
+                # stale-probe reclaim window, and a reclaim under a
+                # merely-slow probe would turn its success into a
+                # phantom readmit
+                with dh.probe_execution(d):
+                    ok = self._probe_device(d)
+            finally:
+                dh.probe_result(d, ok)
+                if ok:
+                    self.stats.update(dh.totals())
+                self._probe_threads.pop(d, None)
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"bccsp-device-probe-{d}")
+        self._probe_threads[d] = t
+        t.start()
+
+    def _probe_device(self, d: int) -> bool:
+        """One bounded single-chip probe: ship a tiny array to the
+        quarantined device and run a trivial computation on it, on a
+        watchdog thread so a wedged chip cannot stall admission. Goes
+        through the SAME `tpu.device_lost` fault point as the span
+        feeder (arg = full-mesh index) so chaos keeps a dead chip
+        benched until it disarms."""
+        timeout = (self._devhealth.config.probe_timeout_s
+                   if self._devhealth else 5.0)
+        box: dict = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                faults.check("tpu.device_lost", arg=d)
+                import jax
+                import jax.numpy as jnp
+                dev = self._dev_all[d]
+                x = jax.device_put(np.arange(8, dtype=np.int32), dev)
+                jax.block_until_ready(jnp.sum(x + 1))
+                box["ok"] = True
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=work, daemon=True,
+                             name=f"bccsp-device-probe-{d}")
+        t.start()
+        if not done.wait(timeout) or "error" in box:
+            logger.warning(
+                "device %d re-admission probe failed (%s); staying "
+                "quarantined", d,
+                box.get("error", f"no answer in {timeout:.1f}s"))
+            return False
+        return True
+
+    @hot_path
+    def _rebuild_mesh(self, healthy: list) -> None:
+        """Swap the serving mesh for one over `healthy` (full-mesh
+        indices): drain in-flight dispatch spans (bounded — a wedged
+        span must not hold the rebuild forever), drop every compiled
+        program and replicated table handle bound to the old mesh,
+        then install the new one. Tables re-replicate lazily on the
+        first dispatch (`_resolve_tables` re-places them under the
+        new mesh); span/bucket floors re-derive per batch from the
+        serving mesh size."""
+        lockcheck.note_blocking("tpu.mesh_rebuild")
+        import time as _time
+        with self._mesh_lock:
+            cur = [self._device_index(d)
+                   for d in self._mesh.devices.flat] \
+                if self._mesh is not None else []
+            if healthy == cur:
+                return              # another thread already rebuilt
+            # gate NEW spans for the WHOLE drain+swap window: without
+            # the gate sustained load starves the drain, and a span
+            # admitted between drain and swap would recompile an
+            # old-mesh program into the freshly-cleared fn cache
+            with self._dispatch_cv:
+                self._rebuild_pending = True
+            try:
+                deadline = _time.monotonic() + 5.0
+                with self._dispatch_cv:
+                    while self._dispatch_inflight > 0 and \
+                            _time.monotonic() < deadline:
+                        self._dispatch_cv.wait(
+                            max(0.0, deadline - _time.monotonic()))
+                    if self._dispatch_inflight > 0:
+                        logger.warning(
+                            "mesh rebuild proceeding with %d dispatch "
+                            "span(s) still in flight after the drain "
+                            "deadline (they serve sw on failure)",
+                            self._dispatch_inflight)
+                if len(healthy) == len(self._dev_all):
+                    mesh = self._mesh_full
+                else:
+                    from fabric_tpu.parallel import batch_mesh
+                    mesh = batch_mesh(
+                        devices=[self._dev_all[i] for i in healthy])
+                with self._jit_lock:
+                    # every compiled shard_map program and replicated
+                    # table handle embeds the old mesh — drop them;
+                    # the jit cache rebuilds (persistent-cache-
+                    # assisted) and the tables re-replicate on first
+                    # dispatch
+                    self._comb_fns.clear()
+                    self._fn = None
+                    self._ed_tab = None
+                    self._g16_rep = None
+                # cached Q tables replicated over the OLD mesh hold a
+                # shard on the benched chip: re-materialize each from
+                # a known-healthy replica so the first dispatch
+                # re-places clean bytes (an unreadable entry is
+                # dropped — the disk/rebuild path heals it)
+                self._rehost_cached_tables(
+                    {self._dev_all[i] for i in healthy})
+                self._mesh = mesh
+            finally:
+                with self._dispatch_cv:
+                    self._rebuild_pending = False
+                    self._dispatch_cv.notify_all()
+            self.stats["shard_devices"] = mesh.size
+            self.stats["mesh_rebuilds"] += 1
+            if mesh.size < len(self._dev_all):
+                logger.warning(
+                    "serving mesh REBUILT over %d/%d device(s) "
+                    "(quarantined: %s) — keeping %d/%d device "
+                    "throughput instead of the sw path",
+                    mesh.size, len(self._dev_all),
+                    self._devhealth.quarantined()
+                    if self._devhealth else [],
+                    mesh.size, len(self._dev_all))
+            else:
+                logger.info(
+                    "serving mesh restored to the full %d device(s)",
+                    mesh.size)
+
+    def _rehost_cached_tables(self, keep: set) -> None:
+        """After a mesh swap, cached Q tables replicated over the OLD
+        mesh are poisoned handles — one replica lives on the benched
+        chip, and on real hardware the next `device_put` re-placement
+        may read from it. Re-materialize each cached table on the
+        host from a replica on a KEPT device (`keep` = the new
+        mesh's device objects); entries that cannot be read are
+        dropped (the persisted-bytes / rebuild path heals them on the
+        next miss). Host copies re-replicate through the normal
+        `_resolve_tables` device_put on first dispatch."""
+        with self._q16_lock:
+            for cache in (self._qflat_cache, self._q8_cache):
+                for key in list(cache):
+                    arr = cache[key]
+                    shards = getattr(arr, "addressable_shards", None)
+                    if shards is None:
+                        continue        # already a host array
+                    try:
+                        devs = {getattr(sh, "device", None)
+                                for sh in shards}
+                        if devs <= keep:
+                            continue    # no replica on a benched chip
+                        pick = next((sh for sh in shards
+                                     if sh.device in keep), None)
+                        # ftpu-lint: allow-host-sync(deliberate D2H
+                        # rescue of a replicated table from a healthy
+                        # replica during the rare mesh swap)
+                        host = np.asarray(pick.data if pick is not None
+                                          else arr)
+                        cache[key] = host
+                    except Exception:
+                        evicted = cache.pop(key)
+                        if cache is self._qflat_cache:
+                            self._qflat_cache_bytes -= \
+                                getattr(evicted, "size", 0) * 4
+                            self._q16_last_use.pop(key, None)
+                            self.stats["q16_cache_bytes"] = \
+                                self._qflat_cache_bytes
+                            self.stats["q16_resident_sets"] = \
+                                len(self._qflat_cache)
+                        logger.warning(
+                            "cached table for one key set was "
+                            "unreadable after the mesh swap; dropped "
+                            "(rebuilds from persisted bytes on the "
+                            "next miss)", exc_info=True)
 
     # -- the batch path --
 
@@ -402,6 +778,17 @@ class TPUProvider(api.BCCSP):
         if len(items) < self._min_batch:
             self._bump_scheme("p256", sw_lanes=len(items))
             return self._sw.verify_batch(items)
+        # elastic-mesh health hook BEFORE admission: kick due chip
+        # re-admission probes and apply any pending mesh shrink/grow,
+        # so this batch stages against a coherent serving mesh. With
+        # EVERY chip benched, serve sw outright — the provider
+        # breaker ignores device-attributed errors, so a doomed
+        # dispatch would just pay transfer latency per batch forever
+        healthy = self._maybe_probe_and_rebuild()
+        if healthy is not None and not healthy:
+            self.stats["degraded_batches"] += 1
+            self._bump_scheme("p256", sw_lanes=len(items))
+            return self._sw.verify_batch(items)
         # admission FIRST: admit() resolves the breaker state and the
         # probe decision atomically, so a cooldown expiring between a
         # state peek and the dispatch can never send an un-split batch
@@ -423,15 +810,19 @@ class TPUProvider(api.BCCSP):
                 cut = max(pb, self._min_batch)
                 dev_items, probe_rest = items[:cut], items[cut:]
         try:
-            out = self._breaker.guard(
-                lambda: self._verify_batch_device(dev_items))
-        except Exception:
+            with self._dispatch_span():
+                out = self._breaker.guard(
+                    lambda: self._verify_batch_device(dev_items))
+        except Exception as e:
             self.stats["sw_fallbacks"] += 1
             self._sync_breaker_stats()
             self._bump_scheme("p256", sw_lanes=len(items))
+            struck = self._attribute_device_failure(e)
             logger.exception(
-                "TPU batch verify failed; falling back to sw for %d items",
-                len(items))
+                "TPU batch verify failed%s; falling back to sw for "
+                "%d items",
+                (f" (device {struck} quarantined)"
+                 if struck is not None else ""), len(items))
             return self._sw.verify_batch(items)
         self._sync_breaker_stats()
         self._bump_scheme("p256", dispatches=1)
@@ -659,6 +1050,11 @@ class TPUProvider(api.BCCSP):
         if n < self._min_batch or not self._ed25519_enabled:
             self._bump_scheme("ed25519", lanes=n, sw_lanes=n)
             return self._sw.verify_batch(items)
+        healthy = self._maybe_probe_and_rebuild()
+        if healthy is not None and not healthy:
+            self.stats["degraded_batches"] += 1
+            self._bump_scheme("ed25519", lanes=n, sw_lanes=n)
+            return self._sw.verify_batch(items)
         try:
             is_probe = self._breaker.admit()
         except breaker_mod.CircuitOpen:
@@ -673,15 +1069,19 @@ class TPUProvider(api.BCCSP):
                 cut = max(pb, self._min_batch)
                 dev_items, probe_rest = items[:cut], items[cut:]
         try:
-            out = self._breaker.guard(
-                lambda: self._dispatch_ed25519(dev_items))
-        except Exception:
+            with self._dispatch_span():
+                out = self._breaker.guard(
+                    lambda: self._dispatch_ed25519(dev_items))
+        except Exception as e:
             self.stats["sw_fallbacks"] += 1
             self._sync_breaker_stats()
             self._bump_scheme("ed25519", lanes=n, sw_lanes=n)
+            struck = self._attribute_device_failure(e)
             logger.exception(
-                "Ed25519 batch verify failed; falling back to sw for "
-                "%d items", n)
+                "Ed25519 batch verify failed%s; falling back to sw "
+                "for %d items",
+                (f" (device {struck} quarantined)"
+                 if struck is not None else ""), n)
             return self._sw.verify_batch(items)
         self._sync_breaker_stats()
         self._bump_scheme("ed25519", lanes=len(dev_items),
@@ -1104,10 +1504,18 @@ class TPUProvider(api.BCCSP):
             return self._verify_prepared_sw(
                 range(n), digests, key_idx, keys, pubs, get_sig)
 
-        # breaker admission: while degraded every prepared batch rides
-        # the host path (bit-identical verdicts); in probing state this
-        # batch IS the probe — capped at ProbeBatch lanes, the rest on
-        # the host path — and its resolve outcome decides re-entry
+        # elastic-mesh health hook, then breaker admission: while
+        # degraded every prepared batch rides the host path
+        # (bit-identical verdicts); in probing state this batch IS
+        # the probe — capped at ProbeBatch lanes, the rest on the
+        # host path — and its resolve outcome decides re-entry. With
+        # every chip benched, serve the host path outright.
+        healthy = self._maybe_probe_and_rebuild()
+        if healthy is not None and not healthy:
+            self.stats["degraded_batches"] += 1
+            out = self._verify_prepared_sw(
+                range(n), digests, key_idx, keys, pubs, get_sig)
+            return lambda: out
         try:
             is_probe = self._breaker.admit()
         except breaker_mod.CircuitOpen:
@@ -1125,12 +1533,13 @@ class TPUProvider(api.BCCSP):
         try:
             # staging may pay a first-dispatch compile: mark it live so
             # a probing breaker's stale-reclaim can't preempt it
-            with self._breaker.execution():
+            with self._dispatch_span(), self._breaker.execution():
                 resolve = self._verify_prepared_device(
                     digests[:cut], r[:cut], rpn[:cut], w[:cut],
                     der_ok[:cut], key_idx[:cut], keys, pubs, get_sig)
         except Exception as e:
             self._breaker.failure(e)
+            self._attribute_device_failure(e)
             out = fallback()
             return lambda: out
 
@@ -1138,8 +1547,10 @@ class TPUProvider(api.BCCSP):
             try:
                 # the guard runs the deadline watchdog and records the
                 # device outcome (success closes a probing breaker)
-                out = self._breaker.guard(resolve)
-            except Exception:
+                with self._dispatch_span():
+                    out = self._breaker.guard(resolve)
+            except Exception as e:
+                self._attribute_device_failure(e)
                 return fallback()
             self._sync_breaker_stats()
             if cut < n:
@@ -1930,10 +2341,26 @@ class TPUProvider(api.BCCSP):
             imap = s.addressable_devices_indices_map(a.shape)
             shards = []
             for d, dev in enumerate(mesh_devs):
+                gi = self._device_index(dev)
                 t0 = _time.perf_counter()
-                shards.append(jax.device_put(a[imap[dev]], dev))
-                if timings is not None and d < len(timings):
-                    timings[d] += _time.perf_counter() - t0
+                try:
+                    # per-device fault seam (arg = FULL-mesh index, so
+                    # chaos targets chip k whatever the serving mesh):
+                    # device_lost errors here, device_straggler stalls
+                    # this chip's transfer stream — feeding the
+                    # quarantine accounting either way
+                    faults.check("tpu.device_lost", arg=gi)
+                    faults.check("tpu.device_straggler", arg=gi)
+                    shards.append(jax.device_put(a[imap[dev]], dev))
+                except Exception as e:
+                    # a failed per-chip transfer IS device-attributed:
+                    # quarantine THIS chip (the provider breaker
+                    # ignores DeviceLostError — one bad chip must not
+                    # bench the whole accelerator path)
+                    raise DeviceLostError(gi, e) from e
+                finally:
+                    if timings is not None and d < len(timings):
+                        timings[d] += _time.perf_counter() - t0
             out.append(jax.make_array_from_single_device_arrays(
                 a.shape, s, shards))
         return tuple(out)
@@ -1943,11 +2370,16 @@ class TPUProvider(api.BCCSP):
         """Refresh the per-device shard gauges after a sharded batch:
         transfer-enqueue seconds per chip (from `_shard_put`), lanes
         per chip, and the per-device ready lag of the FINAL span's
-        accept bitmap. Readiness is sampled by blocking shards in mesh
-        order, so device d's reading is max(its own, earlier devices')
-        — an upper bound that still localizes a straggler chip as a
-        step in the curve. Runs at the end-of-batch sync point, never
-        inside an overlapped span."""
+        accept bitmap. Readiness is sampled by blocking shards in a
+        per-batch ROTATING order, so device d's reading is max(its
+        own, earlier-sampled devices') — an upper bound that still
+        localizes a straggler chip as a step at its sampling
+        position. The rotation matters: the first-sampled chip
+        inflates every later reading equally, so a compute-slow chip
+        PERMANENTLY sampled first would never show a jump (or skew)
+        at all; rotating guarantees it has a measured predecessor on
+        all but 1-in-N batches. Runs at the end-of-batch sync point,
+        never inside an overlapped span."""
         import time as _time
         ndev = len(tdev)
         # lanes from the final span's REAL extent, not the nominal
@@ -1956,11 +2388,19 @@ class TPUProvider(api.BCCSP):
         shape = getattr(last_out, "shape", None)
         if shape:
             span = int(shape[0])
-        ready: list = []
+        mesh_devs = list(self._mesh.devices.flat)
+        npos = min(ndev, len(mesh_devs))
+        rot = self._ready_rot % npos if npos else 0
+        self._ready_rot += 1
+        order = list(range(rot, npos)) + list(range(0, rot))
+        ready: list = []                 # mesh-position indexed
+        sample_seq: list = []            # (position, reading) in order
         shards = getattr(last_out, "addressable_shards", None)
         if shards is not None and t_disp0 is not None:
             by_dev = {sh.device: sh for sh in shards}
-            for dev in self._mesh.devices.flat:
+            ready = [0.0] * npos
+            for pos in order:
+                dev = mesh_devs[pos]
                 sh = by_dev.get(dev)
                 if sh is not None:
                     try:
@@ -1969,8 +2409,9 @@ class TPUProvider(api.BCCSP):
                         logger.warning(
                             "shard ready probe failed on %s", dev,
                             exc_info=True)
-                ready.append(
-                    round(_time.perf_counter() - t_disp0, 6))
+                r = round(_time.perf_counter() - t_disp0, 6)
+                ready[pos] = r
+                sample_seq.append((pos, r))
         self.shard_stats = {
             "transfer_s": [round(t, 6) for t in tdev],
             "ready_s": ready,
@@ -1979,6 +2420,20 @@ class TPUProvider(api.BCCSP):
         self.stats["shard_devices"] = ndev
         self.stats["shard_skew_s"] = (
             round(max(ready) - min(ready), 6) if ready else 0.0)
+        if self._devhealth is not None:
+            # straggler accounting IN SAMPLING ORDER: per-chip
+            # transfer time and the ready-lag jumps localize a chip
+            # pacing the whole mesh; enough consecutive strikes
+            # quarantine it (the NEXT batch's admission hook rebuilds
+            # the mesh over the survivors)
+            seq = sample_seq or [(pos, 0.0) for pos in order]
+            full_idx = [self._device_index(mesh_devs[pos])
+                        for pos, _ in seq]
+            self._devhealth.observe_shard(
+                full_idx,
+                [tdev[pos] for pos, _ in seq],
+                [r for _, r in seq] if sample_seq else [])
+            self.stats.update(self._devhealth.totals())
 
     def _mesh_chunk(self, bucket: int) -> int:
         """Chunk size; under a mesh, slices stay divisible by the mesh

@@ -1,0 +1,361 @@
+"""Per-device health for the sharded verify mesh (elastic fail-in-place).
+
+The provider-wide breaker (common/breaker.py) answers "is the
+accelerator path serving at all" — one chip failing mid-`shard_map`
+would trip it and drop an 8-chip box to 0-chip (host-path) throughput.
+Large accelerator fleets instead fail IN PLACE: bench the one bad
+chip, keep serving on the survivors, re-admit after it recovers
+(the committee-consensus measurement in arXiv:2302.00418 makes the
+same point for consensus crypto — throughput claims are meaningless
+without the degraded-mode curve).
+
+This module is the accounting half of that: a ring of per-device
+`CircuitBreaker`s (the SAME trip/cooldown/probe discipline as the
+provider breaker, one per chip) fed by three signals the sharded
+dispatch already produces —
+
+  * device-attributed dispatch failures (`DeviceLostError` from the
+    span feeder, or a runtime error whose message names a device);
+  * per-chip transfer timings from `TPUProvider._shard_put`;
+  * per-chip ready-lag skew from `_record_shard_stats`.
+
+A device whose breaker opens is QUARANTINED: `healthy()` drops it and
+the provider rebuilds a smaller mesh over the survivors
+(bccsp/tpu.py `_rebuild_mesh`). After `cooldown_s` the breaker
+half-opens and `probe_candidates()` offers the chip for ONE bounded
+probe dispatch; success re-admits it and the mesh grows back.
+
+States per device (the `bccsp_device_state` gauge):
+    0 healthy      in the serving mesh
+    1 probing      cooldown elapsed, awaiting its probe's outcome
+    2 quarantined  out of the mesh, cooling down
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from fabric_tpu.common.breaker import (
+    BreakerConfig,
+    CircuitBreaker,
+    CircuitOpen,
+    DEGRADED,
+    DEVICE,
+)
+
+logger = logging.getLogger("common.devicehealth")
+
+
+class DeviceLostError(RuntimeError):
+    """A dispatch failure attributable to ONE device (raised by the
+    sharded span feeder when a chip's transfer stream fails or an
+    armed `tpu.device_lost` fault fires). The provider breaker
+    IGNORES this type — losing one chip must quarantine that chip,
+    never bench the whole accelerator path."""
+
+    def __init__(self, device: int, cause: BaseException):
+        super().__init__(f"device {device} lost: "
+                         f"{type(cause).__name__}: {cause}")
+        self.device = device
+        self.cause = cause
+
+
+class DeviceStragglerError(RuntimeError):
+    """Synthetic failure fed to a device's breaker when its straggler
+    strikes exceed the budget — the chip answers, but so slowly it
+    paces the whole mesh."""
+
+
+# runtime errors that name a device: "device 3", "TPU_3", "TPU:3",
+# "device=3" — the patterns real XLA/PJRT errors use
+_DEVICE_RE = re.compile(
+    r"(?:\bdevice[\s=:#]+|\bTPU[_:]|\bchip[\s=:#]+)(\d+)",
+    re.IGNORECASE)
+
+
+def device_from_error(message: str, n_devices: int) -> Optional[int]:
+    """Best-effort device attribution for a runtime error string:
+    the first in-range device index the message names, else None."""
+    for m in _DEVICE_RE.finditer(message or ""):
+        d = int(m.group(1))
+        if 0 <= d < n_devices:
+            return d
+    return None
+
+
+@dataclass
+class DeviceHealthConfig:
+    """`BCCSP.TPU.DeviceHealth` in core.yaml (parsed by
+    bccsp/factory.py)."""
+    # device-attributed faults before quarantine. 1 by default: a
+    # transfer stream failing on a named chip is strong evidence
+    # (noisy timing signals gate through straggler_strikes instead)
+    trip_threshold: int = 1
+    cooldown_s: float = 30.0
+    # a chip whose per-batch transfer time (or ready-lag jump) exceeds
+    # the mesh median by this many seconds earns a straggler strike;
+    # <= 0 disables straggler quarantine entirely
+    straggler_skew_s: float = 2.0
+    # consecutive struck batches before the chip is quarantined
+    straggler_strikes: int = 3
+    # wall bound on one re-admission probe dispatch
+    probe_timeout_s: float = 5.0
+
+
+class DeviceHealth:
+    """Per-device fault/straggler accounting + quarantine ring.
+
+    Device indices are FULL-mesh positions (the factory-built mesh),
+    stable across rebuilds — chaos targets "chip 3" whatever the
+    serving mesh currently looks like. Thread-safe: dispatch paths,
+    the admission-time rebuild hook and the stats poller all read it.
+    """
+
+    def __init__(self, n_devices: int,
+                 config: Optional[DeviceHealthConfig] = None,
+                 clock=time.monotonic, name: str = "bccsp.device"):
+        self.config = config or DeviceHealthConfig()
+        self.n_devices = n_devices
+        self._lock = threading.Lock()
+        self._breakers = [
+            CircuitBreaker(
+                BreakerConfig(
+                    trip_threshold=max(1, self.config.trip_threshold),
+                    cooldown_s=self.config.cooldown_s),
+                name=f"{name}{d}", clock=clock)
+            for d in range(n_devices)
+        ]
+        self._strikes = [0] * n_devices
+        self._quarantines = [0] * n_devices
+        self._readmits = [0] * n_devices
+        self._straggler_strikes_total = 0
+
+    def set_clock(self, clock) -> None:
+        """Test seam: drive every per-device breaker's cooldown from
+        an injectable monotonic clock instead of wall sleeps."""
+        for br in self._breakers:
+            br._clock = clock
+
+    # -- state --
+
+    def state(self, d: int) -> str:
+        return self._breakers[d].state
+
+    def state_codes(self) -> list[int]:
+        return [br.state_code for br in self._breakers]
+
+    def healthy(self) -> list[int]:
+        """Full-mesh indices fit to serve (breaker closed). Probing
+        devices stay OUT until their probe succeeds — the serving
+        mesh only ever contains chips currently believed good."""
+        return [d for d, br in enumerate(self._breakers)
+                if br.state == DEVICE]
+
+    def quarantined(self) -> list[int]:
+        return [d for d, br in enumerate(self._breakers)
+                if br.state != DEVICE]
+
+    # -- fault accounting --
+
+    def record_fault(self, d: int, exc: BaseException | None = None
+                     ) -> bool:
+        """Count one device-attributed failure against chip `d`.
+        Returns True when this failure newly quarantined it. A chip
+        that is ALREADY benched is left alone: CircuitBreaker.failure
+        on an open breaker re-arms its cooldown, so re-attributed
+        failures from stale/doomed dispatches would keep a dead chip
+        from ever reaching its re-admission probe. The whole
+        check-fail-count sequence runs under the ring lock — a chip
+        dying with several dispatches in flight attributes
+        CONCURRENTLY, and racers past a bare pre-check would each
+        re-arm the cooldown and each count a quarantine."""
+        br = self._breakers[d]
+        with self._lock:
+            if br.state != DEVICE:
+                return False
+            br.failure(exc)
+            newly = br.state != DEVICE
+            if newly:
+                self._quarantines[d] += 1
+                self._strikes[d] = 0
+        if newly:
+            logger.warning(
+                "device %d QUARANTINED after %s (cooldown %.1fs; the "
+                "mesh rebuilds over the survivors)", d,
+                type(exc).__name__ if exc else "failure",
+                self.config.cooldown_s)
+        return newly
+
+    def attribute(self, exc: BaseException) -> Optional[int]:
+        """Map a dispatch exception to a device and record the fault:
+        a `DeviceLostError` carries its device; any other error is
+        matched against the device-naming patterns. Returns the
+        struck device index, or None when unattributable."""
+        if isinstance(exc, DeviceLostError):
+            d = exc.device
+        else:
+            d = device_from_error(str(exc), self.n_devices)
+        if d is None:
+            return None
+        self.record_fault(d, exc)
+        return d
+
+    # -- straggler accounting --
+
+    def observe_shard(self, full_idx: list[int],
+                      transfer_s: list[float],
+                      ready_s: list[float]) -> list[int]:
+        """Feed one sharded batch's per-chip readings (positions map
+        to `full_idx`). A chip whose transfer time exceeds the mesh
+        median — or whose ready-lag JUMP over its mesh predecessor
+        exceeds it (ready_s is sampled in mesh order, so a straggler
+        shows as a step) — by `straggler_skew_s` earns a strike; a
+        clean batch clears its strikes (consecutive, not lifetime).
+        `straggler_strikes` strikes quarantine it. Returns EVERY
+        newly quarantined device (correlated failures — two chips on
+        one degrading link — cross the threshold in the same batch)."""
+        skew = self.config.straggler_skew_s
+        if skew <= 0 or len(full_idx) < 2:
+            return []
+        struck: set[int] = set()
+        if transfer_s and len(transfer_s) == len(full_idx):
+            # LOWER median: with an even mesh and half the chips slow
+            # (one degrading switch feeding two chips), the upper
+            # median IS the slow value and nothing ever reads as over
+            # budget — a straggler is "slower than a typical chip",
+            # and the typical chip is the faster half's boundary
+            med = sorted(transfer_s)[(len(transfer_s) - 1) // 2]
+            for pos, t in enumerate(transfer_s):
+                if t - med > skew:
+                    struck.add(pos)
+        if ready_s and len(ready_s) == len(full_idx):
+            for pos in range(1, len(ready_s)):
+                if ready_s[pos] - ready_s[pos - 1] > skew:
+                    struck.add(pos)
+        over: list[tuple[int, int]] = []
+        with self._lock:
+            for pos in range(len(full_idx)):
+                d = full_idx[pos]
+                if pos not in struck:
+                    self._strikes[d] = 0
+                    continue
+                self._strikes[d] += 1
+                self._straggler_strikes_total += 1
+                logger.warning(
+                    "device %d straggler strike %d/%d (skew budget "
+                    "%.3fs)", d, self._strikes[d],
+                    self.config.straggler_strikes, skew)
+                if self._strikes[d] >= self.config.straggler_strikes:
+                    over.append((d, self._strikes[d]))
+        quarantined: list[int] = []
+        for d, trip in over:
+            exc = DeviceStragglerError(
+                f"device {d} struck {trip} consecutive batches")
+            # drive the chip's breaker OPEN through its own
+            # discipline (record_fault counts the quarantine
+            # transition; the loop is bounded by the chip's trip
+            # threshold), so cooldown/probe re-entry is exactly the
+            # fault path's
+            br = self._breakers[d]
+            for _ in range(max(1, self.config.trip_threshold)):
+                if br.state != DEVICE:
+                    break
+                self.record_fault(d, exc)
+            if br.state != DEVICE:
+                quarantined.append(d)
+        return quarantined
+
+    # -- probe / re-admission --
+
+    def probe_candidates(self) -> list[int]:
+        """Quarantined devices whose cooldown elapsed AND whose
+        half-open probe slot this caller just acquired — the caller
+        MUST report each one via probe_result()."""
+        out = []
+        for d, br in enumerate(self._breakers):
+            if br.state == DEVICE:
+                continue
+            try:
+                if br.admit():
+                    out.append(d)
+            except CircuitOpen:
+                continue
+        return out
+
+    def probe_execution(self, d: int):
+        """Context manager marking chip `d`'s probe as LIVE while it
+        executes (the breaker's execution window): probe wall time is
+        bounded by `probe_timeout_s`, which may exceed the breaker's
+        stale-probe reclaim window (max(cooldown_s, 1s)) — without
+        this, an admission's state poll would reclaim the slot under
+        a merely-slow probe."""
+        return self._breakers[d].execution()
+
+    def probe_result(self, d: int, ok: bool,
+                     exc: BaseException | None = None) -> None:
+        """Report a probe outcome. A successful probe counts as a
+        re-admission ONLY if the breaker actually closed —
+        `success()` is deliberately a no-op on a breaker the
+        stale-probe reclaim already re-opened, and counting/logging a
+        readmit then would report a recovered chip that never
+        rejoined the mesh."""
+        br = self._breakers[d]
+        readmitted = False
+        with self._lock:
+            if ok:
+                br.success()
+                readmitted = br.state == DEVICE
+                if readmitted:
+                    self._readmits[d] += 1
+                    self._strikes[d] = 0
+            else:
+                br.failure(exc or DeviceLostError(
+                    d, RuntimeError("probe failed")))
+        if readmitted:
+            logger.info("device %d probe succeeded; re-admitted to "
+                        "the mesh", d)
+        elif ok:
+            logger.warning(
+                "device %d probe answered, but its slot was already "
+                "stale-reclaimed (probe outlived the breaker's "
+                "reclaim window); staying quarantined until the next "
+                "probe", d)
+
+    # -- observability (bccsp_device_* gauges) --
+
+    def snapshot(self) -> dict:
+        """Per-device gauge rows, one slot per FULL-mesh device:
+        published device-labeled by profiling.publish_provider_stats."""
+        with self._lock:
+            return {
+                "state": self.state_codes(),
+                "trips": [br.stats["trips"]
+                          for br in self._breakers],
+                "quarantines": list(self._quarantines),
+                "readmits": list(self._readmits),
+            }
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {
+                "device_quarantines": sum(self._quarantines),
+                "device_readmits": sum(self._readmits),
+                "device_straggler_strikes":
+                    self._straggler_strikes_total,
+            }
+
+    def any_unhealthy(self) -> bool:
+        return any(br.state != DEVICE for br in self._breakers)
+
+    def force_state(self, d: int, state: str) -> None:
+        """Test seam: pin a device's breaker state directly."""
+        br = self._breakers[d]
+        with br._lock:
+            br._state = state
+            if state == DEGRADED:
+                br._open_until = br._clock() + self.config.cooldown_s

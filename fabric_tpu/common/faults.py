@@ -14,6 +14,18 @@ The well-known points:
     tpu.bls_aggregate  the staged BLS aggregate-verify path — a fault
                        serves the host reference pairing product
                        (bccsp/tpu.py verify_aggregate)
+    tpu.device_lost    per-device point inside the sharded span feeder
+                       and the quarantine probe (bccsp/tpu.py
+                       _shard_put/_probe_device): checked with
+                       arg=<full-mesh device index>, so chaos targets
+                       chip k — an error there quarantines THAT chip
+                       and the provider rebuilds a smaller mesh over
+                       the survivors (common/devicehealth.py)
+    tpu.device_straggler
+                       same per-device seam, delay mode: the targeted
+                       chip's transfer stream stalls, feeding the
+                       straggler accounting that quarantines a chip
+                       pacing the whole mesh (bccsp/tpu.py)
     raft.step          inbound raft messages (orderer raft chain loop)
     order.propose      the batched propose span of the ordering
                        admission window — a fault demotes the window
@@ -44,9 +56,14 @@ Arming:
            pass (tools/chaos_check.sh) arms a whole pytest run while
            each test still starts from the same armed baseline.
 
-Spec grammar: `point=mode[:count][:delay_s]`, `mode` in {error, delay};
-empty count = unlimited. A `delay` fault sleeps then proceeds (a stall,
-for deadline/breaker testing); an `error` fault raises FaultInjected.
+Spec grammar: `point=mode[:count][:delay_s][:arg]`, `mode` in
+{error, delay}; empty count = unlimited. A `delay` fault sleeps then
+proceeds (a stall, for deadline/breaker testing); an `error` fault
+raises FaultInjected. The optional 4th field targets an ARGUMENT: the
+fault fires only when the call site's `check(point, arg=...)` matches
+it (the per-device points pass the full-mesh device index, so
+`tpu.device_lost=error:1::3` kills exactly chip 3); a check without an
+arg never matches an arg-targeted arming.
 
 Counts are consumed per fire; `fires(point)` reports how often a point
 actually fired (armed or not, a check on an unarmed point counts
@@ -81,6 +98,8 @@ KNOWN_POINTS = frozenset({
     "tpu.table_persist",
     "tpu.ed25519",
     "tpu.bls_aggregate",
+    "tpu.device_lost",
+    "tpu.device_straggler",
     "raft.step",
     "order.propose",
     "deliver.stream",
@@ -98,6 +117,7 @@ class _Arming:
     count: Optional[int] = None    # remaining fires; None = unlimited
     delay_s: float = 0.0
     message: str = ""
+    arg: Optional[str] = None      # fire only when check(arg=) matches
 
 
 class FaultRegistry:
@@ -110,7 +130,7 @@ class FaultRegistry:
 
     def arm(self, point: str, mode: str = "error",
             count: Optional[int] = None, delay_s: float = 0.0,
-            message: str = "") -> None:
+            message: str = "", arg=None) -> None:
         if mode not in ("error", "delay"):
             raise ValueError(f"unknown fault mode {mode!r}")
         if point not in KNOWN_POINTS:
@@ -119,11 +139,13 @@ class FaultRegistry:
                 "declares it in KNOWN_POINTS (common/faults.py); a "
                 "typo'd %s entry injects nothing", point, ENV_VAR)
         with self._lock:
-            self._armed[point] = _Arming(mode=mode, count=count,
-                                         delay_s=delay_s,
-                                         message=message)
-        logger.info("fault point %s armed: mode=%s count=%s delay=%.3fs",
-                    point, mode, count, delay_s)
+            self._armed[point] = _Arming(
+                mode=mode, count=count, delay_s=delay_s,
+                message=message,
+                arg=None if arg is None else str(arg))
+        logger.info("fault point %s armed: mode=%s count=%s "
+                    "delay=%.3fs arg=%s", point, mode, count, delay_s,
+                    arg)
 
     def disarm(self, point: str) -> None:
         with self._lock:
@@ -157,8 +179,11 @@ class FaultRegistry:
                          if len(fields) > 1 and fields[1] else None)
                 delay = (float(fields[2])
                          if len(fields) > 2 and fields[2] else 0.0)
+                arg = (fields[3]
+                       if len(fields) > 3 and fields[3] else None)
                 self.arm(point.strip(), mode=mode, count=count,
-                         delay_s=delay, message=f"env:{ENV_VAR}")
+                         delay_s=delay, message=f"env:{ENV_VAR}",
+                         arg=arg)
             except (ValueError, IndexError):
                 logger.warning("ignoring malformed %s entry %r",
                                ENV_VAR, part)
@@ -175,14 +200,20 @@ class FaultRegistry:
 
     # -- the hot-path hook --
 
-    def check(self, point: str) -> None:
+    def check(self, point: str, arg=None) -> None:
         """Fire the fault armed at `point`, if any. Near-free when
-        nothing is armed (the production state)."""
+        nothing is armed (the production state). `arg` is the call
+        site's targeting argument (the per-device points pass the
+        full-mesh device index); an arming with an arg fires ONLY on
+        a matching check, and never on an arg-less one."""
         if not self._armed:
             return
         with self._lock:
             a = self._armed.get(point)
             if a is None:
+                return
+            if a.arg is not None and (arg is None
+                                      or str(arg) != a.arg):
                 return
             if a.count is not None:
                 a.count -= 1
@@ -190,6 +221,8 @@ class FaultRegistry:
                     del self._armed[point]
             self._fires[point] = self._fires.get(point, 0) + 1
             mode, delay_s, msg = a.mode, a.delay_s, a.message
+            if a.arg is not None:
+                msg = f"{msg};arg={a.arg}" if msg else f"arg={a.arg}"
         # act OUTSIDE the lock: a delay fault must not serialize every
         # other fault point behind its sleep
         if mode == "delay":

@@ -125,9 +125,12 @@ BCCSP_SHARD_READY_SECONDS_OPTS = GaugeOpts(
     namespace="bccsp", subsystem="shard", name="ready_s",
     help="Per-device seconds from the batch's first span dispatch "
          "until that device's slice of the final span's accept bitmap "
-         "was ready. Sampled in mesh order (each reading is an upper "
-         "bound); a straggler chip shows as a step in the curve.",
-    label_names=("device",))
+         "was ready. Sampled in a per-batch rotating order (each "
+         "reading is an upper bound given earlier-sampled devices); "
+         "a straggler chip shows as a step at its sampling position — "
+         "the rotation guarantees a chip is not permanently sampled "
+         "first, where its slowness would inflate every reading "
+         "equally and hide.", label_names=("device",))
 
 BCCSP_SHARD_LANES_OPTS = GaugeOpts(
     namespace="bccsp", subsystem="shard", name="lanes",
@@ -161,6 +164,34 @@ BCCSP_SHARD_SKEW_SECONDS_OPTS = GaugeOpts(
     help="Ready-time spread (max - min) across mesh devices for the "
          "most recent sharded batch: persistent skew means one chip "
          "paces the whole mesh.")
+
+BCCSP_DEVICE_STATE_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="state",
+    help="Per-chip health state in the elastic verify mesh: 0 healthy "
+         "(serving), 1 probing (cooldown elapsed, awaiting its "
+         "re-admission probe), 2 quarantined (out of the mesh; the "
+         "provider serves on the survivors). Device label = full-mesh "
+         "index.", label_names=("device",))
+
+BCCSP_DEVICE_TRIPS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="trips",
+    help="Per-chip breaker trips: device-attributed dispatch/transfer "
+         "failures or straggler-strike budgets that opened this "
+         "chip's quarantine breaker since process start.",
+    label_names=("device",))
+
+BCCSP_DEVICE_QUARANTINES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="quarantines",
+    help="Times this chip entered quarantine (benched out of the "
+         "serving mesh) since process start — each one triggered a "
+         "degraded-mesh rebuild over the surviving chips.",
+    label_names=("device",))
+
+BCCSP_DEVICE_READMITS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="readmits",
+    help="Times this chip passed its re-admission probe and rejoined "
+         "the serving mesh (the mesh grew back) since process start.",
+    label_names=("device",))
 
 COMMIT_PIPELINE_DEPTH_OPTS = GaugeOpts(
     namespace="commit", subsystem="pipeline", name="depth",

@@ -105,13 +105,18 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
         "shard_dispatches": metrics_mod.BCCSP_SHARD_DISPATCHES_OPTS,
         "shard_skew_s": metrics_mod.BCCSP_SHARD_SKEW_SECONDS_OPTS,
     }
+    # the per-device quarantine/readmit split is published as the
+    # canonical device-labeled bccsp_device_* series below; a generic
+    # scalar gauge for the stats aggregate of the same name would
+    # collide with it in the registry (same fqname, different labels)
+    labeled_only = {"device_quarantines", "device_readmits"}
     gauges = {
         name: metrics_provider.new_gauge(canonical.get(
             name, metrics_mod.GaugeOpts(
                 namespace="bccsp", name=name,
                 help="BCCSP provider runtime counter "
                      "(TPUProvider.stats)"))).with_labels()
-        for name in stats
+        for name in stats if name not in labeled_only
     }
     # the canonical degradation instruments (the names operators
     # alert on): breaker state gauge + trip counter, fed from the
@@ -133,6 +138,26 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
             }
         except Exception:
             shard_gauges = None
+    # per-device health gauges (device label = FULL-mesh index): fed
+    # from the provider's device_stats property — read fresh per poll
+    # so cooldown-driven state changes (quarantined -> probing) show
+    # without a dispatch
+    device_stats = getattr(csp, "device_stats", None)
+    device_gauges = None
+    if isinstance(device_stats, dict):
+        try:
+            device_gauges = {
+                "state": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_DEVICE_STATE_OPTS),
+                "trips": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_DEVICE_TRIPS_OPTS),
+                "quarantines": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_DEVICE_QUARANTINES_OPTS),
+                "readmits": metrics_provider.new_gauge(
+                    metrics_mod.BCCSP_DEVICE_READMITS_OPTS),
+            }
+        except Exception:
+            device_gauges = None
     # scheme-router gauges (scheme label = router partition key):
     # fed from the provider's scheme_stats dicts, refreshed per poll
     scheme_stats = getattr(csp, "scheme_stats", None)
@@ -208,6 +233,21 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
                                 warned.add("shard_" + name)
                                 logger.warning(
                                     "bccsp shard gauge %r publish "
+                                    "failed (suppressing repeats): %s",
+                                    name, e)
+            if device_gauges is not None:
+                cur = getattr(csp, "device_stats", None)
+                if isinstance(cur, dict):
+                    for name, g in device_gauges.items():
+                        try:
+                            for d, v in enumerate(cur.get(name) or ()):
+                                g.with_labels("device",
+                                              str(d)).set(float(v))
+                        except Exception as e:
+                            if ("device_" + name) not in warned:
+                                warned.add("device_" + name)
+                                logger.warning(
+                                    "bccsp device gauge %r publish "
                                     "failed (suppressing repeats): %s",
                                     name, e)
             if scheme_gauges is not None:

@@ -195,7 +195,10 @@ class OrdererNode:
                                          False)))
         self.ops.register_checker("orderer", lambda: None)
         # breaker state of the sig-filter's TPU provider on /healthz
-        # (device | degraded | probing); degraded still serves
+        # (device | degraded | probing); degraded still serves. The
+        # elastic-mesh sub-state (`;degraded_mesh:<k>/<n>` — serving
+        # on k of n chips after a quarantine, or 1/<requested> when
+        # startup enumeration failed) rides the same string.
         health = getattr(csp, "health", None)
         if callable(health):
             self.ops.register_checker("bccsp", health)

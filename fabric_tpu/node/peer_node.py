@@ -257,7 +257,12 @@ class PeerNode:
         self.ops.register_checker("peer", lambda: None)
         # the TPU provider's breaker state on /healthz: degraded means
         # verdicts are served (bit-identically) by the sw path while
-        # the device cools down — report, don't fail the node
+        # the device cools down — report, don't fail the node. The
+        # elastic-mesh sub-state rides the same string
+        # (`device;degraded_mesh:<k>/<n>`): serving on k of n chips
+        # after a quarantine — or 1/<requested> when startup device
+        # enumeration failed — is degraded-but-serving, never a
+        # failed check.
         health = getattr(csp, "health", None)
         if callable(health):
             self.ops.register_checker("bccsp", health)

@@ -50,6 +50,22 @@ _SW = SWProvider()
 _KEYS = [_SW.key_gen(ECDSAKeyGenOpts(ephemeral=True)) for _ in range(3)]
 
 
+class _StepClock:
+    """Injectable monotonic clock for the breaker's clock seam
+    (`CircuitBreaker(clock=)` / `DeviceHealth(clock=)`): cooldown
+    transitions are driven by `advance()`, never by wall sleeps, so
+    timing assertions cannot lose races on a loaded box."""
+
+    def __init__(self):
+        self._t = time.monotonic()
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> None:
+        self._t += dt
+
+
 def _premask_pool(n_keys=2):
     """(VerifyItem, expected) pool whose verdicts are decided by host
     pre-validation alone (valid low-S sig -> True; malformed DER,
@@ -339,7 +355,16 @@ class TestTPUProviderDegradation:
     def test_deadline_stall_trips_then_reprobes(self, monkeypatch):
         """Stalled dispatches (delay faults) exceed DeadlineMs, count
         as failures, trip the breaker; after CooldownS the next batch
-        probes the device and re-admits it."""
+        probes the device and re-admits it.
+
+        Cooldown passage is driven through the breaker's monotonic
+        CLOCK SEAM (a stepped fake), not wall sleeps: on a loaded box
+        the old 0.2s margin lost races — more than the cooldown could
+        elapse between the trip inside verify_batch and the health()
+        assertion, reading `probing` where the test pinned
+        `degraded`. The deadline watchdog itself still runs on wall
+        time (the 1.0s injected stall vs the 300ms deadline leaves no
+        meaningful race)."""
         faults.clear()
         # the deadline must measure the DISPATCH, not first-use costs:
         # warm the jax backend and the native-extension probe (a ~3s
@@ -353,12 +378,16 @@ class TestTPUProviderDegradation:
             monkeypatch, min_batch=4,
             fallback=BreakerConfig(deadline_ms=300, trip_threshold=2,
                                    cooldown_s=0.2, probe_batch=64))
+        clk = _StepClock()
+        tpu._breaker._clock = clk
         items, expected = _tile(_premask_pool(), 16)
         assert tpu.verify_batch(items) == expected     # timeout 1
         assert tpu.verify_batch(items) == expected     # timeout 2: trip
         assert tpu.stats["breaker_deadline_timeouts"] == 2
+        # deterministic: the breaker's clock has not moved since the
+        # trip, so the cooldown CANNOT have elapsed yet
         assert tpu.health() == "degraded"
-        time.sleep(0.25)
+        clk.advance(0.25)
         assert tpu.health() == "probing"
         # fault budget exhausted: the probe dispatch succeeds
         assert tpu.verify_batch(items) == expected

@@ -122,9 +122,23 @@ assert stats.get("pipeline_batches", 0) > 0, "pipeline path never ran"
 assert stats.get("pipeline_overlap_ratio", 0) > 0, stats
 mc = stages.get("multichip") or {}
 if mc.get("ok"):
+    # round-13 contract: the multichip line carries the device-health
+    # facts (chips benched/re-admitted, final mesh size) so the
+    # driver can tell a full-fleet scaling number from a
+    # degraded-mesh salvage without opening sidecars
+    for f in ("device_quarantines", "device_readmits",
+              "final_mesh_devices"):
+        assert f in mc and mc[f] is not None, \
+            f"multichip line lacks device-health field {f!r}: {mc}"
+    if mc["device_quarantines"]:
+        assert mc.get("device_health_note") or \
+            mc["final_mesh_devices"] == mc.get("devices"), \
+            f"degraded multichip run without a salvage note: {mc}"
     print("bench_smoke: multichip scaling",
           mc.get("tpu_steady_scaling_x"), "x over",
-          mc.get("devices"), "devices")
+          mc.get("devices"), "devices; device_health",
+          {f: mc[f] for f in ("device_quarantines", "device_readmits",
+                              "final_mesh_devices")})
 print("bench_smoke: ok —",
       {k: stats[k] for k in ("pipeline_batches", "pipeline_chunks",
                              "pipeline_overlap_ratio")},

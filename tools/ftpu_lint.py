@@ -77,6 +77,11 @@ REQUIRED_HOT_PATHS = {
         "_dispatch_comb_digest", "_dispatch_comb", "_shard_put",
         # round-11 scheme router: the Ed25519 device dispatch span
         "_dispatch_ed25519",
+        # round-13 elastic mesh: the degraded-mesh rebuild runs on
+        # the dispatch path (admission hook, between batches) — a
+        # host sync smuggled in here would stall every batch behind
+        # the swap
+        "_rebuild_mesh",
     ),
     "fabric_tpu/core/commitpipeline.py": ("_validate_one",),
     # round-10 ordering spans: the batched raft propose and the
