@@ -155,6 +155,14 @@ def _start_watchdog() -> None:
         # orphan a bench child that still owns the single-owner TPU
         # chip, wedging the driver's next claim of the device
         _kill_children()
+        trace_dump = None
+        try:
+            # the flight recorder is the rc=124 postmortem: dump what
+            # the process was doing when the deadline fired
+            from fabric_tpu.common import tracing
+            trace_dump = tracing.dump("bench_watchdog")
+        except Exception:       # noqa: BLE001
+            pass
         res = {
             "metric": "block-validation sig-verify throughput "
                       "(smoke, self-deadline hit)",
@@ -162,6 +170,7 @@ def _start_watchdog() -> None:
             "unit": "sigs/s",
             "deadline_s": DEADLINE_S,
             "deadline_hit": True,
+            "trace_dump": trace_dump,
             "completed_sections": sorted(_PARTIAL),
         }
         if _PARTIAL.get("stage"):
@@ -172,6 +181,13 @@ def _start_watchdog() -> None:
             res["devices"] = _PARTIAL.get("devices")
             res["local_devices"] = _PARTIAL.get("local_devices")
             res["mesh_devices"] = _PARTIAL.get("mesh_devices")
+            # round-14 salvage: the verify tail + measured tracing
+            # overhead survive a deadline-cut core stage, so the
+            # orchestrator's multichip line still carries them
+            for k in ("verify_p50_s", "verify_p99_s",
+                      "tracing_overhead_pct"):
+                if k in _PARTIAL:
+                    res[k] = _PARTIAL[k]
         emit_final(res, dict(_PARTIAL))
         os._exit(0)
 
@@ -966,6 +982,37 @@ def stage_core():
     provider_s = min(times)
     if not all(out):
         raise SystemExit("correctness failure in steady provider pass")
+
+    # --- round-14 tracing facts: verify tail latencies from the
+    #     stage reservoirs, and a measured tracing-on vs tracing-off
+    #     A/B on the SAME steady loop (the acceptance bar: the
+    #     always-on recorder must cost <=2% on this stage) ---
+    from fabric_tpu.common import tracing
+    trace_fields: dict = {}
+    provider_off_s = None
+    if tracing.enabled():       # FTPU_TRACE=0 skips the A/B entirely
+        tq = tracing.stage_quantiles().get("tpu.verify") or {}
+        trace_fields["verify_p50_s"] = \
+            round(tq["p50_s"], 6) if tq else None
+        trace_fields["verify_p99_s"] = \
+            round(tq["p99_s"], 6) if tq else None
+        tracing.set_enabled(False)
+        try:
+            times_off = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                out = prov.verify_batch(items)
+                times_off.append(time.perf_counter() - t0)
+        finally:
+            tracing.set_enabled(True)
+        if not all(out):
+            raise SystemExit("correctness failure in tracing-off "
+                             "pass")
+        provider_off_s = min(times_off)
+        trace_fields["tracing_overhead_pct"] = round(
+            (provider_s / provider_off_s - 1.0) * 100.0, 2)
+    _PARTIAL.update(trace_fields)
+
     _PARTIAL["provider_verify_batch_sigs_per_s"] = \
         round(batch / provider_s, 1)
     _PARTIAL["value"] = _PARTIAL["provider_verify_batch_sigs_per_s"]
@@ -975,6 +1022,9 @@ def stage_core():
                 "mesh_devices": mesh_devices, "batch": batch,
                 "sigs_per_s": round(batch / provider_s, 1),
                 "seconds": round(provider_s, 4),
+                "tracing_off_seconds": (round(provider_off_s, 4)
+                                        if provider_off_s else None),
+                **trace_fields,
                 "overlap_ratio":
                     prov.stats["pipeline_overlap_ratio"],
                 "shard_skew_s": prov.stats["shard_skew_s"]})
@@ -1182,6 +1232,7 @@ def stage_core():
         "shard_stats": dict(prov.shard_stats),
         "scheme_stats": {k: dict(v)
                          for k, v in prov.scheme_stats.items()},
+        "trace_stage_quantiles": tracing.stage_quantiles(),
         "ed25519": dict(ed_fields) or None,
         "devices": [str(d) for d in jax.devices()],
     }
@@ -1212,6 +1263,7 @@ def stage_core():
         "deadline_s": DEADLINE_S or None,
         "deadline_hit": False,
         "on_tpu": on_tpu,
+        **trace_fields,
         **ed_fields,
     }, detail)
 
@@ -1352,10 +1404,25 @@ def stage_pipeline():
         res["commit_pipeline_overlap_ratio"] = \
             commitpipe["overlap_ratio"]
         res["commit_pipeline_speedup"] = commitpipe["speedup"]
+        for k in ("cp_validate_p50_s", "cp_validate_p99_s",
+                  "cp_commit_p50_s", "cp_commit_p99_s"):
+            if commitpipe.get(k) is not None:
+                res[k] = commitpipe[k]
     if orderpipe and "order_raft_s" in orderpipe:
         res["order_raft_s"] = orderpipe["order_raft_s"]
         res["order_tx_per_s"] = orderpipe["order_tx_per_s"]
         res["order_vs_validate"] = orderpipe["order_vs_validate"]
+        # round-14 stage tails + the end-to-end lifecycle trace
+        for k in ("order_window_p50_s", "order_window_p99_s",
+                  "order_propose_p50_s", "order_propose_p99_s",
+                  "order_consensus_p50_s", "order_consensus_p99_s",
+                  "order_write_p50_s", "order_write_p99_s",
+                  "validate_p50_s", "validate_p99_s",
+                  "commit_p50_s", "commit_p99_s",
+                  "trace_file", "probe_trace_id",
+                  "trace_linked_stages"):
+            if orderpipe.get(k) is not None:
+                res[k] = orderpipe[k]
     elif orderpipe and "skipped" in orderpipe:
         res["order_skipped"] = orderpipe["skipped"]
     if pipeline and "tpu_peer_block_s" in pipeline:
@@ -1537,6 +1604,11 @@ def orchestrate():
             mc["device_quarantines"] = quar
             mc["device_readmits"] = readm
             mc["final_mesh_devices"] = final_mesh
+            # round-14: the all-device verify tail beside the scaling
+            # ratio — a straggler chip shows here before it shows in
+            # the mean
+            mc["verify_p50_s"] = coreN.get("verify_p50_s")
+            mc["verify_p99_s"] = coreN.get("verify_p99_s")
             if quar and final_mesh and \
                     final_mesh < (coreN.get("mesh_devices") or 0):
                 mc["device_health_note"] = (

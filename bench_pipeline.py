@@ -641,9 +641,17 @@ def make_order_service(root: str, client=None, csp=None,
                                  client=support.client, close=close)
 
 
+def _stage_tail(stage: str, which: str):
+    """Rounded stage-quantile lookup shared by the bench rigs (the
+    `*_p50_s`/`*_p99_s` stage-line fields)."""
+    from fabric_tpu.common import tracing
+    return tracing.stage_quantile(stage, which, ndigits=6)
+
+
 def order_pipeline_run(csp=None, ntxs: int = 1024,
                        window: int = 256,
-                       block_txs: int = 256) -> dict:
+                       block_txs: int = 256,
+                       trace_path: str = None) -> dict:
     """ISSUE 7 scenario: the batched raft ordering pipeline, wheel-free
     (stub x509/MSP seam, pure-python P-256 when the OpenSSL wheel is
     absent) so the bounded default bench can always report the
@@ -660,18 +668,28 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
     import shutil
 
     from fabric_tpu.bccsp import VerifyItem
+    from fabric_tpu.common import tracing
     from fabric_tpu.protos import common as cpb
 
     root = tempfile.mkdtemp(prefix="bench_order_")
     svc = None
+    commit_pipe = None
     try:
+        # start from a clean recorder: this run's dump and stage
+        # quantiles should describe THIS run, not earlier bench
+        # sections sharing the process
+        tracing.reset()
         svc = make_order_service(root, csp=csp, block_txs=block_txs,
                                  batch_timeout_s=30.0)
         client = svc.client
 
-        # ---- creator-signed envelopes (CPU signing, untimed) ----
+        # ---- creator-signed envelopes (CPU signing, untimed):
+        # `ntxs` for the timed run + one extra block's worth for the
+        # untimed lifecycle PROBE below, so the timed denominator is
+        # unchanged vs earlier rounds ----
         t0 = time.perf_counter()
-        envs = [client.envelope(i) for i in range(ntxs)]
+        envs = [client.envelope(i) for i in range(ntxs + block_txs)]
+        probe_envs, envs = envs[:block_txs], envs[block_txs:]
         sign_s = time.perf_counter() - t0
 
         # wait out the single-node election so the timed run measures
@@ -682,39 +700,66 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
                 raise RuntimeError("no raft leader after 60s")
             time.sleep(0.01)
 
+        def pump(run, stop_deadline):
+            """Broadcast `run` under per-window ingress spans (the
+            broadcast_stream seam's round-14 shape: each window's
+            trace context propagates into the order events)."""
+            pos = 0
+            while pos < len(run):
+                with tracing.span("ingress.batch",
+                                  envelopes=min(window,
+                                                len(run) - pos)) as c:
+                    resps = svc.broadcast.process_messages(
+                        run[pos:pos + window])
+                ok = 0
+                for resp in resps:
+                    if resp.status == cpb.Status.SUCCESS:
+                        ok += 1
+                    elif resp.status == \
+                            cpb.Status.SERVICE_UNAVAILABLE:
+                        break   # leadership wobble: retry tail
+                    else:
+                        raise RuntimeError(f"broadcast rejected: "
+                                           f"{resp.status} "
+                                           f"{resp.info}")
+                pos += ok
+                if ok == 0:
+                    if time.monotonic() > stop_deadline:
+                        raise RuntimeError(
+                            "broadcast unavailable for 60s")
+                    time.sleep(0.02)
+            return c
+
+        ledger = svc.support.ledger
+
+        def wait_txs(want, deadline_s=600):
+            deadline = time.monotonic() + deadline_s
+            while True:
+                blks = [ledger.get_block(n)
+                        for n in range(1, ledger.height)]
+                got = sum(len(b.data.data) for b in blks
+                          if b is not None)
+                if got >= want and all(b is not None for b in blks):
+                    return blks
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"ordering stalled: {got}/{want} at height "
+                        f"{ledger.height}")
+                time.sleep(0.02)
+
+        # ---- the lifecycle probe (untimed): ONE full block pushed
+        # through ingress->order->write alone, so its trace_id links
+        # a transaction end to end deterministically (the acceptance
+        # trace); the commit pipeline below re-attaches the same
+        # context for its validate/commit spans ----
+        probe_ctx = pump(probe_envs, deadline0)
+        wait_txs(len(probe_envs))
+        probe_trace_id = probe_ctx.trace_id if probe_ctx else None
+
         # ---- the timed ordering run ----
         t0 = time.perf_counter()
-        pos = 0
-        while pos < len(envs):
-            resps = svc.broadcast.process_messages(
-                envs[pos:pos + window])
-            ok = 0
-            for resp in resps:
-                if resp.status == cpb.Status.SUCCESS:
-                    ok += 1
-                elif resp.status == cpb.Status.SERVICE_UNAVAILABLE:
-                    break    # transient leadership wobble: retry tail
-                else:
-                    raise RuntimeError(f"broadcast rejected: "
-                                       f"{resp.status} {resp.info}")
-            pos += ok
-            if ok == 0:
-                if time.monotonic() > deadline0:
-                    raise RuntimeError("broadcast unavailable for 60s")
-                time.sleep(0.02)
-        ledger = svc.support.ledger
-        deadline = time.monotonic() + 600
-        while True:
-            blocks = [ledger.get_block(n)
-                      for n in range(1, ledger.height)]
-            got = sum(len(b.data.data) for b in blocks
-                      if b is not None)
-            if got >= ntxs and all(b is not None for b in blocks):
-                break
-            if time.monotonic() > deadline:
-                raise RuntimeError(f"ordering stalled: {got}/{ntxs} "
-                                   f"at height {ledger.height}")
-            time.sleep(0.02)
+        pump(envs, time.monotonic() + 60)
+        blocks = wait_txs(len(probe_envs) + ntxs)
         order_s = time.perf_counter() - t0
 
         # ---- the peer-validation equivalent on the SAME provider ----
@@ -728,15 +773,138 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
         if not all(ok):
             raise RuntimeError("validate-equivalent rejected lanes")
 
+        # ---- validate+commit the ORDERED stream through the REAL
+        # CommitPipeline (round 14): its commit.validate /
+        # commit.commit spans complete the lifecycle — the probe
+        # block submits under the probe's trace context, so one
+        # trace_id now links ingress -> order.window -> order.propose
+        # -> order.consensus -> order.write -> commit.validate ->
+        # commit.commit in the dumped trace ----
+        from fabric_tpu.core.commitpipeline import CommitPipeline
+        from fabric_tpu.core.txvalidator import ValidationResult
+        from fabric_tpu.protos import transaction as txpb
+        from fabric_tpu.protoutil import protoutil as pu
+
+        class _Validator:
+            """Batched creator-signature verify per block on the same
+            provider (the device-bound stage), deferred-publication
+            contract matching the real TxValidator."""
+
+            def validate_ahead(self, block, known_txids=None):
+                v0 = time.perf_counter()
+                vitems = []
+                for env_bytes in block.data.data:
+                    env = pu.unmarshal_envelope(env_bytes)
+                    vitems.append(VerifyItem(key=client.pub,
+                                             signature=env.signature,
+                                             message=env.payload))
+                vok = provider.verify_batch(vitems)
+                codes = [txpb.TxValidationCode.VALID if o else
+                         txpb.TxValidationCode.BAD_CREATOR_SIGNATURE
+                         for o in vok]
+                return ValidationResult(
+                    codes=codes, n_items=len(vitems),
+                    duration_s=time.perf_counter() - v0)
+
+            def publish_validation(self, block, result):
+                while len(block.metadata.metadata) <= \
+                        cpb.BlockMetadataIndex.TRANSACTIONS_FILTER:
+                    block.metadata.metadata.append(b"")
+                block.metadata.metadata[
+                    cpb.BlockMetadataIndex.TRANSACTIONS_FILTER] = \
+                    bytes(result.codes)
+
+            def validate(self, block):
+                result = self.validate_ahead(block)
+                self.publish_validation(block, result)
+                return result.codes
+
+        class _BlockStore:
+            @staticmethod
+            def block_tx_ids(block):
+                out = []
+                for env_bytes in block.data.data:
+                    try:
+                        env = pu.unmarshal_envelope(env_bytes)
+                        payload = pu.get_payload(env)
+                        out.append(pu.get_channel_header(
+                            payload).tx_id)
+                    except Exception:       # noqa: BLE001
+                        out.append("")
+                return out
+
+        class _PeerLedger:
+            def __init__(self):
+                self.height = 1             # "genesis committed"
+                self.block_store = _BlockStore()
+
+        class _PeerChan:
+            channel_id = client.channel
+
+            def __init__(self):
+                self.ledger = _PeerLedger()
+                self.validator = _Validator()
+                self.committed: list = []
+
+            def commit_validated(self, block, codes, rwsets=None,
+                                 tx_ids=None):
+                if not all(c == txpb.TxValidationCode.VALID
+                           for c in codes):
+                    raise RuntimeError(
+                        f"ordered block [{block.header.number}] "
+                        f"failed creator-signature validation")
+                self.committed.append(block.header.number)
+                self.ledger.height = block.header.number + 1
+                return list(codes)
+
+            def process_block(self, block):
+                codes = self.validator.validate(block)
+                return self.commit_validated(block, codes)
+
+        chan = _PeerChan()
+        commit_pipe = CommitPipeline(chan, depth=1)
+        t0 = time.perf_counter()
+        for i, blk in enumerate(blocks, start=1):
+            # the probe block (number 1) carries the probe context so
+            # its validate/commit spans share the lifecycle trace_id
+            with tracing.attached(
+                    probe_ctx if blk.header.number == 1 else None):
+                commit_pipe.submit(i, block=blk)
+        commit_pipe.drain(timeout=600)
+        commit_leg_s = time.perf_counter() - t0
+        if len(chan.committed) != len(blocks):
+            raise RuntimeError(
+                f"commit leg short: {len(chan.committed)}/"
+                f"{len(blocks)} blocks")
+
+        # ---- stage tails + the lifecycle trace dump ----
+        pq = _stage_tail
+
+        if trace_path is None:
+            trace_path = os.environ.get("BENCH_TRACE_SIDECAR",
+                                        "bench_trace.json")
+        trace_file = None
+        linked = []
+        if trace_path:
+            try:
+                trace_file = tracing.dump("bench_full_pipeline",
+                                          path=trace_path)
+            except Exception:               # noqa: BLE001
+                trace_file = None
+        if probe_trace_id:
+            linked = tracing.trace_stages(probe_trace_id)
+
         stats = svc.chain.order_pipeline_stats()
         win = getattr(svc.support.ingress_csp, "stats", {})
         return {
             "ntxs": ntxs, "window": window, "block_txs": block_txs,
-            "blocks": len(blocks), "sign_s": round(sign_s, 2),
+            "blocks": len(blocks) - 1,      # probe block excluded
+            "sign_s": round(sign_s, 2),
             "order_raft_s": round(order_s, 3),
             "order_tx_per_s": round(ntxs / order_s, 1),
             "validate_equiv_s": round(validate_s, 4),
             "order_vs_validate": round(order_s / validate_s, 2),
+            "commit_leg_s": round(commit_leg_s, 3),
             "batch_fill": stats.get("fill"),
             "windows": stats.get("windows"),
             "blocks_proposed": stats.get("blocks_proposed"),
@@ -748,8 +916,29 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
             "ingress_window_dispatches": win.get("window_dispatches"),
             "ingress_window_callers": win.get("window_callers"),
             "filter_backend": type(provider).__name__,
+            # round-14 per-stage tails (the means above hide these)
+            "order_window_p50_s": pq("order.window", "p50_s"),
+            "order_window_p99_s": pq("order.window", "p99_s"),
+            "order_propose_p50_s": pq("order.propose", "p50_s"),
+            "order_propose_p99_s": pq("order.propose", "p99_s"),
+            "order_consensus_p50_s": pq("order.consensus", "p50_s"),
+            "order_consensus_p99_s": pq("order.consensus", "p99_s"),
+            "order_write_p50_s": pq("order.write", "p50_s"),
+            "order_write_p99_s": pq("order.write", "p99_s"),
+            "validate_p50_s": pq("commit.validate", "p50_s"),
+            "validate_p99_s": pq("commit.validate", "p99_s"),
+            "commit_p50_s": pq("commit.commit", "p50_s"),
+            "commit_p99_s": pq("commit.commit", "p99_s"),
+            "trace_file": trace_file,
+            "probe_trace_id": probe_trace_id,
+            "trace_linked_stages": ",".join(linked) or None,
         }
     finally:
+        if commit_pipe is not None:
+            try:
+                commit_pipe.stop()
+            except Exception:             # noqa: BLE001
+                pass
         if svc is not None:
             try:
                 svc.close(flush=True)
@@ -1036,11 +1225,16 @@ def commit_pipeline_run(n_blocks: int = 6, ntxs: int = 24) -> dict:
     from fabric_tpu.protos import common as cpb, proposal as proppb
     from fabric_tpu.protos import transaction as txpb
 
+    from fabric_tpu.common import tracing
+
     channel = "cpbench"
     root = tempfile.mkdtemp(prefix="bench_cp_")
     seq = piped = pipeline = None
     scratch_kv = None
     try:
+        # clean stage reservoirs: this run's validate/commit tails
+        # must describe THIS rig, not earlier bench sections
+        tracing.reset()
         sw = SWProvider()
         key = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
         pub = key.public_key()
@@ -1166,10 +1360,17 @@ def commit_pipeline_run(n_blocks: int = 6, ntxs: int = 24) -> dict:
 
         assert piped.ledger.commit_hash == seq.ledger.commit_hash, \
             "pipelined commit hash diverged from sequential"
+        pq = _stage_tail
+
         return {
             "blocks": n_blocks, "txs_per_block": ntxs,
             "sequential_s": round(sequential_s, 4),
             "pipelined_s": round(pipelined_s, 4),
+            # round-14 per-block stage tails from the pipelined twin
+            "cp_validate_p50_s": pq("commit.validate", "p50_s"),
+            "cp_validate_p99_s": pq("commit.validate", "p99_s"),
+            "cp_commit_p50_s": pq("commit.commit", "p50_s"),
+            "cp_commit_p99_s": pq("commit.commit", "p99_s"),
             "speedup": round(sequential_s / pipelined_s, 3)
             if pipelined_s else None,
             "overlap_ratio": round(overlap, 4),

@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Optional
 
+from fabric_tpu.common import tracing
 from fabric_tpu.common.hotpath import hot_path
 from fabric_tpu.common.overload import Deadline, OverloadError
 
@@ -136,6 +137,7 @@ class AdmissionWindow:
                             self._queue.remove(mine)
                             self.stats["window_sheds"] += 1
                             self._last_shed_t = time.monotonic()
+                            tracing.note_shed("bccsp.admission")
                             raise OverloadError(
                                 "bccsp.admission",
                                 "convoy wait exceeded the deadline "
@@ -161,6 +163,10 @@ class AdmissionWindow:
             wait = time.perf_counter() - t0
             self.stats["window_wait_s"] += wait
             self.stats["window_last_wait_s"] = wait
+        # convoy-wait tail distribution (trace_stage_seconds + the
+        # bench's admission p50/p99) — outside the cond, one reading
+        # per caller whichever role (leader waits ~0)
+        tracing.observe_stage("bccsp.admission.wait", wait)
         if batch is not None:
             try:
                 self._dispatch_window(batch)
@@ -173,6 +179,7 @@ class AdmissionWindow:
         return mine.result
 
     @hot_path
+    @tracing.traced("bccsp.window")
     def _dispatch_window(self, batch) -> None:
         """ONE provider dispatch for every caller in `batch`, verdicts
         scattered back per caller. The provider's breaker/fallback

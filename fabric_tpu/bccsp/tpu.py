@@ -37,6 +37,7 @@ from fabric_tpu.common import breaker as breaker_mod
 from fabric_tpu.common import devicehealth as devhealth_mod
 from fabric_tpu.common import faults
 from fabric_tpu.common import lockcheck
+from fabric_tpu.common import tracing
 from fabric_tpu.common.devicehealth import DeviceLostError
 from fabric_tpu.common.hotpath import hot_path
 
@@ -417,7 +418,11 @@ class TPUProvider(api.BCCSP):
                 self._dispatch_cv.wait(0.1)
             self._dispatch_inflight += 1
         try:
-            yield
+            # one `tpu.verify` span per breaker-guarded device
+            # dispatch (whichever scheme path): the bench's verify
+            # p50/p99 and the flight recorder's dispatch timeline
+            with tracing.span("tpu.verify"):
+                yield
         finally:
             with self._dispatch_cv:
                 self._dispatch_inflight -= 1
@@ -554,6 +559,7 @@ class TPUProvider(api.BCCSP):
         return True
 
     @hot_path
+    @tracing.traced("tpu.mesh_rebuild")
     def _rebuild_mesh(self, healthy: list) -> None:
         """Swap the serving mesh for one over `healthy` (full-mesh
         indices): drain in-flight dispatch spans (bounded — a wedged
@@ -620,6 +626,8 @@ class TPUProvider(api.BCCSP):
                     self._dispatch_cv.notify_all()
             self.stats["shard_devices"] = mesh.size
             self.stats["mesh_rebuilds"] += 1
+            tracing.instant("tpu.mesh_rebuild", devices=mesh.size,
+                            full=len(self._dev_all))
             if mesh.size < len(self._dev_all):
                 logger.warning(
                     "serving mesh REBUILT over %d/%d device(s) "
@@ -987,6 +995,7 @@ class TPUProvider(api.BCCSP):
             sw_lanes)
 
     @hot_path
+    @tracing.traced("tpu.dispatch")
     def _dispatch_arrays(self, bucket, key_map, key_idx, blocks,
                          nblocks, r_l, rpn_l, w_l, premask, digests,
                          has_digest, qx_b, qy_b, async_out=False):
@@ -1093,6 +1102,7 @@ class TPUProvider(api.BCCSP):
         return out
 
     @hot_path
+    @tracing.traced("tpu.ed25519")
     def _dispatch_ed25519(self, items) -> list[bool]:
         """The Ed25519 device span: host prep rows (gates + challenge
         already computed), bucket/chunk staging, sharded feed under a
@@ -1253,6 +1263,7 @@ class TPUProvider(api.BCCSP):
             return self._prep_pool
 
     @hot_path
+    @tracing.traced("tpu.pipeline")
     def _verify_batch_pipelined(self, items) -> Optional[list[bool]]:
         """Double-buffered verify: the batch is split into fixed
         PipelineChunk-lane spans; while span N executes on device,
@@ -2317,6 +2328,7 @@ class TPUProvider(api.BCCSP):
                              "q16": q16, "K": K}
 
     @hot_path
+    @tracing.traced("tpu.shard_put")
     def _shard_put(self, arrs, timings=None):
         """Round-robin span feeder for the sharded dispatch: deal each
         span's lanes contiguously across the mesh — device d takes the
@@ -2417,6 +2429,17 @@ class TPUProvider(api.BCCSP):
             "ready_s": ready,
             "lanes": [span // ndev] * ndev,
         }
+        # per-chip tail distributions (round 14): the snapshot gauges
+        # above show the LAST batch; these feed trace_stage_seconds so
+        # a chip whose p99 transfer/ready drifts shows up long before
+        # the straggler quarantine trips. Stage label carries the
+        # FULL-mesh index — stable across rebuilds, like the gauges.
+        for pos in range(npos):
+            gi = self._device_index(mesh_devs[pos])
+            tracing.observe_stage(f"device.transfer.d{gi}", tdev[pos])
+            if ready:
+                tracing.observe_stage(f"device.ready.d{gi}",
+                                      ready[pos])
         self.stats["shard_devices"] = ndev
         self.stats["shard_skew_s"] = (
             round(max(ready) - min(ready), 6) if ready else 0.0)
@@ -2445,6 +2468,7 @@ class TPUProvider(api.BCCSP):
         return chunk
 
     @hot_path
+    @tracing.traced("tpu.comb_digest")
     def _dispatch_comb_digest(self, bucket, key_map, key_idx, r8, rpn8,
                               w8, premask, digests, async_out=False):
         """Digest-lane comb dispatch: compact u8 scalar operands, limb
@@ -2516,6 +2540,7 @@ class TPUProvider(api.BCCSP):
         return thunk if async_out else thunk()
 
     @hot_path
+    @tracing.traced("tpu.comb")
     def _dispatch_comb(self, bucket, key_map, key_idx, blocks, nblocks,
                        r_l, rpn_l, w_l, premask, digests, has_digest,
                        async_out=False):

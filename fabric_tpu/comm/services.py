@@ -19,6 +19,7 @@ import time
 from fabric_tpu.comm.server import (
     GRPCServer, STREAM_STREAM, UNARY_STREAM, UNARY_UNARY,
 )
+from fabric_tpu.common import tracing
 from fabric_tpu.protos import common, gateway as gwpb, gossip as gpb
 from fabric_tpu.protos import orderer as opb, proposal as ppb
 
@@ -120,7 +121,16 @@ def broadcast_stream(request_iterator, broadcast_handler,
     `SERVICE_UNAVAILABLE` (reference Fabric's overloaded-orderer
     contract) instead of a stalled stream — and the batch runs under
     the ambient deadline so every downstream wait (admission window,
-    raft event enqueue) is bounded by the same budget."""
+    raft event enqueue) is bounded by the same budget.
+
+    Round 14: the correlation edge. Each contiguous run of real
+    envelopes processes under an `ingress.batch` span with a FRESH
+    trace context (one trace per ingress run — the batch is the
+    pipeline's unit of work; a single-envelope submitter gets its
+    own), which the downstream order events inherit ambiently
+    (order window -> propose -> consensus -> block write). A shed
+    leaves an `overload.shed` instant in the flight recorder beside
+    its 1:1 response marker."""
     from fabric_tpu.common import overload
 
     _register_ingress_stage()
@@ -155,6 +165,7 @@ def broadcast_stream(request_iterator, broadcast_handler,
                         _bcast_ingress_stats["sheds"] += 1
                         _bcast_ingress_stats["last_shed_t"] = \
                             time.monotonic()
+                        tracing.note_shed("broadcast.ingress")
                         q.put_forced((_BCAST_SHED, None))
                         break
         except Exception as e:
@@ -192,7 +203,8 @@ def broadcast_stream(request_iterator, broadcast_handler,
                 batch.append(nxt)
             # split the drained window into contiguous runs of real
             # envelopes (processed batched under the run's tightest
-            # remaining deadline) and shed markers (answered in place)
+            # remaining deadline, under one fresh-trace ingress span)
+            # and shed markers (answered in place)
             run: list = []
             run_dl = None
 
@@ -200,13 +212,23 @@ def broadcast_stream(request_iterator, broadcast_handler,
                 nonlocal run, run_dl
                 if not run:
                     return
-                if run_dl is not None:
-                    with run_dl.applied():
-                        yield from \
-                            broadcast_handler.process_messages(run)
-                else:
-                    yield from broadcast_handler.process_messages(run)
+                # the span closes BEFORE the responses are yielded: a
+                # slow client pulling responses (or cancelling the
+                # stream, raising GeneratorExit at a yield) must not
+                # inflate the ingress.batch duration or stamp bogus
+                # error spans — the span measures handler time only
+                with tracing.span("ingress.batch",
+                                  envelopes=len(run)):
+                    if run_dl is not None:
+                        with run_dl.applied():
+                            resps = list(
+                                broadcast_handler.process_messages(
+                                    run))
+                    else:
+                        resps = list(
+                            broadcast_handler.process_messages(run))
                 run, run_dl = [], None
+                yield from resps
 
             for env, dl in batch:
                 if env is _BCAST_SHED:

@@ -173,6 +173,7 @@ class CircuitBreaker:
                 # held probe slot must not leak
                 self._probe_inflight = False
             return
+        tripped = 0
         with self._lock:
             self.stats["failures"] += 1
             st = self._state_locked()
@@ -181,6 +182,7 @@ class CircuitBreaker:
                     self._failures >= self.config.trip_threshold:
                 if st != DEGRADED:
                     self.stats["trips"] += 1
+                    tripped = self._failures
                     logger.warning(
                         "%s breaker OPEN after %d consecutive device "
                         "failure(s) (%s); serving the sw path for "
@@ -191,6 +193,12 @@ class CircuitBreaker:
                 self._open_until = (self._clock()
                                     + self.config.cooldown_s)
                 self._probe_inflight = False
+        if tripped:
+            # flight-recorder landmark + automatic postmortem dump
+            # (rate-limited, never raises) — OUTSIDE the breaker lock:
+            # the dump does file I/O
+            from fabric_tpu.common import tracing
+            tracing.note_breaker_trip(self.name, failures=tripped)
 
     # -- guarded execution --
 

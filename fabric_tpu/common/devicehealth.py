@@ -189,6 +189,10 @@ class DeviceHealth:
                 "mesh rebuilds over the survivors)", d,
                 type(exc).__name__ if exc else "failure",
                 self.config.cooldown_s)
+            # flight-recorder landmark + automatic postmortem dump
+            # (outside the ring lock: the dump does file I/O)
+            from fabric_tpu.common import tracing
+            tracing.note_quarantine(d)
         return newly
 
     def attribute(self, exc: BaseException) -> Optional[int]:
@@ -319,6 +323,8 @@ class DeviceHealth:
         if readmitted:
             logger.info("device %d probe succeeded; re-admitted to "
                         "the mesh", d)
+            from fabric_tpu.common import tracing
+            tracing.note_readmit(d)
         elif ok:
             logger.warning(
                 "device %d probe answered, but its slot was already "

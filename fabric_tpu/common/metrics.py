@@ -193,6 +193,35 @@ BCCSP_DEVICE_READMITS_OPTS = GaugeOpts(
          "the serving mesh (the mesh grew back) since process start.",
     label_names=("device",))
 
+BCCSP_DEVICE_QUARANTINES_TOTAL_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="quarantines_total",
+    help="Chip quarantines across the whole mesh since process start "
+         "— the scalar aggregate of the device-labeled "
+         "bccsp_device_quarantines series, under its own canonical "
+         "name so the generic provider-stats poller can publish it "
+         "without colliding with the labeled gauge's fqname.")
+
+BCCSP_DEVICE_READMITS_TOTAL_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="readmits_total",
+    help="Probe re-admissions across the whole mesh since process "
+         "start — the scalar aggregate of the device-labeled "
+         "bccsp_device_readmits series (see "
+         "bccsp_device_quarantines_total for why the name differs "
+         "from the stats key).")
+
+TRACE_STAGE_SECONDS_OPTS = HistogramOpts(
+    namespace="trace", subsystem="stage", name="seconds",
+    help="Per-stage latency distributions from the lifecycle-tracing "
+         "spans (common/tracing.py): ingress batches, admission-"
+         "window convoy waits, order window/propose/consensus/write, "
+         "commit-pipeline validate/commit, device dispatch and "
+         "per-device transfer/ready — p50/p99-derivable tails beside "
+         "the last-batch snapshot gauges. The stage label is the "
+         "span name.",
+    label_names=("stage",),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10))
+
 COMMIT_PIPELINE_DEPTH_OPTS = GaugeOpts(
     namespace="commit", subsystem="pipeline", name="depth",
     help="Configured commit-pipeline depth: how many blocks may be "

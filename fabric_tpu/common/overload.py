@@ -49,6 +49,8 @@ import time
 import weakref
 from typing import Optional
 
+from fabric_tpu.common import tracing
+
 _INGRESS_ENV = "FTPU_INGRESS_BUDGET_S"
 _ENQUEUE_ENV = "FTPU_ENQUEUE_BUDGET_S"
 
@@ -306,6 +308,7 @@ class SheddingQueue:
                 if remaining <= 0:
                     self.stats["sheds"] += 1
                     self._last_shed_t = time.monotonic()
+                    tracing.note_shed(self.name)
                     raise OverloadError(
                         self.name,
                         f"queue full at {self.maxsize} for "
@@ -327,6 +330,7 @@ class SheddingQueue:
                 if count_shed:
                     self.stats["sheds"] += 1
                     self._last_shed_t = time.monotonic()
+                    tracing.note_shed(self.name)
                 else:
                     self.stats["drops"] += 1
                 return False
@@ -368,6 +372,7 @@ class SheddingQueue:
                 dropped += 1
                 self.stats["sheds"] += 1
                 self._last_shed_t = time.monotonic()
+                tracing.note_shed(self.name)
             self._q.put_nowait(item)
             self._account_put(time.monotonic())
         return dropped

@@ -17,6 +17,7 @@ adapted to this runtime:
 from __future__ import annotations
 
 import collections
+import itertools
 import logging
 import os
 import sys
@@ -26,6 +27,34 @@ import time
 logger = logging.getLogger("common.profiling")
 
 SAMPLE_HZ = 100
+
+_poller_seq = itertools.count()
+
+
+def _spawn_poller(name: str, poll_s: float, tick) -> threading.Thread:
+    """One daemon poll loop, with a process-unique thread name and a
+    deterministic per-poller interval jitter. Every publisher used to
+    spawn with the same bare name and the same 5s period, so stacked
+    pollers woke in phase — the sampling profiler (/debug/profile)
+    read the synchronized sleep stacks as one aliased hot frame, and
+    two providers' pollers were indistinguishable in a thread dump.
+    The jitter staggers the periods (+3% per poller sequence —
+    strictly DISTINCT periods, so no two pollers ever re-align; a
+    modulo scheme would hand the 6th poller the 1st one's exact
+    period back) and the `-<seq>` suffix makes each poller
+    attributable."""
+    seq = next(_poller_seq)
+    interval = poll_s * (1.0 + 0.03 * seq)
+
+    def loop():
+        while True:
+            tick()
+            time.sleep(interval)
+
+    t = threading.Thread(target=loop, name=f"{name}-{seq}",
+                         daemon=True)
+    t.start()
+    return t
 
 
 def sample_profile(seconds: float = 5.0, hz: int = SAMPLE_HZ) -> str:
@@ -104,19 +133,22 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
         "shard_devices": metrics_mod.BCCSP_SHARD_DEVICES_OPTS,
         "shard_dispatches": metrics_mod.BCCSP_SHARD_DISPATCHES_OPTS,
         "shard_skew_s": metrics_mod.BCCSP_SHARD_SKEW_SECONDS_OPTS,
+        # the scalar quarantine/readmit aggregates share their STATS
+        # key with the device-labeled bccsp_device_* series; their
+        # canonical *_total names keep the registry fqnames disjoint
+        # (the round-13 exclusion left these aggregates unpublished)
+        "device_quarantines":
+            metrics_mod.BCCSP_DEVICE_QUARANTINES_TOTAL_OPTS,
+        "device_readmits":
+            metrics_mod.BCCSP_DEVICE_READMITS_TOTAL_OPTS,
     }
-    # the per-device quarantine/readmit split is published as the
-    # canonical device-labeled bccsp_device_* series below; a generic
-    # scalar gauge for the stats aggregate of the same name would
-    # collide with it in the registry (same fqname, different labels)
-    labeled_only = {"device_quarantines", "device_readmits"}
     gauges = {
         name: metrics_provider.new_gauge(canonical.get(
             name, metrics_mod.GaugeOpts(
                 namespace="bccsp", name=name,
                 help="BCCSP provider runtime counter "
                      "(TPUProvider.stats)"))).with_labels()
-        for name in stats if name not in labeled_only
+        for name in stats
     }
     # the canonical degradation instruments (the names operators
     # alert on): breaker state gauge + trip counter, fed from the
@@ -193,98 +225,95 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
     except Exception:
         admission_wait = None
 
-    def poll():
-        last_trips = 0
-        warned: set = set()     # once per gauge, not once per poll_s
-        while True:
-            if admission_wait is not None:
-                win = getattr(csp, "__ftpu_admission_window__", None)
-                if win is not None:
-                    try:
-                        admission_wait.set(float(
-                            win.stats.get("window_last_wait_s", 0.0)))
-                    except Exception as e:
-                        if "admission" not in warned:
-                            warned.add("admission")
-                            logger.warning(
-                                "bccsp admission gauge publish failed "
-                                "(suppressing repeats): %s", e)
-            for name, g in gauges.items():
-                try:
-                    g.set(float(stats.get(name, 0)))
-                except Exception as e:
-                    if name not in warned:
-                        warned.add(name)
-                        logger.warning("bccsp stats gauge %r publish "
-                                       "failed (suppressing repeats): "
-                                       "%s", name, e)
-            if shard_gauges is not None:
-                # re-read per poll: the provider replaces the dict
-                # wholesale on each sharded batch
-                cur = getattr(csp, "shard_stats", None)
-                if isinstance(cur, dict):
-                    for name, g in shard_gauges.items():
-                        try:
-                            for d, v in enumerate(cur.get(name) or ()):
-                                g.with_labels("device",
-                                              str(d)).set(float(v))
-                        except Exception as e:
-                            if ("shard_" + name) not in warned:
-                                warned.add("shard_" + name)
-                                logger.warning(
-                                    "bccsp shard gauge %r publish "
-                                    "failed (suppressing repeats): %s",
-                                    name, e)
-            if device_gauges is not None:
-                cur = getattr(csp, "device_stats", None)
-                if isinstance(cur, dict):
-                    for name, g in device_gauges.items():
-                        try:
-                            for d, v in enumerate(cur.get(name) or ()):
-                                g.with_labels("device",
-                                              str(d)).set(float(v))
-                        except Exception as e:
-                            if ("device_" + name) not in warned:
-                                warned.add("device_" + name)
-                                logger.warning(
-                                    "bccsp device gauge %r publish "
-                                    "failed (suppressing repeats): %s",
-                                    name, e)
-            if scheme_gauges is not None:
-                cur = getattr(csp, "scheme_stats", None)
-                if isinstance(cur, dict):
-                    for name, g in scheme_gauges.items():
-                        try:
-                            for scheme, v in dict(
-                                    cur.get(name) or {}).items():
-                                g.with_labels(
-                                    "scheme", str(scheme)).set(
-                                        float(v))
-                        except Exception as e:
-                            if ("scheme_" + name) not in warned:
-                                warned.add("scheme_" + name)
-                                logger.warning(
-                                    "bccsp scheme gauge %r publish "
-                                    "failed (suppressing repeats): %s",
-                                    name, e)
-            if fallback_state is not None:
-                try:
-                    fallback_state.set(float(breaker.state_code))
-                    trips = breaker.stats["trips"]
-                    if trips > last_trips:
-                        fallback_trips.add(trips - last_trips)
-                        last_trips = trips
-                except Exception as e:
-                    if "breaker" not in warned:
-                        warned.add("breaker")
-                        logger.warning("bccsp breaker gauge publish "
-                                       "failed (suppressing repeats): "
-                                       "%s", e)
-            time.sleep(poll_s)
+    state = {"last_trips": 0}
+    warned: set = set()         # once per gauge, not once per poll
 
-    t = threading.Thread(target=poll, name="bccsp-stats", daemon=True)
-    t.start()
-    return t
+    def tick():
+        if admission_wait is not None:
+            win = getattr(csp, "__ftpu_admission_window__", None)
+            if win is not None:
+                try:
+                    admission_wait.set(float(
+                        win.stats.get("window_last_wait_s", 0.0)))
+                except Exception as e:
+                    if "admission" not in warned:
+                        warned.add("admission")
+                        logger.warning(
+                            "bccsp admission gauge publish failed "
+                            "(suppressing repeats): %s", e)
+        for name, g in gauges.items():
+            try:
+                g.set(float(stats.get(name, 0)))
+            except Exception as e:
+                if name not in warned:
+                    warned.add(name)
+                    logger.warning("bccsp stats gauge %r publish "
+                                   "failed (suppressing repeats): "
+                                   "%s", name, e)
+        if shard_gauges is not None:
+            # re-read per poll: the provider replaces the dict
+            # wholesale on each sharded batch
+            cur = getattr(csp, "shard_stats", None)
+            if isinstance(cur, dict):
+                for name, g in shard_gauges.items():
+                    try:
+                        for d, v in enumerate(cur.get(name) or ()):
+                            g.with_labels("device",
+                                          str(d)).set(float(v))
+                    except Exception as e:
+                        if ("shard_" + name) not in warned:
+                            warned.add("shard_" + name)
+                            logger.warning(
+                                "bccsp shard gauge %r publish "
+                                "failed (suppressing repeats): %s",
+                                name, e)
+        if device_gauges is not None:
+            cur = getattr(csp, "device_stats", None)
+            if isinstance(cur, dict):
+                for name, g in device_gauges.items():
+                    try:
+                        for d, v in enumerate(cur.get(name) or ()):
+                            g.with_labels("device",
+                                          str(d)).set(float(v))
+                    except Exception as e:
+                        if ("device_" + name) not in warned:
+                            warned.add("device_" + name)
+                            logger.warning(
+                                "bccsp device gauge %r publish "
+                                "failed (suppressing repeats): %s",
+                                name, e)
+        if scheme_gauges is not None:
+            cur = getattr(csp, "scheme_stats", None)
+            if isinstance(cur, dict):
+                for name, g in scheme_gauges.items():
+                    try:
+                        for scheme, v in dict(
+                                cur.get(name) or {}).items():
+                            g.with_labels(
+                                "scheme", str(scheme)).set(
+                                    float(v))
+                    except Exception as e:
+                        if ("scheme_" + name) not in warned:
+                            warned.add("scheme_" + name)
+                            logger.warning(
+                                "bccsp scheme gauge %r publish "
+                                "failed (suppressing repeats): %s",
+                                name, e)
+        if fallback_state is not None:
+            try:
+                fallback_state.set(float(breaker.state_code))
+                trips = breaker.stats["trips"]
+                if trips > state["last_trips"]:
+                    fallback_trips.add(trips - state["last_trips"])
+                    state["last_trips"] = trips
+            except Exception as e:
+                if "breaker" not in warned:
+                    warned.add("breaker")
+                    logger.warning("bccsp breaker gauge publish "
+                                   "failed (suppressing repeats): "
+                                   "%s", e)
+
+    return _spawn_poller("bccsp-stats", poll_s, tick)
 
 
 def publish_overload_stats(metrics_provider, poll_s: float = 5.0):
@@ -309,40 +338,36 @@ def publish_overload_stats(metrics_provider, poll_s: float = 5.0):
     sheds_c = metrics_provider.new_counter(
         metrics_mod.OVERLOAD_SHEDS_TOTAL_OPTS)
 
-    def poll():
-        last_sheds: dict = {}
-        warned: set = set()
-        while True:
-            for stage, s in overload.stage_stats().items():
-                try:
-                    lbl = ("stage", stage)
-                    depth_g.with_labels(*lbl).set(
-                        float(s.get("depth", 0)))
-                    cap_g.with_labels(*lbl).set(
-                        float(s.get("capacity", 0)))
-                    if "max_depth" in s:
-                        max_g.with_labels(*lbl).set(
-                            float(s["max_depth"]))
-                    if "last_wait_s" in s:
-                        wait_g.with_labels(*lbl).set(
-                            float(s["last_wait_s"]))
-                    sheds = int(s.get("sheds", 0))
-                    if sheds > last_sheds.get(stage, 0):
-                        sheds_c.with_labels(*lbl).add(
-                            sheds - last_sheds.get(stage, 0))
-                        last_sheds[stage] = sheds
-                except Exception as e:
-                    if stage not in warned:
-                        warned.add(stage)
-                        logger.warning(
-                            "overload gauge publish for %r failed "
-                            "(suppressing repeats): %s", stage, e)
-            time.sleep(poll_s)
+    last_sheds: dict = {}
+    warned: set = set()
 
-    t = threading.Thread(target=poll, name="overload-stats",
-                         daemon=True)
-    t.start()
-    return t
+    def tick():
+        for stage, s in overload.stage_stats().items():
+            try:
+                lbl = ("stage", stage)
+                depth_g.with_labels(*lbl).set(
+                    float(s.get("depth", 0)))
+                cap_g.with_labels(*lbl).set(
+                    float(s.get("capacity", 0)))
+                if "max_depth" in s:
+                    max_g.with_labels(*lbl).set(
+                        float(s["max_depth"]))
+                if "last_wait_s" in s:
+                    wait_g.with_labels(*lbl).set(
+                        float(s["last_wait_s"]))
+                sheds = int(s.get("sheds", 0))
+                if sheds > last_sheds.get(stage, 0):
+                    sheds_c.with_labels(*lbl).add(
+                        sheds - last_sheds.get(stage, 0))
+                    last_sheds[stage] = sheds
+            except Exception as e:
+                if stage not in warned:
+                    warned.add(stage)
+                    logger.warning(
+                        "overload gauge publish for %r failed "
+                        "(suppressing repeats): %s", stage, e)
+
+    return _spawn_poller("overload-stats", poll_s, tick)
 
 
 def publish_order_stats(metrics_provider, registrar, poll_s: float = 5.0):
@@ -372,30 +397,26 @@ def publish_order_stats(metrics_provider, registrar, poll_s: float = 5.0):
             metrics_mod.ORDERER_BATCH_OVERLAP_RATIO_OPTS),
     }
 
-    def poll():
-        warned: set = set()     # once per channel, not once per poll_s
-        while True:
-            for cid in registrar.channel_list():
-                support = registrar.get_chain(cid)
-                stats_fn = getattr(
-                    getattr(support, "chain", None),
-                    "order_pipeline_stats", None)
-                if stats_fn is None:
-                    continue
-                try:
-                    stats = stats_fn()
-                    for name, g in gauges.items():
-                        g.with_labels("channel", cid).set(
-                            float(stats.get(name, 0)))
-                except Exception as e:
-                    if cid not in warned:
-                        warned.add(cid)
-                        logger.warning(
-                            "orderer batch gauge publish for %r "
-                            "failed (suppressing repeats): %s", cid, e)
-            time.sleep(poll_s)
+    warned: set = set()         # once per channel, not once per poll
 
-    t = threading.Thread(target=poll, name="orderer-batch-stats",
-                         daemon=True)
-    t.start()
-    return t
+    def tick():
+        for cid in registrar.channel_list():
+            support = registrar.get_chain(cid)
+            stats_fn = getattr(
+                getattr(support, "chain", None),
+                "order_pipeline_stats", None)
+            if stats_fn is None:
+                continue
+            try:
+                stats = stats_fn()
+                for name, g in gauges.items():
+                    g.with_labels("channel", cid).set(
+                        float(stats.get(name, 0)))
+            except Exception as e:
+                if cid not in warned:
+                    warned.add(cid)
+                    logger.warning(
+                        "orderer batch gauge publish for %r "
+                        "failed (suppressing repeats): %s", cid, e)
+
+    return _spawn_poller("orderer-batch-stats", poll_s, tick)

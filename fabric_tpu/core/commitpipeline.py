@@ -63,6 +63,7 @@ from typing import Callable, Optional
 from fabric_tpu.common import faults
 from fabric_tpu.common import metrics as metrics_mod
 from fabric_tpu.common import overload
+from fabric_tpu.common import tracing
 from fabric_tpu.common.hotpath import hot_path
 
 logger = logging.getLogger("commitpipeline")
@@ -108,6 +109,10 @@ class _Item:
     # sequential-fallback demotion (stage-A failure)
     fallback: bool = False
     verified: bool = False       # mcs.verify_block already passed
+    # trace context captured at submit (the feeder's ambient one):
+    # the validate/commit spans keep the block's trace_id across both
+    # worker threads
+    tctx: object = None
 
 
 class CommitPipeline:
@@ -247,6 +252,8 @@ class CommitPipeline:
                 if remaining <= 0:
                     self.stats["sheds"] += 1
                     self._last_shed_t = time.monotonic()
+                    tracing.note_shed(
+                        f"commit.pipeline.{self.channel.channel_id}")
                     raise overload.OverloadError(
                         f"commit.pipeline.{self.channel.channel_id}",
                         f"backpressure wait for block [{seq}] "
@@ -258,7 +265,8 @@ class CommitPipeline:
                 raise CommitPipelineError(
                     seq, "verify", RuntimeError("pipeline stopped"))
             self._intake.append(_Item(seq=seq, epoch=self._epoch,
-                                      raw=raw, block=block))
+                                      raw=raw, block=block,
+                                      tctx=tracing.capture()))
             self._inflight += 1
             self._next_seq = seq + 1
             self.stats["submitted"] += 1
@@ -476,6 +484,12 @@ class CommitPipeline:
 
     @hot_path
     def _validate_one(self, item: _Item) -> None:
+        with tracing.span("commit.validate", parent=item.tctx,
+                          seq=item.seq):
+            self._validate_one_traced(item)
+
+    @hot_path
+    def _validate_one_traced(self, item: _Item) -> None:
         from fabric_tpu import protoutil as pu
         from fabric_tpu.ledger.kvledger import extract_tx_rwset
 
@@ -558,19 +572,22 @@ class CommitPipeline:
             codes = None
             t0 = time.perf_counter()
             try:
-                if item.fallback:
-                    codes = self._commit_fallback(item)
-                else:
-                    # deferred validation side effects: the
-                    # predecessor is durably committed NOW, so the
-                    # TRANSACTIONS_FILTER stamp and validation metrics
-                    # for this block are published sequentially-
-                    # equivalently
-                    self.channel.validator.publish_validation(
-                        item.block, item.result)
-                    codes = self.channel.commit_validated(
-                        item.block, list(item.result.codes),
-                        rwsets=item.rwsets, tx_ids=item.tx_ids)
+                with tracing.span("commit.commit", parent=item.tctx,
+                                  seq=item.seq,
+                                  fallback=item.fallback):
+                    if item.fallback:
+                        codes = self._commit_fallback(item)
+                    else:
+                        # deferred validation side effects: the
+                        # predecessor is durably committed NOW, so the
+                        # TRANSACTIONS_FILTER stamp and validation
+                        # metrics for this block are published
+                        # sequentially-equivalently
+                        self.channel.validator.publish_validation(
+                            item.block, item.result)
+                        codes = self.channel.commit_validated(
+                            item.block, list(item.result.codes),
+                            rwsets=item.rwsets, tx_ids=item.tx_ids)
             except _Rejected as e:
                 self._fail_locked(item, e.stage, e.cause)
             except Exception as e:   # noqa: BLE001 — sticky, feeder retries

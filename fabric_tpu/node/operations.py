@@ -109,6 +109,14 @@ class OperationsServer:
                     {"Version": self._version}).encode())
             elif path == "/logspec":
                 self._logspec(h, method)
+            elif path == "/debug/trace" and method == "GET":
+                # the flight recorder (common/tracing.py) is always on
+                # by design — reading it is the POSTMORTEM surface, so
+                # unlike the profiling endpoints below it is not gated
+                # by operations.profile.enabled
+                from fabric_tpu.common import tracing
+                h._reply(200, json.dumps(
+                    tracing.chrome_trace()).encode())
             elif path.startswith("/debug/") and method == "GET":
                 self._debug(h, path)
             else:

@@ -59,6 +59,11 @@ class OrdererNode:
         self.metrics = provider
         from fabric_tpu.common import flogging as _flog
         _flog.wire_logging_metrics(provider)
+        # round-14 lifecycle tracing: Operations.Tracing.* knobs +
+        # span durations into the trace_stage_seconds histogram (the
+        # recorder itself is always on; /debug/trace reads it)
+        from fabric_tpu.common import tracing as _tracing
+        _tracing.configure_from_config(cfg, metrics_provider=provider)
 
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(

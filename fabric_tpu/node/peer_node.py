@@ -100,6 +100,12 @@ class PeerNode:
         self.metrics = provider
         from fabric_tpu.common import flogging as _flog
         _flog.wire_logging_metrics(provider)
+        # round-14 lifecycle tracing: operations.tracing.* knobs (the
+        # viperutil lookup is case-insensitive) + span durations into
+        # the trace_stage_seconds histogram; /debug/trace reads the
+        # always-on flight recorder
+        from fabric_tpu.common import tracing as _tracing
+        _tracing.configure_from_config(cfg, metrics_provider=provider)
 
         fs_path = cfg.get_path("peer.fileSystemPath")
         os.makedirs(fs_path, exist_ok=True)

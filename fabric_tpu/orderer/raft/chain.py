@@ -42,7 +42,7 @@ import threading
 import time
 from typing import Optional
 
-from fabric_tpu.common import faults, overload
+from fabric_tpu.common import faults, overload, tracing
 from fabric_tpu.common.hotpath import hot_path
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
 from fabric_tpu.orderer.raft.core import LEADER, RaftNode
@@ -276,7 +276,9 @@ class RaftChain:
             "last_consensus_s": 0.0,
             "steps_coalesced": 0, "demotions": 0,
         }
-        self._proposed_at: dict[int, float] = {}
+        # block number -> (propose perf_counter, trace context):
+        # consumed at commit time for the consensus-latency span
+        self._proposed_at: dict[int, tuple] = {}
         # raft-loop busy window, read by the write stage's overlap
         # accounting: (busy-since or None, last closed busy interval)
         self._loop_busy_since: Optional[float] = None
@@ -371,7 +373,8 @@ class RaftChain:
             raise MsgProcessorError("chain is halted")
         leader = self.node.leader_id
         if leader == self.node_id:
-            self._events.put(("order_batch", envs_seqs))
+            self._events.put(("order_batch", envs_seqs,
+                              tracing.capture()))
             return len(envs_seqs)
         accepted = 0
         for env, seq in envs_seqs:
@@ -395,7 +398,8 @@ class RaftChain:
             raise MsgProcessorError("chain is halted")
         leader = self.node.leader_id
         if leader == self.node_id:
-            self._events.put(("order", env, config_seq, is_config))
+            self._events.put(("order", env, config_seq, is_config,
+                              tracing.capture()))
             return
         self._submit_forward(env, config_seq)
 
@@ -456,7 +460,8 @@ class RaftChain:
             ch = pu.get_channel_header(payload)
             is_config = ch.type in (common.HeaderType.CONFIG,
                                     common.HeaderType.ORDERER_TRANSACTION)
-            self._events.put(("order", env, config_seq, is_config))
+            self._events.put(("order", env, config_seq, is_config,
+                              tracing.capture()))
         except overload.OverloadError as e:
             # full event queue past the deadline budget: backpressure
             # to the FORWARDER, which surfaces it to its client as a
@@ -579,9 +584,11 @@ class RaftChain:
                 window: list = []
                 for ev in self._coalesce_steps(evs):
                     if ev[0] == "order":
-                        window.append((ev[1], ev[2], ev[3]))
+                        window.append((ev[1], ev[2], ev[3],
+                                       ev[4] if len(ev) > 4 else None))
                     elif ev[0] == "order_batch":
-                        window.extend((env, seq, False)
+                        ctx = ev[2] if len(ev) > 2 else None
+                        window.extend((env, seq, False, ctx)
                                       for env, seq in ev[1])
                     else:
                         self._handle_event(ev, now)
@@ -648,11 +655,27 @@ class RaftChain:
         batch rides one `_propose_batch` (one WAL append). Config
         messages break the run — they flush pending work and get their
         own block, preserving intra-channel arrival order exactly like
-        the per-envelope path."""
+        the per-envelope path.
+
+        Round 14: the whole pass runs under an `order.window` span
+        attached to the window's first traced envelope (the ingress
+        span's context, carried across the event queue), so propose /
+        consensus / write spans downstream share its trace_id."""
+        # normalize legacy 3-tuple items (tests and older callers
+        # drive this entry directly without a trace context)
+        window = [w if len(w) > 3 else (w[0], w[1], w[2], None)
+                  for w in window]
+        wctx = next((c for _env, _seq, _cfg, c in window
+                     if c is not None), None)
+        with tracing.span("order.window", parent=wctx,
+                          envelopes=len(window)):
+            self._run_order_window(window)
+
+    def _run_order_window(self, window) -> None:
         support = self._support
         if self.node.state != LEADER:
             # deposed between submit and processing: re-route
-            for env, seq, is_config in window:
+            for env, seq, is_config, _ctx in window:
                 try:
                     self._submit(env, seq, is_config)
                 except MsgProcessorError as e:
@@ -672,7 +695,7 @@ class RaftChain:
                 batches.extend(cut)
             run = []
 
-        for env, seq, is_config in window:
+        for env, seq, is_config, _ctx in window:
             if is_config:
                 flush_run()
                 # propose everything cut so far FIRST: the config
@@ -741,6 +764,7 @@ class RaftChain:
             self._propose_batch([list(batch)])
 
     @hot_path
+    @tracing.traced("order.propose")
     def _propose_batch(self, batches) -> None:
         """The batched-propose span: every batch the admission window
         cut becomes one raft entry, ALL entries appended through one
@@ -777,8 +801,9 @@ class RaftChain:
             self.metrics.proposal_failures.add(len(blocks) - n)
             self._creator = None
         now = time.perf_counter()
+        pctx = tracing.capture()
         for block in blocks[:n]:
-            self._proposed_at[block.header.number] = now
+            self._proposed_at[block.header.number] = (now, pctx)
         self.order_stats["blocks_proposed"] += n
         if n:
             self.order_stats["last_fill"] = len(batches[n - 1])
@@ -796,7 +821,8 @@ class RaftChain:
             self.metrics.proposal_failures.add(1)
             self._creator = None
             return
-        self._proposed_at[block.header.number] = time.perf_counter()
+        self._proposed_at[block.header.number] = (
+            time.perf_counter(), tracing.capture())
         self.order_stats["blocks_proposed"] += 1
         self.order_stats["last_fill"] = len(envelopes)
 
@@ -838,11 +864,20 @@ class RaftChain:
             logger.warning("[%s] undecodable raft entry %d",
                            self._support.channel_id, entry.index)
             return
-        t0 = self._proposed_at.pop(block.header.number, None)
-        if t0 is not None:
-            dt = time.perf_counter() - t0
+        rec = self._proposed_at.pop(block.header.number, None)
+        pctx = None
+        if rec is not None:
+            t0, pctx = rec
+            t1 = time.perf_counter()
+            dt = t1 - t0
             self.order_stats["consensus_s"] += dt
             self.order_stats["last_consensus_s"] = dt
+            # propose->commit replication latency as a complete span
+            # under the proposing window's trace (leader only — a
+            # follower never proposed, so it has no t0 to anchor)
+            pctx = tracing.observe_span(
+                "order.consensus", t0, t1, parent=pctx,
+                block=block.header.number) or pctx
         height = self._support.ledger.height
         if self._write_stage is not None:
             # blocks the write stage holds count as written: a
@@ -862,7 +897,8 @@ class RaftChain:
                              block.header.number,
                              self._support.ledger.height)
                 return
-        self._write_committed_block(block)
+        with tracing.attached(pctx):
+            self._write_committed_block(block)
         self._applied_since_compact += 1
         if self._applied_since_compact >= COMPACT_EVERY:
             # compaction barrier: an entry compacted away while its

@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from fabric_tpu.common import overload
+from fabric_tpu.common import overload, tracing
 
 logger = logging.getLogger("orderer.raft.pipeline")
 
@@ -138,6 +138,8 @@ class BlockWriteStage:
                 if remaining <= 0:
                     self.stats["sheds"] += 1
                     self._last_shed_t = time.monotonic()
+                    tracing.note_shed(
+                        f"order.write.{self._support.channel_id}")
                     raise OrderWriteError(
                         block.header.number,
                         overload.OverloadError(
@@ -148,7 +150,10 @@ class BlockWriteStage:
                 self._cond.wait(timeout=remaining)
             if self._error is not None:
                 raise self._error
-            self._pending.append(block)
+            # the ambient context (the proposing window's, re-attached
+            # by the raft loop at _apply) rides with the block so the
+            # async write span keeps the transaction's trace_id
+            self._pending.append((block, tracing.capture()))
             self._submitted_tip = block.header.number
             self._cond.notify_all()
 
@@ -243,35 +248,42 @@ class BlockWriteStage:
                     return
                 # take everything queued: the whole run becomes ONE
                 # batched sign+verify span through the BCCSP seam
-                span, self._pending = self._pending, []
+                pending, self._pending = self._pending, []
                 self._cond.notify_all()   # wake a backpressured submit
+            run = [b for b, _ctx in pending]
+            rctx = next((c for _b, c in pending if c is not None),
+                        None)
             t0 = time.perf_counter()
             try:
-                write_blocks = getattr(self._support, "write_blocks",
-                                       None)
-                if write_blocks is not None and len(span) > 1:
-                    write_blocks(span)
-                else:
-                    for block in span:
-                        self._support.write_block(block)
+                with tracing.span("order.write", parent=rctx,
+                                  blocks=len(run),
+                                  first=run[0].header.number,
+                                  last=run[-1].header.number):
+                    write_blocks = getattr(self._support,
+                                           "write_blocks", None)
+                    if write_blocks is not None and len(run) > 1:
+                        write_blocks(run)
+                    else:
+                        for block in run:
+                            self._support.write_block(block)
             except Exception as e:   # noqa: BLE001 — sticky, chain demotes
                 logger.exception(
                     "[%s] pipelined write of blocks [%d..%d] failed; "
                     "the chain will demote to sequential writes and "
                     "replay from the raft log",
-                    self._support.channel_id, span[0].header.number,
-                    span[-1].header.number)
+                    self._support.channel_id, run[0].header.number,
+                    run[-1].header.number)
                 with self._cond:
                     if self._error is None:
                         self._error = OrderWriteError(
-                            span[0].header.number, e)
+                            run[0].header.number, e)
                     self._cond.notify_all()
                 continue
             t1 = time.perf_counter()
             with self._cond:
-                self._written_tip = span[-1].header.number
+                self._written_tip = run[-1].header.number
                 self._cond.notify_all()
-            self.stats["written"] += len(span)
+            self.stats["written"] += len(run)
             self.stats["spans"] += 1
             self.stats["write_s"] += t1 - t0
             self.stats["last_write_s"] = t1 - t0

@@ -279,6 +279,92 @@ class TestHotPathCoverage:
             lint.REQUIRED_HOT_PATHS["fabric_tpu/bccsp/tpu.py"]
 
 
+class TestSpanCoverage:
+    """Round-14 rule: every REQUIRED_SPANS function (the hot-path
+    dispatch spans plus the pipeline stage workers) must open a
+    lifecycle tracing span — a @traced decorator or a span()/
+    observe_span()/observe_stage()/instant() call; dropping it blinds
+    the flight recorder on exactly that stage."""
+
+    def _seed_tpu(self, root, body: str):
+        bccsp = os.path.join(root, "fabric_tpu", "bccsp")
+        os.makedirs(bccsp, exist_ok=True)
+        open(os.path.join(bccsp, "__init__.py"), "w").close()
+        with open(os.path.join(bccsp, "tpu.py"), "w") as f:
+            f.write(body)
+
+    def _spans(self, lint, spanned=True, how="traced"):
+        names = lint.REQUIRED_SPANS["fabric_tpu/bccsp/tpu.py"]
+        out = ["from fabric_tpu.common.hotpath import hot_path",
+               "from fabric_tpu.common import tracing", ""]
+        for name in names:
+            out.append("@hot_path")
+            if spanned and how == "traced":
+                out.append(f'@tracing.traced("tpu.{name}")')
+            out.append(f"def {name}(*a, **kw):")
+            if spanned and how == "with":
+                out.append(f'    with tracing.span("tpu.{name}"):')
+                out.append("        return None")
+            elif spanned and how == "nested":
+                out.append("    def inner():")
+                out.append(f'        tracing.observe_stage('
+                           f'"tpu.{name}", 0.0)')
+                out.append("    return inner()")
+            else:
+                out.append("    return None")
+            out.append("")
+        return "\n".join(out)
+
+    def test_unspanned_stage_is_a_finding(self, lint, tmp_path):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        self._seed_tpu(root, self._spans(lint, spanned=False))
+        findings = [f for f in lint.run_lint(root)
+                    if f.rule == "span-coverage"]
+        assert len(findings) == len(
+            lint.REQUIRED_SPANS["fabric_tpu/bccsp/tpu.py"])
+        assert any("_dispatch_arrays" in f.message for f in findings)
+        assert all("tracing" in f.message for f in findings)
+
+    @pytest.mark.parametrize("how", ["traced", "with", "nested"])
+    def test_each_span_spelling_is_clean(self, lint, tmp_path, how):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        self._seed_tpu(root, self._spans(lint, how=how))
+        assert [f for f in lint.run_lint(root)
+                if f.rule == "span-coverage"] == []
+
+    def test_missing_stage_reports_registry_drift(self, lint,
+                                                  tmp_path):
+        root = _seed_tree(str(tmp_path))
+        _regen_docs(root)
+        body = self._spans(lint).replace("def _shard_put",
+                                        "def _shard_put_renamed")
+        self._seed_tpu(root, body)
+        findings = [f for f in lint.run_lint(root)
+                    if f.rule == "span-coverage"]
+        assert len(findings) == 1
+        assert "_shard_put" in findings[0].message
+        assert "REQUIRED_SPANS" in findings[0].message
+
+    def test_registry_covers_hot_paths_and_stage_workers(self, lint):
+        """REQUIRED_SPANS is a superset of REQUIRED_HOT_PATHS and
+        names the pipeline stage workers — the registry IS the rule's
+        coverage claim."""
+        for path, funcs in lint.REQUIRED_HOT_PATHS.items():
+            for fn in funcs:
+                assert fn in lint.REQUIRED_SPANS.get(path, ()), \
+                    (path, fn)
+        assert "_write_loop" in \
+            lint.REQUIRED_SPANS["fabric_tpu/orderer/raft/pipeline.py"]
+        assert "_commit_loop" in \
+            lint.REQUIRED_SPANS["fabric_tpu/core/commitpipeline.py"]
+        assert "broadcast_stream" in \
+            lint.REQUIRED_SPANS["fabric_tpu/comm/services.py"]
+        assert "_process_order_window" in \
+            lint.REQUIRED_SPANS["fabric_tpu/orderer/raft/chain.py"]
+
+
 class TestUnboundedQueueRule:
     """Round-12 rule: creating an unbounded queue.Queue anywhere in
     fabric_tpu/ is a finding — the overload-protection layer closed

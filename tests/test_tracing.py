@@ -1,0 +1,386 @@
+"""Round-14 lifecycle tracing: context propagation, the flight
+recorder ring, Chrome-trace export, stage histograms, dump triggers
+and the disabled-mode fast path (fabric_tpu/common/tracing.py).
+
+The chaos gate (`tools/chaos_check.sh tracing`) re-runs this file
+with tpu.dispatch / order.propose / tpu.device_lost armed via env —
+armed faults must surface as error-status spans and parseable dumps,
+never as broken tests.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fabric_tpu.common import faults, tracing
+
+
+@pytest.fixture()
+def trace_env(tmp_path):
+    """Isolated recorder: small ring, instant dumps into tmp_path;
+    restores the process defaults afterwards."""
+    tracing.configure(enabled=True, ring_size=256, sample_every=1,
+                      dump_dir=str(tmp_path),
+                      dump_min_interval_s=0.0, shed_burst=32)
+    tracing.reset()
+    yield tmp_path
+    tracing.wait_dumps()
+    tracing.configure(enabled=True, ring_size=4096, sample_every=1,
+                      dump_dir="", dump_min_interval_s=10.0,
+                      shed_burst=32)
+    tracing.reset()
+
+
+def _events(name=None):
+    evs = tracing.snapshot()
+    return [e for e in evs if name is None or e[1] == name]
+
+
+class TestContextPropagation:
+    def test_nested_spans_share_trace_and_link_parent(self, trace_env):
+        with tracing.span("order.window") as outer:
+            with tracing.span("order.propose") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.span_id != outer.span_id
+        ev = _events("order.propose")[0]
+        # (ph, name, trace, span, parent, t0, dur, tname, attrs, err)
+        assert ev[2] == outer.trace_id
+        assert ev[4] == outer.span_id
+
+    def test_ambient_is_thread_local_and_restored(self, trace_env):
+        assert tracing.capture() is None
+        with tracing.span("a") as ctx:
+            assert tracing.capture() is ctx
+        assert tracing.capture() is None
+
+    def test_capture_attach_crosses_threads(self, trace_env):
+        got = {}
+
+        def worker(ctx):
+            with tracing.attached(ctx):
+                with tracing.span("commit.validate") as c:
+                    got["trace"] = c.trace_id
+
+        with tracing.span("ingress.batch") as ctx:
+            handoff = tracing.capture()
+        t = threading.Thread(target=worker, args=(handoff,))
+        t.start()
+        t.join()
+        assert got["trace"] == ctx.trace_id
+        assert sorted(tracing.trace_stages(ctx.trace_id)) == [
+            "commit.validate", "ingress.batch"]
+
+    def test_explicit_parent_beats_ambient(self, trace_env):
+        root = tracing.new_context()
+        with tracing.span("a"):
+            with tracing.span("b", parent=root) as c:
+                assert c.trace_id == root.trace_id
+
+    def test_attached_none_is_passthrough(self, trace_env):
+        with tracing.span("a") as ctx:
+            with tracing.attached(None):
+                assert tracing.capture() is ctx
+
+    def test_observe_span_inherits_parent(self, trace_env):
+        root = tracing.new_context()
+        t0 = time.perf_counter()
+        ctx = tracing.observe_span("order.consensus", t0, t0 + 0.25,
+                                   parent=root, block=7)
+        assert ctx.trace_id == root.trace_id
+        ev = _events("order.consensus")[0]
+        assert ev[6] == pytest.approx(0.25, abs=1e-6)
+        assert ev[9] is None and ev[8] == {"block": 7}
+
+
+class TestRing:
+    def test_ring_bounds_and_drop_oldest(self, trace_env):
+        tracing.configure(ring_size=8)
+        for i in range(20):
+            with tracing.span(f"s{i}"):
+                pass
+        names = [e[1] for e in tracing.snapshot()]
+        assert names == [f"s{i}" for i in range(12, 20)]
+
+    def test_ring_is_preallocated(self, trace_env):
+        tracing.configure(ring_size=16)
+        assert len(tracing._state.ring) == 16
+        with tracing.span("one"):
+            pass
+        assert len(tracing._state.ring) == 16
+
+    def test_sampling_thins_spans_but_not_errors(self, trace_env):
+        tracing.configure(sample_every=4)
+        try:
+            for i in range(8):
+                with tracing.span("sampled"):
+                    pass
+            assert len(_events("sampled")) == 2
+            with pytest.raises(RuntimeError):
+                with tracing.span("boom"):
+                    raise RuntimeError("x")
+            # error spans always record, whatever the sampling phase
+            assert len(_events("boom")) == 1
+        finally:
+            tracing.configure(sample_every=1)
+
+    def test_instants_always_record(self, trace_env):
+        tracing.configure(sample_every=1000)
+        try:
+            tracing.instant("device.quarantine", device=3)
+            assert len(_events("device.quarantine")) == 1
+        finally:
+            tracing.configure(sample_every=1)
+
+
+class TestChromeTraceSchema:
+    def test_export_round_trips_and_carries_correlation(self,
+                                                       trace_env):
+        with tracing.span("order.window", envelopes=5) as ctx:
+            with tracing.span("order.propose"):
+                pass
+        tracing.instant("breaker.trip", breaker="bccsp.tpu")
+        doc = json.loads(json.dumps(tracing.chrome_trace()))
+        evs = doc["traceEvents"]
+        meta = [e for e in evs if e["ph"] == "M"]
+        spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+        inst = [e for e in evs if e["ph"] == "i"]
+        # tid = pipeline stage, named via thread_name metadata
+        tid_names = {e["args"]["name"] for e in meta
+                     if e["name"] == "thread_name"}
+        assert {"stage:order", "stage:breaker"} <= tid_names
+        w = spans["order.window"]
+        assert w["args"]["trace_id"] == ctx.trace_id
+        assert w["args"]["envelopes"] == 5
+        assert w["dur"] >= 0 and "ts" in w and "pid" in w
+        p = spans["order.propose"]
+        assert p["args"]["parent_span_id"] == ctx.span_id
+        assert inst and inst[0]["args"]["breaker"] == "bccsp.tpu"
+        assert spans["order.window"]["tid"] == p["tid"]
+
+    def test_error_status_stamped_from_exception(self, trace_env):
+        with pytest.raises(ValueError):
+            with tracing.span("tpu.verify"):
+                raise ValueError("device gone")
+        ev = _events("tpu.verify")[0]
+        assert ev[9] == "ValueError: device gone"
+        doc = tracing.chrome_trace()
+        args = [e for e in doc["traceEvents"]
+                if e.get("name") == "tpu.verify"][0]["args"]
+        assert args["error"] == "ValueError: device gone"
+
+    def test_attrs_formatted_only_at_export(self, trace_env):
+        class Lazy:
+            formatted = 0
+
+            def __str__(self):
+                Lazy.formatted += 1
+                return "lazy!"
+
+        with tracing.span("a", obj=Lazy()):
+            pass
+        assert Lazy.formatted == 0          # stored raw on the span
+        doc = tracing.chrome_trace()
+        assert Lazy.formatted == 1          # formatted at export
+        ev = [e for e in doc["traceEvents"] if e.get("name") == "a"][0]
+        assert ev["args"]["obj"] == "lazy!"
+
+
+class TestStageHistograms:
+    def test_quantiles_over_known_data(self, trace_env):
+        for ms in range(1, 101):
+            tracing.observe_stage("bccsp.admission.wait", ms / 1000.0)
+        q = tracing.stage_quantiles()["bccsp.admission.wait"]
+        assert q["count"] == 100
+        assert q["p50_s"] == pytest.approx(0.050, abs=0.002)
+        assert q["p99_s"] == pytest.approx(0.100, abs=0.002)
+        assert q["mean_s"] == pytest.approx(0.0505, abs=0.001)
+
+    def test_span_exit_observes_its_stage(self, trace_env):
+        with tracing.span("order.write"):
+            pass
+        assert tracing.stage_quantile("order.write", "count") == 1
+
+    def test_bound_provider_histogram_renders(self, trace_env):
+        from fabric_tpu.common import metrics as metrics_mod
+        provider = metrics_mod.PrometheusProvider()
+        tracing.bind_metrics(provider)
+        try:
+            with tracing.span("commit.commit"):
+                pass
+            tracing.observe_stage("device.transfer.d3", 0.002)
+            text = provider.render()
+            assert 'trace_stage_seconds_bucket{stage="commit.commit"' \
+                in text
+            assert 'stage="device.transfer.d3"' in text
+            assert 'trace_stage_seconds_count{stage="commit.commit"}' \
+                ' 1' in text
+        finally:
+            tracing._state.hist = None
+
+
+class TestDumpTriggers:
+    def test_breaker_trip_dumps_flight_recorder(self, trace_env):
+        from fabric_tpu.common import breaker as breaker_mod
+        with tracing.span("tpu.verify"):
+            pass
+        br = breaker_mod.CircuitBreaker(
+            breaker_mod.BreakerConfig(trip_threshold=1),
+            name="bccsp.tpu.test")
+        br.failure(RuntimeError("dead device"))
+        tracing.wait_dumps()
+        dumps = [f for f in os.listdir(trace_env)
+                 if "breaker_trip" in f]
+        assert dumps, os.listdir(trace_env)
+        doc = json.load(open(os.path.join(trace_env, dumps[0])))
+        names = {e["name"] for e in doc["traceEvents"]}
+        assert "breaker.trip" in names and "tpu.verify" in names
+        assert doc["ftpu"]["reason"] == "breaker_trip"
+
+    def test_quarantine_dumps_and_readmit_marks(self, trace_env):
+        from fabric_tpu.common import devicehealth as dh_mod
+        dh = dh_mod.DeviceHealth(4, dh_mod.DeviceHealthConfig(
+            trip_threshold=1, cooldown_s=0.0))
+        dh.record_fault(2, RuntimeError("chip 2 gone"))
+        tracing.wait_dumps()
+        assert [f for f in os.listdir(trace_env)
+                if "device_quarantine" in f]
+        assert _events("device.quarantine")[0][8] == {"device": 2}
+        for d in dh.probe_candidates():
+            dh.probe_result(d, True)
+        assert _events("device.readmit")
+
+    def test_shed_burst_dumps_once(self, trace_env):
+        tracing.configure(shed_burst=5)
+        for _ in range(12):
+            tracing.note_shed("raft.events.test")
+        tracing.wait_dumps()
+        dumps = [f for f in os.listdir(trace_env)
+                 if "shed_burst" in f]
+        assert len(dumps) >= 1
+        assert len(_events("overload.shed")) == 12
+
+    def test_auto_dump_rate_limited(self, trace_env):
+        tracing.configure(dump_min_interval_s=3600.0)
+        try:
+            first = tracing.auto_dump("breaker_trip")
+            second = tracing.auto_dump("breaker_trip")
+            assert first is not None and second is None
+        finally:
+            tracing.configure(dump_min_interval_s=0.0)
+
+    def test_dump_carries_stage_quantiles(self, trace_env):
+        with tracing.span("order.propose"):
+            pass
+        path = tracing.dump("manual")
+        doc = json.load(open(path))
+        assert "order.propose" in doc["ftpu"]["stage_quantiles"]
+
+
+class TestDisabledMode:
+    def test_disabled_span_is_shared_noop(self, trace_env):
+        tracing.set_enabled(False)
+        try:
+            # zero-allocation: every disabled span() is the SAME object
+            assert tracing.span("a") is tracing.span("b")
+            with tracing.span("a") as ctx:
+                assert ctx is None
+            tracing.instant("x")
+            tracing.observe_stage("y", 1.0)
+            tracing.note_shed("z")
+            assert tracing.snapshot() == []
+            assert tracing.stage_quantiles() == {}
+        finally:
+            tracing.set_enabled(True)
+
+    def test_traced_decorator_disabled_calls_through(self, trace_env):
+        calls = []
+
+        @tracing.traced("tpu.dispatch")
+        def fn(x):
+            calls.append(x)
+            return x * 2
+
+        tracing.set_enabled(False)
+        try:
+            assert fn(3) == 6
+            assert tracing.snapshot() == []
+        finally:
+            tracing.set_enabled(True)
+        assert fn(4) == 8
+        assert _events("tpu.dispatch")
+
+    def test_reenable_records_again(self, trace_env):
+        tracing.set_enabled(False)
+        tracing.set_enabled(True)
+        with tracing.span("back"):
+            pass
+        assert _events("back")
+
+
+class TestDebugTraceEndpoint:
+    def test_served_without_profile_enabled(self, trace_env):
+        import urllib.request
+
+        from fabric_tpu.node.operations import OperationsServer
+        with tracing.span("ingress.batch"):
+            pass
+        srv = OperationsServer()       # profile_enabled=False
+        srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://{srv.address}/debug/trace",
+                    timeout=30) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            names = {e["name"] for e in doc["traceEvents"]}
+            assert "ingress.batch" in names
+        finally:
+            srv.stop()
+
+
+@pytest.mark.chaos
+class TestChaosTracing:
+    """Armed faults must land in the recorder as error-status spans
+    and a parseable postmortem — the attribution evidence the chaos
+    machinery itself never had."""
+
+    def test_armed_dispatch_fault_stamps_error_span(self, trace_env):
+        faults.clear()
+        faults.arm("tpu.dispatch", mode="error", count=1)
+        try:
+            with pytest.raises(faults.FaultInjected):
+                with tracing.span("tpu.dispatch"):
+                    faults.check("tpu.dispatch")
+        finally:
+            faults.reset()
+        ev = _events("tpu.dispatch")[0]
+        assert ev[9] and "FaultInjected" in ev[9]
+        # the export of an armed-fault run still round-trips
+        doc = json.loads(json.dumps(tracing.chrome_trace()))
+        errs = [e for e in doc["traceEvents"]
+                if e.get("args", {}).get("error")]
+        assert errs
+
+    def test_order_pipeline_trace_links_lifecycle(self, trace_env,
+                                                  tmp_path):
+        """A real (tiny) ordered stream: whatever faults the chaos
+        gate armed, one probe transaction's trace must link
+        ingress -> order -> write -> validate -> commit, and the
+        dumped file must parse."""
+        import bench_pipeline
+        out = bench_pipeline.order_pipeline_run(
+            ntxs=24, window=8, block_txs=8,
+            trace_path=str(tmp_path / "trace.json"))
+        assert out["probe_trace_id"]
+        linked = set((out["trace_linked_stages"] or "").split(","))
+        for stage in ("ingress.batch", "order.window", "order.write",
+                      "commit.validate", "commit.commit"):
+            assert stage in linked, sorted(linked)
+        doc = json.load(open(out["trace_file"]))
+        assert doc["traceEvents"]
+        for f in ("order_propose_p50_s", "order_write_p99_s",
+                  "validate_p50_s", "commit_p99_s"):
+            assert out[f] and out[f] > 0, (f, out[f])

@@ -57,7 +57,12 @@ if [ "$rc" -ne 0 ]; then
 fi
 
 python - "$OUT" "$SIDECAR" <<'EOF'
-import json, sys
+import json, os, sys
+
+# the documented operator opt-out: with FTPU_TRACE=0 the bench skips
+# the tracing A/B and emits no tail/trace fields — the round-14
+# asserts below must skip with it, not fail the harness
+tracing_off = os.environ.get("FTPU_TRACE") == "0"
 
 out_path, sidecar = sys.argv[1], sys.argv[2]
 lines = [ln for ln in open(out_path).read().splitlines() if ln.strip()]
@@ -103,6 +108,40 @@ if "skipped" not in fp and not fp.get("order_skipped"):
         f"full_pipeline lacks order_raft_s: {fp}"
     assert fp.get("order_vs_validate", 0) > 0, \
         f"full_pipeline lacks order_vs_validate: {fp}"
+    # round-14 contract: the stage line carries per-stage tail
+    # latencies (means hide the tail) and the lifecycle trace file,
+    # whose Chrome-trace JSON must round-trip and link one
+    # transaction's trace end to end
+    for f in () if tracing_off else ("order_propose_p50_s", "order_propose_p99_s",
+              "order_write_p50_s", "order_write_p99_s",
+              "validate_p50_s", "commit_p99_s"):
+        assert fp.get(f, 0) and fp[f] > 0, \
+            f"full_pipeline lacks stage tail field {f!r}: {fp}"
+    if not tracing_off:
+        assert fp.get("trace_file"), \
+            f"full_pipeline lacks trace_file: {fp}"
+        trace = json.load(open(fp["trace_file"]))
+        assert trace.get("traceEvents"), "trace file has no events"
+        linked = set((fp.get("trace_linked_stages") or "").split(","))
+        for stage in ("ingress.batch", "order.window", "order.write",
+                      "commit.validate", "commit.commit"):
+            assert stage in linked, \
+                f"probe trace does not link {stage!r}: {sorted(linked)}"
+        print("bench_smoke: lifecycle trace", fp["trace_file"],
+              "links", sorted(linked))
+
+# round-14 contract: the core stage measures the tracing overhead
+# A/B on its steady loop and reports the verify tail
+pe = stages.get("provider_e2e") or {}
+if pe and "skipped" not in pe and not tracing_off:
+    assert "tracing_overhead_pct" in pe, \
+        f"provider_e2e lacks tracing_overhead_pct: {pe}"
+    assert pe.get("verify_p50_s", 0) > 0, \
+        f"provider_e2e lacks verify_p50_s: {pe}"
+    assert pe.get("verify_p99_s", 0) > 0, \
+        f"provider_e2e lacks verify_p99_s: {pe}"
+    print("bench_smoke: tracing overhead",
+          pe["tracing_overhead_pct"], "% on the steady verify loop")
 
 # round-11 contract: the core stage's ed25519 regime reports its own
 # throughput line or an explicit skip marker (env opt-out / budget) —
@@ -130,6 +169,10 @@ if mc.get("ok"):
               "final_mesh_devices"):
         assert f in mc and mc[f] is not None, \
             f"multichip line lacks device-health field {f!r}: {mc}"
+    # round-14: the all-device verify tail rides the multichip line
+    for f in () if tracing_off else ("verify_p50_s", "verify_p99_s"):
+        assert mc.get(f) is not None and mc[f] > 0, \
+            f"multichip line lacks verify tail field {f!r}: {mc}"
     if mc["device_quarantines"]:
         assert mc.get("device_health_note") or \
             mc["final_mesh_devices"] == mc.get("devices"), \

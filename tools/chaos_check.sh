@@ -12,7 +12,7 @@
 # Spec grammar: point=mode[:count][:delay_s][:arg], mode in
 # {error, delay}; the 4th field targets a check() argument (the
 # per-device points pass the full-mesh chip index).
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -145,6 +145,19 @@ overload() {
         tests/test_overload.py -k "Shed or Chain or Broadcast"
 }
 
+tracing() {
+    # the round-14 lifecycle tracer under fire: armed dispatch /
+    # propose / per-device faults must surface as ERROR-STATUS spans
+    # in the flight recorder, the auto-dumped postmortem file must
+    # stay json.loads-parseable, and the Chrome-trace export must
+    # round-trip — while every verdict/liveness contract of the
+    # traced paths holds (the tests assert both)
+    run "tpu.dispatch=error:1;order.propose=error:1" \
+        tests/test_tracing.py
+    run "tpu.device_lost=error:1::3;tpu.dispatch=delay:1:0.02" \
+        tests/test_tracing.py
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -162,9 +175,10 @@ case "${1:-all}" in
     schemes) schemes ;;
     overload) overload ;;
     mesh-health) mesh_health ;;
+    tracing) tracing ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
-         schemes; overload; mesh_health; static ;;
+         schemes; overload; mesh_health; tracing; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

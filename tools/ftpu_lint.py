@@ -48,6 +48,15 @@ on the flagged line or the line above; the reason is mandatory):
                  (deadline-aware, shed-counting) or pass an explicit
                  positive bound with a Full policy; waive a deliberate
                  site with allow-unbounded-queue(<reason>)
+  span-coverage  every function in the REQUIRED_SPANS registry (the
+                 REQUIRED_HOT_PATHS dispatch spans plus the pipeline
+                 stage workers) must open a lifecycle tracing span —
+                 a `@traced("...")` decorator or a
+                 span/observe_span/observe_stage/instant call
+                 (common/tracing.py). Dropping it silently blinds the
+                 flight recorder and the per-stage histograms on
+                 exactly the code they were written for (no waiver:
+                 the registry IS the waiver; update it on a rename)
 
 Usage:
   python tools/ftpu_lint.py [--root DIR] [--rules r1,r2] [files...]
@@ -65,7 +74,8 @@ import sys
 from dataclasses import dataclass
 
 ALL_RULES = ("fault-point", "metric-drift", "silent-swallow",
-             "host-sync", "hot-path-coverage", "unbounded-queue")
+             "host-sync", "hot-path-coverage", "unbounded-queue",
+             "span-coverage")
 
 # The spans the host-sync rule exists FOR: every overlapped/sharded
 # device-dispatch span. A span here without @hot_path is a finding —
@@ -89,6 +99,23 @@ REQUIRED_HOT_PATHS = {
     "fabric_tpu/orderer/raft/chain.py": ("_propose_batch",),
     "fabric_tpu/bccsp/admission.py": ("_dispatch_window",),
 }
+
+# The span-coverage registry (round 14): every dispatch span above
+# must ALSO open a lifecycle tracing span, and so must the pipeline
+# stage workers listed here — the per-stage latency histograms and
+# the flight recorder are only as complete as this coverage. Like
+# REQUIRED_HOT_PATHS, the registry is the waiver: renames update it.
+REQUIRED_SPANS = {path: tuple(funcs)
+                  for path, funcs in REQUIRED_HOT_PATHS.items()}
+for _path, _funcs in {
+    # registered pipeline stages: ingress batching, the order window,
+    # the async block-write worker, commit-pipeline stage B
+    "fabric_tpu/comm/services.py": ("broadcast_stream",),
+    "fabric_tpu/orderer/raft/chain.py": ("_process_order_window",),
+    "fabric_tpu/orderer/raft/pipeline.py": ("_write_loop",),
+    "fabric_tpu/core/commitpipeline.py": ("_commit_loop",),
+}.items():
+    REQUIRED_SPANS[_path] = REQUIRED_SPANS.get(_path, ()) + _funcs
 
 _WAIVER_RE = re.compile(
     r"#\s*ftpu-lint:\s*allow-([a-z-]+)\(\s*(.*?)\s*\)?\s*$")
@@ -351,6 +378,67 @@ def _hot_coverage_findings(rel, tree):
     return out
 
 
+# -- rule: span-coverage --
+
+_SPAN_CALLS = {"span", "observe_span", "observe_stage", "instant"}
+
+
+def _is_traced_decorator(dec) -> bool:
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    if isinstance(target, ast.Name):
+        return target.id == "traced"
+    if isinstance(target, ast.Attribute):
+        return target.attr == "traced"
+    return False
+
+
+def _opens_span(fn) -> bool:
+    """True when `fn` carries a @traced decorator or (anywhere in its
+    body, nested closures included — broadcast_stream's span lives in
+    its flush_run closure) calls span()/observe_span()/
+    observe_stage()/instant() — plain or as tracing.<name>."""
+    if any(_is_traced_decorator(d) for d in fn.decorator_list):
+        return True
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if name in _SPAN_CALLS:
+            return True
+    return False
+
+
+def _span_coverage_findings(rel, tree):
+    want = REQUIRED_SPANS.get(rel.replace(os.sep, "/"))
+    if not want:
+        return []
+    out = []
+    fns: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+    for name in want:
+        fn = fns.get(name)
+        if fn is None:
+            out.append(Finding(
+                rel, 1, "span-coverage",
+                f"required traced stage `{name}` no longer exists — "
+                f"if it was renamed, update REQUIRED_SPANS in "
+                f"tools/ftpu_lint.py so the lifecycle-tracing rule "
+                f"keeps covering it"))
+        elif not _opens_span(fn):
+            out.append(Finding(
+                rel, fn.lineno, "span-coverage",
+                f"pipeline stage `{name}` opens no lifecycle tracing "
+                f"span (common/tracing.py): add @traced(...) or a "
+                f"span()/observe_span() call, or the flight recorder "
+                f"and per-stage histograms go blind on exactly this "
+                f"stage"))
+    return out
+
+
 # -- rule: unbounded-queue --
 
 _QUEUE_CLASSES = {"Queue", "LifoQueue", "PriorityQueue"}
@@ -496,6 +584,8 @@ def run_lint(root: str, rules=ALL_RULES, files=None) -> list:
             findings += _host_sync_findings(rel, tree, waivers)
         if "hot-path-coverage" in rules:
             findings += _hot_coverage_findings(rel, tree)
+        if "span-coverage" in rules:
+            findings += _span_coverage_findings(rel, tree)
         if "unbounded-queue" in rules:
             findings += _unbounded_queue_findings(rel, tree, waivers)
     if "metric-drift" in rules and not files:

@@ -35,6 +35,7 @@ FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
     tests/test_lockcheck.py tests/test_ftpu_lint.py \
     tests/test_chaos.py tests/test_commit_pipeline.py \
     tests/test_pipeline_overlap.py tests/test_backoff.py \
-    tests/test_overload.py tests/test_device_health.py
+    tests/test_overload.py tests/test_device_health.py \
+    tests/test_tracing.py
 
 echo "static_check: all gates green"
