@@ -1359,6 +1359,29 @@ def stage_pipeline():
     _PARTIAL["order_pipeline"] = orderpipe
     detail["order_pipeline"] = orderpipe
 
+    # round-15: the bounded leader-kill failover soak (wheel-free,
+    # chaos-wrapped 3-consenter cluster) — like the order section, a
+    # skip is explicit so the smoke gate can tell "didn't run" from
+    # "lost its fields"
+    if os.environ.get("BENCH_FAILOVER", "1") != "1":
+        failover = {"skipped": "BENCH_FAILOVER!=1"}
+    elif _remaining() <= 60:
+        failover = {"skipped": "time budget exhausted"}
+    else:
+        try:
+            import bench_pipeline
+            failover = bench_pipeline.failover_run(
+                producers=int(os.environ.get(
+                    "BENCH_FAILOVER_PRODUCERS", "2")),
+                ntxs_per_producer=int(os.environ.get(
+                    "BENCH_FAILOVER_TXS", "24" if SMOKE else "60")),
+                block_txs=int(os.environ.get(
+                    "BENCH_FAILOVER_BLOCK_TXS", "4")))
+        except Exception as e:          # noqa: BLE001
+            failover = {"error": f"{type(e).__name__}: {e}"}
+    _PARTIAL["failover"] = failover
+    detail["failover"] = failover
+
     idemix = None
     if want("BENCH_IDEMIX"):
         try:
@@ -1425,6 +1448,23 @@ def stage_pipeline():
                 res[k] = orderpipe[k]
     elif orderpipe and "skipped" in orderpipe:
         res["order_skipped"] = orderpipe["skipped"]
+    if failover and "reelect_s" in failover:
+        # round-15 failover facts on the stage line: how fast ordering
+        # recovered from a leader kill under chaos, and that the
+        # exactly-once/convergence contract held
+        res["failover_reelect_s"] = failover["reelect_s"]
+        res["failover_committed"] = failover["committed"]
+        res["failover_leader_changes"] = failover["leader_changes"]
+        res["failover_exact_once"] = \
+            failover["accepted_commit_exact_once"]
+        res["failover_chaos_dropped"] = failover["chaos_dropped"]
+    elif failover and "skipped" in failover:
+        res["failover_skipped"] = failover["skipped"]
+    elif failover and "error" in failover:
+        # surface the real exception on the stage line: the smoke
+        # gate's "lacks failover_reelect_s" alone sends the
+        # investigator to the wrong place
+        res["failover_error"] = failover["error"]
     if pipeline and "tpu_peer_block_s" in pipeline:
         res["e2e_tpu_peer_block_s"] = pipeline["tpu_peer_block_s"]
     emit_final(res, detail)

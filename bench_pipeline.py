@@ -576,7 +576,8 @@ def make_order_service(root: str, client=None, csp=None,
                        endpoints=None, net=None,
                        write_pipeline=None, start: bool = True,
                        tick_interval_s: float = 0.02,
-                       election_tick: int = 8, on_config=None):
+                       election_tick: int = 8, on_config=None,
+                       transport_wrap=None):
     """A raft ordering service over `make_order_support`: single-node
     by default, multi-consenter when `net` + `endpoints` are shared
     across calls. `start=False` leaves the ready loop unstarted so
@@ -599,6 +600,10 @@ def make_order_service(root: str, client=None, csp=None,
         block_txs=block_txs, batch_timeout_s=batch_timeout_s,
         endpoints=eps, on_config=on_config)
     transport = net.register(endpoint)
+    if transport_wrap is not None:
+        # round 15: the chaos seam — e.g. NetChaos.wrap_cluster puts
+        # this consenter's outbound links under seeded network chaos
+        transport = transport_wrap(transport)
     chain = RaftChain(support, transport,
                       tick_interval_s=tick_interval_s,
                       election_tick=election_tick,
@@ -1193,6 +1198,658 @@ def overload_run(producers: int = 4, ntxs_per_producer: int = 300,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def failover_run(consenters: int = 3, producers: int = 2,
+                 ntxs_per_producer: int = 60, window: int = 12,
+                 block_txs: int = 8, seed: int = 7,
+                 drop_rate: float = 0.10, dup_rate: float = 0.05,
+                 reorder_rate: float = 0.10, reorder_window: int = 4,
+                 kill_after: float = 0.35,
+                 partition_s: float = 0.3,
+                 reelect_bound_s: float = 30.0) -> dict:
+    """ISSUE 13 soak: a 3-consenter raft ordering cluster with every
+    inter-consenter link under seeded network chaos (drop + duplicate
+    + bounded reorder, `common/netchaos.py`), the LEADER killed
+    crash-equivalently mid-load, and — after re-election — one
+    surviving follower partitioned and healed. The claims:
+
+      * ordering recovers within a bounded re-election window
+        (`failover_reelect_s` < `reelect_bound_s`), attributable via
+        `raft.leader_change` tracing instants and a parseable
+        flight-recorder auto-dump;
+      * the survivors' committed block streams are BYTE-IDENTICAL
+        (numbers, prev-hash linkage, data hashes, envelope bytes);
+      * exactly-once: no envelope commits twice, and every ACCEPTED
+        (SUCCESS-acked) envelope commits — acks lost with the dead
+        leader are reconciled by resubmission AFTER quiescence, the
+        real client protocol;
+      * the committed stream replays bit-identically through a fresh
+        sequential oracle service (the PR-9 oracle-replay check).
+
+    Chaos decisions are seeded (`seed`) so a failing run reproduces."""
+    import shutil
+    import threading
+
+    from fabric_tpu.common import netchaos, tracing
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protoutil.protoutil import marshal as pu_marshal
+
+    root = tempfile.mkdtemp(prefix="bench_failover_")
+    dump_dir = os.path.join(root, "traces")
+    chaos = netchaos.NetChaos(seed=seed)
+    chaos.set_policy(netchaos.LinkPolicy(
+        drop_rate=drop_rate, dup_rate=dup_rate,
+        reorder_rate=reorder_rate, reorder_window=reorder_window))
+    eps = [f"orderer{i}.example.com:{7050 + i}"
+           for i in range(consenters)]
+    svcs: dict = {}
+    oracle = None
+    t_run0 = time.perf_counter()
+    try:
+        tracing.reset()
+        tracing.configure(dump_dir=dump_dir)
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        net = LocalClusterNetwork()
+        client = make_order_client()
+        for i, ep in enumerate(eps):
+            svcs[ep] = make_order_service(
+                os.path.join(root, f"o{i}"), client=client,
+                endpoint=ep, endpoints=eps, net=net,
+                block_txs=block_txs, batch_timeout_s=0.1,
+                tick_interval_s=0.01, election_tick=8,
+                transport_wrap=chaos.wrap_cluster)
+        alive = dict(svcs)
+
+        def current_leader(services=None):
+            from fabric_tpu.orderer.raft.core import LEADER
+            for ep, s in (services or alive).items():
+                if s.chain.node.state == LEADER:
+                    return ep
+            return None
+
+        def wait_leader(bound_s, services=None):
+            deadline = time.monotonic() + bound_s
+            while time.monotonic() < deadline:
+                ep = current_leader(services)
+                if ep is not None:
+                    return ep
+                time.sleep(0.005)
+            raise RuntimeError(f"no raft leader inside {bound_s}s")
+
+        wait_leader(60.0)
+
+        # pre-sign every envelope (untimed CPU setup); globally unique
+        all_envs = [[client.envelope(p * 1_000_000 + i)
+                     for i in range(ntxs_per_producer)]
+                    for p in range(producers)]
+        n_offered = producers * ntxs_per_producer
+
+        accepted_lock = threading.Lock()
+        accepted: set = set()          # marshaled envelope bytes
+        unknown: set = set()           # outcome lost with a dying node
+        shed = [0]
+        errors: list = []
+
+        def producer(p: int) -> None:
+            envs = all_envs[p]
+            pos = 0
+            rotation = 0
+            deadline = time.monotonic() + 180
+            while pos < len(envs):
+                if time.monotonic() > deadline:
+                    errors.append(f"producer {p}: offered-load "
+                                  f"deadline at {pos}/{len(envs)}")
+                    return
+                targets = list(alive.values())
+                svc = targets[(p + rotation) % len(targets)]
+                batch = envs[pos:pos + window]
+                try:
+                    resps = svc.broadcast.process_messages(batch)
+                except Exception:   # noqa: BLE001 — a dying node mid-call:
+                    # outcome UNKNOWN (it may have enqueued a prefix);
+                    # reconciliation decides after quiescence
+                    with accepted_lock:
+                        unknown.update(pu_marshal(e) for e in batch)
+                    pos += len(batch)
+                    rotation += 1
+                    continue
+                ok = 0
+                for resp in resps:
+                    if resp.status == cpb.Status.SUCCESS:
+                        ok += 1
+                    elif resp.status == cpb.Status.SERVICE_UNAVAILABLE:
+                        shed[0] += 1
+                        break       # election wobble: retry the tail
+                    else:
+                        errors.append(f"producer {p}: {resp.status} "
+                                      f"{resp.info}")
+                        return
+                with accepted_lock:
+                    accepted.update(pu_marshal(e)
+                                    for e in batch[:ok])
+                pos += ok
+                if ok == 0:
+                    rotation += 1
+                    time.sleep(0.02)
+
+        threads = [threading.Thread(target=producer, args=(p,),
+                                    name=f"failover-producer-{p}")
+                   for p in range(producers)]
+        for t in threads:
+            t.start()
+
+        # ---- the kill: wait for part of the load, then crash the
+        # leader (no flush — its unwritten blocks die with it) ----
+        kill_threshold = int(kill_after * n_offered)
+        deadline = time.monotonic() + 120
+        while True:
+            with accepted_lock:
+                n_acc = len(accepted)
+            if n_acc >= kill_threshold:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"load never reached the kill threshold "
+                    f"({n_acc}/{kill_threshold}; errors={errors[:2]})")
+            time.sleep(0.005)
+        victim_ep = wait_leader(30.0)
+        victim = alive[victim_ep]
+        # rebind (never mutate) the shared dict: producer threads are
+        # mid-iteration over it without a lock, and a pop() here would
+        # kill one with 'dictionary changed size' OUTSIDE its
+        # try/except — silently weakening the offered load
+        alive = {ep: s for ep, s in alive.items()
+                 if ep != victim_ep}
+        t_kill = time.monotonic()
+        victim.close(flush=False)
+        new_leader_ep = wait_leader(reelect_bound_s, services=alive)
+        reelect_s = time.monotonic() - t_kill
+
+        # ---- one partition-and-heal on a surviving follower ----
+        follower_eps = [ep for ep in alive if ep != new_leader_ep]
+        if follower_eps and partition_s > 0:
+            chaos.partition([follower_eps[0]],
+                            heal_after_s=partition_s)
+
+        for t in threads:
+            t.join(timeout=240)
+        if errors:
+            raise RuntimeError("; ".join(errors[:3]))
+
+        # ---- quiesce: survivor streams equal and stable ----
+        def read_stream(svc, timeout_s: float = 10.0):
+            """Fully-readable committed stream: `height` can advance
+            a beat before the row is visible to this reader thread
+            (async write stage) — retry until every block reads."""
+            lg = svc.support.ledger
+            rd = time.monotonic() + timeout_s
+            while True:
+                h = lg.height
+                out = []
+                for n in range(h):
+                    b = lg.get_block(n)
+                    if b is None:
+                        break
+                    out.append(b)
+                if len(out) == h or time.monotonic() > rd:
+                    return out
+                time.sleep(0.01)
+
+        def survivor_streams():
+            return {ep: read_stream(s) for ep, s in alive.items()}
+
+        # stability is detected on the CHEAP height signal (monotonic;
+        # a full read_stream per 50ms poll would proto-decode every
+        # block of every survivor hundreds of times) — the full
+        # visibility-retrying reads happen once afterwards
+        deadline = time.monotonic() + 240
+        stable_since = None
+        last_sig = None
+        while True:
+            sig = tuple(s.support.ledger.height
+                        for s in alive.values())
+            now = time.monotonic()
+            if sig != last_sig or len(set(sig)) != 1:
+                last_sig, stable_since = sig, now
+            elif now - stable_since >= 1.0:
+                break
+            if now > deadline:
+                raise RuntimeError(f"survivors never quiesced: {sig}")
+            time.sleep(0.05)
+
+        # ---- reconcile: resubmit accepted/unknown envelopes the dead
+        # leader lost, then re-quiesce ----
+        def committed_envs():
+            streams = survivor_streams()
+            ref = streams[new_leader_ep]
+            return [bytes(d) for b in ref[1:] for d in b.data.data]
+
+        committed = committed_envs()
+        cset = set(committed)
+        with accepted_lock:
+            tracked = set(accepted) | set(unknown)
+        missing = (set(accepted) - cset) | (set(unknown) - cset)
+        resubmitted = len(missing)
+        if missing:
+            leader_svc = alive[wait_leader(30.0, services=alive)]
+            todo = [cpb.Envelope.FromString(raw)
+                    for raw in sorted(missing)]
+            pos = 0
+            deadline = time.monotonic() + 120
+            while pos < len(todo):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"reconciliation stalled at {pos}/{len(todo)}")
+                resps = leader_svc.broadcast.process_messages(
+                    todo[pos:pos + window])
+                ok = sum(1 for r in resps
+                         if r.status == cpb.Status.SUCCESS)
+                pos += ok
+                if ok == 0:
+                    time.sleep(0.02)
+            with accepted_lock:
+                accepted.update(pu_marshal(e) for e in todo)
+            deadline = time.monotonic() + 240
+            last_hs = None
+            while True:
+                hs = tuple(s.support.ledger.height
+                           for s in alive.values())
+                if hs != last_hs:
+                    # re-read (and re-decode) the chain only when the
+                    # cheap height signal moved
+                    committed = committed_envs()
+                    last_hs = hs
+                if set(committed) >= set(accepted) and \
+                        len(set(hs)) == 1:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError("resubmitted envelopes never "
+                                       "all committed")
+                time.sleep(0.05)
+
+        # ---- the contract ----
+        dup_count = len(committed) - len(set(committed))
+        assert dup_count == 0, \
+            f"{dup_count} envelope(s) committed more than once"
+        with accepted_lock:
+            lost = set(accepted) - set(committed)
+        assert not lost, f"{len(lost)} accepted envelope(s) lost"
+        stray = set(committed) - tracked - set(accepted)
+        assert not stray, \
+            f"{len(stray)} committed envelope(s) never offered"
+
+        streams = survivor_streams()
+        ref_ep, ref = next(iter(streams.items()))
+        for ep, st in streams.items():
+            assert len(st) == len(ref), (ep, len(st), len(ref))
+            for x, y in zip(ref, st):
+                assert (x.header.number == y.header.number and
+                        x.header.previous_hash ==
+                        y.header.previous_hash and
+                        x.header.data_hash == y.header.data_hash and
+                        list(x.data.data) == list(y.data.data)), \
+                    f"survivor streams diverge at block " \
+                    f"{x.header.number} ({ref_ep} vs {ep})"
+
+        # ---- failover attribution: instants + parseable auto-dump ----
+        leader_changes = sum(
+            1 for e in tracing.snapshot()
+            if e[0] == "i" and e[1] == "raft.leader_change")
+        assert leader_changes >= consenters + 1, leader_changes
+        tracing.wait_dumps()
+        dump_path = None
+        if os.path.isdir(dump_dir):
+            dumps = sorted(
+                f for f in os.listdir(dump_dir)
+                if "leader_change" in f and f.endswith(".json"))
+            if dumps:
+                dump_path = os.path.join(dump_dir, dumps[-1])
+                with open(dump_path, encoding="utf-8") as f:
+                    doc = json.load(f)
+                assert doc.get("traceEvents"), "empty failover dump"
+        assert dump_path is not None, \
+            "no leader_change flight-recorder dump was written"
+
+        # ---- sequential-oracle replay, bit-identical ----
+        oracle = make_order_service(
+            os.path.join(root, "oracle"), client=client,
+            block_txs=block_txs, batch_timeout_s=0.1,
+            write_pipeline=False,
+            endpoint="oracle0.example.com:7050",
+            endpoints=("oracle0.example.com:7050",))
+        odl = time.monotonic() + 60
+        while oracle.chain.node.leader_id != oracle.chain.node_id:
+            if time.monotonic() > odl:
+                raise RuntimeError("oracle: no raft leader")
+            time.sleep(0.01)
+        committed_objs = [cpb.Envelope.FromString(raw)
+                          for raw in committed]
+        pos = 0
+        odl = time.monotonic() + 240
+        while pos < len(committed_objs):
+            resps = oracle.broadcast.process_messages(
+                committed_objs[pos:pos + window])
+            ok = sum(1 for r in resps
+                     if r.status == cpb.Status.SUCCESS)
+            if ok == 0 and time.monotonic() > odl:
+                raise RuntimeError("oracle rejected the committed "
+                                   "stream")
+            pos += ok
+            if ok == 0:
+                time.sleep(0.02)
+        olg = oracle.support.ledger
+        ocommitted: list = []
+        onext = 1
+        odl = time.monotonic() + 240
+        while len(ocommitted) < len(committed):
+            while onext < olg.height:
+                b = olg.get_block(onext)
+                if b is None:
+                    break
+                ocommitted.extend(bytes(d) for d in b.data.data)
+                onext += 1
+            if time.monotonic() > odl:
+                raise RuntimeError("oracle drain stalled")
+            time.sleep(0.02)
+        assert ocommitted == committed, \
+            "oracle envelope stream diverged bit-wise"
+
+        with accepted_lock:
+            n_accepted = len(accepted)
+        return {
+            "consenters": consenters,
+            "offered": n_offered,
+            "accepted": n_accepted,
+            "unknown_outcome": len(unknown),
+            "client_shed": shed[0],
+            "resubmitted": resubmitted,
+            "committed": len(committed),
+            "duplicates": 0,
+            "reelect_s": round(reelect_s, 3),
+            "reelect_bound_s": reelect_bound_s,
+            "leader_changes": leader_changes,
+            "killed_leader": victim_ep,
+            "survivor_streams_identical": True,
+            "accepted_commit_exact_once": True,
+            "oracle_bit_identical": True,
+            "trace_dump": dump_path,
+            "chaos_dropped": chaos.stats["dropped"],
+            "chaos_duplicated": chaos.stats["duplicated"],
+            "chaos_reordered": chaos.stats["reordered"],
+            "chaos_partitioned": chaos.stats["partitioned"],
+            "chaos_heals": chaos.stats["heals"],
+            "run_s": round(time.perf_counter() - t_run0, 2),
+        }
+    finally:
+        for s in list(svcs.values()) + ([oracle] if oracle else []):
+            try:
+                s.close(flush=False)
+            except Exception:     # noqa: BLE001
+                pass
+        chaos.close()
+        tracing.configure(
+            dump_dir=os.environ.get("FTPU_TRACE_DUMP_DIR", ""))
+        shutil.rmtree(root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Round-15 crash-point recovery matrix: subprocess children.
+#
+# The harness (tests/test_net_chaos.py) runs these as KILLED AND
+# RESTARTED real processes: run 1 arms a `crash`-mode fault at one
+# durable-write seam (raft.wal_append / order.block_write /
+# onboarding.commit) via FTPU_FAULTS and dies mid-stream (os._exit
+# 137, a power loss at the seam); run 2 reopens the same root, replays
+# from the WAL/ledger, reports the replayed stream's per-block digests,
+# pumps whatever payloads are still missing, and asserts exactly-once;
+# run 3 reopens again and must report the IDENTICAL digests (restart
+# replay is deterministic and bit-identical).
+# ---------------------------------------------------------------------------
+
+
+def _block_digest(block) -> str:
+    """Digest over EVERYTHING durable — header, envelope bytes AND
+    metadata (a restart replays stored bytes, it never re-signs, so
+    bit-identity across reopen includes each block's signature)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(block.header.number.to_bytes(8, "big"))
+    h.update(bytes(block.header.previous_hash))
+    h.update(bytes(block.header.data_hash))
+    for d in block.data.data:
+        h.update(len(d).to_bytes(4, "big"))
+        h.update(bytes(d))
+    for m in block.metadata.metadata:
+        h.update(len(m).to_bytes(4, "big"))
+        h.update(bytes(m))
+    return h.hexdigest()
+
+
+def crash_matrix_order_child(root: str, ntxs: int = 16,
+                             block_txs: int = 4) -> dict:
+    """One crash-matrix cell over the raft ordering service: open (or
+    reopen) the service at `root`, report the REPLAYED stream, then
+    pump every payload of range(ntxs) not yet committed — one block's
+    worth at a time, waiting each out, so the WAL-append / block-write
+    seams are crossed once per batch and an armed crash fault lands
+    mid-stream deterministically."""
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protoutil import protoutil as pu
+
+    svc = make_order_service(root, block_txs=block_txs,
+                             batch_timeout_s=0.05,
+                             tick_interval_s=0.01)
+    try:
+        ledger = svc.support.ledger
+        client = svc.client
+
+        def stream():
+            # a block can be committed-but-mid-append in the write
+            # stage: read the contiguous written prefix only
+            out = []
+            for n in range(ledger.height):
+                b = ledger.get_block(n)
+                if b is None:
+                    break
+                out.append(b)
+            return out
+
+        def payload_counts():
+            counts: dict = {}
+            for b in stream()[1:]:
+                for raw in b.data.data:
+                    env = pu.unmarshal_envelope(bytes(raw))
+                    data = bytes(pu.get_payload(env).data)
+                    counts[data] = counts.get(data, 0) + 1
+            return counts
+
+        replay_digests = [_block_digest(b) for b in stream()]
+
+        deadline = time.monotonic() + 60
+        while svc.chain.node.leader_id != svc.chain.node_id:
+            if time.monotonic() > deadline:
+                raise RuntimeError("no raft leader after 60s")
+            time.sleep(0.005)
+
+        want = {f"tx{i}".encode(): i for i in range(ntxs)}
+        have = payload_counts()
+        missing = [i for data, i in sorted(want.items(),
+                                           key=lambda kv: kv[1])
+                   if data not in have]
+        pumped = 0
+        for lo in range(0, len(missing), block_txs):
+            batch = [client.envelope(i)
+                     for i in missing[lo:lo + block_txs]]
+            pos = 0
+            deadline = time.monotonic() + 60
+            while pos < len(batch):
+                resps = svc.broadcast.process_messages(batch[pos:])
+                pos += sum(1 for r in resps
+                           if r.status == cpb.Status.SUCCESS)
+                if time.monotonic() > deadline:
+                    raise RuntimeError("pump stalled")
+                if pos < len(batch):
+                    time.sleep(0.01)
+            pumped += len(batch)
+            # wait THIS batch durable before the next: one admission
+            # window -> one WAL append -> one block write per batch
+            deadline = time.monotonic() + 60
+            while sum(payload_counts().get(
+                    f"tx{i}".encode(), 0)
+                    for i in missing[lo:lo + block_txs]) < len(batch):
+                if time.monotonic() > deadline:
+                    raise RuntimeError("batch never committed")
+                time.sleep(0.01)
+
+        counts = payload_counts()
+        exact_once = (sorted(counts) == sorted(want) and
+                      all(v == 1 for v in counts.values()))
+        final = stream()
+        return {
+            "replay_height": len(replay_digests),
+            "replay_digests": replay_digests,
+            "height": len(final),
+            "block_digests": [_block_digest(b) for b in final],
+            "payloads_exact_once": exact_once,
+            "pumped": pumped,
+            "ntxs": ntxs,
+        }
+    finally:
+        svc.close(flush=True)
+
+
+def crash_matrix_onboard_child(root: str, nblocks: int = 9) -> dict:
+    """The onboarding-commit crash-matrix cell: replicate a
+    deterministic stub-signed chain (the test_onboarding seam shape)
+    into a DURABLE OrdererLedger through the real ChainReplicator —
+    `onboarding.commit=crash:1:k` kills the process at the k-th
+    commit; the rerun must resume from the durable prefix and finish
+    with a replica bit-identical to the source."""
+    import hashlib
+    from types import SimpleNamespace
+
+    from fabric_tpu.orderer import onboarding as onb
+    from fabric_tpu.orderer.multichannel import OrdererLedger
+    from fabric_tpu.common.backoff import FullJitterBackoff
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protos import configtx as ctxpb
+    from fabric_tpu.protoutil import protoutil as pu
+
+    channel = "crashonb"
+    signer = b"orderer-a"
+
+    def sign(ident: bytes, msg: bytes) -> bytes:
+        return hashlib.sha256(b"stubsig|" + ident + b"|" + msg) \
+            .digest()
+
+    class _Csp:
+        def verify_batch(self, items):
+            return [sig == sign(ident, msg)
+                    for ident, msg, sig in items]
+
+    class _Prepared:
+        def __init__(self, signed):
+            self.items = [(sd.identity, sd.data, sd.signature)
+                          for sd in signed]
+            self._signed = signed
+
+        def finish(self, ok):
+            for sd, o in zip(self._signed, ok):
+                if o and sd.identity == signer:
+                    return
+            raise RuntimeError("no valid orderer signature")
+
+    class _Policy:
+        def prepare(self, signed):
+            return _Prepared(signed)
+
+    meta = ctxpb.ConsensusMetadata()
+    c = meta.consenters.add()
+    c.host, c.port = "src.example.com", 7050
+    bundle = SimpleNamespace(
+        csp=_Csp(),
+        policy_manager=SimpleNamespace(
+            get_policy=lambda path: _Policy()),
+        orderer=SimpleNamespace(
+            consensus_metadata=meta.SerializeToString(
+                deterministic=True)))
+
+    # deterministic source chain: both the crashed and the resumed
+    # child regenerate the identical bytes
+    blocks = []
+    prev = b""
+    for i in range(nblocks):
+        block = pu.new_block(i, prev)
+        block.data.data.append(b"onb-payload-%d" % i)
+        block.header.data_hash = pu.block_data_hash(block.data)
+        md = cpb.Metadata()
+        md.value = pu.encode_last_config(0)
+        if i > 0:
+            ms = md.signatures.add()
+            ms.signature_header = pu.marshal(
+                pu.create_signature_header(signer, b"n" * 24))
+            ms.signature = sign(
+                signer, md.value + ms.signature_header +
+                pu.block_header_bytes(block.header))
+        block.metadata.metadata[
+            cpb.BlockMetadataIndex.SIGNATURES] = pu.marshal(md)
+        blocks.append(block)
+        prev = pu.block_header_hash(block.header)
+
+    class _Transport:
+        endpoint = "joiner.example.com:0"
+
+        def pull_blocks(self, ep, cid, start, end):
+            return [b for b in blocks
+                    if start <= b.header.number < end]
+
+    ledger = OrdererLedger(os.path.join(root, "replica"))
+    try:
+        class _LedgerSink:
+            def height(self):
+                return ledger.height
+
+            def tip_hash(self):
+                if ledger.height == 0:
+                    return None
+                return pu.block_header_hash(
+                    ledger.get_block(ledger.height - 1).header)
+
+            def verify(self, span):
+                n, bundle_after, err = onb.verify_block_span(
+                    channel, span, self.height(), self.tip_hash(),
+                    bundle)
+                return n, err
+
+            def commit(self, block):
+                ledger.add_block(block)
+
+        replay_digests = [_block_digest(ledger.get_block(n))
+                          for n in range(ledger.height)]
+        rep = onb.ChainReplicator(
+            channel, _Transport(),
+            consenters_fn=lambda: ["src.example.com:7050"],
+            sink=_LedgerSink(), batch=3,
+            backoff=FullJitterBackoff(0.001, 0.01))
+        rep.run(target_height=nblocks, max_wall_s=60.0)
+
+        replica = [ledger.get_block(n) for n in range(ledger.height)]
+        source_digests = [_block_digest(b) for b in blocks]
+        replica_digests = [_block_digest(b) for b in replica]
+        return {
+            "replay_height": len(replay_digests),
+            "replay_digests": replay_digests,
+            "height": len(replica),
+            "block_digests": replica_digests,
+            "source_digests": source_digests,
+            "matches_source": replica_digests == source_digests,
+            "replay_is_source_prefix": replay_digests ==
+            source_digests[:len(replay_digests)],
+        }
+    finally:
+        ledger.close()
+
+
 def _have_openssl_cp() -> bool:
     try:
         from fabric_tpu.bccsp._crypto_compat import HAVE_CRYPTOGRAPHY
@@ -1409,6 +2066,51 @@ def commit_pipeline_run(n_blocks: int = 6, ntxs: int = 24) -> dict:
 if __name__ == "__main__":
     import sys
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    if len(sys.argv) > 1 and sys.argv[1] == "failover":
+        # the round-15 leader-kill soak (tools/soak_check.sh): same
+        # lockcheck discipline as the overload regime
+        from fabric_tpu.common import lockcheck
+        if os.environ.get(lockcheck.ENV_VAR):
+            lockcheck.install(
+                raise_on_violation=os.environ.get(
+                    lockcheck.ENV_VAR) == "raise")
+        out = failover_run(
+            producers=int(os.environ.get("SOAK_PRODUCERS", "2")),
+            ntxs_per_producer=int(os.environ.get("SOAK_TXS", "60")),
+            seed=int(os.environ.get("SOAK_SEED", "7")),
+            drop_rate=float(os.environ.get("SOAK_DROP_RATE", "0.10")),
+            reelect_bound_s=float(os.environ.get(
+                "SOAK_REELECT_BOUND_S", "30")))
+        san = lockcheck.sanitizer()
+        out["lockcheck_violations"] = (
+            len(san.violations()) if san is not None else None)
+        print(json.dumps(out))
+        if san is not None and san.violations():
+            print(san.report(), file=sys.stderr)
+            sys.exit(3)
+        sys.exit(0)
+
+    if len(sys.argv) > 1 and sys.argv[1] == "crashchild":
+        # one crash-matrix cell (tests/test_net_chaos.py drives this
+        # as a killed-and-restarted subprocess; the crash fault itself
+        # rides in via FTPU_FAULTS)
+        mode, root = sys.argv[2], sys.argv[3]
+        if mode == "order":
+            out = crash_matrix_order_child(
+                root,
+                ntxs=int(os.environ.get("CRASH_NTXS", "16")),
+                block_txs=int(os.environ.get("CRASH_BLOCK_TXS", "4")))
+        elif mode == "onboard":
+            out = crash_matrix_onboard_child(
+                root,
+                nblocks=int(os.environ.get("CRASH_NBLOCKS", "9")))
+        else:
+            print(f"unknown crashchild mode {mode!r}",
+                  file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(out))
+        sys.exit(0)
 
     if len(sys.argv) > 1 and sys.argv[1] == "overload":
         # the round-12 soak regime (tools/soak_check.sh): arm the

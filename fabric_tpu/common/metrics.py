@@ -333,6 +333,38 @@ BCCSP_ADMISSION_WAIT_SECONDS_OPTS = GaugeOpts(
          "dispatched — the convoy latency the round-12 "
          "condition-variable rewrite made observable.")
 
+NET_CHAOS_DROPPED_TOTAL_OPTS = CounterOpts(
+    namespace="net", subsystem="chaos", name="dropped_total",
+    help="Messages dropped by the network-chaos layer "
+         "(common/netchaos.py): link-policy drop draws plus armed "
+         "net.drop fault fires. Nonzero proves a chaos soak's claimed "
+         "loss rate actually happened.")
+
+NET_CHAOS_DUPLICATED_TOTAL_OPTS = CounterOpts(
+    namespace="net", subsystem="chaos", name="duplicated_total",
+    help="Messages delivered twice by the network-chaos layer "
+         "(dup-rate policy draws plus armed net.dup fault fires) — "
+         "the duplicate-safe step handling they exercise must keep "
+         "commit streams bit-identical.")
+
+NET_CHAOS_DELAYED_TOTAL_OPTS = CounterOpts(
+    namespace="net", subsystem="chaos", name="delayed_total",
+    help="Messages deferred by the network-chaos layer's scheduler "
+         "(fixed/jittered link delay policies plus armed net.delay "
+         "fault fires); the sender never blocks.")
+
+NET_CHAOS_REORDERED_TOTAL_OPTS = CounterOpts(
+    namespace="net", subsystem="chaos", name="reordered_total",
+    help="Messages held back for bounded reordering (overtaken by up "
+         "to the policy's reorder window of later messages on their "
+         "link, or released at the hold deadline).")
+
+NET_CHAOS_PARTITIONED_TOTAL_OPTS = CounterOpts(
+    namespace="net", subsystem="chaos", name="partitioned_total",
+    help="Messages cut by an installed chaos partition (symmetric or "
+         "asymmetric link-set cuts, programmatic or armed via "
+         "net.partition) before it healed.")
+
 DELIVER_RECONNECTS_OPTS = CounterOpts(
     namespace="deliver", subsystem="client", name="reconnects",
     help="Deliver-stream reconnect attempts after a stream failure "

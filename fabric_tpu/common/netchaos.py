@@ -1,0 +1,625 @@
+"""Deterministic network chaos at the transport seams.
+
+Round 15. Every prior robustness layer hardened a NODE-LOCAL failure
+class — device loss, overload, crash-safe onboarding — while the
+network between consenters stayed perfect: `LocalClusterNetwork` and
+the gossip `LocalNetwork` deliver every message exactly once, in
+order, instantly. Committee-based consensus is exactly where message
+loss and leader churn dominate at scale (arXiv 2302.00418), so this
+module makes the in-process fabrics faultable the same way the device
+path already is: deterministically, observably, and through the SAME
+`common/faults.py` registry the chaos CI arms.
+
+Three pieces:
+
+**`NetChaos`** — the engine. One instance models one network's
+weather: per-link policies (`LinkPolicy`: drop-rate, duplicate-rate,
+fixed+jittered delay, bounded reorder) drawn from per-link PRNG
+streams seeded from the engine seed and the link name (crc32), so the
+DECISION SEQUENCE for a link depends only on the seed and that link's
+message sequence — never on thread interleavings across links. Same
+seed in, same delivery schedule out (`schedule_log()` is the
+assertable artifact). Partitions cut whole link sets — symmetric
+(`mode="both"`) or asymmetric (`"in"`/`"out"`) — and heal
+programmatically or after `heal_after_s`. Deferred work (delays,
+reorder holds, timed heals) runs on a lazy scheduler thread; senders
+never block.
+
+**Fault-point driving** — the `net.drop` / `net.delay` / `net.dup` /
+`net.reorder` / `net.partition` points in `faults.KNOWN_POINTS`. The
+engine polls the registry per send and CONSUMES matching armings
+(`faults.consume`: canonical count/fires accounting, no raise),
+applying the effect on its own schedule. Link targeting rides the
+arg grammar: an endpoint matches either side, `a>b` a directed link,
+`a|b|c` any member of the set; `net.partition`'s arg IS the cut group
+and its delay field the auto-heal delay —
+`net.partition=error:1:2.5:node2|node3` isolates {node2, node3} once
+and heals 2.5 s later.
+
+**Wrappers** — `ChaosClusterTransport` around any
+`orderer/cluster.ClusterTransport` (async consensus sends ride the
+full policy set; the synchronous submit/pull RPCs honor partitions —
+SERVICE_UNAVAILABLE / ConnectionError, matching what the unreachable
+paths already raise) and `ChaosGossipTransport` around the gossip
+`Transport`. Both forward everything else to the wrapped transport,
+so `make_order_service(transport_wrap=engine.wrap_cluster)` is the
+whole integration.
+
+Chaos'd messages are counted on the canonical `net_chaos_*` counters
+(common/metrics.py, gendoc'd) and the engine's `stats` dict — a soak
+that claims "10% drop" can prove drops actually happened.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import random
+import threading
+import time
+import zlib
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from fabric_tpu.common import faults, tracing
+
+logger = logging.getLogger("common.netchaos")
+
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+NET_CHAOS_COUNTERS = {
+    "dropped": _m.NET_CHAOS_DROPPED_TOTAL_OPTS,
+    "duplicated": _m.NET_CHAOS_DUPLICATED_TOTAL_OPTS,
+    "delayed": _m.NET_CHAOS_DELAYED_TOTAL_OPTS,
+    "reordered": _m.NET_CHAOS_REORDERED_TOTAL_OPTS,
+    "partitioned": _m.NET_CHAOS_PARTITIONED_TOTAL_OPTS,
+}
+
+
+@dataclass
+class LinkPolicy:
+    """Chaos weather for one link (or a wildcard set of links). Rates
+    are per-message probabilities drawn from the link's seeded PRNG
+    stream; `reorder_window` bounds how many later messages may
+    overtake a held one and `reorder_hold_s` caps the hold on quiet
+    links (liveness: a held message always delivers eventually)."""
+
+    drop_rate: float = 0.0
+    dup_rate: float = 0.0
+    delay_s: float = 0.0
+    delay_jitter_s: float = 0.0
+    reorder_rate: float = 0.0
+    reorder_window: int = 4
+    reorder_hold_s: float = 0.25
+
+
+def link_match(arg: str, src: str, dst: str) -> bool:
+    """The fault-arg link grammar: `a>b` = the directed link, a set
+    `a|b|c` = either endpoint in the set, a bare endpoint = either
+    side of the link."""
+    if ">" in arg:
+        a, _, b = arg.partition(">")
+        return src == a and dst == b
+    if "|" in arg:
+        members = set(arg.split("|"))
+        return src in members or dst in members
+    return src == arg or dst == arg
+
+
+class _Held:
+    """A message held back for reordering: released after `remaining`
+    later messages pass on its link, or at `deadline` — whichever
+    comes first."""
+
+    __slots__ = ("fn", "remaining", "deadline")
+
+    def __init__(self, fn, remaining: int, deadline: float):
+        self.fn = fn
+        self.remaining = remaining
+        self.deadline = deadline
+
+
+class NetChaos:
+    """Seeded, deterministic chaos engine shared by every wrapped
+    transport of one test network."""
+
+    def __init__(self, seed: int = 0, metrics_provider=None,
+                 log_cap: int = 4096):
+        self.seed = int(seed)
+        self._lock = threading.Lock()
+        # (src_pat, dst_pat, policy); "*" matches any endpoint —
+        # first match wins, so register specific links first
+        self._policies: list[tuple[str, str, LinkPolicy]] = []
+        self._rngs: dict[str, random.Random] = {}
+        self._seqs: dict[str, itertools.count] = {}
+        # token -> (cut group, mode in {"both","in","out"})
+        self._partitions: dict[int, tuple[frozenset, str]] = {}
+        self._partition_seq = itertools.count(1)
+        self._held: dict[str, list[_Held]] = {}
+        self._log: list[tuple] = []
+        self._log_cap = log_cap
+        self.stats = {"sent": 0, "delivered": 0, "dropped": 0,
+                      "duplicated": 0, "delayed": 0, "reordered": 0,
+                      "partitioned": 0, "partitions_installed": 0,
+                      "heals": 0}
+        prov = metrics_provider or _m.DisabledProvider()
+        self._counters = {k: prov.new_counter(opts)
+                          for k, opts in NET_CHAOS_COUNTERS.items()}
+        # deferred delivery: heap of (due, tiebreak, fn); the thread
+        # starts lazily so policy-free engines stay thread-free
+        self._heap: list = []
+        self._heap_seq = itertools.count()
+        # deliveries popped off the heap/hold lists but not yet run —
+        # quiesce() must count them or it reports "nothing pending"
+        # mid-delivery
+        self._inflight = 0
+        self._cond = threading.Condition(self._lock)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # policy API (the soak rigs drive this programmatically)
+    # ------------------------------------------------------------------
+
+    def set_policy(self, policy: LinkPolicy, src: str = "*",
+                   dst: str = "*") -> None:
+        with self._lock:
+            self._policies.append((src, dst, policy))
+
+    def clear_policies(self) -> None:
+        with self._lock:
+            self._policies = []
+
+    def partition(self, group, mode: str = "both",
+                  heal_after_s: Optional[float] = None) -> int:
+        """Cut the links between `group` and every other endpoint.
+        `mode`: "both" = symmetric; "out" = only messages FROM the
+        group are cut (it can hear but not speak); "in" = only
+        messages INTO it. Returns a token for `heal(token)`;
+        `heal_after_s` schedules the heal automatically."""
+        if mode not in ("both", "in", "out"):
+            raise ValueError(f"unknown partition mode {mode!r}")
+        cut = frozenset(group)
+        with self._lock:
+            token = next(self._partition_seq)
+            self._partitions[token] = (cut, mode)
+            self.stats["partitions_installed"] += 1
+        logger.info("netchaos: partition %d installed — %s mode=%s "
+                    "heal_after=%s", token, sorted(cut), mode,
+                    heal_after_s)
+        if heal_after_s is not None and heal_after_s > 0:
+            self._schedule(time.monotonic() + heal_after_s,
+                           lambda: self.heal(token))
+        return token
+
+    def heal(self, token: Optional[int] = None) -> None:
+        """Remove one partition (or all of them)."""
+        with self._lock:
+            if token is None:
+                healed = bool(self._partitions)
+                self._partitions.clear()
+            else:
+                healed = self._partitions.pop(token, None) is not None
+            if healed:
+                self.stats["heals"] += 1
+        if healed:
+            logger.info("netchaos: partition healed (token=%s)", token)
+
+    def partitioned(self, src: str, dst: str) -> bool:
+        with self._lock:
+            return self._cut_locked(src, dst)
+
+    def _cut_locked(self, src: str, dst: str) -> bool:
+        for cut, mode in self._partitions.values():
+            s_in, d_in = src in cut, dst in cut
+            if s_in == d_in:
+                continue    # same side: link survives
+            if mode == "both":
+                return True
+            if mode == "out" and s_in:
+                return True
+            if mode == "in" and d_in:
+                return True
+        return False
+
+    # ------------------------------------------------------------------
+    # observation
+    # ------------------------------------------------------------------
+
+    def schedule_log(self) -> list:
+        """The decision log, oldest first: (seq-on-link, src, dst,
+        action, detail) — the deterministic artifact two same-seed
+        engines must agree on."""
+        with self._lock:
+            return list(self._log)
+
+    def quiesce(self, timeout: float = 5.0) -> bool:
+        """Wait for every deferred delivery (delays, reorder holds,
+        and deliveries already popped but still executing) to flush;
+        True when nothing is pending."""
+        def idle() -> bool:
+            return (not self._heap and
+                    not any(self._held.values()) and
+                    self._inflight == 0)
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if idle():
+                    return True
+            time.sleep(0.01)
+        with self._lock:
+            return idle()
+
+    # ------------------------------------------------------------------
+    # the routing decision (wrappers call this)
+    # ------------------------------------------------------------------
+
+    def send(self, src: str, dst: str,
+             deliver: Callable[[], None]) -> bool:
+        """Route one asynchronous message from `src` to `dst`;
+        `deliver` performs the actual handoff (called zero, one or two
+        times, possibly later on the scheduler thread). Returns False
+        when the message was dropped/cut."""
+        link = f"{src}>{dst}"
+        with self._lock:
+            seq = next(self._seqs.setdefault(link, itertools.count()))
+            self.stats["sent"] += 1
+        # a partition the poll installs cuts THIS send already; the
+        # per-message fault armings are consumed only for messages
+        # that SURVIVE the cut — a count-limited net.dup fire burned
+        # on a message a partition kills would report the fault acted
+        # while nothing was ever duplicated
+        self._poll_partition_fault()
+        with self._lock:
+            cut = self._cut_locked(src, dst)
+        if cut:
+            self._note(seq, src, dst, "partitioned", "")
+            self._count("partitioned")
+            return False
+        eff = self._fault_effects(src, dst)
+        if "drop" in eff:
+            self._note(seq, src, dst, "dropped", "fault")
+            self._count("dropped")
+            return False
+
+        policy = self._match_policy(src, dst)
+        delay = 0.0
+        dup = False
+        hold: Optional[int] = None
+        hold_s = 0.25
+        detail = []
+        if "delay" in eff:
+            delay = max(delay, float(eff["delay"].get("delay_s")
+                                     or 0.02))
+            detail.append(f"fault-delay={delay:.3f}")
+        if "dup" in eff:
+            dup = True
+            detail.append("fault-dup")
+        if "reorder" in eff:
+            hold = int(eff["reorder"].get("delay_s") or 0) or 4
+            detail.append(f"fault-reorder={hold}")
+        if policy is not None:
+            rng = self._link_rng(link)
+            # one draw per knob, in a fixed order: the stream stays
+            # aligned across outcomes, so decisions depend only on
+            # the seed and this link's message sequence
+            r_drop = rng.random()
+            r_dup = rng.random()
+            r_reord = rng.random()
+            r_jitter = rng.random()
+            if policy.drop_rate and r_drop < policy.drop_rate:
+                self._note(seq, src, dst, "dropped", "policy")
+                self._count("dropped")
+                return False
+            if policy.dup_rate and r_dup < policy.dup_rate:
+                dup = True
+            if policy.reorder_rate and r_reord < policy.reorder_rate:
+                hold = hold or policy.reorder_window
+                hold_s = policy.reorder_hold_s
+            d = policy.delay_s + policy.delay_jitter_s * r_jitter
+            delay = max(delay, d)
+
+        if dup:
+            self._note(seq, src, dst, "duplicated",
+                       ";".join(detail))
+            self._count("duplicated")
+        if hold is not None:
+            self._note(seq, src, dst, "held",
+                       f"window={hold};" + ";".join(detail))
+            self._count("reordered")
+            with self._lock:
+                self._held.setdefault(link, []).append(
+                    _Held(deliver, hold,
+                          time.monotonic() + max(hold_s, 0.01)))
+            self._schedule(time.monotonic() + max(hold_s, 0.01),
+                           lambda: self._flush_expired(link))
+            if dup:
+                self._deliver_now(deliver)
+            return True
+        if delay > 0:
+            self._note(seq, src, dst, "delayed", f"{delay:.4f}")
+            self._count("delayed")
+            self._schedule(time.monotonic() + delay,
+                           lambda: self._deliver_deferred(link,
+                                                          deliver))
+            if dup:
+                self._schedule(time.monotonic() + delay,
+                               lambda: self._deliver_now(deliver))
+            return True
+        self._note(seq, src, dst, "delivered", ";".join(detail))
+        self._deliver_now(deliver)
+        if dup:
+            self._deliver_now(deliver)
+        self._release_overtaken(link)
+        return True
+
+    # -- fault-registry polling --
+
+    _FAULT_KEYS = (("net.drop", "drop"), ("net.delay", "delay"),
+                   ("net.dup", "dup"), ("net.reorder", "reorder"))
+
+    def _fault_effects(self, src: str, dst: str) -> dict:
+        out: dict = {}
+        for point, key in self._FAULT_KEYS:
+            a = faults.arming(point)
+            if a is None:
+                continue
+            if a["arg"] is not None and \
+                    not link_match(a["arg"], src, dst):
+                continue
+            got = faults.consume(point, arg=a["arg"])
+            if got is not None:
+                out[key] = got
+        return out
+
+    def _poll_partition_fault(self) -> bool:
+        """An armed `net.partition` installs a partition (once per
+        fire): the arg is the cut group, the delay field the auto-heal
+        delay. Arg-less armings are refused loudly — 'partition
+        everything from everything' has no meaning."""
+        a = faults.arming("net.partition")
+        if a is None:
+            return False
+        if a["arg"] is None:
+            logger.warning("net.partition armed without a link-set "
+                           "arg; ignoring (spec: net.partition="
+                           "error:1:<heal_s>:node2|node3)")
+            faults.consume("net.partition")
+            return False
+        got = faults.consume("net.partition", arg=a["arg"])
+        if got is None:
+            return False
+        heal_after = float(got.get("delay_s") or 0.0) or None
+        self.partition(got["arg"].split("|"),
+                       heal_after_s=heal_after)
+        return True
+
+    # -- plumbing --
+
+    def _match_policy(self, src: str, dst: str) -> Optional[LinkPolicy]:
+        with self._lock:
+            for sp, dp, pol in self._policies:
+                if sp in ("*", src) and dp in ("*", dst):
+                    return pol
+        return None
+
+    def _link_rng(self, link: str) -> random.Random:
+        with self._lock:
+            rng = self._rngs.get(link)
+            if rng is None:
+                rng = self._rngs[link] = random.Random(
+                    (self.seed << 32)
+                    ^ zlib.crc32(link.encode("utf-8")))
+            return rng
+
+    def _note(self, seq: int, src: str, dst: str, action: str,
+              detail: str) -> None:
+        with self._lock:
+            self._log.append((seq, src, dst, action, detail))
+            if len(self._log) > self._log_cap:
+                del self._log[:len(self._log) - self._log_cap]
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self.stats[key] += 1
+        try:
+            self._counters[key].add(1)
+        except Exception:   # noqa: BLE001 — counting must never drop a message
+            logger.warning("net_chaos counter %s failed", key,
+                           exc_info=True)
+
+    def _deliver_now(self, fn: Callable[[], None]) -> None:
+        try:
+            fn()
+        except ConnectionError as e:
+            # an unreachable/unregistered endpoint (killed node): for
+            # the chaos fabric that is just more loss — log quietly,
+            # raft retransmission owns recovery
+            logger.debug("netchaos: delivery unreachable: %s", e)
+        except Exception:
+            logger.exception("netchaos: delivery failed")
+        else:
+            with self._lock:
+                self.stats["delivered"] += 1
+
+    def _deliver_deferred(self, link: str,
+                          fn: Callable[[], None]) -> None:
+        self._deliver_now(fn)
+        self._release_overtaken(link)
+
+    def _release_overtaken(self, link: str) -> None:
+        """One message DELIVERED on `link`: held (reordered) messages
+        count it toward their overtake window and release when it
+        closes. Drops don't count (nothing overtook anything), and a
+        released message does not itself decrement other holds
+        (documented simplification)."""
+        ready: list = []
+        with self._lock:
+            held = self._held.get(link)
+            if not held:
+                return
+            keep = []
+            for h in held:
+                h.remaining -= 1
+                if h.remaining <= 0:
+                    ready.append(h.fn)
+                else:
+                    keep.append(h)
+            self._held[link] = keep
+            self._inflight += len(ready)
+        for fn in ready:
+            self._deliver_now(fn)
+        if ready:
+            with self._lock:
+                self._inflight -= len(ready)
+
+    def _flush_expired(self, link: str) -> None:
+        """Reorder-hold liveness cap: deliver held messages whose
+        deadline passed even if the link went quiet."""
+        now = time.monotonic()
+        ready: list = []
+        with self._lock:
+            held = self._held.get(link)
+            if not held:
+                return
+            keep = []
+            for h in held:
+                (ready if h.deadline <= now else keep).append(h)
+            self._held[link] = keep
+            self._inflight += len(ready)
+        for h in ready:
+            self._deliver_now(h.fn)
+        if ready:
+            with self._lock:
+                self._inflight -= len(ready)
+
+    # -- the scheduler --
+
+    def _schedule(self, due: float, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            heapq.heappush(self._heap,
+                           (due, next(self._heap_seq), fn))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._pump_loop,
+                    name=f"netchaos-sched-{id(self) & 0xffff:04x}",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _pump_loop(self) -> None:
+        """Deferred-delivery worker: pops due items (delayed messages,
+        reorder-hold deadlines, timed heals) and runs them outside the
+        engine lock."""
+        while True:
+            due_fns: list = []
+            with self._lock:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    due_fns.append(heapq.heappop(self._heap)[2])
+                if not due_fns:
+                    wait = None if not self._heap else \
+                        max(0.0, self._heap[0][0] - now)
+                    self._cond.wait(timeout=wait if wait is not None
+                                    else 0.5)
+                    continue
+                self._inflight += len(due_fns)
+            t0 = time.perf_counter()
+            for fn in due_fns:
+                try:
+                    fn()
+                except Exception:
+                    logger.exception("netchaos: scheduled delivery "
+                                     "failed")
+            with self._lock:
+                self._inflight -= len(due_fns)
+            tracing.observe_stage("net.chaos.flush",
+                                  time.perf_counter() - t0)
+
+    def close(self) -> None:
+        """Stop the scheduler; anything still deferred is dropped
+        (teardown is a network death, not a delivery guarantee)."""
+        with self._lock:
+            self._closed = True
+            self._heap = []
+            self._held.clear()
+            self._cond.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout=2)
+
+    # -- wrapper factories --
+
+    def wrap_cluster(self, transport) -> "ChaosClusterTransport":
+        return ChaosClusterTransport(transport, self)
+
+    def wrap_gossip(self, transport) -> "ChaosGossipTransport":
+        return ChaosGossipTransport(transport, self)
+
+
+class _ChaosWrapper:
+    """Forwarding base: everything the chaos layer doesn't model goes
+    straight to the wrapped transport (handlers, auth tables, close)."""
+
+    def __init__(self, inner, chaos: NetChaos):
+        self._inner = inner
+        self.chaos = chaos
+
+    @property
+    def endpoint(self) -> str:
+        return self._inner.endpoint
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+class ChaosClusterTransport(_ChaosWrapper):
+    """`ClusterTransport` with weather: consensus sends ride the full
+    drop/dup/delay/reorder/partition policy set; the synchronous
+    submit/pull RPCs honor partitions — an unreachable submit answers
+    SERVICE_UNAVAILABLE and an unreachable pull raises, exactly the
+    shapes the real unreachable paths produce (PR-3 rule)."""
+
+    def send_consensus(self, target: str, channel: str,
+                       payload: bytes) -> None:
+        inner = self._inner
+        self.chaos.send(
+            inner.endpoint, target,
+            lambda: inner.send_consensus(target, channel, payload))
+
+    def submit(self, target: str, channel: str, env_bytes: bytes,
+               config_seq: int = 0):
+        if self.chaos.partitioned(self._inner.endpoint, target):
+            from fabric_tpu.protos import common, orderer as opb
+            return opb.SubmitResponse(
+                channel=channel,
+                status=common.Status.SERVICE_UNAVAILABLE,
+                info=f"{target} unreachable (chaos partition)")
+        return self._inner.submit(target, channel, env_bytes,
+                                  config_seq)
+
+    def pull_blocks(self, target: str, channel: str, start: int,
+                    end: int):
+        if self.chaos.partitioned(self._inner.endpoint, target):
+            raise ConnectionError(
+                f"{target} unreachable from {self._inner.endpoint} "
+                f"(chaos partition)")
+        return self._inner.pull_blocks(target, channel, start, end)
+
+
+class ChaosGossipTransport(_ChaosWrapper):
+    """Gossip `Transport` with weather on `send`. Gossip is loss-
+    tolerant by design, so dropped/duplicated messages here are pure
+    pressure on the anti-entropy machinery — and every one is counted
+    (`net_chaos_*`, beside the inbox's gossip_comm_overflow_count)."""
+
+    def send(self, endpoint: str, msg) -> None:
+        inner = self._inner
+        self.chaos.send(inner.endpoint, endpoint,
+                        lambda: inner.send(endpoint, msg))

@@ -194,6 +194,19 @@ class LocalClusterNetwork:
         node = self._reachable(sender, target)
         if node is not None:
             node.enqueue_consensus(sender, channel, payload)
+            return
+        with self._lock:
+            known = target in self._nodes
+        if not known:
+            # an UNREGISTERED/removed endpoint is a dead address, not
+            # transient loss: raise like the submit/pull paths do
+            # (PR-3 rule — cluster transports RAISE on unreachable),
+            # so a caller holding a stale consenter table hears about
+            # it instead of silently heartbeating a ghost. A node
+            # that is merely down/partitioned still drops silently:
+            # that is network loss, and raft retransmission owns it.
+            raise ConnectionError(
+                f"{target} unreachable from {sender}: not registered")
 
     def route_submit(self, sender: str, target: str, channel: str,
                      env_bytes: bytes,

@@ -219,6 +219,11 @@ class RaftChain:
         self.metrics = RaftMetrics(metrics_provider,
                                    channel=support.channel_id)
         self._last_leader = None   # soft_leader sentinel: None = no leader
+        # failover attribution (round 15): the FIRST election of a
+        # chain is startup, every later change is a failover — only
+        # those auto-dump the flight recorder
+        self._seen_leader = False
+        self._send_warned: dict[str, float] = {}
 
         self._consenters = parse_consenters(
             support.bundle().orderer.consensus_metadata)
@@ -628,15 +633,44 @@ class RaftChain:
             # lost) must not double-count the following None→Y
             if ready.soft_leader is not None:
                 self.metrics.leader_changes.add(1)
+            # every leadership transition is a tracing landmark; a
+            # REAL failover (a leader was already known) additionally
+            # auto-dumps the flight recorder so the events leading to
+            # it are attributable post-hoc (rate-limited, async)
+            tracing.instant(
+                "raft.leader_change",
+                channel=self._support.channel_id,
+                leader=ready.soft_leader or 0,
+                prev=self._last_leader or 0,
+                term=self.node.term)
+            if self._seen_leader:
+                tracing.auto_dump("leader_change")
+            if ready.soft_leader is not None:
+                self._seen_leader = True
             self._last_leader = ready.soft_leader
             self.metrics.is_leader.set(
                 1 if ready.soft_leader == self.node_id else 0)
         for msg in ready.messages:
             target = self._consenters.get(msg.to)
-            if target is not None:
+            if target is None:
+                continue
+            try:
                 self._transport.send_consensus(
                     target, self._support.channel_id,
                     msg.SerializeToString())
+            except Exception as e:   # noqa: BLE001 — one dead peer must
+                # not abort the rest of the drain: the transport RAISES
+                # on unregistered endpoints (round 15), and a leader
+                # heartbeating a killed consenter would otherwise drop
+                # every later message of this ready batch. Rate-limit
+                # the warn — this fires every heartbeat tick while the
+                # peer stays gone.
+                now = time.monotonic()
+                if now - self._send_warned.get(target, 0.0) > 5.0:
+                    self._send_warned[target] = now
+                    logger.warning("[%s] consensus send to %s failed "
+                                   "(suppressing repeats 5s): %s",
+                                   self._support.channel_id, target, e)
         for entry in ready.committed_entries:
             self._apply(entry)
         if ready.soft_leader != self.node_id and self._creator:
@@ -793,7 +827,20 @@ class RaftChain:
             # were never proposed: rebuild from the raft-log tail
             self._creator = None
             for batch in batches:
-                self._propose_block(batch)
+                try:
+                    self._propose_block(batch)
+                except Exception:   # noqa: BLE001 — a storage error mid-
+                    # demotion (failing WAL) must not abort the rest of
+                    # the window or escape into the ready loop: this
+                    # block is DROPPED exactly like a deposed leader's
+                    # (clients track commitment via deliver and
+                    # retransmit), the remaining batches still propose
+                    logger.warning(
+                        "[%s] sequential propose failed; block of %d "
+                        "envelope(s) dropped", self._support.channel_id,
+                        len(batch), exc_info=True)
+                    self.metrics.proposal_failures.add(1)
+                    self._creator = None
             return
         if n < len(blocks):
             logger.warning("[%s] %d proposal(s) dropped (not leader)",

@@ -15,9 +15,11 @@ the protocol unit-testable without threads or clocks
 from __future__ import annotations
 
 import logging
+import random
 from dataclasses import dataclass, field
 from typing import Optional
 
+from fabric_tpu.common.backoff import FullJitterBackoff
 from fabric_tpu.protos import raft as rpb
 
 logger = logging.getLogger("orderer.raft")
@@ -57,9 +59,22 @@ class RaftNode:
         self.state = FOLLOWER
         self.leader_id: int = 0
         self._elapsed = 0
-        # deterministic per-node election jitter (reference uses rand;
-        # node-id spread gives the same liveness without randomness)
-        self._timeout = election_tick + (node_id * 3) % election_tick
+        # Deterministic per-node election jitter, RE-DRAWN per round
+        # (round 15): the old fixed node-id spread made colliding
+        # timeouts collide FOREVER — two candidates under a lossy
+        # link could split every election. A node-id-seeded PRNG
+        # keeps the core deterministic (same node, same sequence)
+        # while consecutive failed campaigns draw from a widening,
+        # bounded window under the common/backoff.py full-jitter
+        # discipline — the bounded re-election guarantee: the worst
+        # timeout is election_tick + the backoff cap (3x), and any
+        # sign of a live leader resets the spread.
+        self._rng = random.Random(0x9E3779B9 ^ (node_id & 0xFFFFFFFF))
+        self._elect_backoff = FullJitterBackoff(
+            base_s=2.0, max_s=float(3 * election_tick),
+            draw=self._rng.uniform)
+        self._timeout = election_tick + int(
+            self._rng.uniform(0, election_tick))
         self._votes: dict[int, bool] = {}
         self._pre_votes: dict[int, bool] = {}
 
@@ -184,6 +199,11 @@ class RaftNode:
     def _campaign(self) -> None:
         if self.id not in self.peers:
             return  # removed from the cluster
+        # re-draw the next election timeout with full jitter over a
+        # widening (bounded) window: repeated split/failed campaigns
+        # de-synchronize instead of colliding again
+        self._timeout = self.election_tick + 1 + int(
+            self._elect_backoff.next())
         if len(self.peers) == 1:
             self._become_leader(self.term + 1)
             return
@@ -277,20 +297,44 @@ class RaftNode:
             self.voted_for = 0
         self.leader_id = leader
         self._elapsed = 0
+        if leader:
+            # progress: a live leader exists — the next outage starts
+            # from the base election window, not this one's ceiling
+            self._reset_election_jitter()
         if changed:
             self._persist_hard_state()
+
+    def _reset_election_jitter(self) -> None:
+        if self._elect_backoff.failures:
+            self._elect_backoff.reset()
+            self._timeout = self.election_tick + int(
+                self._rng.uniform(0, self.election_tick))
 
     def _become_leader(self, term: int) -> None:
         self.state = LEADER
         self.term = term
         self.leader_id = self.id
         self._elapsed = 0
+        self._reset_election_jitter()
         last = self.last_index()
         self.next_index = {p: last + 1 for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}
-        self.match_index[self.id] = last
         logger.info("raft node %d became leader at term %d", self.id,
                     term)
+        if last > self.commit_index:
+            # an uncommitted predecessor tail: commit it NOW by
+            # appending an empty own-term entry (etcd appends one at
+            # every term start; doing it only when a tail exists
+            # keeps quiet elections index-stable). Raft forbids
+            # counting replicas of old-term entries toward commit, so
+            # without this the tail — blocks accepted by the dead
+            # leader — would sit unwritten until the next client
+            # proposal happens to arrive.
+            e = rpb.Entry(index=last + 1, term=self.term,
+                          type=rpb.Entry.NORMAL, data=b"")
+            self._storage.append([e])
+            self._ready.entries_to_persist.append(e)
+        self.match_index[self.id] = self.last_index()
         self._broadcast_append()
         if len(self.peers) == 1:
             self._maybe_commit()
@@ -327,9 +371,22 @@ class RaftNode:
         if self.state != FOLLOWER:
             self._become_follower(msg.term, msg.from_)
         self.leader_id = msg.from_
+        self._reset_election_jitter()
 
         resp = self._base(msg.from_, rpb.RaftMessage.APPEND_RESP)
         prev = msg.prev_log_index
+        if prev < self.commit_index:
+            # A STALE append — delayed, duplicated or reordered by the
+            # network — entirely below our commit point. Committed
+            # entries are immutable and known to match the leader's
+            # log, so ack the commit index and touch NOTHING (etcd
+            # MsgApp handling). Without this guard the conflict scan
+            # below would see term_of()==0 for compacted indexes and
+            # truncate_from() a compacted index — deleting the whole
+            # LIVE log on a message that carries no new information.
+            resp.last_log_index = self.commit_index
+            self._send(msg.from_, resp)
+            return
         if prev > self.last_index() or \
                 (prev >= self._storage.first_index() - 1 and
                  self._storage.term_of(prev) != msg.prev_log_term):
@@ -426,6 +483,15 @@ class RaftNode:
         self.leader_id = msg.from_
         meta = msg.snapshot
         if meta.last_index <= self.commit_index:
+            # stale/duplicate snapshot (reordered, or our ack was
+            # dropped): ACK the current position anyway — silence
+            # here leaves the leader's next_index below our first
+            # index forever, and it would re-send this snapshot on
+            # every heartbeat (a livelock the drop+dup chaos surfaces
+            # immediately)
+            resp = self._base(msg.from_, rpb.RaftMessage.APPEND_RESP)
+            resp.last_log_index = self.commit_index
+            self._send(msg.from_, resp)
             return
         # accept the snapshot position; the chain pulls blocks
         self._storage.install_snapshot(meta)

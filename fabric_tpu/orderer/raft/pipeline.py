@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from fabric_tpu.common import overload, tracing
+from fabric_tpu.common import faults, overload, tracing
 
 logger = logging.getLogger("orderer.raft.pipeline")
 
@@ -255,6 +255,12 @@ class BlockWriteStage:
                         None)
             t0 = time.perf_counter()
             try:
+                # the block-write seam of the crash-point recovery
+                # matrix: crash mode kills the consenter between raft
+                # commit and the durable block append (the committed
+                # entries replay from the WAL at restart); error mode
+                # is a sticky stage failure -> the chain demotes
+                faults.check("order.block_write")
                 with tracing.span("order.write", parent=rctx,
                                   blocks=len(run),
                                   first=run[0].header.number,

@@ -13,6 +13,7 @@ from __future__ import annotations
 import struct
 from typing import Optional
 
+from fabric_tpu.common import faults
 from fabric_tpu.ledger.kvdb import DBHandle
 from fabric_tpu.protos import raft as rpb
 
@@ -87,6 +88,12 @@ class RaftStorage:
         return out
 
     def append(self, entries: list[rpb.Entry]) -> None:
+        # the WAL-append seam of the crash-point recovery matrix:
+        # crash mode dies HERE — before the atomic batch write — so a
+        # restart must reconstruct from what the previous appends made
+        # durable; error mode models a failing disk (the chain drops
+        # the step / demotes the propose)
+        faults.check("raft.wal_append")
         batch = self._db.new_batch()
         for e in entries:
             batch.put(_ek(e.index),

@@ -1,0 +1,934 @@
+"""Round-15 network chaos + partition-tolerant ordering (ISSUE 13).
+
+The claims under test:
+
+  * `common/netchaos.py` is DETERMINISTIC: same seed + same per-link
+    message sequence => the same delivery schedule (drop/dup/delay/
+    reorder decisions), independent of other links;
+  * each policy knob works in isolation, partitions cut symmetric or
+    asymmetric link sets and heal (programmatically or timed), and the
+    `net.*` fault points drive the same effects through the canonical
+    faults registry (count/fires accounting, colon-tolerant arg
+    grammar);
+  * a 3-consenter `LocalClusterNetwork` under drop+dup+reorder chaos
+    WITH a partition-and-heal converges to byte-identical committed
+    streams with zero accepted-then-lost envelopes (after the client
+    reconciliation protocol) and `raft.leader_change` instants in the
+    flight recorder;
+  * duplicate/reorder chaos produces a block stream BIT-IDENTICAL to a
+    chaos-free run (deterministic 1-tx blocks);
+  * the raft core survives what chaos surfaces: a stale reordered
+    APPEND below the commit index never truncates the live log, a
+    stale SNAPSHOT is acked (no retry livelock), repeated failed
+    campaigns re-draw bounded full-jitter timeouts, and a new leader
+    commits its predecessors' uncommitted tail without client traffic;
+  * the crash-point recovery matrix: a REAL subprocess killed by a
+    crash-mode fault at each durable-write seam (raft WAL append,
+    pipelined block write, onboarding commit) restarts to bit-identical
+    replay and finishes with every payload committed exactly once;
+  * `LocalClusterNetwork.route_consensus` RAISES on unregistered
+    endpoints (the PR-3 unreachable rule) while down/partitioned nodes
+    stay silent drops.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import bench_pipeline as bp
+from fabric_tpu.common import faults, netchaos, tracing
+from fabric_tpu.common.netchaos import LinkPolicy, NetChaos, link_match
+from fabric_tpu.ledger.kvdb import DBHandle, KVStore
+from fabric_tpu.orderer.cluster import LocalClusterNetwork
+from fabric_tpu.orderer.raft.core import FOLLOWER, LEADER, RaftNode
+from fabric_tpu.orderer.raft.storage import RaftStorage
+from fabric_tpu.protos import common as cpb
+from fabric_tpu.protos import raft as rpb
+from fabric_tpu.protoutil import protoutil as pu
+
+
+def _wait(cond, timeout: float = 30.0, step: float = 0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(step)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+    def _drive(self, seed):
+        e = NetChaos(seed=seed)
+        e.set_policy(LinkPolicy(drop_rate=0.3, dup_rate=0.2,
+                                delay_s=0.0, reorder_rate=0.2,
+                                reorder_window=3))
+        sink: list = []
+        for i in range(80):
+            e.send("a", "b", lambda: sink.append(1))
+            e.send("b", "a", lambda: sink.append(2))
+        log = e.schedule_log()
+        e.close()
+        return log
+
+    def test_same_seed_same_schedule(self):
+        faults.clear()
+        assert self._drive(11) == self._drive(11)
+
+    def test_different_seed_different_schedule(self):
+        faults.clear()
+        assert self._drive(11) != self._drive(12)
+
+    def test_link_streams_independent(self):
+        """Adding traffic on one link must not perturb another link's
+        decision sequence (per-link PRNG streams)."""
+        faults.clear()
+
+        def decisions(extra_links):
+            e = NetChaos(seed=5)
+            e.set_policy(LinkPolicy(drop_rate=0.5))
+            for i in range(40):
+                e.send("a", "b", lambda: None)
+                for ln in range(extra_links):
+                    e.send(f"x{ln}", "y", lambda: None)
+            out = [rec[3] for rec in e.schedule_log()
+                   if rec[1] == "a" and rec[2] == "b"]
+            e.close()
+            return out
+
+        assert decisions(0) == decisions(3)
+
+
+class TestPolicies:
+    def setup_method(self):
+        faults.clear()
+
+    def teardown_method(self):
+        faults.reset()
+
+    def test_drop_all(self):
+        e = NetChaos(seed=1)
+        e.set_policy(LinkPolicy(drop_rate=1.0))
+        got: list = []
+        for _ in range(5):
+            assert not e.send("a", "b", lambda: got.append(1))
+        assert got == [] and e.stats["dropped"] == 5
+        e.close()
+
+    def test_duplicate_all(self):
+        e = NetChaos(seed=1)
+        e.set_policy(LinkPolicy(dup_rate=1.0))
+        got: list = []
+        e.send("a", "b", lambda: got.append(1))
+        assert got == [1, 1] and e.stats["duplicated"] == 1
+        e.close()
+
+    def test_delay_defers_without_blocking_sender(self):
+        e = NetChaos(seed=1)
+        e.set_policy(LinkPolicy(delay_s=0.08))
+        got: list = []
+        t0 = time.perf_counter()
+        e.send("a", "b", lambda: got.append(1))
+        assert time.perf_counter() - t0 < 0.05   # sender not blocked
+        assert got == []
+        assert _wait(lambda: got == [1], timeout=2.0)
+        assert e.stats["delayed"] == 1
+        e.close()
+
+    def test_reorder_bounded_window(self):
+        """A held message is overtaken by exactly its window of later
+        messages, then released — bounded reordering."""
+        e = NetChaos(seed=1)
+        got: list = []
+        faults.arm("net.reorder", mode="error", count=1, delay_s=2)
+        for i in range(3):
+            e.send("a", "b", (lambda i=i: (lambda: got.append(i)))())
+        assert _wait(lambda: len(got) == 3, timeout=2.0)
+        assert got == [1, 2, 0]
+        assert e.stats["reordered"] == 1
+        e.close()
+
+    def test_reorder_hold_deadline_keeps_liveness(self):
+        """On a quiet link the hold deadline releases the message —
+        reordering never becomes loss."""
+        e = NetChaos(seed=1)
+        e.set_policy(LinkPolicy(reorder_rate=1.0, reorder_window=50,
+                                reorder_hold_s=0.05))
+        got: list = []
+        e.send("a", "b", lambda: got.append(1))
+        assert got == []
+        assert _wait(lambda: got == [1], timeout=2.0)
+        e.close()
+
+    def test_partition_modes_and_heal(self):
+        e = NetChaos(seed=1)
+        got: list = []
+        tok = e.partition(["b"])
+        assert not e.send("a", "b", lambda: got.append("ab"))
+        assert not e.send("b", "a", lambda: got.append("ba"))
+        e.heal(tok)
+        assert e.send("a", "b", lambda: got.append("ab2"))
+        # asymmetric: the group can hear but not speak
+        e.partition(["b"], mode="out")
+        assert e.send("a", "b", lambda: got.append("in-ok"))
+        assert not e.send("b", "a", lambda: got.append("cut"))
+        e.heal()
+        # asymmetric the other way
+        e.partition(["b"], mode="in")
+        assert not e.send("a", "b", lambda: got.append("cut2"))
+        assert e.send("b", "a", lambda: got.append("out-ok"))
+        e.heal()
+        assert got == ["ab2", "in-ok", "out-ok"]
+        assert e.stats["partitioned"] == 4
+        assert e.stats["heals"] == 3
+        e.close()
+
+    def test_timed_heal(self):
+        e = NetChaos(seed=1)
+        e.partition(["b"], heal_after_s=0.05)
+        assert not e.send("a", "b", lambda: None)
+        assert _wait(lambda: not e.partitioned("a", "b"), timeout=2.0)
+        assert e.send("a", "b", lambda: None)
+        e.close()
+
+
+class TestFaultGrammar:
+    def setup_method(self):
+        faults.clear()
+
+    def teardown_method(self):
+        faults.reset()
+
+    def test_link_match_grammar(self):
+        assert link_match("n1", "n1", "n2")
+        assert link_match("n1", "n2", "n1")
+        assert not link_match("n3", "n1", "n2")
+        assert link_match("n1>n2", "n1", "n2")
+        assert not link_match("n1>n2", "n2", "n1")
+        assert link_match("n2|n3", "n1", "n3")
+        assert not link_match("n2|n3", "n1", "n4")
+
+    def test_env_arg_keeps_colons(self):
+        """Endpoint args contain ':' — everything past the 3rd field
+        separator is the arg verbatim."""
+        faults.arm_from_env(
+            spec="net.drop=error:2::orderer0.example.com:7050")
+        a = faults.arming("net.drop")
+        assert a is not None
+        assert a["arg"] == "orderer0.example.com:7050"
+        assert a["count"] == 2
+
+    def test_net_drop_counts_and_fires(self):
+        e = NetChaos(seed=1)
+        faults.arm("net.drop", mode="error", count=2)
+        got: list = []
+        for _ in range(4):
+            e.send("a", "b", lambda: got.append(1))
+        assert len(got) == 2
+        assert faults.fires("net.drop") == 2
+        assert not faults.armed("net.drop")
+        e.close()
+
+    def test_net_drop_arg_targets_one_link(self):
+        e = NetChaos(seed=1)
+        faults.arm("net.drop", mode="error", count=None, arg="a>b")
+        got: list = []
+        e.send("b", "a", lambda: got.append("ba"))
+        e.send("a", "b", lambda: got.append("ab"))
+        assert got == ["ba"]
+        e.close()
+
+    def test_net_dup_and_delay(self):
+        e = NetChaos(seed=1)
+        faults.arm("net.dup", mode="error", count=1)
+        got: list = []
+        e.send("a", "b", lambda: got.append(1))
+        assert got == [1, 1]
+        faults.arm("net.delay", mode="delay", count=1, delay_s=0.05)
+        e.send("a", "b", lambda: got.append(2))
+        assert got == [1, 1]
+        assert _wait(lambda: got == [1, 1, 2], timeout=2.0)
+        e.close()
+
+    def test_net_partition_installs_and_auto_heals(self):
+        e = NetChaos(seed=1)
+        faults.arm("net.partition", mode="error", count=1,
+                   delay_s=0.05, arg="b|c")
+        got: list = []
+        # first send polls the arming, installs the cut, and is cut
+        assert not e.send("a", "b", lambda: got.append(1))
+        assert not e.send("c", "a", lambda: got.append(2))
+        assert e.send("b", "c", lambda: got.append(3))  # same side
+        assert faults.fires("net.partition") == 1
+        assert _wait(lambda: not e.partitioned("a", "b"), timeout=2.0)
+        assert e.send("a", "b", lambda: got.append(4))
+        assert got == [3, 4]
+        e.close()
+
+    def test_partitioned_send_never_burns_fault_fires(self):
+        """A count-limited arming must not be consumed by a message a
+        partition kills anyway — the fire would claim the fault acted
+        while nothing was ever duplicated/dropped/delayed."""
+        e = NetChaos(seed=1)
+        tok = e.partition(["b"])
+        faults.arm("net.dup", mode="error", count=1)
+        got: list = []
+        assert not e.send("a", "b", lambda: got.append(1))
+        assert faults.fires("net.dup") == 0
+        e.heal(tok)
+        e.send("a", "b", lambda: got.append(1))
+        assert got == [1, 1]
+        assert faults.fires("net.dup") == 1
+        e.close()
+
+    def test_consume_accounting(self):
+        faults.arm("net.dup", mode="error", count=1, arg="n9")
+        assert faults.consume("net.dup", arg="other") is None
+        got = faults.consume("net.dup", arg="n9")
+        assert got is not None and got["arg"] == "n9"
+        assert faults.consume("net.dup", arg="n9") is None
+        assert faults.fires("net.dup") == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: unreachable semantics on the cluster fabric
+# ---------------------------------------------------------------------------
+
+
+class TestClusterUnreachable:
+    def test_send_consensus_to_unregistered_raises(self):
+        net = LocalClusterNetwork()
+        t = net.register("n1:7050")
+        try:
+            with pytest.raises(ConnectionError):
+                t.send_consensus("ghost:9999", "ch", b"payload")
+        finally:
+            t.close()
+
+    def test_send_consensus_to_removed_raises(self):
+        net = LocalClusterNetwork()
+        t1 = net.register("n1:7050")
+        t2 = net.register("n2:7051")
+        t2.close()     # unregisters
+        try:
+            with pytest.raises(ConnectionError):
+                t1.send_consensus("n2:7051", "ch", b"payload")
+        finally:
+            t1.close()
+
+    def test_down_and_partitioned_stay_silent_drops(self):
+        net = LocalClusterNetwork()
+        t1 = net.register("n1:7050")
+        t2 = net.register("n2:7051")
+        try:
+            net.take_down("n2:7051")
+            t1.send_consensus("n2:7051", "ch", b"x")   # no raise
+            net.bring_up("n2:7051")
+            net.partition("n1:7050", "n2:7051")
+            t1.send_consensus("n2:7051", "ch", b"x")   # no raise
+        finally:
+            net.heal()
+            t1.close()
+            t2.close()
+
+
+# ---------------------------------------------------------------------------
+# raft core hardening (deterministic, no threads)
+# ---------------------------------------------------------------------------
+
+
+def _storage(tag: str = "s") -> RaftStorage:
+    return RaftStorage(DBHandle(KVStore(":memory:"), tag))
+
+
+def _append_msg(frm, term, prev, prev_term, entries, commit):
+    m = rpb.RaftMessage(type=rpb.RaftMessage.APPEND, from_=frm,
+                        term=term)
+    m.prev_log_index = prev
+    m.prev_log_term = prev_term
+    m.commit = commit
+    for idx, t, data in entries:
+        e = m.entries.add()
+        e.index, e.term, e.type, e.data = idx, t, rpb.Entry.NORMAL, \
+            data
+    return m
+
+
+class TestRaftCoreHardening:
+    def setup_method(self):
+        # these pin storage-level protocol internals: ambient chaos
+        # armings (raft.wal_append etc.) would fire inside the direct
+        # step/append calls and turn the assertions into fault tests
+        faults.clear()
+
+    def teardown_method(self):
+        faults.reset()
+
+    def _replicated_follower(self):
+        """Follower with committed entries 1..3 (term 1), compacted
+        through index 3."""
+        n = RaftNode(2, [1, 2], _storage())
+        n.step(_append_msg(1, 1, 0, 0,
+                           [(1, 1, b"e1"), (2, 1, b"e2"),
+                            (3, 1, b"e3")], commit=3))
+        n.ready()
+        assert n.commit_index == 3 and n.last_index() == 3
+        n.compact(3, block_height=3)
+        assert n._storage.first_index() == 4
+        return n
+
+    def test_stale_append_below_commit_never_truncates(self):
+        """The reorder/dup killer: a delayed duplicate APPEND entirely
+        below the commit index must ack the commit index and mutate
+        NOTHING — the old conflict scan read term 0 for compacted
+        indexes and truncated the whole live log."""
+        n = self._replicated_follower()
+        n.step(_append_msg(1, 1, 0, 0,
+                           [(1, 1, b"e1"), (2, 1, b"e2")], commit=2))
+        r = n.ready()
+        assert n.commit_index == 3
+        assert n.last_index() == 3          # nothing truncated
+        acks = [m for m in r.messages
+                if m.type == rpb.RaftMessage.APPEND_RESP]
+        assert acks and not acks[0].reject
+        assert acks[0].last_log_index == 3  # ack the commit point
+
+    def test_duplicate_append_is_idempotent(self):
+        n = RaftNode(2, [1, 2], _storage())
+        msg = _append_msg(1, 1, 0, 0, [(1, 1, b"x")], commit=1)
+        n.step(msg)
+        applied_once = list(n.ready().committed_entries)
+        n.step(msg)
+        r = n.ready()
+        assert r.committed_entries == []     # no re-apply
+        assert n.last_index() == 1
+        assert [e.data for e in applied_once] == [b"x"]
+
+    def test_stale_snapshot_is_acked_not_ignored(self):
+        """Silence on a duplicate snapshot livelocks the leader into
+        re-sending it forever when the original ack was dropped."""
+        n = self._replicated_follower()
+        m = rpb.RaftMessage(type=rpb.RaftMessage.SNAPSHOT, from_=1,
+                            term=1)
+        m.snapshot.last_index = 2
+        m.snapshot.last_term = 1
+        n.step(m)
+        r = n.ready()
+        acks = [x for x in r.messages
+                if x.type == rpb.RaftMessage.APPEND_RESP]
+        assert acks and acks[0].last_log_index == 3
+
+    def test_election_timeout_redraws_bounded(self):
+        """Failed campaigns re-draw the timeout with widening, BOUNDED
+        full jitter; hearing a live leader resets the spread."""
+        n = RaftNode(1, [1, 2, 3], _storage(), election_tick=10)
+        lo, hi = 10 + 1, 10 + 1 + 3 * 10
+        seen = set()
+        for _ in range(8):
+            n._campaign()
+            assert lo <= n._timeout <= hi, n._timeout
+            seen.add(n._timeout)
+        assert len(seen) > 1, "timeout never re-drawn"
+        assert n._elect_backoff.failures == 8
+        # a live leader's APPEND resets the backoff
+        n.step(_append_msg(2, n.term + 1, 0, 0, [], commit=0))
+        assert n._elect_backoff.failures == 0
+        assert 10 <= n._timeout <= 20
+
+    def test_deterministic_per_node(self):
+        a = RaftNode(7, [7, 8], _storage("a"), election_tick=10)
+        b = RaftNode(7, [7, 8], _storage("b"), election_tick=10)
+        assert a._timeout == b._timeout
+        a._campaign()
+        b._campaign()
+        assert a._timeout == b._timeout
+
+    def test_new_leader_commits_predecessor_tail_without_traffic(self):
+        """Entries replicated to a majority but uncommitted when the
+        leader died must commit under the NEW leader without waiting
+        for client traffic (the empty own-term entry)."""
+        s1, s2 = _storage("n1"), _storage("n2")
+        n1 = RaftNode(1, [1, 2, 3], s1)
+        n2 = RaftNode(2, [1, 2, 3], s2)
+        # old leader (node 3, term 1) replicated entry 1 to BOTH
+        # survivors but died before sending its commit index
+        for n in (n1, n2):
+            n.step(_append_msg(3, 1, 0, 0, [(1, 1, b"tail")],
+                               commit=0))
+            n.ready()
+            assert n.commit_index == 0 and n.last_index() == 1
+        # node 1 campaigns and wins with node 2's vote
+        n1.pre_vote = False
+        n1._campaign()
+        votes = [m for m in n1.ready().messages
+                 if m.type == rpb.RaftMessage.VOTE]
+        n2.step(next(m for m in votes if m.to == 2))
+        resp = [m for m in n2.ready().messages
+                if m.type == rpb.RaftMessage.VOTE_RESP]
+        n1.step(resp[0])
+        assert n1.state == LEADER
+        # the empty entry exists and drives the tail's commit
+        assert n1.last_index() == 2
+        appends = [m for m in n1.ready().messages
+                   if m.type == rpb.RaftMessage.APPEND and m.to == 2]
+        assert appends
+        n2.step(appends[-1])
+        acks = [m for m in n2.ready().messages
+                if m.type == rpb.RaftMessage.APPEND_RESP]
+        n1.step(acks[-1])
+        n1.ready()
+        assert n1.commit_index == 2, \
+            "predecessor tail not committed by the new leader"
+
+    def test_quiet_election_appends_no_empty_entry(self):
+        """No uncommitted tail -> no empty entry: quiet elections stay
+        index-stable (existing stream expectations unchanged)."""
+        n = RaftNode(1, [1], _storage())
+        for _ in range(50):
+            n.tick()
+        assert n.state == LEADER
+        assert n.last_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# ordering-service integration (threaded, real loops)
+# ---------------------------------------------------------------------------
+
+
+def _pump_accept(svc, envs, deadline_s=60.0):
+    """Broadcast envelopes until every one is SUCCESS-acked; returns
+    the marshaled bytes of the accepted run (in order)."""
+    pos = 0
+    deadline = time.monotonic() + deadline_s
+    while pos < len(envs):
+        resps = svc.broadcast.process_messages(envs[pos:])
+        for r in resps:
+            if r.status == cpb.Status.SUCCESS:
+                pos += 1
+            else:
+                break
+        assert time.monotonic() < deadline, \
+            f"broadcast stalled at {pos}/{len(envs)}"
+        if pos < len(envs):
+            time.sleep(0.02)
+    return [pu.marshal(e) for e in envs]
+
+
+def _stream(svc, timeout: float = 10.0):
+    """The fully-readable committed stream: `height` can advance a
+    beat before the row is visible to this reader thread (async write
+    stage), so retry until every block < height reads back."""
+    lg = svc.support.ledger
+    deadline = time.monotonic() + timeout
+    while True:
+        h = lg.height
+        out = []
+        for n in range(h):
+            b = lg.get_block(n)
+            if b is None:
+                break
+            out.append(b)
+        if len(out) == h:
+            return out
+        if time.monotonic() > deadline:
+            return out
+        time.sleep(0.01)
+
+
+def _assert_same_stream(a, b):
+    assert len(a) == len(b), (len(a), len(b))
+    for x, y in zip(a, b):
+        assert x.header.number == y.header.number
+        assert x.header.previous_hash == y.header.previous_hash
+        assert x.header.data_hash == y.header.data_hash
+        assert list(x.data.data) == list(y.data.data), \
+            f"block {x.header.number} data diverged"
+
+
+class TestClusterConvergence:
+    def test_partition_heal_convergence_exactly_once(self, tmp_path):
+        """3 consenters, every link under seeded drop+dup+reorder
+        chaos, the LEADER partitioned away mid-load and healed: all
+        three nodes converge to byte-identical streams, and after the
+        client reconciliation protocol every accepted envelope is
+        committed exactly once (zero accepted-then-lost)."""
+        faults.clear()
+        tracing.reset()
+        chaos = NetChaos(seed=23)
+        chaos.set_policy(LinkPolicy(drop_rate=0.10, dup_rate=0.08,
+                                    reorder_rate=0.10,
+                                    reorder_window=4))
+        client = bp.make_order_client()
+        net = LocalClusterNetwork()
+        eps = tuple(f"orderer{i}.example.com:{7050 + i}"
+                    for i in range(3))
+        svcs = [bp.make_order_service(
+            str(tmp_path / f"o{i}"), client=client, endpoint=eps[i],
+            endpoints=eps, net=net, block_txs=4,
+            batch_timeout_s=0.1, tick_interval_s=0.01,
+            election_tick=8, transport_wrap=chaos.wrap_cluster)
+            for i in range(3)]
+        try:
+            assert _wait(lambda: any(
+                s.chain.node.state == LEADER for s in svcs)), \
+                "no leader elected under chaos"
+            leader = next(s for s in svcs
+                          if s.chain.node.state == LEADER)
+            envs = [client.envelope(i) for i in range(24)]
+            accepted = set(_pump_accept(leader, envs[:12]))
+
+            # cut the leader away and keep submitting to a survivor
+            tok = chaos.partition([leader.transport.endpoint])
+            survivors = [s for s in svcs if s is not leader]
+            assert _wait(lambda: any(
+                s.chain.node.state == LEADER for s in survivors),
+                timeout=30), "survivors never re-elected"
+            new_leader = next(s for s in survivors
+                              if s.chain.node.state == LEADER)
+            accepted |= set(_pump_accept(new_leader, envs[12:]))
+            chaos.heal(tok)
+
+            # quiesce: all three FULLY-READABLE streams equal length
+            # (height alone can outrun block visibility)
+            def converged():
+                ls = [len(_stream(s)) for s in svcs]
+                return (len(set(ls)) == 1 and ls[0] > 1 and
+                        ls[0] == svcs[0].support.ledger.height)
+            assert _wait(converged, timeout=60), \
+                [s.support.ledger.height for s in svcs]
+
+            def committed_set():
+                return {bytes(d) for b in _stream(svcs[0])[1:]
+                        for d in b.data.data}
+
+            # reconciliation: envelopes acked by the then-leader while
+            # partitioned died with its truncated tail — the client
+            # protocol resubmits anything accepted-but-missing after
+            # quiescence, and nothing may commit twice
+            missing = accepted - committed_set()
+            if missing:
+                todo = [cpb.Envelope.FromString(raw)
+                        for raw in sorted(missing)]
+                cur = next(s for s in svcs
+                           if s.chain.node.state == LEADER)
+                _pump_accept(cur, todo)
+            assert _wait(lambda: committed_set() >= accepted,
+                         timeout=60), "accepted envelopes lost"
+            assert _wait(converged, timeout=60)
+
+            streams = [_stream(s) for s in svcs]
+            _assert_same_stream(streams[0], streams[1])
+            _assert_same_stream(streams[0], streams[2])
+            flat = [bytes(d) for b in streams[0][1:]
+                    for d in b.data.data]
+            assert len(flat) == len(set(flat)), \
+                "an envelope committed more than once"
+            assert set(flat) == accepted
+
+            # failover attribution: leader-change instants recorded
+            changes = [e for e in tracing.snapshot()
+                       if e[0] == "i" and
+                       e[1] == "raft.leader_change"]
+            assert len(changes) >= 4, len(changes)
+            # and the chaos actually injected
+            assert chaos.stats["dropped"] > 0
+            assert chaos.stats["partitioned"] > 0
+        finally:
+            for s in svcs:
+                s.close()
+            chaos.close()
+            faults.reset()
+
+    def test_dup_reorder_parity_vs_chaos_free(self, tmp_path):
+        """Heavy duplicate+reorder chaos on the consensus links of a
+        2-consenter cluster: with deterministic 1-tx blocks the
+        committed stream is BIT-IDENTICAL to a chaos-free run's —
+        chaos changes delivery, never content."""
+        faults.clear()
+        # ONE client and ONE envelope list shared by both runs:
+        # bit-identity needs identical input bytes (keys and nonces
+        # are drawn at envelope creation)
+        client = bp.make_order_client()
+        envs = [client.envelope(i) for i in range(10)]
+
+        def run(tag, wrap):
+            net = LocalClusterNetwork()
+            eps = tuple(f"{tag}{i}.example.com:{7300 + i}"
+                        for i in range(2))
+            svcs = [bp.make_order_service(
+                str(tmp_path / f"{tag}{i}"), client=client,
+                endpoint=eps[i], endpoints=eps, net=net,
+                block_txs=1, batch_timeout_s=0.1,
+                tick_interval_s=0.01, election_tick=8,
+                transport_wrap=wrap) for i in range(2)]
+            try:
+                assert _wait(lambda: any(
+                    s.chain.node.state == LEADER for s in svcs))
+                leader = next(s for s in svcs
+                              if s.chain.node.state == LEADER)
+                for i, env in enumerate(envs):
+                    _pump_accept(leader, [env])
+                    assert _wait(lambda: leader.support.ledger.height
+                                 >= i + 2, timeout=30)
+                target = len(envs) + 1
+                assert _wait(lambda: all(
+                    len(_stream(s)) == target for s in svcs),
+                    timeout=60), \
+                    [s.support.ledger.height for s in svcs]
+                streams = [_stream(s) for s in svcs]
+                _assert_same_stream(streams[0], streams[1])
+                return streams[0]
+            finally:
+                for s in svcs:
+                    s.close()
+
+        chaos = NetChaos(seed=41)
+        chaos.set_policy(LinkPolicy(dup_rate=0.4, reorder_rate=0.4,
+                                    reorder_window=4,
+                                    delay_jitter_s=0.004))
+        try:
+            noisy = run("noisy", chaos.wrap_cluster)
+            assert chaos.stats["duplicated"] > 0
+            assert chaos.stats["reordered"] > 0
+        finally:
+            chaos.close()
+        clean = run("clean", None)
+        _assert_same_stream(noisy, clean)
+        faults.reset()
+
+
+class TestGossipChaos:
+    def test_gossip_send_rides_the_wrapper_and_counts(self):
+        from fabric_tpu.gossip.transport import LocalNetwork
+        from fabric_tpu.protos import gossip as gpb
+
+        faults.clear()
+        net = LocalNetwork()
+        ta = net.register("peer-a:7051")
+        tb = net.register("peer-b:7051")
+        got: list = []
+        tb.set_handler(lambda sender, msg: got.append(sender))
+        chaos = NetChaos(seed=2)
+        wrapped = chaos.wrap_gossip(ta)
+        msg = gpb.SignedGossipMessage()
+        try:
+            chaos.set_policy(LinkPolicy(drop_rate=1.0))
+            wrapped.send("peer-b:7051", msg)
+            time.sleep(0.1)
+            assert got == []
+            assert chaos.stats["dropped"] == 1
+            chaos.clear_policies()
+            chaos.set_policy(LinkPolicy(dup_rate=1.0))
+            wrapped.send("peer-b:7051", msg)
+            assert _wait(lambda: len(got) == 2, timeout=5)
+            assert chaos.stats["duplicated"] == 1
+            assert wrapped.endpoint == "peer-a:7051"
+        finally:
+            chaos.close()
+            ta.close()
+            tb.close()
+            faults.reset()
+
+
+class TestDurableSeamFaults:
+    """ERROR-mode behavior of the two new durable-write fault points:
+    a failing block write is a sticky stage failure (demote + WAL
+    replay, nothing lost), a failing WAL append demotes the window and
+    at worst DROPS a block like a deposed leader would — the service
+    stays live and a retransmitting client completes the stream."""
+
+    def setup_method(self):
+        faults.clear()
+
+    def teardown_method(self):
+        faults.reset()
+
+    def _payload_counts(self, svc):
+        counts: dict = {}
+        for b in _stream(svc)[1:]:
+            for raw in b.data.data:
+                env = pu.unmarshal_envelope(bytes(raw))
+                counts[bytes(pu.get_payload(env).data)] = \
+                    counts.get(bytes(pu.get_payload(env).data), 0) + 1
+        return counts
+
+    def _quiesce(self, svc, settle_s: float = 0.7,
+                 timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        last, since = None, time.monotonic()
+        while time.monotonic() < deadline:
+            h = len(_stream(svc))
+            now = time.monotonic()
+            if h != last:
+                last, since = h, now
+            elif now - since >= settle_s:
+                return
+            time.sleep(0.05)
+
+    def test_block_write_error_demotes_and_heals(self, tmp_path):
+        svc = bp.make_order_service(str(tmp_path / "bw"),
+                                    block_txs=4, batch_timeout_s=0.05,
+                                    tick_interval_s=0.01)
+        try:
+            assert _wait(lambda: svc.chain.node.state == LEADER)
+            faults.arm("order.block_write", mode="error", count=1)
+            envs = [svc.client.envelope(i) for i in range(8)]
+            _pump_accept(svc, envs)
+            want = {f"tx{i}".encode(): 1 for i in range(8)}
+            assert _wait(lambda: self._payload_counts(svc) == want,
+                         timeout=30), self._payload_counts(svc)
+            assert svc.chain._write_stage is None       # demoted
+            assert svc.chain.order_stats["demotions"] >= 1
+            stream = _stream(svc)
+            for i, blk in enumerate(stream):
+                assert blk.header.number == i
+                if i:
+                    assert blk.header.previous_hash == \
+                        pu.block_header_hash(stream[i - 1].header)
+        finally:
+            svc.close()
+
+    def test_wal_append_errors_never_wedge_the_loop(self, tmp_path):
+        """Three consecutive WAL failures: batched propose demotes,
+        a sequential propose may DROP its block (deposed-leader
+        semantics, loudly) — but the loop survives, later traffic
+        orders, and a retransmitting client completes the stream
+        exactly once."""
+        svc = bp.make_order_service(str(tmp_path / "wal"),
+                                    block_txs=4, batch_timeout_s=0.05,
+                                    tick_interval_s=0.01)
+        try:
+            assert _wait(lambda: svc.chain.node.state == LEADER)
+            faults.arm("raft.wal_append", mode="error", count=3)
+            _pump_accept(svc, [svc.client.envelope(i)
+                               for i in range(8)])
+            self._quiesce(svc)
+            assert not faults.armed("raft.wal_append")
+            # retransmit whatever was dropped (fresh envelopes, same
+            # payloads — the client protocol)
+            want = {f"tx{i}".encode() for i in range(8)}
+            missing = sorted(want - set(self._payload_counts(svc)))
+            if missing:
+                redo = [svc.client.envelope(
+                    int(m.decode()[2:])) for m in missing]
+                _pump_accept(svc, redo)
+            assert _wait(lambda: set(self._payload_counts(svc))
+                         == want, timeout=30)
+            counts = self._payload_counts(svc)
+            assert all(v == 1 for v in counts.values()), counts
+            assert svc.chain.order_stats["demotions"] >= 1
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# the crash-point recovery matrix (REAL killed-and-restarted processes)
+# ---------------------------------------------------------------------------
+
+
+def _run_child(mode: str, root: str, fault_spec: str = "",
+               extra_env: dict | None = None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # explicit override: ambient chaos armings (chaos_check subsets)
+    # must not leak into the matrix cells — the cell's spec IS the env
+    env["FTPU_FAULTS"] = fault_spec
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, bp.__file__, "crashchild", mode, root],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=os.path.dirname(bp.__file__))
+    return proc
+
+
+def _child_json(proc):
+    assert proc.returncode == 0, \
+        f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr[-2000:]}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+class TestCrashMatrix:
+    ORDER_ENV = {"CRASH_NTXS": "8", "CRASH_BLOCK_TXS": "4"}
+
+    def _order_cell(self, root, fault_spec):
+        killed = _run_child("order", root, fault_spec,
+                            self.ORDER_ENV)
+        assert killed.returncode == 137, \
+            f"crash fault never fired: rc={killed.returncode}\n" \
+            f"{killed.stderr[-2000:]}"
+        r2 = _child_json(_run_child("order", root, "",
+                                    self.ORDER_ENV))
+        assert r2["payloads_exact_once"], r2
+        assert r2["pumped"] > 0, "restart had nothing left to pump?"
+        r3 = _child_json(_run_child("order", root, "",
+                                    self.ORDER_ENV))
+        # bit-identical replay: reopening replays exactly the durable
+        # stream the previous run left, and pumps nothing
+        assert r3["replay_digests"] == r2["block_digests"]
+        assert r3["block_digests"] == r2["block_digests"]
+        assert r3["pumped"] == 0
+        return r2
+
+    def test_kill_at_wal_append_replays_bit_identical(self, tmp_path):
+        self._order_cell(str(tmp_path / "wal"),
+                         "raft.wal_append=crash:1:2")
+
+    def test_kill_at_block_write_replays_bit_identical(self,
+                                                       tmp_path):
+        r2 = self._order_cell(str(tmp_path / "bw"),
+                              "order.block_write=crash:1:1")
+        # the entry committed in raft but never block-written must
+        # have come back through the WAL replay
+        assert r2["replay_height"] >= 1
+
+    def test_kill_at_onboarding_commit_resumes_durable_prefix(
+            self, tmp_path):
+        root = str(tmp_path / "onb")
+        killed = _run_child("onboard", root,
+                            "onboarding.commit=crash:1:4")
+        assert killed.returncode == 137, killed.stderr[-2000:]
+        r2 = _child_json(_run_child("onboard", root, ""))
+        assert 0 < r2["replay_height"] < r2["height"]
+        assert r2["replay_is_source_prefix"], \
+            "the durable prefix diverged from the source chain"
+        assert r2["matches_source"], \
+            "the resumed replica is not bit-identical to the source"
+
+
+# ---------------------------------------------------------------------------
+# wrapper RPC semantics
+# ---------------------------------------------------------------------------
+
+
+class TestChaosClusterRpc:
+    def test_partitioned_submit_and_pull_shapes(self, tmp_path):
+        """RPCs across a partition produce exactly the unreachable
+        shapes the PR-3 rule fixed: SERVICE_UNAVAILABLE submits and
+        RAISING pulls."""
+        faults.clear()
+        net = LocalClusterNetwork()
+        t1 = net.register("n1:7050")
+        net.register("n2:7051")
+        chaos = NetChaos(seed=1)
+        w = chaos.wrap_cluster(t1)
+        try:
+            chaos.partition(["n2:7051"])
+            resp = w.submit("n2:7051", "ch", b"env")
+            assert resp.status == cpb.Status.SERVICE_UNAVAILABLE
+            with pytest.raises(ConnectionError):
+                w.pull_blocks("n2:7051", "ch", 0, 4)
+        finally:
+            chaos.close()
+            for ep in ("n1:7050", "n2:7051"):
+                net.unregister(ep)
+            faults.reset()

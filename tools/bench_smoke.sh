@@ -130,6 +130,25 @@ if "skipped" not in fp and not fp.get("order_skipped"):
         print("bench_smoke: lifecycle trace", fp["trace_file"],
               "links", sorted(linked))
 
+# round-15 contract: the full_pipeline line carries the bounded
+# leader-kill failover facts (or an explicit skip marker) — fields
+# silently missing from a section that claims to have run is the
+# failure mode this guards
+if "skipped" not in fp and not fp.get("failover_skipped"):
+    assert not fp.get("failover_error"), \
+        f"failover section failed: {fp['failover_error']}"
+    assert fp.get("failover_reelect_s", 0) > 0, \
+        f"full_pipeline lacks failover_reelect_s: {fp}"
+    assert fp.get("failover_committed", 0) > 0, \
+        f"full_pipeline lacks failover_committed: {fp}"
+    assert fp.get("failover_exact_once") is True, \
+        f"failover exactly-once contract not reported green: {fp}"
+    assert fp.get("failover_leader_changes", 0) > 0, fp
+    print("bench_smoke: failover re-elected in",
+          fp["failover_reelect_s"], "s;",
+          fp["failover_committed"], "committed exactly once under",
+          fp.get("failover_chaos_dropped"), "dropped msgs")
+
 # round-14 contract: the core stage measures the tracing overhead
 # A/B on its steady loop and reports the verify tail
 pe = stages.get("provider_e2e") or {}

@@ -12,7 +12,7 @@
 # Spec grammar: point=mode[:count][:delay_s][:arg], mode in
 # {error, delay}; the 4th field targets a check() argument (the
 # per-device points pass the full-mesh chip index).
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|net|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -158,6 +158,32 @@ tracing() {
         tests/test_tracing.py
 }
 
+net() {
+    # the round-15 network-chaos layer under its OWN fault points:
+    # ambient net.* armings ride every NetChaos engine the suite
+    # builds — drops/dups/reorders on live consensus links must
+    # change delivery, never verdicts or convergence (tests that pin
+    # exact schedules clear the ambient arming themselves). The raft/
+    # order/gossip suites run alongside: engine-less tests prove the
+    # armings are inert where no chaos transport exists.
+    run "net.drop=error:4;net.dup=error:2" \
+        tests/test_net_chaos.py tests/test_gossip.py
+    run "net.reorder=error:3:4;net.delay=delay:2:0.02" \
+        tests/test_net_chaos.py -k "Cluster or Parity or Policies or Gossip"
+    run "net.partition=error:1:0.4:orderer0.example.com:7050;raft.step=error:2" \
+        tests/test_net_chaos.py tests/test_raft.py \
+        tests/test_order_pipeline.py
+    # the new durable-seam points in ERROR mode: a failing block
+    # write is a sticky stage failure -> demote + WAL replay, a
+    # failing WAL append demotes / drops a block loudly — never a
+    # wedge. Only the suites written for deposed-leader semantics run
+    # armed (core-internals tests clear the ambient arming; stream-
+    # completeness suites would read a dropped block as a failure).
+    run "raft.wal_append=error:2;order.block_write=error:1" \
+        tests/test_net_chaos.py \
+        -k "DurableSeam or Policies or FaultGrammar or Unreachable or Rpc or Hardening"
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -176,9 +202,10 @@ case "${1:-all}" in
     overload) overload ;;
     mesh-health) mesh_health ;;
     tracing) tracing ;;
+    net) net ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
-         schemes; overload; mesh_health; tracing; static ;;
+         schemes; overload; mesh_health; tracing; net; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

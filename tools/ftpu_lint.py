@@ -109,11 +109,14 @@ REQUIRED_SPANS = {path: tuple(funcs)
                   for path, funcs in REQUIRED_HOT_PATHS.items()}
 for _path, _funcs in {
     # registered pipeline stages: ingress batching, the order window,
-    # the async block-write worker, commit-pipeline stage B
+    # the async block-write worker, commit-pipeline stage B, and the
+    # round-15 network-chaos deferred-delivery worker (its flush
+    # stage is the evidence a chaos soak's delays actually ran)
     "fabric_tpu/comm/services.py": ("broadcast_stream",),
     "fabric_tpu/orderer/raft/chain.py": ("_process_order_window",),
     "fabric_tpu/orderer/raft/pipeline.py": ("_write_loop",),
     "fabric_tpu/core/commitpipeline.py": ("_commit_loop",),
+    "fabric_tpu/common/netchaos.py": ("_pump_loop",),
 }.items():
     REQUIRED_SPANS[_path] = REQUIRED_SPANS.get(_path, ()) + _funcs
 
@@ -122,7 +125,11 @@ _WAIVER_RE = re.compile(
 _WAIVER_KINDS = ("swallow", "fault-point", "host-sync",
                  "unbounded-queue")
 
-_FAULT_METHODS = {"check", "arm", "armed", "disarm", "fires"}
+_FAULT_METHODS = {"check", "arm", "armed", "disarm", "fires",
+                  # round 15: the read/consume accessors netchaos
+                  # drives the net.* points through — a typo'd
+                  # literal there is just as vacuous as one in check()
+                  "arming", "consume"}
 _HOST_SYNC_BUILTINS = {"float", "bool"}
 _NP_NAMES = {"np", "numpy"}
 

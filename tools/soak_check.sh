@@ -21,14 +21,20 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-: "${SOAK_PRODUCERS:=4}"
+# round-15 retune: the round-12 values (4 producers, 0.15s budget,
+# cap 8, 0.05s propose stall) stopped saturating this box — the drain
+# kept up at ~1.05x offered and zero sheds, failing the gate
+# vacuously on an UNCHANGED tree. More producers, a tighter budget, a
+# smaller event queue and a longer armed stall restore a genuine
+# ~1.7x over-capacity shape.
+: "${SOAK_PRODUCERS:=6}"
 : "${SOAK_TXS:=400}"
-: "${SOAK_BUDGET_S:=0.15}"
-: "${SOAK_EVENTS_CAP:=8}"
+: "${SOAK_BUDGET_S:=0.1}"
+: "${SOAK_EVENTS_CAP:=4}"
 : "${SOAK_WALL_S:=600}"
 # chaos armed: propose-path stalls + dropped raft steps, the faults
 # that choke the middle of the pipeline and force admission-edge sheds
-: "${SOAK_FAULTS:=order.propose=delay::0.05;raft.step=error:5}"
+: "${SOAK_FAULTS:=order.propose=delay::0.12;raft.step=error:5}"
 
 echo "== soak_check: sustained over-capacity, FTPU_FAULTS='${SOAK_FAULTS}', lockcheck armed"
 rc=0
@@ -80,4 +86,68 @@ print("soak_check: PASS — "
       f"({r['stage_sheds']}), "
       f"{r['accepted']} accepted all committed bit-identically, "
       f"0 lock violations")
+EOF
+
+# ---------------------------------------------------------------------------
+# Round-15 failover soak (ISSUE 13 acceptance): a 3-consenter cluster
+# with every link under seeded chaos (>=10% drop + duplicates +
+# reorder window >=4 + a partition-and-heal), the LEADER killed
+# crash-equivalently mid-load. The run itself asserts survivor
+# byte-identity, exactly-once after reconciliation, and the oracle
+# replay; this gate re-checks the emitted facts and the bounded
+# re-election claim.
+# ---------------------------------------------------------------------------
+: "${FAILOVER_TXS:=60}"
+: "${FAILOVER_REELECT_BOUND_S:=30}"
+
+echo "== soak_check: leader-kill failover under seeded chaos, lockcheck armed"
+rc=0
+fout=$(timeout -k 10 "${SOAK_WALL_S}" \
+    env JAX_PLATFORMS=cpu FTPU_LOCKCHECK=1 \
+    SOAK_TXS="${FAILOVER_TXS}" \
+    SOAK_REELECT_BOUND_S="${FAILOVER_REELECT_BOUND_S}" \
+    python bench_pipeline.py failover) || rc=$?
+echo "${fout}"
+if [ "${rc}" -ne 0 ]; then
+    echo "soak_check: failover run failed (rc=${rc})" >&2
+    exit "${rc}"
+fi
+
+python - "${fout}" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+
+def check(cond, msg):
+    if not cond:
+        print(f"soak_check FAILED: {msg}: {json.dumps(r)}",
+              file=sys.stderr)
+        sys.exit(1)
+
+check(r["accepted_commit_exact_once"] is True,
+      "accepted envelopes did not commit exactly once across the kill")
+check(r["duplicates"] == 0, "duplicate commits after reconciliation")
+check(r["survivor_streams_identical"] is True,
+      "survivor block streams diverged")
+check(r["oracle_bit_identical"] is True,
+      "committed stream diverged from the sequential oracle")
+check(0 < r["reelect_s"] < r["reelect_bound_s"],
+      "re-election was not inside the bounded window")
+check(r["leader_changes"] >= 4,
+      "leader-change instants missing from the flight recorder")
+check(r["trace_dump"] is not None,
+      "no parseable leader_change auto-dump")
+check(r["chaos_dropped"] > 0 and r["chaos_duplicated"] > 0
+      and r["chaos_reordered"] > 0,
+      "the chaos layer injected nothing — the soak was vacuous")
+check(r["chaos_heals"] >= 1, "the partition never healed")
+check(r["lockcheck_violations"] == 0,
+      "lock-order violations recorded under failover load")
+print("soak_check: PASS — leader killed at "
+      f"{r['killed_leader']}, re-elected in {r['reelect_s']}s; "
+      f"{r['committed']} committed exactly once "
+      f"({r['resubmitted']} reconciled) under "
+      f"{r['chaos_dropped']} drops/{r['chaos_duplicated']} dups/"
+      f"{r['chaos_reordered']} reorders; survivors byte-identical")
 EOF

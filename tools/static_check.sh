@@ -36,6 +36,6 @@ FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
     tests/test_chaos.py tests/test_commit_pipeline.py \
     tests/test_pipeline_overlap.py tests/test_backoff.py \
     tests/test_overload.py tests/test_device_health.py \
-    tests/test_tracing.py
+    tests/test_tracing.py tests/test_net_chaos.py
 
 echo "static_check: all gates green"
