@@ -183,9 +183,13 @@ def _start_watchdog() -> None:
             res["mesh_devices"] = _PARTIAL.get("mesh_devices")
             # round-14 salvage: the verify tail + measured tracing
             # overhead survive a deadline-cut core stage, so the
-            # orchestrator's multichip line still carries them
+            # orchestrator's multichip line still carries them;
+            # round-16 salvage: so do the device-cost facts (a
+            # deadline hit DURING a cold compile is exactly when
+            # compile_s matters)
             for k in ("verify_p50_s", "verify_p99_s",
-                      "tracing_overhead_pct"):
+                      "tracing_overhead_pct", "compile_s",
+                      "compile_cache_hits", "mem_peak_bytes"):
                 if k in _PARTIAL:
                     res[k] = _PARTIAL[k]
         emit_final(res, dict(_PARTIAL))
@@ -233,6 +237,32 @@ def _kill_children() -> None:
             p.kill()
         except OSError:
             pass
+
+
+def _ledger_verdict(candidate: dict) -> str:
+    """tools/perf_ledger.verdict over the round history in this
+    file's directory. Loaded by path (tools/ is not a package);
+    any failure degrades to an 'unavailable:' marker — the ledger
+    must never break the bench's final-line contract."""
+    try:
+        import importlib.util
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "ftpu_perf_ledger",
+            os.path.join(here, "tools", "perf_ledger.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.verdict(candidate, here)
+    except Exception as e:          # noqa: BLE001
+        return f"unavailable:{type(e).__name__}"
+
+
+def _devicecost_mod():
+    """Lazy fabric_tpu.common.devicecost (round 16): jax-free to
+    import, but the orchestrator stays import-light until a stage
+    needs the memory/compile readings."""
+    from fabric_tpu.common import devicecost
+    return devicecost
 
 
 def _have_openssl() -> bool:
@@ -887,6 +917,11 @@ def stage_core():
         prov.prewarm(buckets=(4096, CHUNK), wait_restore=True)
     prewarm_s = time.perf_counter() - t0
     _PARTIAL["prewarm_s"] = round(prewarm_s, 1)
+    # earliest round-16 salvage point: prewarm just paid the compiles
+    _PARTIAL["compile_s"] = round(
+        prov.stats.get("compile_seconds", 0.0), 3)
+    _PARTIAL["compile_cache_hits"] = \
+        prov.stats.get("compile_cache_hits", 0)
 
     # --- workload: NKEYS org keys, `batch` signed messages. With
     # OpenSSL, reuse the persisted bench key set; without it (this
@@ -1013,6 +1048,23 @@ def stage_core():
             (provider_s / provider_off_s - 1.0) * 100.0, 2)
     _PARTIAL.update(trace_fields)
 
+    # --- round-16 device-cost facts: compile seconds / persistent-
+    #     cache hits from the provider's compile seam, and the
+    #     fleet's peak HBM occupancy (0 on backends without
+    #     memory_stats) — refreshed again for the final line after
+    #     the remaining sub-stages compile their shapes ---
+    def devicecost_fields():
+        return {
+            "compile_s": round(
+                prov.stats.get("compile_seconds", 0.0), 3),
+            "compile_cache_hits":
+                prov.stats.get("compile_cache_hits", 0),
+            "mem_peak_bytes": _devicecost_mod().peak_memory_bytes(),
+        }
+
+    dc_fields = devicecost_fields()
+    _PARTIAL.update(dc_fields)
+
     _PARTIAL["provider_verify_batch_sigs_per_s"] = \
         round(batch / provider_s, 1)
     _PARTIAL["value"] = _PARTIAL["provider_verify_batch_sigs_per_s"]
@@ -1025,6 +1077,7 @@ def stage_core():
                 "tracing_off_seconds": (round(provider_off_s, 4)
                                         if provider_off_s else None),
                 **trace_fields,
+                **dc_fields,
                 "overlap_ratio":
                     prov.stats["pipeline_overlap_ratio"],
                 "shard_skew_s": prov.stats["shard_skew_s"]})
@@ -1194,6 +1247,8 @@ def stage_core():
                     "skipped": ed_fields["ed25519_skipped"]})
 
     on_tpu = type(prov)._on_tpu()
+    dc_fields = devicecost_fields()     # refreshed: all shapes built
+    _PARTIAL.update(dc_fields)
     detail = {
         "batch": batch,
         "distinct_keys": NKEYS,
@@ -1233,6 +1288,8 @@ def stage_core():
         "scheme_stats": {k: dict(v)
                          for k, v in prov.scheme_stats.items()},
         "trace_stage_quantiles": tracing.stage_quantiles(),
+        "compile_events": list(prov.device_cost.events),
+        "device_memory": _devicecost_mod().device_memory(),
         "ed25519": dict(ed_fields) or None,
         "devices": [str(d) for d in jax.devices()],
     }
@@ -1264,6 +1321,7 @@ def stage_core():
         "deadline_hit": False,
         "on_tpu": on_tpu,
         **trace_fields,
+        **dc_fields,
         **ed_fields,
     }, detail)
 
@@ -1704,7 +1762,7 @@ def orchestrate():
         n for n, o in stages.items()
         if o and o.get("ok") is False and "skipped" not in o))
     detail = {"stages": stages, "stage_detail": stage_detail}
-    emit_final({
+    agg = {
         "metric": "block-validation sig-verify throughput "
                   f"({BLOCK_TXS}-tx block, 2-of-3 P-256, via "
                   "TPUProvider, staged)",
@@ -1718,12 +1776,23 @@ def orchestrate():
         "tpu_steady_s": best.get("tpu_steady_s"),
         "cpu_ideal_sigs_per_s": best.get("cpu_ideal_sigs_per_s"),
         "tpu_steady_scaling_x": mc.get("tpu_steady_scaling_x"),
+        # round-16 device-cost facts from the winning core stage
+        "compile_s": best.get("compile_s"),
+        "compile_cache_hits": best.get("compile_cache_hits"),
+        "mem_peak_bytes": best.get("mem_peak_bytes"),
         "stages_ok": ok_names or None,
         "stages_failed": bad_names or None,
         "deadline_s": DEADLINE_S or None,
         "deadline_hit": False,
         "on_tpu": best.get("on_tpu"),
-    }, detail)
+    }
+    # round-16 perf ledger: gate this aggregate against the
+    # BENCH_r*/MULTICHIP_r* round history beside this file. One
+    # compact verdict string — 'ok(..)' / 'regressed:<metrics>' /
+    # 'skipped:cpu-rig' / 'no_history' — so the driver (and
+    # bench_smoke) reads the trend without opening the trajectory.
+    agg["ledger"] = _ledger_verdict(agg)
+    emit_final(agg, detail)
 
 
 def main():

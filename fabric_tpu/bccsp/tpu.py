@@ -34,8 +34,10 @@ from fabric_tpu.bccsp import bccsp as api
 from fabric_tpu.bccsp import sw as swmod
 from fabric_tpu.bccsp import utils
 from fabric_tpu.common import breaker as breaker_mod
+from fabric_tpu.common import devicecost
 from fabric_tpu.common import devicehealth as devhealth_mod
 from fabric_tpu.common import faults
+from fabric_tpu.common import jaxenv
 from fabric_tpu.common import lockcheck
 from fabric_tpu.common import tracing
 from fabric_tpu.common.devicehealth import DeviceLostError
@@ -245,6 +247,12 @@ class TPUProvider(api.BCCSP):
                       "device_quarantines": 0,
                       "device_readmits": 0,
                       "device_straggler_strikes": 0,
+                      # round-16 device-cost seam (compile & cache
+                      # telemetry; common/devicecost.py — the
+                      # canonical bccsp_compile_* gauges)
+                      "compile_total": 0, "compile_cache_hits": 0,
+                      "compile_cold_total": 0, "compile_failures": 0,
+                      "compile_seconds": 0.0,
                       "breaker_state": 0, "breaker_trips": 0,
                       "breaker_probes": 0,
                       "breaker_deadline_timeouts": 0,
@@ -276,6 +284,14 @@ class TPUProvider(api.BCCSP):
         # background table-byte writers' publish step, so a concurrent
         # trim can never resurrect a just-reclaimed table file
         self._warm_lock = threading.Lock()
+        # round-16 device-cost recorder: every compiled-path build
+        # rides the _jit seam below; counters mirror into self.stats
+        # (bccsp_compile_* gauges) and per-chip busy time accumulates
+        # for bccsp_device_busy_ratio. cache_dir resolves LAZILY —
+        # the factory enables the persistent cache around provider
+        # construction time
+        self._devicecost = devicecost.CompileRecorder(
+            stats=self.stats, cache_dir=jaxenv.cache_dir)
         # guards ALL q16/q8 cache bookkeeping (_qflat_cache,
         # _qflat_cache_bytes, _q16_heat/_q16_last_use/_q16_denied/
         # _q16_prewarmed/_q16_loading, _q8_cache): the background
@@ -354,12 +370,16 @@ class TPUProvider(api.BCCSP):
         serving mesh is smaller than the fleet —
         'device;degraded_mesh:<k>/<n>' (k healthy of n chips; also
         '1/<requested>' when startup enumeration failed and the node
-        silently serves single-device). Verdicts are identical in
-        every state; only the serving path (and therefore throughput)
-        differs."""
+        silently serves single-device), and the round-16 HBM-headroom
+        sub-state ('...;hbm_low:d<k>:<free>%free') when any chip's
+        free memory drops under FTPU_HBM_HEADROOM_FRAC — an operator
+        sees an oversized span BEFORE it OOMs. Verdicts are identical
+        in every state; only the serving path (and therefore
+        throughput) differs."""
         st = self._breaker.state
-        sub = self._mesh_substate()
-        return f"{st};{sub}" if sub else st
+        parts = [p for p in (self._mesh_substate(),
+                             self._hbm_substate()) if p]
+        return ";".join([st] + parts) if parts else st
 
     def _mesh_substate(self) -> Optional[str]:
         """`degraded_mesh:<k>/<n>` when serving on fewer chips than
@@ -375,6 +395,15 @@ class TPUProvider(api.BCCSP):
             return f"degraded_mesh:{cur}/{full}"
         return None
 
+    def _hbm_substate(self) -> Optional[str]:
+        """`hbm_low:d<k>:<free>%free` when any device's free memory
+        fraction drops under the headroom threshold (devices without
+        memory_stats — CPU meshes — never report), else None."""
+        try:
+            return devicecost.hbm_substate()
+        except Exception:           # noqa: BLE001
+            return None
+
     @property
     def device_stats(self) -> dict:
         """Per-device health rows (one slot per FULL-mesh device),
@@ -385,6 +414,34 @@ class TPUProvider(api.BCCSP):
             return {"state": [], "trips": [], "quarantines": [],
                     "readmits": []}
         return self._devhealth.snapshot()
+
+    @property
+    def device_cost(self) -> devicecost.CompileRecorder:
+        """The round-16 compile/cache/busy recorder — read by
+        profiling.publish_devicecost_stats and the bench's
+        compile_s / mem_peak_bytes stage fields."""
+        return self._devicecost
+
+    def _jit(self, kind: str, fn, **jit_kw):
+        """The ONE compiled-path build seam: every jitted program the
+        provider serves (comb/digest/ladder/table builders, ed25519,
+        pairing, g2msm) is built here, so the `tpu.compile` fault
+        point, the compile-telemetry recorder and the `tpu.compile`
+        tracing spans cover every path by construction. An armed
+        fault (or a broken backend) books a compile_failures count
+        and an error-status span, then propagates to the caller's
+        breaker/fallback exactly as before."""
+        t0 = self._devicecost._clock()
+        try:
+            with tracing.span("tpu.compile", kind=kind, build=True):
+                faults.check("tpu.compile")
+                import jax
+                jitted = jax.jit(fn, **jit_kw)
+        except BaseException as e:
+            self._devicecost.note(kind, self._devicecost._clock() - t0,
+                                  cache_hit=False, error=e)
+            raise
+        return self._devicecost.wrap(kind, jitted)
 
     def _sync_breaker_stats(self) -> None:
         b = self._breaker
@@ -423,6 +480,10 @@ class TPUProvider(api.BCCSP):
             # p50/p99 and the flight recorder's dispatch timeline
             with tracing.span("tpu.verify"):
                 yield
+            # first successful dispatch = steady state: from here a
+            # cold compile is a serving-path latency cliff and the
+            # recorder auto-dumps the timeline around it
+            self._devicecost.mark_steady()
         finally:
             with self._dispatch_cv:
                 self._dispatch_inflight -= 1
@@ -1148,22 +1209,17 @@ class TPUProvider(api.BCCSP):
         key = ("ed25519",)
         with self._jit_lock:
             if key not in self._comb_fns:
-                faults.check("tpu.compile")
-                import jax
-
                 from fabric_tpu.ops import ed25519 as edo
                 fn = edo.verify_core
                 if self._mesh is not None:
                     from jax.sharding import PartitionSpec as P
-
-                    from fabric_tpu.common import jaxenv
                     s = P("batch")
                     rep = P()
                     fn = jaxenv.shard_map(
                         fn, mesh=self._mesh,
                         in_specs=(rep, s, s, s, s, s, s, s),
                         out_specs=s)
-                self._comb_fns[key] = jax.jit(fn)
+                self._comb_fns[key] = self._jit("ed25519", fn)
             return self._comb_fns[key]
 
     def _ed_table(self):
@@ -1438,6 +1494,10 @@ class TPUProvider(api.BCCSP):
         self.stats["pipeline_host_s"] = round(host_s, 6)
         self.stats["pipeline_transfer_s"] = round(transfer_s, 6)
         self.stats["pipeline_device_s"] = round(device_s, 6)
+        if self._mesh is None:
+            # single-chip providers have no per-shard ready probe;
+            # the batch's device stage IS device 0's busy time
+            self._devicecost.busy.note(0, device_s)
         # overlap = the host-prep time that ran INSIDE the device-busy
         # window [first dispatch, results materialized] — measured as
         # interval intersection, not main-thread wait time, because
@@ -2440,6 +2500,10 @@ class TPUProvider(api.BCCSP):
             if ready:
                 tracing.observe_stage(f"device.ready.d{gi}",
                                       ready[pos])
+                # round-16 busy accounting: the same per-chip ready
+                # reading feeds bccsp_device_busy_ratio (device-time
+                # over wall-time, windowed by the stats poller)
+                self._devicecost.busy.note(gi, ready[pos])
         self.stats["shard_devices"] = ndev
         self.stats["shard_skew_s"] = (
             round(max(ready) - min(ready), 6) if ready else 0.0)
@@ -2574,23 +2638,18 @@ class TPUProvider(api.BCCSP):
     def _qtab_fn(self, K: int):
         with self._jit_lock:
             if K not in self._qtab_fns:
-                faults.check("tpu.compile")
-                import jax
-
                 from fabric_tpu.ops import comb
-                self._qtab_fns[K] = jax.jit(comb.build_q_tables)
+                self._qtab_fns[K] = self._jit("qtab",
+                                              comb.build_q_tables)
             return self._qtab_fns[K]
 
     def _q16_fn(self, K: int):
         key = ("q16", K)
         with self._jit_lock:
             if key not in self._qtab_fns:
-                faults.check("tpu.compile")
-                import jax
-
                 from fabric_tpu.ops import comb
-                self._qtab_fns[key] = jax.jit(
-                    comb.build_q16_tables, static_argnums=1)
+                self._qtab_fns[key] = self._jit(
+                    "qtab16", comb.build_q16_tables, static_argnums=1)
             return self._qtab_fns[key]
 
     def _comb_pipeline(self, K: int, q16: bool = False):
@@ -2600,9 +2659,6 @@ class TPUProvider(api.BCCSP):
 
     def _comb_pipeline_locked(self, key, K: int, q16: bool):
         if key not in self._comb_fns:
-            faults.check("tpu.compile")
-            import jax
-
             from fabric_tpu.ops import comb, sha256
 
             # q16=False pipelines run pure 8-bit on BOTH bases: they
@@ -2630,16 +2686,15 @@ class TPUProvider(api.BCCSP):
                 # simply combs its own batch slice against replicated
                 # tables — no collectives in the main path at all
                 from jax.sharding import PartitionSpec as P
-
-                from fabric_tpu.common import jaxenv
                 s = P("batch")
                 rep = P()
-                self._comb_fns[key] = jax.jit(jaxenv.shard_map(
-                    fused, mesh=self._mesh,
-                    in_specs=(s, s, s, rep, rep, s, s, s, s, s, s),
-                    out_specs=s))
+                self._comb_fns[key] = self._jit(
+                    "comb", jaxenv.shard_map(
+                        fused, mesh=self._mesh,
+                        in_specs=(s, s, s, rep, rep, s, s, s, s, s, s),
+                        out_specs=s))
             else:
-                self._comb_fns[key] = jax.jit(fused)
+                self._comb_fns[key] = self._jit("comb", fused)
         return self._comb_fns[key]
 
     def _comb_pipeline_digest(self, K: int, q16: bool,
@@ -2659,9 +2714,6 @@ class TPUProvider(api.BCCSP):
         key = ("digest", K, q16, donate)
         with self._jit_lock:
             if key not in self._comb_fns:
-                faults.check("tpu.compile")
-                import jax
-
                 from fabric_tpu.ops import comb, limb
 
                 # q16=False pipelines run pure 8-bit on BOTH bases:
@@ -2687,23 +2739,20 @@ class TPUProvider(api.BCCSP):
                     jit_kw["donate_argnums"] = (0, 3, 4, 5, 6, 7)
                 if self._mesh is not None:
                     from jax.sharding import PartitionSpec as P
-
-                    from fabric_tpu.common import jaxenv
                     s = P("batch")
                     rep = P()
-                    self._comb_fns[key] = jax.jit(jaxenv.shard_map(
-                        fused, mesh=self._mesh,
-                        in_specs=(s, rep, rep, s, s, s, s, s),
-                        out_specs=s), **jit_kw)
+                    self._comb_fns[key] = self._jit(
+                        "comb_digest", jaxenv.shard_map(
+                            fused, mesh=self._mesh,
+                            in_specs=(s, rep, rep, s, s, s, s, s),
+                            out_specs=s), **jit_kw)
                 else:
-                    self._comb_fns[key] = jax.jit(fused, **jit_kw)
+                    self._comb_fns[key] = self._jit("comb_digest",
+                                                    fused, **jit_kw)
             return self._comb_fns[key]
 
     def _pipeline(self):
         if self._fn is None:
-            faults.check("tpu.compile")
-            import jax
-
             from fabric_tpu.ops import p256, sha256
 
             def fused(blocks, nblocks, qx, qy, r, rpn, w, premask,
@@ -2716,10 +2765,11 @@ class TPUProvider(api.BCCSP):
             if self._mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
                 s = NamedSharding(self._mesh, P("batch"))
-                self._fn = jax.jit(fused, in_shardings=(s,) * 10,
-                                   out_shardings=s)
+                self._fn = self._jit("ladder", fused,
+                                     in_shardings=(s,) * 10,
+                                     out_shardings=s)
             else:
-                self._fn = jax.jit(fused)
+                self._fn = self._jit("ladder", fused)
         return self._fn
 
     def prewarm(self, buckets=(4096, 32768), key_counts=(1, 4),
@@ -2888,8 +2938,6 @@ class TPUProvider(api.BCCSP):
         if len(products) < max(2, self._min_batch // 4):
             return self._pairing_host(products)
         try:
-            import jax
-
             from fabric_tpu.ops import bn254 as bdev
             nterms = len(products[0])
             n = len(products)
@@ -2909,7 +2957,8 @@ class TPUProvider(api.BCCSP):
             staged = bdev.stage_pairing_products(padded)
             key = ("pairing", nterms, bucket)
             if key not in self._qtab_fns:
-                self._qtab_fns[key] = jax.jit(
+                self._qtab_fns[key] = self._jit(
+                    "pairing",
                     lambda xPs, yPs, Qs, Q1s, nQ2s:
                     bdev.pairing_product_is_one(xPs, yPs, Qs, Q1s,
                                                 nQ2s))
@@ -2941,8 +2990,6 @@ class TPUProvider(api.BCCSP):
         if len(lanes) < max(2, self._min_batch // 8):
             return [bref.g2_msm(lane) for lane in lanes]
         try:
-            import jax
-
             from fabric_tpu.ops import bn254 as bdev
             nterms = len(lanes[0])
             n = len(lanes)
@@ -2953,7 +3000,8 @@ class TPUProvider(api.BCCSP):
             bits, q_flat = bdev.stage_g2_msm(list(lanes) + pad)
             key = ("g2msm", nterms, bucket)
             if key not in self._qtab_fns:
-                self._qtab_fns[key] = jax.jit(bdev.g2_msm_scan)
+                self._qtab_fns[key] = self._jit("g2msm",
+                                                bdev.g2_msm_scan)
             import jax.numpy as jnp
             out = self._qtab_fns[key](
                 jnp.asarray(bits), *[jnp.asarray(a) for a in q_flat])

@@ -18,6 +18,15 @@ logger = logging.getLogger("common.jaxenv")
 _ENV = "FABRIC_TPU_XLA_CACHE"
 _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "fabric_tpu_xla")
 _done = False
+_cache_dir: str | None = None
+
+
+def cache_dir() -> str | None:
+    """The enabled persistent-compile-cache directory, or None. The
+    round-16 compile seam (common/devicecost.py) probes this dir's
+    entry count around each compile: a cold compile WRITES an entry,
+    a warm load only reads — the cache-hit-vs-miss signal."""
+    return _cache_dir
 
 
 def enable_compilation_cache(path: str | None = None) -> str | None:
@@ -27,7 +36,7 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     Setting the env var to an empty string disables the cache. Safe to
     call repeatedly; must run before the first jit compilation to help.
     """
-    global _done
+    global _done, _cache_dir
     if _done:
         return None
     cache = path if path is not None else os.environ.get(_ENV, _DEFAULT)
@@ -42,6 +51,7 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
         _done = True
+        _cache_dir = cache
         logger.info("XLA compilation cache at %s", cache)
         return cache
     except Exception:

@@ -209,6 +209,60 @@ BCCSP_DEVICE_READMITS_TOTAL_OPTS = GaugeOpts(
          "bccsp_device_quarantines_total for why the name differs "
          "from the stats key).")
 
+BCCSP_COMPILE_TOTAL_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="compile", name="total",
+    help="XLA programs built through the provider's compile seam "
+         "(common/devicecost.py) since process start: each first "
+         "dispatch of a new argument shape and each AOT prewarm "
+         "compile, whether a cold compile or a persistent-cache "
+         "load.")
+
+BCCSP_COMPILE_CACHE_HITS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="compile", name="cache_hits",
+    help="Persistent-compile-cache hits among bccsp_compile_total "
+         "(classified by cache-dir entry delta plus a wall-time "
+         "threshold). total - cache_hits = cold compiles — the "
+         "minutes-long restart cliff; a cold compile in steady state "
+         "auto-dumps the flight recorder.")
+
+BCCSP_COMPILE_SECONDS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="compile", name="seconds",
+    help="Cumulative wall seconds spent inside the compile seam "
+         "(tracing + XLA compilation or cache load) since process "
+         "start — the device-side cost the bench's compile_s stage "
+         "field and the perf ledger track across rounds.")
+
+BCCSP_DEVICE_MEM_USED_BYTES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="mem_used_bytes",
+    help="Per-device bytes currently allocated (memory_stats "
+         "bytes_in_use), polled by publish_devicecost_stats. Devices "
+         "without the API (CPU meshes) publish nothing.",
+    label_names=("device",))
+
+BCCSP_DEVICE_MEM_PEAK_BYTES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="mem_peak_bytes",
+    help="Per-device peak bytes allocated since process start "
+         "(memory_stats peak_bytes_in_use) — the high-water mark an "
+         "oversized span leaves behind.",
+    label_names=("device",))
+
+BCCSP_DEVICE_MEM_LIMIT_BYTES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="mem_limit_bytes",
+    help="Per-device memory capacity (memory_stats bytes_limit); "
+         "used - limit headroom under FTPU_HBM_HEADROOM_FRAC also "
+         "surfaces as the /healthz components.bccsp hbm_low "
+         "sub-state.",
+    label_names=("device",))
+
+BCCSP_DEVICE_BUSY_RATIO_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="device", name="busy_ratio",
+    help="Per-device device-time over wall-time in the last poll "
+         "window, fed from the same per-chip ready readings as the "
+         "device.ready.d<k> tracing stages — sustained low ratios "
+         "on a big mesh mean the feeder (host prep/transfer), not "
+         "the chips, is the bottleneck.",
+    label_names=("device",))
+
 TRACE_STAGE_SECONDS_OPTS = HistogramOpts(
     namespace="trace", subsystem="stage", name="seconds",
     help="Per-stage latency distributions from the lifecycle-tracing "

@@ -88,21 +88,83 @@ def sample_profile(seconds: float = 5.0, hz: int = SAMPLE_HZ) -> str:
 
 _trace_lock = threading.Lock()
 
+# bounded /debug/jax/trace output: captures beyond this many are
+# pruned oldest-first from the managed parent directory
+JAX_TRACE_KEEP = int(os.environ.get("FTPU_JAX_TRACE_KEEP", "5"))
+
+
+class ProfilerBusyError(RuntimeError):
+    """A jax-trace capture is already running. The JAX profiler
+    supports one live session per process; a second request must be
+    REFUSED immediately (the ops endpoint maps this to 409) — the old
+    behavior parked the second HTTP worker on the lock for the whole
+    capture window."""
+
 
 def capture_jax_trace(out_dir: str, seconds: float = 3.0) -> str:
     """Capture a JAX/xplane profiler trace of device activity for
-    `seconds`; returns the trace directory. Serialized: the JAX
-    profiler supports one live session per process."""
+    `seconds`; returns the trace directory. One live session per
+    process: a concurrent call raises ProfilerBusyError immediately
+    instead of queueing behind the full capture window."""
     import jax
 
-    with _trace_lock:
+    if not _trace_lock.acquire(blocking=False):
+        raise ProfilerBusyError(
+            "a jax trace capture is already running; retry after its "
+            "window ends")
+    try:
         os.makedirs(out_dir, exist_ok=True)
         jax.profiler.start_trace(out_dir)
         try:
             time.sleep(seconds)
         finally:
             jax.profiler.stop_trace()
+    finally:
+        _trace_lock.release()
     return out_dir
+
+
+def capture_jax_trace_bounded(seconds: float = 3.0,
+                              parent_dir: str | None = None,
+                              keep: int | None = None) -> str:
+    """The ops-endpoint capture: a fresh per-capture directory under
+    ONE managed parent, pruned to the newest `keep` captures after
+    each run — /debug/jax/trace used to mkdtemp a new orphan
+    directory per request, growing tmp without bound. Raises
+    ProfilerBusyError like capture_jax_trace."""
+    import tempfile
+
+    parent = parent_dir or os.path.join(tempfile.gettempdir(),
+                                        "ftpu_jax_trace")
+    os.makedirs(parent, exist_ok=True)
+    out = tempfile.mkdtemp(prefix="jax_trace_", dir=parent)
+    try:
+        capture_jax_trace(out, seconds)
+    except ProfilerBusyError:
+        try:
+            os.rmdir(out)           # never leak the unused dir
+        except OSError as e:
+            logger.debug("could not remove unused trace dir %s: %s",
+                         out, e)
+        raise
+    _prune_trace_dirs(parent, JAX_TRACE_KEEP if keep is None
+                      else max(1, int(keep)))
+    return out
+
+
+def _prune_trace_dirs(parent: str, keep: int) -> None:
+    """Delete all but the newest `keep` capture directories under
+    `parent` (best-effort — a prune failure never fails the capture
+    that triggered it)."""
+    import shutil
+
+    try:
+        entries = [e for e in os.scandir(parent) if e.is_dir()]
+    except OSError:
+        return
+    entries.sort(key=lambda e: e.stat().st_mtime, reverse=True)
+    for e in entries[max(1, keep):]:
+        shutil.rmtree(e.path, ignore_errors=True)
 
 
 def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
@@ -141,6 +203,13 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
             metrics_mod.BCCSP_DEVICE_QUARANTINES_TOTAL_OPTS,
         "device_readmits":
             metrics_mod.BCCSP_DEVICE_READMITS_TOTAL_OPTS,
+        # round-16 compile/cache telemetry (common/devicecost.py):
+        # the canonical names operators alert on — cold compiles in
+        # steady state are the minutes-long latency cliff
+        "compile_total": metrics_mod.BCCSP_COMPILE_TOTAL_OPTS,
+        "compile_cache_hits":
+            metrics_mod.BCCSP_COMPILE_CACHE_HITS_OPTS,
+        "compile_seconds": metrics_mod.BCCSP_COMPILE_SECONDS_OPTS,
     }
     gauges = {
         name: metrics_provider.new_gauge(canonical.get(
@@ -420,3 +489,84 @@ def publish_order_stats(metrics_provider, registrar, poll_s: float = 5.0):
                         "failed (suppressing repeats): %s", cid, e)
 
     return _spawn_poller("orderer-batch-stats", poll_s, tick)
+
+
+def publish_devicecost_stats(metrics_provider, csp,
+                             poll_s: float = 5.0):
+    """Expose the round-16 device-cost readings as gauges, refreshed
+    by a daemon poller: per-device memory occupancy
+    (`bccsp_device_mem_{used,peak,limit}_bytes`, from each device's
+    memory_stats — devices without the API publish nothing) and
+    per-device busy ratios (`bccsp_device_busy_ratio`, device-time
+    over wall-time in the poll window, fed by the provider's
+    CompileRecorder.busy accumulator). The compile/cache counters
+    themselves ride publish_provider_stats (they live in the
+    provider's stats dict). Returns the poller thread, or None when
+    the gauges cannot be declared."""
+    tick = devicecost_tick(metrics_provider, csp)
+    if tick is None:
+        return None
+    return _spawn_poller("devicecost-stats", poll_s, tick)
+
+
+def devicecost_tick(metrics_provider, csp):
+    """Build the devicecost gauges and return the refresh callable
+    (None when the gauges cannot be declared) — split from
+    publish_devicecost_stats so tests drive one deterministic tick
+    instead of leaking a fast poller that keeps crossing into the
+    jax runtime for the rest of the session."""
+    from fabric_tpu.common import devicecost as dc
+    from fabric_tpu.common import metrics as metrics_mod
+
+    try:
+        mem_used = metrics_provider.new_gauge(
+            metrics_mod.BCCSP_DEVICE_MEM_USED_BYTES_OPTS)
+        mem_peak = metrics_provider.new_gauge(
+            metrics_mod.BCCSP_DEVICE_MEM_PEAK_BYTES_OPTS)
+        mem_limit = metrics_provider.new_gauge(
+            metrics_mod.BCCSP_DEVICE_MEM_LIMIT_BYTES_OPTS)
+        busy_g = metrics_provider.new_gauge(
+            metrics_mod.BCCSP_DEVICE_BUSY_RATIO_OPTS)
+    except Exception:
+        logger.warning("devicecost gauges unavailable", exc_info=True)
+        return None
+
+    warned: set = set()
+
+    def tick():
+        try:
+            rows = dc.device_memory()
+        except Exception as e:      # noqa: BLE001
+            rows = []
+            if "mem" not in warned:
+                warned.add("mem")
+                logger.warning("device memory probe failed "
+                               "(suppressing repeats): %s", e)
+        for r in rows:
+            try:
+                lbl = ("device", str(r["device"]))
+                mem_used.with_labels(*lbl).set(
+                    float(r["bytes_in_use"]))
+                mem_peak.with_labels(*lbl).set(
+                    float(r["peak_bytes_in_use"]))
+                mem_limit.with_labels(*lbl).set(
+                    float(r["bytes_limit"]))
+            except Exception as e:  # noqa: BLE001
+                if "mem_gauge" not in warned:
+                    warned.add("mem_gauge")
+                    logger.warning("device memory gauge publish "
+                                   "failed (suppressing repeats): "
+                                   "%s", e)
+        rec = getattr(csp, "device_cost", None)
+        if rec is not None:
+            try:
+                for d, ratio in rec.busy.ratios().items():
+                    busy_g.with_labels("device", str(d)).set(
+                        float(ratio))
+            except Exception as e:  # noqa: BLE001
+                if "busy" not in warned:
+                    warned.add("busy")
+                    logger.warning("device busy-ratio publish failed "
+                                   "(suppressing repeats): %s", e)
+
+    return tick

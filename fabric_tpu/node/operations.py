@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import json
 import logging
-import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
@@ -196,8 +195,15 @@ class OperationsServer:
                      "text/plain")
         elif path == "/debug/jax/trace":
             secs = min(60.0, qf("seconds", 3.0))
-            out = tempfile.mkdtemp(prefix="jax_trace_")
-            traced = profiling.capture_jax_trace(out, secs)
+            try:
+                # bounded output (keep-last-N capture dirs under one
+                # managed parent) and an immediate 409 when a capture
+                # is already live — the second request used to park
+                # on the profiler lock for the whole window
+                traced = profiling.capture_jax_trace_bounded(secs)
+            except profiling.ProfilerBusyError as e:
+                h._reply(409, json.dumps({"Error": str(e)}).encode())
+                return
             h._reply(200, json.dumps({"trace_dir": traced}).encode())
         else:
             h._reply(404, b'{"Error":"unknown debug surface"}')

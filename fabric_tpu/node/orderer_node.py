@@ -72,6 +72,9 @@ class OrdererNode:
         # orderer's /metrics too, not just the peer's
         from fabric_tpu.common import profiling
         profiling.publish_provider_stats(provider, csp)
+        # round-16 device-cost gauges: per-chip memory occupancy +
+        # busy ratios beside the compile/cache counters above
+        profiling.publish_devicecost_stats(provider, csp)
         # round-12 overload stages (broadcast ingress, raft event
         # queues, write stages, admission window) as overload_* gauges
         profiling.publish_overload_stats(provider)

@@ -124,6 +124,9 @@ class PeerNode:
         # gauges (bccsp_*) on /metrics
         from fabric_tpu.common import profiling
         profiling.publish_provider_stats(provider, csp)
+        # round-16 device-cost gauges: per-chip memory occupancy +
+        # busy ratios beside the compile/cache counters above
+        profiling.publish_devicecost_stats(provider, csp)
         # round-12 overload stages (commit pipeline, gossip inboxes)
         # as overload_* gauges
         profiling.publish_overload_stats(provider)

@@ -356,6 +356,21 @@ class RaftChain:
     def errored(self) -> bool:
         return self._halted.is_set()
 
+    def force_tick(self) -> None:
+        """Inject one immediate protocol tick through the event queue
+        (round 16). The raft core is tick-driven by design — the
+        protocol's whole clock is `node.tick()` — so a caller that
+        needs retransmission/election timers to advance on ITS
+        cadence (chaos tests healing dropped steps, a loaded box
+        where the wall-clock tick thread starves) enqueues ticks
+        instead of sleeping out wall margins. The tick runs on the
+        loop's own thread like any event (no new locking), rides
+        put_forced (a full queue cannot drop the clock), and a
+        heartbeat-refreshed follower never times out from it — this
+        accelerates the protocol uniformly, exactly like a shorter
+        tick_interval_s."""
+        self._events.put_forced(("tick",))
+
     def order(self, env: common.Envelope, config_seq: int) -> None:
         """Single-envelope Order folds through the SAME batch
         admission window as the bulk path: under load, the ready loop
@@ -509,6 +524,11 @@ class RaftChain:
             except Exception:
                 logger.exception("[%s] raft step failed; message "
                                  "dropped", self._support.channel_id)
+        elif ev[0] == "tick":
+            # a force_tick() injection: one protocol tick on the
+            # loop's own thread, independent of the wall-clock
+            # cadence (see force_tick below)
+            self.node.tick()
 
     def _coalesce_steps(self, evs: list) -> list:
         """Merge superseded CONSECUTIVE step messages from the same

@@ -401,18 +401,30 @@ class TestFaultDemotion:
 
 
 class TestClusterChaos:
-    def _wait(self, cond, timeout: float = 30.0, step: float = 0.02):
+    def _wait(self, cond, timeout: float = 30.0, step: float = 0.02,
+              kick=None):
+        """Poll for `cond`, driving the protocol clock through
+        `kick` between polls (RaftChain.force_tick — the raft core's
+        tick seam) instead of trusting the 20ms wall-clock tick
+        threads to keep pace: on a loaded box those threads starve
+        and wall-sleep margins flake (the PR-12 note this deflakes).
+        The timeout stays as a genuine-stall backstop only."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if cond():
                 return True
+            if kick is not None:
+                kick()
             time.sleep(step)
         return cond()
 
     def test_raft_step_fault_tolerated_across_cluster(self, tmp_path):
         """A 2-consenter service with `raft.step` armed: dropped step
         messages are retransmitted by raft itself — broadcast ingest
-        completes, both nodes converge on bit-identical streams."""
+        completes, both nodes converge on bit-identical streams.
+        Election and retransmission progress is DRIVEN via the tick
+        seam (force_tick), so convergence speed tracks this loop's
+        cadence, not the box's scheduler."""
         from fabric_tpu.orderer.cluster import LocalClusterNetwork
 
         client = bp.make_order_client()
@@ -423,10 +435,15 @@ class TestClusterChaos:
             str(tmp_path / f"o{i}"), client=client, endpoint=eps[i],
             endpoints=eps, net=net, write_pipeline=True, start=True,
             block_txs=4, tick_interval_s=0.02) for i in range(2)]
+
+        def kick():
+            for s in svcs:
+                s.chain.force_tick()
+
         try:
             assert self._wait(lambda: any(
-                s.chain.node.state == LEADER for s in svcs)), \
-                "no leader elected"
+                s.chain.node.state == LEADER for s in svcs),
+                kick=kick), "no leader elected"
             leader = next(s for s in svcs
                           if s.chain.node.state == LEADER)
             faults.arm("raft.step", mode="error", count=3)
@@ -440,13 +457,16 @@ class TestClusterChaos:
                            if r.status == cpb.Status.SUCCESS)
                 assert time.monotonic() < deadline, "broadcast stalled"
                 if pos < len(envs):
-                    time.sleep(0.05)
+                    # heal the armed drops NOW: ticks drive raft's
+                    # retransmission on this loop's cadence
+                    kick()
+                    time.sleep(0.02)
 
             want = [pu.marshal(e) for e in envs]
             assert self._wait(lambda: all(
                 sorted(_env_bytes(_stream(s))) == sorted(want)
-                for s in svcs)), [s.support.ledger.height
-                                  for s in svcs]
+                for s in svcs), kick=kick), \
+                [s.support.ledger.height for s in svcs]
             streams = [_stream(s) for s in svcs]
             _assert_linked(streams[0])
             _assert_same_stream(streams[0], streams[1])

@@ -91,6 +91,13 @@ assert any(s.startswith("core") or s in ("provider_e2e", "kernel_steady")
 
 if final.get("deadline_hit") or any(
         o.get("deadline_hit") or o.get("timeout") for o in stages.values()):
+    # round-16: salvage lines keep the device-cost facts — a deadline
+    # cut AFTER prewarm must still report what the compiles cost
+    for o in stages.values():
+        if o.get("deadline_hit") and "prewarm_s" in (
+                o.get("completed_sections") or []):
+            assert "compile_s" in o, \
+                f"salvage line lost compile_s: {o}"
     print("bench_smoke: a deadline was hit (cold compile?) — "
           "all lines still parseable:", sorted(stages))
     sys.exit(0)
@@ -161,6 +168,30 @@ if pe and "skipped" not in pe and not tracing_off:
         f"provider_e2e lacks verify_p99_s: {pe}"
     print("bench_smoke: tracing overhead",
           pe["tracing_overhead_pct"], "% on the steady verify loop")
+
+# round-16 contract: the core-family stage lines carry the
+# device-cost facts (compile seconds, persistent-cache hits, peak
+# device memory — 0s on backends without memory_stats, but the
+# FIELDS must parse), and the final aggregate carries them plus the
+# perf-ledger verdict string
+for name in ("core", "provider_e2e"):
+    obj = stages.get(name) or {}
+    if not obj or "skipped" in obj:
+        continue
+    for f in ("compile_s", "compile_cache_hits", "mem_peak_bytes"):
+        assert f in obj, f"{name} line lacks device-cost field {f!r}: {obj}"
+        assert isinstance(obj[f], (int, float)), (name, f, obj[f])
+    assert obj["compile_s"] >= 0 and obj["compile_cache_hits"] >= 0, obj
+assert "ledger" in final and isinstance(final["ledger"], str), \
+    f"final aggregate lacks the ledger verdict: {final}"
+assert not final["ledger"].startswith("unavailable"), \
+    f"perf ledger failed to run: {final['ledger']}"
+for f in ("compile_s", "compile_cache_hits", "mem_peak_bytes"):
+    assert f in final, f"final aggregate lacks {f!r}: {final}"
+print("bench_smoke: device-cost fields",
+      {f: final[f] for f in ("compile_s", "compile_cache_hits",
+                             "mem_peak_bytes")},
+      "ledger:", final["ledger"])
 
 # round-11 contract: the core stage's ed25519 regime reports its own
 # throughput line or an explicit skip marker (env opt-out / budget) —

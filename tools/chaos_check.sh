@@ -12,7 +12,7 @@
 # Spec grammar: point=mode[:count][:delay_s][:arg], mode in
 # {error, delay}; the 4th field targets a check() argument (the
 # per-device points pass the full-mesh chip index).
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|net|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|net|devicecost|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -184,6 +184,22 @@ net() {
         -k "DurableSeam or Policies or FaultGrammar or Unreachable or Rpc or Hardening"
 }
 
+devicecost() {
+    # the round-16 device-cost layer under fire: armed tpu.compile
+    # faults must surface as compile_failures counters and
+    # error-status tpu.compile spans (the test suite pins both) while
+    # the breaker/sw-fallback keeps every verdict bit-identical —
+    # a failing compile degrades the serving path, never the answers
+    run "tpu.compile=error:2" \
+        tests/test_devicecost.py tests/test_chaos.py
+    run "tpu.compile=error:1;tpu.dispatch=error:1" \
+        tests/test_devicecost.py \
+        -k "CompileSeam or ProviderJitSeam"
+    run "tpu.compile=delay:1:0.05" \
+        tests/test_devicecost.py tests/test_chaos.py \
+        -k "Degradation or CompileSeam or ProviderJitSeam"
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -203,9 +219,11 @@ case "${1:-all}" in
     mesh-health) mesh_health ;;
     tracing) tracing ;;
     net) net ;;
+    devicecost) devicecost ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
-         schemes; overload; mesh_health; tracing; net; static ;;
+         schemes; overload; mesh_health; tracing; net; devicecost;
+         static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

@@ -117,6 +117,11 @@ for _path, _funcs in {
     "fabric_tpu/orderer/raft/pipeline.py": ("_write_loop",),
     "fabric_tpu/core/commitpipeline.py": ("_commit_loop",),
     "fabric_tpu/common/netchaos.py": ("_pump_loop",),
+    # round-16 compile seam: the shared classification path (every
+    # first-shape dispatch and AOT prewarm compile funnels through
+    # it) must open its `tpu.compile` span — the compile telemetry
+    # and the cold-compile postmortem dumps ride it
+    "fabric_tpu/common/devicecost.py": ("run_compile",),
 }.items():
     REQUIRED_SPANS[_path] = REQUIRED_SPANS.get(_path, ()) + _funcs
 

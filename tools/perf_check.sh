@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Round-16 perf-ledger gate: the BENCH_r*/MULTICHIP_r* round history
+# must parse into a non-empty trajectory (crashed r04 and rc=124 r05
+# REPRESENTED, never fatal), a candidate at the history's best must
+# pass `check`, and a seeded regression must be FLAGGED with a
+# nonzero exit — the machine check that the next driver round cannot
+# silently regress.
+#
+# A real candidate can be gated too: PERF_CANDIDATE=<file> (a bench
+# final-aggregate JSON object, or raw bench stdout whose last JSON
+# line is the aggregate — bench_smoke's tee output works). CPU
+# parity-rig candidates (on_tpu=false) are skipped by the ledger
+# itself; the mechanics above gate on synthesized device-round
+# candidates so this script is green on every host.
+#
+# Standalone: tools/perf_check.sh   (wired beside static_check)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TRAJ="$(mktemp)"
+GOOD="$(mktemp)"
+BAD="$(mktemp)"
+trap 'rm -f "$TRAJ" "$GOOD" "$BAD"' EXIT
+
+echo "== perf_check 1/3: trajectory over the round history"
+python tools/perf_ledger.py --out "$TRAJ"
+python - "$TRAJ" "$GOOD" "$BAD" <<'EOF'
+import json, sys
+
+traj = json.load(open(sys.argv[1]))
+assert traj["rounds"], "empty trajectory"
+assert traj["metrics"], "no metric series extracted from history"
+statuses = {r.get("round"): r.get("status") for r in traj["rounds"]}
+broken = {r["round"] for r in traj.get("broken_rounds", [])}
+# the r04/r05 shapes must be carried as rows, not dropped or fatal
+assert broken, f"no crashed/timeout rounds represented: {statuses}"
+print("perf_check: trajectory", len(traj["rounds"]), "rounds,",
+      len(traj["metrics"]), "metric series; statuses:", statuses)
+
+# synthesize gate candidates from the history itself: one AT the
+# per-metric best (must pass), one 2x worse on every axis (must be
+# flagged) — device-round candidates, so the cpu-rig skip never hides
+# a broken comparator
+good = {"on_tpu": True, "unit": "sigs/s"}
+bad = {"on_tpu": True, "unit": "sigs/s"}
+for name, s in traj["metrics"].items():
+    if s.get("tolerance_mode") == "abs":
+        continue
+    good[name] = s["best"]
+    bad[name] = s["best"] * (0.5 if s["direction"] == "up" else 2.0)
+json.dump(good, open(sys.argv[2], "w"))
+json.dump(bad, open(sys.argv[3], "w"))
+EOF
+
+echo "== perf_check 2/3: best-of-history candidate must pass"
+python tools/perf_ledger.py check --candidate "$GOOD" > /dev/null
+
+echo "== perf_check 3/3: seeded regression must be flagged (rc=1)"
+set +e
+python tools/perf_ledger.py check --candidate "$BAD" > /dev/null
+rc=$?
+set -e
+if [ "$rc" -ne 1 ]; then
+    echo "perf_check: seeded regression not flagged (rc=$rc)" >&2
+    exit 1
+fi
+
+if [ -n "${PERF_CANDIDATE:-}" ]; then
+    echo "== perf_check extra: gating PERF_CANDIDATE=$PERF_CANDIDATE"
+    python tools/perf_ledger.py check --candidate "$PERF_CANDIDATE"
+fi
+
+echo "perf_check: green"

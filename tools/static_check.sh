@@ -15,6 +15,10 @@
 #                                  across a device dispatch /
 #                                  injected-fault stall FAILS the run
 #                                  (tests/conftest.py sessionfinish)
+#   4. tools/perf_check.sh       — round-16 perf ledger: the
+#                                  BENCH_r*/MULTICHIP_r* history must
+#                                  parse into a trajectory and a
+#                                  seeded regression must be flagged
 #
 # Standalone: tools/static_check.sh
 # From the chaos gate: tools/chaos_check.sh static
@@ -24,18 +28,22 @@ cd "$(dirname "$0")/.."
 PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow'
         -p no:cacheprovider -p no:randomly)
 
-echo "== static_check 1/3: ftpu_lint"
+echo "== static_check 1/4: ftpu_lint"
 python tools/ftpu_lint.py
 
-echo "== static_check 2/3: gendoc --check"
+echo "== static_check 2/4: gendoc --check"
 python -m fabric_tpu.common.gendoc --check
 
-echo "== static_check 3/3: lock-order sanitizer (threaded subset)"
+echo "== static_check 3/4: lock-order sanitizer (threaded subset)"
 FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
     tests/test_lockcheck.py tests/test_ftpu_lint.py \
     tests/test_chaos.py tests/test_commit_pipeline.py \
     tests/test_pipeline_overlap.py tests/test_backoff.py \
     tests/test_overload.py tests/test_device_health.py \
-    tests/test_tracing.py tests/test_net_chaos.py
+    tests/test_tracing.py tests/test_net_chaos.py \
+    tests/test_devicecost.py
+
+echo "== static_check 4/4: perf ledger gate"
+./tools/perf_check.sh
 
 echo "static_check: all gates green"
