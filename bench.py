@@ -1501,7 +1501,11 @@ def stage_pipeline():
                   "validate_p50_s", "validate_p99_s",
                   "commit_p50_s", "commit_p99_s",
                   "trace_file", "probe_trace_id",
-                  "trace_linked_stages"):
+                  "trace_linked_stages",
+                  # round-18: cross-node linkage + e2e finality tails
+                  # (e2e_skipped is the explicit didn't-run marker)
+                  "trace_nodes", "e2e_commit_p50_s",
+                  "e2e_commit_p99_s", "e2e_skipped"):
             if orderpipe.get(k) is not None:
                 res[k] = orderpipe[k]
     elif orderpipe and "skipped" in orderpipe:

@@ -673,7 +673,7 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
     import shutil
 
     from fabric_tpu.bccsp import VerifyItem
-    from fabric_tpu.common import tracing
+    from fabric_tpu.common import clustertrace, tracing
     from fabric_tpu.protos import common as cpb
 
     root = tempfile.mkdtemp(prefix="bench_order_")
@@ -684,6 +684,7 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
         # quantiles should describe THIS run, not earlier bench
         # sections sharing the process
         tracing.reset()
+        clustertrace.reset()
         svc = make_order_service(root, csp=csp, block_txs=block_txs,
                                  batch_timeout_s=30.0)
         client = svc.client
@@ -714,6 +715,11 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
                 with tracing.span("ingress.batch",
                                   envelopes=min(window,
                                                 len(run) - pos)) as c:
+                    if c is not None:
+                        # first-ingress birth stamp (round 18): the
+                        # e2e_commit_seconds observation at the
+                        # commit leg measures from here
+                        clustertrace.note_birth(c.trace_id)
                     resps = svc.broadcast.process_messages(
                         run[pos:pos + window])
                 ok = 0
@@ -867,14 +873,28 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
                 return self.commit_validated(block, codes)
 
         chan = _PeerChan()
-        commit_pipe = CommitPipeline(chan, depth=1)
+        # round 18: the commit leg IS the peer node of this rig —
+        # naming it gives the probe trace a second node track (the
+        # orderer's chain loop already records under its endpoint)
+        commit_pipe = CommitPipeline(
+            chan, depth=1, node_id="peer0.example.com:7051")
         t0 = time.perf_counter()
         for i, blk in enumerate(blocks, start=1):
-            # the probe block (number 1) carries the probe context so
-            # its validate/commit spans share the lifecycle trace_id
-            with tracing.attached(
-                    probe_ctx if blk.header.number == 1 else None):
-                commit_pipe.submit(i, block=blk)
+            # every block submits under the carrier the block writer
+            # registered (round 18 — the deliver-feeder shape); the
+            # probe block's carrier descends from the probe ingress
+            # span, so its validate/commit spans keep the lifecycle
+            # trace_id exactly as before
+            carrier = clustertrace.block_carrier(client.channel,
+                                                 blk.header.number)
+            if carrier is None and blk.header.number == 1:
+                with tracing.attached(probe_ctx):
+                    commit_pipe.submit(i, block=blk)
+            else:
+                with clustertrace.resumed(
+                        carrier, link="deliver:orderbench",
+                        node="peer0.example.com:7051"):
+                    commit_pipe.submit(i, block=blk)
         commit_pipe.drain(timeout=600)
         commit_leg_s = time.perf_counter() - t0
         if len(chan.committed) != len(blocks):
@@ -896,8 +916,22 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
                                           path=trace_path)
             except Exception:               # noqa: BLE001
                 trace_file = None
+        nodes: list = []
         if probe_trace_id:
             linked = tracing.trace_stages(probe_trace_id)
+            # round-18 contract: the probe's trace must CROSS nodes —
+            # the orderer's chain-loop track plus the commit leg's
+            # peer track at minimum
+            nodes = tracing.trace_nodes(probe_trace_id)
+            assert len(nodes) >= 2, \
+                f"probe trace stayed on one node: {nodes}"
+
+        # round-18 e2e finality tails (birth -> commit on the peer
+        # leg); an explicit marker when tracing is off or nothing
+        # carried a birth, so the smoke gate can tell "didn't run"
+        # from "lost its fields"
+        e2e_p50 = _stage_tail("e2e.commit", "p50_s")
+        e2e_p99 = _stage_tail("e2e.commit", "p99_s")
 
         stats = svc.chain.order_pipeline_stats()
         win = getattr(svc.support.ingress_csp, "stats", {})
@@ -937,6 +971,12 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
             "trace_file": trace_file,
             "probe_trace_id": probe_trace_id,
             "trace_linked_stages": ",".join(linked) or None,
+            "trace_nodes": ",".join(nodes) or None,
+            **({"e2e_commit_p50_s": e2e_p50,
+                "e2e_commit_p99_s": e2e_p99}
+               if e2e_p50 is not None else
+               {"e2e_skipped": "tracing off or no birth-stamped "
+                               "commits"}),
         }
     finally:
         if commit_pipe is not None:
@@ -949,6 +989,373 @@ def order_pipeline_run(csp=None, ntxs: int = 1024,
                 svc.close(flush=True)
             except Exception:         # noqa: BLE001
                 pass
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def cluster_trace_run(consenters: int = 3, ntxs: int = 24,
+                      block_txs: int = 8, window: int = 12,
+                      slo_target_s: float = 1.0,
+                      deadline_s: float = 120.0) -> dict:
+    """ISSUE 15 acceptance rig: a wheel-free in-process 3-consenter +
+    2-peer run that produces ONE merged Chrome trace in which a single
+    probe transaction's trace_id links ingress -> raft consensus hops
+    -> block write -> gossip/deliver -> commit.validate/commit.commit
+    on BOTH peers.
+
+    Topology: `consenters` raft orderers over one LocalClusterNetwork
+    (wire carriers framed into consensus/submit payloads); peer0 feeds
+    its CommitPipeline from a REAL `common/deliver.DeliverHandler`
+    block stream off the leader; peer1 receives the same blocks over
+    the gossip `LocalNetwork` (a relay reads a FOLLOWER's deliver
+    stream and re-gossips under the resumed carrier). Two
+    OperationsServers front the shared recorder; the merge is pulled
+    over HTTP via `/debug/trace/cluster?trace_id=` (peer fetch + clock
+    alignment + span-id dedup all exercised), and
+    `e2e_commit_seconds`/`hop_seconds` + `components.slo` are read off
+    the REAL /metrics and /healthz surfaces."""
+    import shutil
+    import threading
+    import types
+    import urllib.request
+
+    from fabric_tpu.common import clustertrace, tracing
+    from fabric_tpu.common import metrics as metrics_mod
+    from fabric_tpu.common.deliver import DeliverHandler
+    from fabric_tpu.core.commitpipeline import CommitPipeline
+    from fabric_tpu.core.txvalidator import ValidationResult
+    from fabric_tpu.gossip.transport import LocalNetwork
+    from fabric_tpu.node.operations import OperationsServer
+    from fabric_tpu.orderer.cluster import LocalClusterNetwork
+    from fabric_tpu.peer.deliverclient import seek_envelope
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protos import transaction as txpb
+    from fabric_tpu.protoutil import protoutil as pu
+
+    if not tracing.enabled():
+        return {"skipped": "FTPU_TRACE=0"}
+
+    root = tempfile.mkdtemp(prefix="bench_ctrace_")
+    t_run0 = time.perf_counter()
+    deadline = time.monotonic() + deadline_s
+    eps = [f"orderer{i}.example.com:{7050 + i}"
+           for i in range(consenters)]
+    peer_eps = ["peer0.example.com:7051", "peer1.example.com:7052"]
+    svcs: dict = {}
+    pipes: list = []
+    ops_servers: list = []
+    gossip_net = None
+    try:
+        tracing.reset()
+        clustertrace.reset()
+        provider = metrics_mod.PrometheusProvider()
+        tracing.bind_metrics(provider)   # + e2e/hop histograms
+        clustertrace.configure_slo(slo_target_s)
+
+        net = LocalClusterNetwork()
+        client = make_order_client()
+        for i, ep in enumerate(eps):
+            svcs[ep] = make_order_service(
+                os.path.join(root, f"o{i}"), client=client,
+                endpoint=ep, endpoints=eps, net=net,
+                block_txs=block_txs, batch_timeout_s=0.1,
+                tick_interval_s=0.01, election_tick=8)
+
+        def leader_ep():
+            from fabric_tpu.orderer.raft.core import LEADER
+            for ep, s in svcs.items():
+                if s.chain.node.state == LEADER:
+                    return ep
+            return None
+
+        while leader_ep() is None:
+            if time.monotonic() > deadline:
+                raise RuntimeError("no raft leader")
+            time.sleep(0.005)
+        lead = svcs[leader_ep()]
+
+        # ---- the probe block + steady traffic, birth-stamped ----
+        envs = [client.envelope(i) for i in range(block_txs + ntxs)]
+        probe_envs, rest = envs[:block_txs], envs[block_txs:]
+
+        def pump(run):
+            pos = 0
+            ctx = None
+            while pos < len(run):
+                with tracing.span(
+                        "ingress.batch",
+                        envelopes=min(window, len(run) - pos)) as c:
+                    if c is not None:
+                        clustertrace.note_birth(c.trace_id)
+                        ctx = c
+                    resps = lead.broadcast.process_messages(
+                        run[pos:pos + window])
+                ok = sum(1 for r in resps
+                         if r.status == cpb.Status.SUCCESS)
+                pos += ok
+                if ok == 0:
+                    if time.monotonic() > deadline:
+                        raise RuntimeError("broadcast stalled")
+                    time.sleep(0.02)
+            return ctx
+
+        probe_ctx = pump(probe_envs)
+        probe_trace_id = probe_ctx.trace_id
+        pump(rest)
+
+        # every consenter durably holds every block
+        want_txs = len(envs)
+        while True:
+            heights = [s.support.ledger.height for s in svcs.values()]
+            got = 0
+            if len(set(heights)) == 1 and heights[0] > 1:
+                blks = [lead.support.ledger.get_block(n)
+                        for n in range(1, heights[0])]
+                if all(b is not None for b in blks):
+                    got = sum(len(b.data.data) for b in blks)
+                    if got >= want_txs:
+                        break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"cluster never converged: {heights} ({got}/"
+                    f"{want_txs} txs)")
+            time.sleep(0.02)
+        height = heights[0]
+
+        # ---- the two peers ----
+        class _Validator:
+            def validate_ahead(self, block, known_txids=None):
+                v0 = time.perf_counter()
+                n = len(block.data.data)
+                return ValidationResult(
+                    codes=[txpb.TxValidationCode.VALID] * n,
+                    n_items=n,
+                    duration_s=time.perf_counter() - v0)
+
+            def publish_validation(self, block, result):
+                while len(block.metadata.metadata) <= \
+                        cpb.BlockMetadataIndex.TRANSACTIONS_FILTER:
+                    block.metadata.metadata.append(b"")
+                block.metadata.metadata[
+                    cpb.BlockMetadataIndex.TRANSACTIONS_FILTER] = \
+                    bytes(result.codes)
+
+            def validate(self, block):
+                result = self.validate_ahead(block)
+                self.publish_validation(block, result)
+                return result.codes
+
+        class _BlockStore:
+            @staticmethod
+            def block_tx_ids(block):
+                return [""] * len(block.data.data)
+
+        class _PeerChan:
+            channel_id = client.channel
+
+            def __init__(self):
+                self.ledger = types.SimpleNamespace(
+                    height=1, block_store=_BlockStore())
+                self.validator = _Validator()
+                self.committed: list = []
+
+            def commit_validated(self, block, codes, rwsets=None,
+                                 tx_ids=None):
+                self.committed.append(block.header.number)
+                self.ledger.height = block.header.number + 1
+                return list(codes)
+
+            def process_block(self, block):
+                codes = self.validator.validate(block)
+                return self.commit_validated(block, codes)
+
+        chans = [_PeerChan() for _ in peer_eps]
+        pipes = [CommitPipeline(chan, depth=1, node_id=pep)
+                 for chan, pep in zip(chans, peer_eps)]
+
+        # peer0: the REAL DeliverHandler block stream off the leader
+        deliver = DeliverHandler(
+            lambda cid: lead.support
+            if cid == client.channel else None)
+        seek = seek_envelope(client.channel, 1, client.signer,
+                             stop=height - 1)
+        errors: list = []
+
+        def deliver_feeder():
+            try:
+                for resp in deliver.handle(seek):
+                    if resp.WhichOneof("type") != "block":
+                        break
+                    blk = resp.block
+                    carrier = clustertrace.block_carrier(
+                        client.channel, blk.header.number)
+                    with clustertrace.resumed(
+                            carrier,
+                            link=f"deliver:{lead.transport.endpoint}",
+                            node=peer_eps[0]):
+                        pipes[0].submit(blk.header.number, block=blk)
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(f"deliver feeder: {e}")
+
+        # peer1: blocks re-gossiped over the gossip fabric by a relay
+        # reading a FOLLOWER's deliver stream (carrier captured at the
+        # relay's resumed ambient, re-extracted at peer1's transport
+        # drain)
+        gossip_net = LocalNetwork()
+        relay_t = gossip_net.register("relay.example.com:7060")
+        peer1_t = gossip_net.register(peer_eps[1])
+
+        def on_gossip(sender, raw):
+            # runs on peer1's drain thread UNDER the resumed carrier
+            blk = cpb.Block()
+            blk.ParseFromString(raw)
+            clustertrace.register_block(client.channel,
+                                        blk.header.number)
+            with clustertrace.resumed(
+                    clustertrace.block_carrier(client.channel,
+                                               blk.header.number),
+                    link=f"gossip:{sender}", node=peer_eps[1]):
+                pipes[1].submit(blk.header.number, block=blk)
+
+        peer1_t.set_handler(on_gossip)
+        follower = next(s for ep, s in svcs.items()
+                        if s is not lead)
+        fol_deliver = DeliverHandler(
+            lambda cid: follower.support
+            if cid == client.channel else None)
+
+        def gossip_relay():
+            try:
+                for resp in fol_deliver.handle(seek):
+                    if resp.WhichOneof("type") != "block":
+                        break
+                    blk = resp.block
+                    carrier = clustertrace.block_carrier(
+                        client.channel, blk.header.number)
+                    with clustertrace.resumed(
+                            carrier, link="deliver:follower",
+                            node="relay.example.com:7060"):
+                        relay_t.send(peer_eps[1],
+                                     blk.SerializeToString())
+            except Exception as e:   # noqa: BLE001 — surfaced below
+                errors.append(f"gossip relay: {e}")
+
+        threads = [threading.Thread(target=deliver_feeder,
+                                    name="ctrace-deliver"),
+                   threading.Thread(target=gossip_relay,
+                                    name="ctrace-relay")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=max(5.0, deadline - time.monotonic()))
+        if errors:
+            raise RuntimeError("; ".join(errors))
+        # the gossip leg submits from peer1's ASYNC drain thread:
+        # pipeline.drain() only covers already-submitted blocks, so
+        # wait for every commit to actually land before asserting
+        while not all(len(c.committed) >= height - 1
+                      for c in chans):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"peer commits stalled: "
+                    f"{[len(c.committed) for c in chans]}/"
+                    f"{height - 1}")
+            time.sleep(0.01)
+        for p in pipes:
+            p.drain(timeout=max(5.0, deadline - time.monotonic()))
+        for chan in chans:
+            assert len(chan.committed) == height - 1, \
+                (chan.committed, height)
+
+        # ---- the operations surfaces ----
+        ops_a = OperationsServer(metrics_provider=provider)
+        ops_a.register_checker("slo", clustertrace.slo_health)
+        ops_b = OperationsServer()
+        ops_a.set_trace_peers([ops_b.address])
+        ops_a.start()
+        ops_b.start()
+        ops_servers = [ops_a, ops_b]
+
+        def get_json(addr, path):
+            with urllib.request.urlopen(f"http://{addr}{path}",
+                                        timeout=10) as r:
+                return json.load(r)
+
+        merged = get_json(ops_a.address,
+                          f"/debug/trace/cluster?trace_id="
+                          f"{probe_trace_id}")
+        probe_events = [e for e in merged["traceEvents"]
+                        if e.get("ph") != "M"]
+        assert probe_events, "merged cluster trace is empty"
+        assert all(e["args"]["trace_id"] == probe_trace_id
+                   for e in probe_events), "trace_id filter leaked"
+        stages = {e["name"] for e in probe_events}
+        for want in ("ingress.batch", "hop.recv", "order.write",
+                     "commit.validate", "commit.commit"):
+            assert want in stages, \
+                f"probe trace lacks {want!r}: {sorted(stages)}"
+        nodes = {e["args"].get("node") for e in probe_events} - {None}
+        commit_nodes = {e["args"].get("node") for e in probe_events
+                        if e["name"] == "commit.commit"}
+        assert set(peer_eps) <= commit_nodes, \
+            f"probe did not commit on both peers: {commit_nodes}"
+        hop_nodes = {e["args"].get("node") for e in probe_events
+                     if e["name"] == "hop.recv"} - {None}
+        assert any(n in hop_nodes for n in eps), \
+            f"no consensus hop resumed on a consenter: {hop_nodes}"
+
+        with urllib.request.urlopen(
+                f"http://{ops_a.address}/metrics", timeout=10) as r:
+            metrics_text = r.read().decode()
+        assert "e2e_commit_seconds" in metrics_text, \
+            "e2e_commit_seconds not rendered on /metrics"
+        assert "hop_seconds" in metrics_text, \
+            "hop_seconds not rendered on /metrics"
+        healthz = get_json(ops_a.address, "/healthz")
+        slo_state = (healthz.get("components") or {}).get("slo")
+        assert slo_state is not None, healthz
+
+        pq = _stage_tail
+        return {
+            "consenters": consenters,
+            "peers": len(peer_eps),
+            "ntxs": want_txs,
+            "blocks": height - 1,
+            "probe_trace_id": probe_trace_id,
+            "merged_events": len(probe_events),
+            "trace_nodes": ",".join(sorted(nodes)),
+            "commit_nodes": ",".join(sorted(commit_nodes)),
+            "linked_stages": ",".join(sorted(stages)),
+            "residual_skew_s": merged["ftpu"]["cluster"][
+                "residual_skew_s_observed"],
+            "e2e_commit_p50_s": pq("e2e.commit", "p50_s"),
+            "e2e_commit_p99_s": pq("e2e.commit", "p99_s"),
+            "slo_health": slo_state,
+            "slo_target_s": slo_target_s,
+            "run_s": round(time.perf_counter() - t_run0, 2),
+        }
+    finally:
+        for p in pipes:
+            try:
+                p.stop()
+            except Exception:         # noqa: BLE001
+                pass
+        for s in svcs.values():
+            try:
+                s.close(flush=True)
+            except Exception:         # noqa: BLE001
+                pass
+        if gossip_net is not None:
+            for ep in list(gossip_net.endpoints()):
+                try:
+                    gossip_net._nodes[ep].close()
+                except Exception:     # noqa: BLE001
+                    pass
+        for o in ops_servers:
+            try:
+                o.stop()
+            except Exception:         # noqa: BLE001
+                pass
+        clustertrace.configure_slo(None)
         shutil.rmtree(root, ignore_errors=True)
 
 
@@ -1233,6 +1640,8 @@ def failover_run(consenters: int = 3, producers: int = 2,
     from fabric_tpu.protos import common as cpb
     from fabric_tpu.protoutil.protoutil import marshal as pu_marshal
 
+    from fabric_tpu.common import clustertrace
+
     root = tempfile.mkdtemp(prefix="bench_failover_")
     dump_dir = os.path.join(root, "traces")
     chaos = netchaos.NetChaos(seed=seed)
@@ -1246,6 +1655,11 @@ def failover_run(consenters: int = 3, producers: int = 2,
     t_run0 = time.perf_counter()
     try:
         tracing.reset()
+        # the birth/block-carrier registries are keyed by (channel,
+        # number) on the SHARED default channel: an earlier bench
+        # section's first-wins registrations would otherwise shadow
+        # this one's
+        clustertrace.reset()
         tracing.configure(dump_dir=dump_dir)
         from fabric_tpu.orderer.cluster import LocalClusterNetwork
         net = LocalClusterNetwork()
@@ -1495,6 +1909,22 @@ def failover_run(consenters: int = 3, producers: int = 2,
             1 for e in tracing.snapshot()
             if e[0] == "i" and e[1] == "raft.leader_change")
         assert leader_changes >= consenters + 1, leader_changes
+
+        # round-18 contract: with wire-carrier propagation the
+        # ordering traces CROSS consenters — the leader's windows
+        # must show resumed consensus hops on other nodes' tracks
+        # even under chaos (dup/reorder forward carriers, drops just
+        # lose hops)
+        multi_node_traces = 0
+        if tracing.enabled():
+            trace_node_sets: dict = {}
+            for e in tracing.snapshot():
+                if e[2] is not None and e[10] is not None:
+                    trace_node_sets.setdefault(e[2], set()).add(e[10])
+            multi_node_traces = sum(
+                1 for s in trace_node_sets.values() if len(s) >= 2)
+            assert multi_node_traces > 0, \
+                "no trace crossed a consenter boundary"
         tracing.wait_dumps()
         dump_path = None
         if os.path.isdir(dump_dir):
@@ -1571,6 +2001,7 @@ def failover_run(consenters: int = 3, producers: int = 2,
             "survivor_streams_identical": True,
             "accepted_commit_exact_once": True,
             "oracle_bit_identical": True,
+            "multi_node_traces": multi_node_traces,
             "trace_dump": dump_path,
             "chaos_dropped": chaos.stats["dropped"],
             "chaos_duplicated": chaos.stats["duplicated"],
@@ -1889,9 +2320,12 @@ def commit_pipeline_run(n_blocks: int = 6, ntxs: int = 24) -> dict:
     seq = piped = pipeline = None
     scratch_kv = None
     try:
-        # clean stage reservoirs: this run's validate/commit tails
-        # must describe THIS rig, not earlier bench sections
+        # clean stage reservoirs + carrier registries: this run's
+        # validate/commit tails must describe THIS rig, not earlier
+        # bench sections
         tracing.reset()
+        from fabric_tpu.common import clustertrace
+        clustertrace.reset()
         sw = SWProvider()
         key = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
         pub = key.public_key()
@@ -2089,6 +2523,16 @@ if __name__ == "__main__":
         if san is not None and san.violations():
             print(san.report(), file=sys.stderr)
             sys.exit(3)
+        sys.exit(0)
+
+    if len(sys.argv) > 1 and sys.argv[1] == "clustertrace":
+        # the round-18 cross-node tracing acceptance rig: 3 consenters
+        # + 2 peers, ONE merged Chrome trace over /debug/trace/cluster
+        out = cluster_trace_run(
+            ntxs=int(os.environ.get("CTRACE_TXS", "24")),
+            block_txs=int(os.environ.get("CTRACE_BLOCK_TXS", "8")),
+            slo_target_s=float(os.environ.get("CTRACE_SLO_S", "1.0")))
+        print(json.dumps(out))
         sys.exit(0)
 
     if len(sys.argv) > 1 and sys.argv[1] == "crashchild":

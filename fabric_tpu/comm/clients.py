@@ -85,6 +85,16 @@ class BroadcastClient:
 
     def process_message(self, env: common.Envelope
                         ) -> opb.BroadcastResponse:
+        # round 18: a client submitting under an ambient trace sends
+        # its carrier in metadata so the orderer resumes the SAME
+        # trace (no ambient trace / tracing off = no metadata)
+        from fabric_tpu.common import clustertrace
+        carrier = clustertrace.capture_carrier()
+        if carrier is not None:
+            return self._call(
+                env, timeout=self._timeout,
+                metadata=(("ftpu-trace-carrier",
+                           carrier.to_header()),))
         return self._call(env, timeout=self._timeout)
 
     def process_messages(self, envs) -> list:

@@ -24,6 +24,7 @@ import threading
 from typing import Optional
 
 from fabric_tpu.comm.clients import ClusterClient, channel_to
+from fabric_tpu.common import clustertrace, tracing
 from fabric_tpu.orderer.cluster import ClusterTransport
 from fabric_tpu.protos import common, orderer as opb
 
@@ -126,6 +127,11 @@ class GRPCClusterTransport(ClusterTransport):
                        payload: bytes) -> None:
         import time as _t
         t0 = _t.perf_counter()
+        # round 18: the trace carrier rides INSIDE the consensus
+        # payload frame — it survives real serialization, and the
+        # receiving hub's _drain extracts it (idempotent if a chaos
+        # wrapper already framed)
+        payload = clustertrace.inject(payload)
         try:
             self._client(target).send_consensus(channel, payload)
             self._m_send_time.with_labels(
@@ -139,8 +145,8 @@ class GRPCClusterTransport(ClusterTransport):
     def submit(self, target: str, channel: str, env_bytes: bytes,
                config_seq: int = 0) -> opb.SubmitResponse:
         try:
-            return self._client(target).submit(channel, env_bytes,
-                                               config_seq)
+            return self._client(target).submit(
+                channel, clustertrace.inject(env_bytes), config_seq)
         except Exception as e:
             return opb.SubmitResponse(
                 channel=channel,
@@ -255,6 +261,10 @@ class GRPCClusterTransport(ClusterTransport):
             logger.warning("[%s] cluster inbox full", self.endpoint)
 
     def _drain(self) -> None:
+        # carrier extraction seam (round 18) — mirrors the in-process
+        # LocalClusterTransport: the remote worker resumes the
+        # sender's span tree under this node's id
+        tracing.set_node(self.endpoint)
         while not self._closed.is_set():
             try:
                 sender, channel, payload = self._inbox.get(timeout=0.2)
@@ -263,26 +273,37 @@ class GRPCClusterTransport(ClusterTransport):
             handler = self._handlers.get(channel)
             if handler is None:
                 continue
+            payload, carrier = clustertrace.extract(payload)
             try:
-                handler.on_consensus(sender, payload)
+                with clustertrace.resumed(
+                        carrier, link=f"{sender}>{self.endpoint}",
+                        node=self.endpoint):
+                    handler.on_consensus(sender, payload)
             except Exception:
                 logger.exception("consensus handler failed")
 
     def handle_submit(self, channel: str, env_bytes: bytes,
                       config_seq: int = 0) -> opb.SubmitResponse:
         handler = self._handlers.get(channel)
+        env_bytes, carrier = clustertrace.extract(env_bytes)
         if handler is None:
             return opb.SubmitResponse(
                 channel=channel, status=common.Status.NOT_FOUND,
                 info=f"channel {channel} not served here")
-        return handler.on_submit(env_bytes, config_seq)
+        with clustertrace.resumed(carrier,
+                                  link=f"submit>{self.endpoint}",
+                                  node=self.endpoint):
+            return handler.on_submit(env_bytes, config_seq)
 
-    def handle_pull(self, channel: str, start: int,
-                    end: int) -> list[common.Block]:
+    def handle_pull(self, channel: str, start: int, end: int,
+                    carrier=None) -> list[common.Block]:
         handler = self._handlers.get(channel)
         if handler is None:
             return []
-        return handler.serve_blocks(start, end)
+        with clustertrace.resumed(carrier,
+                                  link=f"pull>{self.endpoint}",
+                                  node=self.endpoint):
+            return handler.serve_blocks(start, end)
 
     def close(self) -> None:
         self._closed.set()

@@ -19,6 +19,7 @@ import grpc
 
 from fabric_tpu.comm import services as svc
 from fabric_tpu.comm.clients import _OPTS
+from fabric_tpu.gossip import transport as _transport
 from fabric_tpu.gossip.transport import Transport
 from fabric_tpu.protos import gossip as gpb
 
@@ -69,13 +70,25 @@ class GRPCGossipTransport(Transport):
                 self._calls[endpoint] = call
             return call
 
-    def send(self, endpoint: str, msg: gpb.SignedGossipMessage) -> None:
+    def send(self, endpoint: str, msg: gpb.SignedGossipMessage,
+             carrier=_transport._CAPTURE) -> None:
         if self._closed:
             return
         try:
+            from fabric_tpu.common import clustertrace
+            # the base-class sentinel, NOT None: a chaos wrapper that
+            # captured no ambient at send time passes carrier=None,
+            # and re-capturing here (on its scheduler thread) would
+            # re-parent the deferred message onto a foreign trace
+            if carrier is _transport._CAPTURE:
+                carrier = clustertrace.capture_carrier()
+            md = [("sender-endpoint", self.endpoint)]
+            if carrier is not None:
+                # round 18: the wire spelling of the trace carrier on
+                # the gossip fabric (services.register_gossip resumes)
+                md.append(("ftpu-trace-carrier", carrier.to_header()))
             call = self._call_for(endpoint)
-            call.future(msg, timeout=5,
-                        metadata=(("sender-endpoint", self.endpoint),))
+            call.future(msg, timeout=5, metadata=tuple(md))
         except Exception:
             # gossip is loss-tolerant; a dead peer is discovery's
             # problem, not the sender's

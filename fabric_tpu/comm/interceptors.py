@@ -95,11 +95,18 @@ class ConcurrencyLimiter(grpc.ServerInterceptor):
     reference's untyped error (which gRPC maps to UNKNOWN).
     """
 
-    def __init__(self, limits: dict[str, int]):
+    def __init__(self, limits: dict[str, int], metrics_provider=None):
         self._limits = {svc: n for svc, n in limits.items()
                         if n and n > 0}
         self._sems = {svc: threading.BoundedSemaphore(n)
                       for svc, n in self._limits.items()}
+        # round 18: rejections are shed work — count them canonically
+        # (rpc_rejects_total, beside overload_sheds_total) and leave
+        # an rpc.reject instant in the flight recorder, or an
+        # overloaded edge is invisible to the trace layer
+        self._m_rejects = (metrics_provider or
+                           _m.DisabledProvider()).new_counter(
+            _m.RPC_REJECTS_TOTAL_OPTS)
         for svc, n in self._limits.items():
             logger.info("concurrency limit for %s is %d", svc, n)
 
@@ -107,7 +114,7 @@ class ConcurrencyLimiter(grpc.ServerInterceptor):
         handler = continuation(handler_call_details)
         if handler is None:
             return None
-        service, _ = _split_method(handler_call_details.method)
+        service, method = _split_method(handler_call_details.method)
         sema = self._sems.get(service)
         if sema is None:
             return handler
@@ -117,6 +124,11 @@ class ConcurrencyLimiter(grpc.ServerInterceptor):
             logger.error(
                 "Too many requests for %s, exceeding concurrency "
                 "limit (%d)", service, limit)
+            from fabric_tpu.common import tracing
+            tracing.instant("rpc.reject", service=service,
+                            method=method, limit=limit)
+            self._m_rejects.with_labels("service", service,
+                                        "method", method).add(1)
             context.abort(
                 grpc.StatusCode.RESOURCE_EXHAUSTED,
                 f"too many requests for {service}, exceeding "

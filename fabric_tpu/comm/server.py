@@ -56,8 +56,9 @@ class GRPCServer:
         )
         interceptors = [ServerObservability(config.metrics_provider)]
         if config.concurrency_limits:
-            interceptors.append(
-                ConcurrencyLimiter(config.concurrency_limits))
+            interceptors.append(ConcurrencyLimiter(
+                config.concurrency_limits,
+                metrics_provider=config.metrics_provider))
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=config.max_workers),
             options=opts,

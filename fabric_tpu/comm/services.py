@@ -19,7 +19,7 @@ import time
 from fabric_tpu.comm.server import (
     GRPCServer, STREAM_STREAM, UNARY_STREAM, UNARY_UNARY,
 )
-from fabric_tpu.common import tracing
+from fabric_tpu.common import clustertrace, tracing
 from fabric_tpu.protos import common, gateway as gwpb, gossip as gpb
 from fabric_tpu.protos import orderer as opb, proposal as ppb
 
@@ -218,7 +218,13 @@ def broadcast_stream(request_iterator, broadcast_handler,
                 # inflate the ingress.batch duration or stamp bogus
                 # error spans — the span measures handler time only
                 with tracing.span("ingress.batch",
-                                  envelopes=len(run)):
+                                  envelopes=len(run)) as ictx:
+                    if ictx is not None:
+                        # FIRST ingress stamps the trace's birth wall
+                        # time (round 18): e2e_commit_seconds on every
+                        # committing peer measures from here, and the
+                        # wire carrier transports it across nodes
+                        clustertrace.note_birth(ictx.trace_id)
                     if run_dl is not None:
                         with run_dl.applied():
                             resps = list(
@@ -250,10 +256,19 @@ def register_broadcast(server: GRPCServer, broadcast_handler) -> None:
         yield from broadcast_stream(request_iterator,
                                     broadcast_handler)
 
+    def handle_unary(env, ctx):
+        # the broadcast CLIENT path (round 18): a gateway/CLI client
+        # submitting under its own trace sends the carrier in call
+        # metadata — resume it so the orderer-side lifecycle joins
+        # the client's trace instead of opening a fresh one
+        carrier = clustertrace.Carrier.from_header(
+            dict(ctx.invocation_metadata()).get("ftpu-trace-carrier"))
+        with clustertrace.resumed(carrier, link="broadcast:client"):
+            return broadcast_handler.process_message(env)
+
     server.add_service(BROADCAST_SERVICE, {
         "Broadcast": (
-            UNARY_UNARY,
-            lambda env, ctx: broadcast_handler.process_message(env),
+            UNARY_UNARY, handle_unary,
             common.Envelope, opb.BroadcastResponse),
         "BroadcastStream": (
             STREAM_STREAM, handle_stream,
@@ -325,9 +340,14 @@ def register_gossip(server: GRPCServer, on_message) -> None:
     Transport handler. The sender's endpoint rides in metadata (the
     reference binds it via the mTLS handshake + ConnEstablish)."""
     def send(smsg: gpb.SignedGossipMessage, ctx):
-        sender = dict(ctx.invocation_metadata()).get("sender-endpoint",
-                                                     "")
-        on_message(sender, smsg)
+        md = dict(ctx.invocation_metadata())
+        sender = md.get("sender-endpoint", "")
+        # gossip gRPC carrier (round 18): same metadata channel as
+        # the sender identity; absent/corrupt -> fresh trace
+        carrier = clustertrace.Carrier.from_header(
+            md.get("ftpu-trace-carrier"))
+        with clustertrace.resumed(carrier, link=f"gossip:{sender}"):
+            on_message(sender, smsg)
         return gpb.Empty()
     server.add_service(GOSSIP_SERVICE, {
         "Send": (UNARY_UNARY, send,

@@ -1,0 +1,731 @@
+"""Cross-node distributed tracing: wire carriers, cluster merge, SLOs.
+
+Round 18. The round-14 lifecycle traces stop at process boundaries: a
+probe transaction's trace_id links ingress -> order -> commit only
+INSIDE one node, while the consensus hops, gossip dissemination and
+deliver streams that dominate multi-node latency open orphan traces on
+the remote side. The committee-consensus measurement paper
+(arXiv:2302.00418) attributes consensus cost per hop to make it
+optimizable, and ACE Runtime (arXiv:2603.10242) frames user-visible
+FINALITY — not per-stage throughput — as the SLO; this module supplies
+both, in three pieces:
+
+**Wire carrier** — a compact frame (magic + length + json of
+trace_id / parent span_id / birth wall-stamp / send wall-stamp)
+injected at every cross-node seam and extracted on the remote side:
+
+  * `inject(payload)` prepends the frame to an opaque byte payload
+    (consensus messages, forwarded submit envelopes) when the sender
+    has an ambient trace; IDEMPOTENT — an already-framed payload is
+    returned untouched, which is exactly how the NetChaos wrappers
+    forward carriers on dup/reorder without re-parenting (the chaos
+    wrapper frames EAGERLY at send time; the deferred delivery on the
+    scheduler thread must not re-frame under that thread's foreign
+    ambient context).
+  * `extract(payload)` ALWAYS strips a frame (a receiver with tracing
+    disabled must still parse the payload) and never raises: absent
+    or corrupt carrier -> `(payload, None)` -> a fresh local trace.
+  * `capture_carrier()` / `resumed(carrier, link=, node=)` are the
+    side-band spelling for seams that hand off objects rather than
+    bytes (the in-process gossip fabric, block pulls); resumed()
+    re-attaches the REMOTE context so the worker's spans join the
+    sender's trace under the worker's own node_id, records a
+    `hop.recv` span parented to the sender's span, and observes the
+    send->receive latency on `hop_seconds{link=}` (negative readings
+    — receiver clock behind sender — are clamped for the histogram
+    but kept RAW in the span args as skew evidence for the merger).
+
+**Cluster aggregation** — every Chrome-trace export carries a
+monotonic<->wall clock anchor in its `ftpu` header (tracing.py);
+`merge_docs` aligns N per-node documents onto one wall timeline,
+re-tids events as `node/stage` tracks, dedups by span id (two ops
+endpoints of one in-process rig export the same ring), filters by
+trace_id, and REPORTS residual skew (anchor offsets + any negative
+hop readings) instead of hiding it. `/debug/trace/cluster`
+(node/operations.py) pulls `/debug/trace` from configured peer ops
+endpoints and serves the merge; `merge_files` does the same over
+flight-recorder dump files.
+
+**SLO layer** — envelopes get a BIRTH wall-stamp at first ingress
+(`note_birth`, keyed by trace_id, first stamp wins — re-relays and
+carrier-forwarded re-deliveries keep one identity because the carrier
+itself transports the birth); each peer commit observes
+birth->committed on the `e2e_commit_seconds{node=}` histogram
+(`note_commit`) and feeds a rolling error-budget tracker: with target
+`Operations.SLO.CommitP99S` (env FTPU_SLO_COMMIT_P99_S), 1% of
+observations may exceed the target (a p99 SLO); the burn rate is the
+observed violation fraction over that budget. `/healthz` surfaces
+`components.slo` as `ok` | `burning:<rate>`, and a SUSTAINED burn
+auto-dumps the flight recorder once per episode (rate-limited) — the
+same trigger discipline as `breaker.trip`.
+
+Blocks travel by value, not by reference: `register_block(channel,
+number)` pins the writing node's carrier per block (block bytes must
+stay bit-identical across replay, so the carrier never rides INSIDE
+the block) and `block_carrier(channel, number)` recovers it at the
+gossip/deliver commit seams.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import os
+import struct
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from fabric_tpu.common import tracing
+
+logger = logging.getLogger("common.clustertrace")
+
+from fabric_tpu.common import metrics as _m  # noqa: E402
+
+# wire frame: MAGIC + u32 big-endian json length + json + payload
+MAGIC = b"FTRC1\x00"
+_LEN = struct.Struct(">I")
+_MAX_CARRIER = 4096            # sanity bound: a "length" past this is
+#                                not a frame, it is payload bytes that
+#                                happened to start with the magic
+
+# p99 SLO: 1% of observations may exceed the target
+SLO_ERROR_BUDGET = 0.01
+SLO_WINDOW = 256               # rolling e2e observations judged
+SLO_MIN_OBS = 20               # don't judge a burn on thin evidence
+
+_REGISTRY_CAP = 4096           # birth/block registries (drop-oldest)
+
+# sentinel default for side-band carrier parameters: "capture the
+# ambient carrier HERE". Distinct from None ("the sender already
+# looked and found nothing") so a wrapper that defers delivery can
+# forward its send-time capture — even a None one — without the inner
+# transport re-capturing on the scheduler thread's foreign ambient.
+CAPTURE_AMBIENT = object()
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+class Carrier:
+    """One hop's wire identity: the trace, the sending span (the
+    remote parent), the envelope's first-ingress birth wall-stamp and
+    the send wall-stamp (hop latency is measured at extraction)."""
+
+    __slots__ = ("trace_id", "span_id", "birth", "sent")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 birth: Optional[float] = None,
+                 sent: Optional[float] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.birth = birth
+        self.sent = sent
+
+    def __repr__(self) -> str:
+        return f"Carrier({self.trace_id}/{self.span_id})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, Carrier) and
+                other.trace_id == self.trace_id and
+                other.span_id == self.span_id and
+                other.birth == self.birth and other.sent == self.sent)
+
+    def to_json(self) -> bytes:
+        doc = {"t": self.trace_id, "s": self.span_id}
+        if self.birth is not None:
+            doc["b"] = self.birth
+        if self.sent is not None:
+            doc["w"] = self.sent
+        return json.dumps(doc, separators=(",", ":")).encode("utf-8")
+
+    @classmethod
+    def from_json(cls, raw: bytes) -> Optional["Carrier"]:
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            t, s = doc["t"], doc["s"]
+            if not isinstance(t, str) or not isinstance(s, str):
+                return None
+            return cls(t, s, doc.get("b"), doc.get("w"))
+        except Exception:           # corrupt carrier -> fresh trace
+            return None
+
+    # gRPC metadata spelling (the broadcast client path / gossip gRPC)
+    def to_header(self) -> str:
+        return base64.b64encode(self.to_json()).decode("ascii")
+
+    @classmethod
+    def from_header(cls, value: Optional[str]) -> Optional["Carrier"]:
+        if not value:
+            return None
+        try:
+            return cls.from_json(base64.b64decode(value))
+        except Exception:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# module state: registries, histograms, the SLO tracker
+# ---------------------------------------------------------------------------
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.births: dict = {}          # trace_id -> birth wall time
+        self.birth_order: list = []     # insertion order (drop-oldest)
+        self.blocks: dict = {}          # (channel, number) -> Carrier
+        self.block_order: list = []
+        self.hop_hist = None            # hop_seconds{link=}
+        self.hop_children: dict = {}
+        self.e2e_hist = None            # e2e_commit_seconds{node=}
+        self.e2e_children: dict = {}
+
+
+_state = _State()
+
+
+def reset() -> None:
+    """Test isolation: drop registries and the SLO window (bound
+    histograms survive — binding is process wiring, not run state)."""
+    with _state.lock:
+        _state.births.clear()
+        del _state.birth_order[:]
+        _state.blocks.clear()
+        del _state.block_order[:]
+    _slo.reset()
+
+
+def bind_metrics(provider) -> None:
+    """Create the canonical cross-node histograms on `provider`
+    (called from tracing.bind_metrics so both node assemblies wire it
+    with one call)."""
+    try:
+        with _state.lock:
+            _state.hop_hist = provider.new_histogram(
+                _m.HOP_SECONDS_OPTS)
+            _state.hop_children = {}
+            _state.e2e_hist = provider.new_histogram(
+                _m.E2E_COMMIT_SECONDS_OPTS)
+            _state.e2e_children = {}
+    except Exception:
+        logger.warning("cluster-trace histogram bind failed",
+                       exc_info=True)
+
+
+def _observe_labeled(hist_attr: str, child_attr: str, label: str,
+                     value: str, seconds: float) -> None:
+    with _state.lock:
+        hist = getattr(_state, hist_attr)
+        if hist is None:
+            return
+        children = getattr(_state, child_attr)
+        child = children.get(value)
+        if child is None:
+            try:
+                child = children[value] = hist.with_labels(label,
+                                                           value)
+            except Exception:
+                logger.warning("histogram child bind failed",
+                               exc_info=True)
+                children[value] = child = None
+    if child is not None:
+        try:
+            child.observe(seconds)
+        except Exception:
+            logger.warning("histogram observe failed", exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# birth + block registries
+# ---------------------------------------------------------------------------
+
+def note_birth(trace_id: Optional[str],
+               birth: Optional[float] = None) -> Optional[float]:
+    """Stamp a trace's FIRST-ingress wall time (idempotent: the first
+    stamp wins, so a carrier-forwarded re-delivery or a gossip
+    re-relay keeps one identity). Returns the effective birth."""
+    if trace_id is None or not tracing.enabled():
+        return None
+    if birth is None:
+        birth = time.time()
+    with _state.lock:
+        got = _state.births.get(trace_id)
+        if got is not None:
+            return got
+        _state.births[trace_id] = birth
+        _state.birth_order.append(trace_id)
+        if len(_state.birth_order) > _REGISTRY_CAP:
+            drop = _state.birth_order[:len(_state.birth_order) // 2]
+            del _state.birth_order[:len(drop)]
+            for t in drop:
+                _state.births.pop(t, None)
+    return birth
+
+
+def birth_of(trace_id: Optional[str]) -> Optional[float]:
+    if trace_id is None:
+        return None
+    with _state.lock:
+        return _state.births.get(trace_id)
+
+
+def register_block(channel: str, number: int,
+                   carrier: Optional[Carrier] = None) -> None:
+    """Pin the carrier for one written/received block so the
+    gossip/deliver commit seams can resume its trace. Default carrier
+    = the calling thread's ambient context + its trace's birth. First
+    registration wins (a re-relay must not re-parent)."""
+    if not tracing.enabled():
+        return
+    if carrier is None:
+        carrier = capture_carrier()
+    if carrier is None:
+        return
+    key = (channel, int(number))
+    with _state.lock:
+        if key in _state.blocks:
+            return
+        _state.blocks[key] = carrier
+        _state.block_order.append(key)
+        if len(_state.block_order) > _REGISTRY_CAP:
+            drop = _state.block_order[:len(_state.block_order) // 2]
+            del _state.block_order[:len(drop)]
+            for k in drop:
+                _state.blocks.pop(k, None)
+
+
+def block_carrier(channel: str, number: int) -> Optional[Carrier]:
+    if not tracing.enabled():
+        return None
+    with _state.lock:
+        return _state.blocks.get((channel, int(number)))
+
+
+# ---------------------------------------------------------------------------
+# inject / extract / resume
+# ---------------------------------------------------------------------------
+
+def capture_carrier() -> Optional[Carrier]:
+    """The calling thread's ambient trace as a wire carrier (None
+    outside any span or with tracing disabled) — captured EAGERLY at
+    the send site, before any deferred/wrapped delivery."""
+    if not tracing.enabled():
+        return None
+    ctx = tracing.capture()
+    if ctx is None:
+        return None
+    return Carrier(ctx.trace_id, ctx.span_id,
+                   birth=birth_of(ctx.trace_id), sent=time.time())
+
+
+def inject(payload: bytes) -> bytes:
+    """Frame `payload` with the ambient carrier. No ambient trace (or
+    tracing disabled) -> the payload object returned UNCHANGED (the
+    zero-allocation no-op path); already framed -> unchanged
+    (idempotence = no re-parenting on dup/reorder/wrapped sends)."""
+    if not tracing.enabled():
+        return payload
+    if payload.startswith(MAGIC):
+        return payload
+    carrier = capture_carrier()
+    if carrier is None:
+        return payload
+    body = carrier.to_json()
+    return MAGIC + _LEN.pack(len(body)) + body + payload
+
+
+def extract(payload: bytes) -> tuple[bytes, Optional[Carrier]]:
+    """Strip a carrier frame (ALWAYS — a tracing-disabled receiver
+    must still parse the payload). Never raises: absent or corrupt
+    carrier -> (payload, None), a fresh local trace downstream."""
+    if not payload.startswith(MAGIC):
+        return payload, None
+    head = len(MAGIC) + _LEN.size
+    if len(payload) < head:
+        return payload, None
+    (n,) = _LEN.unpack(payload[len(MAGIC):head])
+    if n > _MAX_CARRIER or len(payload) < head + n:
+        # not a plausible frame: treat the whole thing as payload
+        return payload, None
+    if not tracing.enabled():
+        # strip, but skip the decode: a tracing-off receiver pays
+        # for the slice only, and resume stays a no-op
+        return payload[head + n:], None
+    carrier = Carrier.from_json(payload[head:head + n])
+    return payload[head + n:], carrier
+
+
+class _NoopResume:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_RESUME = _NoopResume()
+
+
+class _Resumed:
+    """Extraction-side context: re-attach the remote trace under this
+    worker's node id, parent the local subtree to the sender's span
+    (exactly ONE parent — the carrier's span_id — however many copies
+    a duplicating link delivered), record the `hop.recv` span and
+    observe `hop_seconds{link=}`."""
+
+    __slots__ = ("_carrier", "_link", "_node", "_attach",
+                 "_prior_node")
+
+    def __init__(self, carrier: Carrier, link: Optional[str],
+                 node: Optional[str]):
+        self._carrier = carrier
+        self._link = link
+        self._node = node
+        self._attach = None
+
+    def __enter__(self):
+        c = self._carrier
+        if self._node is not None:
+            self._prior_node = tracing.bound_node()
+            tracing.set_node(self._node)
+        else:
+            self._prior_node = None
+        # propagate the birth only when the carrier ACTUALLY has one:
+        # defaulting to receive time here would fabricate a birth for
+        # traces that never crossed an ingress edge and record falsely
+        # small finality numbers into the e2e histogram / SLO budget
+        if c.birth is not None:
+            note_birth(c.trace_id, c.birth)
+        remote = tracing.TraceContext(c.trace_id, c.span_id)
+        link = self._link or "unknown"
+        pc1 = time.perf_counter()
+        if c.sent is not None:
+            raw_hop = time.time() - c.sent
+        else:
+            raw_hop = 0.0
+        hop = max(0.0, raw_hop)
+        # the hop span: parented to the REMOTE sending span; raw
+        # (possibly negative — clock skew) latency kept in args as
+        # the merger's skew evidence
+        ctx = tracing.observe_span(
+            "hop.recv", pc1 - hop, pc1, parent=remote, link=link,
+            raw_hop_s=round(raw_hop, 6)) or remote
+        _observe_labeled("hop_hist", "hop_children", "link", link,
+                         hop)
+        tracing.observe_stage(f"hop.{link}", hop)
+        self._attach = tracing.attached(ctx)
+        self._attach.__enter__()
+        return ctx
+
+    def __exit__(self, *exc):
+        if self._attach is not None:
+            self._attach.__exit__(*exc)
+        if self._node is not None:
+            tracing.set_node(self._prior_node)
+        return False
+
+
+def resumed(carrier: Optional[Carrier], link: Optional[str] = None,
+            node: Optional[str] = None):
+    """`with resumed(carrier, link="a>b", node="b"):` around the
+    remote half of a cross-node handoff. None carrier (or tracing
+    disabled) -> shared no-op: the handler runs exactly as before,
+    opening a fresh trace if it opens anything at all."""
+    if carrier is None or not tracing.enabled():
+        return _NOOP_RESUME
+    return _Resumed(carrier, link, node)
+
+
+# ---------------------------------------------------------------------------
+# e2e commit latency + the SLO error budget
+# ---------------------------------------------------------------------------
+
+class SLOTracker:
+    """Rolling error-budget tracker for the commit-latency SLO.
+
+    p99 semantics: with target T, at most `SLO_ERROR_BUDGET` (1%) of
+    e2e observations may exceed T. `burn_rate` = observed violation
+    fraction / budget over the last `SLO_WINDOW` observations — 1.0
+    means the budget is being consumed exactly as fast as it accrues;
+    above that the SLO is burning. A sustained burn (rate >= 1 with
+    at least `SLO_MIN_OBS` observations in the window) surfaces as
+    `burning:<rate>` on /healthz and auto-dumps the flight recorder
+    ONCE per episode (plus tracing's own dump rate limit) — the same
+    trigger discipline as `breaker.trip`."""
+
+    def __init__(self, target_p99_s: Optional[float] = None):
+        self._lock = threading.Lock()
+        self.target_p99_s = target_p99_s
+        self._ring = [False] * SLO_WINDOW    # True = over target
+        self._idx = 0
+        self._count = 0
+        self._burning = False       # episode latch for the auto-dump
+        self.stats = {"observed": 0, "over_target": 0, "dumps": 0}
+
+    def configure(self, target_p99_s: Optional[float]) -> None:
+        with self._lock:
+            self.target_p99_s = target_p99_s \
+                if target_p99_s and target_p99_s > 0 else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring = [False] * SLO_WINDOW
+            self._idx = 0
+            self._count = 0
+            self._burning = False
+            self.stats = {"observed": 0, "over_target": 0,
+                          "dumps": 0}
+
+    def observe(self, e2e_s: float) -> None:
+        dump = False
+        with self._lock:
+            if self.target_p99_s is None:
+                return
+            over = e2e_s > self.target_p99_s
+            self._ring[self._idx % SLO_WINDOW] = over
+            self._idx += 1
+            self._count = min(self._count + 1, SLO_WINDOW)
+            self.stats["observed"] += 1
+            if over:
+                self.stats["over_target"] += 1
+            rate = self._burn_rate_locked()
+            if rate >= 1.0 and self._count >= SLO_MIN_OBS:
+                if not self._burning:
+                    self._burning = True
+                    self.stats["dumps"] += 1
+                    dump = True
+            else:
+                self._burning = False
+        if dump:
+            tracing.instant("slo.burn",
+                            target_s=self.target_p99_s,
+                            burn_rate=round(rate, 2))
+            tracing.auto_dump("slo_burn")
+
+    def _burn_rate_locked(self) -> float:
+        if self._count == 0:
+            return 0.0
+        over = sum(1 for i in range(self._count)
+                   if self._ring[i])
+        frac = over / self._count
+        return frac / SLO_ERROR_BUDGET
+
+    def burn_rate(self) -> float:
+        with self._lock:
+            return self._burn_rate_locked()
+
+    def health(self) -> str:
+        """The /healthz `components.slo` sub-state: `ok` |
+        `burning:<rate>` (degraded-but-serving — never a failed
+        check; an SLO without a configured target reads `ok`)."""
+        with self._lock:
+            if self.target_p99_s is None or \
+                    self._count < SLO_MIN_OBS:
+                return "ok"
+            rate = self._burn_rate_locked()
+        if rate >= 1.0:
+            return f"burning:{rate:.1f}"
+        return "ok"
+
+
+_slo = SLOTracker(
+    _env_float("FTPU_SLO_COMMIT_P99_S", 0.0) or None)
+
+
+def slo() -> SLOTracker:
+    return _slo
+
+
+def slo_health() -> str:
+    return _slo.health()
+
+
+def configure_slo(target_p99_s: Optional[float]) -> None:
+    _slo.configure(target_p99_s)
+
+
+def configure_from_config(cfg) -> None:
+    """Node-assembly entry: `Operations.SLO.CommitP99S` (seconds; the
+    env FTPU_SLO_COMMIT_P99_S survives when the key is absent)."""
+    try:
+        t = cfg.get("Operations.SLO.CommitP99S")
+    except Exception:
+        t = None
+    if t is not None:
+        try:
+            configure_slo(float(t))
+        except (TypeError, ValueError):
+            logger.warning("Operations.SLO.CommitP99S=%r unparsable",
+                           t)
+
+
+def note_commit(ctx, node: Optional[str] = None) -> Optional[float]:
+    """One block/transaction durably committed under trace context
+    (or trace_id) `ctx` on `node`: observe birth->now on
+    `e2e_commit_seconds{node=}`, the `e2e.commit` stage reservoir and
+    the SLO tracker. No recorded birth (tracing off at ingress, or a
+    trace that never crossed an ingress edge) -> None, no
+    observation."""
+    if ctx is None or not tracing.enabled():
+        return None
+    trace_id = getattr(ctx, "trace_id", ctx)
+    birth = birth_of(trace_id)
+    if birth is None:
+        return None
+    e2e = max(0.0, time.time() - birth)
+    label = node or tracing.current_node() or "local"
+    _observe_labeled("e2e_hist", "e2e_children", "node", label, e2e)
+    tracing.observe_stage("e2e.commit", e2e)
+    _slo.observe(e2e)
+    return e2e
+
+
+# ---------------------------------------------------------------------------
+# cluster aggregation: merge per-node Chrome traces onto one timeline
+# ---------------------------------------------------------------------------
+
+def _doc_epoch(doc: dict) -> Optional[float]:
+    try:
+        return float(doc["ftpu"]["clock"]["epoch_wall_s"])
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+def merge_docs(docs: list, trace_id: Optional[str] = None,
+               errors: Optional[list] = None) -> dict:
+    """N per-node Chrome-trace documents -> ONE. Events are aligned
+    onto a common wall timeline via each doc's clock anchor (docs
+    without an anchor keep their own timeline and are flagged),
+    re-tid'd as `node/stage` tracks, deduplicated by span id (shared
+    rings exported twice, re-pulled dumps), optionally filtered to
+    one trace_id, and sorted by aligned ts (ordering preserved under
+    deliberate skew — alignment uses the anchors, not arrival order).
+    Residual skew is REPORTED in the `ftpu.cluster` header: per-node
+    anchor offsets plus the worst negative hop reading (a receive
+    stamped before its send is direct clock-skew evidence)."""
+    errors = errors if errors is not None else []
+    epochs = [e for e in (_doc_epoch(d) for d in docs)
+              if e is not None]
+    base = min(epochs) if epochs else 0.0
+    pid_seq = 0
+    tids: dict = {}
+    out = []
+    seen: set = set()
+    nodes: dict = {}
+    neg_hop = 0.0
+    for doc in docs:
+        pid_seq += 1
+        epoch = _doc_epoch(doc)
+        shift_us = 0.0 if epoch is None else (epoch - base) * 1e6
+        doc_node = (doc.get("ftpu") or {}).get("node_id")
+        for ev in doc.get("traceEvents", ()):
+            if ev.get("ph") == "M":
+                continue
+            args = ev.get("args") or {}
+            if trace_id is not None and \
+                    args.get("trace_id") != trace_id:
+                continue
+            span_id = args.get("span_id")
+            if span_id is not None:
+                if span_id in seen:
+                    continue        # same ring exported twice
+                seen.add(span_id)
+            node = args.get("node") or doc_node or f"n{pid_seq}"
+            nodes.setdefault(node, {
+                "epoch_wall_s": epoch,
+                "shift_us": round(shift_us, 1),
+                "anchored": epoch is not None})
+            raw_hop = args.get("raw_hop_s")
+            if isinstance(raw_hop, (int, float)) and raw_hop < 0:
+                neg_hop = max(neg_hop, -raw_hop)
+            group = ev.get("cat") or \
+                str(ev.get("name", "")).split(".", 1)[0]
+            tid = tids.setdefault((node, group), len(tids) + 1)
+            rec = dict(ev)
+            rec["pid"] = 1
+            rec["tid"] = tid
+            rec["ts"] = round(float(ev.get("ts", 0.0)) + shift_us, 1)
+            out.append(rec)
+    out.sort(key=lambda r: r["ts"])
+    meta = [{"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "fabric-tpu-cluster"}}]
+    for (node, group), tid in sorted(tids.items(),
+                                     key=lambda kv: kv[1]):
+        meta.append({"ph": "M", "name": "thread_name", "pid": 1,
+                     "tid": tid,
+                     "args": {"name": f"{node}/{group}"}})
+    unanchored = sorted(n for n, info in nodes.items()
+                        if not info["anchored"])
+    if unanchored:
+        errors.append(f"no clock anchor from: {unanchored} — their "
+                      f"events keep an unaligned timeline")
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": meta + out,
+        "ftpu": {
+            "reason": "cluster_merge",
+            "trace_id": trace_id,
+            "cluster": {
+                "docs": len(docs),
+                "nodes": nodes,
+                # residual skew: the alignment uses per-node wall
+                # anchors, so whatever their wall clocks disagree by
+                # REMAINS in the merged timeline — the negative-hop
+                # bound is the part we can actually observe
+                "residual_skew_s_observed": round(neg_hop, 6),
+                "errors": errors,
+            },
+        },
+    }
+
+
+def merge_files(paths: list, trace_id: Optional[str] = None) -> dict:
+    """Merge flight-recorder dump FILES (the offline spelling of the
+    cluster endpoint). Unreadable files are reported in the header's
+    errors list, never fatal."""
+    docs = []
+    errors: list = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                docs.append(json.load(f))
+        except Exception as e:      # noqa: BLE001 — report, keep merging
+            errors.append(f"{p}: {type(e).__name__}: {e}")
+    return merge_docs(docs, trace_id=trace_id, errors=errors)
+
+
+def fetch_peer_trace(address: str, trace_id: Optional[str] = None,
+                     timeout_s: float = 3.0) -> dict:
+    """GET one peer ops endpoint's /debug/trace (forwarding the
+    trace_id filter so one probe's spans don't ship the whole ring)."""
+    url = f"http://{address}/debug/trace"
+    if trace_id:
+        url += f"?trace_id={urllib.parse.quote(trace_id)}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.load(resp)
+
+
+def cluster_trace(peers, trace_id: Optional[str] = None,
+                  timeout_s: float = 3.0) -> dict:
+    """The /debug/trace/cluster body: this process's recorder merged
+    with every configured peer's /debug/trace. Peer fetch failures
+    are reported in the merge header, never fatal — a partitioned
+    peer must not take the debugging surface down with it."""
+    docs = [tracing.chrome_trace(trace_id=trace_id)]
+    errors: list = []
+    for peer in peers or ():
+        try:
+            docs.append(fetch_peer_trace(peer, trace_id=trace_id,
+                                         timeout_s=timeout_s))
+        except Exception as e:      # noqa: BLE001 — report, keep merging
+            errors.append(f"{peer}: {type(e).__name__}: {e}")
+    return merge_docs(docs, trace_id=trace_id, errors=errors)

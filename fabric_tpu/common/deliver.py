@@ -9,10 +9,12 @@ blocks under BLOCK_UNTIL_READY.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Iterator, Optional
 
 from fabric_tpu.protos import common, orderer as ordpb
 from fabric_tpu.protoutil import protoutil as pu
+from fabric_tpu.common import clustertrace, tracing
 from fabric_tpu.common.policies import policy as papi
 
 logger = logging.getLogger("deliver")
@@ -95,6 +97,27 @@ class DeliverHandler:
             for resp in self._handle(env, parsed):
                 if resp.WhichOneof("type") == "block":
                     sent.add(1)
+                    # round-18 carrier seam: blocks travel by VALUE
+                    # (their bytes must stay bit-identical across
+                    # replay, so no carrier rides inside them) — the
+                    # serving side marks each streamed block's trace
+                    # with a `deliver.block` span under the carrier
+                    # the writer registered; the consuming side
+                    # (peer/deliverclient.py, gossip/state.py)
+                    # resumes the same registry entry at commit.
+                    # tracing off = one attr read, nothing else.
+                    if tracing.enabled():
+                        carrier = clustertrace.block_carrier(
+                            channel, resp.block.header.number)
+                        if carrier is not None:
+                            now = time.perf_counter()
+                            tracing.observe_span(
+                                "deliver.block", now, now,
+                                parent=tracing.TraceContext(
+                                    carrier.trace_id,
+                                    carrier.span_id),
+                                block=resp.block.header.number,
+                                channel=channel)
                 else:
                     self.metrics.requests_completed.with_labels(
                         "channel", channel, "status",

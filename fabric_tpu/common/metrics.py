@@ -425,6 +425,45 @@ DELIVER_RECONNECTS_OPTS = CounterOpts(
          "(full-jitter backoff between attempts).",
     label_names=("channel",))
 
+E2E_COMMIT_SECONDS_OPTS = HistogramOpts(
+    namespace="e2e", subsystem="commit", name="seconds",
+    help="End-to-end commit latency: first-ingress birth stamp to "
+         "durable commit on the labeled node (the user-visible "
+         "finality number — common/clustertrace.py observes it at "
+         "every commit-pipeline/gossip-state commit where the "
+         "block's trace carrier is known). Birth rides the wire "
+         "carrier, so re-relays and carrier-forwarded re-deliveries "
+         "keep one identity; the rolling SLO error budget "
+         "(Operations.SLO.CommitP99S -> /healthz components.slo) is "
+         "fed from the same observations.",
+    label_names=("node",),
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+             10, 30, 60))
+
+HOP_SECONDS_OPTS = HistogramOpts(
+    namespace="hop", name="seconds",
+    help="Per-hop network latency observed at carrier EXTRACTION "
+         "(send wall-stamp to receive), labeled by link (consensus "
+         "`src>dst`, `deliver:<endpoint>`, `gossip:<src>`, "
+         "`broadcast:client`). Cross-node readings include wall-"
+         "clock skew: negative raws are clamped to 0 here but kept "
+         "in the hop.recv span args as the cluster merger's "
+         "residual-skew evidence.",
+    label_names=("link",),
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5))
+
+RPC_REJECTS_TOTAL_OPTS = CounterOpts(
+    namespace="rpc", name="rejects_total",
+    help="RPCs rejected at the gRPC edge by the per-service "
+         "concurrency limiter (comm/interceptors.py "
+         "ConcurrencyLimiter, RESOURCE_EXHAUSTED): shed work that "
+         "never reached a pipeline queue, counted beside "
+         "overload_sheds_total so the overload picture includes the "
+         "transport edge; each rejection also leaves an `rpc.reject` "
+         "instant in the flight recorder.",
+    label_names=("service", "method"))
+
 
 class Counter:
     def __init__(self, opts: CounterOpts):

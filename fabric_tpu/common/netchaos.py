@@ -62,7 +62,7 @@ import zlib
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from fabric_tpu.common import faults, tracing
+from fabric_tpu.common import clustertrace, faults, tracing
 
 logger = logging.getLogger("common.netchaos")
 
@@ -589,6 +589,14 @@ class ChaosClusterTransport(_ChaosWrapper):
     def send_consensus(self, target: str, channel: str,
                        payload: bytes) -> None:
         inner = self._inner
+        # frame the trace carrier EAGERLY, at send time (round 18):
+        # delayed/reordered/duplicated copies deliver on the chaos
+        # scheduler thread, whose ambient context is not the
+        # sender's — injecting there would re-parent (or orphan) the
+        # hop. inject() is idempotent, so the inner transport's own
+        # injection leaves this frame untouched and every duplicate
+        # carries the SAME parent span.
+        payload = clustertrace.inject(payload)
         self.chaos.send(
             inner.endpoint, target,
             lambda: inner.send_consensus(target, channel, payload))
@@ -619,7 +627,14 @@ class ChaosGossipTransport(_ChaosWrapper):
     pressure on the anti-entropy machinery — and every one is counted
     (`net_chaos_*`, beside the inbox's gossip_comm_overflow_count)."""
 
-    def send(self, endpoint: str, msg) -> None:
+    def send(self, endpoint: str, msg,
+             carrier=clustertrace.CAPTURE_AMBIENT) -> None:
         inner = self._inner
-        self.chaos.send(inner.endpoint, endpoint,
-                        lambda: inner.send(endpoint, msg))
+        if carrier is clustertrace.CAPTURE_AMBIENT:
+            # capture at SEND time (see ChaosClusterTransport): the
+            # deferred delivery must forward the sender's carrier —
+            # even a None one — not the scheduler thread's ambient
+            carrier = clustertrace.capture_carrier()
+        self.chaos.send(
+            inner.endpoint, endpoint,
+            lambda: inner.send(endpoint, msg, carrier=carrier))

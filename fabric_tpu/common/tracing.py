@@ -144,6 +144,38 @@ class _State:
 _state = _State()
 _tls = threading.local()
 
+# node attribution (round 18): which LOGICAL node recorded an event.
+# One process is normally one node (`FTPU_NODE_ID` / set_default_node
+# at assembly), but the in-process multi-node rigs bind a node id per
+# WORKER THREAD (cluster/gossip drain loops, the raft chain loop,
+# commit-pipeline workers) so one shared ring still renders
+# `node/stage` tracks per logical node.
+_default_node: Optional[str] = os.environ.get("FTPU_NODE_ID") or None
+
+
+def set_default_node(node: Optional[str]) -> None:
+    """Process-level node identity (config/env; None clears)."""
+    global _default_node
+    _default_node = node or None
+
+
+def set_node(node: Optional[str]) -> None:
+    """Bind the CALLING THREAD to a logical node id (None unbinds —
+    events fall back to the process default). Worker threads of the
+    in-process multi-node rigs call this once at loop start."""
+    _tls.node = node or None
+
+
+def current_node() -> Optional[str]:
+    n = getattr(_tls, "node", None)
+    return n if n is not None else _default_node
+
+
+def bound_node() -> Optional[str]:
+    """The raw THREAD binding (no default fallback) — what a scoped
+    rebind must save/restore."""
+    return getattr(_tls, "node", None)
+
 
 # ---------------------------------------------------------------------------
 # configuration
@@ -207,6 +239,12 @@ def configure_from_config(cfg, metrics_provider=None) -> None:
         ring_size=ring or None,
         sample_every=sample or None,
         dump_dir=cfg.get("Operations.Tracing.DumpDir"))
+    # node identity for cross-node trace attribution (round 18):
+    # config key only when PRESENT — the FTPU_NODE_ID env (or an
+    # assembly's explicit set_default_node) survives otherwise
+    node = cfg.get("Operations.Tracing.NodeID")
+    if node:
+        set_default_node(str(node))
     if metrics_provider is not None:
         bind_metrics(metrics_provider)
 
@@ -222,6 +260,15 @@ def bind_metrics(provider) -> None:
             metrics_mod.TRACE_STAGE_SECONDS_OPTS)
     except Exception:
         logger.warning("trace_stage_seconds histogram unavailable",
+                       exc_info=True)
+    # round 18: the cross-node layer's e2e_commit_seconds/hop_seconds
+    # histograms bind off the same provider (lazy import — the
+    # cluster-trace module imports this one)
+    try:
+        from fabric_tpu.common import clustertrace
+        clustertrace.bind_metrics(provider)
+    except Exception:
+        logger.warning("cluster-trace histograms unavailable",
                        exc_info=True)
 
 
@@ -436,12 +483,16 @@ def observe_stage(stage: str, seconds: float) -> None:
 # ---------------------------------------------------------------------------
 
 def _record(ev: tuple) -> None:
+    # the 11th field is the recording thread's logical node (round 18)
+    node = getattr(_tls, "node", None)
+    if node is None:
+        node = _default_node
     st = _state
     with st.ring_lock:
         ring = st.ring
         i = st.ring_idx
         st.ring_idx = i + 1
-        ring[i % len(ring)] = ev
+        ring[i % len(ring)] = ev + (node,)
 
 
 class _StageLat:
@@ -611,28 +662,55 @@ def trace_stages(trace_id: str) -> list:
     return sorted({e[1] for e in snapshot() if e[2] == trace_id})
 
 
+def trace_nodes(trace_id: str) -> list:
+    """The distinct logical nodes that recorded events under one
+    trace_id, sorted (round 18: the cross-node rigs assert a probe
+    transaction's trace really crossed node boundaries with this)."""
+    return sorted({e[10] for e in snapshot()
+                   if e[2] == trace_id and e[10] is not None})
+
+
 def _fmt_attr(v):
     return v if isinstance(v, (bool, int, float, str)) or v is None \
         else str(v)
 
 
-def chrome_trace() -> dict:
+def clock_anchor() -> dict:
+    """One (monotonic, wall) clock pair plus the derived wall time of
+    trace ts=0 — stamped into every export header so the cluster
+    merger (common/clustertrace.py) can align per-node Chrome-trace
+    timelines onto one wall axis and REPORT residual skew instead of
+    hiding it."""
+    pc = time.perf_counter()
+    wall = time.time()
+    return {"perf_counter": pc, "wall": wall,
+            "epoch_wall_s": wall - (pc - _PC0)}
+
+
+def chrome_trace(trace_id: Optional[str] = None) -> dict:
     """The recorder as a Chrome-trace-event document
     (chrome://tracing / perfetto loadable). tid = pipeline stage
-    (the first dotted segment of the span name), so the five
-    overlapped stages render as parallel tracks; per-span correlation
-    ids + attrs ride in `args`. Attrs were stored raw — THIS is where
-    they are formatted."""
+    (the first dotted segment of the span name) — or `node/stage`
+    when the event's recording thread carried a node binding (the
+    cross-node view, round 18) — so the overlapped stages render as
+    parallel tracks; per-span correlation ids + attrs ride in `args`.
+    `trace_id` filters to one transaction's spans (the `?trace_id=`
+    surface: pulling one probe must not ship the whole ring). Attrs
+    were stored raw — THIS is where they are formatted."""
     pid = os.getpid()
     tids: dict = {}
     out = []
-    for ph, name, tr, sp, par, t0, dur, tname, attrs, err in \
+    for ph, name, tr, sp, par, t0, dur, tname, attrs, err, node in \
             snapshot():
+        if trace_id is not None and tr != trace_id:
+            continue
         group = name.split(".", 1)[0]
-        tid = tids.setdefault(group, len(tids) + 1)
+        tid = tids.setdefault((node, group), len(tids) + 1)
         args = {"trace_id": tr, "span_id": sp, "thread": tname}
         if par is not None:
             args["parent_span_id"] = par
+        if node is not None:
+            args["node"] = node
         if attrs:
             for k, v in attrs.items():
                 args[k] = _fmt_attr(v)
@@ -648,10 +726,17 @@ def chrome_trace() -> dict:
         out.append(rec)
     meta = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
              "args": {"name": "fabric-tpu"}}]
-    for group, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+    for (node, group), tid in sorted(tids.items(),
+                                     key=lambda kv: kv[1]):
+        label = f"{node}/{group}" if node is not None \
+            else f"stage:{group}"
         meta.append({"ph": "M", "name": "thread_name", "pid": pid,
-                     "tid": tid, "args": {"name": f"stage:{group}"}})
-    return {"displayTimeUnit": "ms", "traceEvents": meta + out}
+                     "tid": tid, "args": {"name": label}})
+    return {"displayTimeUnit": "ms", "traceEvents": meta + out,
+            "ftpu": {"pid": pid, "node_id": _default_node,
+                     "clock": clock_anchor(),
+                     **({"trace_id": trace_id}
+                        if trace_id is not None else {})}}
 
 
 def _dump_path(reason: str) -> str:
@@ -670,7 +755,10 @@ def dump(reason: str = "manual", path: Optional[str] = None) -> str:
     an `ftpu` header (reason, pid, wall time, stage quantiles) so a
     dump is a self-contained postmortem."""
     doc = chrome_trace()
-    doc["ftpu"] = {
+    # extend (never replace) the export header: the clock anchor +
+    # node id chrome_trace stamped are what the cluster merger aligns
+    # dump FILES by
+    doc["ftpu"].update({
         "reason": reason,
         "pid": os.getpid(),
         "wall_time": time.time(),
@@ -679,7 +767,7 @@ def dump(reason: str = "manual", path: Optional[str] = None) -> str:
             k: {f: round(v, 6) if isinstance(v, float) else v
                 for f, v in q.items()}
             for k, q in stage_quantiles().items()},
-    }
+    })
     if path is None:
         path = _dump_path(reason)
     with open(path, "w", encoding="utf-8") as f:

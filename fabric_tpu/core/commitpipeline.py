@@ -60,7 +60,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from fabric_tpu.common import faults
+from fabric_tpu.common import clustertrace, faults
 from fabric_tpu.common import metrics as metrics_mod
 from fabric_tpu.common import overload
 from fabric_tpu.common import tracing
@@ -127,12 +127,18 @@ class CommitPipeline:
 
     def __init__(self, channel, mcs=None, depth: int = 1,
                  metrics_provider=None,
-                 on_committed: Optional[Callable] = None):
+                 on_committed: Optional[Callable] = None,
+                 node_id: Optional[str] = None):
         if depth < 1:
             raise ValueError("CommitPipeline needs depth >= 1 "
                              "(0 = sequential: do not build one)")
         self.channel = channel
         self.depth = depth
+        # cross-node trace attribution (round 18): the COMMITTING
+        # node's identity — labels e2e_commit_seconds and the
+        # validate/commit spans' track in the merged cluster trace
+        self.node_id = node_id
+        self._e2e_node = node_id or tracing.current_node() or "local"
         self._mcs = mcs
         self.on_committed = on_committed
         self._cond = threading.Condition()
@@ -368,6 +374,7 @@ class CommitPipeline:
     # -- stage A: validate ahead --
 
     def _validate_loop(self) -> None:
+        tracing.set_node(self.node_id)
         while not self._stop.is_set():
             with self._cond:
                 # a pending sticky error also parks the worker (the
@@ -557,6 +564,7 @@ class CommitPipeline:
     # -- stage B: ordered commit --
 
     def _commit_loop(self) -> None:
+        tracing.set_node(self.node_id)
         while not self._stop.is_set():
             with self._cond:
                 # park (don't spin) while a sticky error awaits reset
@@ -610,6 +618,13 @@ class CommitPipeline:
                 self.stats["committed"] += 1
                 self.stats["commit_s"] += t1 - t0
                 self.stats["last_commit_s"] = t1 - t0
+                # user-visible finality (round 18): first-ingress
+                # birth -> durable commit on THIS node, feeding
+                # e2e_commit_seconds{node=} and the SLO error budget.
+                # No carrier/birth for this block's trace = no
+                # observation (and tracing-off is a no-op).
+                clustertrace.note_commit(item.tctx,
+                                         node=self._e2e_node)
                 # validate+commit wall for THIS block (fallbacks run
                 # validation inside the commit window already): keeps
                 # gossip's commit_duration histogram meaning the same

@@ -22,8 +22,10 @@ logger = logging.getLogger("gossip.state")
 
 MAX_RANGE = 10  # blocks per state request (reference defAntiEntropyBatchSize)
 
+from fabric_tpu.common import clustertrace as _ct  # noqa: E402
 from fabric_tpu.common import metrics as _mdefs  # noqa: E402
 from fabric_tpu.common import overload as _overload  # noqa: E402
+from fabric_tpu.common import tracing as _tracing  # noqa: E402
 
 STATE_HEIGHT = _mdefs.GaugeOpts(
     namespace="gossip", subsystem="state", name="height",
@@ -144,15 +146,25 @@ class GossipStateProvider:
 
     def _on_block(self, sender: str, seq: int,
                   block_bytes: bytes) -> None:
+        # a gossiped block arrives on the transport drain thread
+        # UNDER the sender's resumed trace (round 18): pin its
+        # carrier per block number so the commit loop — which pops
+        # from the buffer later, on its own thread — can resume the
+        # same trace at commit (first registration wins: a re-relay
+        # keeps one identity)
+        _ct.register_block(self.channel_id, seq)
         self.buffer.push(seq, block_bytes)
 
     def add_local_block(self, block: common.Block,
                         gossip_out: bool = True) -> None:
         """Leader path: a block fetched from the orderer enters the
         same pipeline AND is pushed to the channel."""
+        _ct.register_block(self.channel_id, block.header.number)
         raw = block.SerializeToString()
         self.buffer.push(block.header.number, raw)
         if gossip_out:
+            # transport.send captures the ambient carrier (the
+            # deliver stream's resumed context on the leader path)
             self._node.gossip_block(self.channel_id,
                                     block.header.number, raw)
 
@@ -187,7 +199,14 @@ class GossipStateProvider:
             try:
                 import time as _t
                 _t0 = _t.perf_counter()
-                self._peer.process_block(block)
+                # resume the gossiped block's trace (round 18) so the
+                # sequential commit lands on the sender's trace_id and
+                # observes birth->commit finality on THIS node
+                with _ct.resumed(
+                        _ct.block_carrier(self.channel_id, seq),
+                        link=f"gossip:{self.channel_id}"):
+                    self._peer.process_block(block)
+                    _ct.note_commit(_tracing.capture())
                 self._m_commit.observe(_t.perf_counter() - _t0)
             except Exception:
                 logger.exception("[%s] commit of block [%d] failed",
@@ -244,18 +263,28 @@ class GossipStateProvider:
                 seq, raw = item
                 # abort=self._stop: a stopping provider must not sit
                 # in the backpressure wait behind a slow commit
-                while True:
-                    try:
-                        pipeline.submit(seq, raw=raw,
-                                        abort=self._stop)
-                        break
-                    except _overload.OverloadError:
-                        # deadline-bounded backpressure: nothing was
-                        # enqueued — retry the SAME block in place
-                        # instead of a reset + re-fetch (the block is
-                        # still in hand; only the wait was bounded)
-                        if self._stop.is_set():
-                            return
+                # submit under the block's registered carrier (round
+                # 18): the pipeline captures the ambient context per
+                # item, so its validate/commit spans + e2e
+                # observation join the gossip sender's trace. Resume
+                # ONCE around the retry loop — a backpressure retry
+                # is local queueing, not another hop.
+                with _ct.resumed(
+                        _ct.block_carrier(self.channel_id, seq),
+                        link=f"gossip:{self.channel_id}"):
+                    while True:
+                        try:
+                            pipeline.submit(seq, raw=raw,
+                                            abort=self._stop)
+                            break
+                        except _overload.OverloadError:
+                            # deadline-bounded backpressure: nothing
+                            # was enqueued — retry the SAME block in
+                            # place instead of a reset + re-fetch
+                            # (the block is still in hand; only the
+                            # wait was bounded)
+                            if self._stop.is_set():
+                                return
             except Exception as e:    # noqa: BLE001 — reset + re-fetch
                 if self._stop.is_set():
                     return

@@ -21,9 +21,16 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from fabric_tpu.common import clustertrace, tracing
 from fabric_tpu.protos import gossip as gpb
 
 logger = logging.getLogger("gossip.comm")
+
+# sentinel: "capture the ambient carrier here" — a wrapper (NetChaos)
+# that defers delivery passes the carrier it captured at send time
+# instead (even a None one), so the scheduler thread's foreign
+# ambient never re-parents
+_CAPTURE = clustertrace.CAPTURE_AMBIENT
 
 Handler = Callable[[str, gpb.SignedGossipMessage], None]
 
@@ -41,11 +48,14 @@ OVERFLOW_COUNT = _m.CounterOpts(
 
 class Transport:
     """The seam. Implementations: LocalTransport (in-proc),
-    GRPCTransport (fabric_tpu/comm)."""
+    GRPCTransport (fabric_tpu/comm). `carrier` (round 18) lets a
+    wrapping transport forward an ALREADY-captured trace carrier;
+    implementations default to capturing the sender's ambient one."""
 
     endpoint: str
 
-    def send(self, endpoint: str, msg: gpb.SignedGossipMessage) -> None:
+    def send(self, endpoint: str, msg: gpb.SignedGossipMessage,
+             carrier=_CAPTURE) -> None:
         raise NotImplementedError
 
     def set_handler(self, handler: Handler) -> None:
@@ -73,33 +83,47 @@ class LocalTransport(Transport):
             daemon=True)
         self._thread.start()
 
-    def send(self, endpoint: str, msg: gpb.SignedGossipMessage) -> None:
-        self._net.deliver(self.endpoint, endpoint, msg)
+    def send(self, endpoint: str, msg: gpb.SignedGossipMessage,
+             carrier=_CAPTURE) -> None:
+        if carrier is _CAPTURE:
+            # side-band carrier (round 18): captured at the SEND site
+            # — the in-process fabric hands off objects, so the
+            # carrier rides the delivery tuple instead of a byte frame
+            carrier = clustertrace.capture_carrier()
+        self._net.deliver(self.endpoint, endpoint, msg,
+                          carrier=carrier)
 
     def set_handler(self, handler: Handler) -> None:
         self._handler = handler
 
     # -- called by the network --
 
-    def enqueue(self, sender: str, msg: gpb.SignedGossipMessage) -> None:
+    def enqueue(self, sender: str, msg: gpb.SignedGossipMessage,
+                carrier=None) -> None:
         # drop-oldest: stale gossip is worthless, fresh is not; every
         # evicted message is COUNTED (the old re-insert race silently
         # lost the incoming message instead)
-        dropped = self._inbox.put_drop_oldest((sender, msg))
+        dropped = self._inbox.put_drop_oldest((sender, msg, carrier))
         if dropped:
             self._m_overflow.add(dropped)
 
     def _drain(self) -> None:
+        # extraction seam (round 18): gossiped blocks resume the
+        # sender's trace under THIS node's id
+        tracing.set_node(self.endpoint)
         while not self._closed.is_set():
             try:
-                sender, msg = self._inbox.get(timeout=0.2)
+                sender, msg, carrier = self._inbox.get(timeout=0.2)
             except queue.Empty:
                 continue
             handler = self._handler
             if handler is None:
                 continue
             try:
-                handler(sender, msg)
+                with clustertrace.resumed(
+                        carrier, link=f"gossip:{sender}",
+                        node=self.endpoint):
+                    handler(sender, msg)
             except Exception:
                 logger.exception("[%s] gossip handler failed",
                                  self.endpoint)
@@ -146,7 +170,7 @@ class LocalNetwork:
                 self._partitions.discard(frozenset((a, b)))
 
     def deliver(self, sender: str, target: str,
-                msg: gpb.SignedGossipMessage) -> None:
+                msg: gpb.SignedGossipMessage, carrier=None) -> None:
         with self._lock:
             node = self._nodes.get(target)
             cut = frozenset((sender, target)) in self._partitions
@@ -157,7 +181,7 @@ class LocalNetwork:
             self._drop_seq += 1
             if (self._drop_seq % 100) < self.drop_fraction * 100:
                 return
-        node.enqueue(sender, msg)
+        node.enqueue(sender, msg, carrier=carrier)
 
     def endpoints(self) -> list[str]:
         with self._lock:

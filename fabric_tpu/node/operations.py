@@ -43,6 +43,9 @@ class OperationsServer:
         self._profile_enabled = profile_enabled
         self._checkers: dict[str, Callable[[], None]] = {}
         self._extra: dict[str, Callable] = {}
+        # round 18: peer ops endpoints the cluster-trace merge pulls
+        # /debug/trace from (host:port strings)
+        self._trace_peers: list[str] = []
         ops = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -85,6 +88,13 @@ class OperationsServer:
         check, for states that are degraded-but-serving."""
         self._checkers[component] = check
 
+    def set_trace_peers(self, peers) -> None:
+        """Configure the ops addresses `/debug/trace/cluster` merges
+        (`Operations.Tracing.ClusterPeers` — list or comma string)."""
+        if isinstance(peers, str):
+            peers = [p.strip() for p in peers.split(",") if p.strip()]
+        self._trace_peers = list(peers or [])
+
     def register_handler(self, prefix: str,
                          fn: Callable[[str, str, bytes],
                                       tuple[int, bytes]]) -> None:
@@ -112,10 +122,24 @@ class OperationsServer:
                 # the flight recorder (common/tracing.py) is always on
                 # by design — reading it is the POSTMORTEM surface, so
                 # unlike the profiling endpoints below it is not gated
-                # by operations.profile.enabled
+                # by operations.profile.enabled. ?trace_id= filters to
+                # one transaction's spans (round 18: pulling one probe
+                # must not ship the whole ring).
                 from fabric_tpu.common import tracing
-                h._reply(200, json.dumps(
-                    tracing.chrome_trace()).encode())
+                h._reply(200, json.dumps(tracing.chrome_trace(
+                    trace_id=self._query_param(h, "trace_id")
+                )).encode())
+            elif path == "/debug/trace/cluster" and method == "GET":
+                # cluster view (round 18): this recorder merged with
+                # every configured peer's /debug/trace onto one wall-
+                # aligned timeline (tids = node/stage; residual clock
+                # skew reported in the ftpu.cluster header, peer fetch
+                # failures reported, never fatal)
+                from fabric_tpu.common import clustertrace
+                h._reply(200, json.dumps(clustertrace.cluster_trace(
+                    self._trace_peers,
+                    trace_id=self._query_param(h, "trace_id")
+                )).encode())
             elif path.startswith("/debug/") and method == "GET":
                 self._debug(h, path)
             else:
@@ -135,6 +159,14 @@ class OperationsServer:
             except Exception as reply_exc:
                 logger.warning("ops: could not deliver 500 reply for "
                                "%s %s: %s", method, path, reply_exc)
+
+    @staticmethod
+    def _query_param(h, name: str) -> Optional[str]:
+        from urllib.parse import parse_qs, urlparse
+        try:
+            return parse_qs(urlparse(h.path).query)[name][0] or None
+        except (KeyError, IndexError):
+            return None
 
     def _healthz(self, h) -> None:
         failed = []

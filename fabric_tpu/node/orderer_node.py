@@ -64,6 +64,10 @@ class OrdererNode:
         # recorder itself is always on; /debug/trace reads it)
         from fabric_tpu.common import tracing as _tracing
         _tracing.configure_from_config(cfg, metrics_provider=provider)
+        # round-18 cross-node layer: the commit-latency SLO target
+        # (Operations.SLO.CommitP99S -> /healthz components.slo)
+        from fabric_tpu.common import clustertrace as _ctrace
+        _ctrace.configure_from_config(cfg)
 
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(
@@ -220,6 +224,13 @@ class OrdererNode:
         # capacity with SERVICE_UNAVAILABLE is working as designed
         from fabric_tpu.common import overload as _overload
         self.ops.register_checker("overload", _overload.health)
+        # commit-latency SLO burn state (ok | burning:<rate>):
+        # degraded-but-serving, the breaker-trip trigger discipline —
+        # a sustained burn also auto-dumps the flight recorder
+        self.ops.register_checker("slo", _ctrace.slo_health)
+        self.ops.set_trace_peers(
+            cfg.get("Operations.Tracing.ClusterPeers")
+            or os.environ.get("FTPU_TRACE_PEERS", ""))
         self.ops.register_handler("/participation",
                                   self._participation_http(
                                       participation))

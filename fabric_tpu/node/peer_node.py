@@ -106,6 +106,10 @@ class PeerNode:
         # always-on flight recorder
         from fabric_tpu.common import tracing as _tracing
         _tracing.configure_from_config(cfg, metrics_provider=provider)
+        # round-18 cross-node layer: the commit-latency SLO target
+        # (operations.slo.commitP99S -> /healthz components.slo)
+        from fabric_tpu.common import clustertrace as _ctrace
+        _ctrace.configure_from_config(cfg)
 
         fs_path = cfg.get_path("peer.fileSystemPath")
         os.makedirs(fs_path, exist_ok=True)
@@ -280,6 +284,13 @@ class PeerNode:
         # never a failed health check
         from fabric_tpu.common import overload as _overload
         self.ops.register_checker("overload", _overload.health)
+        # commit-latency SLO burn state (ok | burning:<rate>) — this
+        # IS the node that commits, so the e2e histogram/error budget
+        # fills here; a sustained burn auto-dumps the flight recorder
+        self.ops.register_checker("slo", _ctrace.slo_health)
+        self.ops.set_trace_peers(
+            cfg.get("operations.tracing.clusterPeers")
+            or os.environ.get("FTPU_TRACE_PEERS", ""))
         self.ops.register_handler("/admin", self._admin_http)
         self.ops.start()
 

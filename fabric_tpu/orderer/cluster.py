@@ -17,6 +17,7 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from fabric_tpu.common import clustertrace, tracing
 from fabric_tpu.protos import common, orderer as opb
 
 logger = logging.getLogger("orderer.cluster")
@@ -78,22 +79,26 @@ class LocalClusterTransport(ClusterTransport):
     def remove_handler(self, channel: str) -> None:
         self._handlers.pop(channel, None)
 
-    # -- outbound --
+    # -- outbound (round 18: every cross-node send carries the wire
+    # trace carrier — framed into the opaque payloads, side-band on
+    # the argument-only pull RPC) --
 
     def send_consensus(self, target: str, channel: str,
                        payload: bytes) -> None:
         self._net.route_consensus(self.endpoint, target, channel,
-                                  payload)
+                                  clustertrace.inject(payload))
 
     def submit(self, target: str, channel: str, env_bytes: bytes,
                config_seq: int = 0) -> opb.SubmitResponse:
         return self._net.route_submit(self.endpoint, target, channel,
-                                      env_bytes, config_seq)
+                                      clustertrace.inject(env_bytes),
+                                      config_seq)
 
     def pull_blocks(self, target: str, channel: str, start: int,
                     end: int) -> list[common.Block]:
-        return self._net.route_pull(self.endpoint, target, channel,
-                                    start, end)
+        return self._net.route_pull(
+            self.endpoint, target, channel, start, end,
+            carrier=clustertrace.capture_carrier())
 
     # -- inbound (async consensus path only; submit/pull are RPCs) --
 
@@ -106,6 +111,11 @@ class LocalClusterTransport(ClusterTransport):
                            self.endpoint)
 
     def _drain(self) -> None:
+        # extraction seam (round 18): the remote worker resumes the
+        # SENDER's span tree under this node's id — a raft APPEND
+        # carries its proposing window's trace across the hop instead
+        # of opening an orphan (or no) trace here
+        tracing.set_node(self.endpoint)
         while not self._closed.is_set():
             try:
                 sender, channel, payload = self._inbox.get(timeout=0.2)
@@ -114,8 +124,12 @@ class LocalClusterTransport(ClusterTransport):
             handler = self._handlers.get(channel)
             if handler is None:
                 continue
+            payload, carrier = clustertrace.extract(payload)
             try:
-                handler.on_consensus(sender, payload)
+                with clustertrace.resumed(
+                        carrier, link=f"{sender}>{self.endpoint}",
+                        node=self.endpoint):
+                    handler.on_consensus(sender, payload)
             except Exception:
                 logger.exception("[%s] consensus handler failed",
                                  self.endpoint)
@@ -123,19 +137,26 @@ class LocalClusterTransport(ClusterTransport):
     def handle_submit(self, channel: str, env_bytes: bytes,
                       config_seq: int = 0) -> opb.SubmitResponse:
         handler = self._handlers.get(channel)
+        env_bytes, carrier = clustertrace.extract(env_bytes)
         if handler is None:
             return opb.SubmitResponse(
                 channel=channel,
                 status=common.Status.NOT_FOUND,
                 info=f"channel {channel} not served here")
-        return handler.on_submit(env_bytes, config_seq)
+        with clustertrace.resumed(carrier,
+                                  link=f"submit>{self.endpoint}",
+                                  node=self.endpoint):
+            return handler.on_submit(env_bytes, config_seq)
 
-    def handle_pull(self, channel: str, start: int,
-                    end: int) -> list[common.Block]:
+    def handle_pull(self, channel: str, start: int, end: int,
+                    carrier=None) -> list[common.Block]:
         handler = self._handlers.get(channel)
         if handler is None:
             return []
-        return handler.serve_blocks(start, end)
+        with clustertrace.resumed(carrier,
+                                  link=f"pull>{self.endpoint}",
+                                  node=self.endpoint):
+            return handler.serve_blocks(start, end)
 
     def close(self) -> None:
         self._closed.set()
@@ -220,7 +241,8 @@ class LocalClusterNetwork:
         return node.handle_submit(channel, env_bytes, config_seq)
 
     def route_pull(self, sender: str, target: str, channel: str,
-                   start: int, end: int) -> list[common.Block]:
+                   start: int, end: int,
+                   carrier=None) -> list[common.Block]:
         node = self._reachable(sender, target)
         if node is None:
             # a dead source must be DISTINGUISHABLE from one that has
@@ -228,4 +250,4 @@ class LocalClusterNetwork:
             # on transport errors but treats an empty result at the
             # tip as quiescence
             raise ConnectionError(f"{target} unreachable from {sender}")
-        return node.handle_pull(channel, start, end)
+        return node.handle_pull(channel, start, end, carrier=carrier)

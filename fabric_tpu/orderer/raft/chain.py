@@ -42,7 +42,7 @@ import threading
 import time
 from typing import Optional
 
-from fabric_tpu.common import faults, overload, tracing
+from fabric_tpu.common import clustertrace, faults, overload, tracing
 from fabric_tpu.common.hotpath import hot_path
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
 from fabric_tpu.orderer.raft.core import LEADER, RaftNode
@@ -284,6 +284,11 @@ class RaftChain:
         # block number -> (propose perf_counter, trace context):
         # consumed at commit time for the consensus-latency span
         self._proposed_at: dict[int, tuple] = {}
+        # the most recent propose's trace context (round 18): entry-
+        # bearing consensus sends in _drain_ready attach it so the
+        # raft replication hop carries the ordering trace across
+        # consenters (heartbeats stay unparented)
+        self._last_order_ctx = None
         # raft-loop busy window, read by the write stage's overlap
         # accounting: (busy-since or None, last closed busy interval)
         self._loop_busy_since: Optional[float] = None
@@ -295,7 +300,8 @@ class RaftChain:
                 "FTPU_ORDER_PIPELINE", "1") != "0"
         if write_pipeline:
             self._write_stage = BlockWriteStage(
-                support, loop_activity=self._loop_activity)
+                support, loop_activity=self._loop_activity,
+                node_id=self.endpoint)
         transport.set_channel_auth(
             support.channel_id,
             parse_consenter_certs(
@@ -573,6 +579,10 @@ class RaftChain:
         return self._loop_busy_since, self._loop_window
 
     def _run(self) -> None:
+        # cross-node trace attribution (round 18): everything this
+        # loop records — order window/propose/consensus spans, leader-
+        # change instants — belongs to THIS consenter's track
+        tracing.set_node(self.endpoint)
         next_tick = time.monotonic() + self._tick_s
         while not self._halted.is_set():
             now = time.monotonic()
@@ -675,9 +685,17 @@ class RaftChain:
             if target is None:
                 continue
             try:
-                self._transport.send_consensus(
-                    target, self._support.channel_id,
-                    msg.SerializeToString())
+                # entry-bearing sends ride the last propose's trace
+                # (round 18): the transport injects the ambient
+                # carrier, so the remote consenter resumes the
+                # ordering trace for exactly the replication hops —
+                # attached(None) is a passthrough for heartbeats
+                with tracing.attached(
+                        self._last_order_ctx if msg.entries
+                        else None):
+                    self._transport.send_consensus(
+                        target, self._support.channel_id,
+                        msg.SerializeToString())
             except Exception as e:   # noqa: BLE001 — one dead peer must
                 # not abort the rest of the drain: the transport RAISES
                 # on unregistered endpoints (round 15), and a leader
@@ -698,6 +716,7 @@ class RaftChain:
             self._creator = None
             self._timer_deadline = None
             self._proposed_at.clear()
+            self._last_order_ctx = None
 
     # -- leader-side ordering (the admission window) --
 
@@ -869,6 +888,7 @@ class RaftChain:
             self._creator = None
         now = time.perf_counter()
         pctx = tracing.capture()
+        self._last_order_ctx = pctx
         for block in blocks[:n]:
             self._proposed_at[block.header.number] = (now, pctx)
         self.order_stats["blocks_proposed"] += n
@@ -888,8 +908,9 @@ class RaftChain:
             self.metrics.proposal_failures.add(1)
             self._creator = None
             return
+        self._last_order_ctx = tracing.capture()
         self._proposed_at[block.header.number] = (
-            time.perf_counter(), tracing.capture())
+            time.perf_counter(), self._last_order_ctx)
         self.order_stats["blocks_proposed"] += 1
         self.order_stats["last_fill"] = len(envelopes)
 
@@ -987,6 +1008,15 @@ class RaftChain:
     def _write_committed_block(self, block: common.Block) -> None:
         self.metrics.committed_block_number.set(block.header.number)
         support = self._support
+        # pin the block's trace carrier (round 18): blocks travel by
+        # value and must stay bit-identical across replay, so the
+        # carrier lives in a side registry keyed (channel, number) —
+        # the gossip/deliver commit seams resume it on the peers.
+        # Ambient here is the proposing window's context (re-attached
+        # at _apply on the leader); a follower has none and registers
+        # nothing — its deliver readers fall back to a fresh trace.
+        clustertrace.register_block(support.channel_id,
+                                    block.header.number)
         if pu.is_config_block(block):
             # config barrier: the reconfiguration below (and the
             # bundle the NEXT message validates under) must observe

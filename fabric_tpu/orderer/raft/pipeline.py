@@ -76,8 +76,10 @@ class BlockWriteStage:
 
     def __init__(self, support,
                  loop_activity: Optional[Callable] = None,
-                 max_pending: int = MAX_PENDING):
+                 max_pending: int = MAX_PENDING,
+                 node_id: Optional[str] = None):
         self._support = support
+        self._node_id = node_id      # trace-track attribution
         self._cond = threading.Condition()
         self._pending: list = []
         self._max_pending = max_pending
@@ -239,6 +241,9 @@ class BlockWriteStage:
     # -- the worker --
 
     def _write_loop(self) -> None:
+        # the async writer records order.write spans on its own
+        # thread: bind them to the owning consenter's trace track
+        tracing.set_node(self._node_id)
         while not self._stop.is_set():
             with self._cond:
                 while (not self._pending or self._error is not None) \

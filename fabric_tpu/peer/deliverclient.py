@@ -19,7 +19,9 @@ import threading
 import time
 from typing import Callable, Optional
 
-from fabric_tpu.common import faults, metrics as metrics_mod
+from fabric_tpu.common import clustertrace, faults
+from fabric_tpu.common import metrics as metrics_mod
+from fabric_tpu.common import tracing
 from fabric_tpu.common.backoff import FullJitterBackoff
 from fabric_tpu.common.overload import OverloadError
 from fabric_tpu.protos import common, orderer as ordpb
@@ -142,6 +144,15 @@ class Deliverer:
                     raise ConnectionError(
                         f"deliver ended with status {resp.status}")
                 block = resp.block
+                # resume the block's wire trace (round 18): the
+                # writer registered a carrier per block number —
+                # submit under it so the commit-pipeline's validate/
+                # commit spans (and the e2e_commit_seconds
+                # observation) join the orderer-side trace instead of
+                # opening an orphan one. Absent carrier/tracing-off:
+                # shared no-op.
+                carrier = clustertrace.block_carrier(
+                    channel.channel_id, block.header.number)
                 if pipeline is not None:
                     # verification happens inside stage A (same
                     # next-expected-block contract as below); wait for
@@ -156,18 +167,27 @@ class Deliverer:
                     # `expected` (== pipeline.next_seq within one
                     # stream: both start there and advance per block)
                     # is the single sequence tracker for both branches
-                    while True:
-                        try:
-                            pipeline.submit(expected, block=block,
-                                            abort=self._stop)
-                            break
-                        except OverloadError:
-                            # deadline-bounded backpressure: nothing
-                            # was enqueued — retry the SAME block
-                            # in place (a reset + re-seek would
-                            # re-fetch work the pipeline still holds)
-                            if self._stop.is_set():
-                                return
+                    # resume ONCE around the whole retry loop: a
+                    # backpressure retry is local queueing, not
+                    # another network hop — re-entering resumed()
+                    # per attempt would flood hop_seconds/the ring
+                    # with duplicate hop.recv observations
+                    with clustertrace.resumed(
+                            carrier,
+                            link=f"deliver:{channel.channel_id}"):
+                        while True:
+                            try:
+                                pipeline.submit(expected, block=block,
+                                                abort=self._stop)
+                                break
+                            except OverloadError:
+                                # deadline-bounded backpressure:
+                                # nothing was enqueued — retry the
+                                # SAME block in place (a reset +
+                                # re-seek would re-fetch work the
+                                # pipeline still holds)
+                                if self._stop.is_set():
+                                    return
                     pipeline.wait_validated(expected,
                                             abort=self._stop)
                     # backoff resets only on COMMITTED progress — a
@@ -182,7 +202,11 @@ class Deliverer:
                     # (blocksprovider.go:229)
                     self._mcs.verify_block(channel.channel_id,
                                            expected, block)
-                    channel.process_block(block)
+                    with clustertrace.resumed(
+                            carrier,
+                            link=f"deliver:{channel.channel_id}"):
+                        channel.process_block(block)
+                        clustertrace.note_commit(tracing.capture())
                     # a processed block proves the stream is healthy
                     # again: reset the backoff so the NEXT outage
                     # starts from the base delay instead of the

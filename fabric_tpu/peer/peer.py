@@ -86,7 +86,10 @@ class Channel:
             from fabric_tpu.core.commitpipeline import CommitPipeline
             self.commit_pipeline = CommitPipeline(
                 self, mcs=peer.mcs, depth=depth,
-                metrics_provider=peer.metrics_provider)
+                metrics_provider=peer.metrics_provider,
+                # e2e_commit_seconds/trace-track attribution: the
+                # peer's gossip endpoint names the committing node
+                node_id=getattr(peer, "endpoint", None))
         _prov = peer.metrics_provider or _pm.DisabledProvider()
         self._m_pvt_commit = _prov.new_histogram(
             PVT_COMMIT_BLOCK_DURATION).with_labels(

@@ -1,0 +1,811 @@
+"""Round-18 cross-node distributed tracing
+(fabric_tpu/common/clustertrace.py + the transport carrier seams).
+
+Covers: wire-carrier inject/extract round-trips (absent/corrupt
+carrier -> fresh trace, never a crash), carrier-resumed remote spans
+(hop.recv linkage, node attribution, exactly-one parent under
+duplication), the NetChaos wrappers forwarding carriers on
+dup/reorder/partition, multi-node Chrome-trace merging with
+deliberately skewed clocks (skew reported, ordering preserved), the
+e2e_commit_seconds birth->commit math, SLO burn-rate accounting +
+/healthz sub-state + rate-limited auto-dump, the `?trace_id=` filter
+on /debug/trace and its forwarding through /debug/trace/cluster, and
+the full in-process 3-consenter + 2-peer acceptance rig.
+
+The chaos gate (`tools/chaos_check.sh e2e-trace`) re-runs this file
+with net.drop / net.reorder / net.dup / order.propose armed via env —
+carriers and error spans must both survive. Tests that pin exact
+delivery counts clear the ambient arming themselves (faults.clear).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fabric_tpu.common import clustertrace as ct
+from fabric_tpu.common import faults, netchaos, tracing
+from fabric_tpu.common import metrics as metrics_mod
+
+
+@pytest.fixture()
+def ctrace_env(tmp_path):
+    """Isolated recorder + registries; restores process defaults."""
+    tracing.configure(enabled=True, ring_size=1024, sample_every=1,
+                      dump_dir=str(tmp_path),
+                      dump_min_interval_s=0.0, shed_burst=32)
+    tracing.set_default_node(None)
+    tracing.set_node(None)
+    tracing.reset()
+    ct.reset()
+    ct.configure_slo(None)
+    yield tmp_path
+    tracing.wait_dumps()
+    tracing.configure(enabled=True, ring_size=4096, sample_every=1,
+                      dump_dir="", dump_min_interval_s=10.0,
+                      shed_burst=32)
+    tracing.set_default_node(None)
+    tracing.set_node(None)
+    tracing.reset()
+    ct.reset()
+    ct.configure_slo(None)
+
+
+def _events(name=None):
+    return [e for e in tracing.snapshot()
+            if name is None or e[1] == name]
+
+
+# ---------------------------------------------------------------------------
+# the wire carrier
+# ---------------------------------------------------------------------------
+
+class TestCarrier:
+    def test_inject_extract_roundtrip(self, ctrace_env):
+        with tracing.span("ingress.batch") as ctx:
+            ct.note_birth(ctx.trace_id)
+            framed = ct.inject(b"raft-payload")
+        assert framed.startswith(ct.MAGIC)
+        payload, carrier = ct.extract(framed)
+        assert payload == b"raft-payload"
+        assert carrier.trace_id == ctx.trace_id
+        assert carrier.span_id == ctx.span_id
+        assert carrier.birth is not None
+        assert carrier.sent is not None
+
+    def test_absent_carrier_is_fresh_trace(self, ctrace_env):
+        payload, carrier = ct.extract(b"plain bytes")
+        assert payload == b"plain bytes"
+        assert carrier is None
+
+    def test_inject_is_idempotent_no_reparenting(self, ctrace_env):
+        with tracing.span("a"):
+            once = ct.inject(b"x")
+        # a foreign ambient context must NOT re-frame (the NetChaos
+        # scheduler-thread case): the original parent is preserved
+        with tracing.span("b") as other:
+            twice = ct.inject(once)
+        assert twice == once
+        _, carrier = ct.extract(twice)
+        assert carrier.trace_id != other.trace_id
+
+    def test_no_ambient_returns_payload_unchanged(self, ctrace_env):
+        raw = b"payload"
+        assert ct.inject(raw) is raw
+
+    def test_disabled_mode_is_noop_but_still_strips(self, ctrace_env):
+        with tracing.span("a"):
+            framed = ct.inject(b"x")
+        tracing.set_enabled(False)
+        try:
+            raw = b"y"
+            assert ct.inject(raw) is raw           # zero-alloc path
+            # a tracing-off RECEIVER must still parse the payload
+            payload, carrier = ct.extract(framed)
+            assert payload == b"x"
+            assert carrier is None                 # resume gated off
+        finally:
+            tracing.set_enabled(True)
+
+    def test_corrupt_json_never_crashes(self, ctrace_env):
+        bad = ct.MAGIC + ct._LEN.pack(7) + b"not-js}" + b"payload"
+        payload, carrier = ct.extract(bad)
+        assert payload == b"payload"
+        assert carrier is None
+
+    def test_implausible_length_treated_as_payload(self, ctrace_env):
+        bad = ct.MAGIC + ct._LEN.pack(1 << 30) + b"short"
+        payload, carrier = ct.extract(bad)
+        assert payload == bad       # not a frame: bytes untouched
+        assert carrier is None
+
+    def test_truncated_frame(self, ctrace_env):
+        bad = ct.MAGIC + b"\x00"
+        payload, carrier = ct.extract(bad)
+        assert payload == bad
+        assert carrier is None
+
+    def test_header_roundtrip_and_corrupt(self, ctrace_env):
+        c = ct.Carrier("t1", "s1", birth=1.5, sent=2.5)
+        assert ct.Carrier.from_header(c.to_header()) == c
+        assert ct.Carrier.from_header("%%%not-b64") is None
+        assert ct.Carrier.from_header(None) is None
+        assert ct.Carrier.from_header("") is None
+
+
+class TestResume:
+    def test_resumed_links_hop_and_node(self, ctrace_env):
+        c = ct.Carrier("trace-x", "span-x", birth=time.time() - 1.0,
+                       sent=time.time() - 0.2)
+        with ct.resumed(c, link="a>b", node="nodeB"):
+            with tracing.span("order.window"):
+                pass
+        hops = _events("hop.recv")
+        assert len(hops) == 1
+        ph, name, tr, sp, par, t0, dur, tname, attrs, err, node = \
+            hops[0]
+        assert tr == "trace-x" and par == "span-x"
+        assert node == "nodeB"
+        assert attrs["link"] == "a>b"
+        assert 0.1 < dur < 5.0          # the send->receive latency
+        # the worker's own span joined the remote trace
+        win = _events("order.window")[0]
+        assert win[2] == "trace-x"
+        assert win[10] == "nodeB"
+        # birth carried across the hop
+        assert ct.birth_of("trace-x") == c.birth
+        # hop stage reservoir fed
+        assert tracing.stage_quantile("hop.a>b", "count") == 1
+
+    def test_negative_hop_clamped_but_reported_raw(self, ctrace_env):
+        c = ct.Carrier("t", "s", sent=time.time() + 5.0)  # skewed
+        with ct.resumed(c, link="skew>me"):
+            pass
+        hop = _events("hop.recv")[0]
+        assert hop[6] == 0.0                       # clamped duration
+        assert hop[8]["raw_hop_s"] < 0             # skew evidence
+
+    def test_resumed_none_is_noop(self, ctrace_env):
+        with tracing.span("outer") as outer:
+            with ct.resumed(None, link="x") as got:
+                assert got is None
+                assert tracing.capture() is outer
+        assert _events("hop.recv") == []
+
+    def test_exactly_one_parent_under_duplication(self, ctrace_env):
+        with tracing.span("a"):
+            framed = ct.inject(b"msg")
+        for _ in range(2):                 # a duplicating link
+            payload, carrier = ct.extract(framed)
+            with ct.resumed(carrier, link="dup>link"):
+                pass
+        hops = _events("hop.recv")
+        assert len(hops) == 2
+        assert len({h[4] for h in hops}) == 1   # ONE distinct parent
+
+    def test_thread_node_binding_restored(self, ctrace_env):
+        tracing.set_node("original")
+        try:
+            c = ct.Carrier("t", "s", sent=time.time())
+            with ct.resumed(c, link="l", node="remote"):
+                assert tracing.current_node() == "remote"
+            assert tracing.current_node() == "original"
+        finally:
+            tracing.set_node(None)
+
+    def test_birth_first_stamp_wins(self, ctrace_env):
+        first = ct.note_birth("tid", 100.0)
+        second = ct.note_birth("tid", 200.0)
+        assert first == second == 100.0
+        assert ct.birth_of("tid") == 100.0
+
+
+class TestBlockRegistry:
+    def test_register_and_lookup(self, ctrace_env):
+        with tracing.span("order.write") as ctx:
+            ct.note_birth(ctx.trace_id)
+            ct.register_block("ch", 7)
+        c = ct.block_carrier("ch", 7)
+        assert c.trace_id == ctx.trace_id
+        assert c.birth is not None
+        assert ct.block_carrier("ch", 8) is None
+
+    def test_first_registration_wins(self, ctrace_env):
+        with tracing.span("a") as first:
+            ct.register_block("ch", 1)
+        with tracing.span("b"):
+            ct.register_block("ch", 1)      # re-relay: no re-parent
+        assert ct.block_carrier("ch", 1).trace_id == first.trace_id
+
+    def test_disabled_mode(self, ctrace_env):
+        tracing.set_enabled(False)
+        try:
+            ct.register_block("ch", 1)
+            assert ct.block_carrier("ch", 1) is None
+        finally:
+            tracing.set_enabled(True)
+
+
+# ---------------------------------------------------------------------------
+# transport seams
+# ---------------------------------------------------------------------------
+
+class _ConsensusSink:
+    def __init__(self):
+        self.got = []       # (sender, payload, ambient trace_id, node)
+        self.event = threading.Event()
+
+    def on_consensus(self, sender, payload):
+        ctx = tracing.capture()
+        self.got.append((sender, payload,
+                         ctx.trace_id if ctx else None,
+                         tracing.current_node()))
+        self.event.set()
+
+    def on_submit(self, env_bytes, config_seq=0):
+        from fabric_tpu.protos import common, orderer as opb
+        ctx = tracing.capture()
+        self.got.append(("submit", env_bytes,
+                         ctx.trace_id if ctx else None,
+                         tracing.current_node()))
+        return opb.SubmitResponse(channel="ch",
+                                  status=common.Status.SUCCESS)
+
+    def serve_blocks(self, start, end):
+        ctx = tracing.capture()
+        self.got.append(("pull", b"", ctx.trace_id if ctx else None,
+                         tracing.current_node()))
+        return []
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError("condition never met")
+        time.sleep(0.01)
+
+
+class TestClusterTransportSeams:
+    def test_consensus_carrier_crosses_nodes(self, ctrace_env):
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        net = LocalClusterNetwork()
+        a = net.register("nodeA:1")
+        b = net.register("nodeB:2")
+        sink = _ConsensusSink()
+        b.set_handler("ch", sink)
+        try:
+            with tracing.span("order.propose") as ctx:
+                a.send_consensus("nodeB:2", "ch", b"raft-append")
+            _wait(sink.event.is_set)
+            sender, payload, trace_id, node = sink.got[0]
+            assert sender == "nodeA:1"
+            assert payload == b"raft-append"   # frame stripped
+            assert trace_id == ctx.trace_id    # resumed, not orphan
+            assert node == "nodeB:2"           # remote's own node id
+            hop = _events("hop.recv")[0]
+            assert hop[8]["link"] == "nodeA:1>nodeB:2"
+        finally:
+            a.close()
+            b.close()
+
+    def test_submit_and_pull_carriers(self, ctrace_env):
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        from fabric_tpu.protos import common
+        net = LocalClusterNetwork()
+        a = net.register("nodeA:1")
+        b = net.register("nodeB:2")
+        sink = _ConsensusSink()
+        b.set_handler("ch", sink)
+        try:
+            with tracing.span("ingress.batch") as ctx:
+                resp = a.submit("nodeB:2", "ch", b"env-bytes")
+                a.pull_blocks("nodeB:2", "ch", 0, 1)
+            assert resp.status == common.Status.SUCCESS
+            kinds = {g[0]: g for g in sink.got}
+            assert kinds["submit"][1] == b"env-bytes"
+            assert kinds["submit"][2] == ctx.trace_id
+            assert kinds["pull"][2] == ctx.trace_id
+        finally:
+            a.close()
+            b.close()
+
+    def test_corrupt_wire_carrier_never_crashes(self, ctrace_env):
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        net = LocalClusterNetwork()
+        b = net.register("nodeB:2")
+        sink = _ConsensusSink()
+        b.set_handler("ch", sink)
+        try:
+            bad = ct.MAGIC + ct._LEN.pack(5) + b"{bad}" + b"payload"
+            b.enqueue_consensus("evil", "ch", bad)
+            _wait(sink.event.is_set)
+            _s, payload, trace_id, _n = sink.got[0]
+            assert payload == b"payload"
+            assert trace_id is None            # fresh trace, no crash
+        finally:
+            b.close()
+
+
+class TestGossipTransportSeams:
+    def test_gossip_carrier_side_band(self, ctrace_env):
+        from fabric_tpu.gossip.transport import LocalNetwork
+        net = LocalNetwork()
+        a = net.register("peerA:1")
+        b = net.register("peerB:2")
+        got = []
+        done = threading.Event()
+
+        def handler(sender, msg):
+            ctx = tracing.capture()
+            got.append((sender, msg, ctx.trace_id if ctx else None,
+                        tracing.current_node()))
+            done.set()
+
+        b.set_handler(handler)
+        try:
+            with tracing.span("gossip.push") as ctx:
+                a.send("peerB:2", b"block-bytes")
+            _wait(done.is_set)
+            sender, msg, trace_id, node = got[0]
+            assert (sender, msg) == ("peerA:1", b"block-bytes")
+            assert trace_id == ctx.trace_id
+            assert node == "peerB:2"
+        finally:
+            a.close()
+            b.close()
+
+    def test_gossip_without_ambient_is_carrierless(self, ctrace_env):
+        from fabric_tpu.gossip.transport import LocalNetwork
+        net = LocalNetwork()
+        a = net.register("peerA:1")
+        b = net.register("peerB:2")
+        got = []
+        done = threading.Event()
+
+        def handler(sender, msg):
+            ctx = tracing.capture()
+            got.append(ctx)
+            done.set()
+
+        b.set_handler(handler)
+        try:
+            a.send("peerB:2", b"x")
+            _wait(done.is_set)
+            assert got[0] is None
+        finally:
+            a.close()
+            b.close()
+
+
+class TestNetChaosCarriers:
+    """The chaos wrappers must FORWARD carriers on dup/reorder
+    without re-parenting: the frame is built eagerly at send time, so
+    the scheduler thread's foreign ambient never rewrites it."""
+
+    def test_dup_forwards_one_parent(self, ctrace_env):
+        faults.clear()       # pinned delivery counts: no env chaos
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        chaos = netchaos.NetChaos(seed=3)
+        chaos.set_policy(netchaos.LinkPolicy(dup_rate=1.0))
+        net = LocalClusterNetwork()
+        a = chaos.wrap_cluster(net.register("nodeA:1"))
+        b = net.register("nodeB:2")
+        sink = _ConsensusSink()
+        b.set_handler("ch", sink)
+        try:
+            with tracing.span("order.propose"):
+                a.send_consensus("nodeB:2", "ch", b"append")
+            chaos.quiesce()
+            _wait(lambda: len(sink.got) == 2)
+            payloads = {g[1] for g in sink.got}
+            traces = {g[2] for g in sink.got}
+            assert payloads == {b"append"}
+            assert len(traces) == 1 and None not in traces
+            hops = _events("hop.recv")
+            assert len({h[4] for h in hops}) == 1   # ONE parent
+        finally:
+            a.close()
+            b.close()
+            chaos.close()
+
+    def test_reorder_keeps_carriers_intact(self, ctrace_env):
+        faults.clear()
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        chaos = netchaos.NetChaos(seed=5)
+        chaos.set_policy(netchaos.LinkPolicy(reorder_rate=1.0,
+                                             reorder_window=2,
+                                             reorder_hold_s=0.05))
+        net = LocalClusterNetwork()
+        a = chaos.wrap_cluster(net.register("nodeA:1"))
+        b = net.register("nodeB:2")
+        sink = _ConsensusSink()
+        b.set_handler("ch", sink)
+        try:
+            ids = []
+            for i in range(4):
+                with tracing.span("order.propose") as c:
+                    ids.append(c.trace_id)
+                    a.send_consensus("nodeB:2", "ch",
+                                     f"m{i}".encode())
+            chaos.quiesce()
+            _wait(lambda: len(sink.got) == 4)
+            # every delivered message still pairs its OWN trace
+            by_payload = {g[1]: g[2] for g in sink.got}
+            for i in range(4):
+                assert by_payload[f"m{i}".encode()] == ids[i]
+        finally:
+            a.close()
+            b.close()
+            chaos.close()
+
+    def test_partition_cuts_without_crash(self, ctrace_env):
+        faults.clear()
+        from fabric_tpu.orderer.cluster import LocalClusterNetwork
+        chaos = netchaos.NetChaos(seed=1)
+        net = LocalClusterNetwork()
+        a = chaos.wrap_cluster(net.register("nodeA:1"))
+        b = net.register("nodeB:2")
+        sink = _ConsensusSink()
+        b.set_handler("ch", sink)
+        try:
+            chaos.partition(["nodeB:2"])
+            with tracing.span("order.propose"):
+                a.send_consensus("nodeB:2", "ch", b"cut")
+            chaos.quiesce()
+            time.sleep(0.05)
+            assert sink.got == []
+            assert chaos.stats["partitioned"] == 1
+        finally:
+            a.close()
+            b.close()
+            chaos.close()
+
+
+# ---------------------------------------------------------------------------
+# cluster merge
+# ---------------------------------------------------------------------------
+
+def _mk_doc(node, epoch, events):
+    """A minimal per-node Chrome-trace doc: events = [(name, trace,
+    span, ts_us, extra_args)]."""
+    tev = []
+    for name, tr, sp, ts, extra in events:
+        args = {"trace_id": tr, "span_id": sp}
+        args.update(extra or {})
+        tev.append({"ph": "X", "name": name,
+                    "cat": name.split(".", 1)[0], "pid": 7, "tid": 1,
+                    "ts": ts, "dur": 1.0, "args": args})
+    return {"displayTimeUnit": "ms", "traceEvents": tev,
+            "ftpu": {"node_id": node,
+                     "clock": {"epoch_wall_s": epoch}}}
+
+
+class TestMerge:
+    def test_skewed_clocks_aligned_and_reported(self, ctrace_env):
+        # node B's wall clock is 2s ahead; its event at local ts 0
+        # really happened 2s after A's ts 0 — alignment must order
+        # A's event first and REPORT the shift
+        a = _mk_doc("A", 1000.0, [("order.propose", "t", "s1",
+                                   500.0, None)])
+        b = _mk_doc("B", 1002.0, [("commit.commit", "t", "s2",
+                                   0.0, {"raw_hop_s": -0.25})])
+        merged = ct.merge_docs([a, b])
+        ev = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+        assert [e["name"] for e in ev] == ["order.propose",
+                                          "commit.commit"]
+        assert ev[1]["ts"] - ev[0]["ts"] == pytest.approx(
+            2_000_000 - 500, abs=1.0)
+        cluster = merged["ftpu"]["cluster"]
+        assert cluster["nodes"]["B"]["shift_us"] == pytest.approx(
+            2e6)
+        assert cluster["residual_skew_s_observed"] == \
+            pytest.approx(0.25)
+
+    def test_dedup_by_span_id(self, ctrace_env):
+        a = _mk_doc("A", 0.0, [("x", "t", "same-span", 1.0, None)])
+        b = _mk_doc("A", 0.0, [("x", "t", "same-span", 1.0, None)])
+        merged = ct.merge_docs([a, b])
+        assert len([e for e in merged["traceEvents"]
+                    if e["ph"] != "M"]) == 1
+
+    def test_trace_id_filter(self, ctrace_env):
+        a = _mk_doc("A", 0.0, [("x", "keep", "s1", 1.0, None),
+                               ("y", "drop", "s2", 2.0, None)])
+        merged = ct.merge_docs([a], trace_id="keep")
+        ev = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+        assert [e["args"]["trace_id"] for e in ev] == ["keep"]
+
+    def test_node_stage_tids(self, ctrace_env):
+        a = _mk_doc("A", 0.0, [("order.propose", "t", "s1", 1.0,
+                                None)])
+        b = _mk_doc("B", 0.0, [("commit.commit", "t", "s2", 2.0,
+                                None)])
+        merged = ct.merge_docs([a, b])
+        labels = {e["args"]["name"] for e in merged["traceEvents"]
+                  if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert labels == {"A/order", "B/commit"}
+
+    def test_unanchored_doc_flagged_not_dropped(self, ctrace_env):
+        a = _mk_doc("A", 5.0, [("x", "t", "s1", 1.0, None)])
+        b = _mk_doc("B", 0.0, [("y", "t", "s2", 2.0, None)])
+        del b["ftpu"]["clock"]
+        merged = ct.merge_docs([a, b])
+        ev = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+        assert len(ev) == 2
+        errs = merged["ftpu"]["cluster"]["errors"]
+        assert any("clock anchor" in e for e in errs)
+
+    def test_merge_files_reports_unreadable(self, ctrace_env,
+                                            tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(
+            _mk_doc("A", 0.0, [("x", "t", "s1", 1.0, None)])))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        merged = ct.merge_files([str(good), str(bad)])
+        assert len([e for e in merged["traceEvents"]
+                    if e["ph"] != "M"]) == 1
+        assert any("bad.json" in e
+                   for e in merged["ftpu"]["cluster"]["errors"])
+
+    def test_live_ring_merge_dedups_two_exports(self, ctrace_env):
+        tracing.set_node("nodeA")
+        try:
+            with tracing.span("order.window"):
+                pass
+        finally:
+            tracing.set_node(None)
+        doc1 = tracing.chrome_trace()
+        doc2 = tracing.chrome_trace()     # same ring, second export
+        merged = ct.merge_docs([doc1, doc2])
+        ev = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+        assert len(ev) == 1
+        assert ev[0]["args"]["node"] == "nodeA"
+
+
+# ---------------------------------------------------------------------------
+# e2e finality + the SLO error budget
+# ---------------------------------------------------------------------------
+
+class TestE2ECommit:
+    def test_birth_to_commit_math(self, ctrace_env):
+        tid = "trace-e2e"
+        ct.note_birth(tid, time.time() - 0.5)
+        ctx = tracing.TraceContext(tid, "s")
+        e2e = ct.note_commit(ctx, node="peer0")
+        assert 0.4 < e2e < 2.0
+        assert tracing.stage_quantile("e2e.commit", "count") == 1
+
+    def test_no_birth_no_observation(self, ctrace_env):
+        assert ct.note_commit(
+            tracing.TraceContext("unknown", "s")) is None
+        assert ct.note_commit(None) is None
+
+    def test_multi_peer_commit_histogram_renders(self, ctrace_env):
+        provider = metrics_mod.PrometheusProvider()
+        tracing.bind_metrics(provider)
+        tid = "trace-m"
+        ct.note_birth(tid, time.time() - 0.1)
+        ctx = tracing.TraceContext(tid, "s")
+        ct.note_commit(ctx, node="peer0")
+        ct.note_commit(ctx, node="peer1")
+        text = provider.render()
+        assert 'e2e_commit_seconds_count{node="peer0"} 1' in text
+        assert 'e2e_commit_seconds_count{node="peer1"} 1' in text
+
+    def test_hop_histogram_renders(self, ctrace_env):
+        provider = metrics_mod.PrometheusProvider()
+        tracing.bind_metrics(provider)
+        c = ct.Carrier("t", "s", sent=time.time())
+        with ct.resumed(c, link="a>b"):
+            pass
+        assert 'hop_seconds_count{link="a>b"} 1' in provider.render()
+
+
+class TestSLO:
+    def test_burn_rate_math(self, ctrace_env):
+        slo = ct.SLOTracker(0.1)
+        for _ in range(50):
+            slo.observe(0.01)               # all under target
+        assert slo.burn_rate() == 0.0
+        assert slo.health() == "ok"
+        for _ in range(ct.SLO_MIN_OBS):
+            slo.observe(1.0)                # all over target
+        # 20/70 over budget of 1% -> burning hard
+        assert slo.burn_rate() == pytest.approx(
+            (ct.SLO_MIN_OBS / 70) / ct.SLO_ERROR_BUDGET)
+        assert slo.health().startswith("burning:")
+
+    def test_fractional_budget(self, ctrace_env):
+        slo = ct.SLOTracker(0.1)
+        for i in range(100):
+            slo.observe(1.0 if i < 2 else 0.01)   # 2% violations
+        assert slo.burn_rate() == pytest.approx(2.0)
+
+    def test_no_target_is_ok(self, ctrace_env):
+        slo = ct.SLOTracker(None)
+        slo.observe(100.0)
+        assert slo.health() == "ok"
+        assert slo.stats["observed"] == 0
+
+    def test_thin_evidence_never_burns(self, ctrace_env):
+        slo = ct.SLOTracker(0.1)
+        for _ in range(ct.SLO_MIN_OBS - 1):
+            slo.observe(1.0)
+        assert slo.health() == "ok"     # under SLO_MIN_OBS
+
+    def test_sustained_burn_dumps_once_per_episode(self, ctrace_env):
+        slo = ct.SLOTracker(0.1)
+        for _ in range(ct.SLO_MIN_OBS + 5):
+            slo.observe(1.0)
+        assert slo.stats["dumps"] == 1          # latched
+        tracing.wait_dumps()
+        assert any(e[1] == "slo.burn" for e in tracing.snapshot())
+        dumps = [p for p in ctrace_env.iterdir()
+                 if "slo_burn" in p.name]
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["ftpu"]["reason"] == "slo_burn"
+        # recover, then burn again -> ONE more dump
+        for _ in range(ct.SLO_WINDOW):
+            slo.observe(0.01)
+        assert slo.health() == "ok"
+        for _ in range(ct.SLO_WINDOW):
+            slo.observe(1.0)
+        assert slo.stats["dumps"] == 2
+
+    def test_healthz_substate(self, ctrace_env):
+        from fabric_tpu.node.operations import OperationsServer
+        ct.configure_slo(0.1)
+        ops = OperationsServer()
+        ops.register_checker("slo", ct.slo_health)
+        ops.start()
+        try:
+            def healthz():
+                with urllib.request.urlopen(
+                        f"http://{ops.address}/healthz",
+                        timeout=5) as r:
+                    return json.load(r)
+
+            assert healthz()["components"]["slo"] == "ok"
+            tid = "slo-trace"
+            ct.note_birth(tid, time.time() - 10.0)
+            for _ in range(ct.SLO_MIN_OBS + 1):
+                ct.note_commit(tracing.TraceContext(tid, "s"),
+                               node="p")
+            body = healthz()
+            assert body["status"] == "OK"       # degraded-but-serving
+            assert body["components"]["slo"].startswith("burning:")
+        finally:
+            ops.stop()
+            tracing.wait_dumps()
+
+    def test_config_entry(self, ctrace_env):
+        class _Cfg:
+            def get(self, key, default=None):
+                return {"Operations.SLO.CommitP99S": "0.25"}.get(
+                    key, default)
+
+        ct.configure_from_config(_Cfg())
+        assert ct.slo().target_p99_s == 0.25
+
+
+# ---------------------------------------------------------------------------
+# the debug surfaces
+# ---------------------------------------------------------------------------
+
+class TestTraceEndpoints:
+    @pytest.fixture()
+    def two_ops(self, ctrace_env):
+        from fabric_tpu.node.operations import OperationsServer
+        ops_a = OperationsServer()
+        ops_b = OperationsServer()
+        ops_a.set_trace_peers([ops_b.address])
+        ops_a.start()
+        ops_b.start()
+        yield ops_a, ops_b
+        ops_a.stop()
+        ops_b.stop()
+
+    @staticmethod
+    def _get(addr, path):
+        with urllib.request.urlopen(f"http://{addr}{path}",
+                                    timeout=5) as r:
+            return json.load(r)
+
+    def test_trace_id_filter_on_debug_trace(self, two_ops):
+        ops_a, _ = two_ops
+        with tracing.span("keep.me") as keep:
+            pass
+        with tracing.span("drop.me"):
+            pass
+        doc = self._get(ops_a.address,
+                        f"/debug/trace?trace_id={keep.trace_id}")
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e["ph"] != "M"}
+        assert names == {"keep.me"}
+        # unfiltered still ships everything
+        full = self._get(ops_a.address, "/debug/trace")
+        names = {e["name"] for e in full["traceEvents"]
+                 if e["ph"] != "M"}
+        assert {"keep.me", "drop.me"} <= names
+        assert full["ftpu"]["clock"]["epoch_wall_s"] > 0
+
+    def test_cluster_endpoint_merges_and_forwards(self, two_ops):
+        ops_a, _ = two_ops
+        with tracing.span("order.window") as keep:
+            pass
+        with tracing.span("other.trace"):
+            pass
+        doc = self._get(
+            ops_a.address,
+            f"/debug/trace/cluster?trace_id={keep.trace_id}")
+        ev = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+        # both endpoints exported the same shared ring: the filter
+        # was FORWARDED and the merge deduplicated by span id
+        assert len(ev) == 1
+        assert ev[0]["args"]["trace_id"] == keep.trace_id
+        assert doc["ftpu"]["cluster"]["docs"] == 2
+        assert doc["ftpu"]["cluster"]["errors"] == []
+
+    def test_cluster_endpoint_tolerates_dead_peer(self, ctrace_env):
+        from fabric_tpu.node.operations import OperationsServer
+        ops = OperationsServer()
+        ops.set_trace_peers(["127.0.0.1:1"])     # nothing listens
+        ops.start()
+        try:
+            with tracing.span("alive"):
+                pass
+            doc = self._get(ops.address, "/debug/trace/cluster")
+            assert any(e["name"] == "alive"
+                       for e in doc["traceEvents"])
+            assert doc["ftpu"]["cluster"]["errors"]
+        finally:
+            ops.stop()
+
+    def test_trace_peers_comma_string(self, ctrace_env):
+        from fabric_tpu.node.operations import OperationsServer
+        ops = OperationsServer()
+        ops.start()
+        try:
+            ops.set_trace_peers("a:1, b:2 ,")
+            assert ops._trace_peers == ["a:1", "b:2"]
+            ops.set_trace_peers(None)
+            assert ops._trace_peers == []
+        finally:
+            ops.stop()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance rig: 3 consenters + 2 peers, one merged trace
+# ---------------------------------------------------------------------------
+
+class TestClusterRun:
+    def test_three_consenter_two_peer_merged_trace(self, ctrace_env):
+        import bench_pipeline as bp
+        out = bp.cluster_trace_run(ntxs=8, block_txs=4, window=6)
+        assert out["probe_trace_id"]
+        # commit.validate/commit.commit landed on BOTH peers
+        assert set(out["commit_nodes"].split(",")) == {
+            "peer0.example.com:7051", "peer1.example.com:7052"}
+        # the probe crossed at least one consenter hop + both peers
+        nodes = set(out["trace_nodes"].split(","))
+        assert len(nodes) >= 4
+        for want in ("ingress.batch", "hop.recv", "order.write",
+                     "commit.validate", "commit.commit"):
+            assert want in out["linked_stages"].split(","), out
+        assert out["e2e_commit_p50_s"] > 0
+        assert out["e2e_commit_p99_s"] > 0
+        assert out["slo_health"] == "ok" or \
+            out["slo_health"].startswith("burning:")
+
+    def test_disabled_tracing_skips(self, ctrace_env):
+        import bench_pipeline as bp
+        tracing.set_enabled(False)
+        try:
+            assert bp.cluster_trace_run()["skipped"]
+        finally:
+            tracing.set_enabled(True)

@@ -129,13 +129,31 @@ if "skipped" not in fp and not fp.get("order_skipped"):
             f"full_pipeline lacks trace_file: {fp}"
         trace = json.load(open(fp["trace_file"]))
         assert trace.get("traceEvents"), "trace file has no events"
+        # round-18: the export header carries the clock anchor the
+        # cluster merger aligns by
+        assert (trace.get("ftpu") or {}).get("clock", {}).get(
+            "epoch_wall_s"), "trace file lacks the clock anchor"
         linked = set((fp.get("trace_linked_stages") or "").split(","))
         for stage in ("ingress.batch", "order.window", "order.write",
                       "commit.validate", "commit.commit"):
             assert stage in linked, \
                 f"probe trace does not link {stage!r}: {sorted(linked)}"
+        # round-18: the probe's trace must CROSS nodes (orderer track
+        # + the commit leg's peer track), and the stage line carries
+        # the e2e finality tails (or the explicit skip marker)
+        tnodes = [n for n in (fp.get("trace_nodes") or "").split(",")
+                  if n]
+        assert len(tnodes) >= 2, \
+            f"probe trace did not cross nodes: {fp.get('trace_nodes')}"
+        if "e2e_skipped" not in fp:
+            assert fp.get("e2e_commit_p50_s", 0) > 0, \
+                f"full_pipeline lacks e2e_commit_p50_s: {fp}"
+            assert fp.get("e2e_commit_p99_s", 0) > 0, \
+                f"full_pipeline lacks e2e_commit_p99_s: {fp}"
         print("bench_smoke: lifecycle trace", fp["trace_file"],
-              "links", sorted(linked))
+              "links", sorted(linked), "across", tnodes,
+              "e2e_p99", fp.get("e2e_commit_p99_s",
+                                fp.get("e2e_skipped")))
 
 # round-15 contract: the full_pipeline line carries the bounded
 # leader-kill failover facts (or an explicit skip marker) — fields

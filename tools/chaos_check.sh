@@ -12,7 +12,7 @@
 # Spec grammar: point=mode[:count][:delay_s][:arg], mode in
 # {error, delay}; the 4th field targets a check() argument (the
 # per-device points pass the full-mesh chip index).
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|net|devicecost|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|net|devicecost|e2e-trace|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -200,6 +200,20 @@ devicecost() {
         -k "Degradation or CompileSeam or ProviderJitSeam"
 }
 
+e2e_trace() {
+    # the round-18 cross-node tracing layer under fire: net.drop /
+    # net.reorder chaos on live links plus an armed order.propose —
+    # wire carriers must SURVIVE (dup/reorder forward without
+    # re-parenting, drops just lose hops), armed faults must surface
+    # as error-status spans, and the merged cluster trace + e2e/SLO
+    # contracts must hold throughout
+    run "net.drop=error:3;net.reorder=error:2" \
+        tests/test_cluster_trace.py
+    run "net.dup=error:2;order.propose=error:1" \
+        tests/test_cluster_trace.py \
+        -k "Carrier or Chaos or Cluster or Resume"
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -220,10 +234,11 @@ case "${1:-all}" in
     tracing) tracing ;;
     net) net ;;
     devicecost) devicecost ;;
+    e2e-trace) e2e_trace ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
          schemes; overload; mesh_health; tracing; net; devicecost;
-         static ;;
+         e2e_trace; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

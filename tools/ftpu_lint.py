@@ -122,6 +122,20 @@ for _path, _funcs in {
     # it) must open its `tpu.compile` span — the compile telemetry
     # and the cold-compile postmortem dumps ride it
     "fabric_tpu/common/devicecost.py": ("run_compile",),
+    # round-18 carrier EXTRACTION seams: every cross-node transport
+    # drain (cluster consensus, cluster gRPC, gossip) and the deliver
+    # feeder must resume the wire carrier (clustertrace.resumed) — a
+    # new transport path that skips this silently drops propagation
+    # and the cluster trace falls apart into per-node orphans
+    "fabric_tpu/orderer/cluster.py": ("_drain", "handle_submit"),
+    "fabric_tpu/comm/cluster_grpc.py": ("_drain", "handle_submit"),
+    "fabric_tpu/gossip/transport.py": ("_drain",),
+    "fabric_tpu/peer/deliverclient.py": ("_pull",),
+    # note_commit records the e2e finality observation — rename it
+    # and every commit seam goes blind at once (`resumed` is covered
+    # transitively: it is itself a recognized span-opening call, so a
+    # seam that drops it trips the entries above)
+    "fabric_tpu/common/clustertrace.py": ("note_commit",),
 }.items():
     REQUIRED_SPANS[_path] = REQUIRED_SPANS.get(_path, ()) + _funcs
 
@@ -392,7 +406,11 @@ def _hot_coverage_findings(rel, tree):
 
 # -- rule: span-coverage --
 
-_SPAN_CALLS = {"span", "observe_span", "observe_stage", "instant"}
+_SPAN_CALLS = {"span", "observe_span", "observe_stage", "instant",
+               # round 18: the carrier-resume primitive opens the
+               # hop.recv span — extraction seams satisfy span
+               # coverage through it
+               "resumed"}
 
 
 def _is_traced_decorator(dec) -> bool:
