@@ -1440,6 +1440,42 @@ def stage_pipeline():
     _PARTIAL["failover"] = failover
     detail["failover"] = failover
 
+    # round-19: the adaptive admission control plane vs the same rig
+    # with static knobs — closed-loop clients against a chaos-wrapped
+    # 3-consenter + 2-peer cluster, reporting max sustainable tx/s at
+    # the p99 commit SLO. Like the order/failover sections, a skip is
+    # explicit so the smoke gate can tell "didn't run" from "ran but
+    # lost its fields".
+    if os.environ.get("BENCH_ADAPTIVE", "1") != "1":
+        adaptrig = {"skipped": "BENCH_ADAPTIVE!=1"}
+    elif _remaining() <= 90:
+        adaptrig = {"skipped": "time budget exhausted"}
+    else:
+        # the rig builds its own controller; it refuses to run as a
+        # vacuous static-vs-static comparison when the control plane
+        # is globally disabled, so enable it for the section only
+        prev_adaptive = os.environ.get("FTPU_ADAPTIVE")
+        os.environ["FTPU_ADAPTIVE"] = "1"
+        try:
+            import bench_pipeline
+            adaptrig = bench_pipeline.adaptive_serving_run(
+                ntxs=int(os.environ.get(
+                    "BENCH_ADAPTIVE_TXS", "240" if SMOKE else "2400")),
+                invalid=int(os.environ.get(
+                    "BENCH_ADAPTIVE_INVALID", "8" if SMOKE else "48")),
+                slo_target_s=float(os.environ.get(
+                    "BENCH_ADAPTIVE_SLO_S", "1.5")),
+                deadline_s=max(60.0, _remaining() - 20))
+        except Exception as e:          # noqa: BLE001
+            adaptrig = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            if prev_adaptive is None:
+                os.environ.pop("FTPU_ADAPTIVE", None)
+            else:
+                os.environ["FTPU_ADAPTIVE"] = prev_adaptive
+    _PARTIAL["adaptive"] = adaptrig
+    detail["adaptive"] = adaptrig
+
     idemix = None
     if want("BENCH_IDEMIX"):
         try:
@@ -1527,6 +1563,28 @@ def stage_pipeline():
         # gate's "lacks failover_reelect_s" alone sends the
         # investigator to the wrong place
         res["failover_error"] = failover["error"]
+    if adaptrig and "max_sustainable_tx_s" in adaptrig:
+        # round-19 control-plane facts on the stage line: the serving
+        # capacity the rig sustained INSIDE the SLO, whether the
+        # closed loop beat the static baseline, and that the
+        # anti-flap discipline held (phase details ride the sidecar)
+        res["max_sustainable_tx_s"] = adaptrig["max_sustainable_tx_s"]
+        res["adaptive_slo_held"] = adaptrig["slo_held"]
+        res["adaptive_slo_target_s"] = adaptrig["slo_target_s"]
+        res["adaptive_p99_s"] = \
+            adaptrig["adaptive"]["commit_p99_s"]
+        res["adaptive_static_tx_s"] = adaptrig["static"]["tx_s"]
+        res["adaptive_beats_static"] = \
+            adaptrig["adaptive_beats_static"]
+        res["adaptive_no_flap"] = adaptrig["no_flap"]
+        res["adaptive_controller_moves"] = \
+            adaptrig["controller_moves"]
+        res["adaptive_exact_once"] = \
+            adaptrig["accepted_commit_exact_once"]
+    elif adaptrig and "skipped" in adaptrig:
+        res["adaptive_skipped"] = adaptrig["skipped"]
+    elif adaptrig and "error" in adaptrig:
+        res["adaptive_error"] = adaptrig["error"]
     if pipeline and "tpu_peer_block_s" in pipeline:
         res["e2e_tpu_peer_block_s"] = pipeline["tpu_peer_block_s"]
     emit_final(res, detail)

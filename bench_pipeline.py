@@ -418,7 +418,17 @@ def make_order_support(root: str, client=None, csp=None,
     provider = csp if csp is not None else sw
     ingress = AdmissionWindow.shared(provider)
 
-    okey = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+    # one orderer signing key per RIG, parked on the shared client:
+    # snapshot catch-up verifies pulled-block signatures against the
+    # block SOURCE, so every consenter of a multi-node bench cluster
+    # must sign under the same (stub) orderer identity
+    okey = getattr(client, "_bench_orderer_key", None)
+    if okey is None:
+        okey = sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+        try:
+            client._bench_orderer_key = okey
+        except Exception:
+            pass
     opub = okey.public_key()
 
     class _OrdererSigner:
@@ -561,6 +571,78 @@ def make_order_support(root: str, client=None, csp=None,
             self._sequence += 1
             if self.on_config is not None:
                 self.on_config(self, block)
+
+        def verify_onboarded_span(self, blocks) -> tuple:
+            """Snapshot catch-up verification over the stub MSP:
+            numbering from the ledger tip, data-hash, prev-hash
+            linkage, and every block signature against the rig's
+            shared orderer identity in ONE batched dispatch (the stub
+            has a single orderer principal, so the full policy
+            re-derivation of the real ChainSupport collapses to
+            that key)."""
+            from fabric_tpu.orderer.onboarding import VerificationError
+            height = self.ledger.height
+            prev = None
+            if height:
+                prev = pu.block_header_hash(
+                    self.ledger.get_block(height - 1).header)
+            evals, items = [], []
+            error = None
+            for i, b in enumerate(blocks):
+                number = height + i
+                try:
+                    if b.header.number != number:
+                        raise VerificationError(
+                            b.header.number,
+                            f"out of order (expected {number})")
+                    if b.header.data_hash != \
+                            pu.block_data_hash(b.data):
+                        raise VerificationError(
+                            number, "data hash mismatch")
+                    if prev is not None and \
+                            b.header.previous_hash != prev:
+                        raise VerificationError(
+                            number, "previous-hash linkage broken")
+                    lo, n = len(items), 0
+                    if number > 0:
+                        signed = pu.block_signature_set(b)
+                        if not signed:
+                            raise VerificationError(
+                                number, "unsigned block")
+                        for sd in signed:
+                            if sd.identity != signer.serialize():
+                                raise VerificationError(
+                                    number, "unknown block signer")
+                            items.append(signer.verify_item(
+                                sd.data, sd.signature))
+                        n = len(signed)
+                except Exception as e:
+                    error = e if isinstance(e, VerificationError) \
+                        else VerificationError(number, str(e))
+                    break
+                evals.append((number, lo, n))
+                prev = pu.block_header_hash(b.header)
+            ok = provider.verify_batch(items) if items else []
+            n_valid = 0
+            for number, lo, n in evals:
+                if not all(ok[lo:lo + n]):
+                    error = VerificationError(
+                        number, "block signature invalid")
+                    break
+                n_valid += 1
+            return n_valid, error
+
+        def commit_onboarded_block(self, block) -> None:
+            """Commit one VERIFIED pulled block verbatim (it keeps the
+            source's signatures) and resync the writer's tip."""
+            if block.header.number != self.ledger.height:
+                raise ValueError(
+                    f"onboarding block {block.header.number} out of "
+                    f"order (height {self.ledger.height})")
+            self.ledger.add_block(block)
+            self.writer.resync(block)
+            if pu.is_config_block(block):
+                self._last_config = block.header.number
 
         def close(self):
             self.ledger.close()
@@ -1605,6 +1687,668 @@ def overload_run(producers: int = 4, ntxs_per_producer: int = 300,
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _scheme_mix_run(n_items: int = 96, n_keys: int = 24,
+                    hot_keys: int = 4, hot_frac: float = 0.8,
+                    ed_items: int = 24, bls_items: int = 4,
+                    invalid_frac: float = 0.1,
+                    seed: int = 5) -> dict:
+    """The Caliper-style scenario-mix side workload of the round-19
+    serving rig: ONE mixed batch through a fresh `AdmissionWindow` —
+    P-256 endorsement checks under a hot-key vs long-tail key
+    distribution (`hot_frac` of items signed by `hot_keys` keys, the
+    rest spread over the tail), an Ed25519 MSP slice, a (small — the
+    wheel-free pairing costs ~0.25s/verify) BLS consenter slice, and
+    an adversarial invalid-signature mix. Every valid item must
+    verify, every corrupted one must be refused — the mixed batch
+    exercises the window's scheme router + span splitter exactly the
+    way a mixed-tenant serving plane would."""
+    import hashlib
+    import random
+
+    from fabric_tpu.bccsp import (BLSKeyGenOpts, ECDSAKeyGenOpts,
+                                  Ed25519KeyGenOpts, VerifyItem)
+    from fabric_tpu.bccsp.admission import AdmissionWindow
+    from fabric_tpu.bccsp.sw import SWProvider
+
+    rng = random.Random(seed)
+    sw = SWProvider()
+    window = AdmissionWindow.shared(sw)
+    ec_keys = [sw.key_gen(ECDSAKeyGenOpts(ephemeral=True))
+               for _ in range(n_keys)]
+    ed_keys = [sw.key_gen(Ed25519KeyGenOpts(ephemeral=True))
+               for _ in range(max(2, hot_keys))]
+    bls_keys = [sw.key_gen(BLSKeyGenOpts(ephemeral=True))
+                for _ in range(2)]
+
+    items, want, schemes = [], [], []
+    key_picks = {"hot": 0, "tail": 0}
+    t_sign0 = time.perf_counter()
+    for i in range(n_items + ed_items + bls_items):
+        msg = f"scheme-mix item {i}".encode()
+        if i < n_items:
+            if rng.random() < hot_frac:
+                key = ec_keys[rng.randrange(hot_keys)]
+                key_picks["hot"] += 1
+            else:
+                key = ec_keys[hot_keys +
+                              rng.randrange(n_keys - hot_keys)]
+                key_picks["tail"] += 1
+            sig = sw.sign(key, hashlib.sha256(msg).digest())
+            schemes.append("p256")
+        elif i < n_items + ed_items:
+            key = ed_keys[rng.randrange(len(ed_keys))]
+            sig = sw.sign(key, msg)   # message-based scheme
+            schemes.append("ed25519")
+        else:
+            key = bls_keys[rng.randrange(len(bls_keys))]
+            sig = sw.sign(key, msg)
+            schemes.append("bls12381")
+        ok = rng.random() >= invalid_frac
+        if not ok:
+            # wrong-message signature: well-formed, must verify False
+            bad = msg + b"#tampered"
+            if schemes[-1] == "p256":
+                sig = sw.sign(key, hashlib.sha256(bad).digest())
+            else:
+                sig = sw.sign(key, bad)
+        items.append(VerifyItem(key=key.public_key(), signature=sig,
+                                message=msg))
+        want.append(ok)
+    sign_s = time.perf_counter() - t_sign0
+
+    t0 = time.perf_counter()
+    got = window.verify_batch(items)
+    verify_s = time.perf_counter() - t0
+    mismatches = [i for i, (g, w) in enumerate(zip(got, want))
+                  if bool(g) != w]
+    assert not mismatches, \
+        (f"scheme-mix verdict mismatch at {mismatches[:5]} "
+         f"(schemes {[schemes[i] for i in mismatches[:5]]})")
+    return {
+        "items": len(items),
+        "schemes": {s: schemes.count(s)
+                    for s in ("p256", "ed25519", "bls12381")},
+        "key_distribution": key_picks,
+        "invalid_refused": sum(1 for w in want if not w),
+        "sign_s": round(sign_s, 3),
+        "verify_s": round(verify_s, 3),
+        "verify_per_s": round(len(items) / max(verify_s, 1e-9), 1),
+        "q16_table_cache": "skipped (sw provider)",
+        "all_verdicts_exact": True,
+    }
+
+
+def adaptive_serving_run(consenters: int = 3, workers: int = 6,
+                         ntxs: int = 2400, invalid: int = 48,
+                         clients: int = 20000,
+                         block_txs: int = 1,
+                         slo_target_s: float = 1.5,
+                         events_cap: int = 256,
+                         interval_s: float = 0.25,
+                         warmup_frac: float = 0.25,
+                         seed: int = 11,
+                         drop_rate: float = 0.02,
+                         dup_rate: float = 0.01,
+                         reorder_rate: float = 0.02,
+                         reorder_window: int = 4,
+                         flap_ceiling: int = 6,
+                         adjust_ceiling: int = 250,
+                         scheme_mix: bool = True,
+                         deadline_s: float = 600.0) -> dict:
+    """ISSUE 19 acceptance rig: the closed-loop serving benchmark that
+    pits the ADAPTIVE admission control plane against the same rig
+    with static knobs, and reports **max sustainable tx/s at a held
+    p99 commit SLO**.
+
+    Topology per phase (built fresh twice, identical except for the
+    controller): a 3-consenter raft ordering cluster with every
+    inter-consenter link under seeded network chaos, plus two peers
+    fed post-load from DISTINCT consenters (peer0 off the leader's
+    deliver stream, peer1 off a follower's) through real
+    CommitPipelines. `workers` closed-loop clients — multiplexing
+    `clients` simulated client identities (the tx payload carries the
+    client id) — submit pre-signed P-256 envelopes one at a time
+    under the live ingress deadline budget, with `invalid`
+    corrupted-signature envelopes interleaved (they must be refused,
+    never committed). `block_txs=1` makes the signed-block writer the
+    genuine serving bottleneck (~5ms sign+self-verify per block on
+    the wheel-free provider), so offered load really does exceed
+    drain capacity and the static phase exhibits bufferbloat: the
+    raft events queue absorbs the excess and commit p99 blows through
+    the SLO. A watcher thread stamps every commit against its submit
+    time and feeds `clustertrace.slo()` live — the burn signal the
+    controller (adaptive phase only) closes the loop on, shrinking
+    queue capacities and deadline budgets until latency is bounded by
+    shallow queues instead of deep ones.
+
+    Methodology (Caliper-style): per phase, p99 and throughput are
+    computed over the steady window — commits whose SUBMIT fell after
+    `warmup_frac` of the load wall (the warmup covers the
+    controller's reaction time in the adaptive phase and the
+    queue-growth ramp in the static one); `slo_held` is steady-window
+    p99 <= target; `max_sustainable_tx_s` is the adaptive phase's
+    steady-window committed rate. `adaptive_beats_static` per the
+    acceptance bar: the adaptive phase holds the SLO AND (the static
+    phase burns it OR adaptive sustained more tx/s). Controller
+    adjustments are bounded: reversals <= `flap_ceiling`, total moves
+    <= `adjust_ceiling`. The adaptive phase's committed stream must
+    replay bit-identically through a fresh sequential oracle, and
+    accepted == committed exactly-once in BOTH phases."""
+    import gc
+    import shutil
+    import threading
+    import types
+
+    from fabric_tpu.common import (adaptive, clustertrace, netchaos,
+                                   overload, tracing)
+    from fabric_tpu.common import metrics as metrics_mod
+    from fabric_tpu.common.deliver import DeliverHandler
+    from fabric_tpu.core.commitpipeline import CommitPipeline
+    from fabric_tpu.core.txvalidator import ValidationResult
+    from fabric_tpu.orderer.cluster import LocalClusterNetwork
+    from fabric_tpu.peer.deliverclient import seek_envelope
+    from fabric_tpu.protos import common as cpb
+    from fabric_tpu.protos import transaction as txpb
+    from fabric_tpu.protoutil.protoutil import marshal as pu_marshal
+
+    if not adaptive.enabled():
+        return {"skipped": "FTPU_ADAPTIVE disabled"}
+
+    root = tempfile.mkdtemp(prefix="bench_adaptive_")
+    t_run0 = time.perf_counter()
+    deadline = time.monotonic() + deadline_s
+    peer_eps = ["peer0.example.com:7051", "peer1.example.com:7052"]
+    client = make_order_client(channel="adaptbench")
+
+    # ---- pre-signed envelope pool (untimed setup, shared by both
+    # phases — each phase runs over a fresh ledger, so identical tx
+    # ids never meet). The payload carries the simulated client id:
+    # `workers` threads multiplex `clients` logical clients, the
+    # closed-loop Caliper shape.
+    pool = []                     # (envelope, marshalled, valid)
+    for i in range(ntxs):
+        env = client.envelope(
+            i, payload=f"c{i % clients}:tx{i}".encode())
+        pool.append((env, pu_marshal(env), True))
+    inv_step = max(1, ntxs // max(1, invalid))
+    for j in range(invalid):
+        env = client.envelope(
+            ntxs + j, payload=f"c{j % clients}:bad{j}".encode())
+        # adversarial mix: a WELL-FORMED signature over the wrong
+        # bytes — it must fail verification cleanly (a malformed
+        # encoding would test the parser, not the policy)
+        env.signature = client.signer.sign(
+            env.payload + b"#tampered")
+        # interleave the adversarial mix evenly through the stream
+        pool.insert(min(len(pool), j * inv_step + inv_step // 2),
+                    (env, pu_marshal(env), False))
+    invalid_raws = {raw for _e, raw, ok in pool if not ok}
+
+    class _Validator:
+        def validate_ahead(self, block, known_txids=None):
+            v0 = time.perf_counter()
+            n = len(block.data.data)
+            return ValidationResult(
+                codes=[txpb.TxValidationCode.VALID] * n,
+                n_items=n,
+                duration_s=time.perf_counter() - v0)
+
+        def publish_validation(self, block, result):
+            while len(block.metadata.metadata) <= \
+                    cpb.BlockMetadataIndex.TRANSACTIONS_FILTER:
+                block.metadata.metadata.append(b"")
+            block.metadata.metadata[
+                cpb.BlockMetadataIndex.TRANSACTIONS_FILTER] = \
+                bytes(result.codes)
+
+        def validate(self, block):
+            result = self.validate_ahead(block)
+            self.publish_validation(block, result)
+            return result.codes
+
+    class _BlockStore:
+        @staticmethod
+        def block_tx_ids(block):
+            return [""] * len(block.data.data)
+
+    class _PeerChan:
+        channel_id = client.channel
+
+        def __init__(self):
+            self.ledger = types.SimpleNamespace(
+                height=1, block_store=_BlockStore())
+            self.validator = _Validator()
+            self.committed: list = []
+
+        def commit_validated(self, block, codes, rwsets=None,
+                             tx_ids=None):
+            self.committed.append(block.header.number)
+            self.ledger.height = block.header.number + 1
+            return list(codes)
+
+        def process_block(self, block):
+            codes = self.validator.validate(block)
+            return self.commit_validated(block, codes)
+
+    def run_phase(name: str, with_controller: bool) -> dict:
+        eps = [f"orderer{i}.{name}.example.com:{7050 + i}"
+               for i in range(consenters)]
+        tracing.reset()
+        clustertrace.reset()
+        adaptive.reset()
+        gc.collect()
+        provider = metrics_mod.PrometheusProvider()
+        tracing.bind_metrics(provider)
+        clustertrace.configure_slo(slo_target_s)
+        chaos = netchaos.NetChaos(seed=seed)
+        chaos.set_policy(netchaos.LinkPolicy(
+            drop_rate=drop_rate, dup_rate=dup_rate,
+            reorder_rate=reorder_rate,
+            reorder_window=reorder_window))
+        net = LocalClusterNetwork()
+        svcs: dict = {}
+        pipes: list = []
+        ctl = None
+        os.environ["FTPU_RAFT_EVENTS_CAP"] = str(events_cap)
+        try:
+            for i, ep in enumerate(eps):
+                svcs[ep] = make_order_service(
+                    os.path.join(root, name, f"o{i}"),
+                    client=client, channel=client.channel,
+                    endpoint=ep, endpoints=eps,
+                    net=net, block_txs=block_txs,
+                    batch_timeout_s=0.1,
+                    # the leader's loop stalls up to ~events_cap x
+                    # 5ms in writer backpressure under overload; the
+                    # election timeout must ride it out or a healthy
+                    # leader gets deposed mid-burn
+                    tick_interval_s=0.02, election_tick=200,
+                    transport_wrap=chaos.wrap_cluster)
+        finally:
+            os.environ.pop("FTPU_RAFT_EVENTS_CAP", None)
+        try:
+            def leader_ep():
+                from fabric_tpu.orderer.raft.core import LEADER
+                for ep, s in svcs.items():
+                    if s.chain.node.state == LEADER:
+                        return ep
+                return None
+
+            while leader_ep() is None:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(f"{name}: no raft leader")
+                time.sleep(0.005)
+            lead = svcs[leader_ep()]
+
+            if with_controller:
+                # the shared AdmissionWindow is cached per provider
+                # and registered its span knob when the STATIC phase
+                # built it; adaptive.reset() cleared the registry, so
+                # re-park the knob for this phase's controller
+                from fabric_tpu.bccsp.admission import \
+                    AdmissionWindow
+                win = AdmissionWindow.shared(client.sw)
+                if "bccsp.admission.span" not in adaptive.knobs():
+                    adaptive.register_attr_knob(
+                        win, "max_window_items",
+                        "bccsp.admission.span",
+                        floor=16, ceiling=win._SPAN_CAP)
+                ctl = adaptive.start_controller(
+                    metrics_provider=provider,
+                    interval_s=interval_s)
+                if ctl is None:
+                    raise RuntimeError(
+                        "adaptive controller failed to start")
+
+            # ---- closed-loop load ----
+            slices = [pool[w::workers] for w in range(workers)]
+            submit_t: dict = {}
+            sub_lock = threading.Lock()
+            accepted: list = [[] for _ in range(workers)]
+            shed = [0] * workers
+            rejected = [0] * workers
+            errors: list = []
+            committed: list = []
+            n_target = [None]      # set once workers finish
+            stop_watch = threading.Event()
+            lat: list = []         # (submit_t, commit_t, latency_s)
+
+            def worker(w: int) -> None:
+                for env, raw, _ok in slices[w]:
+                    now = time.perf_counter()
+                    with sub_lock:
+                        submit_t[raw] = now
+                    try:
+                        budget = overload.ingress_budget_s()
+                        with overload.Deadline.after(
+                                budget).applied():
+                            resp = lead.broadcast.process_messages(
+                                [env])[0]
+                    except Exception as e:  # noqa: BLE001
+                        errors.append(f"{name} worker {w}: {e!r}")
+                        return
+                    if resp.status == cpb.Status.SUCCESS:
+                        accepted[w].append(raw)
+                    else:
+                        with sub_lock:
+                            submit_t.pop(raw, None)
+                        if resp.status == \
+                                cpb.Status.SERVICE_UNAVAILABLE:
+                            shed[w] += 1
+                        else:
+                            rejected[w] += 1
+
+            def watcher() -> None:
+                ledger = lead.support.ledger
+                next_block = 1
+                slo = clustertrace.slo()
+                while True:
+                    advanced = True
+                    while advanced:
+                        advanced = False
+                        while next_block < ledger.height:
+                            b = ledger.get_block(next_block)
+                            if b is None:
+                                break
+                            now = time.perf_counter()
+                            for d in b.data.data:
+                                raw = bytes(d)
+                                with sub_lock:
+                                    st = submit_t.get(raw)
+                                if st is not None:
+                                    lsec = now - st
+                                    slo.observe(lsec)
+                                    lat.append((st, now, lsec))
+                                committed.append(raw)
+                            next_block += 1
+                            advanced = True
+                    if stop_watch.is_set():
+                        return
+                    if n_target[0] is not None and \
+                            len(committed) >= n_target[0]:
+                        return
+                    time.sleep(0.02)
+
+            t_load0 = time.perf_counter()
+            wthreads = [threading.Thread(
+                target=worker, args=(w,),
+                name=f"adaptive-client-{w}")
+                for w in range(workers)]
+            watch = threading.Thread(target=watcher,
+                                     name="adaptive-watcher")
+            watch.start()
+            for t in wthreads:
+                t.start()
+            for t in wthreads:
+                t.join(timeout=max(5.0,
+                                   deadline - time.monotonic()))
+            if errors:
+                raise RuntimeError("; ".join(errors[:3]))
+            n_accepted = sum(len(a) for a in accepted)
+            n_target[0] = n_accepted
+            watch.join(timeout=max(5.0,
+                                   deadline - time.monotonic()))
+            if watch.is_alive():
+                stop_watch.set()
+                watch.join(timeout=5.0)
+                raise RuntimeError(
+                    f"{name}: drain stalled at "
+                    f"{len(committed)}/{n_accepted}")
+            load_s = time.perf_counter() - t_load0
+
+            # ---- exactly-once + adversarial-mix accounting ----
+            accepted_set = {raw for a in accepted for raw in a}
+            assert len(committed) == n_accepted, \
+                (name, len(committed), n_accepted)
+            assert set(committed) == accepted_set, \
+                f"{name}: committed stream diverged from accepted"
+            assert not (invalid_raws & set(committed)), \
+                f"{name}: an invalid-signature envelope committed"
+            n_rejected = sum(rejected)
+            assert n_rejected <= invalid, (name, n_rejected)
+
+            # ---- steady-window latency + throughput ----
+            cut = t_load0 + warmup_frac * load_s
+            steady = [x for x in lat if x[0] >= cut] or lat
+            lats = sorted(x[2] for x in steady)
+            p99 = lats[int(0.99 * (len(lats) - 1))] if lats else 0.0
+            p50 = lats[len(lats) // 2] if lats else 0.0
+            span0 = min(x[0] for x in steady) if steady else cut
+            span1 = max(x[1] for x in steady) if steady else cut
+            tx_s = len(steady) / max(span1 - span0, 1e-9)
+
+            stages = overload.stage_stats()
+            stage_sheds = {n: int(s.get("sheds", 0))
+                           for n, s in stages.items()
+                           if s.get("sheds")}
+            # the raft events queues carry a FORCED control-plane
+            # lane (consensus steps, bounded at 4x the data-plane
+            # capacity) — their depth bound is 5x; everything else
+            # must honor its configured capacity exactly
+            depth_violations = {
+                n: s for n, s in stages.items()
+                if s.get("capacity", 0) > 0
+                and s.get("max_depth", 0) > s["capacity"] *
+                (5 if s.get("forced") else 1)}
+            assert not depth_violations, \
+                f"{name}: depth bound broken: {depth_violations}"
+
+            # ---- both peers commit the full chain, fed from
+            # DISTINCT consenters ----
+            while True:
+                heights = [s.support.ledger.height
+                           for s in svcs.values()]
+                if len(set(heights)) == 1:
+                    break
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"{name}: consenters never converged "
+                        f"{heights}")
+                time.sleep(0.02)
+            height = heights[0]
+            chans = [_PeerChan() for _ in peer_eps]
+            pipes = [CommitPipeline(chan, depth=1, node_id=pep)
+                     for chan, pep in zip(chans, peer_eps)]
+            follower = next(s for s in svcs.values()
+                            if s is not lead)
+            feed_errors: list = []
+
+            def feed(src, pipe, pep):
+                try:
+                    handler = DeliverHandler(
+                        lambda cid: src.support
+                        if cid == client.channel else None)
+                    seek = seek_envelope(client.channel, 1,
+                                         client.signer,
+                                         stop=height - 1)
+                    for resp in handler.handle(seek):
+                        if resp.WhichOneof("type") != "block":
+                            break
+                        blk = resp.block
+                        carrier = clustertrace.block_carrier(
+                            client.channel, blk.header.number)
+                        with clustertrace.resumed(
+                                carrier,
+                                link=f"deliver:"
+                                     f"{src.transport.endpoint}",
+                                node=pep):
+                            pipe.submit(blk.header.number,
+                                        block=blk)
+                except Exception as e:  # noqa: BLE001 — surfaced below
+                    feed_errors.append(f"{pep}: {e}")
+
+            fthreads = [
+                threading.Thread(target=feed,
+                                 args=(src, pipe, pep),
+                                 name=f"adaptive-feed-{pep}")
+                for src, pipe, pep in zip((lead, follower), pipes,
+                                          peer_eps)]
+            for t in fthreads:
+                t.start()
+            for t in fthreads:
+                t.join(timeout=max(5.0,
+                                   deadline - time.monotonic()))
+            if feed_errors:
+                raise RuntimeError("; ".join(feed_errors))
+            for p in pipes:
+                p.drain(timeout=max(5.0,
+                                    deadline - time.monotonic()))
+            for chan in chans:
+                assert len(chan.committed) == height - 1, \
+                    (name, len(chan.committed), height - 1)
+
+            out = {
+                "offered": len(pool),
+                "accepted": n_accepted,
+                "shed": sum(shed),
+                "rejected_invalid": n_rejected,
+                "committed": len(committed),
+                "blocks": height - 1,
+                "peer_commits": [len(c.committed) for c in chans],
+                "load_s": round(load_s, 2),
+                "steady_obs": len(steady),
+                "commit_p50_s": round(p50, 3),
+                "commit_p99_s": round(p99, 3),
+                "tx_s": round(tx_s, 1),
+                "slo_held": bool(p99 <= slo_target_s),
+                "slo_over_target": clustertrace.slo().stats[
+                    "over_target"],
+                "stage_sheds": stage_sheds,
+                "chaos": {k: chaos.stats[k]
+                          for k in ("sent", "dropped", "duplicated",
+                                    "reordered")},
+            }
+            if ctl is not None:
+                ctl_stats = dict(ctl.stats)
+                out["controller"] = ctl_stats
+                out["knobs_final"] = {
+                    n: k.value()
+                    for n, k in sorted(adaptive.knobs().items())}
+                rendered = provider.render() \
+                    if hasattr(provider, "render") else ""
+                out["adaptive_metrics_rendered"] = bool(
+                    ctl_stats.get("moves", 0) == 0 or
+                    "adaptive_knob_value" in rendered)
+            return out, committed
+        finally:
+            stop_w = locals().get("stop_watch")
+            if stop_w is not None:
+                stop_w.set()
+            if ctl is not None:
+                adaptive.stop_controller()
+            for p in pipes:
+                try:
+                    p.stop()
+                except Exception:     # noqa: BLE001
+                    pass
+            for s in svcs.values():
+                try:
+                    s.close(flush=True)
+                except Exception:     # noqa: BLE001
+                    pass
+            chaos.close()
+            clustertrace.configure_slo(None)
+
+    oracle = None
+    try:
+        static_res, _static_committed = run_phase("static", False)
+        adaptive_res, committed = run_phase("adaptive", True)
+
+        # ---- sequential-oracle replay of the ADAPTIVE phase's
+        # committed stream (same client: the oracle must accept the
+        # exact committed bytes) ----
+        oracle = make_order_service(
+            os.path.join(root, "oracle"), client=client,
+            channel=client.channel,
+            block_txs=64, batch_timeout_s=0.2,
+            write_pipeline=False,
+            endpoint="oracle0.example.com:7050",
+            endpoints=("oracle0.example.com:7050",))
+        odl = time.monotonic() + 60
+        while oracle.chain.node.leader_id != oracle.chain.node_id:
+            if time.monotonic() > odl:
+                raise RuntimeError("oracle: no raft leader")
+            time.sleep(0.01)
+        committed_envs = [cpb.Envelope.FromString(raw)
+                          for raw in committed]
+        pos = 0
+        while pos < len(committed_envs):
+            resps = oracle.broadcast.process_messages(
+                committed_envs[pos:pos + 64])
+            ok = sum(1 for r in resps
+                     if r.status == cpb.Status.SUCCESS)
+            if ok == 0:
+                raise RuntimeError(
+                    "oracle rejected the committed stream")
+            pos += ok
+        olg = oracle.support.ledger
+        ocommitted: list = []
+        onext = 1
+        while len(ocommitted) < len(committed):
+            while onext < olg.height:
+                b = olg.get_block(onext)
+                if b is None:
+                    break
+                ocommitted.extend(bytes(d) for d in b.data.data)
+                onext += 1
+            if time.monotonic() > deadline:
+                raise RuntimeError("oracle drain stalled")
+            time.sleep(0.02)
+        assert ocommitted == committed, \
+            "sequential-oracle envelope stream diverged bit-wise"
+
+        ctl_stats = adaptive_res.get("controller", {})
+        moves = int(ctl_stats.get("moves", 0))
+        reversals = int(ctl_stats.get("reversals", 0))
+        no_flap = (reversals <= flap_ceiling and
+                   moves <= adjust_ceiling)
+        beats = bool(
+            adaptive_res["slo_held"] and
+            (not static_res["slo_held"] or
+             adaptive_res["tx_s"] > static_res["tx_s"]))
+        res = {
+            "consenters": consenters,
+            "peers": len(peer_eps),
+            "workers": workers,
+            "clients_simulated": clients,
+            "ntxs_per_phase": len(pool),
+            "invalid_per_phase": invalid,
+            "block_txs": block_txs,
+            "events_cap": events_cap,
+            "slo_target_s": slo_target_s,
+            "warmup_frac": warmup_frac,
+            "static": static_res,
+            "adaptive": adaptive_res,
+            "max_sustainable_tx_s": adaptive_res["tx_s"],
+            "slo_held": adaptive_res["slo_held"],
+            "adaptive_beats_static": beats,
+            "controller_moves": moves,
+            "controller_reversals": reversals,
+            "flap_ceiling": flap_ceiling,
+            "adjust_ceiling": adjust_ceiling,
+            "no_flap": no_flap,
+            "accepted_commit_exact_once": True,
+            "oracle_bit_identical": True,
+        }
+        if scheme_mix:
+            try:
+                res["scheme_mix"] = _scheme_mix_run()
+            except Exception as e:    # noqa: BLE001
+                res["scheme_mix"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+        res["run_s"] = round(time.perf_counter() - t_run0, 2)
+        return res
+    finally:
+        if oracle is not None:
+            try:
+                oracle.close(flush=True)
+            except Exception:         # noqa: BLE001
+                pass
+        from fabric_tpu.common import adaptive as _ad
+        _ad.reset()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def failover_run(consenters: int = 3, producers: int = 2,
                  ntxs_per_producer: int = 60, window: int = 12,
                  block_txs: int = 8, seed: int = 7,
@@ -2554,6 +3298,35 @@ if __name__ == "__main__":
                   file=sys.stderr)
             sys.exit(2)
         print(json.dumps(out))
+        sys.exit(0)
+
+    if len(sys.argv) > 1 and sys.argv[1] == "adaptive":
+        # the round-19 closed-loop serving soak (tools/soak_check.sh):
+        # adaptive-vs-static phases, max sustainable tx/s at a held
+        # p99 commit SLO. Same lockcheck discipline as the other
+        # regimes — armed BEFORE the fabric_tpu imports.
+        from fabric_tpu.common import lockcheck
+        if os.environ.get(lockcheck.ENV_VAR):
+            lockcheck.install(
+                raise_on_violation=os.environ.get(
+                    lockcheck.ENV_VAR) == "raise")
+        out = adaptive_serving_run(
+            workers=int(os.environ.get("SOAK_WORKERS", "6")),
+            ntxs=int(os.environ.get("SOAK_TXS", "2400")),
+            invalid=int(os.environ.get("SOAK_INVALID", "48")),
+            slo_target_s=float(os.environ.get("SOAK_SLO_S", "1.5")),
+            events_cap=int(os.environ.get("SOAK_EVENTS_CAP", "256")),
+            interval_s=float(os.environ.get(
+                "SOAK_ADAPT_INTERVAL_S", "0.25")),
+            seed=int(os.environ.get("SOAK_SEED", "11")),
+            drop_rate=float(os.environ.get("SOAK_DROP_RATE", "0.02")))
+        san = lockcheck.sanitizer()
+        out["lockcheck_violations"] = (
+            len(san.violations()) if san is not None else None)
+        print(json.dumps(out))
+        if san is not None and san.violations():
+            print(san.report(), file=sys.stderr)
+            sys.exit(3)
         sys.exit(0)
 
     if len(sys.argv) > 1 and sys.argv[1] == "overload":

@@ -65,22 +65,35 @@ class AdmissionWindow:
 
     _ATTR = "__ftpu_admission_window__"
 
+    _SPAN_CAP = 2048   # default max signature lanes per dispatch
+
     def __init__(self, csp):
         self._csp = csp
         self._cond = threading.Condition()
         self._queue: list[_Pending] = []
         self._dispatching = False
+        # round 19: the adaptive span knob — a leader takes at most
+        # this many signature lanes per coalesced dispatch (0 =
+        # uncapped); callers left queued are led by the next waiter.
+        # Tightening trades device batch efficiency for convoy
+        # latency when the verify fabric saturates.
+        self.max_window_items = self._SPAN_CAP
         self.stats = {
             "window_dispatches": 0,   # provider verify_batch calls
             "window_items": 0,        # signature lanes dispatched
             "window_callers": 0,      # verify_batch calls coalesced
             "window_sheds": 0,        # callers shed while queued
+            "window_splits": 0,       # takeovers the span cap split
             "window_wait_s": 0.0,     # cumulative convoy wait
             "window_last_wait_s": 0.0,
         }
         self._last_shed_t: Optional[float] = None
-        from fabric_tpu.common import overload
+        from fabric_tpu.common import adaptive, overload
+        self._shed_rate = overload.ShedRateWindow()
         overload.register_stage("bccsp.admission", self)
+        adaptive.register_attr_knob(
+            self, "max_window_items", "bccsp.admission.span",
+            floor=16, ceiling=self._SPAN_CAP)
 
     def overload_stats(self) -> dict:
         """The overload-registry protocol (common/overload.py): the
@@ -95,6 +108,8 @@ class AdmissionWindow:
                 "wait_s": self.stats["window_wait_s"],
                 "last_wait_s": self.stats["window_last_wait_s"],
                 "last_shed_t": self._last_shed_t,
+                "shed_rate": self._shed_rate.rate(),
+                "span_cap": self.max_window_items,
             }
 
     @classmethod
@@ -137,6 +152,7 @@ class AdmissionWindow:
                             self._queue.remove(mine)
                             self.stats["window_sheds"] += 1
                             self._last_shed_t = time.monotonic()
+                            self._shed_rate.note()
                             tracing.note_shed("bccsp.admission")
                             raise OverloadError(
                                 "bccsp.admission",
@@ -153,9 +169,28 @@ class AdmissionWindow:
                 batch = None
             else:
                 # the window is idle and my request is still queued:
-                # I lead — take everything accumulated so far
+                # I lead — take everything accumulated so far, up to
+                # the adaptive span cap (my own pending always rides;
+                # callers left queued are led by the next waiter the
+                # moment this dispatch scatters)
                 self._dispatching = True
-                batch, self._queue = self._queue, []
+                cap = int(self.max_window_items or 0)
+                if cap > 0 and len(self._queue) > 1:
+                    take, rest = [mine], []
+                    n = len(mine.items)
+                    for p in self._queue:
+                        if p is mine:
+                            continue
+                        if n < cap:
+                            take.append(p)
+                            n += len(p.items)
+                        else:
+                            rest.append(p)
+                    if rest:
+                        self.stats["window_splits"] += 1
+                    batch, self._queue = take, rest
+                else:
+                    batch, self._queue = self._queue, []
             # accumulate under the cond: every coalesced waiter exits
             # concurrently after a scatter, and an unlocked += here
             # loses addends under exactly the convoy load this stat

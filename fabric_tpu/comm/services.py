@@ -83,6 +83,26 @@ _BCAST_SHED = object()      # shed marker: holds the envelope's 1:1
 
 # one counter shared by every broadcast stream on the process
 _bcast_ingress_stats = {"sheds": 0, "last_shed_t": None}
+_bcast_shed_rate = None     # overload.ShedRateWindow, built lazily
+
+# round 19: the per-stream inbox bound is process-tunable — the
+# adaptive controller's ingress-capacity knob moves it here and the
+# setter pushes the new bound onto every LIVE stream queue (maxsize
+# is read per put), so a tighten takes effect mid-stream.
+DEFAULT_BCAST_INBOX = 2048
+_bcast_inbox = {"capacity": DEFAULT_BCAST_INBOX}
+_bcast_live_queues: "weakref.WeakSet" = None   # built lazily
+
+
+def bcast_inbox_capacity() -> int:
+    return _bcast_inbox["capacity"]
+
+
+def _set_bcast_inbox_capacity(v: int) -> None:
+    _bcast_inbox["capacity"] = max(1, int(v))
+    if _bcast_live_queues is not None:
+        for q in list(_bcast_live_queues):
+            q.maxsize = _bcast_inbox["capacity"]
 
 
 class _BroadcastIngressStats:
@@ -91,9 +111,12 @@ class _BroadcastIngressStats:
     edge shed — aggregates across streams."""
 
     def overload_stats(self) -> dict:
-        return {"depth": 0, "capacity": 2048,
+        rate = (_bcast_shed_rate.rate()
+                if _bcast_shed_rate is not None else 0.0)
+        return {"depth": 0, "capacity": _bcast_inbox["capacity"],
                 "sheds": _bcast_ingress_stats["sheds"],
-                "last_shed_t": _bcast_ingress_stats["last_shed_t"]}
+                "last_shed_t": _bcast_ingress_stats["last_shed_t"],
+                "shed_rate": rate}
 
 
 _bcast_ingress_stage = _BroadcastIngressStats()
@@ -101,12 +124,33 @@ _bcast_ingress_stage = _BroadcastIngressStats()
 
 def _register_ingress_stage() -> None:
     # process-singleton stage entry; per-stream queues come and go
-    from fabric_tpu.common import overload
+    global _bcast_shed_rate, _bcast_live_queues
+    import weakref
+
+    from fabric_tpu.common import adaptive, overload
     overload.register_stage("broadcast.ingress", _bcast_ingress_stage)
+    if _bcast_shed_rate is None:
+        _bcast_shed_rate = overload.ShedRateWindow()
+    if _bcast_live_queues is None:
+        _bcast_live_queues = weakref.WeakSet()
+    if getattr(_bcast_ingress_stage, "__ftpu_adaptive_knob__",
+               None) is None:
+        adaptive.register_attr_knob(
+            _bcast_ingress_stage, "_capacity_shim",
+            "broadcast.ingress.capacity",
+            floor=max(1, DEFAULT_BCAST_INBOX // 8),
+            ceiling=DEFAULT_BCAST_INBOX)
+
+
+# the knob seam reads/writes through a property-like shim on the
+# stage singleton (register_attr_knob targets attributes)
+_BroadcastIngressStats._capacity_shim = property(
+    lambda self: _bcast_inbox["capacity"],
+    lambda self, v: _set_bcast_inbox_capacity(v))
 
 
 def broadcast_stream(request_iterator, broadcast_handler,
-                     window: int = 500, inbox: int = 2048,
+                     window: int = 500, inbox=None,
                      budget_s=None):
     """Streamed ingest (the reference's AtomicBroadcast.Broadcast
     shape): responses are 1:1 in order, but the server drains the
@@ -134,8 +178,15 @@ def broadcast_stream(request_iterator, broadcast_handler,
     from fabric_tpu.common import overload
 
     _register_ingress_stage()
-    q = overload.SheddingQueue("broadcast.ingress.stream",
-                               maxsize=inbox, register=False)
+    q = overload.SheddingQueue(
+        "broadcast.ingress.stream",
+        maxsize=inbox if inbox is not None
+        else _bcast_inbox["capacity"],
+        register=False)
+    if inbox is None:
+        # adaptive capacity moves reach live streams (explicit inbox
+        # pins the bound — tests and embedded rigs stay deterministic)
+        _bcast_live_queues.add(q)
     done = object()
     stop = threading.Event()  # set when the response generator dies
 
@@ -165,6 +216,8 @@ def broadcast_stream(request_iterator, broadcast_handler,
                         _bcast_ingress_stats["sheds"] += 1
                         _bcast_ingress_stats["last_shed_t"] = \
                             time.monotonic()
+                        if _bcast_shed_rate is not None:
+                            _bcast_shed_rate.note()
                         tracing.note_shed("broadcast.ingress")
                         q.put_forced((_BCAST_SHED, None))
                         break

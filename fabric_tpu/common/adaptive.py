@@ -1,0 +1,592 @@
+"""Adaptive admission control: closed-loop tuning of the serving knobs.
+
+Round 19. Rounds 9-18 built the instruments — deadline-bounded
+shedding queues with per-stage depth/shed readings (overload.py), the
+SLO-burn tracker over the e2e commit histogram (clustertrace.py), and
+per-chip busy/memory telemetry (devicecost.py) — but every knob those
+layers expose (queue capacities, enqueue/ingress deadline budgets, the
+admission-window span) was a STATIC env var chosen once, for one box,
+at deploy time. The committee-consensus measurement in PAPERS.md
+(arXiv:2302.00418) shows signature verification dominating consensus
+cost at scale, and the ACE-runtime line (arXiv:2603.10242) makes
+sub-second cryptographic finality the user-visible contract: when the
+verify fabric saturates, SOMETHING must give, and it should be
+admission — early, bounded, and reversible — not the p99.
+
+This module is that loop, in three pieces:
+
+`Knob` — the single seam every tunable registers through: a named
+get/set pair with a declared floor, ceiling and step (multiplicative;
+a tighten divides, a relax multiplies, both clamp). Capacity knobs
+ride the owning queue's lifetime (the registry holds weak references;
+a halted channel's knobs disappear with its queues), budget knobs are
+process-wide overrides layered into `overload.ingress_budget_s()` /
+`default_enqueue_budget_s()` resolution.
+
+`AdaptiveController` — the policy: each tick reads the live signals
+(SLO-burn rate, rolling per-stage shed rates, queue-depth pressure,
+device busy ratio, HBM headroom), classifies the tick HOT (the SLO is
+burning or the fabric is saturating — shrink the serving surface so
+work sheds at the edge instead of queueing into the p99) or CALM
+(budget intact, no recent sheds, shallow queues — grow back toward
+the configured ceilings), and moves every registered knob one bounded
+step in that direction. Hysteresis is asymmetric and explicit:
+tightening needs `tighten_after` consecutive hot ticks, relaxing
+needs `relax_after` consecutive calm ticks (backing off must be
+prompt, recovering must be cautious), and a direction REVERSAL
+additionally waits out `reversal_cooldown` ticks — chaos-noise
+flipping the signals tick-to-tick holds rather than flaps. Every move
+emits an `adaptive.adjust` tracing instant plus the canonical
+`adaptive_*` gauges/counters, so a postmortem can replay exactly what
+the controller did and why.
+
+The module singleton (`start_controller` / `stop_controller` /
+`health`) is what the node assemblies wire: a daemon tick thread plus
+an `/healthz` `components.adaptive` state. `FTPU_ADAPTIVE=0` (or
+`Operations.Adaptive.Enabled: false`) disables the plane entirely —
+no thread, no knob ever moved; registration stays a dict insert.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Optional
+
+from fabric_tpu.common import overload, tracing
+
+logger = logging.getLogger("common.adaptive")
+
+_ENABLED_ENV = "FTPU_ADAPTIVE"
+
+TIGHTEN = -1
+RELAX = +1
+
+_DEF_INTERVAL_S = 2.0
+
+_cfg_lock = threading.Lock()
+_config: dict = {"enabled": None, "interval_s": None,
+                 "target_p99_s": None}
+
+
+def configure_from_config(cfg) -> None:
+    """`Operations.Adaptive.{Enabled,IntervalS}` config keys; the env
+    toggle (`FTPU_ADAPTIVE`) remains the override, mirroring the
+    Operations.Overload.* seam."""
+    enabled = cfg.get("Operations.Adaptive.Enabled", None)
+    interval = cfg.get_duration("Operations.Adaptive.IntervalS", 0.0)
+    with _cfg_lock:
+        _config["enabled"] = (bool(enabled)
+                              if enabled is not None else None)
+        _config["interval_s"] = interval if interval > 0 else None
+
+
+def enabled() -> bool:
+    env = os.environ.get(_ENABLED_ENV)
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "no", "off")
+    with _cfg_lock:
+        c = _config["enabled"]
+    return True if c is None else c
+
+
+def configured_interval_s() -> float:
+    with _cfg_lock:
+        c = _config["interval_s"]
+    return c if c is not None else _DEF_INTERVAL_S
+
+
+# ---------------------------------------------------------------------------
+# the knob seam
+# ---------------------------------------------------------------------------
+
+class Knob:
+    """One tunable: a get/set pair with declared bounds. `step` is a
+    multiplicative factor (> 1): a TIGHTEN move divides the current
+    value by it, a RELAX move multiplies, both clamped to
+    [floor, ceiling]. Integer knobs (queue capacities, window spans)
+    round after stepping; a move that rounds/clamps back onto the
+    current value is a no-op the controller counts as a clamp, so
+    every knob converges at its bound instead of oscillating there."""
+
+    __slots__ = ("name", "floor", "ceiling", "step", "integer",
+                 "_get", "_set", "__weakref__")
+
+    def __init__(self, name: str, get: Callable[[], float],
+                 set: Callable[[float], None], floor: float,
+                 ceiling: float, step: float = 2.0,
+                 integer: bool = False):
+        if not floor <= ceiling:
+            raise ValueError(f"knob {name!r}: floor {floor} above "
+                             f"ceiling {ceiling}")
+        if step <= 1.0:
+            raise ValueError(f"knob {name!r}: step must be > 1 "
+                             "(it is a multiplicative factor)")
+        self.name = name
+        self.floor = floor
+        self.ceiling = ceiling
+        self.step = float(step)
+        self.integer = integer
+        self._get = get
+        self._set = set
+
+    def value(self):
+        return self._get()
+
+    def move(self, direction: int):
+        """One bounded step. Returns (old, new, clamped): new == old
+        with clamped=True when the bound (or integer rounding at the
+        bound) absorbed the move."""
+        cur = self._get()
+        raw = cur / self.step if direction < 0 else cur * self.step
+        new = min(self.ceiling, max(self.floor, raw))
+        if self.integer:
+            new = int(round(new))
+        if new == cur:
+            return cur, cur, True
+        self._set(new)
+        return cur, new, False
+
+
+_knob_lock = threading.Lock()
+_knobs: "weakref.WeakValueDictionary[str, Knob]" = \
+    weakref.WeakValueDictionary()
+
+
+def register_knob(knob: Knob) -> Knob:
+    """Register a knob for the controller. Weakly held: a knob whose
+    owner keeps it alive (`register_queue_capacity` parks it on the
+    queue object) drops out of the controller's view when the owner
+    is collected; re-registration under the same name replaces."""
+    with _knob_lock:
+        _knobs[knob.name] = knob
+    return knob
+
+
+def unregister_knob(name: str, knob: Optional[Knob] = None) -> None:
+    with _knob_lock:
+        if knob is None or _knobs.get(name) is knob:
+            _knobs.pop(name, None)
+
+
+def knobs() -> dict:
+    """Live snapshot of the registered knobs, keyed by name."""
+    with _knob_lock:
+        return dict(_knobs.items())
+
+
+_OWNER_ATTR = "__ftpu_adaptive_knob__"
+
+
+def register_queue_capacity(q, name: Optional[str] = None,
+                            floor: Optional[int] = None,
+                            ceiling: Optional[int] = None,
+                            step: float = 2.0) -> Knob:
+    """Attach a capacity knob to a `SheddingQueue`: `maxsize` is read
+    per put, so a move takes effect on the next admission. Default
+    bounds anchor at the CONFIGURED capacity — floor base/8 (the
+    controller may shrink the queue to shed early, never to zero),
+    ceiling base (it never grants more buffering than the operator
+    configured). The knob is parked on the queue so their lifetimes
+    coincide."""
+    base = int(q.maxsize)
+    k = Knob(name or f"{q.name}.capacity",
+             get=lambda: q.maxsize,
+             set=lambda v: setattr(q, "maxsize", max(1, int(v))),
+             floor=max(1, base // 8 if floor is None else floor),
+             ceiling=base if ceiling is None else ceiling,
+             step=step, integer=True)
+    setattr(q, _OWNER_ATTR, k)
+    return register_knob(k)
+
+
+def register_attr_knob(owner, attr: str, name: str,
+                       floor: float, ceiling: float,
+                       step: float = 2.0,
+                       integer: bool = True) -> Knob:
+    """Generic attribute knob (BlockWriteStage._max_pending, the
+    AdmissionWindow span cap): same lifetime discipline as
+    `register_queue_capacity` — the knob rides the owner."""
+    def _get():
+        return getattr(owner, attr)
+
+    def _set(v):
+        setattr(owner, attr, int(v) if integer else float(v))
+
+    k = Knob(name, get=_get, set=_set, floor=floor, ceiling=ceiling,
+             step=step, integer=integer)
+    try:
+        setattr(owner, _OWNER_ATTR, k)
+    except (AttributeError, TypeError):
+        pass   # slotted owner: caller keeps the knob alive
+    return register_knob(k)
+
+
+class _BudgetHolder:
+    """Anchor object for the process-wide deadline-budget knobs (the
+    registry is weak; these need an owner)."""
+
+    def __init__(self):
+        self.knobs: list = []
+
+
+_budgets = _BudgetHolder()
+
+
+def register_budget_knobs(min_ingress_s: float = 0.05,
+                          min_enqueue_s: float = 0.05) -> list:
+    """The ingress/enqueue deadline-budget knobs, layered into
+    overload.py's dynamic-override resolution. Bounds anchor at the
+    statically resolved base (env > config > default): the controller
+    may cut a budget to base/8 (shed sooner under pressure) and
+    restore it to exactly the configured value, never beyond."""
+    ing_base = overload.static_ingress_budget_s()
+    enq_base = overload.static_enqueue_budget_s()
+    ing = Knob("budget.ingress_s",
+               get=overload.ingress_budget_s,
+               set=lambda v: overload.set_dynamic_budget(
+                   "ingress", v),
+               floor=max(min_ingress_s, ing_base / 8.0),
+               ceiling=ing_base)
+    enq = Knob("budget.enqueue_s",
+               get=overload.default_enqueue_budget_s,
+               set=lambda v: overload.set_dynamic_budget(
+                   "enqueue", v),
+               floor=max(min_enqueue_s, enq_base / 8.0),
+               ceiling=enq_base)
+    _budgets.knobs = [ing, enq]
+    register_knob(ing)
+    register_knob(enq)
+    return [ing, enq]
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+
+def default_signals(csp=None) -> dict:
+    """The live signal vector: SLO-burn rate (PR 14), per-stage shed
+    rate + depth pressure (PR 9), device busy ratio + HBM headroom
+    (PR 13). Every probe is best-effort — a missing subsystem reads
+    as its quiet value, so a thin rig (no devices, no SLO target)
+    still runs the loop on queue pressure alone."""
+    sig = {"slo_burn": 0.0, "shed_rate": 0.0, "queue_pressure": 0.0,
+           "device_busy": 0.0, "hbm_headroom": 1.0}
+    try:
+        from fabric_tpu.common import clustertrace
+        sig["slo_burn"] = float(clustertrace.slo().burn_rate())
+    except Exception:   # noqa: BLE001 — quiet value stands in
+        pass            # ftpu-lint: allow-swallow(signal probe:
+        #                 a rig without the SLO tracker reads burn 0)
+    try:
+        for s in overload.stage_stats().values():
+            sig["shed_rate"] += float(s.get("shed_rate", 0.0))
+            cap = s.get("capacity") or 0
+            if cap > 0:
+                sig["queue_pressure"] = max(
+                    sig["queue_pressure"],
+                    float(s.get("depth", 0)) / float(cap))
+    except Exception:   # noqa: BLE001 — quiet value stands in
+        pass            # ftpu-lint: allow-swallow(signal probe:
+        #                 stage snapshot is advisory)
+    rec = getattr(csp, "device_cost", None) if csp is not None \
+        else None
+    if rec is not None:
+        try:
+            ratios = rec.busy.ratios()
+            if ratios:
+                sig["device_busy"] = max(
+                    float(r) for r in ratios.values())
+        except Exception:   # noqa: BLE001 — quiet value stands in
+            pass            # ftpu-lint: allow-swallow(signal probe:
+            #                 busy accumulator is advisory)
+        try:
+            from fabric_tpu.common import devicecost as dc
+            rows = dc.device_memory()
+            for r in rows:
+                limit = float(r.get("bytes_limit") or 0)
+                if limit > 0:
+                    headroom = 1.0 - float(
+                        r.get("bytes_in_use") or 0) / limit
+                    sig["hbm_headroom"] = min(sig["hbm_headroom"],
+                                              max(0.0, headroom))
+        except Exception:   # noqa: BLE001 — quiet value stands in
+            pass            # ftpu-lint: allow-swallow(signal probe:
+            #                 a host-only rig has no HBM to read)
+    return sig
+
+
+class AdaptiveController:
+    """The closed loop: signals -> hot/calm classification -> one
+    bounded, hysteresis-damped knob move per tick. Clock and signal
+    source are injectable so tests drive fabricated traces through
+    deterministic ticks; `start()` spawns the daemon loop the node
+    assemblies use."""
+
+    def __init__(self, csp=None, metrics_provider=None,
+                 interval_s: Optional[float] = None,
+                 clock=time.monotonic,
+                 signal_fn: Optional[Callable[[], dict]] = None,
+                 tighten_after: int = 2, relax_after: int = 4,
+                 reversal_cooldown: int = 4,
+                 burn_hot: float = 1.0, burn_calm: float = 0.5,
+                 shed_rate_hot: float = 0.2,
+                 busy_hot: float = 0.95,
+                 headroom_low: float = 0.05,
+                 pressure_calm: float = 0.5):
+        self._csp = csp
+        self._clock = clock
+        self.interval_s = (interval_s if interval_s is not None
+                           else configured_interval_s())
+        self._signal_fn = (signal_fn if signal_fn is not None
+                           else lambda: default_signals(csp))
+        self.tighten_after = tighten_after
+        self.relax_after = relax_after
+        self.reversal_cooldown = reversal_cooldown
+        self.burn_hot = burn_hot
+        self.burn_calm = burn_calm
+        self.shed_rate_hot = shed_rate_hot
+        self.busy_hot = busy_hot
+        self.headroom_low = headroom_low
+        self.pressure_calm = pressure_calm
+        self.stats = {
+            "ticks": 0, "tightens": 0, "relaxes": 0, "holds": 0,
+            "moves": 0, "clamps": 0, "reversals": 0,
+            "cooldown_holds": 0,
+        }
+        self._hot_streak = 0
+        self._calm_streak = 0
+        self._last_direction = 0    # last direction actually MOVED
+        self._cooldown = 0
+        self._last_signals: dict = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._knob_g = self._adj_c = self._sig_g = None
+        if metrics_provider is not None:
+            self.bind_metrics(metrics_provider)
+
+    def bind_metrics(self, provider) -> None:
+        from fabric_tpu.common import metrics as metrics_mod
+        try:
+            self._knob_g = provider.new_gauge(
+                metrics_mod.ADAPTIVE_KNOB_VALUE_OPTS)
+            self._adj_c = provider.new_counter(
+                metrics_mod.ADAPTIVE_ADJUSTMENTS_TOTAL_OPTS)
+            self._sig_g = provider.new_gauge(
+                metrics_mod.ADAPTIVE_SIGNAL_OPTS)
+        except Exception:   # noqa: BLE001
+            logger.warning("adaptive gauges unavailable",
+                           exc_info=True)
+
+    # -- the policy --
+
+    def _classify(self, sig: dict) -> int:
+        """HOT (TIGHTEN-ward), CALM (RELAX-ward) or neutral. Hot on
+        ANY saturation evidence; calm only when EVERY signal is
+        quiet — the asymmetry is deliberate (shedding early is cheap
+        and reversible, a burned p99 budget is neither)."""
+        if (sig.get("slo_burn", 0.0) >= self.burn_hot
+                or sig.get("shed_rate", 0.0) > self.shed_rate_hot
+                or sig.get("device_busy", 0.0) > self.busy_hot
+                or sig.get("hbm_headroom", 1.0) < self.headroom_low):
+            return TIGHTEN
+        if (sig.get("slo_burn", 0.0) < self.burn_calm
+                and sig.get("shed_rate", 0.0) == 0.0
+                and sig.get("queue_pressure", 0.0)
+                < self.pressure_calm
+                and sig.get("device_busy", 0.0) < self.busy_hot):
+            return RELAX
+        return 0
+
+    def tick(self) -> dict:
+        """One control decision. Returns the decision record (the
+        tests' observation point; the daemon loop discards it)."""
+        sig = self._signal_fn()
+        self._last_signals = dict(sig)
+        self.stats["ticks"] += 1
+        if self._sig_g is not None:
+            for name, v in sig.items():
+                self._sig_g.with_labels("signal", name).set(float(v))
+        leaning = self._classify(sig)
+        if leaning == TIGHTEN:
+            self._hot_streak += 1
+            self._calm_streak = 0
+        elif leaning == RELAX:
+            self._calm_streak += 1
+            self._hot_streak = 0
+        else:
+            self._hot_streak = 0
+            self._calm_streak = 0
+        if self._cooldown > 0:
+            self._cooldown -= 1
+
+        want = 0
+        if self._hot_streak >= self.tighten_after:
+            want = TIGHTEN
+        elif self._calm_streak >= self.relax_after:
+            want = RELAX
+        moved: list = []
+        if want == 0:
+            self.stats["holds"] += 1
+        elif (self._last_direction not in (0, want)
+              and self._cooldown > 0):
+            # direction reversal inside the cooldown: hold — this is
+            # the anti-flap discipline chaos-noise signals exercise
+            self.stats["cooldown_holds"] += 1
+            self.stats["holds"] += 1
+        else:
+            moved = self._apply(want, sig)
+        return {"signals": sig, "leaning": leaning, "want": want,
+                "moved": moved}
+
+    def _apply(self, direction: int, sig: dict) -> list:
+        live = knobs()
+        moved = []
+        all_clamped = bool(live)
+        reason = ("slo_burn" if sig.get("slo_burn", 0.0)
+                  >= self.burn_hot else
+                  "shed_rate" if sig.get("shed_rate", 0.0)
+                  > self.shed_rate_hot else
+                  "device" if direction == TIGHTEN else "calm")
+        for name in sorted(live):
+            knob = live[name]
+            try:
+                old, new, clamped = knob.move(direction)
+            except Exception as e:   # noqa: BLE001
+                logger.warning("knob %s move failed: %s", name, e)
+                continue
+            if clamped:
+                self.stats["clamps"] += 1
+                continue
+            all_clamped = False
+            moved.append((name, old, new))
+            self.stats["moves"] += 1
+            tracing.instant(
+                "adaptive.adjust", knob=name, frm=old, to=new,
+                direction=("tighten" if direction == TIGHTEN
+                           else "relax"),
+                reason=reason)
+            if self._knob_g is not None:
+                self._knob_g.with_labels("knob", name).set(float(new))
+            if self._adj_c is not None:
+                self._adj_c.with_labels(
+                    "knob", name, "direction",
+                    "tighten" if direction == TIGHTEN
+                    else "relax").add(1.0)
+        if moved:
+            if direction == TIGHTEN:
+                self.stats["tightens"] += 1
+            else:
+                self.stats["relaxes"] += 1
+            if self._last_direction not in (0, direction):
+                self.stats["reversals"] += 1
+            self._last_direction = direction
+            self._cooldown = self.reversal_cooldown
+        elif all_clamped:
+            # every knob is pinned at its bound for this direction:
+            # the plane has given all it has — a hold, not a move
+            self.stats["holds"] += 1
+        return moved
+
+    def last_signals(self) -> dict:
+        return dict(self._last_signals)
+
+    # -- the daemon loop --
+
+    def start(self) -> "AdaptiveController":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:   # noqa: BLE001
+                    logger.warning("adaptive tick failed",
+                                   exc_info=True)
+
+        self._thread = threading.Thread(target=loop,
+                                        name="adaptive-controller",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def health(self) -> str:
+        s = self.stats
+        return (f"ok:moves={s['moves']},reversals={s['reversals']},"
+                f"clamps={s['clamps']}")
+
+
+# ---------------------------------------------------------------------------
+# the module singleton (node assemblies + /healthz)
+# ---------------------------------------------------------------------------
+
+_ctl_lock = threading.Lock()
+_controller: Optional[AdaptiveController] = None
+
+
+def start_controller(csp=None, metrics_provider=None,
+                     interval_s: Optional[float] = None,
+                     **policy) -> Optional[AdaptiveController]:
+    """Wire the process controller: register the budget knobs, spawn
+    the tick loop, return the controller — or None (and do NOTHING:
+    zero threads, zero overrides) when the plane is disabled."""
+    if not enabled():
+        return None
+    global _controller
+    with _ctl_lock:
+        if _controller is not None:
+            return _controller
+        register_budget_knobs()
+        ctl = AdaptiveController(csp=csp,
+                                 metrics_provider=metrics_provider,
+                                 interval_s=interval_s, **policy)
+        ctl.start()
+        _controller = ctl
+        return ctl
+
+
+def stop_controller() -> None:
+    global _controller
+    with _ctl_lock:
+        ctl, _controller = _controller, None
+    if ctl is not None:
+        ctl.stop()
+    overload.clear_dynamic_budgets()
+
+
+def controller() -> Optional[AdaptiveController]:
+    return _controller
+
+
+def health() -> str:
+    """/healthz `components.adaptive`: `disabled` when the plane is
+    off, else the controller's move/reversal/clamp counts — an
+    operator reads flapping (reversals climbing) straight off the
+    health surface."""
+    ctl = _controller
+    if ctl is None:
+        return "disabled"
+    return ctl.health()
+
+
+def reset() -> None:
+    """Test hook: stop the loop, clear every registration and
+    override."""
+    stop_controller()
+    with _knob_lock:
+        _knobs.clear()
+    _budgets.knobs = []
+    with _cfg_lock:
+        for k in _config:
+            _config[k] = None

@@ -379,6 +379,43 @@ OVERLOAD_PUT_WAIT_SECONDS_OPTS = GaugeOpts(
          "waited for space (backpressure before the shed horizon).",
     label_names=("stage",))
 
+OVERLOAD_SHED_RATE_OPTS = GaugeOpts(
+    namespace="overload", name="shed_rate",
+    help="Sheds per second over each stage's trailing rolling window "
+         "(overload.SHED_RATE_WINDOW_S): the burst-vs-steady reading "
+         "the round-19 adaptive controller and /healthz act on — "
+         "sheds_total answers 'has this stage ever shed', this "
+         "gauge answers 'is it shedding NOW'.",
+    label_names=("stage",))
+
+ADAPTIVE_KNOB_VALUE_OPTS = GaugeOpts(
+    namespace="adaptive", subsystem="knob", name="value",
+    help="Current value of each serving knob registered with the "
+         "round-19 adaptive admission controller (queue capacities, "
+         "deadline budgets, the admission-window span), updated at "
+         "each controller move — the live picture of how far the "
+         "plane is tightened from its configured ceilings.",
+    label_names=("knob",))
+
+ADAPTIVE_ADJUSTMENTS_TOTAL_OPTS = CounterOpts(
+    namespace="adaptive", name="adjustments_total",
+    help="Knob moves the adaptive controller applied, by knob and "
+         "direction (tighten = floor-ward under SLO-burn/saturation, "
+         "relax = ceiling-ward in calm). A healthy controller moves "
+         "in bounded runs; alternating tighten/relax growth is "
+         "flapping and the hysteresis discipline failing.",
+    label_names=("knob", "direction"))
+
+ADAPTIVE_SIGNAL_OPTS = GaugeOpts(
+    namespace="adaptive", name="signal",
+    help="The adaptive controller's input vector as last sampled: "
+         "slo_burn (error-budget burn rate), shed_rate (summed "
+         "rolling per-stage sheds/s), queue_pressure (max "
+         "depth/capacity), device_busy (max per-chip busy ratio), "
+         "hbm_headroom (min per-chip free-memory fraction) — the "
+         "evidence behind every adaptive.adjust instant.",
+    label_names=("signal",))
+
 BCCSP_ADMISSION_WAIT_SECONDS_OPTS = GaugeOpts(
     namespace="bccsp", subsystem="admission", name="wait_s",
     help="Seconds the most recent verify_batch caller spent in the "

@@ -53,36 +53,165 @@ from fabric_tpu.common import tracing
 
 _INGRESS_ENV = "FTPU_INGRESS_BUDGET_S"
 _ENQUEUE_ENV = "FTPU_ENQUEUE_BUDGET_S"
+_EVENTS_CAP_ENV = "FTPU_RAFT_EVENTS_CAP"
 
 _DEF_INGRESS_S = 30.0
 _DEF_ENQUEUE_S = 10.0
+_DEF_EVENTS_CAP = 4096
 
 # /healthz reports "shedding" while any queue shed within this window
 SHED_HEALTH_WINDOW_S = 30.0
 
+# rolling shed-RATE window (round 19): the controller and /healthz
+# need burst-vs-steady, which a lifetime counter cannot give
+SHED_RATE_WINDOW_S = 30.0
 
-def _env_budget(name: str, default: float) -> float:
+# round 19: the serving knobs resolve through three layers —
+#   dynamic (set by the adaptive controller, bounded by its knob
+#   floors/ceilings) > env (the operator's explicit override, which
+#   also anchors the controller's bounds) > Operations.Overload.*
+#   config > built-in default.
+_cfg_lock = threading.Lock()
+_config: dict = {"ingress_budget_s": None, "enqueue_budget_s": None,
+                 "raft_events_cap": None}
+_dynamic: dict = {"ingress_budget_s": None, "enqueue_budget_s": None}
+
+
+def configure_from_config(cfg) -> None:
+    """Lift the env-only serving knobs into `Operations.Overload.*`
+    config keys (round 19): `IngressBudgetS`, `EnqueueBudgetS`
+    (durations) and `RaftEventsCap` (int). Env remains the override —
+    operators and the adaptive controller tune through one seam."""
+    ing = cfg.get_duration("Operations.Overload.IngressBudgetS", 0.0)
+    enq = cfg.get_duration("Operations.Overload.EnqueueBudgetS", 0.0)
+    cap = cfg.get_int("Operations.Overload.RaftEventsCap", 0)
+    with _cfg_lock:
+        _config["ingress_budget_s"] = ing if ing > 0 else None
+        _config["enqueue_budget_s"] = enq if enq > 0 else None
+        _config["raft_events_cap"] = cap if cap > 0 else None
+
+
+def set_dynamic_budget(name: str, value) -> None:
+    """The adaptive controller's seam: install (or with None, clear) a
+    runtime override for `ingress_budget_s` / `enqueue_budget_s`. The
+    controller's knob floor/ceiling — anchored at the statically
+    resolved base — bounds what lands here."""
+    key = f"{name}_budget_s"
+    if key not in _dynamic:
+        raise KeyError(f"unknown dynamic budget {name!r}")
+    with _cfg_lock:
+        _dynamic[key] = float(value) if value is not None else None
+
+
+def clear_dynamic_budgets() -> None:
+    with _cfg_lock:
+        for k in _dynamic:
+            _dynamic[k] = None
+
+
+def _env_float(name: str):
     try:
         v = float(os.environ.get(name, ""))
     except ValueError:
-        return default
-    return v if v > 0 else default
+        return None
+    return v if v > 0 else None
+
+
+def static_ingress_budget_s() -> float:
+    """The configured (pre-controller) ingress budget: env >
+    config > default. The adaptive controller anchors its ingress
+    knob's ceiling here."""
+    v = _env_float(_INGRESS_ENV)
+    if v is not None:
+        return v
+    with _cfg_lock:
+        c = _config["ingress_budget_s"]
+    return c if c is not None else _DEF_INGRESS_S
+
+
+def static_enqueue_budget_s() -> float:
+    v = _env_float(_ENQUEUE_ENV)
+    if v is not None:
+        return v
+    with _cfg_lock:
+        c = _config["enqueue_budget_s"]
+    return c if c is not None else _DEF_ENQUEUE_S
 
 
 def ingress_budget_s() -> float:
     """The per-envelope deadline budget established at broadcast
-    ingress (FTPU_INGRESS_BUDGET_S, default 30s): the total wall an
-    envelope may spend queued across ALL stages before it is shed."""
-    return _env_budget(_INGRESS_ENV, _DEF_INGRESS_S)
+    ingress (default 30s): the total wall an envelope may spend queued
+    across ALL stages before it is shed. Resolution: the adaptive
+    controller's dynamic override, else FTPU_INGRESS_BUDGET_S, else
+    `Operations.Overload.IngressBudgetS`, else the default."""
+    with _cfg_lock:
+        d = _dynamic["ingress_budget_s"]
+    return d if d is not None else static_ingress_budget_s()
 
 
 def default_enqueue_budget_s() -> float:
     """The bound for a blocking inter-stage put whose caller carries
-    no deadline (FTPU_ENQUEUE_BUDGET_S, default 10s). This is the
-    backstop that closes the unbounded-blocking-put class: a put with
-    neither an explicit nor an ambient deadline still cannot wait
-    forever."""
-    return _env_budget(_ENQUEUE_ENV, _DEF_ENQUEUE_S)
+    no deadline (default 10s). This is the backstop that closes the
+    unbounded-blocking-put class: a put with neither an explicit nor
+    an ambient deadline still cannot wait forever. Resolution mirrors
+    `ingress_budget_s` (dynamic > FTPU_ENQUEUE_BUDGET_S >
+    `Operations.Overload.EnqueueBudgetS` > default)."""
+    with _cfg_lock:
+        d = _dynamic["enqueue_budget_s"]
+    return d if d is not None else static_enqueue_budget_s()
+
+
+def raft_events_cap() -> int:
+    """The per-channel raft event-queue bound (FTPU_RAFT_EVENTS_CAP >
+    `Operations.Overload.RaftEventsCap` > 4096). The live queue's
+    capacity is additionally a registered adaptive knob — this helper
+    only resolves the STARTING bound."""
+    try:
+        v = int(os.environ.get(_EVENTS_CAP_ENV, "") or 0)
+    except ValueError:
+        v = 0
+    if v > 0:
+        return v
+    with _cfg_lock:
+        c = _config["raft_events_cap"]
+    return c if c is not None else _DEF_EVENTS_CAP
+
+
+class ShedRateWindow:
+    """Rolling shed-rate reading: sheds per second over the trailing
+    `window_s`. The lifetime `sheds` counter answers "has this stage
+    EVER shed"; the controller and /healthz need "is it shedding NOW"
+    — burst vs steady. Clock-injectable for deterministic tests."""
+
+    __slots__ = ("window_s", "_clock", "_stamps", "_lock")
+
+    def __init__(self, window_s: float = SHED_RATE_WINDOW_S,
+                 clock=time.monotonic):
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._stamps: "list[float]" = []
+        self._lock = threading.Lock()
+
+    def note(self) -> None:
+        now = self._clock()
+        with self._lock:
+            self._stamps.append(now)
+            self._trim(now)
+
+    def rate(self) -> float:
+        now = self._clock()
+        with self._lock:
+            self._trim(now)
+            return len(self._stamps) / self.window_s
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self.window_s
+        stamps = self._stamps
+        i = 0
+        while i < len(stamps) and stamps[i] < horizon:
+            i += 1
+        if i:
+            del stamps[:i]
 
 
 class OverloadError(Exception):
@@ -275,8 +404,16 @@ class SheddingQueue:
             "max_depth": 0, "wait_s": 0.0, "last_wait_s": 0.0,
         }
         self._last_shed_t: Optional[float] = None
+        self._shed_rate = ShedRateWindow()
         if register:
             register_stage(name, self)
+
+    def _account_shed(self) -> None:
+        # callers hold self._not_full
+        self.stats["sheds"] += 1
+        self._last_shed_t = time.monotonic()
+        self._shed_rate.note()
+        tracing.note_shed(self.name)
 
     # -- producer side --
 
@@ -306,9 +443,7 @@ class SheddingQueue:
             while self._q.qsize() >= self.maxsize:
                 remaining = expires - time.monotonic()
                 if remaining <= 0:
-                    self.stats["sheds"] += 1
-                    self._last_shed_t = time.monotonic()
-                    tracing.note_shed(self.name)
+                    self._account_shed()
                     raise OverloadError(
                         self.name,
                         f"queue full at {self.maxsize} for "
@@ -328,15 +463,20 @@ class SheddingQueue:
         with self._not_full:
             if self._q.qsize() >= self.maxsize:
                 if count_shed:
-                    self.stats["sheds"] += 1
-                    self._last_shed_t = time.monotonic()
-                    tracing.note_shed(self.name)
+                    self._account_shed()
                 else:
                     self.stats["drops"] += 1
                 return False
             self._q.put_nowait(item)
             self._account_put(time.monotonic())
             return True
+
+    def note_drop(self) -> None:
+        """Account an INTERNAL message dropped by the caller without
+        entering the queue (e.g. a flooded control-plane lane) — lands
+        in `drops`, never `sheds`."""
+        with self._not_full:
+            self.stats["drops"] += 1
 
     def put_nowait(self, item) -> None:
         """queue.Queue-compatible spelling: raises `queue.Full` when
@@ -370,9 +510,7 @@ class SheddingQueue:
                 except _queue.Empty:
                     break
                 dropped += 1
-                self.stats["sheds"] += 1
-                self._last_shed_t = time.monotonic()
-                tracing.note_shed(self.name)
+                self._account_shed()
             self._q.put_nowait(item)
             self._account_put(time.monotonic())
         return dropped
@@ -410,4 +548,5 @@ class SheddingQueue:
         out["depth"] = self._q.qsize()
         out["capacity"] = self.maxsize
         out["last_shed_t"] = self._last_shed_t
+        out["shed_rate"] = self._shed_rate.rate()
         return out

@@ -406,6 +406,8 @@ def publish_overload_stats(metrics_provider, poll_s: float = 5.0):
         metrics_mod.OVERLOAD_PUT_WAIT_SECONDS_OPTS)
     sheds_c = metrics_provider.new_counter(
         metrics_mod.OVERLOAD_SHEDS_TOTAL_OPTS)
+    rate_g = metrics_provider.new_gauge(
+        metrics_mod.OVERLOAD_SHED_RATE_OPTS)
 
     last_sheds: dict = {}
     warned: set = set()
@@ -424,6 +426,9 @@ def publish_overload_stats(metrics_provider, poll_s: float = 5.0):
                 if "last_wait_s" in s:
                     wait_g.with_labels(*lbl).set(
                         float(s["last_wait_s"]))
+                if "shed_rate" in s:
+                    rate_g.with_labels(*lbl).set(
+                        float(s["shed_rate"]))
                 sheds = int(s.get("sheds", 0))
                 if sheds > last_sheds.get(stage, 0):
                     sheds_c.with_labels(*lbl).add(

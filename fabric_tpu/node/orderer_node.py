@@ -68,6 +68,12 @@ class OrdererNode:
         # (Operations.SLO.CommitP99S -> /healthz components.slo)
         from fabric_tpu.common import clustertrace as _ctrace
         _ctrace.configure_from_config(cfg)
+        # round-19 serving knobs: Operations.Overload.* config keys
+        # (env remains the override) + the adaptive controller toggle
+        from fabric_tpu.common import adaptive as _adaptive
+        from fabric_tpu.common import overload as _overload
+        _overload.configure_from_config(cfg)
+        _adaptive.configure_from_config(cfg)
 
         bccsp_cfg = cfg.get("General.BCCSP") or {}
         csp = bccsp_factory.new_bccsp(
@@ -222,12 +228,17 @@ class OrdererNode:
         # overload state (ok | shedding:<stages>): shedding is
         # degraded-but-serving — the orderer refusing load past
         # capacity with SERVICE_UNAVAILABLE is working as designed
-        from fabric_tpu.common import overload as _overload
         self.ops.register_checker("overload", _overload.health)
         # commit-latency SLO burn state (ok | burning:<rate>):
         # degraded-but-serving, the breaker-trip trigger discipline —
         # a sustained burn also auto-dumps the flight recorder
         self.ops.register_checker("slo", _ctrace.slo_health)
+        # round-19 adaptive admission controller: closes the loop
+        # from the slo/overload/devicecost signals above onto the
+        # registered serving knobs (disabled -> no thread, no moves)
+        self.adaptive = _adaptive.start_controller(
+            csp=csp, metrics_provider=provider)
+        self.ops.register_checker("adaptive", _adaptive.health)
         self.ops.set_trace_peers(
             cfg.get("Operations.Tracing.ClusterPeers")
             or os.environ.get("FTPU_TRACE_PEERS", ""))
@@ -284,6 +295,8 @@ class OrdererNode:
         return handler
 
     def stop(self) -> None:
+        from fabric_tpu.common import adaptive as _adaptive
+        _adaptive.stop_controller()
         if self.registrar:
             self.registrar.halt()
         if self.cluster:

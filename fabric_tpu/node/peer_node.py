@@ -110,6 +110,12 @@ class PeerNode:
         # (operations.slo.commitP99S -> /healthz components.slo)
         from fabric_tpu.common import clustertrace as _ctrace
         _ctrace.configure_from_config(cfg)
+        # round-19 serving knobs: Operations.Overload.* config keys
+        # (env remains the override) + the adaptive controller toggle
+        from fabric_tpu.common import adaptive as _adaptive
+        from fabric_tpu.common import overload as _overload
+        _overload.configure_from_config(cfg)
+        _adaptive.configure_from_config(cfg)
 
         fs_path = cfg.get_path("peer.fileSystemPath")
         os.makedirs(fs_path, exist_ok=True)
@@ -282,12 +288,17 @@ class PeerNode:
         # overload state (ok | shedding:<stages>): shedding is
         # degraded-but-serving — load past capacity refused cleanly,
         # never a failed health check
-        from fabric_tpu.common import overload as _overload
         self.ops.register_checker("overload", _overload.health)
         # commit-latency SLO burn state (ok | burning:<rate>) — this
         # IS the node that commits, so the e2e histogram/error budget
         # fills here; a sustained burn auto-dumps the flight recorder
         self.ops.register_checker("slo", _ctrace.slo_health)
+        # round-19 adaptive admission controller: closes the loop
+        # from the slo/overload/devicecost signals above onto the
+        # registered serving knobs (disabled -> no thread, no moves)
+        self.adaptive = _adaptive.start_controller(
+            csp=csp, metrics_provider=provider)
+        self.ops.register_checker("adaptive", _adaptive.health)
         self.ops.set_trace_peers(
             cfg.get("operations.tracing.clusterPeers")
             or os.environ.get("FTPU_TRACE_PEERS", ""))
@@ -447,6 +458,8 @@ class PeerNode:
              "dir": completed_dir}).encode()
 
     def stop(self) -> None:
+        from fabric_tpu.common import adaptive as _adaptive
+        _adaptive.stop_controller()
         if self.gossip:
             self.gossip.stop()
         if self.server:

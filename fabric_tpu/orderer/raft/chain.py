@@ -42,7 +42,8 @@ import threading
 import time
 from typing import Optional
 
-from fabric_tpu.common import clustertrace, faults, overload, tracing
+from fabric_tpu.common import (adaptive, clustertrace, faults,
+                               overload, tracing)
 from fabric_tpu.common.hotpath import hot_path
 from fabric_tpu.orderer.msgprocessor import MsgProcessorError
 from fabric_tpu.orderer.raft.core import LEADER, RaftNode
@@ -57,6 +58,18 @@ from fabric_tpu.protoutil import protoutil as pu
 logger = logging.getLogger("orderer.raft.chain")
 
 COMPACT_EVERY = 64   # entries between raft-log compactions
+
+# round 19: default pacing bound on proposed-but-unapplied raft
+# entries. The event queue and the write stage bound their own depths,
+# but nothing bounded the CONSENSUS segment between them — a leader
+# cuts and proposes instantly, so sustained overload parks thousands
+# of blocks inside replication and every commit inherits that standing
+# queue (classic bufferbloat: tightening the other knobs cannot drain
+# a backlog that lives between them). The generous default keeps the
+# gate invisible in normal operation; the adaptive controller shrinks
+# the live cap under SLO burn so end-to-end latency becomes
+# inflight x per-block cost instead of backlog x per-block cost.
+MAX_INFLIGHT_BLOCKS = 4096
 
 from fabric_tpu.common import metrics as _m  # noqa: E402
 
@@ -205,6 +218,80 @@ class _BlockCreator:
         return block
 
 
+class _ProposalGate:
+    """Admission-edge pacing for the consensus pipeline (round 19).
+
+    Depth is entries the leader has proposed but not yet applied
+    (`last_index - applied_index`): the segment of the ordering path
+    that had no bound of its own. `admit` blocks the submitting
+    broadcast worker while the pipeline is at capacity — honest
+    backpressure, bounded by the caller's deadline budget exactly like
+    `SheddingQueue.put` — then sheds with a retryable OverloadError.
+    The live cap is a registered adaptive knob; the registered-stage
+    readings (`raft.inflight.<channel>.<node>`) feed the controller's
+    overload signal like any other stage."""
+
+    _POLL_S = 0.005   # applied_index advances off-thread; no condvar
+
+    def __init__(self, chain: "RaftChain",
+                 cap: int = MAX_INFLIGHT_BLOCKS):
+        self._chain = chain
+        self.cap = cap
+        self._lock = threading.Lock()
+        self.stats = {"puts": 0, "sheds": 0}
+        self._last_shed_t: Optional[float] = None
+        self._shed_rate = overload.ShedRateWindow()
+        self._name = (f"raft.inflight.{chain._support.channel_id}"
+                      f".{chain.node_id}")
+        overload.register_stage(self._name, self)
+
+    def depth(self) -> int:
+        node = self._chain.node
+        return max(0, node.last_index() - node.applied_index)
+
+    def overload_stats(self) -> dict:
+        # no max_depth: the gate paces ADMISSION, it is not a hard
+        # bound — one already-admitted window may cut several blocks
+        # past the cap, which is overshoot, not a leak
+        with self._lock:
+            return {
+                "depth": self.depth(),
+                "capacity": self.cap,
+                "sheds": self.stats["sheds"],
+                "puts": self.stats["puts"],
+                "last_shed_t": self._last_shed_t,
+                "shed_rate": self._shed_rate.rate(),
+            }
+
+    def admit(self) -> None:
+        """Wait for consensus-pipeline headroom, up to the ambient
+        deadline budget; shed (retryable) past it."""
+        cap = int(self.cap or 0)
+        if cap <= 0 or self.depth() < cap:
+            with self._lock:
+                self.stats["puts"] += 1
+            return
+        budget = overload.Deadline.remaining_or(
+            overload.default_enqueue_budget_s())
+        deadline = time.monotonic() + max(0.0, budget)
+        while self.depth() >= int(self.cap or cap):
+            if time.monotonic() >= deadline or \
+                    self._chain._halted.is_set():
+                with self._lock:
+                    self.stats["sheds"] += 1
+                    self._last_shed_t = time.monotonic()
+                    self._shed_rate.note()
+                tracing.note_shed(self._name)
+                raise overload.OverloadError(
+                    self._name,
+                    f"consensus pipeline at {self.depth()} inflight "
+                    f"entries (cap {int(self.cap)}) past the deadline "
+                    f"budget")
+            time.sleep(self._POLL_S)
+        with self._lock:
+            self.stats["puts"] += 1
+
+
 class RaftChain:
     """consensus.Chain over the raft core."""
 
@@ -254,17 +341,31 @@ class RaftChain:
         # queue — a full queue bounds the producer's wait by the
         # caller's deadline budget and then sheds with a retryable
         # OverloadError (surfaced as SERVICE_UNAVAILABLE), instead of
-        # hanging the broadcast handler forever. FTPU_RAFT_EVENTS_CAP
-        # shrinks the bound for the overload soak rig.
-        try:
-            events_cap = int(os.environ.get(
-                "FTPU_RAFT_EVENTS_CAP", "4096") or 4096)
-        except ValueError:
-            events_cap = 4096
+        # hanging the broadcast handler forever. The starting bound
+        # resolves through overload.raft_events_cap()
+        # (FTPU_RAFT_EVENTS_CAP > Operations.Overload.RaftEventsCap >
+        # 4096, round 19); the live capacity is a registered adaptive
+        # knob — the controller shrinks it under SLO burn so ordering
+        # load sheds at the admission edge instead of queueing into
+        # the commit p99, and restores it in calm.
         self._events = overload.SheddingQueue(
             f"raft.events.{support.channel_id}",
-            maxsize=max(1, events_cap))
+            maxsize=max(1, overload.raft_events_cap()))
+        adaptive.register_queue_capacity(
+            self._events,
+            name=(f"raft.events.{support.channel_id}"
+                  f".{self.node_id}.capacity"),
+            floor=max(4, self._events.maxsize // 32))
         self._halted = threading.Event()
+        # round 19: proposal pacing — see _ProposalGate. The cap is an
+        # adaptive knob: invisible at the default, tightened under SLO
+        # burn so commit latency tracks inflight depth, not backlog.
+        self._proposal_gate = _ProposalGate(self)
+        adaptive.register_attr_knob(
+            self._proposal_gate, "cap",
+            f"raft.inflight.{support.channel_id}.{self.node_id}.cap",
+            floor=max(2, MAX_INFLIGHT_BLOCKS // 1024),
+            ceiling=MAX_INFLIGHT_BLOCKS)
         self._thread: Optional[threading.Thread] = None
         self._creator: Optional[_BlockCreator] = None
         self._timer_deadline: Optional[float] = None
@@ -399,6 +500,7 @@ class RaftChain:
             raise MsgProcessorError("chain is halted")
         leader = self.node.leader_id
         if leader == self.node_id:
+            self._proposal_gate.admit()
             self._events.put(("order_batch", envs_seqs,
                               tracing.capture()))
             return len(envs_seqs)
@@ -458,12 +560,20 @@ class RaftChain:
             msg.ParseFromString(payload)
         except Exception:
             return
-        # a dropped step is INTERNAL protocol loss (raft
-        # retransmission recovers it), not a client-visible shed:
-        # count it in the queue's `drops` stat, keep sheds_total and
-        # /healthz `shedding` meaning real refused work
-        if not self._events.offer(("step", msg), count_shed=False):
-            logger.warning("[%s] raft event queue full; step "
+        # round 19: consensus steps are CONTROL-PLANE traffic and ride
+        # PAST the data-plane bound (put_forced) — a queue full of
+        # order submissions must never starve acks and heartbeats, or
+        # sustained admission pressure deposes a healthy leader and
+        # the whole channel livelocks (the serving soak exposed
+        # exactly this). The lane is still bounded: past 4x the
+        # data-plane capacity the step is dropped (raft retransmission
+        # recovers INTERNAL protocol loss — counted in `drops`, not
+        # `sheds`, which keeps meaning client-visible refusals).
+        if self._events.qsize() < 4 * self._events.maxsize:
+            self._events.put_forced(("step", msg))
+        else:
+            self._events.note_drop()
+            logger.warning("[%s] raft event queue flooded; step "
                            "message dropped",
                            self._support.channel_id)
 
@@ -486,6 +596,8 @@ class RaftChain:
             ch = pu.get_channel_header(payload)
             is_config = ch.type in (common.HeaderType.CONFIG,
                                     common.HeaderType.ORDERER_TRANSACTION)
+            if not is_config:   # config traffic is never paced
+                self._proposal_gate.admit()
             self._events.put(("order", env, config_seq, is_config,
                               tracing.capture()))
         except overload.OverloadError as e:

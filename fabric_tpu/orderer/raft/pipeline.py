@@ -39,7 +39,7 @@ import threading
 import time
 from typing import Callable, Optional
 
-from fabric_tpu.common import faults, overload, tracing
+from fabric_tpu.common import adaptive, faults, overload, tracing
 
 logger = logging.getLogger("orderer.raft.pipeline")
 
@@ -93,8 +93,19 @@ class BlockWriteStage:
             "write_s": 0.0, "overlap_s": 0.0, "last_write_s": 0.0,
         }
         self._last_shed_t: Optional[float] = None
+        self._shed_rate = overload.ShedRateWindow()
         overload.register_stage(
             f"order.write.{support.channel_id}", self)
+        # round 19: the pending-span bound is an adaptive knob —
+        # tightening it propagates writer backpressure to the
+        # admission edge sooner (shallower queues, shorter commit
+        # tail); the ceiling is the configured bound.
+        knob_scope = f"{support.channel_id}.{node_id}" if node_id \
+            else support.channel_id
+        adaptive.register_attr_knob(
+            self, "_max_pending",
+            f"order.write.{knob_scope}.max_pending",
+            floor=max(1, max_pending // 32), ceiling=max_pending)
         self._thread = threading.Thread(
             target=self._write_loop,
             name=f"order-write-{support.channel_id}", daemon=True)
@@ -111,6 +122,7 @@ class BlockWriteStage:
                 "sheds": self.stats["sheds"],
                 "puts": self.stats["written"] + len(self._pending),
                 "last_shed_t": self._last_shed_t,
+                "shed_rate": self._shed_rate.rate(),
             }
 
     # -- raft-loop API --
@@ -140,6 +152,7 @@ class BlockWriteStage:
                 if remaining <= 0:
                     self.stats["sheds"] += 1
                     self._last_shed_t = time.monotonic()
+                    self._shed_rate.note()
                     tracing.note_shed(
                         f"order.write.{self._support.channel_id}")
                     raise OrderWriteError(

@@ -1,0 +1,401 @@
+"""Round-19 adaptive admission control (ISSUE 16).
+
+The claims under test, over `common/adaptive.py`, the knob seams it
+tunes (`common/overload.py` budgets, SheddingQueue capacities, the
+raft proposal gate) and the observability contract:
+
+  * sustained SLO burn TIGHTENS every registered knob in bounded
+    multiplicative steps; recovery RELAXES only after the (longer)
+    calm hysteresis — backing off is prompt, recovering is cautious;
+  * chaos-noise signals flipping hot/calm tick-to-tick produce HOLDS,
+    not flapping (direction reversals wait out the cooldown);
+  * every knob converges at its floor/ceiling (clamp, not oscillate)
+    and a controller move never leaves the declared bounds;
+  * `FTPU_ADAPTIVE=0` is a true no-op: no controller, no thread, no
+    knob ever moved, `health()` reads `disabled`;
+  * each applied move emits an `adaptive.adjust` tracing instant and
+    the `adaptive_*` gauges/counters;
+  * the serving knobs resolve dynamic (controller) > env >
+    `Operations.Overload.*` config > default, and the rolling
+    shed-rate window reads sheds-per-second over an injected clock;
+  * the raft proposal gate (`chain._ProposalGate`) admits under its
+    cap, sheds PAST the deadline budget with a retryable
+    OverloadError, and surfaces depth/capacity through the overload
+    registry like any stage.
+
+The controller's clock and signal source are injected — no threads,
+no sleeps; each `tick()` is one deterministic control decision.
+Wired into tools/static_check.sh's lockcheck subset: the decision
+path must stay lock-ordering clean alongside the queues it tunes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from fabric_tpu.common import adaptive, metrics, overload, tracing
+from fabric_tpu.common.adaptive import (
+    RELAX, TIGHTEN, AdaptiveController, Knob,
+)
+
+
+@pytest.fixture()
+def adaptive_env(monkeypatch):
+    """Isolated plane: enabled via env, empty registry, clean budget
+    overrides, fresh recorder; restores everything afterwards."""
+    monkeypatch.setenv("FTPU_ADAPTIVE", "1")
+    adaptive.reset()
+    overload.clear_dynamic_budgets()
+    tracing.configure(enabled=True, ring_size=1024, sample_every=1,
+                      dump_dir="", dump_min_interval_s=10.0)
+    tracing.reset()
+    yield
+    adaptive.reset()
+    overload.clear_dynamic_budgets()
+    tracing.reset()
+
+
+class _Sig:
+    """Scriptable signal source: a list of signal dicts, replayed one
+    per tick (the last one repeats)."""
+
+    def __init__(self, *frames):
+        self.frames = list(frames)
+        self.i = 0
+
+    def __call__(self):
+        f = self.frames[min(self.i, len(self.frames) - 1)]
+        self.i += 1
+        return dict(f)
+
+
+QUIET = {"slo_burn": 0.0, "shed_rate": 0.0, "queue_pressure": 0.0,
+         "device_busy": 0.0, "hbm_headroom": 1.0}
+BURNING = dict(QUIET, slo_burn=4.0)
+
+
+class _Holder:
+    """Knob owner for register_attr_knob (keeps the weak registry
+    entry alive for the test's duration)."""
+
+    def __init__(self, cap=64):
+        self.cap = cap
+
+
+def _ctl(signals, **policy):
+    policy.setdefault("tighten_after", 2)
+    policy.setdefault("relax_after", 4)
+    policy.setdefault("reversal_cooldown", 4)
+    return AdaptiveController(interval_s=3600.0, clock=lambda: 0.0,
+                              signal_fn=signals, **policy)
+
+
+class TestHysteresis:
+    def test_burn_tightens_after_streak(self, adaptive_env):
+        h = _Holder(cap=64)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=8, ceiling=64)
+        ctl = _ctl(_Sig(BURNING))
+        assert ctl.tick()["moved"] == []      # streak 1 < tighten_after
+        assert h.cap == 64
+        moved = ctl.tick()["moved"]           # streak 2: tighten
+        assert moved == [("t.cap", 64, 32)]
+        assert h.cap == 32
+        assert ctl.tick()["moved"] == [("t.cap", 32, 16)]
+        assert ctl.stats["tightens"] == 2
+
+    def test_recovery_relaxes_only_after_calm_hysteresis(
+            self, adaptive_env):
+        h = _Holder(cap=64)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=8, ceiling=64)
+        ctl = _ctl(_Sig(BURNING, BURNING, QUIET),
+                   reversal_cooldown=0)
+        ctl.tick(), ctl.tick()                # tighten once -> 32
+        assert h.cap == 32
+        for _ in range(3):                    # calm 1..3 < relax_after
+            assert ctl.tick()["moved"] == []
+        assert h.cap == 32
+        assert ctl.tick()["moved"] == [("t.cap", 32, 64)]
+        assert ctl.stats["relaxes"] == 1
+
+    def test_one_hot_tick_among_calm_resets_the_calm_streak(
+            self, adaptive_env):
+        h = _Holder(cap=32)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=8, ceiling=64)
+        ctl = _ctl(_Sig(QUIET, QUIET, QUIET, BURNING, QUIET, QUIET,
+                        QUIET, QUIET),
+                   reversal_cooldown=0)
+        for _ in range(7):
+            assert ctl.tick()["moved"] == []  # streak broken at tick 4
+        assert ctl.tick()["moved"] == [("t.cap", 32, 64)]
+
+
+class TestAntiFlap:
+    def test_chaos_noise_holds_instead_of_flapping(self,
+                                                   adaptive_env):
+        h = _Holder(cap=64)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=4, ceiling=64)
+        # hot long enough to tighten, then calm long enough to WANT a
+        # relax while the reversal cooldown still runs, then hot again
+        frames = [BURNING] * 3 + [QUIET] * 4 + [BURNING] * 3
+        ctl = _ctl(_Sig(*frames), reversal_cooldown=6)
+        for _ in frames:
+            ctl.tick()
+        assert ctl.stats["reversals"] == 0
+        assert ctl.stats["cooldown_holds"] >= 1
+        assert ctl.stats["tightens"] >= 2
+
+    def test_reversal_after_cooldown_is_counted(self, adaptive_env):
+        h = _Holder(cap=64)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=4, ceiling=64)
+        ctl = _ctl(_Sig(BURNING, BURNING, QUIET),
+                   reversal_cooldown=2)
+        ctl.tick(), ctl.tick()                # tighten; cooldown = 2
+        for _ in range(5):                    # calm: cooldown drains,
+            ctl.tick()                        # then relax_after trips
+        assert ctl.stats["relaxes"] == 1
+        assert ctl.stats["reversals"] == 1
+        assert h.cap == 64
+
+
+class TestBounds:
+    def test_tighten_converges_at_floor_as_clamps(self, adaptive_env):
+        h = _Holder(cap=16)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=8, ceiling=64)
+        ctl = _ctl(_Sig(BURNING))
+        for _ in range(8):
+            ctl.tick()
+        assert h.cap == 8                     # pinned, never below
+        assert ctl.stats["clamps"] >= 1
+        assert ctl.stats["moves"] == 1        # 16 -> 8, then clamps
+
+    def test_relax_never_exceeds_ceiling(self, adaptive_env):
+        h = _Holder(cap=48)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=8, ceiling=64)
+        ctl = _ctl(_Sig(QUIET), reversal_cooldown=0)
+        for _ in range(10):
+            ctl.tick()
+        assert h.cap == 64
+
+    def test_knob_declares_sane_bounds(self):
+        with pytest.raises(ValueError):
+            Knob("bad", get=lambda: 1, set=lambda v: None,
+                 floor=10, ceiling=5)
+        with pytest.raises(ValueError):
+            Knob("bad", get=lambda: 1, set=lambda v: None,
+                 floor=1, ceiling=5, step=1.0)
+
+    def test_queue_capacity_knob_anchors_at_configured_cap(
+            self, adaptive_env):
+        q = overload.SheddingQueue("t.q", maxsize=64)
+        k = adaptive.register_queue_capacity(q)
+        assert (k.floor, k.ceiling) == (8, 64)
+        assert k.move(TIGHTEN) == (64, 32, False)
+        assert q.maxsize == 32
+        assert k.move(RELAX) == (32, 64, False)
+        assert k.move(RELAX) == (64, 64, True)   # clamped at base
+
+
+class TestDisabled:
+    def test_disabled_plane_is_a_no_op(self, adaptive_env,
+                                       monkeypatch):
+        monkeypatch.setenv("FTPU_ADAPTIVE", "0")
+        assert not adaptive.enabled()
+        assert adaptive.start_controller() is None
+        assert adaptive.controller() is None
+        assert adaptive.health() == "disabled"
+        # no budget override was installed behind the operator's back
+        assert overload.ingress_budget_s() == \
+            overload.static_ingress_budget_s()
+
+    def test_env_toggle_spellings(self, adaptive_env, monkeypatch):
+        for off in ("0", "false", "No", "OFF"):
+            monkeypatch.setenv("FTPU_ADAPTIVE", off)
+            assert not adaptive.enabled()
+        monkeypatch.setenv("FTPU_ADAPTIVE", "1")
+        assert adaptive.enabled()
+
+
+class TestObservability:
+    def test_moves_emit_instants_and_gauges(self, adaptive_env):
+        h = _Holder(cap=64)
+        adaptive.register_attr_knob(h, "cap", "t.cap",
+                                    floor=8, ceiling=64)
+        provider = metrics.PrometheusProvider()
+        ctl = _ctl(_Sig(BURNING))
+        ctl.bind_metrics(provider)
+        ctl.tick(), ctl.tick()
+        inst = [e for e in tracing.snapshot()
+                if e[1] == "adaptive.adjust"]
+        assert len(inst) == 1
+        attrs = inst[0][8]
+        assert attrs["knob"] == "t.cap"
+        assert (attrs["frm"], attrs["to"]) == (64, 32)
+        assert attrs["direction"] == "tighten"
+        assert attrs["reason"] == "slo_burn"
+        text = provider.render()
+        assert 'adaptive_knob_value{knob="t.cap"} 32' in text
+        assert ('adaptive_adjustments_total'
+                '{knob="t.cap",direction="tighten"} 1') in text
+        assert 'adaptive_signal{signal="slo_burn"} 4' in text
+
+    def test_health_surfaces_controller_counts(self, adaptive_env):
+        ctl = adaptive.start_controller(interval_s=3600.0)
+        try:
+            assert adaptive.health().startswith("ok:moves=")
+        finally:
+            adaptive.stop_controller()
+        assert adaptive.health() == "disabled"
+
+
+class TestBudgetResolution:
+    def test_dynamic_beats_env_beats_config_beats_default(
+            self, adaptive_env, monkeypatch):
+        class _Cfg:
+            def get_duration(self, key, default=0.0):
+                return {"Operations.Overload.IngressBudgetS": 20.0,
+                        "Operations.Overload.EnqueueBudgetS": 8.0,
+                        }.get(key, default)
+
+            def get_int(self, key, default=0):
+                return {"Operations.Overload.RaftEventsCap": 512,
+                        }.get(key, default)
+
+        monkeypatch.delenv("FTPU_INGRESS_BUDGET_S", raising=False)
+        monkeypatch.delenv("FTPU_RAFT_EVENTS_CAP", raising=False)
+        overload.configure_from_config(_Cfg())
+        try:
+            assert overload.ingress_budget_s() == 20.0
+            assert overload.raft_events_cap() == 512
+            monkeypatch.setenv("FTPU_INGRESS_BUDGET_S", "15")
+            monkeypatch.setenv("FTPU_RAFT_EVENTS_CAP", "256")
+            assert overload.ingress_budget_s() == 15.0
+            assert overload.raft_events_cap() == 256
+            overload.set_dynamic_budget("ingress", 5.0)
+            assert overload.ingress_budget_s() == 5.0
+            # the STATIC base (the controller's anchor) ignores the
+            # controller's own override
+            assert overload.static_ingress_budget_s() == 15.0
+            overload.set_dynamic_budget("ingress", None)
+            assert overload.ingress_budget_s() == 15.0
+        finally:
+            class _Empty:
+                def get_duration(self, key, default=0.0):
+                    return default
+
+                def get_int(self, key, default=0):
+                    return default
+
+            overload.configure_from_config(_Empty())
+
+    def test_budget_knobs_anchor_and_restore(self, adaptive_env,
+                                             monkeypatch):
+        monkeypatch.setenv("FTPU_INGRESS_BUDGET_S", "16")
+        ing, _enq = adaptive.register_budget_knobs()
+        assert (ing.floor, ing.ceiling) == (2.0, 16.0)
+        ing.move(TIGHTEN)
+        assert overload.ingress_budget_s() == 8.0
+        ing.move(RELAX)
+        assert overload.ingress_budget_s() == 16.0
+        adaptive.reset()   # stop_controller clears dynamic overrides
+        assert overload.ingress_budget_s() == 16.0
+
+    def test_unknown_dynamic_budget_rejected(self):
+        with pytest.raises(KeyError):
+            overload.set_dynamic_budget("nonsense", 1.0)
+
+
+class TestShedRateWindow:
+    def test_rolling_rate_over_injected_clock(self):
+        now = [0.0]
+        w = overload.ShedRateWindow(window_s=10.0,
+                                    clock=lambda: now[0])
+        assert w.rate() == 0.0
+        for _ in range(5):
+            w.note()
+        assert w.rate() == 0.5                # 5 sheds / 10 s
+        now[0] = 9.0
+        w.note()
+        assert w.rate() == 0.6
+        now[0] = 11.0                         # first burst aged out
+        assert w.rate() == 0.1
+
+
+class TestProposalGate:
+    """The round-19 consensus pacing seam (orderer/raft/chain.py)."""
+
+    def _gate(self, depth=0, cap=4):
+        import types
+
+        from fabric_tpu.orderer.raft.chain import _ProposalGate
+
+        state = {"depth": depth}
+        chain = types.SimpleNamespace(
+            _support=types.SimpleNamespace(channel_id="tch"),
+            node_id=7,
+            node=types.SimpleNamespace(
+                last_index=lambda: state["depth"],
+                applied_index=0),
+            _halted=types.SimpleNamespace(is_set=lambda: False))
+        return _ProposalGate(chain, cap=cap), state
+
+    def test_admits_below_cap_and_reads_as_a_stage(self,
+                                                   adaptive_env):
+        gate, _state = self._gate(depth=3, cap=4)
+        gate.admit()
+        s = overload.stage_stats()["raft.inflight.tch.7"]
+        assert (s["depth"], s["capacity"]) == (3, 4)
+        assert (s["puts"], s["sheds"]) == (1, 0)
+
+    def test_sheds_past_the_deadline_budget(self, adaptive_env):
+        gate, _state = self._gate(depth=4, cap=4)
+        with overload.Deadline.after(0.02).applied():
+            with pytest.raises(overload.OverloadError):
+                gate.admit()
+        assert gate.stats["sheds"] == 1
+        assert overload.stage_stats()[
+            "raft.inflight.tch.7"]["shed_rate"] > 0
+        inst = [e for e in tracing.snapshot()
+                if e[1] == "overload.shed"]
+        assert inst, "shed instant must be recorded"
+
+    def test_admits_when_backlog_drains_within_budget(
+            self, adaptive_env):
+        gate, state = self._gate(depth=4, cap=4)
+
+        # the pipeline applies an entry while the submitter waits
+        import threading
+        t = threading.Timer(0.05,
+                            lambda: state.update(depth=1))
+        t.start()
+        try:
+            with overload.Deadline.after(2.0).applied():
+                gate.admit()                  # blocks, then passes
+        finally:
+            t.join()
+        assert gate.stats["sheds"] == 0
+        assert gate.stats["puts"] == 1
+
+    def test_cap_is_an_adaptive_knob(self, adaptive_env):
+        gate, _state = self._gate(cap=64)
+        k = adaptive.register_attr_knob(
+            gate, "cap", "raft.inflight.tch.7.cap",
+            floor=8, ceiling=64)
+        assert k.move(TIGHTEN) == (64, 32, False)
+        assert gate.cap == 32
+
+
+class TestNoteDrop:
+    def test_internal_drop_counts_as_drop_not_shed(self):
+        q = overload.SheddingQueue("t.drop", maxsize=2)
+        q.note_drop()
+        assert q.stats["drops"] == 1
+        assert q.stats["sheds"] == 0

@@ -174,6 +174,34 @@ if "skipped" not in fp and not fp.get("failover_skipped"):
           fp["failover_committed"], "committed exactly once under",
           fp.get("failover_chaos_dropped"), "dropped msgs")
 
+# round-19 contract: the full_pipeline line carries the adaptive
+# control-plane facts (or an explicit skip marker) — the max
+# sustainable tx/s the closed loop held inside the p99 commit SLO,
+# the static-baseline comparison, and the anti-flap verdict. The
+# contract HERE is "fields parse and exactly-once held" — the strong
+# claims (SLO held, adaptive beats static) belong to the soak gate,
+# where the run is long enough to be a fair fight.
+if "skipped" not in fp and not fp.get("adaptive_skipped"):
+    assert not fp.get("adaptive_error"), \
+        f"adaptive section failed: {fp['adaptive_error']}"
+    assert fp.get("max_sustainable_tx_s", 0) > 0, \
+        f"full_pipeline lacks max_sustainable_tx_s: {fp}"
+    assert fp.get("adaptive_p99_s", 0) > 0, \
+        f"full_pipeline lacks adaptive_p99_s: {fp}"
+    assert fp.get("adaptive_slo_target_s", 0) > 0, fp
+    for f in ("adaptive_slo_held", "adaptive_beats_static",
+              "adaptive_no_flap"):
+        assert isinstance(fp.get(f), bool), \
+            f"full_pipeline lacks adaptive verdict field {f!r}: {fp}"
+    assert fp.get("adaptive_exact_once") is True, \
+        f"adaptive exactly-once contract not reported green: {fp}"
+    print("bench_smoke: adaptive plane sustained",
+          fp["max_sustainable_tx_s"], "tx/s at p99",
+          fp.get("adaptive_p99_s"), "s (SLO",
+          fp.get("adaptive_slo_target_s"), "s held:",
+          fp.get("adaptive_slo_held"), ") vs static",
+          fp.get("adaptive_static_tx_s"), "tx/s")
+
 # round-14 contract: the core stage measures the tracing overhead
 # A/B on its steady loop and reports the verify tail
 pe = stages.get("provider_e2e") or {}

@@ -12,7 +12,7 @@
 # Spec grammar: point=mode[:count][:delay_s][:arg], mode in
 # {error, delay}; the 4th field targets a check() argument (the
 # per-device points pass the full-mesh chip index).
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|mesh-health|tracing|net|devicecost|e2e-trace|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|adaptive|mesh-health|tracing|net|devicecost|e2e-trace|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -145,6 +145,20 @@ overload() {
         tests/test_overload.py -k "Shed or Chain or Broadcast"
 }
 
+adaptive() {
+    # the round-19 control plane under fire: armed propose stalls and
+    # dropped raft steps perturb every signal the controller reads
+    # (burn, sheds, depths) while the hysteresis/anti-flap/bounds
+    # contract is pinned — noisy signals may change WHEN it moves,
+    # never let it flap or leave a knob's declared bounds; the
+    # proposal gate must keep shedding as clean retryable refusals
+    run "order.propose=delay::0.02;raft.step=error:3" \
+        tests/test_adaptive.py
+    run "tpu.dispatch=error:2;order.propose=error:1" \
+        tests/test_adaptive.py tests/test_overload.py -k \
+        "Adaptive or Hysteresis or AntiFlap or Bounds or Gate or Shed"
+}
+
 tracing() {
     # the round-14 lifecycle tracer under fire: armed dispatch /
     # propose / per-device faults must surface as ERROR-STATUS spans
@@ -230,6 +244,7 @@ case "${1:-all}" in
     order) order ;;
     schemes) schemes ;;
     overload) overload ;;
+    adaptive) adaptive ;;
     mesh-health) mesh_health ;;
     tracing) tracing ;;
     net) net ;;
@@ -237,7 +252,7 @@ case "${1:-all}" in
     e2e-trace) e2e_trace ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
-         schemes; overload; mesh_health; tracing; net; devicecost;
+         schemes; overload; adaptive; mesh_health; tracing; net; devicecost;
          e2e_trace; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac

@@ -151,3 +151,85 @@ print("soak_check: PASS — leader killed at "
       f"{r['chaos_dropped']} drops/{r['chaos_duplicated']} dups/"
       f"{r['chaos_reordered']} reorders; survivors byte-identical")
 EOF
+
+# ---------------------------------------------------------------------------
+# Round-19 adaptive serving soak (ISSUE 16 acceptance): the
+# closed-loop workload generator drives the 3-consenter + 2-peer rig
+# under seeded NetChaos twice — once with every serving knob static,
+# once with the adaptive admission controller live — and the gate
+# holds the controller's contract:
+#
+#   * the adaptive phase HOLDS the p99 commit SLO the static phase
+#     burns, at equal-or-better throughput (adaptive_beats_static);
+#   * max_sustainable_tx_s is reported from the steady window;
+#   * adjustments are bounded (no flapping: reversals/moves inside
+#     the rig's ceilings) and at least one knob actually moved;
+#   * admission accounting balances (offered = accepted + shed +
+#     rejected), every accepted tx committed exactly once on all
+#     nodes, and the committed stream replays bit-identically
+#     through the sequential oracle;
+#   * zero lock-order violations with FTPU_LOCKCHECK=1 armed.
+# ---------------------------------------------------------------------------
+: "${ADAPTIVE_TXS:=2400}"
+: "${ADAPTIVE_WALL_S:=600}"
+: "${ADAPTIVE_FAULTS:=raft.step=error:5}"
+
+echo "== soak_check: adaptive closed-loop serving soak, FTPU_FAULTS='${ADAPTIVE_FAULTS}', lockcheck armed"
+rc=0
+aout=$(timeout -k 10 "${ADAPTIVE_WALL_S}" \
+    env JAX_PLATFORMS=cpu FTPU_LOCKCHECK=1 FTPU_ADAPTIVE=1 \
+    FTPU_FAULTS="${ADAPTIVE_FAULTS}" \
+    SOAK_TXS="${ADAPTIVE_TXS}" \
+    python bench_pipeline.py adaptive) || rc=$?
+echo "${aout}"
+if [ "${rc}" -ne 0 ]; then
+    echo "soak_check: adaptive run failed (rc=${rc})" >&2
+    exit "${rc}"
+fi
+
+python - "${aout}" <<'EOF'
+import json
+import sys
+
+r = json.loads(sys.argv[1])
+
+def check(cond, msg):
+    if not cond:
+        print(f"soak_check FAILED: {msg}: {json.dumps(r)}",
+              file=sys.stderr)
+        sys.exit(1)
+
+check(r["slo_held"] is True,
+      "the adaptive phase did not hold the p99 commit SLO")
+check(r["adaptive_beats_static"] is True,
+      "the controller did not beat the static-knob baseline")
+check(r["max_sustainable_tx_s"] > 0,
+      "no max-sustainable-throughput reading")
+check(r["static"]["slo_held"] is False,
+      "the static baseline never burned — the soak was vacuous "
+      "(raise ADAPTIVE_TXS)")
+check(r["no_flap"] is True, "controller flapped")
+check(r["controller_moves"] >= 1, "no knob ever moved")
+for ph in ("static", "adaptive"):
+    p = r[ph]
+    check(p["offered"] == p["accepted"] + p["shed"]
+          + p["rejected_invalid"],
+          f"{ph}: admission accounting does not balance")
+    check(all(c == p["committed"] for c in p["peer_commits"]),
+          f"{ph}: peers diverged from the ordered stream")
+check(r["accepted_commit_exact_once"] is True,
+      "accepted envelopes did not commit exactly once")
+check(r["oracle_bit_identical"] is True,
+      "committed stream diverged from the sequential oracle")
+check(r["scheme_mix"]["all_verdicts_exact"] is True,
+      "mixed-scheme verdicts drifted")
+check(r["lockcheck_violations"] == 0,
+      "lock-order violations recorded under adaptive load")
+print("soak_check: PASS — adaptive plane held "
+      f"p99 {r['adaptive']['commit_p99_s']}s <= "
+      f"{r['slo_target_s']}s at {r['max_sustainable_tx_s']} tx/s "
+      f"(static burned at {r['static']['commit_p99_s']}s, "
+      f"{r['static']['tx_s']} tx/s); "
+      f"{r['controller_moves']} bounded moves, "
+      f"{r['controller_reversals']} reversals")
+EOF
