@@ -1006,9 +1006,9 @@ def stage_core():
     if not all(out):
         raise SystemExit("correctness failure: valid signatures "
                          "rejected")
-    if prov.stats["comb_batches"] < 1:
-        raise SystemExit("bench did not exercise the comb path: %s"
-                         % prov.stats)
+    if prov.stats["comb_batches"] + prov.stats["fused_batches"] < 1:
+        raise SystemExit("bench did not exercise a device verify "
+                         "tier: %s" % prov.stats)
     times = []
     for _ in range(3):
         t0 = time.perf_counter()
@@ -1087,17 +1087,26 @@ def stage_core():
     #     (sharded across the mesh when one is configured — transfer
     #     jitter must not pollute the kernel number) ---
     tpu_s = None
+    host_prep_s = None
+    fused_fields: dict = {}
     if _remaining() <= 45:
         emit_stage({"stage": "kernel_steady", "skipped": "budget",
                     "devices": devices or local_devices})
+        fused_fields["fused_skipped"] = "budget"
     else:
         from fabric_tpu import native
 
         bucket = prov._bucket(batch)   # the shape verify_batch compiled
+        # host SHA-256 of every message lane — the serialized host
+        # slice the round-20 fused kernel moves on device; timed so
+        # the fused A/B below can report what it eliminates
+        t0 = time.perf_counter()
         digests0 = np.zeros((bucket, 8), dtype=np.uint32)
         for i, m in enumerate(msgs):
             digests0[i] = np.frombuffer(
                 hashlib.sha256(m).digest(), dtype=">u4")
+        host_prep_s = time.perf_counter() - t0
+        _PARTIAL["host_prep_s"] = round(host_prep_s, 4)
         prep = native.batch_prep([it.signature for it in items])
         if prep is not None:
             ok_n, r_b, rpn_b, w_b = prep
@@ -1132,6 +1141,11 @@ def stage_core():
             pub = it.key.public_key()
             kb = pub.x_bytes().tobytes() + pub.y_bytes().tobytes()
             key_idx[i] = key_map.setdefault(kb, len(key_map))
+        # pristine first-appearance slots for the fused A/B below:
+        # prepared_digest_pipeline returns a CANONICALLY REMAPPED
+        # key_idx, and remapping an already-remapped array combs
+        # lanes against the wrong keys
+        key_idx0 = key_idx.copy()
         # the provider's SUPPORTED measurement surface: its own
         # compiled digest pipeline + resident tables, degrading to the
         # 8-bit path exactly as verify_batch would (the BENCH_r04
@@ -1183,7 +1197,85 @@ def stage_core():
                     "mesh_devices": mesh_devices, "batch": batch,
                     "sigs_per_s": round(batch / tpu_s, 1),
                     "seconds": round(tpu_s, 4),
+                    "hash_mode": "host-digest",
                     "chunk": chunk, "q16": bool(q16_path)})
+
+        # --- fused A/B sub-stage (round 20): the SAME corpus through
+        #     the fused Pallas tier — raw padded message lanes in,
+        #     device SHA-256 ahead of the comb, zero host hashing.
+        #     `fused_vs_staged` is the per-iteration device ratio;
+        #     `host_prep_s` above is the serialized host slice the
+        #     fused path additionally eliminates. CPU rigs emit an
+        #     explicit `fused_skipped: cpu` marker (the interpret-mode
+        #     Mosaic compile is minutes, not a serving configuration)
+        #     unless FTPU_FUSED=1 forces the A/B through interpret ---
+        if os.environ.get("BENCH_FUSED", "1") != "1":
+            fused_fields["fused_skipped"] = "env"
+        elif (not type(prov)._on_tpu()
+              and os.environ.get("FTPU_FUSED") != "1"):
+            fused_fields["fused_skipped"] = "cpu"
+        elif _remaining() <= 120:
+            fused_fields["fused_skipped"] = "budget"
+        else:
+            from fabric_tpu.ops import sha256 as _sha
+            t0 = time.perf_counter()
+            f_nb = max(1, (max(len(m) for m in msgs) + 9 + 63) // 64)
+            blocks, nblocks = _sha.pack_messages(
+                list(msgs) + [b""] * (bucket - batch), f_nb)
+            nblocks = nblocks.astype(np.int32)
+            fused_pack_s = time.perf_counter() - t0
+            ffn, fkey, ftabs = prov.prepared_fused_pipeline(
+                key_map, key_idx0.copy())
+            fq, fg = ftabs["q_flat"], ftabs["g16"]
+            fdig = np.zeros((bucket, 8), dtype=np.uint32)
+            fhd = np.zeros(bucket, dtype=bool)
+            fstaged = []
+            for lo in range(0, bucket, chunk):
+                hi = lo + chunk
+                fstaged.append(tuple(put(a) for a in (
+                    blocks[lo:hi], nblocks[lo:hi], fkey[lo:hi],
+                    r8[lo:hi], rpn8[lo:hi], w8[lo:hi],
+                    premask[lo:hi], fdig[lo:hi], fhd[lo:hi])))
+            jax.block_until_ready(fstaged)
+            hh0 = prov.stats["host_hashed_lanes"]
+
+            def run_fused():
+                outs = [ffn(ch[0], ch[1], ch[2], fq, fg, *ch[3:])
+                        for ch in fstaged]
+                return np.concatenate([np.asarray(o) for o in outs])
+
+            out = run_fused()              # compile + warm pass
+            if not out[:batch].all():
+                raise SystemExit("correctness failure on fused "
+                                 "verify path")
+            times = []
+            for _ in range(TPU_ITERS):
+                t0 = time.perf_counter()
+                out = run_fused()
+                times.append(time.perf_counter() - t0)
+            fused_s = min(times)
+            fused_fields = {
+                "fused_batch": batch,
+                "fused_steady_s": round(fused_s, 4),
+                "fused_sigs_per_s": round(batch / fused_s, 1),
+                "fused_pack_s": round(fused_pack_s, 4),
+                "fused_vs_staged": (round(tpu_s / fused_s, 3)
+                                    if tpu_s else None),
+                "fused_host_hashed_lanes":
+                    prov.stats["host_hashed_lanes"] - hh0,
+            }
+            _PARTIAL.update(fused_fields)
+            emit_stage({"stage": "fused_verify",
+                        "devices": devices or local_devices,
+                        "mesh_devices": mesh_devices,
+                        "hash_mode": "device-fused",
+                        "host_prep_s": round(host_prep_s, 4),
+                        "nb": f_nb, "chunk": chunk, **fused_fields})
+
+    if "fused_skipped" in fused_fields:
+        _PARTIAL["fused_skipped"] = fused_fields["fused_skipped"]
+        emit_stage({"stage": "fused_verify",
+                    "skipped": fused_fields["fused_skipped"]})
 
     # --- ed25519 regime: the scheme router's second device kernel
     #     (round 11). Own JSON fields on the stage/final lines; an
@@ -1267,9 +1359,13 @@ def stage_core():
                      else "single device (no mesh)"),
         "pipeline_chunk": pipeline_chunk,
         "tpu_steady_s": round(tpu_s, 4) if tpu_s else None,
-        "hash_mode": ("host SHA-256 -> 32B digest lanes (default)"
+        "hash_mode": ("device-fused" if prov._fused_enabled() else
+                      "host SHA-256 -> 32B digest lanes (default)"
                       if prov._hash_on_host else
                       "fused device SHA-256"),
+        "host_prep_s": (round(host_prep_s, 4)
+                        if host_prep_s is not None else None),
+        "fused": dict(fused_fields) or None,
         "tpu_block_tx_per_s": (round(BLOCK_TXS / tpu_s, 1)
                                if tpu_s else None),
         "provider_verify_batch_s": round(provider_s, 4),
@@ -1320,9 +1416,12 @@ def stage_core():
         "deadline_s": DEADLINE_S or None,
         "deadline_hit": False,
         "on_tpu": on_tpu,
+        "host_prep_s": (round(host_prep_s, 4)
+                        if host_prep_s is not None else None),
         **trace_fields,
         **dc_fields,
         **ed_fields,
+        **fused_fields,
     }, detail)
 
 
@@ -1842,6 +1941,14 @@ def orchestrate():
         "compile_s": best.get("compile_s"),
         "compile_cache_hits": best.get("compile_cache_hits"),
         "mem_peak_bytes": best.get("mem_peak_bytes"),
+        # round-20 fused-tier A/B from the winning core stage (skip
+        # marker when the regime didn't run — CPU rig / env / budget)
+        "fused_sigs_per_s": best.get("fused_sigs_per_s"),
+        "fused_steady_s": best.get("fused_steady_s"),
+        "fused_vs_staged": best.get("fused_vs_staged"),
+        "fused_host_hashed_lanes": best.get("fused_host_hashed_lanes"),
+        "fused_skipped": best.get("fused_skipped"),
+        "host_prep_s": best.get("host_prep_s"),
         "stages_ok": ok_names or None,
         "stages_failed": bad_names or None,
         "deadline_s": DEADLINE_S or None,

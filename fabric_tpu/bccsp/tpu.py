@@ -78,6 +78,7 @@ class TPUProvider(api.BCCSP):
                  use_g16: Optional[bool] = None,
                  table_cache_bytes: int = 6 << 30,
                  hash_on_host: bool = True,
+                 fused_verify: Optional[bool] = None,
                  warm_keys_dir: Optional[str] = None,
                  bucket_floor: int = 0,
                  fallback: Optional[breaker_mod.BreakerConfig] = None,
@@ -120,6 +121,15 @@ class TPUProvider(api.BCCSP):
         # trade when the accelerator link is PCIe-fast and host cores
         # are the scarce resource.
         self._hash_on_host = hash_on_host
+        # round-20 fused device path (BCCSP.TPU.FusedVerify): hash
+        # message lanes ON DEVICE inside one Pallas program fused with
+        # the comb (ops/fused_verify.py) — host ships padded SHA
+        # blocks instead of hashing, the device returns verdict
+        # bitmaps. None = auto: on for real TPU backends (where the
+        # host SHA stage is the serialized slice of host_prep_s), off
+        # on CPU rigs (interpret-mode Pallas would be slower than the
+        # OpenSSL-class host hash). FTPU_FUSED=0/1 overrides.
+        self._fused_verify = fused_verify
         # elastic device mesh: `_mesh` is the SERVING mesh (swapped
         # for a smaller one over the survivors when a chip is
         # quarantined, grown back on probe re-admission); `_mesh_full`
@@ -224,6 +234,12 @@ class TPUProvider(api.BCCSP):
                       "q16_disk_loads": 0, "q8_disk_loads": 0,
                       "q16_loading_skips": 0,
                       "nonp256_sw_lanes": 0,
+                      # round-20 fused-kernel counters: batches served
+                      # by the fused Pallas path, message lanes hashed
+                      # on device, and demotions to the host-hash
+                      # comb-digest fallback
+                      "fused_batches": 0, "fused_lanes": 0,
+                      "fused_fallbacks": 0,
                       "ed25519_batches": 0,
                       "bls_aggregate_checks": 0,
                       "pipeline_batches": 0, "pipeline_chunks": 0,
@@ -333,6 +349,32 @@ class TPUProvider(api.BCCSP):
         if env is not None:
             return "pallas" if env == "1" else "xla"
         return "pallas" if self._on_tpu() else "xla"
+
+    def _fused_enabled(self) -> bool:
+        """Resolve the fused-verify knob (BCCSP.TPU.FusedVerify).
+
+        FTPU_FUSED=0/1 overrides for experiments and the fused CI
+        subset; explicit knob next; auto default = real TPU backend
+        only — on CPU rigs the host OpenSSL SHA + comb-digest path is
+        strictly faster than interpret-mode Pallas.
+        """
+        import os
+        env = os.environ.get("FTPU_FUSED")
+        if env is not None:
+            return env != "0"
+        if self._fused_verify is not None:
+            return self._fused_verify
+        return self._on_tpu()
+
+    def _fused_resident_enabled(self) -> bool:
+        """Gate the single-program resident fused kernel (tables
+        pinned in VMEM across grid steps). Default OFF: it is the
+        experimental tier — the tiered fused path (SHA kernel + XLA
+        gather/tree) is the serving configuration; flip on with
+        FTPU_FUSED_RESIDENT=1 when the key-set table fits the VMEM
+        budget (ops/fused_verify.resident_table_bytes)."""
+        import os
+        return os.environ.get("FTPU_FUSED_RESIDENT") == "1"
 
     # -- everything non-batch delegates (pkcs11-style containment) --
 
@@ -905,7 +947,8 @@ class TPUProvider(api.BCCSP):
         # helpers (_dispatch_arrays/_dispatch_comb_digest, and the
         # overlapped pipeline's own check) — exactly one fire per
         # logical batch, whichever path staging takes
-        if self._hash_on_host:
+        fused_ok = self._fused_enabled()
+        if self._hash_on_host and not fused_ok:
             out = self._verify_batch_pipelined(items)
             if out is not None:
                 return out
@@ -986,7 +1029,7 @@ class TPUProvider(api.BCCSP):
                 max_len = max(max_len, len(it.message))
 
         msgs += [b""] * (bucket - n)
-        if self._hash_on_host:
+        if self._hash_on_host and not fused_ok:
             # default path: host SHA-256 → 32-byte digest lanes (runs
             # for EVERY pending lane, including empty messages — an
             # empty message still hashes to SHA-256(b""), never to a
@@ -1042,10 +1085,28 @@ class TPUProvider(api.BCCSP):
                     has_digest[i] = True
                 msgs[i] = b""
             nb = 1
+            fused_ok = False    # every lane is a digest lane now
         blocks, nblocks = sha256.pack_messages(msgs, nb)
         # digest-carrying lanes skip on-device hashing: zero their block
         # count and inject the digest after the hash stage via select
         nblocks = np.where(has_digest, 0, nblocks).astype(np.int32)
+
+        if fused_ok and 0 < len(key_map) <= self._max_keys:
+            # round-20 fused tier: SHA-256 + scalar recovery + comb
+            # windows run ON DEVICE in one Pallas program — the host
+            # ships padded blocks, never hashes. A fused failure
+            # (armed tpu.fused_verify fault, missing Mosaic lowering)
+            # demotes to the host-hash comb-digest path with
+            # bit-identical verdicts, inside _try_fused
+            out = self._try_fused(
+                bucket, key_map, key_idx, blocks, nblocks, r_b, rpn_b,
+                w_b, premask, digests, has_digest, msgs, n)
+            result = out[:n].tolist()
+            self._sw_scatter(
+                sw_lanes, result,
+                lambda ls: self._sw.verify_batch(
+                    [items[i] for i in ls]))
+            return result
 
         r_l = limb.be_bytes_to_limbs(r_b)
         rpn_l = limb.be_bytes_to_limbs(rpn_b)
@@ -1105,6 +1166,46 @@ class TPUProvider(api.BCCSP):
             sw_lanes, result,
             lambda ls: self._sw.verify_batch([items[i] for i in ls]))
         return result
+
+    def _try_fused(self, bucket, key_map, key_idx, blocks, nblocks,
+                   r8, rpn8, w8, premask, digests, has_digest, msgs,
+                   n) -> np.ndarray:
+        """Serve the batch on the fused device path, demoting to the
+        host-hash comb-digest path on ANY fused failure (armed
+        tpu.fused_verify fault, unimplemented Mosaic lowering, OOM on
+        the block tensors). The demotion is bit-identical: the same
+        lanes verify against the same tables, the only difference is
+        WHERE the SHA-256 runs. DeviceLostError propagates — a dead
+        chip is device-attributed (quarantine + mesh rebuild), not a
+        fused-tier defect, and retrying it here on the digest path
+        would just fail again while masking the attribution."""
+        fused_lanes = int(np.sum(premask[:n] & ~has_digest[:n]))
+        try:
+            out = self._dispatch_fused_verify(
+                bucket, key_map, key_idx, blocks, nblocks, r8, rpn8,
+                w8, premask, digests, has_digest)
+        except DeviceLostError:
+            raise
+        except Exception:
+            self.stats["fused_fallbacks"] += 1
+            logger.exception(
+                "fused verify dispatch failed; demoting %d lanes to "
+                "the host-hash comb-digest path", n)
+            hashed = 0
+            for i in range(n):
+                if premask[i] and not has_digest[i]:
+                    digests[i] = np.frombuffer(
+                        self._sw.hash(msgs[i]), dtype=">u4")
+                    has_digest[i] = True
+                    hashed += 1
+            self.stats["host_hashed_lanes"] += hashed
+            self.stats["comb_batches"] += 1
+            return self._dispatch_comb_digest(
+                bucket, key_map, key_idx, r8, rpn8, w8, premask,
+                digests)
+        self.stats["fused_batches"] += 1
+        self.stats["fused_lanes"] += fused_lanes
+        return out
 
     # -- the Ed25519 batch path (scheme router "ed25519" lanes) --
 
@@ -2602,6 +2703,137 @@ class TPUProvider(api.BCCSP):
                 dispatch_s + _time.perf_counter() - t0, 6)
             return out
         return thunk if async_out else thunk()
+
+    @hot_path
+    @tracing.traced("tpu.fused_verify")
+    def _dispatch_fused_verify(self, bucket, key_map, key_idx, blocks,
+                               nblocks, r8, rpn8, w8, premask, digests,
+                               has_digest, async_out=False):
+        """Round-20 fused dispatch: padded SHA blocks + compact u8
+        scalars ship to the device, ONE Pallas program hashes, recovers
+        the (u1, u2) scalars and combs (ops/fused_verify.py) — only
+        verdict bitmaps come back. Same transfer-ahead double buffer
+        as the digest path: chunk k+1's H2D rides under chunk k's
+        execution. The `tpu.fused_verify` fault point arms the
+        fused-tier chaos demotion (see _try_fused); `tpu.dispatch`
+        stays the once-per-batch device seam."""
+        lockcheck.note_blocking("tpu.dispatch")
+        faults.check("tpu.fused_verify")
+        faults.check("tpu.dispatch")
+        import time as _time
+
+        import jax
+
+        key_idx, K, q_flat, g16, q16 = self._resolve_tables(key_map,
+                                                            key_idx)
+        chunk = self._mesh_chunk(bucket)
+        fn = self._fused_pipeline(K, q16)
+
+        ndev = self._mesh.size if self._mesh is not None else 1
+        tdev = [0.0] * ndev
+
+        def stage(lo):
+            hi = lo + chunk
+            arrs = (blocks[lo:hi], nblocks[lo:hi], key_idx[lo:hi],
+                    r8[lo:hi], rpn8[lo:hi], w8[lo:hi], premask[lo:hi],
+                    digests[lo:hi], has_digest[lo:hi])
+            if self._mesh is not None:
+                return self._shard_put(arrs, tdev)
+            return tuple(jax.device_put(a) for a in arrs)
+
+        outs = []
+        transfer_s = dispatch_s = 0.0
+        t_disp0 = None
+        t0 = _time.perf_counter()
+        nxt = stage(0)
+        transfer_s += _time.perf_counter() - t0
+        for lo in range(0, bucket, chunk):
+            cur, nxt = nxt, None
+            if lo + chunk < bucket:
+                t0 = _time.perf_counter()
+                nxt = stage(lo + chunk)
+                transfer_s += _time.perf_counter() - t0
+            t0 = _time.perf_counter()
+            if t_disp0 is None:
+                t_disp0 = t0
+            outs.append(fn(cur[0], cur[1], cur[2], q_flat, g16,
+                           *cur[3:]))
+            dispatch_s += _time.perf_counter() - t0
+        self.stats["prepared_transfer_s"] = round(transfer_s, 6)
+        if self._mesh is not None:
+            self.stats["shard_dispatches"] += len(outs)
+
+        def thunk():
+            t0 = _time.perf_counter()
+            if self._mesh is not None:
+                self._record_shard_stats(outs[-1], tdev, chunk,
+                                         t_disp0)
+            # ftpu-lint: allow-host-sync(the thunk IS the deliberate
+            # materialization point, invoked after dispatch returns)
+            out = np.concatenate([np.asarray(o) for o in outs])
+            self.stats["prepared_device_s"] = round(
+                dispatch_s + _time.perf_counter() - t0, 6)
+            return out
+        return thunk if async_out else thunk()
+
+    def _fused_pipeline(self, K: int, q16: bool):
+        """Build (once per (K, q16)) the jitted fused-verify program.
+        Same seams as the comb pipelines: `_jit` (compile telemetry +
+        tpu.compile fault point), shard_map per-shard programs under a
+        mesh, 8-bit two-table fallback when q16 denied. The resident
+        single-program variant (tables pinned in VMEM across grid
+        steps) is gated by FTPU_FUSED_RESIDENT and the VMEM budget."""
+        key = ("fused", K, q16)
+        with self._jit_lock:
+            if key not in self._comb_fns:
+                from fabric_tpu.ops import fused_verify as fv
+
+                use_g16 = self._g16_enabled() and q16
+                tree = self._tree_impl() if q16 else "xla"
+                resident = (self._fused_resident_enabled() and not q16
+                            and fv.resident_table_bytes(K)
+                            <= fv.RESIDENT_TABLE_BUDGET)
+
+                def fused(blocks, nblocks, key_idx, q_flat, g16, r8,
+                          rpn8, w8, premask, digests, has_digest):
+                    if resident:
+                        return fv.fused_verify_resident(
+                            blocks, nblocks, key_idx, q_flat, r8,
+                            rpn8, w8, premask, digests, has_digest)
+                    return fv.fused_verify_with_tables(
+                        blocks, nblocks, key_idx, q_flat, r8, rpn8,
+                        w8, premask, digests, has_digest,
+                        g16=g16 if use_g16 else None, q16=q16,
+                        tree=tree)
+
+                if self._mesh is not None:
+                    from jax.sharding import PartitionSpec as P
+                    s = P("batch")
+                    rep = P()
+                    self._comb_fns[key] = self._jit(
+                        "fused_verify", jaxenv.shard_map(
+                            fused, mesh=self._mesh,
+                            in_specs=(s, s, s, rep, rep, s, s, s, s,
+                                      s, s),
+                            out_specs=s))
+                else:
+                    self._comb_fns[key] = self._jit("fused_verify",
+                                                    fused)
+            return self._comb_fns[key]
+
+    def prepared_fused_pipeline(self, key_map, key_idx):
+        """Measurement surface for the fused path (bench.py), the twin
+        of prepared_digest_pipeline: canonical key order, resident
+        tables, and the provider's compiled fused program — no private
+        cache peeking. Returns (fn, key_idx, tables); invoke as
+        fn(blocks, nblocks, key_idx_chunk, q_flat, g16, r8, rpn8, w8,
+        premask, digests, has_digest)."""
+        key_idx = np.asarray(key_idx, dtype=np.int32)
+        key_idx, K, q_flat, g16, q16 = self._resolve_tables(
+            dict(key_map), key_idx)
+        fn = self._fused_pipeline(K, q16)
+        return fn, key_idx, {"q_flat": q_flat, "g16": g16,
+                             "q16": q16, "K": K}
 
     @hot_path
     @tracing.traced("tpu.comb")

@@ -86,6 +86,23 @@ def shard_map(fn, mesh, in_specs, out_specs):
                 out_specs=out_specs, check_rep=False)
 
 
+def pallas_interpret() -> bool:
+    """Whether Pallas programs should run under ``interpret=True``.
+
+    The wheel-free CI environment has no Mosaic backend, so every
+    Pallas kernel (ops/ptree.py, ops/fused_verify.py) runs interpreted
+    there — same program, traced through XLA on CPU — and compiles for
+    real only when a TPU backend is actually attached. FTPU_PALLAS_
+    INTERPRET=0/1 overrides the autodetect for A/B runs on real chips.
+    """
+    env = os.environ.get("FTPU_PALLAS_INTERPRET")
+    if env is not None:
+        return env != "0"
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
 def enable_cache_under(warm_dir: str | None) -> str | None:
     """Key the persistent compilation cache under a provider's warm
     state directory (``<warm_dir>/xla_cache``) so the ~minutes kernel

@@ -210,6 +210,11 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
         "compile_cache_hits":
             metrics_mod.BCCSP_COMPILE_CACHE_HITS_OPTS,
         "compile_seconds": metrics_mod.BCCSP_COMPILE_SECONDS_OPTS,
+        # round-20 fused tier: the serving/demotion counters operators
+        # watch to confirm the flagship fused path is the one serving
+        "fused_batches": metrics_mod.BCCSP_FUSED_BATCHES_OPTS,
+        "fused_lanes": metrics_mod.BCCSP_FUSED_LANES_OPTS,
+        "fused_fallbacks": metrics_mod.BCCSP_FUSED_FALLBACKS_OPTS,
     }
     gauges = {
         name: metrics_provider.new_gauge(canonical.get(

@@ -355,7 +355,9 @@ def tree_verify_points(pts, r_l, rpn_l, premask, *, interpret=None,
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        from fabric_tpu.common import jaxenv
+
+        interpret = jaxenv.pallas_interpret()
 
     B, M = pts.shape[0], pts.shape[1]
     bb = min(block_b, _round_up(B, 128))
@@ -412,7 +414,7 @@ def tree_verify_points(pts, r_l, rpn_l, premask, *, interpret=None,
         out_specs=pl.BlockSpec((1, ts, tr), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((g, ts, tr), jnp.int32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(consts, px, py, pz, r_t, rpn_t, pm_t)
@@ -421,6 +423,17 @@ def tree_verify_points(pts, r_l, rpn_l, premask, *, interpret=None,
 
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
+
+
+def compiler_params(**kw):
+    """Version-portable Mosaic compiler params: jax >= 0.5 renamed
+    `TPUCompilerParams` to `CompilerParams`; the 0.4.x line in the
+    wheel-free container only has the old name."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cp = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cp(**kw)
 
 
 def _collapse_tile(M: int, B: int):

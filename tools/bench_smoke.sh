@@ -250,6 +250,36 @@ if ed and "skipped" not in ed and "ed25519_skipped" not in ed:
     print("bench_smoke: ed25519 regime", ed.get("ed25519_sigs_per_s"),
           "sigs/s over", ed.get("ed25519_batch"))
 
+# round-20 contract: the core stage's fused A/B reports its own line
+# or an explicit skip marker. On CPU rigs the marker MUST be there
+# (the interpret-mode Mosaic compile is minutes — not a serving
+# configuration), so its absence means the bench silently attempted
+# a device kernel on the wrong backend. A run line must carry the
+# A/B fields and zero host-hashed lanes (the whole point of the
+# fused tier).
+fv = stages.get("fused_verify") or {}
+assert fv, f"no fused_verify stage line at all: {sorted(stages)}"
+if "skipped" in fv or "fused_skipped" in fv:
+    skip = fv.get("skipped") or fv.get("fused_skipped")
+    assert skip in ("env", "cpu", "budget"), \
+        f"fused_verify skip marker unrecognized: {fv}"
+    if not final.get("on_tpu"):
+        assert final.get("fused_skipped") == skip, \
+            f"final aggregate lost the fused skip marker: {final}"
+    print("bench_smoke: fused regime skipped:", skip)
+else:
+    assert fv.get("fused_sigs_per_s", 0) > 0, \
+        f"fused_verify stage line lacks throughput: {fv}"
+    assert fv.get("fused_steady_s", 0) > 0, fv
+    assert fv.get("fused_host_hashed_lanes") == 0, \
+        f"fused regime hashed lanes on host: {fv}"
+    assert fv.get("hash_mode") == "device-fused", fv
+    assert fv.get("host_prep_s", 0) > 0, \
+        f"fused A/B lacks the host-hash baseline cost: {fv}"
+    print("bench_smoke: fused regime", fv.get("fused_sigs_per_s"),
+          "sigs/s (vs staged x", fv.get("fused_vs_staged"),
+          "), host_prep_s", fv.get("host_prep_s"))
+
 detail = json.load(open(final["sidecar"]))
 core1 = (detail.get("stage_detail") or {}).get("core_1dev") or {}
 stats = core1.get("provider_stats") or {}

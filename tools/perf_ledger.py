@@ -66,6 +66,13 @@ METRICS: dict = {
     "tpu_steady_scaling_x": ("up", 15.0),
     "commit_pipeline_overlap_ratio": ("up", 25.0),
     "tracing_overhead_pct": ("down", 2.0, "abs"),
+    # round-20 fused Pallas tier: the fused A/B sub-stage's own
+    # device number, the host SHA-256 slice it eliminates, and the
+    # fused throughput (new metrics are absent from older rounds and
+    # simply aren't gated until a device round books them)
+    "fused_steady_s": ("down", 20.0),
+    "fused_sigs_per_s": ("up", 20.0),
+    "host_prep_s": ("down", 50.0),
 }
 
 # older rounds (pre-staged bench) spelled some metrics differently;
