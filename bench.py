@@ -1338,6 +1338,105 @@ def stage_core():
         emit_stage({"stage": "ed25519",
                     "skipped": ed_fields["ed25519_skipped"]})
 
+    # --- pairing regime (round 21): the BLS12-381 batched
+    #     Miller-product kernel behind verify_aggregate. Aggregate-
+    #     width sweep; pairing_pairs_per_s is the steady device rate
+    #     at the widest width and pairing_final_exp_share the
+    #     fraction of that pass spent in the ONE shared final
+    #     exponentiation — the cost the batch amortizes, so the share
+    #     should FALL as widths grow. CPU rigs skip with an explicit
+    #     marker (the 381-bit Miller scan compile is not a serving
+    #     configuration off-device) unless FTPU_BLS_DEVICE=1 forces
+    #     the sweep through. ---
+    pair_fields: dict = {}
+    if os.environ.get("BENCH_PAIRING", "1") != "1":
+        pair_fields["pairing_skipped"] = "env"
+    elif (not type(prov)._on_tpu()
+          and os.environ.get("FTPU_BLS_DEVICE") != "1"):
+        pair_fields["pairing_skipped"] = "cpu"
+    elif _remaining() <= 150:
+        pair_fields["pairing_skipped"] = "budget"
+    else:
+        from fabric_tpu.bccsp.bccsp import BLSKeyGenOpts
+        from fabric_tpu.bccsp.sw import bls_aggregate_signatures
+        from fabric_tpu.ops import bls12_381_kernel as blsk
+        sizes = [int(s) for s in os.environ.get(
+            "BENCH_PAIRING_SIZES",
+            "3,7" if SMOKE else "3,7,15,31").split(",")]
+        bls_keys = [prov.key_gen(BLSKeyGenOpts(ephemeral=True))
+                    for _ in range(min(4, max(sizes)))]
+        pb0 = prov.stats["pairing_batches"]
+        sweep = []
+        for nk in sizes:
+            msgs_a = [b"agg %d/%d" % (i, nk) for i in range(nk)]
+            keys_a = [bls_keys[i % len(bls_keys)] for i in range(nk)]
+            agg = bls_aggregate_signatures(
+                [prov.sign(k, m) for k, m in zip(keys_a, msgs_a)])
+            pubs = [k.public_key() for k in keys_a]
+            t0 = time.perf_counter()
+            ok = prov.verify_aggregate(pubs, msgs_a, agg)  # warm
+            warm_s = time.perf_counter() - t0
+            if ok is not True:
+                raise SystemExit("correctness failure: valid BLS "
+                                 "aggregate rejected (%d keys)" % nk)
+            if prov.verify_aggregate(
+                    pubs, msgs_a[:-1] + [b"forged"], agg) is not False:
+                raise SystemExit("correctness failure: forged BLS "
+                                 "aggregate accepted (%d keys)" % nk)
+            times = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                prov.verify_aggregate(pubs, msgs_a, agg)
+                times.append(time.perf_counter() - t0)
+            steady = min(times)
+            npairs = nk + 1          # +1: the (agg_sig, -G2) pair
+            sweep.append({"keys": nk, "pairs": npairs,
+                          "steady_s": round(steady, 4),
+                          "pairs_per_s": round(npairs / steady, 2),
+                          "warm_s": round(warm_s, 1)})
+            emit_stage({"stage": "pairing", **sweep[-1]})
+        if prov.stats["pairing_batches"] == pb0:
+            raise SystemExit("pairing regime never reached the "
+                             "device kernel: %s" % dict(prov.stats))
+        # final-exp share: ONE lane through the jitted register-
+        # machine exponentiation, vs the widest full pass
+        frng = np.random.default_rng(21)
+        ints = [[[int.from_bytes(frng.bytes(47), "big")
+                  for _ in range(2)] for _ in range(3)]
+                for _ in range(2)]
+        staged_f = tuple(tuple(
+            (jnp.asarray(blsk.F.to_mont(c[0])[None, :]),
+             jnp.asarray(blsk.F.to_mont(c[1])[None, :]))
+            for c in half) for half in ints)
+        fe = jax.jit(lambda f: blsk.gt_is_one(blsk.final_exp_batch(f)))
+        jax.block_until_ready(fe(staged_f))          # compile + warm
+        times = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fe(staged_f))
+            times.append(time.perf_counter() - t0)
+        fe_s = min(times)
+        widest = sweep[-1]
+        pair_fields = {
+            "pairing_pairs": widest["pairs"],
+            "pairing_steady_s": widest["steady_s"],
+            "pairing_pairs_per_s": widest["pairs_per_s"],
+            "pairing_final_exp_s": round(fe_s, 4),
+            "pairing_final_exp_share": round(
+                fe_s / widest["steady_s"], 3) if widest["steady_s"]
+                else None,
+            "pairing_sweep": sweep,
+        }
+        _PARTIAL.update({k: v for k, v in pair_fields.items()
+                         if k != "pairing_sweep"})
+        emit_stage({"stage": "pairing",
+                    "devices": devices or local_devices,
+                    "mesh_devices": mesh_devices, **pair_fields})
+    if "pairing_skipped" in pair_fields:
+        _PARTIAL["pairing_skipped"] = pair_fields["pairing_skipped"]
+        emit_stage({"stage": "pairing",
+                    "skipped": pair_fields["pairing_skipped"]})
+
     on_tpu = type(prov)._on_tpu()
     dc_fields = devicecost_fields()     # refreshed: all shapes built
     _PARTIAL.update(dc_fields)
@@ -1387,6 +1486,7 @@ def stage_core():
         "compile_events": list(prov.device_cost.events),
         "device_memory": _devicecost_mod().device_memory(),
         "ed25519": dict(ed_fields) or None,
+        "pairing": dict(pair_fields) or None,
         "devices": [str(d) for d in jax.devices()],
     }
     value = (round(batch / tpu_s, 1) if tpu_s
@@ -1422,6 +1522,8 @@ def stage_core():
         **dc_fields,
         **ed_fields,
         **fused_fields,
+        **{k: v for k, v in pair_fields.items()
+           if k != "pairing_sweep"},
     }, detail)
 
 
@@ -1948,6 +2050,11 @@ def orchestrate():
         "fused_vs_staged": best.get("fused_vs_staged"),
         "fused_host_hashed_lanes": best.get("fused_host_hashed_lanes"),
         "fused_skipped": best.get("fused_skipped"),
+        # round-21 pairing-engine sweep from the winning core stage
+        # (same skip-marker contract: env / cpu / budget)
+        "pairing_pairs_per_s": best.get("pairing_pairs_per_s"),
+        "pairing_final_exp_share": best.get("pairing_final_exp_share"),
+        "pairing_skipped": best.get("pairing_skipped"),
         "host_prep_s": best.get("host_prep_s"),
         "stages_ok": ok_names or None,
         "stages_failed": bad_names or None,

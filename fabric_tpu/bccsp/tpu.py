@@ -83,6 +83,7 @@ class TPUProvider(api.BCCSP):
                  bucket_floor: int = 0,
                  fallback: Optional[breaker_mod.BreakerConfig] = None,
                  ed25519: bool = True,
+                 bls_pairing: Optional[bool] = None,
                  device_health: Optional[
                      devhealth_mod.DeviceHealthConfig] = None,
                  mesh_requested=None):
@@ -242,6 +243,13 @@ class TPUProvider(api.BCCSP):
                       "fused_fallbacks": 0,
                       "ed25519_batches": 0,
                       "bls_aggregate_checks": 0,
+                      # round-21 pairing-engine counters: device
+                      # Miller-product batches (BLS aggregate + BN254
+                      # idemix), pairs they carried, and demotions to
+                      # the host pairing (breaker/error only — the
+                      # small-batch policy route is not a fallback)
+                      "pairing_batches": 0, "pairing_pairs": 0,
+                      "pairing_fallbacks": 0,
                       "pipeline_batches": 0, "pipeline_chunks": 0,
                       "pipeline_host_s": 0.0,
                       "pipeline_transfer_s": 0.0,
@@ -293,6 +301,12 @@ class TPUProvider(api.BCCSP):
         # Ed25519 lanes serve on the host reference path; verdicts are
         # identical either way)
         self._ed25519_enabled = ed25519
+        # BCCSP.TPU.BLSPairing: gate the round-21 batched BLS12-381
+        # Miller-product kernel (None = auto: real TPU backends only —
+        # on CPU rigs the host reference pairing beats interpret-mode
+        # XLA; FTPU_BLS_DEVICE=0/1 overrides). Verdicts are identical
+        # either way (ops/bls12_381_kernel vs ops/bls12_381).
+        self._bls_pairing = bls_pairing
         self._ed_tab = None         # replicated device B-comb table
         self._g16_rep = None        # mesh-replicated g16 cache
         self._persist_threads: list = []
@@ -364,6 +378,22 @@ class TPUProvider(api.BCCSP):
             return env != "0"
         if self._fused_verify is not None:
             return self._fused_verify
+        return self._on_tpu()
+
+    def _bls_pairing_enabled(self) -> bool:
+        """Resolve the BLS pairing-kernel knob (BCCSP.TPU.BLSPairing).
+
+        FTPU_BLS_DEVICE=0/1 overrides for experiments and the pairing
+        chaos/CI subsets; explicit knob next; auto default = real TPU
+        backend only — on CPU rigs the exact host pairing is strictly
+        faster than compiling the wide-limb Miller program.
+        """
+        import os
+        env = os.environ.get("FTPU_BLS_DEVICE")
+        if env is not None:
+            return env != "0"
+        if self._bls_pairing is not None:
+            return self._bls_pairing
         return self._on_tpu()
 
     def _fused_resident_enabled(self) -> bool:
@@ -1346,12 +1376,17 @@ class TPUProvider(api.BCCSP):
     # -- BLS aggregate verify (orderer cluster/consenter identities) --
 
     def verify_aggregate(self, keys, messages, signature) -> bool:
-        """BLS12-381 aggregate verify: the staged batched-Miller-loop
-        / shared-final-exponentiation path (`ops/bls12_381.py` —
-        host-serving today; ROADMAP item 4 lifts the loop on-device)
-        behind the `tpu.bls_aggregate` fault point. Any staged-path
-        failure serves the host reference on the embedded sw provider
-        — verdicts bit-identical (the degrade-don't-halt contract)."""
+        """BLS12-381 aggregate verify: structural/subgroup gates stage
+        the pairing-product pair list (`ops/bls12_381.stage_pairs`),
+        then every Miller product of the call runs as ONE fixed-shape
+        batched device program with ONE shared final exponentiation
+        (`ops/bls12_381_kernel`, the round-21 lift of ROADMAP item 4)
+        behind the `tpu.bls_aggregate` fault point, the breaker and
+        the _jit/compile-recorder seams. Small batches, a disabled
+        kernel (auto: off on CPU rigs) and device failures serve the
+        staged host path; any staged-path failure serves the host
+        reference on the embedded sw provider — verdicts bit-identical
+        on every route (the degrade-don't-halt contract)."""
         # materialize one-shot iterables up front: the staged loop
         # below consumes both, and the fault fallback needs them again
         keys = list(keys)
@@ -1376,7 +1411,9 @@ class TPUProvider(api.BCCSP):
                                          subgroup_check=False)
             except ValueError:
                 return False
-            out = blsagg.aggregate_verify(pks, msgs, sig)
+            pairs = blsagg.stage_pairs(pks, msgs, sig)
+            out = (False if pairs is None
+                   else self._bls_pairing_check(pairs))
             self.stats["bls_aggregate_checks"] += 1
             self._bump_scheme("bls", dispatches=1)
             return out
@@ -1389,6 +1426,85 @@ class TPUProvider(api.BCCSP):
             # msgs, not messages: a one-shot iterable was already
             # consumed by the staged path above
             return self._sw.verify_aggregate(keys, msgs, signature)
+
+    def _bls_pairing_check(self, pairs) -> bool:
+        """Route ONE staged aggregate-verify pair list: the batched
+        device kernel when the pair count clears the gate, the knob
+        resolves on, the mesh is healthy and the breaker admits;
+        otherwise the staged host path (`ops/bls12_381`). Verdicts
+        are bit-identical on every route."""
+        from fabric_tpu.ops import bls12_381 as blsagg
+
+        def host() -> bool:
+            return blsagg.check_products(blsagg.miller_products(pairs))
+
+        n = len(pairs)
+        if (not self._bls_pairing_enabled()
+                or n < max(2, self._min_batch // 4)):
+            return host()
+        healthy = self._maybe_probe_and_rebuild()
+        if healthy is not None and not healthy:
+            self.stats["degraded_batches"] += 1
+            self.stats["pairing_fallbacks"] += 1
+            return host()
+        try:
+            self._breaker.admit()
+        except breaker_mod.CircuitOpen:
+            self.stats["degraded_batches"] += 1
+            self.stats["pairing_fallbacks"] += 1
+            self._sync_breaker_stats()
+            return host()
+        try:
+            with self._dispatch_span():
+                out = self._breaker.guard(
+                    lambda: self._dispatch_bls_pairing(pairs))
+        except Exception as e:
+            self.stats["sw_fallbacks"] += 1
+            self.stats["pairing_fallbacks"] += 1
+            self._sync_breaker_stats()
+            struck = self._attribute_device_failure(e)
+            logger.exception(
+                "device BLS pairing failed%s; staged host path for "
+                "%d pairs",
+                (f" (device {struck} quarantined)"
+                 if struck is not None else ""), n)
+            return host()
+        self._sync_breaker_stats()
+        return out
+
+    @hot_path
+    @tracing.traced("tpu.bls_pairing")
+    def _dispatch_bls_pairing(self, pairs) -> bool:
+        """The BLS pairing device span: pad the staged pairs to a
+        power-of-two bucket (masked filler lanes contribute the Fp12
+        identity), one compiled Miller-product program per bucket
+        shape via the _jit/compile-recorder seam, ONE final
+        exponentiation per call, one scalar verdict back."""
+        import jax.numpy as jnp
+
+        from fabric_tpu.ops import bls12_381_kernel as blsk
+
+        n = len(pairs)
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        staged = blsk.stage_pairs(pairs, pad_to=bucket)
+        key = ("bls_pairing", bucket)
+        if key not in self._qtab_fns:
+            self._qtab_fns[key] = self._jit(
+                "bls_pairing",
+                lambda xP, yP, qx0, qx1, qy0, qy1, mask:
+                blsk.pairs_product_is_one(xP, yP, qx0, qx1, qy0,
+                                          qy1, mask))
+        # ftpu-lint: allow-host-sync(single scalar verdict: the
+        # call's one deliberate materialization point)
+        out = np.asarray(self._qtab_fns[key](
+            *[jnp.asarray(a) for a in staged]))
+        self.stats["pairing_batches"] += 1
+        self.stats["pairing_pairs"] += n
+        # ftpu-lint: allow-host-sync(scalar verdict of the already
+        # materialized result array — no extra device round trip)
+        return bool(out[0])
 
     # -- the overlapped dispatch pipeline (BCCSP.TPU.PipelineChunk) --
 
@@ -3195,9 +3311,15 @@ class TPUProvider(api.BCCSP):
                     bdev.pairing_product_is_one(xPs, yPs, Qs, Q1s,
                                                 nQ2s))
             out = np.asarray(self._qtab_fns[key](*staged))
+            # round-21: pairing_* gauges span both device pairing
+            # engines (BN254 idemix products here, BLS aggregates in
+            # _dispatch_bls_pairing) — pairs counts Miller pairs served
+            self.stats["pairing_batches"] += 1
+            self.stats["pairing_pairs"] += n * nterms
             return out[:n].tolist()
         except Exception:
             self.stats["sw_fallbacks"] += 1
+            self.stats["pairing_fallbacks"] += 1
             logger.exception("device pairing check failed; host fallback"
                              " for %d products", len(products))
             return self._pairing_host(products)

@@ -179,6 +179,26 @@ BCCSP_FUSED_FALLBACKS_OPTS = GaugeOpts(
          "bit-identical; a nonzero steady rate means the flagship "
          "tier is not actually serving.")
 
+BCCSP_PAIRING_PAIRS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pairing", name="pairs",
+    help="Miller pairs served by the device pairing engines since "
+         "process start — BLS12-381 aggregate-verify batches "
+         "(round-21 wide-limb kernel, one shared final exponentiation "
+         "per call) plus BN254 idemix pairing products.")
+
+BCCSP_PAIRING_BATCHES_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pairing", name="batches",
+    help="Batched pairing programs dispatched to device (one per "
+         "verify_aggregate call or idemix pairing_check_batch that "
+         "cleared the small-batch gate).")
+
+BCCSP_PAIRING_FALLBACKS_OPTS = GaugeOpts(
+    namespace="bccsp", subsystem="pairing", name="fallbacks",
+    help="Pairing dispatches demoted to the exact host path (breaker "
+         "open, unhealthy mesh, armed fault or a device error) — "
+         "verdicts stay bit-identical; small-batch POLICY routing to "
+         "the host is deliberate and not counted here.")
+
 BCCSP_SHARD_SKEW_SECONDS_OPTS = GaugeOpts(
     namespace="bccsp", subsystem="shard", name="skew_s",
     help="Ready-time spread (max - min) across mesh devices for the "

@@ -215,6 +215,12 @@ def publish_provider_stats(metrics_provider, csp, poll_s: float = 5.0):
         "fused_batches": metrics_mod.BCCSP_FUSED_BATCHES_OPTS,
         "fused_lanes": metrics_mod.BCCSP_FUSED_LANES_OPTS,
         "fused_fallbacks": metrics_mod.BCCSP_FUSED_FALLBACKS_OPTS,
+        # round-21 pairing engine: serving/demotion counters spanning
+        # both device pairing paths (BLS12-381 aggregates, BN254
+        # idemix products)
+        "pairing_pairs": metrics_mod.BCCSP_PAIRING_PAIRS_OPTS,
+        "pairing_batches": metrics_mod.BCCSP_PAIRING_BATCHES_OPTS,
+        "pairing_fallbacks": metrics_mod.BCCSP_PAIRING_FALLBACKS_OPTS,
     }
     gauges = {
         name: metrics_provider.new_gauge(canonical.get(

@@ -8,14 +8,19 @@ lifts on-device (the 2G2T MSM-outsourcing / ACE-runtime amortization
 from PAPERS.md: the loop iterations batch across pairs; the expensive
 final exp is paid once per call whatever the batch size).
 
-Today every stage runs on the host reference (`bls12_381_ref`): the
-381-bit base field does not fit the 13x20-limb 256-bit machinery
-(`ops/limb.py` / `ops/mont.py`), so widening the limb layout — and
-transcribing `miller_products` below into a vmapped kernel — is item
-4's work. The SEAMS are cut now: `stage_pairs` produces the flat
-(G1, G2) pair list a device kernel would consume, `miller_products`
-is the only function that iterates pairs, and `check_products` is the
-single final-exp site.
+As of round-21 the hot stages run ON DEVICE: `ops/limb.LimbLayout`
+parameterizes the Montgomery core by modulus width (the 381-bit field
+gets a 30-limb layout with re-proven int32 bounds) and
+`ops/bls12_381_kernel` transcribes `miller_products` /
+`check_products` into one fixed-shape batched program — a single
+lax.scan Miller loop over every staged pair, a tree product-reduce,
+ONE register-machine final exponentiation per call. The provider
+routes there through `TPUProvider._bls_pairing_check`; THIS module
+remains the staged host twin those seams demote to (small batches,
+CPU rigs, breaker-open, device faults) with bit-identical verdicts:
+`stage_pairs` produces the flat (G1, G2) pair list both consumers
+share, `miller_products` is the only host function that iterates
+pairs, and `check_products` is the single host final-exp site.
 
 The host fallback twin (`bls12_381_ref.aggregate_verify`) computes
 the same predicate through its own code path — the chaos contract

@@ -360,6 +360,58 @@ def _frob2_gammas() -> tuple:
     return tuple(out)
 
 
+def f2_conj(a):
+    return (a[0], (-a[1]) % P)
+
+
+@lru_cache(maxsize=None)
+def _frob_gammas() -> tuple:
+    """gamma_k = xi^(k*(p-1)/6) in Fp2: the p-power Frobenius sends
+    the coefficient c of w^k to conj(c) * gamma_k."""
+    return tuple(pow_xi(k * (P - 1) // 6) for k in range(6))
+
+
+def f12_frob(a):
+    """x -> x^p on Fp12 (coefficient-wise Fp2 conjugation times the
+    gamma constants; w-exponents 0,2,4 / 1,3,5 across the halves)."""
+    g = _frob_gammas()
+    (c0, c1, c2), (c3, c4, c5) = a
+    return ((f2_conj(c0),
+             f2_mul(f2_conj(c1), g[2]),
+             f2_mul(f2_conj(c2), g[4])),
+            (f2_mul(f2_conj(c3), g[1]),
+             f2_mul(f2_conj(c4), g[3]),
+             f2_mul(f2_conj(c5), g[5])))
+
+
+def final_exponentiation_chain(f) -> tuple:
+    """The DEVICE-SHAPED final exponentiation: easy part, then the
+    Hayashida-Hayasaka-Teruya addition chain for the BLS12 family,
+
+        3*(p^4 - p^2 + 1)/r = (x-1)^2 * (x+p) * (x^2 + p^2 - 1) + 3
+
+    with x = -|x| (so pow-by-|x| plus cyclotomic conjugations — every
+    step is a static square-and-multiply, a Frobenius or a conjugate,
+    exactly the op set of the tower's register machine). Returns
+    final_exponentiation_fast(f)**3; since Phi_12(p) = p^4 - p^2 + 1
+    is ~1 mod 3, gcd(3, r) = 1 and the cube is 1 iff the fast result
+    is 1 — equivalent for every product-equals-one check. Pinned
+    against the single-pow oracle in tests; the device final-exp
+    program mirrors this chain instruction for instruction."""
+    m = f12_mul(f12_conj(f), f12_inv(f))          # f^(p^6-1)
+    m = f12_mul(_frob2(m), m)                     # ^(p^2+1)
+    u = X_BLS
+    t0 = f12_mul(f12_pow(m, u), m)                # m^(u+1) = m^-(x-1)
+    y1 = f12_mul(f12_pow(t0, u), t0)              # m^((x-1)^2)
+    y2 = f12_mul(f12_conj(f12_pow(y1, u)),
+                 f12_frob(y1))                    # y1^(x+p)
+    y3 = f12_mul(f12_mul(f12_pow(f12_pow(y2, u), u),
+                         _frob2(y2)),
+                 f12_conj(y2))                    # y2^(x^2+p^2-1)
+    m3 = f12_mul(f12_mul(m, m), m)
+    return f12_mul(y3, m3)
+
+
 def pow_xi(e: int) -> tuple:
     out = F2_ONE
     base = XI

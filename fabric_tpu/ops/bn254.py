@@ -24,9 +24,15 @@ TPU-first shape:
     arithmetic (fabric_tpu/ops/bn254_ref.g2_frobenius) and the device
     runs two more add+line steps.
 
-The final exponentiation stays on the host for now (one f12_pow per
-batch element over the int reference) — the Miller loop is ~99% of the
-per-credential field work once the exponent bits are fixed.
+The Fp2/Fp6/Fp12 tower arithmetic, the complete twist steps and the
+register-machine final-exponentiation runner are the generic
+`fabric_tpu.ops.tower.Tower` parameterized with BN254's constants
+(D-type twist over xi = 9+u on the default 20-limb layout); this
+module keeps the BN-specific pieces — the 6t+2 Miller loop with its
+optimal-ate Frobenius correction adds, the parameter-t final-exp
+PROGRAM, the G2 MSM scan and the host staging helpers. The final
+exponentiation runs fully on device, amortized: pairing products
+multiply their Miller values and pay `final_exp_batch` once.
 
 Differential oracle: fabric_tpu/ops/bn254_ref.miller_loop at matching
 loop counts (tests run truncated loops on CPU; the full 6t+2 loop is
@@ -42,6 +48,7 @@ from jax import lax
 
 from fabric_tpu.ops import bn254_ref as ref
 from fabric_tpu.ops import limb
+from fabric_tpu.ops import tower
 from fabric_tpu.ops.limb import L
 from fabric_tpu.ops.mont import MontMod
 
@@ -54,104 +61,68 @@ _B_TW = ref.f2_mul((3, 0), _XI_INV)
 _B3_TW = ref.f2_mul((3, 0), ref.f2_mul((3, 0), _XI_INV))
 
 
-def _const_fp2(c):
-    """Exact Fp2 int pair -> broadcastable Montgomery limb constants."""
-    return (jnp.asarray(F.to_mont(c[0])), jnp.asarray(F.to_mont(c[1])))
-
-
-# ---------------------------------------------------------------------------
-# Tower arithmetic over Montgomery limb tensors
-# Fp2 = (a0, a1); Fp6 = (c0, c1, c2) of Fp2; Fp12 = (d0, d1) of Fp6
-# ---------------------------------------------------------------------------
-
-def f2_add(a, b):
-    return (F.add(a[0], b[0]), F.add(a[1], b[1]))
-
-
-def f2_sub(a, b):
-    return (F.sub(a[0], b[0]), F.sub(a[1], b[1]))
-
-
-def f2_mul(a, b):
-    """Karatsuba: 3 base multiplications."""
-    m0 = F.mul(a[0], b[0])
-    m1 = F.mul(a[1], b[1])
-    m2 = F.mul(F.add(a[0], a[1]), F.add(b[0], b[1]))
-    return (F.sub(m0, m1), F.sub(F.sub(m2, m0), m1))
-
-
-def f2_sqr(a):
-    return f2_mul(a, a)
-
-
-def f2_scale(a, s):
-    """Fp2 times an Fp element."""
-    return (F.mul(a[0], s), F.mul(a[1], s))
-
-
-def f2_neg(a):
-    return (F.neg(a[0]), F.neg(a[1]))
-
-
-def f2_mul_xi(a):
-    """Multiply by xi = 9 + u: (9a0 - a1, a0 + 9a1)."""
-    def x9(x):
-        x2 = F.add(x, x)
-        x4 = F.add(x2, x2)
-        x8 = F.add(x4, x4)
-        return F.add(x8, x)
-    return (F.sub(x9(a[0]), a[1]), F.add(a[0], x9(a[1])))
-
-
-def f2_small(a, k: int):
-    """Multiply by a small positive int via a binary add chain."""
-    acc = None
+def _f2_pow_int(a, e: int):
+    """Host: exact Fp2 pow (for Frobenius constants)."""
+    out = (1, 0)
     base = a
-    while k:
-        if k & 1:
-            acc = base if acc is None else f2_add(acc, base)
-        k >>= 1
-        if k:
-            base = f2_add(base, base)
-    return acc
+    while e:
+        if e & 1:
+            out = ref.f2_mul(out, base)
+        base = ref.f2_mul(base, base)
+        e >>= 1
+    return out
 
 
-def f6_add(a, b):
-    return tuple(f2_add(x, y) for x, y in zip(a, b))
+# gamma = xi^((p-1)/6); (v^j w^i)^p = conj-coeffs * gamma^(2j+i)
+_GAMMA = [_f2_pow_int(ref.XI, k * (ref.P - 1) // 6) for k in range(6)]
 
 
-def f6_sub(a, b):
-    return tuple(f2_sub(x, y) for x, y in zip(a, b))
+# ---------------------------------------------------------------------------
+# Tower instance — BN254's D-type twist over xi = 9 + u. Every bound
+# method below is bit-identical to the arithmetic that used to live
+# inline here (proven by the kernel-parity suites).
+# ---------------------------------------------------------------------------
 
+_T = tower.Tower(F, xi=ref.XI, b3_tw=_B3_TW, gammas=_GAMMA,
+                 mtwist=False)
 
-def f6_mul(a, b):
-    c0, c1, c2 = a
-    d0, d1, d2 = b
-    t0, t1, t2 = f2_mul(c0, d0), f2_mul(c1, d1), f2_mul(c2, d2)
-    r0 = f2_add(t0, f2_mul_xi(f2_add(f2_mul(c1, d2), f2_mul(c2, d1))))
-    r1 = f2_add(f2_add(f2_mul(c0, d1), f2_mul(c1, d0)), f2_mul_xi(t2))
-    r2 = f2_add(f2_add(f2_mul(c0, d2), f2_mul(c2, d0)), t1)
-    return (r0, r1, r2)
-
-
-def f6_mul_v(a):
-    """Multiply an Fp6 element by v (v^3 = xi)."""
-    return (f2_mul_xi(a[2]), a[0], a[1])
-
-
-def f12_mul(a, b):
-    a0, a1 = a
-    b0, b1 = b
-    t0 = f6_mul(a0, b0)
-    t1 = f6_mul(a1, b1)
-    r0 = f6_add(t0, f6_mul_v(t1))
-    r1 = f6_sub(f6_mul(f6_add(a0, a1), f6_add(b0, b1)),
-                f6_add(t0, t1))
-    return (r0, r1)
-
-
-def f12_sqr(a):
-    return f12_mul(a, a)
+_const_fp2 = _T.const_fp2
+f2_add = _T.f2_add
+f2_sub = _T.f2_sub
+f2_mul = _T.f2_mul
+f2_sqr = _T.f2_sqr
+f2_scale = _T.f2_scale
+f2_neg = _T.f2_neg
+f2_conj = _T.f2_conj
+f2_mul_xi = _T.f2_mul_xi
+f2_small = _T.f2_small
+f6_add = _T.f6_add
+f6_sub = _T.f6_sub
+f6_mul = _T.f6_mul
+f6_mul_v = _T.f6_mul_v
+f12_mul = _T.f12_mul
+f12_sqr = _T.f12_sqr
+f12_conj = _T.f12_conj
+f12_frob = _T.f12_frob
+f12_one_like = _T.f12_one_like
+line_to_f12 = _T.line_to_f12
+g2_dbl_line = _T.g2_dbl_line
+g2_add_line = _T.g2_add_line
+g2_dbl = _T.g2_dbl
+g2_add_mixed = _T.g2_add_mixed
+fp_inv = _T.fp_inv
+f2_inv = _T.f2_inv
+f6_inv = _T.f6_inv
+f12_inv = _T.f12_inv
+gt_is_one = _T.gt_is_one
+_f12_select = _T.f12_select
+_select_pt = tower.select_pt
+_select_f12 = tower.select_f12
+_flat_from_f12 = tower.flat_from_f12
+_f12_from_flat = tower.f12_from_flat
+_pow_scan = tower.pow_scan
+_OP_MUL, _OP_CONJ, _OP_FROB = tower.OP_MUL, tower.OP_CONJ, tower.OP_FROB
+_NREG = tower.NREG
 
 
 def _f2_zero_like(x):
@@ -159,131 +130,9 @@ def _f2_zero_like(x):
     return (z, z)
 
 
-def f12_one_like(x):
-    """Fp12 one, broadcast to the batch shape of Fp element x."""
-    one = jnp.broadcast_to(jnp.asarray(F.to_mont(1)), x.shape)
-    z = jnp.zeros_like(x)
-    return (((one, z), (z, z), (z, z)), ((z, z), (z, z), (z, z)))
-
-
-def line_to_f12(A, B, C):
-    """Sparse line A + B*w + C*w^3 as a full Fp12 element
-    (w^3 = v*w -> coefficient c1 of the second Fp6 component)."""
-    z = _f2_zero_like(A)
-    return ((A, z, z), (B, C, z))
-
-
-# ---------------------------------------------------------------------------
-# Twist-curve steps with line evaluation
-# ---------------------------------------------------------------------------
-
-def g2_dbl_line(T, xP, yP):
-    """Complete a=0 doubling (RCB15 Alg 9 with b3 on the twist) plus
-    the tangent line at T evaluated at P = (xP, yP) in G1.
-
-    T: ((X0,X1),(Y0,Y1),(Z0,Z1)) Fp2 limb tensors. Line coefficients
-    (see module docstring): scaled by Z^3,
-      A = 2*Y*Z^2 * yP,  B = -3*X^2*Z * xP,  C = 3*X^3 - 2*Y^2*Z.
-    """
-    X, Y, Z = T
-    b3 = tuple(jnp.broadcast_to(c, X[0].shape)
-               for c in _const_fp2(_B3_TW))
-    # line first (uses the pre-doubling T)
-    Z2 = f2_sqr(Z)
-    X2 = f2_sqr(X)
-    YZ = f2_mul(Y, Z)
-    A = f2_scale(f2_small(f2_mul(Y, Z2), 2), yP)
-    B = f2_scale(f2_neg(f2_small(f2_mul(X2, Z), 3)), xP)
-    C = f2_sub(f2_small(f2_mul(X2, X), 3), f2_small(f2_mul(Y, YZ), 2))
-    # RCB15 Alg 9 doubling
-    t0 = f2_sqr(Y)
-    Z3 = f2_small(t0, 8)
-    t1 = YZ
-    t2 = f2_sqr(Z)
-    t2 = f2_mul(b3, t2)
-    X3 = f2_mul(t2, Z3)
-    Y3 = f2_add(t0, t2)
-    Z3 = f2_mul(t1, Z3)
-    t1 = f2_small(t2, 2)
-    t2 = f2_add(t1, t2)
-    t0 = f2_sub(t0, t2)
-    Y3 = f2_mul(t0, Y3)
-    Y3 = f2_add(X3, Y3)
-    t1 = f2_mul(X, Y)
-    X3 = f2_mul(t0, t1)
-    X3 = f2_small(X3, 2)
-    return (X3, Y3, Z3), line_to_f12(A, B, C)
-
-
-def g2_add_line(T, Q, xP, yP):
-    """Complete a=0 mixed addition T + Q (RCB15 Alg 7 with Z2=1) plus
-    the chord line through T, Q evaluated at P.
-
-    Chord coefficients scaled by Z:
-      A = (X - xQ*Z) * yP,  B = -(Y - yQ*Z) * xP,
-      C = (Y - yQ*Z)*xQ - (X - xQ*Z)*yQ.
-    """
-    X1, Y1, Z1 = T
-    xQ, yQ = Q
-    b3 = tuple(jnp.broadcast_to(c, X1[0].shape)
-               for c in _const_fp2(_B3_TW))
-    # line
-    dX = f2_sub(X1, f2_mul(xQ, Z1))
-    dY = f2_sub(Y1, f2_mul(yQ, Z1))
-    A = f2_scale(dX, yP)
-    B = f2_scale(f2_neg(dY), xP)
-    C = f2_sub(f2_mul(dY, xQ), f2_mul(dX, yQ))
-    # RCB15 Alg 7, complete addition for a=0 (general Z2; the twist
-    # point Q is affine so Z2 = mont(1))
-    one = jnp.broadcast_to(jnp.asarray(F.to_mont(1)), X1[0].shape)
-    zero = jnp.zeros_like(one)
-    X2, Y2, Z2 = xQ, yQ, (one, zero)
-    t0 = f2_mul(X1, X2)
-    t1 = f2_mul(Y1, Y2)
-    t2 = f2_mul(Z1, Z2)
-    t3 = f2_mul(f2_add(X1, Y1), f2_add(X2, Y2))
-    t3 = f2_sub(t3, f2_add(t0, t1))
-    t4 = f2_mul(f2_add(Y1, Z1), f2_add(Y2, Z2))
-    t4 = f2_sub(t4, f2_add(t1, t2))
-    X3 = f2_mul(f2_add(X1, Z1), f2_add(X2, Z2))
-    Y3 = f2_sub(X3, f2_add(t0, t2))      # Y3 = X1*Z2 + X2*Z1
-    t0 = f2_small(t0, 3)                 # 3*X1*X2
-    t2 = f2_mul(b3, t2)
-    Z3 = f2_add(t1, t2)
-    t1 = f2_sub(t1, t2)
-    Y3 = f2_mul(b3, Y3)
-    X3 = f2_mul(t4, Y3)
-    X3 = f2_sub(f2_mul(t3, t1), X3)
-    Y3 = f2_mul(Y3, t0)
-    Y3 = f2_add(f2_mul(t1, Z3), Y3)
-    Z3 = f2_mul(Z3, t4)
-    Z3 = f2_add(Z3, f2_mul(t0, t3))
-    return (X3, Y3, Z3), line_to_f12(A, B, C)
-
-
 # ---------------------------------------------------------------------------
 # Batched Miller loop
 # ---------------------------------------------------------------------------
-
-def _select_pt(mask, a, b):
-    """Lane select between two Fp2 point triples; mask: (B,) bool."""
-    m = mask[:, None]
-    return tuple(
-        (jnp.where(m, x[0], y[0]), jnp.where(m, x[1], y[1]))
-        for x, y in zip(a, b))
-
-
-def _select_f12(mask, a, b):
-    m = mask[:, None]
-
-    def sel(x, y):
-        return jnp.where(m, x, y)
-
-    return tuple(
-        tuple((sel(x[0], y[0]), sel(x[1], y[1]))
-              for x, y in zip(c6a, c6b))
-        for c6a, c6b in zip(a, b))
-
 
 def miller_loop_batch(xP, yP, Q, Q1, nQ2, loop: int = ref.ATE_LOOP):
     """f_{loop,Q}(P) for a batch, with optimal-ate corrections.
@@ -326,171 +175,16 @@ def miller_loop_batch(xP, yP, Q, Q1, nQ2, loop: int = ref.ATE_LOOP):
 # Final exponentiation (device)
 # ---------------------------------------------------------------------------
 
-def _f2_pow_int(a, e: int):
-    """Host: exact Fp2 pow (for Frobenius constants)."""
-    out = (1, 0)
-    base = a
-    while e:
-        if e & 1:
-            out = ref.f2_mul(out, base)
-        base = ref.f2_mul(base, base)
-        e >>= 1
-    return out
-
-
-# gamma = xi^((p-1)/6); (v^j w^i)^p = conj-coeffs * gamma^(2j+i)
-_GAMMA = [_f2_pow_int(ref.XI, k * (ref.P - 1) // 6) for k in range(6)]
-
-
-def f2_conj(a):
-    return (a[0], F.neg(a[1]))
-
-
-def f12_conj(f):
-    """x -> x^(p^6): negate the w half. Inverse inside the cyclotomic
-    subgroup (post easy part)."""
-    d0, d1 = f
-    return (d0, tuple(f2_neg(c) for c in d1))
-
-
-def f12_frob(f):
-    """x -> x^p: coefficient-wise Fp2 conjugation times the gamma
-    constants (host-exact, differentially pinned vs ref.f12_frob)."""
-    d0, d1 = f
-
-    def g(k, c):
-        const = tuple(jnp.broadcast_to(v, c[0].shape)
-                      for v in _const_fp2(_GAMMA[k]))
-        return f2_mul(f2_conj(c), const)
-
-    return ((f2_conj(d0[0]), g(2, d0[1]), g(4, d0[2])),
-            (g(1, d1[0]), g(3, d1[1]), g(5, d1[2])))
-
-
-def _pow_scan(x, e: int, mul, sqr, select):
-    """Square-and-multiply by a STATIC positive exponent as a lax.scan
-    (keeps the HLO one-body-sized for multi-thousand-bit chains)."""
-    bits = [int(b) for b in bin(e)[3:]]          # skip the leading 1
-    if not bits:
-        return x
-    bit_arr = jnp.asarray(np.array(bits, dtype=bool))
-
-    def body(acc, bit):
-        acc = sqr(acc)
-        acc = select(bit, mul(acc, x), acc)
-        return acc, None
-
-    out, _ = lax.scan(body, x, bit_arr)
-    return out
-
-
-def fp_inv(x):
-    """Montgomery Fermat inverse: x^(p-2) via a 254-bit scan."""
-    def select(bit, a, b):
-        return jnp.where(bit, a, b)
-
-    return _pow_scan(x, ref.P - 2, F.mul, lambda a: F.mul(a, a), select)
-
-
-def f2_inv(a):
-    d = fp_inv(F.add(F.mul(a[0], a[0]), F.mul(a[1], a[1])))
-    return (F.mul(a[0], d), F.mul(F.neg(a[1]), d))
-
-
-def f6_inv(a):
-    """Adjoint/norm method (mirrors ref.f6_inv)."""
-    c0, c1, c2 = a
-    t0 = f2_sub(f2_sqr(c0), f2_mul_xi(f2_mul(c1, c2)))
-    t1 = f2_sub(f2_mul_xi(f2_sqr(c2)), f2_mul(c0, c1))
-    t2 = f2_sub(f2_sqr(c1), f2_mul(c0, c2))
-    norm = f2_add(f2_mul(c0, t0),
-                  f2_mul_xi(f2_add(f2_mul(c2, t1), f2_mul(c1, t2))))
-    ninv = f2_inv(norm)
-    return (f2_mul(t0, ninv), f2_mul(t1, ninv), f2_mul(t2, ninv))
-
-
-def f12_inv(a):
-    a0, a1 = a
-    t1 = f6_mul(a1, a1)
-    norm = f6_sub(f6_mul(a0, a0), f6_mul_v(t1))
-    ninv = f6_inv(norm)
-    return (f6_mul(a0, ninv),
-            tuple(f2_neg(c) for c in f6_mul(a1, ninv)))
-
-
-def _f12_select(bit, a, b):
-    mask = jnp.broadcast_to(bit, a[0][0][0].shape[:1])
-    return _select_f12(mask, a, b)
-
-
 def f12_pow_t(m):
     """m^t for the BN parameter t (63-bit static scan)."""
     return _pow_scan(m, ref.T_BN, f12_mul, f12_sqr, _f12_select)
 
 
-# -- the final-exp REGISTER MACHINE --
-#
-# A monolithic unrolled chain (3 pow-by-t + ~25 Fp12 muls, each 54
-# Montgomery muls) produces an HLO the compilers refuse: the tunnel's
-# remote TPU compiler SIGKILLs and the CPU jit OOMs. Instead the whole
-# post-inversion exponentiation runs as ONE lax.scan whose body is a
-# tiny f12-op interpreter (MUL/CONJ/FROB over a register file), driven
-# by a static ~310-instruction program assembled from the SAME chain
-# that ref.final_exponentiation_chain pins against the single-pow
-# oracle. HLO cost: one multiply body, regardless of chain length.
-
-_OP_MUL, _OP_CONJ, _OP_FROB = 0, 1, 2
-_NREG = 8
-
-
-def _flat_from_f12(f):
-    """Nested-tuple f12 -> (12, ...) stacked coeff tensor."""
-    coeffs = [c for half in f for fp2 in half for c in fp2]
-    return jnp.stack(coeffs, axis=0)
-
-
-def _f12_from_flat(x):
-    return tuple(
-        tuple((x[h * 6 + j * 2], x[h * 6 + j * 2 + 1])
-              for j in range(3))
-        for h in range(2))
-
-
-class _Asm:
-    """Assembles the final-exp chain into (op, dst, a, b) rows."""
-
-    def __init__(self):
-        self.rows = []
-
-    def emit(self, op, dst, a, b=0):
-        self.rows.append((op, dst, a, b))
-
-    def mul(self, dst, a, b):
-        self.emit(_OP_MUL, dst, a, b)
-
-    def sqr(self, dst, a):
-        self.emit(_OP_MUL, dst, a, a)
-
-    def conj(self, dst, a):
-        self.emit(_OP_CONJ, dst, a)
-
-    def frob(self, dst, a):
-        self.emit(_OP_FROB, dst, a)
-
-    def copy(self, dst, a):
-        self.conj(dst, a)            # conj . conj = identity
-        self.conj(dst, dst)
+class _Asm(tower.Asm):
+    """BN-flavored assembler: pow_t is pow by the static parameter t."""
 
     def pow_t(self, dst, src, tmp):
-        """dst = src^t: square-and-multiply over t's static bits
-        (src, tmp, dst must be distinct registers)."""
-        assert len({dst, src, tmp}) == 3
-        self.copy(tmp, src)          # acc <- src (leading bit)
-        for b in bin(ref.T_BN)[3:]:
-            self.sqr(tmp, tmp)
-            if b == "1":
-                self.mul(tmp, tmp, src)
-        self.copy(dst, tmp)
+        self.pow_static(dst, src, tmp, ref.T_BN)
 
 
 def _final_exp_program() -> np.ndarray:
@@ -551,46 +245,16 @@ def _final_exp_program() -> np.ndarray:
     return np.asarray(A.rows, dtype=np.int32)
 
 
+_FINAL_EXP_PROGRAM = _final_exp_program()
+
+
 def final_exp_batch(f):
     """The full final exponentiation on device: easy part
     (p^6-1)(p^2+1) then the BN hard part via the parameter-t addition
     chain (mirrors ref.final_exponentiation_chain, which is pinned
-    against the single-pow oracle). Runs as a register-machine scan —
-    see the note above the assembler."""
-    inv = f12_inv(f)
-    regs0 = jnp.stack([_flat_from_f12(f), _flat_from_f12(inv)] +
-                      [jnp.zeros_like(_flat_from_f12(f))] * (_NREG - 2),
-                      axis=0)                    # (NREG, 12, ...)
-    program = jnp.asarray(_final_exp_program())
-
-    def body(regs, instr):
-        op, dst, a, b = instr[0], instr[1], instr[2], instr[3]
-        A = _f12_from_flat(jnp.take(regs, a, axis=0))
-        Bv = _f12_from_flat(jnp.take(regs, b, axis=0))
-        res = lax.switch(op, [
-            lambda: _flat_from_f12(f12_mul(A, Bv)),
-            lambda: _flat_from_f12(f12_conj(A)),
-            lambda: _flat_from_f12(f12_frob(A)),
-        ])
-        regs = lax.dynamic_update_index_in_dim(regs, res, dst, axis=0)
-        return regs, None
-
-    regs, _ = lax.scan(body, regs0, program)
-    return _f12_from_flat(regs[0])
-
-
-def gt_is_one(f):
-    """(B,) bool: is the GT element the identity? Canonical-compare
-    every coefficient (mont(1) for c000, zero elsewhere)."""
-    one = jnp.asarray(F.to_mont(1))
-    coeffs = [c for d in f for fp2 in d for c in fp2]
-    first = coeffs[0]
-    ok = jnp.all(F.canonical(first) ==
-                 F.canonical(jnp.broadcast_to(one, first.shape)),
-                 axis=-1)
-    for c in coeffs[1:]:
-        ok = ok & jnp.all(F.canonical(c) == 0, axis=-1)
-    return ok
+    against the single-pow oracle). Runs as the tower's
+    register-machine scan — see fabric_tpu.ops.tower."""
+    return _T.run_final_exp(f, _FINAL_EXP_PROGRAM)
 
 
 def pairing_product_is_one(xPs, yPs, Qs, Q1s, nQ2s,
@@ -663,61 +327,6 @@ def jax_tree(t):
 # credential's proof serially on CPU (vendored IBM/idemix).
 
 NBITS_R = 254                       # ref.R.bit_length()
-
-
-def g2_dbl(T):
-    """RCB15 Alg 9 complete doubling on the twist (no line)."""
-    X, Y, Z = T
-    b3 = tuple(jnp.broadcast_to(c, X[0].shape)
-               for c in _const_fp2(_B3_TW))
-    t0 = f2_sqr(Y)
-    Z3 = f2_small(t0, 8)
-    t1 = f2_mul(Y, Z)
-    t2 = f2_mul(b3, f2_sqr(Z))
-    X3 = f2_mul(t2, Z3)
-    Y3 = f2_add(t0, t2)
-    Z3 = f2_mul(t1, Z3)
-    t1 = f2_small(t2, 2)
-    t2 = f2_add(t1, t2)
-    t0 = f2_sub(t0, t2)
-    Y3 = f2_mul(t0, Y3)
-    Y3 = f2_add(X3, Y3)
-    t1 = f2_mul(X, Y)
-    X3 = f2_mul(t0, t1)
-    X3 = f2_small(X3, 2)
-    return X3, Y3, Z3
-
-
-def g2_add_mixed(T, Q):
-    """RCB15 Alg 7 complete mixed addition T + (affine Q), no line."""
-    X1, Y1, Z1 = T
-    xQ, yQ = Q
-    b3 = tuple(jnp.broadcast_to(c, X1[0].shape)
-               for c in _const_fp2(_B3_TW))
-    one = jnp.broadcast_to(jnp.asarray(F.to_mont(1)), X1[0].shape)
-    zero = jnp.zeros_like(one)
-    X2, Y2, Z2 = xQ, yQ, (one, zero)
-    t0 = f2_mul(X1, X2)
-    t1 = f2_mul(Y1, Y2)
-    t2 = f2_mul(Z1, Z2)
-    t3 = f2_mul(f2_add(X1, Y1), f2_add(X2, Y2))
-    t3 = f2_sub(t3, f2_add(t0, t1))
-    t4 = f2_mul(f2_add(Y1, Z1), f2_add(Y2, Z2))
-    t4 = f2_sub(t4, f2_add(t1, t2))
-    X3 = f2_mul(f2_add(X1, Z1), f2_add(X2, Z2))
-    Y3 = f2_sub(X3, f2_add(t0, t2))
-    t0 = f2_small(t0, 3)
-    t2 = f2_mul(b3, t2)
-    Z3 = f2_add(t1, t2)
-    t1 = f2_sub(t1, t2)
-    Y3 = f2_mul(b3, Y3)
-    X3 = f2_mul(t4, Y3)
-    X3 = f2_sub(f2_mul(t3, t1), X3)
-    Y3 = f2_mul(Y3, t0)
-    Y3 = f2_add(f2_mul(t1, Z3), Y3)
-    Z3 = f2_mul(Z3, t4)
-    Z3 = f2_add(Z3, f2_mul(t0, t3))
-    return X3, Y3, Z3
 
 
 def g2_msm_scan(bit_cols, *Q_flat):

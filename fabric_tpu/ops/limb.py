@@ -39,6 +39,88 @@ PROD = 2 * L                # limbs in a schoolbook product
 
 
 # ---------------------------------------------------------------------------
+# Limb layout (parameterized limb count; 13-bit limbs stay)
+# ---------------------------------------------------------------------------
+
+class LimbLayout:
+    """Limb geometry for one modulus width.
+
+    The module constants above describe the historical 20-limb/256-bit
+    layout every existing kernel (P-256, Ed25519, BN254) was built on;
+    this object is the same geometry with the limb COUNT a parameter so
+    wider primes (BLS12-381's 381-bit field needs 30 limbs) ride the
+    identical carry/multiply machinery. 13-bit limbs are load-bearing
+    and stay fixed: every int32 bound below is a function of W and L.
+
+    int32 safety, re-derived per layout (ValueError, not assert — a
+    silently overflowing column would corrupt field arithmetic):
+      * a schoolbook product column accumulates at most L terms of
+        (2^W)^2 (redundant limbs reach 2^W inclusive), so the column
+        bound is L * 2^(2W);
+      * Montgomery REDC adds up to L more terms of u_i * m_limb
+        (< 2^(2W) each) into a column that already holds a carried
+        (<= 2^W) limb, plus a propagated carry < 2^(31-W);
+    both are covered by requiring
+        L * 2^(2W) + 2^(31-W) + 2^W  <  2^31
+    which admits L <= 31 at W = 13 (L = 32 overflows exactly).
+    """
+
+    def __init__(self, nlimbs: int, w: int = W):
+        if nlimbs < 1:
+            raise ValueError("LimbLayout needs at least one limb")
+        worst = nlimbs * (1 << (2 * w)) + (1 << (31 - w)) + (1 << w)
+        if worst >= 1 << 31:
+            raise ValueError(
+                f"limb layout L={nlimbs} W={w} overflows int32 column "
+                f"accumulation ({worst} >= 2^31); the schoolbook/REDC "
+                f"bound admits at most L={(((1 << 31) - (1 << (31 - w)) - (1 << w)) >> (2 * w))} limbs at W={w}")
+        self.W = w
+        self.MASK = (1 << w) - 1
+        self.L = nlimbs
+        self.PROD = 2 * nlimbs
+
+    @property
+    def bits(self) -> int:
+        """Total representable bits (W * L)."""
+        return self.W * self.L
+
+    def max_modulus_bits(self) -> int:
+        """Largest modulus width this layout's Montgomery R covers:
+        REDC needs 4m < R = 2^(W*L), i.e. bit_length(m) <= W*L - 2."""
+        return self.W * self.L - 2
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"LimbLayout(L={self.L}, W={self.W})"
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, LimbLayout)
+                and (self.L, self.W) == (other.L, other.W))
+
+    def __hash__(self) -> int:
+        return hash((self.L, self.W))
+
+
+# the historical layout, as THE default instance: every <=256-bit
+# kernel stages through this exact geometry, so existing paths are
+# bit-identical by construction
+DEFAULT_LAYOUT = LimbLayout(L)
+
+
+def layout_for_bits(bits: int) -> LimbLayout:
+    """Smallest layout whose Montgomery R covers a `bits`-wide odd
+    modulus (4m < 2^(W*L) => W*L >= bits + 2). Yields exactly the
+    historical 20-limb layout for every 251..258-bit modulus and 30
+    limbs for BLS12-381's 381-bit field; widths past ~401 bits fail
+    loudly in LimbLayout's int32 column bound."""
+    if bits < 1:
+        raise ValueError("modulus width must be positive")
+    n = -(-(bits + 2) // W)          # ceil((bits + 2) / W)
+    if n <= DEFAULT_LAYOUT.L:
+        return DEFAULT_LAYOUT
+    return LimbLayout(n)
+
+
+# ---------------------------------------------------------------------------
 # Host-side converters (numpy; used to stage inputs/constants)
 # ---------------------------------------------------------------------------
 

@@ -3,17 +3,21 @@
 fabric_tpu.ops.limb.Mod exploits the P-256 prime's sparse form (cheap
 fold at 2^256); BN254 — the idemix pairing curve — has a dense 254-bit
 prime where that fold diverges. This module provides modulus-generic
-arithmetic via word-level Montgomery reduction (REDC) with R = 2^260,
-reusing the limb layout (L=20 limbs of W=13 bits, int32) so the same
-vmap/shard_map batching applies.
+arithmetic via word-level Montgomery reduction (REDC) over a
+parameterized limb layout (fabric_tpu.ops.limb.LimbLayout): W=13-bit
+int32 limbs with the limb COUNT derived from the modulus width, so the
+same vmap/shard_map batching serves 251..256-bit primes (the
+historical 20-limb layout, bit-identical) and BLS12-381's 381-bit
+field (30 limbs) alike.
 
-Value discipline (all bounds proven for 2^250 < m < 2^256):
+Value discipline (all bounds proven per layout; R = 2^(W*L)):
   * Every value is kept < 2m with limbs in [0, 2^13] (redundant top ok).
-  * mul: T = a*b < 4m^2 < m*R (since 4m < R=2^260), so one REDC pass
-    returns < 2m. Column accumulators stay < 2^31: the product is
-    carried to 13-bit limbs first, then each of the L reduction steps
-    adds u_i*m (u_i < 2^13) — a column receives at most L such terms
-    (L * 2^26 ~ 2^30.4) plus propagated carries.
+  * mul: T = a*b < 4m^2 < m*R (the layout guarantees 4m < R), so one
+    REDC pass returns < 2m. Column accumulators stay < 2^31: the
+    product is carried to 13-bit limbs first, then each of the L
+    reduction steps adds u_i*m (u_i < 2^13) — a column receives at
+    most L such terms plus propagated carries, which is exactly the
+    bound LimbLayout re-derives (and rejects) per limb count.
   * add: a + b < 4m, one conditional subtract of 2m -> < 2m.
   * sub: a + off4m - b with off4m = 4m redistributed so every limb
     covers the corresponding limb of any carried value < 2m; result
@@ -25,46 +29,62 @@ lane-wise select), exactly like the P-256 path.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import numpy as np
 
 import jax.numpy as jnp
 
 from fabric_tpu.ops import limb
-from fabric_tpu.ops.limb import L, MASK, W, carry3, mul_columns
+from fabric_tpu.ops.limb import MASK, W, carry3, mul_columns
 
 
 class MontMod:
-    """Montgomery context for an odd modulus m, 2^250 < m < 2^256.
+    """Montgomery context for an odd modulus m.
+
+    `layout` pins the limb geometry; None derives the smallest layout
+    covering the modulus width (which is the historical 20-limb layout
+    for every 251..258-bit modulus — no numerical change for the
+    P-256/Ed25519/BN254 kernels). A layout too narrow for 4m < R, or
+    wide enough to overflow int32 column accumulation, fails loudly.
 
     `unroll=False` emits the REDC sweep as one lax.fori_loop body with
     dynamic slices instead of L unrolled update steps — ~20x smaller
-    HLO per multiply, which keeps deep towers (the BN254 pairing's
+    HLO per multiply, which keeps deep towers (the pairing curves'
     hundreds of muls per Miller step) compilable in minutes instead of
     hours; the unrolled form optimizes better for shallow kernels.
     """
 
-    def __init__(self, m: int, unroll: bool = True):
-        if not (1 << 250) < m < (1 << 256):
-            raise ValueError("MontMod supports 251..256-bit moduli")
+    def __init__(self, m: int, unroll: bool = True,
+                 layout: Optional[limb.LimbLayout] = None):
+        if m < 3:
+            raise ValueError("MontMod needs an odd modulus >= 3")
         if m % 2 == 0:
             raise ValueError("modulus must be odd")
+        if layout is None:
+            layout = limb.layout_for_bits(m.bit_length())
+        if 4 * m >= 1 << (layout.W * layout.L):
+            raise ValueError(
+                f"modulus is too wide for {layout!r}: REDC needs 4m < R")
+        self.layout = layout
+        self.L = layout.L
         self.m = m
         self.unroll = unroll
-        self.R = 1 << (W * L)                   # 2^260
-        self.m_limbs = limb.int_to_limbs(m)
-        self.two_m_limbs = limb.int_to_limbs(2 * m)
+        self.R = 1 << (W * self.L)
+        self.m_limbs = limb.int_to_limbs(m, self.L)
+        self.two_m_limbs = limb.int_to_limbs(2 * m, self.L)
         self.mprime = (-pow(m, -1, 1 << W)) % (1 << W)
         self.r_mod_m = self.R % m               # mont(1)
         self.r2_mod_m = (self.R * self.R) % m   # to-mont factor
         # 4m redistributed: limbs 0..L-2 gain 2<<W, limbs 1..L-1 lose 2,
         # so every limb dominates the corresponding limb of any carried
-        # subtrahend < 2m (limbs <= 2^13; top limb of a value < 2m is
-        # < 2m >> 247, and off's top limb is (4m >> 247) - 2 ~ 2x that).
-        off = limb.int_to_limbs(4 * m).astype(np.int64)
-        off[: L - 1] += 2 << W
+        # subtrahend < 2m (limbs <= 2^13; the top limb of a value < 2m
+        # is < 2m >> W*(L-1), and off's top limb is ~2x that).
+        off = limb.int_to_limbs(4 * m, self.L).astype(np.int64)
+        off[: self.L - 1] += 2 << W
         off[1:] -= 2
-        if not ((off[: L - 1] >= 1 << W).all()
-                and off[L - 1] > (2 * m) >> (W * (L - 1))):
+        if not ((off[: self.L - 1] >= 1 << W).all()
+                and off[self.L - 1] > (2 * m) >> (W * (self.L - 1))):
             raise ValueError("modulus shape unsupported (sub offsets)")
         if limb.limbs_to_int(off) != 4 * m:
             raise ValueError("internal: sub_off redistribution broken")
@@ -74,7 +94,7 @@ class MontMod:
 
     def to_mont(self, x: int) -> np.ndarray:
         """Python int -> canonical limbs of x*R mod m."""
-        return limb.int_to_limbs((x % self.m) * self.R % self.m)
+        return limb.int_to_limbs((x % self.m) * self.R % self.m, self.L)
 
     def from_limbs(self, a) -> int:
         """Montgomery-domain limbs -> plain Python int (for tests)."""
@@ -85,6 +105,7 @@ class MontMod:
 
     def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         """mont(a*b): inputs < 2m with 13-bit limbs; output likewise."""
+        L = self.L
         cols = mul_columns(a, b)                      # width 2L
         pad = [(0, 0)] * (cols.ndim - 1) + [(0, 2)]
         acc = carry3(jnp.pad(cols, pad))              # width 2L+2, <=2^13
@@ -113,23 +134,23 @@ class MontMod:
 
             acc = lax.fori_loop(0, L, step, acc)
         out = carry3(acc[..., L:])                    # width L+2
-        # value = T/R + (correction) < m + T/R; T < 2^520/... callers
-        # guarantee T < m*R so out < 2m and its limbs L..L+1 are zero
-        # after the conditional subtract below
+        # value = T/R + (correction) < m + T/R; callers guarantee
+        # T < m*R so out < 2m and its limbs L..L+1 are zero after the
+        # conditional subtract below
         out = self._cond_sub_2m(out)
         return out[..., :L]
 
     def add(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         s = a + b
         s = carry3(jnp.pad(s, [(0, 0)] * (s.ndim - 1) + [(0, 1)]))
-        return self._cond_sub_2m(s)[..., :L]
+        return self._cond_sub_2m(s)[..., :self.L]
 
     def sub(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         off = jnp.asarray(self.sub_off)
         s = a + off - b
         s = carry3(jnp.pad(s, [(0, 0)] * (s.ndim - 1) + [(0, 1)]))
         s = self._cond_sub_2m(self._cond_sub_2m(s))
-        return s[..., :L]
+        return s[..., :self.L]
 
     def neg(self, a: jnp.ndarray) -> jnp.ndarray:
         zero = jnp.zeros_like(a)
@@ -140,7 +161,7 @@ class MontMod:
         x >= 2m. Sequential signed borrow, lane-wise select."""
         n = x.shape[-1]
         tm = np.zeros(n, dtype=np.int32)
-        tm[:L] = self.two_m_limbs
+        tm[:self.L] = self.two_m_limbs
         d = x - jnp.asarray(tm)
         outs = []
         c = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
@@ -159,7 +180,7 @@ class MontMod:
         d = x - m_l
         outs = []
         c = jnp.zeros(x.shape[:-1], dtype=jnp.int32)
-        for i in range(L):
+        for i in range(self.L):
             t = d[..., i] + c
             outs.append(t & MASK)
             c = t >> W

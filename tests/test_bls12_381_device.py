@@ -1,0 +1,496 @@
+"""Differential tests: the round-21 BLS12-381 device pairing engine
+(ops/bls12_381_kernel.py over the 30-limb layout) vs the int
+reference, plus the TPUProvider dispatch seam behind verify_aggregate.
+
+Tier-1 keeps compiles small — tower-op jits, the final-exp program as
+data, staging/padding, and the provider seam with the kernel stubbed
+by a host REPLAY of the staged operands (the recorder-stub idiom of
+tests/test_scheme_router.py: gates, limb staging, padding and masking
+are pinned end to end bit-exactly without the multi-minute Miller-scan
+compile). The real-kernel truncated-Miller, register-machine and full
+verify_pairs parity runs are slow-marked behind FTPU_SLOW=1, mirroring
+the BN254 twins in tests/test_bn254_device.py.
+"""
+
+import os
+import random
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import pytest
+
+from fabric_tpu.bccsp.bccsp import BLSKeyGenOpts
+from fabric_tpu.bccsp.sw import SWProvider, bls_aggregate_signatures
+from fabric_tpu.bccsp.tpu import TPUProvider
+from fabric_tpu.common import faults
+from fabric_tpu.ops import bls12_381 as blsagg
+from fabric_tpu.ops import bls12_381_kernel as dev
+from fabric_tpu.ops import bls12_381_ref as ref
+from fabric_tpu.ops import tower
+
+rng = random.Random(2181)
+
+SMALL_LOOP = 0b1011010          # 6 scan steps, mixed bits
+
+_SW = SWProvider()
+_BLS = _SW.key_gen(BLSKeyGenOpts(ephemeral=True))
+
+
+def _stage2(vals):
+    F = dev.F
+    return (jnp.asarray(np.stack([F.to_mont(v[0]) for v in vals])),
+            jnp.asarray(np.stack([F.to_mont(v[1]) for v in vals])))
+
+
+def _stage6(vals):
+    return tuple(_stage2([v[c] for v in vals]) for c in range(3))
+
+
+def _stage12(vals):
+    return (_stage6([v[0] for v in vals]),
+            _stage6([v[1] for v in vals]))
+
+
+def _rnd_f2():
+    return (rng.randrange(ref.P), rng.randrange(ref.P))
+
+
+def _rnd_f12():
+    return tuple(tuple(_rnd_f2() for _ in range(3)) for _ in range(2))
+
+
+def _is_monomial(el):
+    """True when an int-reference Fp12 element is a single Fp2 * w^k
+    monomial — the only divergence the device Miller loop is allowed
+    vs the reference (twist scalings the final exp kills)."""
+    coeffs = [c for half in el for c in half]
+    nz = [i for i, c in enumerate(coeffs) if c != ref.F2_ZERO]
+    return len(nz) == 1
+
+
+class TestTowerOps381:
+    """The generic tower (ops/tower.py) instantiated on the 30-limb /
+    381-bit field with the M-type twist — the same literal class the
+    BN254 parity suite pins on 20 limbs. Only f2_mul rides jax.jit
+    here: 30-limb compiles are minutes-per-op on single-core CI rigs
+    (measured: f6_mul 64s, f12-level unbounded), so the wider ops run
+    eager — identical traced graph, op-by-op execution — and the
+    compile seam itself is pinned once at the f2 level plus by the
+    BN254 twins."""
+
+    def test_f2_mul_matches_reference_jitted(self):
+        B = 2
+        a2, b2 = [_rnd_f2() for _ in range(B)], [_rnd_f2()
+                                                for _ in range(B)]
+        got = jax.jit(dev.f2_mul)(_stage2(a2), _stage2(b2))
+        F = dev.F
+        for i in range(B):
+            want = ref.f2_mul(a2[i], b2[i])
+            assert (F.from_limbs(np.asarray(got[0][i])),
+                    F.from_limbs(np.asarray(got[1][i]))) == want
+
+    def test_f6_f12_mul_match_reference(self):
+        F = dev.F
+        a6 = [tuple(_rnd_f2() for _ in range(3))]
+        b6 = [tuple(_rnd_f2() for _ in range(3))]
+        a12, b12 = [_rnd_f12()], [_rnd_f12()]
+        with jax.disable_jit():
+            got6 = dev.f6_mul(_stage6(a6), _stage6(b6))
+            got12 = dev.f12_mul(_stage12(a12), _stage12(b12))
+        want = ref.f6_mul(a6[0], b6[0])
+        got_0 = tuple(
+            (F.from_limbs(np.asarray(got6[c][0][0])),
+             F.from_limbs(np.asarray(got6[c][1][0])))
+            for c in range(3))
+        assert got_0 == want, "f6"
+        assert dev.f12_from_device(got12)[0] \
+            == ref.f12_mul(a12[0], b12[0]), "f12"
+
+    def test_f12_frob_conj_match_reference(self):
+        a12 = [_rnd_f12()]
+        staged = _stage12(a12)
+        with jax.disable_jit():
+            frob = dev.f12_frob(staged)
+            conj = dev.f12_conj(staged)
+        assert dev.f12_from_device(frob)[0] == ref.f12_frob(a12[0])
+        assert dev.f12_from_device(conj)[0] == ref.f12_conj(a12[0])
+
+    def test_gt_is_one(self):
+        staged = _stage12([ref.F12_ONE, _rnd_f12()])
+        with jax.disable_jit():
+            out = np.asarray(dev.gt_is_one(staged))
+        assert out.tolist() == [True, False]
+
+
+class TestFinalExpProgram:
+    """The HHT-chain register program as DATA — the scan that runs it
+    is pinned by the BN254 suite; here the program itself is checked
+    against the register-machine invariants."""
+
+    def test_program_structure(self):
+        prog = dev.final_exp_program()
+        assert prog.ndim == 2 and prog.shape[1] == 4
+        ops = set(prog[:, 0].tolist())
+        assert ops <= {tower.OP_MUL, tower.OP_CONJ, tower.OP_FROB}
+        assert int(prog[:, 1:].max()) < tower.NREG
+        assert int(prog[:, 1:].min()) >= 0
+        # the verdict register: the last instruction lands in reg 0
+        assert int(prog[-1][1]) == 0
+
+    def test_program_scales_with_u(self):
+        tiny = dev.final_exp_program(0b11)
+        full = dev.final_exp_program()
+        assert tiny.shape[0] < full.shape[0]
+        # default module program is the pinned full-u chain
+        assert np.array_equal(full, dev._FINAL_EXP_PROGRAM)
+
+    def test_full_program_emulates_to_the_pinned_ref_chain(self):
+        """Execute the full-u device program on HOST bigints — the
+        program is pure data (MUL/CONJ/FROB over NREG registers), so
+        an int interpreter pins every instruction against the pinned
+        reference chain with no compile at all. The scan that runs it
+        on device is the BN254-pinned tower.run_final_exp; the
+        device-vs-ref parity of the three opcodes is TestTowerOps381."""
+        f = _rnd_f12()
+        zero = ((ref.F2_ZERO,) * 3,) * 2   # device registers seed to 0
+        regs = [f, ref.f12_inv(f)] + [zero] * (tower.NREG - 2)
+        for op, dst, a, b in dev.final_exp_program().tolist():
+            if op == tower.OP_MUL:
+                regs[dst] = ref.f12_mul(regs[a], regs[b])
+            elif op == tower.OP_CONJ:
+                regs[dst] = ref.f12_conj(regs[a])
+            else:
+                regs[dst] = ref.f12_frob(regs[a])
+        assert regs[0] == ref.final_exponentiation_chain(f)
+
+    def test_chain_oracle_accepts_pairing_values_only(self):
+        """The host oracle the device program mirrors: chain == fast^3
+        sends genuine pairing products to ONE and random garbage
+        elsewhere (gcd(3, r) = 1 makes the verdicts equivalent)."""
+        sk, pk = ref.bls_keygen(b"chain-oracle")
+        msg = b"m"
+        sig = ref.bls_sign(sk, msg)
+        f = ref.f12_mul(
+            ref.miller_loop(ref.g2_neg((ref.G2_X, ref.G2_Y)), sig),
+            ref.miller_loop(pk, ref.hash_to_g1(msg)))
+        assert ref.final_exponentiation_chain(f) == ref.F12_ONE
+        assert ref.final_exponentiation_chain(_rnd_f12()) \
+            != ref.F12_ONE
+
+
+class TestStagePairs:
+    def test_pads_to_power_of_two_with_masked_filler(self):
+        sk, pk = ref.bls_keygen(b"stage")
+        sig = ref.bls_sign(sk, b"m")
+        pairs = [(sig, ref.g2_neg((ref.G2_X, ref.G2_Y))),
+                 (ref.hash_to_g1(b"m"), pk),
+                 (ref.G1, (ref.G2_X, ref.G2_Y))]
+        xP, yP, qx0, qx1, qy0, qy1, mask = dev.stage_pairs(pairs)
+        assert xP.shape == (4, dev.L)
+        assert mask.tolist() == [True, True, True, False]
+        F = dev.F
+        for i, (p, q) in enumerate(pairs):
+            assert F.from_limbs(xP[i]) == p[0]
+            assert F.from_limbs(yP[i]) == p[1]
+            assert F.from_limbs(qx0[i]) == q[0][0]
+            assert F.from_limbs(qy1[i]) == q[1][1]
+        # the masked filler lane still holds VALID curve points (the
+        # kernel runs them through the scan before masking them out)
+        assert F.from_limbs(xP[3]) == ref.G1[0]
+        assert F.from_limbs(qx0[3]) == ref.G2_X[0]
+
+    def test_non_dividing_tails(self):
+        one = [(ref.G1, (ref.G2_X, ref.G2_Y))]
+        for n, want in ((1, 1), (2, 2), (3, 4), (5, 8), (8, 8)):
+            staged = dev.stage_pairs(one * n)
+            assert staged[0].shape[0] == want, n
+            assert staged[6].sum() == n
+        staged = dev.stage_pairs(one * 3, pad_to=16)
+        assert staged[0].shape[0] == 16
+        assert staged[6].tolist() == [True] * 3 + [False] * 13
+        with pytest.raises(AssertionError):
+            dev.stage_pairs(one * 3, pad_to=2)     # too small
+        with pytest.raises(AssertionError):
+            dev.stage_pairs(one * 3, pad_to=6)     # not a power of 2
+
+
+def _host_replay(xP, yP, qx0, qx1, qy0, qy1, mask):
+    """Replay the STAGED device operands through the int reference —
+    pins staging (limb encoding, padding, masking) end to end without
+    the Miller-scan compile."""
+    F = dev.F
+    mask = np.asarray(mask)
+    pairs = []
+    for i in range(mask.shape[0]):
+        if not mask[i]:
+            continue
+        p = (F.from_limbs(np.asarray(xP[i])),
+             F.from_limbs(np.asarray(yP[i])))
+        q = ((F.from_limbs(np.asarray(qx0[i])),
+              F.from_limbs(np.asarray(qx1[i]))),
+             (F.from_limbs(np.asarray(qy0[i])),
+              F.from_limbs(np.asarray(qy1[i]))))
+        pairs.append((p, q))
+    ok = blsagg.check_products(blsagg.miller_products(pairs))
+    return np.asarray([ok])
+
+
+def _device_provider(**kw):
+    """A provider whose BLS pairing knob is FORCED on (the CPU
+    auto-knob would route everything host) with the small-batch gate
+    floored so 2-pair aggregates reach the dispatch."""
+    kw.setdefault("min_batch", 1)
+    kw.setdefault("use_g16", False)
+    kw.setdefault("pipeline_chunk", 0)
+    kw.setdefault("bls_pairing", True)
+    return TPUProvider(**kw)
+
+
+def _aggregate(n, forge=None):
+    msgs = [b"blk %d" % i for i in range(n)]
+    agg = bls_aggregate_signatures([_SW.sign(_BLS, m) for m in msgs])
+    keys = [_BLS.public_key()] * n
+    if forge is not None:
+        msgs = msgs[:forge] + [b"forged"] + msgs[forge + 1:]
+    return keys, msgs, agg
+
+
+class TestProviderSeam:
+    """TPUProvider.verify_aggregate -> _bls_pairing_check ->
+    _dispatch_bls_pairing with the kernel stubbed by the host replay:
+    routing, staging, counters, faults, breaker re-entry."""
+
+    def _stub(self, tpu, record, fn=_host_replay):
+        def stub_fn(*args):
+            record.append(np.asarray(args[-1]).copy())   # the mask
+            return fn(*args)
+        # pre-populating the jit cache keeps the stub un-traced (a
+        # host replay cannot run under jax.jit); the _jit seam itself
+        # is pinned separately below
+        for bucket in (1, 2, 4, 8, 16):
+            tpu._qtab_fns[("bls_pairing", bucket)] = stub_fn
+
+    def test_accept_reject_bit_identical_via_device_path(self):
+        faults.clear()
+        tpu = _device_provider()
+        masks = []
+        self._stub(tpu, masks)
+        keys, msgs, agg = _aggregate(3)
+        assert tpu.verify_aggregate(keys, msgs, agg) is True
+        assert _SW.verify_aggregate(keys, msgs, agg) is True
+        fkeys, fmsgs, fagg = _aggregate(3, forge=1)
+        assert tpu.verify_aggregate(fkeys, fmsgs, fagg) is False
+        assert _SW.verify_aggregate(fkeys, fmsgs, fagg) is False
+        # adversarial vectors die at the gates, before the device
+        assert tpu.verify_aggregate(keys, msgs, b"\x01" * 96) is False
+        assert tpu.verify_aggregate(keys, msgs, b"short") is False
+        assert len(masks) == 2          # only the staged calls
+        # 3 keys + the aggregate-signature pair -> 4 lanes, all live
+        assert masks[0].tolist() == [True] * 4
+        assert tpu.stats["pairing_batches"] == 2
+        assert tpu.stats["pairing_pairs"] == 8
+        assert tpu.stats["pairing_fallbacks"] == 0
+        # gate-rejected vectors return before the counter (the
+        # pre-round-21 semantics): only the 2 staged checks count
+        assert tpu.stats["bls_aggregate_checks"] == 2
+
+    def test_non_dividing_tail_pads_and_masks(self):
+        faults.clear()
+        tpu = _device_provider()
+        masks = []
+        self._stub(tpu, masks)
+        keys, msgs, agg = _aggregate(4)      # 5 pairs -> bucket 8
+        assert tpu.verify_aggregate(keys, msgs, agg) is True
+        assert masks[0].shape == (8,)
+        assert masks[0].tolist() == [True] * 5 + [False] * 3
+        assert tpu.stats["pairing_pairs"] == 5   # real pairs only
+
+    def test_small_batch_gate_routes_host(self):
+        faults.clear()
+        tpu = _device_provider(min_batch=16)     # gate at 4 pairs
+        masks = []
+        self._stub(tpu, masks)
+        keys, msgs, agg = _aggregate(2)          # 3 pairs < gate
+        assert tpu.verify_aggregate(keys, msgs, agg) is True
+        assert not masks
+        assert tpu.stats["pairing_batches"] == 0
+        # policy routing is not a demotion
+        assert tpu.stats["pairing_fallbacks"] == 0
+
+    def test_knob_resolution(self, monkeypatch):
+        monkeypatch.delenv("FTPU_BLS_DEVICE", raising=False)
+        assert TPUProvider(min_batch=1)._bls_pairing_enabled() \
+            is TPUProvider._on_tpu()
+        assert _device_provider()._bls_pairing_enabled() is True
+        monkeypatch.setenv("FTPU_BLS_DEVICE", "0")
+        assert _device_provider()._bls_pairing_enabled() is False
+        monkeypatch.setenv("FTPU_BLS_DEVICE", "1")
+        assert TPUProvider(min_batch=1)._bls_pairing_enabled() is True
+
+    def test_jit_seam_compiles_through_recorder(self):
+        """The real dispatch path (no pre-seeded cache): a traceable
+        stand-in kernel rides self._jit, so the compile lands in the
+        device-cost recorder and the qtab cache under the bucket key."""
+        faults.clear()
+        tpu = _device_provider()
+        tpu._qtab_fns.clear()
+
+        def fake_kernel(xP, yP, qx0, qx1, qy0, qy1, mask,
+                        loop=ref.X_BLS):
+            return jnp.ones((1,), dtype=bool)
+
+        orig = dev.pairs_product_is_one
+        dev.pairs_product_is_one = fake_kernel
+        try:
+            keys, msgs, agg = _aggregate(3)
+            assert tpu.verify_aggregate(keys, msgs, agg) is True
+        finally:
+            dev.pairs_product_is_one = orig
+        assert ("bls_pairing", 4) in tpu._qtab_fns
+        assert any(e["kind"] == "bls_pairing"
+                   for e in tpu.device_cost.events)
+
+    def test_device_failure_demotes_bit_identical_then_reenters(self):
+        faults.clear()
+        tpu = _device_provider()
+        masks = []
+
+        def boom(*args):
+            raise RuntimeError("synthetic device loss")
+
+        self._stub(tpu, masks, fn=boom)
+        keys, msgs, agg = _aggregate(3)
+        # the dispatch raises -> staged HOST path, verdict unchanged
+        assert tpu.verify_aggregate(keys, msgs, agg) is True
+        assert tpu.stats["pairing_fallbacks"] == 1
+        assert tpu.stats["sw_fallbacks"] == 1
+        assert tpu.stats["pairing_batches"] == 0
+        fkeys, fmsgs, fagg = _aggregate(3, forge=0)
+        assert tpu.verify_aggregate(fkeys, fmsgs, fagg) is False
+        # breaker re-entry: heal the stub, the kernel serves again
+        self._stub(tpu, masks)
+        assert tpu.verify_aggregate(keys, msgs, agg) is True
+        assert tpu.stats["pairing_batches"] == 1
+
+    def test_armed_bls_aggregate_fault_serves_sw_bit_identical(self):
+        faults.clear()
+        try:
+            tpu = _device_provider()
+            masks = []
+            self._stub(tpu, masks)
+            keys, msgs, agg = _aggregate(3)
+            faults.arm("tpu.bls_aggregate", mode="error", count=2)
+            assert tpu.verify_aggregate(keys, msgs, agg) is True
+            fkeys, fmsgs, fagg = _aggregate(3, forge=2)
+            assert tpu.verify_aggregate(fkeys, fmsgs, fagg) is False
+            # the armed fault fires ABOVE the pairing dispatch: the
+            # whole staged path is skipped, sw serves
+            assert not masks
+            assert tpu.stats["sw_fallbacks"] == 2
+            # exhausted arming: the device path serves again
+            assert tpu.verify_aggregate(keys, msgs, agg) is True
+            assert len(masks) == 1
+            assert tpu.stats["pairing_batches"] == 1
+        finally:
+            faults.clear()
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("FTPU_SLOW") != "1",
+    reason="heavy differential; set FTPU_SLOW=1 (multi-minute eager "
+           "scan over 30-limb Fp12 ops)")
+class TestMillerLoop381:
+    def test_truncated_loop_matches_reference_up_to_monomial(self):
+        """Eager (interpret-mode) truncated Miller scan vs the int
+        reference: the device/ref ratio must stay a single Fp2 * w^k
+        monomial — exactly the M-type twist scaling the final
+        exponentiation kills (asserted too)."""
+        sk, pk = ref.bls_keygen(b"kern")
+        msg = b"smoke"
+        sig = ref.bls_sign(sk, msg)
+        pairs = [(sig, ref.g2_neg((ref.G2_X, ref.G2_Y))),
+                 (ref.hash_to_g1(msg), pk)]
+        staged = dev.stage_pairs(pairs)
+        with jax.disable_jit():
+            f = dev.miller_loop_batch(
+                jnp.asarray(staged[0]), jnp.asarray(staged[1]),
+                ((jnp.asarray(staged[2]), jnp.asarray(staged[3])),
+                 (jnp.asarray(staged[4]), jnp.asarray(staged[5]))),
+                loop=SMALL_LOOP)
+        back = dev.f12_from_device(f)
+        for i, (p, q) in enumerate(pairs):
+            want = ref.miller_loop(q, p, loop=SMALL_LOOP)
+            ratio = ref.f12_mul(back[i], ref.f12_inv(want))
+            assert _is_monomial(ratio), f"lane {i}"
+            assert ref.final_exponentiation(ratio) == ref.F12_ONE
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("FTPU_SLOW") != "1",
+    reason="heavy differential; set FTPU_SLOW=1 (multi-minute "
+           "register-machine compile on small rigs)")
+class TestRegisterMachine381:
+    def test_f12_inv_matches_reference(self):
+        """30-limb Fermat inversion (the 381-bit pow_scan) — too slow
+        for tier-1 either eager (~380 eager Montgomery muls) or
+        compiled on 1-core rigs; the 20-limb twin is tier-1 in the
+        BN254 suite."""
+        a12 = [_rnd_f12()]
+        back = dev.f12_from_device(jax.jit(dev.f12_inv)(_stage12(a12)))
+        assert back[0] == ref.f12_inv(a12[0])
+
+    def test_small_u_program_matches_host_chain(self):
+        """The register machine run with a tiny exponent vs a host
+        emulation of the SAME chain — pins the program generator AND
+        the device machine together (jit: the eager scan is hours of
+        op-by-op 30-limb Fp12 dispatches; compile is body-sized)."""
+        U = 0b1101
+        prog = dev.final_exp_program(U)
+
+        def chain_u(f, u):
+            m = ref.f12_mul(ref.f12_conj(f), ref.f12_inv(f))
+            m = ref.f12_mul(ref._frob2(m), m)
+            t0 = ref.f12_mul(ref.f12_pow(m, u), m)
+            y1 = ref.f12_mul(ref.f12_pow(t0, u), t0)
+            y2 = ref.f12_mul(ref.f12_conj(ref.f12_pow(y1, u)),
+                             ref.f12_frob(y1))
+            y3 = ref.f12_mul(ref.f12_mul(
+                ref.f12_pow(ref.f12_pow(y2, u), u), ref._frob2(y2)),
+                ref.f12_conj(y2))
+            m3 = ref.f12_mul(ref.f12_mul(m, m), m)
+            return ref.f12_mul(y3, m3)
+
+        f = _rnd_f12()
+        got = jax.jit(
+            lambda s: dev.final_exp_batch(s, program=prog)
+        )(_stage12([f]))
+        assert dev.f12_from_device(got)[0] == chain_u(f, U)
+        # and the emulation at the REAL u is the pinned ref chain
+        assert chain_u(f, ref.X_BLS) \
+            == ref.final_exponentiation_chain(f)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("FTPU_SLOW") != "1",
+    reason="full-length BLS Miller + final-exp compile; set "
+           "FTPU_SLOW=1 (device rigs / long budget)")
+class TestFullPipeline381:
+    def test_verify_pairs_accept_reject(self):
+        """The real kernel end to end at the full loop count: one
+        compiled program, accept AND reject verdicts bit-identical to
+        the staged host path."""
+        sk, pk = ref.bls_keygen(b"full")
+        msgs = [b"m1", b"m2", b"m3"]
+        sigs = [ref.bls_sign(sk, m) for m in msgs]
+        agg = ref.bls_aggregate(sigs)
+        good = blsagg.stage_pairs([pk] * 3, msgs, agg)
+        assert dev.verify_pairs(good) is True
+        bad = blsagg.stage_pairs([pk] * 3,
+                                 [b"m1", b"forged", b"m3"], agg)
+        assert dev.verify_pairs(bad) is False

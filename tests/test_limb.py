@@ -161,6 +161,68 @@ class TestWordRepack:
             assert limb.limbs_to_int(out[bi]) == int.from_bytes(d, "big")
 
 
+class TestLimbLayout:
+    """Round-21: the parameterized limb geometry and its re-derived
+    int32 column bounds."""
+
+    def test_256bit_widths_resolve_to_the_default_layout(self):
+        # every historical modulus width lands on THE default
+        # instance — existing kernels are bit-identical by identity
+        for bits in (251, 256, 258):
+            assert limb.layout_for_bits(bits) is limb.DEFAULT_LAYOUT
+        assert limb.DEFAULT_LAYOUT.L == limb.L
+        assert limb.DEFAULT_LAYOUT.W == limb.W
+        assert limb.DEFAULT_LAYOUT.MASK == limb.MASK
+        assert limb.DEFAULT_LAYOUT.PROD == limb.PROD
+
+    def test_381bit_width_needs_30_limbs(self):
+        lay = limb.layout_for_bits(381)
+        assert (lay.L, lay.W) == (30, 13)
+        assert lay.bits == 390
+        assert lay.max_modulus_bits() == 388
+        # Montgomery REDC headroom: 4m < R for any 381-bit modulus
+        assert 4 * ((1 << 381) - 1) < 1 << (lay.W * lay.L)
+
+    def test_int32_bound_admits_31_limbs_and_rejects_32(self):
+        limb.LimbLayout(31)                  # largest safe layout
+        with pytest.raises(ValueError, match="overflows int32"):
+            limb.LimbLayout(32)              # first overflowing one
+        # a modulus wide enough to need 32 limbs fails loudly too
+        with pytest.raises(ValueError, match="overflows int32"):
+            limb.layout_for_bits(402)
+        limb.layout_for_bits(401)            # still admissible
+
+    def test_bound_formula_matches_worst_case_column(self):
+        """The ValueError threshold IS the worst realizable column:
+        L products of two redundant (<= 2^W) limbs, plus a carried
+        limb, plus a propagated carry — anything admitted stays an
+        exact int32 sum."""
+        for lay in (limb.DEFAULT_LAYOUT, limb.layout_for_bits(381)):
+            worst = (lay.L * (1 << (2 * lay.W)) + (1 << (31 - lay.W))
+                     + (1 << lay.W))
+            assert worst < 1 << 31
+
+    def test_layout_identity(self):
+        assert limb.LimbLayout(30) == limb.layout_for_bits(381)
+        assert limb.LimbLayout(30) != limb.DEFAULT_LAYOUT
+        assert hash(limb.LimbLayout(20)) == hash(limb.DEFAULT_LAYOUT)
+        with pytest.raises(ValueError):
+            limb.layout_for_bits(0)
+        with pytest.raises(ValueError):
+            limb.LimbLayout(0)
+
+    def test_converters_take_explicit_widths(self):
+        lay = limb.layout_for_bits(381)
+        x = (1 << 380) + 12345
+        arr = limb.int_to_limbs(x, lay.L)
+        assert arr.shape == (lay.L,)
+        assert limb.limbs_to_int(arr) == x
+        batch = limb.ints_to_limbs([x, 7], lay.L)
+        assert batch.shape == (2, lay.L)
+        with pytest.raises(ValueError):
+            limb.int_to_limbs(1 << 391, lay.L)   # past 30*13 bits
+
+
 class TestModInit:
     def test_rejects_small_modulus(self):
         with pytest.raises(ValueError):

@@ -19,12 +19,14 @@ from fabric_tpu.ops import limb, mont
 BN254_P = 21888242871839275222246405745257275088696311157297823662689037894645226208583
 BN254_R = 21888242871839275222246405745257275088548364400416034343698204186575808495617
 P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+BLS381_P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
 
 rng = random.Random(31337)
 
 
-@pytest.mark.parametrize("m", [BN254_P, BN254_R, P256_P],
-                         ids=["bn254-p", "bn254-r", "p256-p"])
+@pytest.mark.parametrize("m", [BN254_P, BN254_R, P256_P, BLS381_P],
+                         ids=["bn254-p", "bn254-r", "p256-p",
+                              "bls381-p"])
 def test_mul_add_sub_chain_matches_ints(m):
     ctx = mont.MontMod(m)
     B = 5
@@ -69,3 +71,17 @@ def test_rejects_bad_moduli():
         mont.MontMod(1 << 200)          # too small
     with pytest.raises(ValueError):
         mont.MontMod((1 << 255) + 2)    # even
+
+
+def test_layout_threads_through_montmod():
+    """Round-21: MontMod derives its limb layout from the modulus
+    width and re-checks the 4m < R REDC headroom against it."""
+    ctx = mont.MontMod(BLS381_P)
+    assert ctx.L == 30
+    assert ctx.layout == limb.layout_for_bits(381)
+    assert 4 * BLS381_P < 1 << (ctx.layout.W * ctx.layout.L)
+    # the 256-bit fields keep the exact historical geometry
+    assert mont.MontMod(BN254_P).layout is limb.DEFAULT_LAYOUT
+    # forcing a too-narrow layout fails loudly, never wraps
+    with pytest.raises(ValueError):
+        mont.MontMod(BLS381_P, layout=limb.DEFAULT_LAYOUT)

@@ -280,6 +280,36 @@ else:
           "sigs/s (vs staged x", fv.get("fused_vs_staged"),
           "), host_prep_s", fv.get("host_prep_s"))
 
+# round-21 contract: the core stage's pairing regime (BLS12-381
+# batched Miller products behind verify_aggregate) reports its sweep
+# line or an explicit skip marker. On CPU rigs the marker MUST be
+# there (the 381-bit Miller scan compile is not a serving
+# configuration off-device); a run line must carry the steady pair
+# rate AND the shared-final-exp share — the amortization fact the
+# whole regime exists to book.
+pr = stages.get("pairing") or {}
+assert pr, f"no pairing stage line at all: {sorted(stages)}"
+if "skipped" in pr or "pairing_skipped" in pr:
+    skip = pr.get("skipped") or pr.get("pairing_skipped")
+    assert skip in ("env", "cpu", "budget"), \
+        f"pairing skip marker unrecognized: {pr}"
+    if not final.get("on_tpu"):
+        assert final.get("pairing_skipped") == skip, \
+            f"final aggregate lost the pairing skip marker: {final}"
+    print("bench_smoke: pairing regime skipped:", skip)
+else:
+    assert pr.get("pairing_pairs_per_s", 0) > 0, \
+        f"pairing stage line lacks throughput: {pr}"
+    assert pr.get("pairing_steady_s", 0) > 0, pr
+    share = pr.get("pairing_final_exp_share")
+    assert share is not None and 0 < share < 1, \
+        f"pairing line lacks a sane final-exp share: {pr}"
+    assert pr.get("pairing_sweep"), \
+        f"pairing line lacks the width sweep: {pr}"
+    print("bench_smoke: pairing regime",
+          pr.get("pairing_pairs_per_s"), "pairs/s,",
+          "final-exp share", share)
+
 detail = json.load(open(final["sidecar"]))
 core1 = (detail.get("stage_detail") or {}).get("core_1dev") or {}
 stats = core1.get("provider_stats") or {}

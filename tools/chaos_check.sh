@@ -12,7 +12,7 @@
 # Spec grammar: point=mode[:count][:delay_s][:arg], mode in
 # {error, delay}; the 4th field targets a check() argument (the
 # per-device points pass the full-mesh chip index).
-# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|adaptive|mesh-health|tracing|net|devicecost|e2e-trace|fused|static]
+# Usage: chaos_check.sh [all|bccsp|raft|deliver|onboarding|commit|shard|order|schemes|overload|adaptive|mesh-health|tracing|net|devicecost|e2e-trace|fused|pairing|static]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -244,6 +244,18 @@ fused() {
         tests/test_chaos.py -k "Degradation or FaultRegistry"
 }
 
+pairing() {
+    # the round-21 BLS12-381 pairing engine under fire: armed
+    # tpu.bls_aggregate faults over the device-kernel suite must
+    # serve every aggregate verdict on the host reference path
+    # BIT-IDENTICALLY, then re-enter through the breaker; kernel
+    # math tests prove the arming is inert below the provider seam.
+    run "tpu.bls_aggregate=error:2" tests/test_bls12_381_device.py \
+        tests/test_scheme_router.py -k "Aggregate or Bls or BLS"
+    run "tpu.bls_aggregate=delay:1:0.05;tpu.compile=error:1" \
+        tests/test_bls12_381_device.py
+}
+
 static() {
     # the round-8 static gate: project-invariant lint + metrics-doc
     # drift + the lock-order-sanitizer-armed threaded subset
@@ -267,10 +279,11 @@ case "${1:-all}" in
     devicecost) devicecost ;;
     e2e-trace) e2e_trace ;;
     fused) fused ;;
+    pairing) pairing ;;
     static) static ;;
     all) bccsp; raft; deliver; onboarding; commit; shard; order;
          schemes; overload; adaptive; mesh_health; tracing; net; devicecost;
-         e2e_trace; fused; static ;;
+         e2e_trace; fused; pairing; static ;;
     *) echo "unknown subset: $1" >&2; exit 2 ;;
 esac
 

@@ -89,6 +89,9 @@ REQUIRED_HOT_PATHS = {
         "_dispatch_fused_verify",
         # round-11 scheme router: the Ed25519 device dispatch span
         "_dispatch_ed25519",
+        # round-21 pairing engine: the batched BLS12-381
+        # Miller-product dispatch span
+        "_dispatch_bls_pairing",
         # round-13 elastic mesh: the degraded-mesh rebuild runs on
         # the dispatch path (admission hook, between batches) — a
         # host sync smuggled in here would stall every batch behind

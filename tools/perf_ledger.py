@@ -73,6 +73,12 @@ METRICS: dict = {
     "fused_steady_s": ("down", 20.0),
     "fused_sigs_per_s": ("up", 20.0),
     "host_prep_s": ("down", 50.0),
+    # round-21 BLS12-381 pairing engine: steady Miller-pair rate at
+    # the widest aggregate and the shared-final-exp slice of that
+    # pass (a share RISING past tolerance means the amortization the
+    # batch structure exists for is eroding)
+    "pairing_pairs_per_s": ("up", 20.0),
+    "pairing_final_exp_share": ("down", 25.0),
 }
 
 # older rounds (pre-staged bench) spelled some metrics differently;

@@ -42,7 +42,8 @@ FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
     tests/test_overload.py tests/test_device_health.py \
     tests/test_tracing.py tests/test_net_chaos.py \
     tests/test_devicecost.py tests/test_cluster_trace.py \
-    tests/test_adaptive.py tests/test_fused_verify.py
+    tests/test_adaptive.py tests/test_fused_verify.py \
+    tests/test_bls12_381_device.py
 
 echo "== static_check 4/4: perf ledger gate"
 ./tools/perf_check.sh
