@@ -343,6 +343,8 @@ class TPUProvider(api.BCCSP):
         """Resolve the use_g16 auto default: big resident tables are the
         right trade on a real TPU backend, not on CPU test meshes."""
         if self._use_g16 is None:
+            # ftpu-check: allow-lockset(idempotent memo: concurrent
+            # racers compute the same backend-derived value)
             self._use_g16 = self._on_tpu()
             logger.info("BCCSP TPU provider: use_g16 auto-resolved to %s",
                         self._use_g16)
@@ -1490,12 +1492,15 @@ class TPUProvider(api.BCCSP):
             bucket *= 2
         staged = blsk.stage_pairs(pairs, pad_to=bucket)
         key = ("bls_pairing", bucket)
-        if key not in self._qtab_fns:
-            self._qtab_fns[key] = self._jit(
-                "bls_pairing",
-                lambda xP, yP, qx0, qx1, qy0, qy1, mask:
-                blsk.pairs_product_is_one(xP, yP, qx0, qx1, qy0,
-                                          qy1, mask))
+        # _jit_lock: same discipline as _qtab_fn/_q16_fn — the
+        # jitted-fn cache is shared with the prewarm restore thread
+        with self._jit_lock:
+            if key not in self._qtab_fns:
+                self._qtab_fns[key] = self._jit(
+                    "bls_pairing",
+                    lambda xP, yP, qx0, qx1, qy0, qy1, mask:
+                    blsk.pairs_product_is_one(xP, yP, qx0, qx1, qy0,
+                                              qy1, mask))
         # ftpu-lint: allow-host-sync(single scalar verdict: the
         # call's one deliberate materialization point)
         out = np.asarray(self._qtab_fns[key](
@@ -2479,10 +2484,14 @@ class TPUProvider(api.BCCSP):
                     logger.exception("warm table restore failed for "
                                      "one set")
                 finally:
-                    self._q16_loading.discard(cache_key)
+                    # _q16_lock: the marker set is read (`in`) and
+                    # cleared by live verifiers under the cache lock
+                    with self._q16_lock:
+                        self._q16_loading.discard(cache_key)
         finally:
-            for cache_key, _ in candidates:
-                self._q16_loading.discard(cache_key)
+            with self._q16_lock:
+                for cache_key, _ in candidates:
+                    self._q16_loading.discard(cache_key)
         if warmed:
             logger.info("prewarmed Q tables for %d persisted key "
                         "set(s) from persisted bytes", warmed)
@@ -3304,13 +3313,17 @@ class TPUProvider(api.BCCSP):
                 return self._pairing_host(products)
             staged = bdev.stage_pairing_products(padded)
             key = ("pairing", nterms, bucket)
-            if key not in self._qtab_fns:
-                self._qtab_fns[key] = self._jit(
-                    "pairing",
-                    lambda xPs, yPs, Qs, Q1s, nQ2s:
-                    bdev.pairing_product_is_one(xPs, yPs, Qs, Q1s,
-                                                nQ2s))
-            out = np.asarray(self._qtab_fns[key](*staged))
+            # _jit_lock: same discipline as _qtab_fn/_q16_fn — the
+            # jitted-fn cache is shared with the prewarm restore thread
+            with self._jit_lock:
+                if key not in self._qtab_fns:
+                    self._qtab_fns[key] = self._jit(
+                        "pairing",
+                        lambda xPs, yPs, Qs, Q1s, nQ2s:
+                        bdev.pairing_product_is_one(xPs, yPs, Qs, Q1s,
+                                                    nQ2s))
+                fn = self._qtab_fns[key]
+            out = np.asarray(fn(*staged))
             # round-21: pairing_* gauges span both device pairing
             # engines (BN254 idemix products here, BLS aggregates in
             # _dispatch_bls_pairing) — pairs counts Miller pairs served
@@ -3353,11 +3366,15 @@ class TPUProvider(api.BCCSP):
             pad = [[(0, None)] * nterms] * (bucket - n)
             bits, q_flat = bdev.stage_g2_msm(list(lanes) + pad)
             key = ("g2msm", nterms, bucket)
-            if key not in self._qtab_fns:
-                self._qtab_fns[key] = self._jit("g2msm",
-                                                bdev.g2_msm_scan)
+            # _jit_lock: same discipline as _qtab_fn/_q16_fn — the
+            # jitted-fn cache is shared with the prewarm restore thread
+            with self._jit_lock:
+                if key not in self._qtab_fns:
+                    self._qtab_fns[key] = self._jit("g2msm",
+                                                    bdev.g2_msm_scan)
+                fn = self._qtab_fns[key]
             import jax.numpy as jnp
-            out = self._qtab_fns[key](
+            out = fn(
                 jnp.asarray(bits), *[jnp.asarray(a) for a in q_flat])
             return bdev.read_g2_msm(out)[:n]
         except Exception:    # noqa: BLE001

@@ -319,6 +319,8 @@ def default_signals(csp=None) -> dict:
     return sig
 
 
+# ftpu-check: allow-lockset(tick is the only mutation point, serialized
+# by the start loop; knob application is guarded by _knob_lock)
 class AdaptiveController:
     """The closed loop: signals -> hot/calm classification -> one
     bounded, hysteresis-damped knob move per tick. Clock and signal

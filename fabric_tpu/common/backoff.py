@@ -16,6 +16,8 @@ import random
 from typing import Callable, Optional
 
 
+# ftpu-check: allow-lockset(instances are thread-local to their owning
+# retry loop, never shared across threads)
 class FullJitterBackoff:
     """delay_n = uniform(0, min(base * 2^n, max)).
 

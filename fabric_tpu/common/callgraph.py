@@ -1,0 +1,729 @@
+"""callgraph — whole-program symbol table + call graph for ftpu_check.
+
+`tools/ftpu_lint.py` checks one file at a time; every rule that spans
+a *call path* (is this dispatch guarded? which thread roots reach this
+attribute write, and under which locks?) needs the project-wide view
+this module builds: every function/method/closure in `fabric_tpu/`
+indexed under a stable qualified name, call edges resolved through
+imports / `self.` / inferred attribute types, thread-spawn sites, and
+the lock contexts lexically held at every call and attribute write.
+
+Pure stdlib-`ast`, no imports of the analyzed code: the analyzer must
+stay runnable against any tree state, including one that does not
+import (exactly like ftpu_lint's `load_known_points`).
+
+Resolution is deliberately best-effort and *under*-approximate: an
+edge we cannot resolve is simply absent. Rules are written so a
+missing edge degrades to a missed finding, never a false one — with
+one exception, `bare_name_fallback`: a method call on an object of
+unknown type (`self._csp.verify_batch(...)`) resolves to every
+project function of that bare name when the name is project-unique
+enough (≤ `_FALLBACK_MAX` candidates). Duck-typed provider seams are
+exactly the edges the seam rules exist for, so the fallback earns its
+imprecision.
+
+Qualified names: `<repo-relative path>::<Outer.inner>` where the
+dotted part walks lexical nesting — classes, methods, nested defs and
+lambdas (`<lambda@LINE>`), e.g.
+`fabric_tpu/bccsp/tpu.py::TPUBCCSP.prewarm.restore`.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore",
+                   "BoundedSemaphore"}
+# method-call fallback: resolve a bare method name project-wide only
+# when it is rare enough that the edge is probably real
+_FALLBACK_MAX = 6
+# ...and never for names every container/stdlib object answers to —
+# `q.get()` resolving to a project `get` method is noise, not an edge
+_GENERIC_METHODS = {
+    "get", "put", "pop", "push", "append", "extend", "add", "remove",
+    "discard", "update", "clear", "close", "open", "start", "stop",
+    "run", "join", "send", "recv", "read", "write", "flush", "reset",
+    "wait", "notify", "notify_all", "acquire", "release", "submit",
+    "result", "cancel", "items", "keys", "values", "copy", "next",
+    "encode", "decode", "digest", "hexdigest", "count", "index",
+    "sort", "create", "load", "save", "name", "size", "info", "error",
+}
+# fallback-resolved ("weak") targets carry this marker inside the
+# resolver; CallSite stores them stripped, flagged in `.weak`
+_WEAK = "~"
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    lineno: int
+    repr: str                    # textual callee, e.g. "self._jit"
+    targets: tuple[str, ...]     # resolved callee qnames (may be empty)
+    locks: frozenset             # lock tokens lexically held here
+    weak: frozenset = frozenset()   # targets resolved by bare-name
+    #                                 fallback (duck-typed guesses)
+
+
+@dataclass
+class AttrWrite:
+    """A write to `self.<attr>` (or a mutation through it) inside a
+    method/closure of a class."""
+    cls_qname: str               # "path::ClassName"
+    attr: str
+    kind: str                    # rebind|augassign|item|mutate|delete
+    lineno: int
+    locks: frozenset             # lock tokens lexically held here
+    func: str = ""               # qname of the containing function
+    via: str = ""                # mutator method name for kind=mutate
+
+
+@dataclass
+class FunctionInfo:
+    qname: str
+    path: str                    # repo-relative, '/'-separated
+    name: str
+    cls: str | None              # qname of enclosing class, if any
+    node: object                 # ast.FunctionDef/AsyncFunctionDef/Lambda
+    lineno: int = 0
+    decorators: tuple = ()       # dotted textual decorator names
+    calls: list = field(default_factory=list)        # [CallSite]
+    writes: list = field(default_factory=list)       # [AttrWrite]
+    thread_targets: list = field(default_factory=list)
+    #                            ^ [(target_qname|None, repr, lineno)]
+
+    @property
+    def is_public(self) -> bool:
+        return not self.name.startswith("_")
+
+
+@dataclass
+class ClassInfo:
+    qname: str                   # "path::ClassName"
+    path: str
+    name: str
+    lineno: int = 0
+    bases: tuple = ()            # textual base names
+    methods: dict = field(default_factory=dict)      # name -> qname
+    attr_types: dict = field(default_factory=dict)   # attr -> cls qname
+    lock_attrs: set = field(default_factory=set)     # attrs that hold locks
+
+
+def _dotted(expr) -> str:
+    """Best-effort dotted repr of a Name/Attribute chain ("" if not
+    one). Subscripts collapse to `[]` so `self._fns[k]` keeps an
+    identity the taint pass can track."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted(expr.value)
+        return f"{base}.{expr.attr}" if base else ""
+    if isinstance(expr, ast.Subscript):
+        base = _dotted(expr.value)
+        return f"{base}[]" if base else ""
+    if isinstance(expr, ast.Call):
+        # functools.partial(fn, ...) carries fn's identity
+        fn = _dotted(expr.func)
+        if fn.endswith("partial") and expr.args:
+            return _dotted(expr.args[0])
+        return ""
+    return ""
+
+
+class Project:
+    """Parse every .py under `<root>/<package>/` and build the index.
+
+    `overrides` maps repo-relative paths to replacement source text —
+    the analyzer self-tests use it to re-analyze the live tree with a
+    fix surgically reverted (no temp checkouts)."""
+
+    def __init__(self, root: str, package: str = "fabric_tpu",
+                 overrides: dict | None = None):
+        self.root = root
+        self.package = package
+        self.sources: dict[str, str] = {}
+        self.trees: dict[str, ast.Module] = {}
+        self.parse_errors: list[tuple[str, str]] = []
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        # module rel-path -> {local alias -> repo-relative module path}
+        self.imports: dict[str, dict[str, str]] = {}
+        # module rel-path -> {alias -> external dotted module ("time")}
+        self.ext_imports: dict[str, dict[str, str]] = {}
+        self.module_functions: dict[str, dict[str, str]] = {}
+        self.module_classes: dict[str, dict[str, str]] = {}
+        self.module_locks: dict[str, set] = {}
+        self.by_bare_name: dict[str, list[str]] = {}
+        self.edges: dict[str, set] = {}
+        # edges excluding bare-name-fallback guesses: what the
+        # false-positive-averse rules (lockset, retrace) traverse
+        self.strong_edges: dict[str, set] = {}
+        overrides = overrides or {}
+        self._load(overrides)
+        self._index_defs()
+        self._infer_attr_types()
+        self._resolve_calls()
+
+    # -- loading --
+
+    def _load(self, overrides: dict) -> None:
+        pkg = os.path.join(self.root, self.package)
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, self.root).replace(os.sep,
+                                                               "/")
+                if rel in overrides:
+                    src = overrides[rel]
+                else:
+                    try:
+                        with open(full, encoding="utf-8") as f:
+                            src = f.read()
+                    except OSError as e:
+                        self.parse_errors.append((rel, str(e)))
+                        continue
+                try:
+                    tree = ast.parse(src)
+                except SyntaxError as e:
+                    self.parse_errors.append((rel, str(e)))
+                    continue
+                self.sources[rel] = src
+                self.trees[rel] = tree
+        for rel, src in overrides.items():
+            if rel in self.trees:
+                continue
+            try:
+                self.sources[rel] = src
+                self.trees[rel] = ast.parse(src)
+            except SyntaxError as e:
+                self.parse_errors.append((rel, str(e)))
+
+    def _module_rel(self, dotted: str) -> str | None:
+        """fabric_tpu.common.tracing -> fabric_tpu/common/tracing.py
+        (or the package __init__), if that file is in the project."""
+        if not dotted.startswith(self.package):
+            return None
+        rel = dotted.replace(".", "/") + ".py"
+        if rel in self.trees:
+            return rel
+        rel = dotted.replace(".", "/") + "/__init__.py"
+        if rel in self.trees:
+            return rel
+        return None
+
+    # -- pass 1: definitions, imports, locks --
+
+    def _index_defs(self) -> None:
+        for rel, tree in self.trees.items():
+            imp: dict[str, str] = {}
+            ext: dict[str, str] = {}
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        local = a.asname or a.name.split(".")[0]
+                        target = self._module_rel(a.name)
+                        if target:
+                            imp[local] = target
+                        else:
+                            ext[local] = a.name
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:      # relative: resolve against rel
+                        base = rel.rsplit("/", 1)[0]
+                        for _ in range(node.level - 1):
+                            base = base.rsplit("/", 1)[0]
+                        mod = (base.replace("/", ".")
+                               + ("." + node.module if node.module
+                                  else ""))
+                    else:
+                        mod = node.module or ""
+                    for a in node.names:
+                        local = a.asname or a.name
+                        sub = self._module_rel(f"{mod}.{a.name}")
+                        if sub:         # `from fabric_tpu.common import
+                            imp[local] = sub    # tracing`
+                            continue
+                        target = self._module_rel(mod)
+                        if target:
+                            # name defined IN a project module: record
+                            # the module; pass-2 looks the name up there
+                            imp[local] = target
+                        elif mod:
+                            ext[local] = f"{mod}.{a.name}"
+            self.imports[rel] = imp
+            self.ext_imports[rel] = ext
+            self.module_functions[rel] = {}
+            self.module_classes[rel] = {}
+            self.module_locks[rel] = set()
+            self._walk_scope(rel, tree, prefix="", cls=None)
+            # module-level lock objects (`_cfg_lock = threading.Lock()`)
+            for node in tree.body:
+                if isinstance(node, ast.Assign) and \
+                        self._is_lock_ctor(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.module_locks[rel].add(t.id)
+
+    @staticmethod
+    def _is_lock_ctor(expr) -> bool:
+        if not isinstance(expr, ast.Call):
+            return False
+        d = _dotted(expr.func)
+        last = d.rsplit(".", 1)[-1]
+        return last in _LOCK_FACTORIES
+
+    def _walk_scope(self, rel: str, node, prefix: str,
+                    cls: str | None) -> None:
+        """Index defs with lexical nesting; classes only nest at their
+        own level (methods keep the class in their dotted path)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cpath = f"{prefix}{child.name}"
+                cq = f"{rel}::{cpath}"
+                info = ClassInfo(qname=cq, path=rel, name=child.name,
+                                 lineno=child.lineno,
+                                 bases=tuple(_dotted(b)
+                                             for b in child.bases))
+                self.classes[cq] = info
+                if not prefix:
+                    self.module_classes[rel][child.name] = cq
+                self._walk_scope(rel, child, prefix=cpath + ".",
+                                 cls=cq)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                fq = f"{rel}::{prefix}{child.name}"
+                fi = FunctionInfo(
+                    qname=fq, path=rel, name=child.name, cls=cls,
+                    node=child, lineno=child.lineno,
+                    decorators=tuple(_dotted(d.func
+                                             if isinstance(d, ast.Call)
+                                             else d)
+                                     for d in child.decorator_list))
+                self.functions[fq] = fi
+                self.by_bare_name.setdefault(child.name, []).append(fq)
+                if cls is not None and prefix.endswith(
+                        self.classes[cls].name + "."):
+                    self.classes[cls].methods[child.name] = fq
+                if not prefix:
+                    self.module_functions[rel][child.name] = fq
+                self._walk_scope(rel, child,
+                                 prefix=f"{prefix}{child.name}.",
+                                 cls=cls)
+            else:
+                self._walk_scope(rel, child, prefix=prefix, cls=cls)
+
+    # -- pass 1b: attribute types + lock attributes --
+
+    def _infer_attr_types(self) -> None:
+        for cq, cls in self.classes.items():
+            for mname, fq in cls.methods.items():
+                fn = self.functions[fq]
+                for node in ast.walk(fn.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if not (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            continue
+                        if self._is_lock_ctor(node.value):
+                            cls.lock_attrs.add(t.attr)
+                            continue
+                        if isinstance(node.value, ast.Call):
+                            tq = self._resolve_class(fn.path,
+                                                     node.value.func)
+                            if tq:
+                                cls.attr_types[t.attr] = tq
+
+    def _resolve_class(self, rel: str, expr) -> str | None:
+        d = _dotted(expr)
+        if not d or "[" in d:
+            return None
+        parts = d.split(".")
+        if len(parts) == 1:
+            return self.module_classes.get(rel, {}).get(parts[0]) or \
+                self._imported_symbol(rel, parts[0], kind="class")
+        mod = self.imports.get(rel, {}).get(parts[0])
+        if mod and len(parts) == 2:
+            return self.module_classes.get(mod, {}).get(parts[1])
+        return None
+
+    def _imported_symbol(self, rel: str, name: str,
+                         kind: str = "func") -> str | None:
+        """`from fabric_tpu.x import name` — find `name` in the module
+        the import record points at."""
+        mod = self.imports.get(rel, {}).get(name)
+        if not mod:
+            return None
+        table = (self.module_classes if kind == "class"
+                 else self.module_functions)
+        got = table.get(mod, {}).get(name)
+        if got:
+            return got
+        # `import fabric_tpu.common.tracing as tracing` style records
+        # the module itself under the alias; a bare-name lookup finds
+        # nothing there
+        return None
+
+    # -- pass 2: call resolution, writes, locks, thread spawns --
+
+    def _resolve_calls(self) -> None:
+        for fq, fn in self.functions.items():
+            self._analyze_function(fn)
+        for fq, fn in self.functions.items():
+            self.edges[fq] = set()
+            self.strong_edges[fq] = set()
+            for cs in fn.calls:
+                self.edges[fq].update(cs.targets)
+                self.strong_edges[fq].update(
+                    t for t in cs.targets if t not in cs.weak)
+
+    def _lock_token(self, fn: FunctionInfo, expr) -> str | None:
+        """Token for a with-context that looks like a lock: a bare
+        Name/Attribute (never a Call — `with tracing.span(...)` is not
+        a lock). Tokens are scoped so the same lock object gets the
+        same token from every method: `self.X` -> `<class>.X`,
+        module-level `_lock` -> `<path>::_lock`."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            if fn.cls is not None:
+                return f"{fn.cls}.{expr.attr}"
+            return f"{fn.path}::self.{expr.attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks.get(fn.path, set()):
+                return f"{fn.path}::{expr.id}"
+            # a local variable bound to a lock: scope to the function
+            # so nested closures sharing the name still match
+            return f"{fn.qname}::{expr.id}"
+        if isinstance(expr, ast.Attribute):
+            d = _dotted(expr)
+            if d:
+                return f"{fn.path}::{d}"
+        return None
+
+    _MUTATORS = {"append", "extend", "insert", "add", "discard",
+                 "remove", "pop", "popitem", "clear", "update",
+                 "setdefault", "appendleft", "popleft", "put",
+                 "put_nowait"}
+
+    def _analyze_function(self, fn: FunctionInfo) -> None:
+        """One lexical walk of `fn`'s own body (nested defs excluded —
+        they are functions of their own) tracking the with-lock
+        stack; records calls, attribute writes and thread spawns."""
+        own_cls = self.classes.get(fn.cls) if fn.cls else None
+
+        def visit(node, locks: frozenset):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                return          # nested scope: analyzed separately
+            # Lambdas are NOT skipped: they are callbacks executed in
+            # the enclosing dynamic context (`breaker.guard(lambda:
+            # self._dispatch(...))`), so their calls/mutations belong
+            # to the enclosing function — including the lock stack.
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = set(locks)
+                for item in node.items:
+                    tok = self._lock_token(fn, item.context_expr)
+                    if tok:
+                        held.add(tok)
+                for item in node.items:
+                    visit(item.context_expr, locks)
+                for stmt in node.body:
+                    visit(stmt, frozenset(held))
+                return
+            if isinstance(node, ast.Call):
+                self._record_call(fn, node, locks)
+            self._record_write(fn, own_cls, node, locks)
+            for child in ast.iter_child_nodes(node):
+                visit(child, locks)
+
+        body = getattr(fn.node, "body", None)
+        if body is None:
+            return
+        for stmt in body:
+            visit(stmt, frozenset())
+
+    def _record_write(self, fn, own_cls, node, locks) -> None:
+        if own_cls is None:
+            return
+
+        def self_attr(expr):
+            if isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                return expr.attr
+            return None
+
+        hits = []       # (attr, kind, lineno)
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                a = self_attr(t)
+                if a:
+                    hits.append((a, "rebind", t.lineno))
+                elif isinstance(t, ast.Subscript):
+                    a = self_attr(t.value)
+                    if a:
+                        hits.append((a, "item", t.lineno))
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        a = self_attr(el)
+                        if a:
+                            hits.append((a, "rebind", el.lineno))
+        elif isinstance(node, ast.AugAssign):
+            a = self_attr(node.target)
+            if a:
+                hits.append((a, "augassign", node.lineno))
+            elif isinstance(node.target, ast.Subscript):
+                a = self_attr(node.target.value)
+                if a:
+                    hits.append((a, "item_aug", node.lineno))
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                a = self_attr(t)
+                if a is None and isinstance(t, ast.Subscript):
+                    a = self_attr(t.value)
+                if a:
+                    hits.append((a, "delete", t.lineno))
+        via = ""
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in self._MUTATORS:
+            a = self_attr(node.func.value)
+            # `self._joinrepo.remove(...)` on an attr with an INFERRED
+            # project class type is a method call (already a call
+            # edge), not a container mutation
+            if a and a not in own_cls.attr_types:
+                hits.append((a, "mutate", node.lineno))
+                via = node.func.attr
+        for attr, kind, lineno in hits:
+            if attr in own_cls.lock_attrs:
+                continue
+            fn.writes.append(AttrWrite(
+                cls_qname=own_cls.qname, attr=attr, kind=kind,
+                lineno=lineno, locks=locks, func=fn.qname, via=via))
+
+    def _record_call(self, fn: FunctionInfo, node: ast.Call,
+                     locks: frozenset) -> None:
+        repr_ = _dotted(node.func)
+        raw = self._resolve_call_target(fn, node.func)
+        targets = tuple(t.lstrip(_WEAK) for t in raw)
+        weak = frozenset(t[1:] for t in raw if t.startswith(_WEAK))
+        fn.calls.append(CallSite(node=node, lineno=node.lineno,
+                                 repr=repr_, targets=targets,
+                                 locks=locks, weak=weak))
+        # thread spawns: threading.Thread(target=X) / Thread(target=X)
+        tail = repr_.rsplit(".", 1)[-1]
+        if tail == "Thread":
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                tq = self._resolve_func_ref(fn, kw.value)
+                fn.thread_targets.append(
+                    (tq, _dotted(kw.value), node.lineno))
+
+    def _resolve_func_ref(self, fn: FunctionInfo, expr):
+        """Resolve a *reference* to a function (thread target, jit
+        argument): local nested def, self.method, imported name,
+        functools.partial(inner, ...)."""
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d.rsplit(".", 1)[-1] == "partial" and expr.args:
+                return self._resolve_func_ref(fn, expr.args[0])
+            return None
+        if isinstance(expr, ast.Lambda):
+            return None
+        got = self._resolve_call_target(fn, expr)
+        return got[0].lstrip(_WEAK) if got else None
+
+    def _enclosing_chain(self, fn: FunctionInfo):
+        """qnames of fn and every lexically-enclosing function, inner
+        first."""
+        local, chain = fn.qname.split("::", 1), []
+        rel = local[0]
+        parts = local[1].split(".")
+        for i in range(len(parts), 0, -1):
+            q = f"{rel}::{'.'.join(parts[:i])}"
+            if q in self.functions:
+                chain.append(q)
+        return chain
+
+    def _resolve_call_target(self, fn: FunctionInfo, func) -> list:
+        rel = fn.path
+        # plain name: nested defs in enclosing functions, then module
+        # functions, classes (ctor), then imports
+        if isinstance(func, ast.Name):
+            name = func.id
+            for enc in self._enclosing_chain(fn):
+                cand = f"{enc}.{name}"
+                if cand in self.functions:
+                    return [cand]
+            got = self.module_functions.get(rel, {}).get(name)
+            if got:
+                return [got]
+            cq = self.module_classes.get(rel, {}).get(name)
+            if cq:
+                init = self.classes[cq].methods.get("__init__")
+                return [init] if init else []
+            got = self._imported_symbol(rel, name)
+            if got:
+                return [got]
+            cq = self._imported_symbol(rel, name, kind="class")
+            if cq:
+                init = self.classes[cq].methods.get("__init__")
+                return [init] if init else []
+            return []
+        if not isinstance(func, ast.Attribute):
+            if isinstance(func, ast.Subscript):
+                return []
+            return []
+        # attribute chains
+        base, attr = func.value, func.attr
+        # self.method(...) / cls.method(...)
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            return self._resolve_method(fn.cls, attr, rel)
+        # self.X.method(...) via inferred attribute types
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id in ("self", "cls") and fn.cls:
+            cls = self.classes.get(fn.cls)
+            tq = cls.attr_types.get(base.attr) if cls else None
+            if tq:
+                return self._resolve_method(tq, attr, rel,
+                                            fallback=False)
+            return self._bare_fallback(attr)
+        # module.attr(...) through a project import
+        d = _dotted(base)
+        if d:
+            mod = self.imports.get(rel, {}).get(d.split(".")[0])
+            if mod and "." not in d:
+                got = self.module_functions.get(mod, {}).get(attr)
+                if got:
+                    return [got]
+                cq = self.module_classes.get(mod, {}).get(attr)
+                if cq:
+                    init = self.classes[cq].methods.get("__init__")
+                    return [init] if init else []
+                return []
+        # obj.method(...) on an unknown object: rare-name fallback
+        return self._bare_fallback(attr)
+
+    def _resolve_method(self, cls_qname, attr, rel,
+                        fallback=True) -> list:
+        seen = set()
+        cq = cls_qname
+        while cq and cq not in seen:
+            seen.add(cq)
+            cls = self.classes.get(cq)
+            if cls is None:
+                break
+            got = cls.methods.get(attr)
+            if got:
+                return [got]
+            # follow the first project-resolvable base
+            nxt = None
+            for b in cls.bases:
+                bq = self._resolve_class(cls.path, ast.parse(
+                    b, mode="eval").body) if b else None
+                if bq:
+                    nxt = bq
+                    break
+            cq = nxt
+        return self._bare_fallback(attr) if fallback else []
+
+    def _bare_fallback(self, name: str) -> list:
+        if name in _GENERIC_METHODS:
+            return []
+        cands = self.by_bare_name.get(name, [])
+        if 0 < len(cands) <= _FALLBACK_MAX:
+            return [_WEAK + c for c in cands]
+        return []
+
+    # -- graph helpers --
+
+    def reachable(self, roots, extra_edges=None,
+                  strong_only: bool = False) -> set:
+        """Transitive closure over resolved call edges
+        (`strong_only` skips bare-name-fallback guesses)."""
+        edges = self.strong_edges if strong_only else self.edges
+        seen: set = set()
+        stack = [r for r in roots if r in self.functions]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            for t in edges.get(q, ()):
+                if t not in seen:
+                    stack.append(t)
+            if extra_edges:
+                for t in extra_edges.get(q, ()):
+                    if t not in seen:
+                        stack.append(t)
+        return seen
+
+    def reachable_avoiding(self, roots, barrier,
+                           strong_only: bool = False) -> set:
+        """Nodes reachable from `roots` along paths on which NO node
+        (roots included) satisfies `barrier(qname)`. The seam rule's
+        core: a dispatch function in this set has at least one
+        entry path no seam dominates."""
+        edges = self.strong_edges if strong_only else self.edges
+        seen: set = set()
+        stack = [r for r in roots
+                 if r in self.functions and not barrier(r)]
+        while stack:
+            q = stack.pop()
+            if q in seen:
+                continue
+            seen.add(q)
+            for t in edges.get(q, ()):
+                if t not in seen and not barrier(t):
+                    stack.append(t)
+        return seen
+
+    def must_hold_locks(self, root, strong_only: bool = False) -> dict:
+        """Per-function MUST-held lock sets on every call path from
+        `root` (a qname, or an iterable of qnames treated as one
+        merged entry point): standard forward dataflow, meet = set
+        intersection (a lock counts only if every path from every
+        root holds it). The lockset at a callee = caller's must-set
+        ∪ locks lexically held at the call site."""
+        TOP = None                          # lattice top: all locks
+        roots = [root] if isinstance(root, str) else list(root)
+        state: dict[str, frozenset | None] = {
+            r: frozenset() for r in roots if r in self.functions}
+        work = list(state)
+        while work:
+            q = work.pop()
+            fn = self.functions.get(q)
+            if fn is None:
+                continue
+            base = state.get(q)
+            if base is None:
+                continue
+            for cs in fn.calls:
+                out = frozenset(base | cs.locks)
+                for t in cs.targets:
+                    if strong_only and t in cs.weak:
+                        continue
+                    cur = state.get(t, TOP)
+                    new = out if cur is TOP else (cur & out)
+                    if cur is TOP or new != cur:
+                        state[t] = new
+                        work.append(t)
+        return {q: (s or frozenset()) for q, s in state.items()}
+
+    def thread_spawns(self):
+        """Every resolved threading.Thread(target=...) in the tree:
+        [(spawning fn qname, target qname, lineno)]."""
+        out = []
+        for fq, fn in self.functions.items():
+            for tq, repr_, lineno in fn.thread_targets:
+                if tq is not None:
+                    out.append((fq, tq, lineno))
+        return out

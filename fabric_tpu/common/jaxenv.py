@@ -95,6 +95,8 @@ def pallas_interpret() -> bool:
     real only when a TPU backend is actually attached. FTPU_PALLAS_
     INTERPRET=0/1 overrides the autodetect for A/B runs on real chips.
     """
+    # ftpu-check: allow-retrace(compile-time config by design: the
+    # interpret flag is pinned for the process, read once per trace)
     env = os.environ.get("FTPU_PALLAS_INTERPRET")
     if env is not None:
         return env != "0"

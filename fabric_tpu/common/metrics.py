@@ -724,6 +724,8 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+# ftpu-check: allow-lockset(_last_counts is flush-loop scratch; a manual
+# flush racing the loop at worst double-counts one statsd delta)
 class StatsdProvider(PrometheusProvider):
     """Statsd backend: instruments accumulate exactly like the registry
     provider; a flush loop (or explicit `flush()`) emits the current

@@ -36,6 +36,8 @@ GAIN = "gain"
 LOSE = "lose"
 
 
+# ftpu-check: allow-lockset(deterministic state machine, no internal
+# concurrency: driven solely by LeaderElectionService._loop)
 class ElectionCore:
     """Deterministic election state machine (no clock, no IO).
 

@@ -73,6 +73,8 @@ class _PrivMetrics:
             RETRIEVE_DURATION).with_labels(*lbl)
 
 
+# ftpu-check: allow-lockset(reconcile_once is serialized by the reconcile
+# loop; a concurrent manual call at worst duplicates one fetch attempt)
 class PrivDataProvider:
     """Per-channel private-data gossip glue."""
 

@@ -36,6 +36,8 @@ def _file_name(suffix: int) -> str:
     return f"blockfile_{suffix:06d}"
 
 
+# ftpu-check: allow-lockset(single-writer store: recover/bootstrap run
+# before the channel serves; appends happen on the committer thread only)
 class BlockStore:
     """One channel's chain of blocks (reference: blockfileMgr)."""
 

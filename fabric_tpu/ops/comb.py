@@ -117,6 +117,9 @@ def g_tables() -> np.ndarray:
     measurable slice of restart-to-first-validated-block, and G is a
     universal constant."""
     import os
+    # ftpu-check: allow-retrace(compile-time config by design: the G
+    # table cache path is pinned for the process and only gates a
+    # host-side np.load, never a traced value)
     cache = os.environ.get(
         "FABRIC_TPU_GTAB_CACHE",
         os.path.expanduser("~/.cache/fabric_tpu/gtab8.npy"))

@@ -278,7 +278,9 @@ class Registrar:
         # cluster fabric for ONBOARDING pulls (join from a non-genesis
         # config block); without one, only genesis joins are possible
         self._cluster_transport = cluster_transport
-        # channel -> replication state, surfaced on /healthz
+        # channel -> replication state, surfaced on /healthz; mutated
+        # from onboarding/promotion threads — always via
+        # _note_onboarding (under _lock)
         self.onboarding_status: dict[str, str] = {}
         self._metrics_provider = metrics_provider or \
             _m.DisabledProvider()
@@ -419,7 +421,7 @@ class Registrar:
                                  channel_id)
                 return
             self._set_participation(channel_id, support)
-            self.onboarding_status.pop(channel_id, None)
+            self._note_onboarding(channel_id, None)
             logger.info("[%s] follower promoted to consenter",
                         channel_id)
         threading.Thread(target=_go, daemon=True,
@@ -440,7 +442,8 @@ class Registrar:
         except Exception:
             ledger.close()
             raise
-        self._chains[channel_id] = support
+        with self._lock:
+            self._chains[channel_id] = support
         support.chain.start()
         self._set_participation(channel_id, support)
 
@@ -553,8 +556,8 @@ class Registrar:
                     sink.bundle),
                 sink=sink,
                 metrics_provider=self._metrics_provider,
-                on_state=lambda st: self.onboarding_status.
-                __setitem__(channel_id, st))
+                on_state=lambda st: self._note_onboarding(channel_id,
+                                                          st))
             replicator.run(
                 target_height=join_block.header.number + 1,
                 stop=self._stop,
@@ -578,11 +581,11 @@ class Registrar:
                 # nothing replicated: leave no trace, allow retry
                 shutil.rmtree(channel_dir, ignore_errors=True)
                 self._joinrepo.remove(channel_id)
-                self.onboarding_status.pop(channel_id, None)
+                self._note_onboarding(channel_id, None)
             else:
                 # keep the durable verified prefix AND the join
                 # artifact: a restart or retried join resumes here
-                self.onboarding_status[channel_id] = "failed"
+                self._note_onboarding(channel_id, "failed")
             raise
         finally:
             with self._lock:
@@ -611,11 +614,24 @@ class Registrar:
         with self._lock:
             return sorted(self._chains)
 
+    def _note_onboarding(self, channel_id: str,
+                         state: Optional[str]) -> None:
+        """Single mutation point for onboarding_status outside held-
+        lock regions: the dict is written from the onboarding and
+        promotion threads and read by /healthz, so every write takes
+        _lock (None removes the entry)."""
+        with self._lock:
+            if state is None:
+                self.onboarding_status.pop(channel_id, None)
+            else:
+                self.onboarding_status[channel_id] = state
+
     def onboarding_health(self) -> Optional[str]:
         """Aggregate replication state for /healthz `components`:
         "chan1:pull chan2:verify", or None when nothing is
         onboarding."""
-        snap = dict(self.onboarding_status)
+        with self._lock:
+            snap = dict(self.onboarding_status)
         if not snap:
             return None
         return " ".join(f"{ch}:{st}" for ch, st in sorted(snap.items()))

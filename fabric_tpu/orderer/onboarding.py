@@ -476,6 +476,8 @@ class BootstrapSink:
                 self._discovery_bundle = adopted
 
 
+# ftpu-check: allow-lockset(single-threaded engine: run/step execute on
+# the one onboarding or tracking thread that owns the instance)
 class ChainReplicator:
     """The pull → verify → commit engine. One instance per channel per
     process; both the bootstrap path (registrar join from a config

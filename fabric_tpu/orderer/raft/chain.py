@@ -292,6 +292,8 @@ class _ProposalGate:
             self.stats["puts"] += 1
 
 
+# ftpu-check: allow-lockset(raft actor: state mutates only on the _run
+# loop; public submit/configure enqueue onto the internally-locked queue)
 class RaftChain:
     """consensus.Chain over the raft core."""
 

@@ -36,6 +36,8 @@ class Ready:
     soft_leader: Optional[int] = None
 
 
+# ftpu-check: allow-lockset(raft actor: every method runs on the owning
+# RaftChain._run loop; cross-thread input arrives via the event queue)
 class RaftNode:
     """One consenter's raft state. `storage` provides the persisted
     log + hard state (term, voted_for) — see storage.py."""

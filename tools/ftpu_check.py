@@ -1,0 +1,827 @@
+#!/usr/bin/env python3
+"""ftpu_check — whole-program static analysis for the fabric_tpu tree.
+
+`tools/ftpu_lint.py` enforces per-file rules against hand-maintained
+name registries; what it cannot see is a *call path*: a brand-new
+dispatch function nobody registered is silently uncovered, and the
+lock-order sanitizer (common/lockcheck.py) only observes the
+interleavings the test suite happens to execute — which is how the
+round-5 qtab-cache data race (unlocked `_qflat_cache`/`_q16_heat`
+mutation across the prewarm restore thread and live verifiers)
+survived five PRs. ftpu_check builds a project-wide symbol table and
+call graph (fabric_tpu/common/callgraph.py) and runs three
+interprocedural rules:
+
+  seam           seam-reachability: device-dispatch functions are
+                 DISCOVERED structurally (callers of `_jit`-produced
+                 callables, `jax.device_put`, `pallas_call`-built
+                 kernels, `shard_map` programs) instead of trusted
+                 from a registry, then each one is proved dominated by
+                 a breaker / fault-point / CompileRecorder / tracing
+                 seam on every call path from the public `verify*`
+                 entry points. An unguarded path is a finding
+                 (`unguarded-dispatch`). ftpu_lint's hand-maintained
+                 REQUIRED_HOT_PATHS registry is cross-checked against
+                 the discovered set, flagging drift in either
+                 direction (`registry-drift`: a registered function on
+                 no dispatch path is stale; a discovered dispatch
+                 function no registry entry dominates is uncovered).
+
+  retrace        retrace-hazard: inside any function reachable from a
+                 `_jit`/`pallas_call`/`shard_map` trace region, flag
+                 recompile/nondeterminism hazards — `time.*` /
+                 `random.*` / `os.environ` reads, iteration over
+                 unordered sets feeding shapes or static args, a
+                 Python `if`/`while` on traced array values
+                 (`jnp.*` calls in the test), and unhashable
+                 static-arg construction at jitted call sites.
+
+  lockset        lockset race: from every `threading.Thread(target=…)`
+                 root (daemon loops included) plus the public-API
+                 root, compute per-root attribute write sets and the
+                 locks held at each write — lexically AND along every
+                 call path (must-hold dataflow, meet = intersection).
+                 An attribute written from ≥2 roots with no common
+                 lock — the exact shape of the qtab bug — is a
+                 finding. Single-bytecode dict-item increments
+                 (`self.stats[k] += n`) are exempt by default: the
+                 tree's documented GIL-gauge policy (see
+                 `TPUProvider._bump_scheme`); `--strict` includes
+                 them.
+
+Waivers: `# ftpu-check: allow-<rule>(<reason>)` on the flagged line or
+the contiguous comment block above it; rule in {seam, retrace,
+lockset}; the reason is mandatory (same grammar as ftpu_lint).
+
+Baseline: pre-existing findings live in tools/ftpu_check_baseline.json
+keyed by stable fingerprints (no line numbers), each with a mandatory
+reason. New findings (not baselined, not waived) fail the gate;
+baseline entries that no longer match anything are reported as stale
+(warning by default, error with --strict-baseline). Regenerate with
+`--write-baseline` — existing reasons are preserved.
+
+Usage:
+  python tools/ftpu_check.py [--root DIR] [--rules seam,retrace,lockset]
+                             [--json] [--baseline FILE]
+                             [--write-baseline] [--strict]
+                             [--strict-baseline]
+
+Exit status: 0 clean, 1 findings, 2 usage/setup error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+from fabric_tpu.common.callgraph import Project, _dotted  # noqa: E402
+
+ALL_RULES = ("seam", "retrace", "lockset")
+DEFAULT_BASELINE = os.path.join("tools", "ftpu_check_baseline.json")
+
+# callables whose *creation* produces a device program: calling the
+# produced object is a dispatch. Matched on the last dotted component
+# so `self._jit`, `jax.jit`, bare `jit` (from jax import jit),
+# `jaxenv.shard_map` and `pl.pallas_call` all hit.
+_JIT_TAILS = {"jit", "_jit", "shard_map", "pallas_call"}
+# direct dispatch primitives: the call itself moves data / runs work
+_DISPATCH_TAILS = {"device_put", "device_put_sharded",
+                   "device_put_replicated"}
+
+_SEAM_CALL_TAILS = {"admit", "guard",            # circuit breaker
+                    "span", "observe_span", "observe_stage",
+                    "instant", "resumed",        # tracing seams
+                    "check", "fires"}            # fault points
+_SEAM_DECORATORS = {"hot_path", "traced"}
+
+_TIME_ROOTS = ("time.", "datetime.")
+_RANDOM_ROOTS = ("random.", "np.random.", "numpy.random.",
+                 "secrets.")
+
+_WAIVER_RE = re.compile(
+    r"#\s*ftpu-check:\s*allow-([a-z-]+)\(\s*(.*?)\s*\)?\s*$")
+
+
+def _own_nodes(fn_node):
+    """Walk a function's body like ast.walk but do NOT descend into
+    nested def scopes — those are functions of their own and enter
+    trace regions (or not) on their own call edges. Lambdas stay: the
+    call graph inlines them into the enclosing function."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    fingerprint: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_json(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "fingerprint": self.fingerprint,
+                "message": self.message}
+
+
+class Waivers:
+    """Per-file `# ftpu-check: allow-<rule>(reason)` comments; a
+    waiver covers its own line or the contiguous comment block
+    directly above the flagged line (ftpu_lint's grammar)."""
+
+    def __init__(self, source: str):
+        self._lines = source.splitlines()
+        self._by_line: dict[int, tuple[str, str]] = {}
+        self.malformed: list[tuple[int, str]] = []
+        for i, text in enumerate(self._lines, start=1):
+            m = _WAIVER_RE.search(text)
+            if not m:
+                continue
+            rule, reason = m.group(1), m.group(2).strip()
+            if rule not in ALL_RULES:
+                self.malformed.append(
+                    (i, f"unknown waiver `allow-{rule}` — known: "
+                        + ", ".join(f"allow-{k}" for k in ALL_RULES)))
+                continue
+            if not reason:
+                self.malformed.append(
+                    (i, "ftpu-check waiver without a reason — write "
+                        "`# ftpu-check: allow-<rule>(<why>)`"))
+                continue
+            self._by_line[i] = (rule, reason)
+
+    def _is_comment_only(self, ln: int) -> bool:
+        if not (1 <= ln <= len(self._lines)):
+            return False
+        return self._lines[ln - 1].lstrip().startswith("#")
+
+    def covers(self, rule: str, *lines: int) -> bool:
+        for ln in lines:
+            got = self._by_line.get(ln)
+            if got and got[0] == rule:
+                return True
+            cand = ln - 1
+            while self._is_comment_only(cand):
+                got = self._by_line.get(cand)
+                if got and got[0] == rule:
+                    return True
+                cand -= 1
+        return False
+
+
+# -- shared taint analysis: which expressions hold jitted callables --
+
+class _Taint:
+    """Per-project dataflow marking names/attributes that hold
+    `_jit`-produced (or `pallas_call`/`shard_map`-built) callables,
+    functions that RETURN one, and the dispatch sites that invoke
+    one. Two-and-a-half passes reach a fixpoint on this tree shape
+    (create → maybe store → call)."""
+
+    def __init__(self, project: Project):
+        self.p = project
+        self.returning_jit: set = set()     # function qnames
+        self.tainted_attrs: set = set()     # "clsq.attr" (incl. [])
+        self.dispatch_sites: dict = {}      # fn qname -> [(line, repr)]
+        self.jit_creations: dict = {}       # fn qname -> [CallSite]
+        for _ in range(3):
+            changed = self._pass()
+            if not changed:
+                break
+        self._collect_sites()
+
+    def _is_jit_call(self, call: ast.Call, repr_: str,
+                     targets) -> bool:
+        tail = repr_.rsplit(".", 1)[-1] if repr_ else ""
+        if tail in _JIT_TAILS:
+            return True
+        return any(t in self.returning_jit for t in targets)
+
+    def _expr_tainted(self, fn, expr) -> bool:
+        """Does `expr` evaluate to a jitted callable?"""
+        if isinstance(expr, ast.Call):
+            repr_ = _dotted(expr.func)
+            targets = self.p._resolve_call_target(fn, expr.func)
+            return self._is_jit_call(expr, repr_, targets)
+        d = _dotted(expr)
+        if not d:
+            return False
+        if d.startswith("self."):
+            key = d[len("self."):]
+            return fn.cls is not None and \
+                f"{fn.cls}.{key}" in self.tainted_attrs
+        return f"{fn.qname}::{d}" in self.tainted_attrs
+
+    def _pass(self) -> bool:
+        changed = False
+        for fq, fn in self.p.functions.items():
+            for node in _own_nodes(fn.node):
+                if isinstance(node, ast.Assign):
+                    if not self._expr_tainted(fn, node.value):
+                        continue
+                    for t in node.targets:
+                        d = _dotted(t)
+                        if not d:
+                            continue
+                        if d.startswith("self.") and fn.cls:
+                            key = f"{fn.cls}.{d[len('self.'):]}"
+                        else:
+                            key = f"{fq}::{d}"
+                        if key not in self.tainted_attrs:
+                            self.tainted_attrs.add(key)
+                            changed = True
+                elif isinstance(node, ast.Return) and \
+                        node.value is not None:
+                    if self._expr_tainted(fn, node.value) and \
+                            fq not in self.returning_jit:
+                        self.returning_jit.add(fq)
+                        changed = True
+        return changed
+
+    def _collect_sites(self) -> None:
+        for fq, fn in self.p.functions.items():
+            sites, creations = [], []
+            for cs in fn.calls:
+                tail = cs.repr.rsplit(".", 1)[-1] if cs.repr else ""
+                if tail in _JIT_TAILS:
+                    creations.append(cs)
+                    continue
+                if tail in _DISPATCH_TAILS:
+                    sites.append((cs.lineno, cs.repr))
+                    continue
+                # invocation of a tainted callable: tainted local /
+                # attr, or directly calling the result of a
+                # jit-returning call (`self._pipeline(K)(args...)`)
+                func = cs.node.func
+                if isinstance(func, ast.Call):
+                    if self._expr_tainted(fn, func):
+                        sites.append((cs.lineno, cs.repr or
+                                      _dotted(func) or "<jit call>"))
+                    continue
+                if self._expr_tainted(fn, func):
+                    sites.append((cs.lineno, cs.repr))
+                elif any(t in self.returning_jit for t in cs.targets):
+                    # calling a fn that returns a jitted callable is
+                    # CREATION, not dispatch
+                    creations.append(cs)
+            if sites:
+                self.dispatch_sites[fq] = sites
+            if creations:
+                self.jit_creations[fq] = creations
+
+
+# -- rule: seam --
+
+def _is_seam_bearing(fn) -> bool:
+    for dec in fn.decorators:
+        if dec.rsplit(".", 1)[-1] in _SEAM_DECORATORS:
+            return True
+    for cs in fn.calls:
+        r = cs.repr
+        if not r:
+            continue
+        tail = r.rsplit(".", 1)[-1]
+        if r.startswith("faults.") or r.startswith("tracing."):
+            if tail in _SEAM_CALL_TAILS or r.startswith("faults."):
+                return True
+        if tail in ("admit", "guard") and ("breaker" in r
+                                           or r.startswith("self.")):
+            return True
+        if tail == "_jit" or "_devicecost" in r:
+            return True
+        if tail in ("span", "observe_span", "observe_stage",
+                    "instant", "resumed"):
+            return True
+    return False
+
+
+def load_hot_path_registry(root: str):
+    """AST-parse REQUIRED_HOT_PATHS out of tools/ftpu_lint.py (no
+    import — mirrors ftpu_lint.load_known_points). Returns
+    ({path: (fn, ...)}, error)."""
+    path = os.path.join(root, "tools", "ftpu_lint.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError) as e:
+        return None, f"cannot parse {path}: {e}"
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name)
+                and t.id == "REQUIRED_HOT_PATHS"
+                for t in node.targets):
+            try:
+                return ast.literal_eval(node.value), None
+            except (ValueError, SyntaxError) as e:
+                return None, f"REQUIRED_HOT_PATHS not a literal: {e}"
+    return None, f"{path} declares no REQUIRED_HOT_PATHS registry"
+
+
+def seam_findings(project: Project, taint: _Taint, waivers,
+                  registry, registry_err) -> list:
+    out = []
+    roots = [fq for fq, fn in project.functions.items()
+             if fn.name.startswith("verify") and fn.is_public]
+
+    def seam(fq):
+        return _is_seam_bearing(project.functions[fq])
+
+    unguarded_reach = project.reachable_avoiding(roots, seam,
+                                                 strong_only=True)
+    for fq in sorted(taint.dispatch_sites):
+        fn = project.functions[fq]
+        if fq not in unguarded_reach or seam(fq):
+            continue
+        line, repr_ = taint.dispatch_sites[fq][0]
+        w = waivers.get(fn.path)
+        if w and w.covers("seam", line, fn.lineno):
+            continue
+        out.append(Finding(
+            fn.path, line, "seam",
+            f"seam:unguarded:{fn.path}::{fn.name}",
+            f"device dispatch `{repr_}` in `{fn.name}` is reachable "
+            f"from a public verify* entry point on a call path with "
+            f"NO breaker/fault-point/CompileRecorder/tracing seam — "
+            f"a device failure here skips the degrade-don't-halt "
+            f"machinery entirely"))
+
+    # registry cross-check (both directions)
+    if registry is None:
+        out.append(Finding("tools/ftpu_lint.py", 1, "seam",
+                           "seam:registry:load", registry_err))
+        return out
+    registered = {(p, f) for p, fns in registry.items() for f in fns}
+
+    def is_registered(fq):
+        fn = project.functions[fq]
+        return (fn.path, fn.name) in registered
+
+    # A) discovered dispatch functions on verify* paths that no
+    #    registry entry dominates: the "new dispatch path nobody
+    #    registered" failure mode the hand registry cannot catch
+    undominated = project.reachable_avoiding(
+        roots, lambda q: is_registered(q) or seam(q),
+        strong_only=True)
+    for fq in sorted(taint.dispatch_sites):
+        fn = project.functions[fq]
+        if fq not in undominated or is_registered(fq) or seam(fq):
+            continue
+        # nested inside a registered function counts as covered
+        # (`prewarm.restore` belongs to prewarm's entry)
+        outer = fq.split("::", 1)[1].split(".")[0]
+        if (fn.path, outer) in registered:
+            continue
+        line, repr_ = taint.dispatch_sites[fq][0]
+        w = waivers.get(fn.path)
+        if w and w.covers("seam", line, fn.lineno):
+            continue
+        out.append(Finding(
+            fn.path, line, "seam",
+            f"seam:uncovered:{fn.path}::{fn.name}",
+            f"discovered dispatch function `{fn.name}` "
+            f"(`{repr_}`) is on a verify* path but neither it nor "
+            f"any dominator is in ftpu_lint's REQUIRED_HOT_PATHS — "
+            f"register it (or the span that owns it) so the "
+            f"host-sync/span rules arm on this path"))
+    # B) registered functions no longer on any dispatch path: stale
+    #    registry entries that give false coverage confidence
+    dispatch_fns = set(taint.dispatch_sites)
+    for path, fns in sorted(registry.items()):
+        for name in fns:
+            cand = [fq for fq, fn in project.functions.items()
+                    if fn.path == path and fn.name == name]
+            if not cand:
+                continue        # missing entirely: ftpu_lint's finding
+            fq = cand[0]
+            reach = project.reachable([fq])
+            if reach & dispatch_fns:
+                continue
+            fn = project.functions[fq]
+            w = waivers.get(fn.path)
+            if w and w.covers("seam", fn.lineno):
+                continue
+            out.append(Finding(
+                path, fn.lineno, "seam",
+                f"seam:stale:{path}::{name}",
+                f"registry drift: REQUIRED_HOT_PATHS entry `{name}` "
+                f"no longer reaches any discovered device-dispatch "
+                f"site — if the dispatch moved, re-register the new "
+                f"span; if the path is host-only now, drop the entry "
+                f"(or waive with a reason)"))
+    return out
+
+
+# -- rule: retrace --
+
+def _trace_region(project: Project, taint: _Taint) -> dict:
+    """qname -> entry qname, for every function inside a trace
+    region: functions passed to jit/shard_map/pallas_call plus their
+    transitive project callees."""
+    entries = []
+    for fq, creations in taint.jit_creations.items():
+        fn = project.functions[fq]
+        for cs in creations:
+            args = list(cs.node.args) + [kw.value
+                                         for kw in cs.node.keywords]
+            for a in args:
+                ref = project._resolve_func_ref(fn, a)
+                if ref is not None:
+                    entries.append(ref)
+    region: dict = {}
+    for entry in entries:
+        for fq in project.reachable([entry], strong_only=True):
+            region.setdefault(fq, entry)
+    return region
+
+
+def retrace_findings(project: Project, taint: _Taint,
+                     waivers) -> list:
+    out = []
+    region = _trace_region(project, taint)
+
+    def emit(fn, line, kind, token, msg):
+        w = waivers.get(fn.path)
+        if w and w.covers("retrace", line):
+            return
+        out.append(Finding(
+            fn.path, line, "retrace",
+            f"retrace:{kind}:{fn.path}::{fn.name}:{token}", msg))
+
+    for fq in sorted(region):
+        fn = project.functions[fq]
+        entry = project.functions[region[fq]]
+        where = (f"`{fn.name}` (traced via `{entry.name}`)"
+                 if fq != region[fq] else f"traced `{fn.name}`")
+        for cs in fn.calls:
+            r = cs.repr
+            if not r:
+                continue
+            if r.startswith(_TIME_ROOTS) or r in ("time",):
+                emit(fn, cs.lineno, "clock", r,
+                     f"{r}() inside {where}: wall-clock reads bake a "
+                     f"trace-time constant into the compiled program "
+                     f"(silent staleness) or retrigger compilation")
+            elif r.startswith(_RANDOM_ROOTS):
+                emit(fn, cs.lineno, "random", r,
+                     f"{r}() inside {where}: host randomness is "
+                     f"nondeterministic across traces — use jax.random "
+                     f"with an explicit key")
+            elif r in ("os.getenv", "os.environ.get"):
+                emit(fn, cs.lineno, "environ", r,
+                     f"{r}() inside {where}: an environment read at "
+                     f"trace time is a hidden static argument — "
+                     f"resolve it before the trace region")
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Subscript) and \
+                    _dotted(node.value) == "os.environ":
+                emit(fn, node.lineno, "environ", "os.environ[]",
+                     f"os.environ[...] inside {where}: an environment "
+                     f"read at trace time is a hidden static argument")
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                is_set = isinstance(it, ast.Set) or (
+                    isinstance(it, ast.Call)
+                    and _dotted(it.func).rsplit(".", 1)[-1]
+                    in ("set", "frozenset"))
+                if is_set:
+                    ln = getattr(node, "lineno", it.lineno)
+                    emit(fn, ln, "set-iter", "set",
+                         f"iteration over an unordered set inside "
+                         f"{where}: element order varies per process "
+                         f"and feeds shapes/static args — sort it "
+                         f"(`sorted(...)`) for a deterministic trace")
+            elif isinstance(node, (ast.If, ast.While)):
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Call) and (
+                            _dotted(sub.func).startswith("jnp.")
+                            or _dotted(sub.func).startswith(
+                                "jax.numpy.")):
+                        emit(fn, node.lineno, "traced-branch",
+                             _dotted(sub.func),
+                             f"Python `{type(node).__name__.lower()}` "
+                             f"on a traced value "
+                             f"(`{_dotted(sub.func)}`) inside "
+                             f"{where}: this raises "
+                             f"TracerBoolConversionError or forces a "
+                             f"retrace — use jnp.where/lax.cond")
+                        break
+    out += _static_arg_findings(project, waivers)
+    return out
+
+
+def _static_arg_findings(project: Project, waivers) -> list:
+    """Unhashable static-arg construction: a jit creation declaring
+    static_argnums, whose produced callable is invoked in the same
+    function with a list/dict/set literal in a static position —
+    guaranteed `TypeError: unhashable type` at the first dispatch."""
+    out = []
+    for fq, fn in project.functions.items():
+        static_of: dict[str, tuple] = {}
+        for node in _own_nodes(fn.node):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                tail = _dotted(node.value.func).rsplit(".", 1)[-1]
+                if tail not in _JIT_TAILS:
+                    continue
+                nums = None
+                for kw in node.value.keywords:
+                    if kw.arg == "static_argnums":
+                        try:
+                            v = ast.literal_eval(kw.value)
+                            nums = (v,) if isinstance(v, int) \
+                                else tuple(v)
+                        except (ValueError, SyntaxError):
+                            pass
+                if nums is None:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        static_of[t.id] = nums
+        if not static_of:
+            continue
+        for node in _own_nodes(fn.node):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in static_of):
+                continue
+            for idx in static_of[node.func.id]:
+                if idx < len(node.args) and isinstance(
+                        node.args[idx], (ast.List, ast.Dict, ast.Set,
+                                         ast.ListComp, ast.DictComp,
+                                         ast.SetComp)):
+                    w = waivers.get(fn.path)
+                    if w and w.covers("retrace", node.lineno):
+                        continue
+                    out.append(Finding(
+                        fn.path, node.lineno, "retrace",
+                        f"retrace:unhashable-static:{fn.path}::"
+                        f"{fn.name}:{node.func.id}:{idx}",
+                        f"argument {idx} of `{node.func.id}` is "
+                        f"declared static_argnums but receives an "
+                        f"unhashable literal — jit will raise at the "
+                        f"first dispatch; pass a tuple or hoist it"))
+    return out
+
+
+# -- rule: lockset --
+
+_API_ROOT = "<public-api>"
+
+
+def lockset_findings(project: Project, waivers,
+                     strict: bool = False) -> list:
+    spawns = project.thread_spawns()
+    thread_roots = sorted({t for _, t, _ in spawns})
+    if not thread_roots:
+        return []
+
+    # per-root reachability + must-hold locksets. The synthetic
+    # public-API root models "any caller thread entering through any
+    # public function": its must-sets start empty at every public fn.
+    root_info: dict[str, tuple[set, dict]] = {}
+    for r in thread_roots:
+        must = project.must_hold_locks(r, strong_only=True)
+        root_info[r] = (set(must), must)
+    api_roots = [fq for fq, fn in project.functions.items()
+                 if fn.is_public and not fn.name.startswith("__")]
+    api_must = project.must_hold_locks(api_roots,
+                                       strong_only=True)
+    root_info[_API_ROOT] = (set(api_must), api_must)
+
+    # collect per-attribute write instances across roots
+    by_attr: dict = {}      # (cls_qname, attr) -> list of instances
+    for root, (reach, must) in root_info.items():
+        for fq in reach:
+            fn = project.functions.get(fq)
+            if fn is None or fn.name == "__init__":
+                continue        # ctor writes happen-before publication
+            for w in fn.writes:
+                if w.kind == "item_aug" and not strict:
+                    continue    # GIL-gauge increments (documented)
+                if w.via in ("put", "put_nowait", "task_done"):
+                    continue    # queue protocol: internally locked
+                eff = frozenset(w.locks | must.get(fq, frozenset()))
+                by_attr.setdefault((w.cls_qname, w.attr), []).append(
+                    (root, eff, w))
+
+    out = []
+    for (clsq, attr), insts in sorted(by_attr.items()):
+        roots = {r for r, _, _ in insts}
+        if len(roots) < 2 or not (roots - {_API_ROOT}):
+            continue
+        # drop waived write sites before judging; a waiver on the
+        # `class` line (or the comment block above it) covers every
+        # attribute of the class — the actor-model annotation
+        path = clsq.split("::")[0]
+        w0 = waivers.get(path)
+        cls_info = project.classes.get(clsq)
+        if w0 and cls_info and w0.covers("lockset", cls_info.lineno):
+            continue
+        live = [(r, eff, w) for r, eff, w in insts
+                if not (w0 and w0.covers("lockset", w.lineno))]
+        roots = {r for r, _, _ in live}
+        if len(roots) < 2 or not (roots - {_API_ROOT}):
+            continue
+        common = None
+        for _, eff, _ in live:
+            common = eff if common is None else (common & eff)
+        if common:
+            continue
+        unlocked = sorted({(w.func.split("::")[-1], w.lineno)
+                           for _, eff, w in live if not eff})
+        sample = ", ".join(f"{f}:{ln}" for f, ln in unlocked[:3]) or \
+            "all sites hold disjoint locks"
+        tnames = sorted(r.split("::")[-1] for r in roots
+                        if r != _API_ROOT)
+        cls_name = clsq.split("::")[-1]
+        line = min(w.lineno for _, _, w in live)
+        out.append(Finding(
+            path, line, "lockset",
+            f"lockset:{clsq}.{attr}",
+            f"`{cls_name}.{attr}` is written from {len(roots)} thread "
+            f"roots ({', '.join(tnames)}"
+            + (", public API" if _API_ROOT in roots else "")
+            + f") with no common lock — unlocked sites: {sample}. "
+            f"Lost updates / dict-changed-size crashes under "
+            f"concurrency (the round-5 qtab-cache bug shape); guard "
+            f"every mutation with one lock or waive with "
+            f"`# ftpu-check: allow-lockset(<reason>)`"))
+    return out
+
+
+# -- baseline --
+
+def load_baseline(path: str):
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {}, None
+    except (OSError, ValueError) as e:
+        return None, f"unreadable baseline {path}: {e}"
+    entries = {}
+    for e in data.get("entries", []):
+        fp, reason = e.get("id"), e.get("reason", "")
+        if not fp or not reason:
+            return None, (f"baseline {path}: every entry needs an "
+                          f"`id` and a non-empty `reason`")
+        entries[fp] = reason
+    return entries, None
+
+
+def write_baseline(path: str, findings, old_entries) -> None:
+    entries = []
+    for f in sorted(findings, key=lambda f: f.fingerprint):
+        entries.append({
+            "id": f.fingerprint,
+            "rule": f.rule,
+            "where": f"{f.path}:{f.line}",
+            "reason": old_entries.get(
+                f.fingerprint,
+                "TODO: justify or fix before committing"),
+        })
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": 1,
+                   "comment": "pre-existing ftpu_check findings; "
+                              "every entry carries a reviewed reason. "
+                              "Regenerate with --write-baseline "
+                              "(reasons are preserved).",
+                   "entries": entries}, f, indent=2)
+        f.write("\n")
+
+
+# -- driver --
+
+def run_check(root: str, rules=ALL_RULES, strict: bool = False,
+              overrides: dict | None = None,
+              registry: dict | None = None):
+    """Returns (findings, project). Malformed waivers and parse
+    errors surface as findings with rule `waiver` / `parse`."""
+    project = Project(root, overrides=overrides)
+    waivers = {rel: Waivers(src)
+               for rel, src in project.sources.items()}
+    findings: list[Finding] = []
+    for rel, w in sorted(waivers.items()):
+        for ln, msg in w.malformed:
+            findings.append(Finding(rel, ln, "waiver",
+                                    f"waiver:{rel}:{ln}", msg))
+    for rel, err in project.parse_errors:
+        findings.append(Finding(rel, 1, "parse",
+                                f"parse:{rel}", f"cannot parse: {err}"))
+    taint = _Taint(project)
+    if "seam" in rules:
+        if registry is None:
+            registry, registry_err = load_hot_path_registry(root)
+        else:
+            registry_err = None
+        findings += seam_findings(project, taint, waivers, registry,
+                                  registry_err)
+    if "retrace" in rules:
+        findings += retrace_findings(project, taint, waivers)
+    if "lockset" in rules:
+        findings += lockset_findings(project, waivers, strict=strict)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule)), \
+        project
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="fabric_tpu whole-program static analysis")
+    parser.add_argument("--root", default=os.path.dirname(_HERE))
+    parser.add_argument("--rules", default=",".join(ALL_RULES))
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default "
+                             f"<root>/{DEFAULT_BASELINE})")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline (report everything)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write current findings as the baseline "
+                             "(existing reasons preserved)")
+    parser.add_argument("--strict", action="store_true",
+                        help="include GIL-gauge item increments in "
+                             "the lockset rule")
+    parser.add_argument("--strict-baseline", action="store_true",
+                        help="stale baseline entries fail the gate")
+    args = parser.parse_args(argv)
+    rules = tuple(r.strip() for r in args.rules.split(",")
+                  if r.strip())
+    unknown = [r for r in rules if r not in ALL_RULES]
+    if unknown:
+        print(f"ftpu_check: unknown rule(s) {unknown}; known: "
+              f"{ALL_RULES}", file=sys.stderr)
+        return 2
+
+    findings, project = run_check(args.root, rules=rules,
+                                  strict=args.strict)
+
+    bl_path = args.baseline or os.path.join(args.root,
+                                            DEFAULT_BASELINE)
+    baseline, bl_err = ({}, None) if args.no_baseline else \
+        load_baseline(bl_path)
+    if baseline is None:
+        print(f"ftpu_check: {bl_err}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        write_baseline(bl_path, findings, baseline)
+        print(f"ftpu_check: wrote {len(findings)} baseline entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to {bl_path}")
+        return 0
+
+    new = [f for f in findings if f.fingerprint not in baseline]
+    matched = {f.fingerprint for f in findings} & set(baseline)
+    stale = sorted(set(baseline) - matched)
+
+    if args.json:
+        print(json.dumps({
+            "rules": list(rules),
+            "findings": [f.as_json() for f in new],
+            "baselined": sorted(matched),
+            "stale_baseline": stale,
+            "functions_analyzed": len(project.functions),
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        for fp in stale:
+            print(f"ftpu_check: stale baseline entry `{fp}` — the "
+                  f"finding is gone; remove it from {bl_path}"
+                  + (" (failing: --strict-baseline)"
+                     if args.strict_baseline else ""))
+    if new:
+        if not args.json:
+            print(f"ftpu_check: {len(new)} new finding(s) "
+                  f"({len(matched)} baselined)")
+        return 1
+    if stale and args.strict_baseline:
+        return 1
+    if not args.json:
+        print(f"ftpu_check: clean ({len(project.functions)} functions "
+              f"analyzed, rules: {', '.join(rules)}, "
+              f"{len(matched)} baselined"
+              + (f", {len(stale)} stale baseline entr"
+                 f"{'y' if len(stale) == 1 else 'ies'}"
+                 if stale else "") + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

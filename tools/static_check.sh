@@ -6,16 +6,26 @@
 #                                  silent-swallow, host-sync-in-hot-path
 #                                  (waiver grammar: # ftpu-lint:
 #                                  allow-<rule>(<reason>))
-#   2. gendoc --check            — docs/metrics_reference.md must match
+#   2. tools/ftpu_check.py       — whole-program call-graph rules
+#                                  (docs/static_analysis.md): seam
+#                                  reachability proofs for discovered
+#                                  device dispatch, retrace-hazard
+#                                  detection inside trace regions, and
+#                                  the cross-thread lockset race rule
+#                                  (waiver grammar: # ftpu-check:
+#                                  allow-<rule>(<reason>); reasoned
+#                                  baseline in
+#                                  tools/ftpu_check_baseline.json)
+#   3. gendoc --check            — docs/metrics_reference.md must match
 #                                  the declared *Opts literals exactly
-#   3. FTPU_LOCKCHECK=1 subset   — the threaded fast subset runs under
+#   4. FTPU_LOCKCHECK=1 subset   — the threaded fast subset runs under
 #                                  the lock-order sanitizer
 #                                  (fabric_tpu/common/lockcheck.py):
 #                                  any A→B/B→A inversion or lock held
 #                                  across a device dispatch /
 #                                  injected-fault stall FAILS the run
 #                                  (tests/conftest.py sessionfinish)
-#   4. tools/perf_check.sh       — round-16 perf ledger: the
+#   5. tools/perf_check.sh       — round-16 perf ledger: the
 #                                  BENCH_r*/MULTICHIP_r* history must
 #                                  parse into a trajectory and a
 #                                  seeded regression must be flagged
@@ -28,13 +38,16 @@ cd "$(dirname "$0")/.."
 PYTEST=(env JAX_PLATFORMS=cpu python -m pytest -q -m 'not slow'
         -p no:cacheprovider -p no:randomly)
 
-echo "== static_check 1/4: ftpu_lint"
+echo "== static_check 1/5: ftpu_lint"
 python tools/ftpu_lint.py
 
-echo "== static_check 2/4: gendoc --check"
+echo "== static_check 2/5: ftpu_check (whole-program)"
+python tools/ftpu_check.py
+
+echo "== static_check 3/5: gendoc --check"
 python -m fabric_tpu.common.gendoc --check
 
-echo "== static_check 3/4: lock-order sanitizer (threaded subset)"
+echo "== static_check 4/5: lock-order sanitizer (threaded subset)"
 FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
     tests/test_lockcheck.py tests/test_ftpu_lint.py \
     tests/test_chaos.py tests/test_commit_pipeline.py \
@@ -45,7 +58,7 @@ FTPU_LOCKCHECK=1 "${PYTEST[@]}" \
     tests/test_adaptive.py tests/test_fused_verify.py \
     tests/test_bls12_381_device.py
 
-echo "== static_check 4/4: perf ledger gate"
+echo "== static_check 5/5: perf ledger gate"
 ./tools/perf_check.sh
 
 echo "static_check: all gates green"
